@@ -1,0 +1,112 @@
+"""Benchmark: fine-tune tokens/sec/chip (the BASELINE.json metric).
+
+Runs a real Llama-style fine-tune step (forward + backward + AdamW update,
+bf16 compute / f32 masters, remat, sequence packing shapes) on the available
+TPU chip(s) and reports the BASELINE.json headline metric. The reference
+publishes no performance numbers (SURVEY.md §6, ``BASELINE.json.published ==
+{}``), so ``vs_baseline`` is reported against the forward baseline defined in
+BASELINE.md — 1.0 until a prior round's number exists to compare against.
+
+Prints exactly ONE JSON line to stdout; all logging goes to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+    import numpy as np
+
+    from ditl_tpu.config import MeshConfig, ModelConfig, TrainConfig
+    from ditl_tpu.data.loader import make_global_batch
+    from ditl_tpu.runtime.mesh import build_mesh
+    from ditl_tpu.train.state import create_train_state
+    from ditl_tpu.train.step import make_train_step
+
+    n_chips = len(jax.devices())
+    platform = jax.devices()[0].platform
+    print(f"bench: {n_chips} {platform} device(s)", file=sys.stderr)
+
+    # ~420M-param Llama-style model: big enough to exercise the MXU, small
+    # enough that params+adam state fit a single v5e chip's HBM.
+    cfg = ModelConfig(
+        name="bench-420m",
+        vocab_size=32768,
+        hidden_size=1024,
+        intermediate_size=2816,
+        num_layers=24,
+        num_heads=16,
+        num_kv_heads=8,
+        head_dim=64,
+        max_seq_len=1024,
+        dtype="bfloat16",
+        param_dtype="float32",
+        remat="full",
+    )
+    batch, seq = (8, 1024) if platform == "tpu" else (2, 128)
+    if platform != "tpu":  # CPU smoke path: shrink everything
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, num_layers=2, hidden_size=256,
+                                  intermediate_size=688, vocab_size=4096)
+    tcfg = TrainConfig(total_steps=1000, warmup_steps=10)
+    mesh = build_mesh(MeshConfig())
+
+    rng = np.random.default_rng(0)
+    host_batch = {
+        "input_ids": rng.integers(3, cfg.vocab_size, size=(batch, seq)).astype(np.int32),
+        "loss_mask": np.ones((batch, seq), np.float32),
+        "labels": np.zeros((batch,), np.int32),
+        "segment_ids": np.ones((batch, seq), np.int32),
+        "positions": np.tile(np.arange(seq, dtype=np.int32), (batch, 1)),
+    }
+    gb = make_global_batch(mesh, host_batch)
+
+    t0 = time.perf_counter()
+    state = create_train_state(jax.random.key(0), cfg, tcfg)
+    step = make_train_step(cfg, tcfg, mesh, gb)
+    state, metrics = step(state, gb)  # compile + first step
+    float(metrics["loss"])  # full host sync (block_until_ready alone does not
+    # guarantee completion through remote-device transports)
+    print(f"bench: compile+first step {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    # Time in windows of `chunk` steps with one host sync per window, so the
+    # device pipeline stays full but every window is bounded by real execution.
+    n_steps = 20 if platform == "tpu" else 5
+    chunk = 5
+    times = []
+    for _ in range(n_steps):
+        t = time.perf_counter()
+        for _ in range(chunk):
+            state, metrics = step(state, gb)
+        float(metrics["loss"])  # sync
+        times.append((time.perf_counter() - t) / chunk)
+    p50 = statistics.median(times)
+    tokens_per_step = batch * seq
+    tps_chip = tokens_per_step / p50 / n_chips
+    print(
+        f"bench: step_time_p50={p50 * 1e3:.1f}ms loss={float(metrics['loss']):.4f}",
+        file=sys.stderr,
+    )
+
+    result = {
+        "metric": "fine-tune tokens/sec/chip (Llama-style 420M, bf16, seq 1024)",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 1.0,
+        "step_time_p50_ms": round(p50 * 1e3, 2),
+        "n_chips": n_chips,
+        "platform": platform,
+        "final_loss": round(float(metrics["loss"]), 4),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""ditl_tpu — a TPU-native distributed fine-tuning / inference framework.
+
+A brand-new JAX / XLA / pjit / Pallas framework with the capabilities of the
+reference repo ``naman1618/Distributed-Inference-with-PyTorch-and-LiteLLM``
+(see SURVEY.md), redesigned TPU-first:
+
+- ``ditl_tpu.config``   — typed config system (replaces the reference's
+  git-ignored ``config.py`` dict, ref ``src/distributed_inference.py:12``).
+- ``ditl_tpu.runtime``  — multi-host bring-up over ICI/DCN via
+  ``jax.distributed`` + device mesh construction (replaces
+  ``dist.init_process_group('nccl')``, ref ``src/distributed_inference.py:14-21``).
+- ``ditl_tpu.data``     — rank/world-size-aware sharding with epoch-seeded
+  shuffling (``DistributedSampler`` semantics, ref
+  ``src/distributed_inference.py:58-59,63``) and global device arrays.
+- ``ditl_tpu.models``   — Llama-style transformer, Mixtral-style MoE, LoRA.
+- ``ditl_tpu.ops``      — jit/Pallas compute: fused attention kernels, ring
+  attention, and the capability-parity text-encode op (ref ``src/utils.py:25-28``).
+- ``ditl_tpu.parallel`` — mesh axes + GSPMD sharding rules (DP/FSDP/TP/SP/EP).
+- ``ditl_tpu.train``    — train state, pjit train step, Orbax checkpointing,
+  metrics (tokens/sec/chip, step-time p50).
+- ``ditl_tpu.client``   — OpenAI-compatible remote-LLM client with retry/backoff
+  (replaces the LiteLLM path, ref ``src/distributed_inference.py:34-41``).
+- ``ditl_tpu.launch``   — single launcher for all hosts (replaces
+  ``scripts/run_node0.sh``/``run_node1.sh``).
+"""
+
+__version__ = "0.1.0"
+
+from ditl_tpu.config import (  # noqa: F401
+    APIConfig,
+    Config,
+    DataConfig,
+    MeshConfig,
+    ModelConfig,
+    RuntimeConfig,
+    TrainConfig,
+)

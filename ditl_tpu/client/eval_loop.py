@@ -1,0 +1,64 @@
+"""Process-0 API evaluation loop (L4/L5).
+
+The reference's hot loop sends every example to the remote LLM serially and
+logs prompt/response/label on rank 0 (ref ``src/distributed_inference.py:64-76``).
+Here the API eval is an explicitly separate, process-0-only, *concurrent* side
+loop (BASELINE.json north star: 'the LiteLLM client path stays intact for
+API-side eval') that never blocks the device train step: the trainer calls it
+between steps with a handful of samples.
+"""
+
+from __future__ import annotations
+
+from ditl_tpu.client.llm import ERROR_SENTINEL, LLMClient
+from ditl_tpu.runtime.distributed import is_coordinator
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["run_api_eval"]
+
+_SYSTEM = (
+    "You are a sentiment classifier. Reply with exactly one word: "
+    "'positive' or 'negative'."
+)
+
+
+def run_api_eval(
+    client: LLMClient,
+    texts: list[str],
+    labels: list[int],
+    max_samples: int = 8,
+    log_chars: int = 100,
+) -> dict:
+    """Send up to ``max_samples`` texts to the remote model; log and score.
+
+    Runs only on process 0 (every other process returns immediately) — the
+    structural form of the reference's ``if rank == 0`` gate (ref ``:71``).
+    Returns {'n', 'n_errors', 'accuracy'} (accuracy over non-error replies).
+    """
+    if not is_coordinator():
+        return {"n": 0, "n_errors": 0, "accuracy": 0.0}
+    texts = texts[:max_samples]
+    labels = labels[:max_samples]
+    responses = client.complete_many(texts, system=_SYSTEM)
+    n_errors = 0
+    n_correct = 0
+    n_scored = 0
+    for text, label, response in zip(texts, labels, responses):
+        logger.info("Prompt: %s...", text[:log_chars])
+        logger.info("Response: %s...", response[:log_chars])
+        logger.info("Actual label: %d", label)
+        if response == ERROR_SENTINEL:
+            n_errors += 1
+            continue
+        lowered = response.lower()
+        predicted = 1 if "positive" in lowered else 0 if "negative" in lowered else None
+        if predicted is not None:
+            n_scored += 1
+            n_correct += int(predicted == label)
+    accuracy = n_correct / n_scored if n_scored else 0.0
+    logger.info(
+        "api eval: %d samples, %d errors, accuracy %.3f", len(texts), n_errors, accuracy
+    )
+    return {"n": len(texts), "n_errors": n_errors, "accuracy": accuracy}

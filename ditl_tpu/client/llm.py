@@ -1,0 +1,149 @@
+"""Remote-LLM client (L4): OpenAI-compatible chat completions.
+
+Parity surface: ``get_model_response(prompt)`` wrapping
+``litellm.completion(CONFIG['MODEL_NAME'], messages)`` with api_base +
+``OPENAI_API_KEY`` configuration (ref ``src/distributed_inference.py:34-41,
+53-54``). Contract preserved exactly: **total function** — it never raises;
+any failure returns the sentinel string. Improvements the reference only
+documents (ref ``docs/troubleshooting.md:42-51`` tells the *user* to
+"implement exponential backoff"):
+
+- exponential backoff with jitter on 429/5xx/connection errors, honoring
+  ``Retry-After``;
+- bounded-concurrency batch path (``complete_many``) so API eval does not
+  serialize per example like the reference's hot loop (ref ``:69``), and
+  the TPU step is never blocked behind HTTP;
+- injectable transport — the test seam SURVEY.md §4 identifies as the
+  reference's one good testing idea (mock via function injection), kept.
+
+Implemented on stdlib ``urllib`` (no litellm/httpx dependency; the image has
+no egress anyway) against the ``/chat/completions`` wire format LiteLLM's
+proxy and every OpenAI-compatible server speak.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Sequence
+
+from ditl_tpu.config import APIConfig
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+ERROR_SENTINEL = "Error: Unable to get model response"
+
+__all__ = ["ERROR_SENTINEL", "LLMClient", "get_model_response"]
+
+Transport = Callable[[str, dict, bytes, float], tuple[int, dict, bytes]]
+
+
+class HTTPStatusError(Exception):
+    def __init__(self, status: int, headers: dict, body: bytes):
+        super().__init__(f"HTTP {status}")
+        self.status = status
+        self.headers = headers
+        self.body = body
+
+
+def _urllib_transport(url: str, headers: dict, body: bytes, timeout: float):
+    req = urllib.request.Request(url, data=body, headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), e.read()
+
+
+class LLMClient:
+    def __init__(self, config: APIConfig | None = None, transport: Transport | None = None):
+        self.config = config or APIConfig()
+        self.transport = transport or _urllib_transport
+
+    # -- low level ----------------------------------------------------------
+
+    def _request_once(self, payload: dict) -> dict:
+        cfg = self.config
+        url = cfg.api_base.rstrip("/") + "/chat/completions"
+        headers = {
+            "Content-Type": "application/json",
+            "Authorization": f"Bearer {cfg.api_key()}",
+        }
+        body = json.dumps(payload).encode("utf-8")
+        status, resp_headers, resp_body = self.transport(url, headers, body, cfg.timeout_s)
+        if status != 200:
+            raise HTTPStatusError(status, resp_headers, resp_body)
+        return json.loads(resp_body)
+
+    def _request_with_retries(self, payload: dict) -> dict:
+        cfg = self.config
+        last_exc: Exception | None = None
+        for attempt in range(cfg.max_retries + 1):
+            try:
+                return self._request_once(payload)
+            except HTTPStatusError as e:
+                last_exc = e
+                retryable = e.status == 429 or e.status >= 500
+                if not retryable or attempt == cfg.max_retries:
+                    raise
+                delay = self._backoff_delay(attempt, e.headers)
+            except (urllib.error.URLError, OSError, TimeoutError) as e:
+                last_exc = e
+                if attempt == cfg.max_retries:
+                    raise
+                delay = self._backoff_delay(attempt, {})
+            logger.warning(
+                "API request failed (%s), retry %d/%d in %.2fs",
+                last_exc,
+                attempt + 1,
+                cfg.max_retries,
+                delay,
+            )
+            time.sleep(delay)
+        raise last_exc  # unreachable
+
+    def _backoff_delay(self, attempt: int, headers: dict) -> float:
+        retry_after = headers.get("Retry-After") or headers.get("retry-after")
+        if retry_after:
+            try:
+                return min(float(retry_after), self.config.backoff_max_s)
+            except ValueError:
+                pass
+        base = self.config.backoff_base_s * (2**attempt)
+        return min(base, self.config.backoff_max_s) * (0.5 + random.random() / 2)
+
+    # -- public surface -----------------------------------------------------
+
+    def complete(self, prompt: str, system: str | None = None) -> str:
+        """Single-turn completion. Total function: returns ``ERROR_SENTINEL``
+        on any failure (parity with ref ``:39-41``)."""
+        messages = []
+        if system:
+            messages.append({"role": "system", "content": system})
+        messages.append({"role": "user", "content": prompt})
+        payload = {"model": self.config.model_name, "messages": messages}
+        try:
+            response = self._request_with_retries(payload)
+            return response["choices"][0]["message"]["content"]
+        except Exception as e:
+            logger.error("Error getting model response: %s", e)
+            return ERROR_SENTINEL
+
+    def complete_many(self, prompts: Sequence[str], system: str | None = None) -> list[str]:
+        """Bounded-concurrency fan-out; order-preserving; each element total."""
+        if not prompts:
+            return []
+        workers = max(1, min(self.config.max_concurrency, len(prompts)))
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            return list(pool.map(lambda p: self.complete(p, system), prompts))
+
+
+def get_model_response(prompt: str, config: APIConfig | None = None) -> str:
+    """Drop-in functional parity with the reference's module-level
+    ``get_model_response(prompt) -> str`` (ref ``src/distributed_inference.py:34``)."""
+    return LLMClient(config).complete(prompt)

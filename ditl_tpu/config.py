@@ -1,0 +1,290 @@
+"""Typed configuration system (L0).
+
+The reference keeps its configuration in a git-ignored Python module exporting a
+5-key dict — ``from config import CONFIG`` (ref ``src/distributed_inference.py:12``,
+``.gitignore:29``, ``docs/setup_guide.md:43-46``) — with secrets stored in the
+module and rendezvous info duplicated between CONFIG and launcher CLI flags
+(defect #5 in SURVEY.md §2). This module replaces that with:
+
+- frozen dataclasses per concern (runtime / mesh / model / data / train / api),
+- secrets **only** from environment variables (never stored in config files),
+- a single source of truth for rendezvous info (``RuntimeConfig``),
+- dotted-path CLI overrides (``train.batch_size=8``) for the launcher.
+
+Reference key mapping:
+  ``MASTER_ADDR``/``MASTER_PORT`` -> ``RuntimeConfig.coordinator_address``
+  ``MODEL_NAME``                  -> ``APIConfig.model_name``
+  ``API_BASE``                    -> ``APIConfig.api_base``
+  ``API_KEY``                     -> env ``OPENAI_API_KEY`` (read lazily, never persisted)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field, fields, replace
+from typing import Any, Mapping, Sequence
+
+__all__ = [
+    "RuntimeConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "DataConfig",
+    "TrainConfig",
+    "APIConfig",
+    "Config",
+    "parse_overrides",
+    "config_fingerprint",
+]
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Distributed-runtime bring-up (replaces NCCL env + ``setup()``, ref
+    ``src/distributed_inference.py:14-18``).
+
+    On a real TPU pod, ``jax.distributed.initialize()`` autodetects everything
+    and all fields may stay ``None``. For CPU simulation or explicit multi-host
+    runs, ``coordinator_address`` is the analog of ``MASTER_ADDR:MASTER_PORT``.
+    """
+
+    coordinator_address: str | None = None  # "host:port"; None => autodetect
+    num_processes: int | None = None  # analog of WORLD_SIZE (ref :47)
+    process_id: int | None = None  # analog of RANK (ref :46)
+    simulate_devices: int = 0  # >0 => force N virtual CPU devices (tests/sim)
+    distributed: bool = False  # True => call jax.distributed.initialize
+    log_level: str = "INFO"
+    profiler_port: int = 0  # >0 => start jax.profiler server on this port
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh shape. Axis sizes of 1 are kept in the mesh (harmless to
+    XLA) so a single step function serves DP, FSDP, TP, SP and EP without
+    rewriting — SURVEY.md §7 'hard part (b)'.
+
+    ``data``: pure data parallelism (batch split, the reference's only strategy).
+    ``fsdp``: parameter/optimizer sharding (ZeRO-3/GSPMD style) — also splits batch.
+    ``sequence``: sequence/context parallelism (ring attention axis).
+    ``tensor``: megatron-style tensor parallelism within a layer.
+    ``expert``: MoE expert parallelism.
+    A value of -1 means "absorb all remaining devices" (at most one axis).
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    sequence: int = 1
+    tensor: int = 1
+    expert: int = 1
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return ("data", "fsdp", "sequence", "tensor", "expert")
+
+    def sizes(self) -> tuple[int, ...]:
+        return (self.data, self.fsdp, self.sequence, self.tensor, self.expert)
+
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        """Resolve -1 axes against the actual device count; validate product."""
+        sizes = list(self.sizes())
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {self}")
+        fixed = 1
+        for i, s in enumerate(sizes):
+            if i not in wild:
+                if s < 1:
+                    raise ValueError(f"mesh axis sizes must be >=1 or -1, got {self}")
+                fixed *= s
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed mesh product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {tuple(sizes)} needs {fixed} devices but {n_devices} are present"
+            )
+        return tuple(sizes)
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Llama/Mixtral-family architecture hyperparameters.
+
+    Defaults describe a tiny debug model; ``presets.py`` provides llama3-8b/70b
+    and mixtral-8x7b shapes. ``num_experts == 0`` means dense MLP.
+    """
+
+    name: str = "tiny-llama"
+    vocab_size: int = 32000
+    hidden_size: int = 256
+    intermediate_size: int = 688
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 4  # grouped-query attention; == num_heads => MHA
+    head_dim: int = 32
+    max_seq_len: int = 2048
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"  # activation/compute dtype
+    param_dtype: str = "float32"  # master parameter dtype
+    # MoE (Mixtral-style); num_experts == 0 disables.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    router_aux_coef: float = 0.01  # Switch-style load-balancing loss weight
+    # LoRA; rank 0 disables.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_dropout: float = 0.0
+    # Attention implementation: "xla" | "flash" (Pallas) | "ring" (SP ring attention)
+    attention_impl: str = "xla"
+    # Gradient checkpointing policy for the layer scan: "none" | "full" | "dots"
+    remat: str = "full"
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Data pipeline. Parity surface: HF ``load_dataset('imdb','train[:1%]')``
+    + DistributedSampler + DataLoader(batch_size=4) (ref
+    ``src/distributed_inference.py:56-59``)."""
+
+    dataset_name: str = "imdb"
+    dataset_split: str = "train[:1%]"
+    text_column: str = "text"
+    label_column: str = "label"
+    batch_size: int = 4  # GLOBAL batch size (split across the data/fsdp axes)
+    seq_len: int = 512
+    shuffle: bool = True
+    seed: int = 0
+    drop_last: bool = False
+    num_epochs: int = 3  # ref :61
+    tokenizer: str = "byte"  # "byte" | HF tokenizer name
+    pack_sequences: bool = True
+    prefetch: int = 2  # device prefetch depth (double buffering)
+    synthetic: bool = False  # True => generated data, no HF hub (hermetic tests)
+    synthetic_examples: int = 256
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 10
+    total_steps: int = 100
+    weight_decay: float = 0.01
+    beta1: float = 0.9
+    beta2: float = 0.95
+    grad_clip_norm: float = 1.0
+    grad_accum_steps: int = 1
+    log_every: int = 10
+    eval_every: int = 0  # 0 => no API eval loop
+    eval_samples: int = 8
+    checkpoint_dir: str = ""  # "" => checkpointing disabled
+    checkpoint_every: int = 0
+    keep_checkpoints: int = 3
+    resume: bool = True  # resume from latest checkpoint if present
+    seed: int = 42
+
+
+@dataclass(frozen=True)
+class APIConfig:
+    """Remote-LLM (OpenAI-compatible) client config — the LiteLLM-parity
+    surface (ref ``src/distributed_inference.py:34-41,53-54``). The API key is
+    *never* stored here; ``api_key()`` reads the env at call time."""
+
+    model_name: str = "meta-llama/Meta-Llama-3.1-70B-Instruct"
+    api_base: str = "http://localhost:4000/v1"
+    api_key_env: str = "OPENAI_API_KEY"
+    timeout_s: float = 60.0
+    max_retries: int = 5
+    backoff_base_s: float = 0.5  # exponential backoff, doc'd-but-unimplemented
+    backoff_max_s: float = 30.0  # in the reference (troubleshooting.md:42-51)
+    max_concurrency: int = 8  # async client fan-out (vs ref's serial loop)
+
+    def api_key(self) -> str:
+        return os.environ.get(self.api_key_env, "")
+
+
+@dataclass(frozen=True)
+class Config:
+    runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    api: APIConfig = field(default_factory=APIConfig)
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any]) -> "Config":
+        kwargs: dict[str, Any] = {}
+        for f in fields(cls):
+            if f.name in d:
+                sub = d[f.name]
+                sub_cls = f.default_factory  # type: ignore[misc]
+                if isinstance(sub, Mapping):
+                    kwargs[f.name] = sub_cls(**sub)
+                else:
+                    kwargs[f.name] = sub
+        return cls(**kwargs)
+
+
+def _coerce(value: str, target_type: Any) -> Any:
+    """Coerce a CLI string to the dataclass field's type."""
+    if target_type in ("bool", bool):
+        if value.lower() in ("1", "true", "yes", "on"):
+            return True
+        if value.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"not a bool: {value!r}")
+    for caster in (int, float):
+        if target_type in (caster.__name__, caster):
+            return caster(value)
+    if value.lower() == "none":
+        return None
+    # Optional[int] style annotations arrive as strings like "int | None".
+    if isinstance(target_type, str) and "int" in target_type:
+        return int(value)
+    if isinstance(target_type, str) and "float" in target_type:
+        return float(value)
+    return value
+
+
+def parse_overrides(config: Config, overrides: Sequence[str]) -> Config:
+    """Apply ``section.key=value`` overrides, e.g. ``mesh.fsdp=8``."""
+    for item in overrides:
+        if "=" not in item:
+            raise ValueError(f"override must be section.key=value, got {item!r}")
+        path, value = item.split("=", 1)
+        parts = path.split(".")
+        if len(parts) != 2:
+            raise ValueError(f"override path must be section.key, got {path!r}")
+        section_name, key = parts
+        if not hasattr(config, section_name):
+            raise ValueError(f"unknown config section {section_name!r}")
+        section = getattr(config, section_name)
+        matching = [f for f in fields(section) if f.name == key]
+        if not matching:
+            raise ValueError(f"unknown key {key!r} in section {section_name!r}")
+        coerced = _coerce(value, matching[0].type)
+        config = replace(config, **{section_name: replace(section, **{key: coerced})})
+    return config
+
+
+def config_fingerprint(config: Config) -> int:
+    """Deterministic 63-bit fingerprint of the full config, used by the
+    cross-host consistency check (runtime/consistency.py) to turn the
+    reference's 'Nodes out of sync' doc advice (troubleshooting.md:53-63) into
+    an executed startup assertion."""
+    import hashlib
+
+    digest = hashlib.sha256(config.to_json().encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big") >> 1

@@ -1,0 +1,4 @@
+from ditl_tpu.data.dataset import TextDataset, load_text_dataset, synthetic_dataset  # noqa: F401
+from ditl_tpu.data.sampler import ShardedSampler  # noqa: F401
+from ditl_tpu.data.tokenizer import ByteTokenizer, get_tokenizer  # noqa: F401
+from ditl_tpu.data.loader import DataPipeline, make_global_batch  # noqa: F401

@@ -1,0 +1,267 @@
+"""Batching + host-local -> global device arrays (L3).
+
+The reference's loader is ``DataLoader(batch_size=4, sampler=DistributedSampler)``
+feeding a serial per-example loop (ref ``src/distributed_inference.py:59,64-69``)
+— the anti-pattern SURVEY.md §7 calls out as 'hard part (c)'. The TPU-native
+pipeline instead:
+
+1. shards the dataset per *process* with ``ShardedSampler`` (each host only
+   tokenizes its own shard),
+2. tokenizes/pads (or packs) into fixed ``(per_host_batch, seq_len)`` int32
+   arrays — static shapes so XLA compiles once,
+3. assembles a *global* jax.Array sharded over the mesh's batch axes with
+   ``jax.make_array_from_process_local_data`` (every host holds only its
+   addressable shards),
+4. prefetches ahead of the device step (double buffering) so the TPU never
+   waits on host tokenization.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Iterator
+
+import numpy as np
+
+from ditl_tpu.config import DataConfig
+from ditl_tpu.data.dataset import TextDataset
+from ditl_tpu.data.sampler import ShardedSampler
+from ditl_tpu.data.tokenizer import Tokenizer
+from ditl_tpu.runtime.mesh import batch_axes
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["make_global_batch", "DataPipeline"]
+
+
+def tokenize_example(
+    tok: Tokenizer, text: str, seq_len: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """[bos] + ids + [eos], truncated/padded to ``seq_len``; mask covers real
+    tokens only."""
+    ids = [tok.bos_id] + tok.encode(text)[: seq_len - 2] + [tok.eos_id]
+    mask = np.zeros(seq_len, dtype=np.float32)
+    mask[: len(ids)] = 1.0
+    out = np.full(seq_len, tok.pad_id, dtype=np.int32)
+    out[: len(ids)] = ids
+    return out, mask
+
+
+def make_global_batch(mesh, host_batch: dict[str, np.ndarray]) -> dict:
+    """Form globally-sharded jax.Arrays from per-host numpy batches.
+
+    The leading (batch) dim is sharded over the mesh's ``data``/``fsdp`` axes;
+    remaining dims are replicated. This is the TPU analog of 'each rank holds
+    its DataLoader batch' — except the result is one logical global array XLA
+    can partition against."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = {}
+    for key, arr in host_batch.items():
+        spec = P(batch_axes(), *([None] * (arr.ndim - 1)))
+        sharding = NamedSharding(mesh, spec)
+        out[key] = jax.make_array_from_process_local_data(sharding, arr)
+    return out
+
+
+class DataPipeline:
+    """End-to-end host data pipeline: shard -> tokenize -> batch -> global
+    arrays, with epoch reseeding and background prefetch."""
+
+    def __init__(
+        self,
+        dataset: TextDataset,
+        tokenizer: Tokenizer,
+        config: DataConfig,
+        mesh,
+    ):
+        import jax
+
+        self.dataset = dataset
+        self.tokenizer = tokenizer
+        self.config = config
+        self.mesh = mesh
+        self.process_count = jax.process_count()
+        self.process_index = jax.process_index()
+        if config.batch_size % self.process_count:
+            raise ValueError(
+                f"global batch_size {config.batch_size} must divide evenly over "
+                f"{self.process_count} processes"
+            )
+        self.host_batch_size = config.batch_size // self.process_count
+        # Batch dim must also divide over the mesh's batch axes for sharding.
+        from ditl_tpu.runtime.mesh import data_parallel_size
+
+        dp = data_parallel_size(mesh)
+        if config.batch_size % dp:
+            raise ValueError(
+                f"global batch_size {config.batch_size} must divide evenly over "
+                f"data-parallel size {dp} (mesh {dict(mesh.shape)})"
+            )
+        self.sampler = ShardedSampler(
+            dataset_size=len(dataset),
+            num_replicas=self.process_count,
+            rank=self.process_index,
+            shuffle=config.shuffle,
+            seed=config.seed,
+            drop_last=config.drop_last,
+        )
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return len(self.sampler) // self.host_batch_size
+
+    def _host_batches(
+        self, epoch: int, start_step: int = 0
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """Per-host numpy batches for one epoch (identical step count on every
+        host, by ShardedSampler's equal-split guarantee). ``start_step`` skips
+        the first N batches (checkpoint resume) without tokenizing them in the
+        padded path; the packed path must still tokenize to keep the stream
+        aligned, but skips batch assembly/upload."""
+        self.sampler.set_epoch(epoch)
+        indices = self.sampler.local_indices()
+        seq_len = self.config.seq_len
+        if self.config.pack_sequences:
+            yield from self._packed_batches(indices, start_step)
+            return
+        n_full = len(indices) // self.host_batch_size
+        for b in range(start_step, n_full):
+            chunk = indices[b * self.host_batch_size : (b + 1) * self.host_batch_size]
+            ids = np.empty((len(chunk), seq_len), dtype=np.int32)
+            mask = np.empty((len(chunk), seq_len), dtype=np.float32)
+            labels = np.empty((len(chunk),), dtype=np.int32)
+            for i, idx in enumerate(chunk):
+                item = self.dataset[int(idx)]
+                ids[i], mask[i] = tokenize_example(self.tokenizer, item["text"], seq_len)
+                labels[i] = item["label"]
+            # Segment ids isolate real tokens (1) from padding (0) in attention.
+            yield {
+                "input_ids": ids,
+                "loss_mask": mask,
+                "labels": labels,
+                "segment_ids": mask.astype(np.int32),
+                "positions": np.broadcast_to(
+                    np.arange(seq_len, dtype=np.int32), ids.shape
+                ).copy(),
+            }
+
+    def _packed_batches(
+        self, indices: np.ndarray, start_step: int = 0
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """Sequence packing: concatenate [bos]doc[eos] streams and slice fixed
+        rows — no pad waste, fully dense MXU work. Deterministic given the
+        epoch's index order.
+
+        SPMD safety: hosts' shards can tokenize to different lengths, so the
+        raw per-host row counts can differ — every host therefore computes the
+        *global minimum* batch count from all shards (cheap: token counts only,
+        no cross-host communication, since every host knows the full
+        permutation) and truncates to it, keeping step counts identical.
+        """
+        tok, seq_len = self.tokenizer, self.config.seq_len
+        stream: list[int] = []
+        for idx in indices:
+            item = self.dataset[int(idx)]
+            stream.extend([tok.bos_id] + tok.encode(item["text"]) + [tok.eos_id])
+        rows_total = len(stream) // seq_len
+        n_batches = rows_total // self.host_batch_size
+        if self.process_count > 1:
+            n_batches = min(n_batches, self._global_min_batches())
+        arr = np.asarray(stream[: rows_total * seq_len], dtype=np.int32).reshape(
+            rows_total, seq_len
+        )
+        is_bos = arr == tok.bos_id
+        # Per-row document segments (1-based; every row starts mid- or at-doc).
+        segments = np.cumsum(is_bos, axis=1).astype(np.int32) + 1
+        # Positions restart at each bos: index within the current document.
+        col = np.broadcast_to(np.arange(seq_len), arr.shape)
+        last_bos = np.maximum.accumulate(np.where(is_bos, col, 0), axis=1)
+        positions = (col - last_bos).astype(np.int32)
+        for b in range(start_step, n_batches):
+            sl = slice(b * self.host_batch_size, (b + 1) * self.host_batch_size)
+            yield {
+                "input_ids": arr[sl],
+                "loss_mask": np.ones_like(arr[sl], dtype=np.float32),
+                "labels": np.zeros((arr[sl].shape[0],), dtype=np.int32),
+                "segment_ids": segments[sl],
+                "positions": positions[sl],
+            }
+
+    def _global_min_batches(self) -> int:
+        """Minimum packed batch count over all hosts' shards. Every host can
+        compute every shard's token count locally (the permutation is shared),
+        so this needs no collective."""
+        tok, seq_len = self.tokenizer, self.config.seq_len
+        perm = self.sampler.global_permutation()
+        counts = []
+        for rank in range(self.process_count):
+            shard = perm[rank :: self.process_count]
+            tokens = sum(
+                len(tok.encode(self.dataset[int(i)]["text"])) + 2 for i in shard
+            )
+            counts.append((tokens // seq_len) // self.host_batch_size)
+        return min(counts)
+
+    def epoch(self, epoch: int, start_step: int = 0) -> Iterator[dict]:
+        """Globally-sharded batches for one epoch, with prefetch."""
+        yield from _prefetch(
+            (
+                make_global_batch(self.mesh, hb)
+                for hb in self._host_batches(epoch, start_step)
+            ),
+            self.config.prefetch,
+        )
+
+    def __iter__(self) -> Iterator[dict]:
+        """Infinite stream across epochs (epoch-seeded reshuffle each pass)."""
+        epoch = 0
+        while True:
+            yield from self.epoch(epoch)
+            epoch += 1
+
+
+def _prefetch(it: Iterator, depth: int) -> Iterator:
+    """Background-thread prefetch of up to ``depth`` items (device transfer is
+    async in JAX, so buffering the host side is enough for double buffering)."""
+    if depth <= 0:
+        yield from it
+        return
+    queue: collections.deque = collections.deque()
+    lock = threading.Condition()
+    done = object()
+    failed = object()
+
+    def worker():
+        try:
+            for item in it:
+                with lock:
+                    while len(queue) >= depth:
+                        lock.wait()
+                    queue.append(item)
+                    lock.notify_all()
+        except BaseException as e:  # surface producer errors to the consumer
+            with lock:
+                queue.append((failed, e))
+                lock.notify_all()
+            return
+        with lock:
+            queue.append(done)
+            lock.notify_all()
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        with lock:
+            while not queue:
+                lock.wait()
+            item = queue.popleft()
+            lock.notify_all()
+        if item is done:
+            return
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is failed:
+            raise item[1]
+        yield item

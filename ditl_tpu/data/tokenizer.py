@@ -1,0 +1,77 @@
+"""Tokenizers (L3).
+
+The reference has no tokenizer — raw review text goes to the remote API and
+the 'device op' encodes characters as ``float(ord(c))`` (ref
+``src/utils.py:25-28``). A real on-TPU fine-tune needs token ids, so:
+
+- ``ByteTokenizer``: dependency-free UTF-8 byte-level tokenizer (vocab 256 +
+  specials) — the default for tests/benchmarks; deterministic and hub-free.
+- ``get_tokenizer``: resolves ``DataConfig.tokenizer`` to either the byte
+  tokenizer or a HF ``AutoTokenizer`` (for Llama-3.1 runs with the real vocab).
+
+Both expose the same tiny surface: ``vocab_size``, ``encode``, ``decode``,
+``pad_id``, ``bos_id``, ``eos_id``.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, Sequence
+
+__all__ = ["Tokenizer", "ByteTokenizer", "HFTokenizer", "get_tokenizer"]
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    pad_id: int
+    bos_id: int
+    eos_id: int
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 bytes shifted by the number of special tokens."""
+
+    def __init__(self):
+        self.pad_id = 0
+        self.bos_id = 1
+        self.eos_id = 2
+        self._offset = 3
+        self.vocab_size = 256 + self._offset
+
+    def encode(self, text: str) -> list[int]:
+        return [b + self._offset for b in text.encode("utf-8")]
+
+    def decode(self, ids: Sequence[int]) -> str:
+        data = bytes(i - self._offset for i in ids if i >= self._offset)
+        return data.decode("utf-8", errors="replace")
+
+
+class HFTokenizer:
+    """Thin adapter over ``transformers.AutoTokenizer``."""
+
+    def __init__(self, name: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(name)
+        self.vocab_size = len(self._tok)
+        # `is not None`, not `or`: token id 0 is a legitimate special token.
+        self.bos_id = self._tok.bos_token_id if self._tok.bos_token_id is not None else 1
+        self.eos_id = self._tok.eos_token_id if self._tok.eos_token_id is not None else 2
+        self.pad_id = (
+            self._tok.pad_token_id if self._tok.pad_token_id is not None else self.eos_id
+        )
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids))
+
+
+def get_tokenizer(name: str = "byte") -> Tokenizer:
+    if name == "byte":
+        return ByteTokenizer()
+    return HFTokenizer(name)

@@ -1,0 +1,96 @@
+"""Single launcher for all hosts (L6).
+
+The reference needs one hand-edited Bash script per node, differing only in
+``--node_rank`` (ref ``scripts/run_node0.sh:13`` vs ``run_node1.sh:13``), plus
+NCCL env tuning. On TPU VMs every host runs *the same command* —
+``jax.distributed.initialize`` discovers rank/world topology from the TPU
+metadata — so the launcher collapses to one CLI (BASELINE.json north star:
+'run_node0.sh + run_node1.sh collapse into a single TPU-VM launcher'):
+
+    python -m ditl_tpu.launch --preset tiny-llama mesh.fsdp=8 train.total_steps=50
+
+CPU simulation of an N-device pod (SURVEY.md §4's repaired test strategy):
+
+    python -m ditl_tpu.launch --simulate 8 data.synthetic=true
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+from ditl_tpu.config import Config, parse_overrides
+from ditl_tpu.models.presets import get_preset
+
+
+def build_config(argv: list[str] | None = None) -> Config:
+    parser = argparse.ArgumentParser(
+        prog="ditl_tpu.launch",
+        description="TPU-native distributed fine-tuning launcher (one command, every host)",
+    )
+    parser.add_argument("--preset", default=None, help="model preset name")
+    parser.add_argument(
+        "--simulate", type=int, default=0, help="simulate N CPU devices (no TPU needed)"
+    )
+    parser.add_argument(
+        "--distributed", action="store_true", help="multi-host: call jax.distributed.initialize"
+    )
+    parser.add_argument("--coordinator", default=None, help="host:port of process 0")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--print-config", action="store_true")
+    parser.add_argument(
+        "overrides", nargs="*", help="config overrides like train.total_steps=50"
+    )
+    args = parser.parse_args(argv)
+
+    config = Config()
+    if args.preset:
+        config = dataclasses.replace(config, model=get_preset(args.preset))
+    # `model.name=<preset>` in overrides swaps in the preset shapes FIRST, so
+    # later model.* overrides layer on top of it rather than being silently
+    # ignored or applied to the tiny default shapes.
+    from ditl_tpu.models.presets import PRESETS
+
+    for item in args.overrides:
+        if item.startswith("model.name=") and item.split("=", 1)[1] in PRESETS:
+            config = dataclasses.replace(
+                config, model=get_preset(item.split("=", 1)[1])
+            )
+    config = dataclasses.replace(
+        config,
+        runtime=dataclasses.replace(
+            config.runtime,
+            simulate_devices=args.simulate,
+            distributed=args.distributed,
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        ),
+    )
+    config = parse_overrides(config, args.overrides)
+    if args.print_config:
+        print(config.to_json())
+        sys.exit(0)
+    return config
+
+
+def main(argv: list[str] | None = None) -> int:
+    config = build_config(argv)
+    from ditl_tpu.train.trainer import train
+
+    try:
+        summary = train(config)
+    except Exception:
+        import logging
+
+        logging.getLogger(__name__).exception("training failed")
+        return 1
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

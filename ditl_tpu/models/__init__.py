@@ -1,0 +1,2 @@
+from ditl_tpu.models import llama  # noqa: F401
+from ditl_tpu.models.presets import PRESETS, get_preset  # noqa: F401

@@ -1,0 +1,295 @@
+"""Llama-3.1-family decoder-only transformer (L1), TPU-first.
+
+The reference never instantiates a model — its 70B Llama lives behind an HTTP
+API (ref ``src/distributed_inference.py:34-41``) and the on-device compute is a
+char-ordinal mean (ref ``src/utils.py:25-28``). This module is the real local
+model the BASELINE.json north star calls for, designed for XLA/TPU:
+
+- **Pure functional**: parameters are a pytree of arrays; ``init`` / ``forward``
+  are plain functions, trivially composable with jit/grad/shard.
+- **Scanned layers**: all decoder layers are stacked along a leading ``layers``
+  dim and traversed with ``lax.scan`` — one layer's HLO compiled once instead
+  of L times (compile-time and code-size win XLA can't get from unrolled
+  Python loops).
+- **Rematerialization**: ``jax.checkpoint`` around the scanned layer trades
+  FLOPs for HBM (``ModelConfig.remat``).
+- **bf16 compute / f32 masters**: matmuls run in ``cfg.dtype`` on the MXU with
+  float32 accumulation; norms/softmax/logits in float32.
+- **Logical sharding**: ``param_logical_axes`` mirrors the param tree with
+  logical axis names; parallel/sharding.py maps them to the mesh (DP / FSDP /
+  TP / SP / EP without touching this file).
+- GQA (``num_kv_heads < num_heads``), RoPE (``rope_theta``), RMSNorm, SwiGLU —
+  the Llama-3.1 architecture; Mixtral-style MoE via ``num_experts > 0``
+  (models/moe.py); LoRA adapters via ``lora_rank > 0`` (models/lora.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.ops.attention import dot_product_attention
+
+Params = dict[str, Any]
+
+__all__ = ["init_params", "param_logical_axes", "forward", "num_params"]
+
+
+def _dtype(name: str):
+    return jnp.dtype(name)
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Initialize the full parameter pytree (layers stacked on axis 0)."""
+    pd = _dtype(cfg.param_dtype)
+    d, hd = cfg.hidden_size, cfg.head_dim
+    nh, nkv, f, L = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size, cfg.num_layers
+    if nh % nkv:
+        raise ValueError(f"num_heads {nh} must be divisible by num_kv_heads {nkv}")
+
+    keys = iter(jax.random.split(rng, 16))
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape) * (1.0 / math.sqrt(fan_in))).astype(pd)
+
+    params: Params = {
+        "embed": {
+            "embedding": (jax.random.normal(next(keys), (cfg.vocab_size, d)) * 0.02).astype(pd)
+        },
+        "layers": {
+            "attn_norm": {"scale": jnp.ones((L, d), pd)},
+            "attn": {
+                "wq": dense(next(keys), (L, d, nh * hd), d),
+                "wk": dense(next(keys), (L, d, nkv * hd), d),
+                "wv": dense(next(keys), (L, d, nkv * hd), d),
+                "wo": dense(next(keys), (L, nh * hd, d), nh * hd),
+            },
+            "mlp_norm": {"scale": jnp.ones((L, d), pd)},
+        },
+        "final_norm": {"scale": jnp.ones((d,), pd)},
+    }
+    if cfg.num_experts > 0:
+        from ditl_tpu.models.moe import init_moe_params
+
+        params["layers"]["moe"] = init_moe_params(next(keys), cfg)
+    else:
+        params["layers"]["mlp"] = {
+            "w_gate": dense(next(keys), (L, d, f), d),
+            "w_up": dense(next(keys), (L, d, f), d),
+            "w_down": dense(next(keys), (L, f, d), f),
+        }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": dense(next(keys), (d, cfg.vocab_size), d)}
+    if cfg.lora_rank > 0:
+        from ditl_tpu.models.lora import init_lora_params
+
+        params["layers"]["lora"] = init_lora_params(next(keys), cfg)
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> Params:
+    """Same structure as ``init_params``, leaves are logical-axis tuples."""
+    axes: Params = {
+        "embed": {"embedding": ("vocab", "embed")},
+        "layers": {
+            "attn_norm": {"scale": ("layers", "norm")},
+            "attn": {
+                "wq": ("layers", "embed", "heads"),
+                "wk": ("layers", "embed", "kv_heads"),
+                "wv": ("layers", "embed", "kv_heads"),
+                "wo": ("layers", "heads", "embed"),
+            },
+            "mlp_norm": {"scale": ("layers", "norm")},
+        },
+        "final_norm": {"scale": ("norm",)},
+    }
+    if cfg.num_experts > 0:
+        from ditl_tpu.models.moe import moe_logical_axes
+
+        axes["layers"]["moe"] = moe_logical_axes(cfg)
+    else:
+        axes["layers"]["mlp"] = {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
+    if not cfg.tie_embeddings:
+        axes["lm_head"] = {"kernel": ("embed", "vocab")}
+    if cfg.lora_rank > 0:
+        from ditl_tpu.models.lora import lora_logical_axes
+
+        axes["layers"]["lora"] = lora_logical_axes(cfg)
+    return axes
+
+
+def num_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm in float32 (norm statistics are precision-sensitive)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + eps)
+    return (out * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding. x: (B, S, H, D); positions: (B, S)."""
+    freqs = rope_frequencies(x.shape[-1], theta)  # (D/2,)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, D/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _constrain(x: jax.Array, logical_axes, mesh, rules):
+    if mesh is None:
+        return x
+    from jax.sharding import NamedSharding
+
+    from ditl_tpu.parallel.sharding import logical_to_spec
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+    )
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _decoder_layer(
+    layer_params: Params,
+    x: jax.Array,
+    *,
+    cfg: ModelConfig,
+    positions: jax.Array,
+    segment_ids: jax.Array | None,
+    mesh,
+    rules,
+) -> tuple[jax.Array, jax.Array]:
+    b, s, d = x.shape
+    nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    cd = _dtype(cfg.dtype)
+    attn = layer_params["attn"]
+    lora = layer_params.get("lora")
+
+    def proj(h, w, name):
+        out = jnp.einsum("bsd,df->bsf", h, w.astype(cd), preferred_element_type=cd)
+        if lora is not None and name in lora:
+            from ditl_tpu.models.lora import lora_delta
+
+            out = out + lora_delta(lora[name], h, cfg)
+        return out
+
+    # Attention block
+    h = rms_norm(x, layer_params["attn_norm"]["scale"], cfg.rms_norm_eps)
+    q = proj(h, attn["wq"], "wq").reshape(b, s, nh, hd)
+    k = proj(h, attn["wk"], "wk").reshape(b, s, nkv, hd)
+    v = proj(h, attn["wv"], "wv").reshape(b, s, nkv, hd)
+    q = apply_rope(q, positions, cfg.rope_theta)
+    k = apply_rope(k, positions, cfg.rope_theta)
+    q = _constrain(q, ("batch", "seq", "act_heads", "head_dim"), mesh, rules)
+    k = _constrain(k, ("batch", "seq", "act_kv_heads", "head_dim"), mesh, rules)
+    attn_out = dot_product_attention(
+        q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl, mesh=mesh
+    )
+    attn_out = attn_out.reshape(b, s, nh * hd)
+    x = x + proj(attn_out, attn["wo"], "wo")
+    x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
+
+    # MLP / MoE block
+    h = rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.rms_norm_eps)
+    aux = jnp.zeros((), jnp.float32)
+    if "moe" in layer_params:
+        from ditl_tpu.models.moe import moe_block
+
+        mlp_out, aux = moe_block(layer_params["moe"], h, cfg, mesh=mesh, rules=rules)
+    else:
+        mlp = layer_params["mlp"]
+        gate = jnp.einsum("bsd,df->bsf", h, mlp["w_gate"].astype(cd), preferred_element_type=cd)
+        up = jnp.einsum("bsd,df->bsf", h, mlp["w_up"].astype(cd), preferred_element_type=cd)
+        inner = jax.nn.silu(gate) * up
+        inner = _constrain(inner, ("batch", "seq", "act_mlp"), mesh, rules)
+        mlp_out = jnp.einsum(
+            "bsf,fd->bsd", inner, mlp["w_down"].astype(cd), preferred_element_type=cd
+        )
+    x = x + mlp_out
+    return _constrain(x, ("batch", "seq", "act_embed"), mesh, rules), aux
+
+
+def forward(
+    params: Params,
+    input_ids: jax.Array,
+    cfg: ModelConfig,
+    *,
+    positions: jax.Array | None = None,
+    segment_ids: jax.Array | None = None,
+    mesh=None,
+    rules=None,
+    with_aux: bool = False,
+) -> jax.Array:
+    """Token ids (B, S) -> logits (B, S, V) in float32.
+
+    ``with_aux=True`` additionally returns the summed per-layer auxiliary loss
+    (MoE router load balancing; zero for dense models)."""
+    cd = _dtype(cfg.dtype)
+    b, s = input_ids.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+
+    x = params["embed"]["embedding"].astype(cd)[input_ids]
+    x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
+
+    def layer_fn(carry, layer_params):
+        return _decoder_layer(
+            layer_params,
+            carry,
+            cfg=cfg,
+            positions=positions,
+            segment_ids=segment_ids,
+            mesh=mesh,
+            rules=rules,
+        )
+
+    if cfg.remat == "full":
+        layer_fn = jax.checkpoint(layer_fn)
+    elif cfg.remat == "dots":
+        layer_fn = jax.checkpoint(
+            layer_fn, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        )
+    x, layer_aux = jax.lax.scan(layer_fn, x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)
+    head = (
+        params["embed"]["embedding"].T if cfg.tie_embeddings else params["lm_head"]["kernel"]
+    )
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, head.astype(cd), preferred_element_type=jnp.float32
+    )
+    logits = _constrain(logits, ("batch", "seq", "act_vocab"), mesh, rules)
+    if with_aux:
+        return logits, jnp.sum(layer_aux)
+    return logits

@@ -1,0 +1,70 @@
+"""LoRA adapters (L1).
+
+Absent from the reference; required by the BASELINE.json config
+'Llama-3.1-70B LoRA fine-tune + LiteLLM eval loop on v5p-32'.
+
+Classic LoRA (Hu et al.): frozen base weight W plus trainable low-rank update
+``(alpha/r) * A @ B`` on the attention q/v projections. ``A`` is initialized
+gaussian, ``B`` zero, so the adapted model starts exactly equal to the base.
+The train step freezes non-LoRA params via an optax mask (train/step.py), so
+optimizer state is allocated only for the adapters — the whole point of LoRA
+memory-wise.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ditl_tpu.config import ModelConfig
+
+# Base-projection names that receive adapters (classic attention-only LoRA).
+LORA_TARGETS = ("wq", "wv")
+
+__all__ = ["LORA_TARGETS", "init_lora_params", "lora_logical_axes", "lora_delta"]
+
+
+def _target_out_dim(name: str, cfg: ModelConfig) -> int:
+    return {
+        "wq": cfg.num_heads * cfg.head_dim,
+        "wk": cfg.num_kv_heads * cfg.head_dim,
+        "wv": cfg.num_kv_heads * cfg.head_dim,
+        "wo": cfg.hidden_size,
+    }[name]
+
+
+def init_lora_params(rng: jax.Array, cfg: ModelConfig) -> dict[str, Any]:
+    pd = jnp.dtype(cfg.param_dtype)
+    d, r, L = cfg.hidden_size, cfg.lora_rank, cfg.num_layers
+    out: dict[str, Any] = {}
+    for i, name in enumerate(LORA_TARGETS):
+        key = jax.random.fold_in(rng, i)
+        out[name] = {
+            "a": (jax.random.normal(key, (L, d, r)) * (1.0 / math.sqrt(d))).astype(pd),
+            "b": jnp.zeros((L, r, _target_out_dim(name, cfg)), pd),
+        }
+    return out
+
+
+def lora_logical_axes(cfg: ModelConfig) -> dict[str, Any]:
+    out_axis = {"wq": "heads", "wk": "kv_heads", "wv": "kv_heads", "wo": "embed"}
+    return {
+        name: {
+            "a": ("layers", "embed", "lora_rank"),
+            "b": ("layers", "lora_rank", out_axis[name]),
+        }
+        for name in LORA_TARGETS
+    }
+
+
+def lora_delta(p: dict[str, Any], h: jax.Array, cfg: ModelConfig) -> jax.Array:
+    """(alpha/r) * (h @ A) @ B, computed in the activation dtype."""
+    cd = h.dtype
+    scale = cfg.lora_alpha / cfg.lora_rank
+    low = jnp.einsum("bsd,dr->bsr", h, p["a"].astype(cd), preferred_element_type=cd)
+    return scale * jnp.einsum(
+        "bsr,rf->bsf", low, p["b"].astype(cd), preferred_element_type=cd
+    )

@@ -1,0 +1,124 @@
+"""Mixtral-style sparse Mixture-of-Experts block (expert-parallel, L1).
+
+Absent from the reference (SURVEY.md §2: 'EP: absent'); required by the
+BASELINE.json config 'Mixtral-8x7B MoE, expert-sharded fine-tune on v5p-64'.
+
+GShard/Switch-style capacity-factor dispatch, chosen over gather/scatter
+routing because every shape is static and every step is an einsum — exactly
+what XLA/MXU want, and the expert dim shards cleanly over the ``expert`` mesh
+axis (dispatch/combine einsums lower to all-to-alls on ICI):
+
+1. router logits -> softmax gates (float32; routing is precision-sensitive),
+2. top-k experts per token, renormalized,
+3. each token claims a capacity slot per chosen expert (cumsum trick); tokens
+   beyond ``capacity = ceil(k*T/E * capacity_factor)`` are dropped (residual
+   path still carries them),
+4. dispatch einsum (T,E,C) x (T,D) -> (E,C,D); per-expert SwiGLU; combine back.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ditl_tpu.config import ModelConfig
+
+CAPACITY_FACTOR = 1.25
+
+__all__ = ["init_moe_params", "moe_logical_axes", "moe_block", "load_balancing_loss"]
+
+
+def init_moe_params(rng: jax.Array, cfg: ModelConfig) -> dict[str, Any]:
+    pd = jnp.dtype(cfg.param_dtype)
+    d, f, L, E = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers, cfg.num_experts
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape) * (1.0 / math.sqrt(fan_in))).astype(pd)
+
+    return {
+        "router": dense(k1, (L, d, E), d),
+        "w_gate": dense(k2, (L, E, d, f), d),
+        "w_up": dense(k3, (L, E, d, f), d),
+        "w_down": dense(k4, (L, E, f, d), f),
+    }
+
+
+def moe_logical_axes(cfg: ModelConfig) -> dict[str, Any]:
+    return {
+        "router": ("layers", "embed", None),
+        "w_gate": ("layers", "expert", "embed", "mlp"),
+        "w_up": ("layers", "expert", "embed", "mlp"),
+        "w_down": ("layers", "expert", "mlp", "embed"),
+    }
+
+
+def load_balancing_loss(gates: jax.Array, dispatch_mask: jax.Array) -> jax.Array:
+    """Switch-Transformer aux loss: E * sum_e(fraction_routed_e * mean_gate_e)."""
+    e = gates.shape[-1]
+    tokens_per_expert = dispatch_mask.sum(axis=(0,)).sum(axis=-1)  # (E,)
+    f = tokens_per_expert / jnp.maximum(dispatch_mask.sum(), 1.0)
+    p = gates.mean(axis=0)
+    return e * jnp.sum(f * p)
+
+
+def moe_block(
+    moe: dict[str, Any],
+    h: jax.Array,
+    cfg: ModelConfig,
+    *,
+    mesh=None,
+    rules=None,
+) -> tuple[jax.Array, jax.Array]:
+    """(B, S, D) -> ((B, S, D), aux_loss) through top-k routed experts. The
+    scalar aux loss is the Switch load-balancing term, weighted into the total
+    loss by ``ModelConfig.router_aux_coef`` (train/step.py)."""
+    b, s, d = h.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    cd = h.dtype
+    t = b * s
+    x = h.reshape(t, d)
+
+    gates = jax.nn.softmax(
+        jnp.einsum("td,de->te", x.astype(jnp.float32), moe["router"].astype(jnp.float32)),
+        axis=-1,
+    )  # (T, E) f32
+    top_w, top_idx = jax.lax.top_k(gates, k)  # (T, k)
+    top_w = top_w / jnp.maximum(top_w.sum(axis=-1, keepdims=True), 1e-9)
+
+    capacity = max(1, int(math.ceil(k * t / e * CAPACITY_FACTOR)))
+
+    # Flatten (T, k) token-major so slot priority follows token order.
+    flat_idx = top_idx.reshape(t * k)
+    flat_w = top_w.reshape(t * k)
+    onehot = jax.nn.one_hot(flat_idx, e, dtype=jnp.float32)  # (TK, E)
+    pos_in_expert = jnp.einsum(
+        "xe,xe->x", jnp.cumsum(onehot, axis=0) - 1.0, onehot
+    )  # (TK,)
+    keep = pos_in_expert < capacity
+    slot_onehot = jax.nn.one_hot(pos_in_expert.astype(jnp.int32), capacity, dtype=jnp.float32)
+    dispatch = onehot[:, :, None] * slot_onehot[:, None, :] * keep[:, None, None]
+    # (TK, E, C)
+
+    token_x = x[jnp.arange(t * k) // k]  # (TK, D)
+    expert_in = jnp.einsum("xec,xd->ecd", dispatch, token_x.astype(jnp.float32)).astype(cd)
+
+    def ffn(w_gate, w_up, w_down, xe):
+        gate = jnp.einsum("ecd,edf->ecf", xe, w_gate.astype(cd), preferred_element_type=cd)
+        up = jnp.einsum("ecd,edf->ecf", xe, w_up.astype(cd), preferred_element_type=cd)
+        return jnp.einsum(
+            "ecf,efd->ecd", jax.nn.silu(gate) * up, w_down.astype(cd),
+            preferred_element_type=cd,
+        )
+
+    expert_out = ffn(moe["w_gate"], moe["w_up"], moe["w_down"], expert_in)  # (E, C, D)
+
+    combined = jnp.einsum(
+        "xec,ecd->xd", dispatch, expert_out.astype(jnp.float32)
+    ) * flat_w[:, None]  # (TK, D)
+    out = combined.reshape(t, k, d).sum(axis=1).astype(cd)
+    aux = load_balancing_loss(gates, dispatch)
+    return out.reshape(b, s, d), aux

@@ -1,0 +1,60 @@
+"""Named model presets for the BASELINE.json target configs."""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from ditl_tpu.config import ModelConfig
+
+PRESETS: dict[str, ModelConfig] = {
+    # Debug/test model: small but architecturally identical to Llama-3.1.
+    "tiny-llama": ModelConfig(),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", num_experts=8, num_experts_per_tok=2, intermediate_size=344
+    ),
+    "llama3-8b": ModelConfig(
+        name="llama3-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_seq_len=8192,
+        rope_theta=500000.0,
+    ),
+    "llama3-70b": ModelConfig(
+        name="llama3-70b",
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=128,
+        max_seq_len=8192,
+        rope_theta=500000.0,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b",
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        num_experts=8,
+        num_experts_per_tok=2,
+    ),
+}
+
+
+def get_preset(name: str, **overrides) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; available: {sorted(PRESETS)}")
+    cfg = PRESETS[name]
+    return replace(cfg, **overrides) if overrides else cfg

@@ -1,0 +1,2 @@
+from ditl_tpu.ops.attention import dot_product_attention  # noqa: F401
+from ditl_tpu.ops.encode import encode_and_reduce  # noqa: F401

@@ -1,0 +1,83 @@
+"""Attention implementations (L1).
+
+The reference contains no attention code at all (SURVEY.md §5 'long-context':
+the 70B model lives behind an HTTP API). Here attention is a first-class op
+with three interchangeable implementations selected by
+``ModelConfig.attention_impl``:
+
+- ``"xla"``:   einsum + softmax, fully fused by XLA. Correctness reference.
+- ``"flash"``: Pallas (Mosaic) blockwise FlashAttention kernel — O(S) memory,
+               tiles sized for MXU/VMEM (ops/flash_attention.py).
+- ``"ring"``:  ring attention over the ``sequence`` mesh axis for contexts
+               longer than one chip's HBM (ops/ring_attention.py).
+
+All take GQA-layout tensors: q ``(B, S, H, D)``, k/v ``(B, S, K, D)`` with
+``H % K == 0``; softmax is computed in float32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dot_product_attention"]
+
+NEG_INF = -2.3819763e38  # most-negative bf16-representable; avoids bf16 NaNs
+
+
+def _xla_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    segment_ids: jax.Array | None,
+) -> jax.Array:
+    b, s_q, h, d = q.shape
+    _, s_kv, kv_heads, _ = k.shape
+    groups = h // kv_heads
+    qg = q.reshape(b, s_q, kv_heads, groups, d)
+    scale = d**-0.5
+    # (B, K, G, Sq, Skv) scores; accumulate in f32 on the MXU.
+    scores = jnp.einsum(
+        "bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((s_q, s_kv), dtype=bool))
+        scores = jnp.where(causal_mask[None, None, None], scores, NEG_INF)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]  # (B,Sq,Skv)
+        scores = jnp.where(seg_mask[:, None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
+    return out.reshape(b, s_q, h, d)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: jax.Array | None = None,
+    impl: str = "xla",
+    mesh=None,
+) -> jax.Array:
+    """Grouped-query attention. ``segment_ids`` (B, S) int32 restricts
+    attention to tokens of the same segment (sequence packing / padding:
+    give pad tokens a segment id of -1-ish sentinel distinct from real ones)."""
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"q heads {q.shape[2]} not divisible by kv heads {k.shape[2]}")
+    if impl == "xla":
+        return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if impl == "flash":
+        from ditl_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    if impl == "ring":
+        from ditl_tpu.ops.ring_attention import ring_attention
+
+        return ring_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, mesh=mesh
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,42 @@
+"""Capability-parity device op.
+
+The reference's entire on-device workload is ``gpu_tensor_operation(text,
+device)``: encode characters as float ordinals, move to device, ``.mean()``,
+sync back with ``.item()`` (ref ``src/utils.py:25-28``) — one H2D/D2H round
+trip *per example*. The TPU-native version is batched, jitted, and padded to a
+static shape so XLA compiles it once; the mean is masked so padding does not
+bias it.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["encode_texts", "encode_and_reduce"]
+
+
+def encode_texts(texts: list[str], max_len: int = 1024) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: UTF-8 code points -> padded (B, max_len) float32 + mask."""
+    out = np.zeros((len(texts), max_len), dtype=np.float32)
+    mask = np.zeros((len(texts), max_len), dtype=np.float32)
+    for i, t in enumerate(texts):
+        ords = np.frombuffer(t.encode("utf-32-le"), dtype=np.uint32)[:max_len]
+        out[i, : len(ords)] = ords.astype(np.float32)
+        mask[i, : len(ords)] = 1.0
+    return out, mask
+
+
+@functools.partial(jax.jit, static_argnames=())
+def _masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+    return (x * mask).sum(axis=-1) / jnp.maximum(mask.sum(axis=-1), 1.0)
+
+
+def encode_and_reduce(texts: list[str], max_len: int = 1024) -> np.ndarray:
+    """Batched equivalent of ``[gpu_tensor_operation(t) for t in texts]``:
+    one compiled call, one transfer each way, per-example masked means."""
+    x, mask = encode_texts(texts, max_len)
+    return np.asarray(_masked_mean(jnp.asarray(x), jnp.asarray(mask)))

@@ -1,0 +1,6 @@
+from ditl_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    logical_to_spec,
+    named_sharding_tree,
+    spec_tree,
+)

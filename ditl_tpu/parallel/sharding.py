@@ -1,0 +1,97 @@
+"""GSPMD sharding rules: logical axes -> mesh axes -> PartitionSpecs.
+
+The reference's single parallelism strategy is data parallelism by sampler
+sharding (ref ``src/distributed_inference.py:58-59``); it has no weight,
+activation, sequence, or expert sharding (SURVEY.md §2 checklist). Here all of
+them are expressed through one mechanism — every parameter and activation
+declares *logical* axes (``"embed"``, ``"heads"``, ``"batch"``...), and a rule
+table maps logical axes onto mesh axes. Changing parallelism strategy
+(DP -> FSDP -> TP/SP -> MoE) is a rule/mesh change, not a model rewrite —
+SURVEY.md §7 'hard part (b)'.
+
+Rules (MaxText-style conventions):
+- ``batch``   -> ``("data", "fsdp")``: both axes split the batch; FSDP is data
+  parallelism with sharded parameters/optimizer state.
+- ``embed``   -> ``fsdp``: ZeRO-3-style parameter sharding along the embedding
+  dim; XLA all-gathers weights per layer and reduce-scatters grads.
+- ``heads`` / ``mlp`` / ``vocab`` -> ``tensor``: Megatron-style intra-layer
+  tensor parallelism (all-reduce on the row-parallel matmul output).
+- ``seq``     -> ``sequence``: context parallelism for long sequences (ring
+  attention partner axis).
+- ``expert``  -> ``expert``: MoE expert parallelism (all-to-all dispatch).
+- ``layers``  -> ``None``: the scanned layer dim is never sharded (pipeline
+  parallelism would shard it; see parallel/pipeline.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["DEFAULT_RULES", "logical_to_spec", "spec_tree", "named_sharding_tree"]
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicated)
+DEFAULT_RULES: dict[str, Any] = {
+    # parameter axes
+    "embed": "fsdp",
+    "heads": "tensor",
+    "kv_heads": "tensor",
+    "mlp": "tensor",
+    "vocab": "tensor",
+    "expert": "expert",
+    "head_dim": None,
+    "layers": None,
+    "norm": None,
+    "lora_rank": None,
+    # activation axes (distinct from parameter axes: an activation's embed dim
+    # is NOT fsdp-sharded — fsdp shards weights and splits batch)
+    "batch": ("data", "fsdp"),
+    "seq": "sequence",
+    "act_embed": None,
+    "act_heads": "tensor",
+    "act_kv_heads": "tensor",
+    "act_mlp": "tensor",
+    "act_vocab": "tensor",
+}
+
+
+def logical_to_spec(
+    logical_axes: Sequence[str | None], rules: dict[str, Any] | None = None
+) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec."""
+    rules = rules if rules is not None else DEFAULT_RULES
+    spec = []
+    for ax in logical_axes:
+        if ax is None:
+            spec.append(None)
+        else:
+            if ax not in rules:
+                raise KeyError(f"no sharding rule for logical axis {ax!r}")
+            spec.append(rules[ax])
+    return P(*spec)
+
+
+def is_axes_leaf(x: Any) -> bool:
+    """A logical-axes leaf is a *plain* tuple of axis names / None. Namedtuples
+    (optax states) and tuples holding subtrees (optax.chain state) are pytree
+    containers, not leaves."""
+    return type(x) is tuple and all(e is None or isinstance(e, str) for e in x)
+
+
+def spec_tree(logical_tree: Any, rules: dict[str, Any] | None = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda axes: logical_to_spec(axes, rules), logical_tree, is_leaf=is_axes_leaf
+    )
+
+
+def named_sharding_tree(mesh, logical_tree: Any, rules: dict[str, Any] | None = None):
+    """Pytree of NamedShardings for ``jax.jit``'s in/out_shardings or
+    ``jax.device_put``."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_tree,
+        is_leaf=is_axes_leaf,
+    )

@@ -1,0 +1,8 @@
+from ditl_tpu.runtime.distributed import (  # noqa: F401
+    barrier,
+    init_runtime,
+    is_coordinator,
+    shutdown_runtime,
+)
+from ditl_tpu.runtime.mesh import build_mesh  # noqa: F401
+from ditl_tpu.runtime.consistency import check_cross_host_consistency  # noqa: F401

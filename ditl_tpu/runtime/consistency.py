@@ -1,0 +1,70 @@
+"""Cross-host consistency checking (race-detection analog).
+
+The reference handles de-synchronized nodes only as documentation — the
+'Nodes out of sync' troubleshooting entry tells the operator to manually verify
+identical seeds/datasets/versions (ref ``docs/troubleshooting.md:53-63``).
+Here that advice is executed in code at startup: every process contributes a
+fingerprint of its (config, seed, data-shard assignment, library versions) and
+an all-gather proves they agree. A mismatched host fails fast at step 0 with a
+precise diff instead of corrupting a run with silently divergent SPMD programs
+(which on TPU typically manifests as a hang inside a collective — the hardest
+failure mode to debug, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Mapping
+
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def _fingerprint(payload: Mapping[str, Any]) -> int:
+    blob = json.dumps(payload, sort_keys=True, default=str).encode("utf-8")
+    return int.from_bytes(hashlib.sha256(blob).digest()[:8], "big") >> 1
+
+
+def host_payload(config=None, extra: Mapping[str, Any] | None = None) -> dict[str, Any]:
+    """What must agree across hosts for an SPMD run to be sound."""
+    import jax
+
+    payload: dict[str, Any] = {
+        "jax_version": jax.__version__,
+        "process_count": jax.process_count(),
+        "global_device_count": jax.device_count(),
+    }
+    if config is not None:
+        payload["config"] = config.to_dict()
+    if extra:
+        payload.update(extra)
+    return payload
+
+
+def check_cross_host_consistency(
+    config=None, extra: Mapping[str, Any] | None = None
+) -> None:
+    """All-gather every host's fingerprint; raise if any disagree.
+
+    Uses ``process_allgather`` so it works on any mesh/topology; cost is one
+    tiny collective at startup.
+    """
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    payload = host_payload(config, extra)
+    fp = _fingerprint(payload)
+    gathered = multihost_utils.process_allgather(np.asarray(fp, dtype=np.int64))
+    gathered = np.atleast_1d(gathered)
+    if not bool(np.all(gathered == gathered[0])):
+        bad = {i: int(v) for i, v in enumerate(gathered)}
+        raise RuntimeError(
+            "cross-host consistency check FAILED: hosts disagree on "
+            f"(config, seed, shard assignment, versions): {bad}. "
+            f"This host (process {jax.process_index()}) computed {fp} from "
+            f"{json.dumps(payload, sort_keys=True, default=str)[:500]}"
+        )
+    logger.info("cross-host consistency check passed (fingerprint %d)", int(fp))

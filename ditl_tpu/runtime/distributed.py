@@ -1,0 +1,123 @@
+"""Distributed runtime bring-up / teardown (L2).
+
+TPU-native replacement for the reference's NCCL process-group lifecycle:
+``setup(rank, world_size)`` — env mutation + ``dist.init_process_group("nccl")``
++ ``dist.barrier()`` (ref ``src/distributed_inference.py:14-18``) — and
+``cleanup()`` — ``dist.destroy_process_group()`` (ref ``:20-21``).
+
+Design differences (TPU-first, SURVEY.md §5 'Distributed communication
+backend'):
+
+- Rendezvous is ``jax.distributed.initialize``: coordinator = process 0
+  (the analog of ``MASTER_ADDR:MASTER_PORT``); on TPU pods all arguments are
+  autodetected from the TPU metadata, so a single launcher serves every host
+  (collapsing ``run_node0.sh``/``run_node1.sh``).
+- Collectives are emitted by GSPMD/XLA over ICI/DCN; user code never issues
+  them. The startup-health ``barrier()`` analog is
+  ``multihost_utils.sync_global_devices``.
+- CPU simulation: ``simulate_devices=N`` forces N virtual host devices via
+  ``xla_force_host_platform_device_count``, which is how multi-node behavior
+  is tested without a cluster (repairs the reference's deadlocking distributed
+  test fixture, SURVEY.md §3.5).
+"""
+
+from __future__ import annotations
+
+import os
+
+from ditl_tpu.config import RuntimeConfig
+from ditl_tpu.utils.logging import get_logger, setup_logging
+
+logger = get_logger(__name__)
+
+_initialized = False
+
+
+def simulate_devices(n: int) -> None:
+    """Request ``n`` virtual CPU devices. Must run before the first JAX
+    *backend* touch (first ``jax.devices()``/array op). Env vars alone are not
+    enough if something imported jax before us (jax snapshots env into its
+    config at import time), so the config is also set directly."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+    os.environ["JAX_NUM_CPU_DEVICES"] = str(n)  # newer-JAX equivalent
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", n)
+
+
+def init_runtime(config: RuntimeConfig | None = None) -> None:
+    """Bring up the distributed runtime (idempotent).
+
+    Order matters: simulation flags must be set before JAX initializes its
+    backends, and ``jax.distributed.initialize`` must run before any
+    device access on multi-host.
+    """
+    global _initialized
+    config = config or RuntimeConfig()
+    if _initialized:
+        return
+    if config.simulate_devices > 0:
+        simulate_devices(config.simulate_devices)
+
+    import jax
+
+    if config.distributed:
+        # Explicit args for CPU/GPU clusters; all-None autodetects on TPU pods.
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+    setup_logging(config.log_level)
+    if config.profiler_port > 0 and jax.process_index() == 0:
+        jax.profiler.start_server(config.profiler_port)
+        logger.info("jax.profiler server on port %d", config.profiler_port)
+    logger.info(
+        "runtime up: process %d/%d, %d local / %d global devices (%s)",
+        jax.process_index(),
+        jax.process_count(),
+        jax.local_device_count(),
+        jax.device_count(),
+        jax.devices()[0].platform,
+    )
+    _initialized = True
+
+
+def barrier(name: str = "startup") -> None:
+    """Block until all processes reach this point — the health-check analog of
+    the reference's lone ``dist.barrier()`` (ref ``src/distributed_inference.py:18``).
+    Implemented as an all-reduce over every global device, so it also verifies
+    that cross-host collectives actually work."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — the reference's ``rank == 0`` gate (ref ``:71``)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def shutdown_runtime() -> None:
+    """Tear down cleanly (analog of ``cleanup()``, ref ``:20-21``): final
+    barrier so no host exits while peers are mid-collective, then release the
+    distributed client."""
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+
+    try:
+        if jax.process_count() > 1:
+            barrier("shutdown")
+            jax.distributed.shutdown()
+    finally:
+        _initialized = False
+    logger.info("runtime shut down")

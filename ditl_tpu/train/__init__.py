@@ -1,0 +1,3 @@
+from ditl_tpu.train.state import TrainState, create_train_state, state_logical_axes  # noqa: F401
+from ditl_tpu.train.step import loss_fn, make_eval_step, make_train_step  # noqa: F401
+from ditl_tpu.train.metrics import MetricsLogger  # noqa: F401

@@ -1,0 +1,88 @@
+"""Orbax checkpoint / resume (SURVEY.md §5 'Checkpoint / resume: absent' in the
+reference — nothing existed to save; required here for the 70B/v5p-128 north
+star, where preemption without resumable state means losing days of work).
+
+Saves the full sharded TrainState plus the data-iterator position (epoch,
+step-within-epoch) so resume continues the exact epoch-seeded shuffle the
+``ShardedSampler`` would have produced — the distributed-sampler reproducibility
+contract extends across restarts. Saves are async (Orbax writes in the
+background while training continues) and multi-host-safe (each host writes its
+addressable shards; Orbax coordinates the commit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["CheckpointManager", "DataIterState"]
+
+
+@dataclasses.dataclass
+class DataIterState:
+    epoch: int = 0
+    step_in_epoch: int = 0
+    global_step: int = 0
+
+
+class CheckpointManager:
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3, save_every: int = 0):
+        import orbax.checkpoint as ocp
+
+        self.directory = ocp.path.utils.epath.Path(directory) if hasattr(
+            ocp.path, "utils"
+        ) else directory
+        self.save_every = save_every
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, enable_async_checkpointing=True
+            ),
+        )
+
+    def should_save(self, step: int) -> bool:
+        return self.save_every > 0 and step > 0 and step % self.save_every == 0
+
+    def save(self, step: int, state: Any, data_iter: DataIterState) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                data_iter=ocp.args.JsonSave(dataclasses.asdict(data_iter)),
+            ),
+        )
+        logger.info("checkpoint save queued at step %d", step)
+
+    def restore_latest(self, abstract_state: Any) -> tuple[Any, DataIterState] | None:
+        """Restore the newest checkpoint, sharded per ``abstract_state``
+        (a jax.eval_shape tree with shardings attached). Returns None if no
+        checkpoint exists."""
+        import orbax.checkpoint as ocp
+
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state),
+                data_iter=ocp.args.JsonRestore(),
+            ),
+        )
+        data_iter = DataIterState(**restored["data_iter"])
+        logger.info("restored checkpoint at step %d", step)
+        return restored["state"], data_iter
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,83 @@
+"""Metrics / observability (SURVEY.md §5).
+
+The reference logs per-example prompt/response/label lines on rank 0 (ref
+``src/distributed_inference.py:71-76``). Here the unit of observability is the
+train step, and the headline numbers are the BASELINE.json metrics:
+**tokens/sec/chip** and **step-time p50**. Device metrics arrive as jax.Arrays;
+they are only synced to host at ``log_every`` boundaries so the metric path
+never stalls the device pipeline.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+from typing import Any
+
+from ditl_tpu.runtime.distributed import is_coordinator
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["MetricsLogger"]
+
+
+class MetricsLogger:
+    def __init__(self, log_every: int = 10, n_chips: int | None = None):
+        import jax
+
+        self.log_every = max(1, log_every)
+        self.n_chips = n_chips if n_chips is not None else jax.device_count()
+        self.step_times: list[float] = []
+        self.tokens_per_sec_chip: list[float] = []
+        self._last_t: float | None = None
+        self._pending: list[tuple[int, Any]] = []
+
+    def start_step(self) -> None:
+        self._last_t = time.perf_counter()
+
+    def end_step(self, step: int, device_metrics: Any) -> None:
+        """Record wall time; stash device metrics without forcing a sync."""
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self.step_times.append(now - self._last_t)
+        self._last_t = None
+        self._pending.append((step, device_metrics))
+        if step % self.log_every == 0:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        step, metrics = self._pending[-1]
+        host = {k: float(v) for k, v in metrics.items()}  # device sync point
+        if self.step_times:
+            dt = self.step_times[-1]
+            tps_chip = host.get("n_tokens", 0.0) / dt / self.n_chips
+            self.tokens_per_sec_chip.append(tps_chip)
+            if is_coordinator():
+                logger.info(
+                    "step %d: loss=%.4f grad_norm=%.3f step_time=%.3fs "
+                    "tokens/sec/chip=%.1f",
+                    step,
+                    host.get("loss", float("nan")),
+                    host.get("grad_norm", float("nan")),
+                    dt,
+                    tps_chip,
+                )
+        self._pending.clear()
+
+    def summary(self) -> dict[str, float]:
+        """BASELINE.md numbers. p50 over steps after compile warm-up."""
+        times = self.step_times[1:] if len(self.step_times) > 1 else self.step_times
+        tps = self.tokens_per_sec_chip[1:] if len(self.tokens_per_sec_chip) > 1 else self.tokens_per_sec_chip
+        out: dict[str, float] = {}
+        if times:
+            out["step_time_p50_s"] = statistics.median(times)
+        if tps:
+            out["tokens_per_sec_per_chip_p50"] = statistics.median(tps)
+        return out
+
+    def summary_json(self) -> str:
+        return json.dumps(self.summary(), sort_keys=True)

@@ -1,0 +1,158 @@
+"""Loss + jitted/sharded train and eval steps (L1/L5).
+
+The reference's intended-but-dead loss loop (``process_batch``, ref
+``src/utils.py:12-23``) fabricated random logits and cross-entropied them
+against sentiment labels, never updating anything. Here the step is real:
+next-token cross-entropy over the local model, value_and_grad, optax update —
+compiled once with ``jax.jit`` against explicit NamedShardings so GSPMD emits
+the DP gradient all-reduce / FSDP all-gather+reduce-scatter / TP collectives
+implied by the mesh, and donated so state is updated in place in HBM.
+
+Gradient accumulation (``TrainConfig.grad_accum_steps``) runs microbatches
+through ``lax.scan`` inside the compiled step — device-resident, no host
+round-trips between microbatches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from ditl_tpu.config import ModelConfig, TrainConfig
+from ditl_tpu.models import llama
+from ditl_tpu.parallel.sharding import DEFAULT_RULES, named_sharding_tree
+from ditl_tpu.train.state import TrainState, make_optimizer, state_logical_axes
+
+__all__ = ["loss_fn", "make_train_step", "make_eval_step", "batch_logical_axes"]
+
+
+def loss_fn(
+    params: Any,
+    batch: dict[str, jax.Array],
+    cfg: ModelConfig,
+    *,
+    mesh=None,
+    rules=None,
+) -> tuple[jax.Array, dict[str, jax.Array]]:
+    """Masked next-token cross-entropy (float32 logits), plus the MoE router
+    load-balancing aux term when the model is sparse."""
+    logits, aux = llama.forward(
+        params,
+        batch["input_ids"],
+        cfg,
+        positions=batch.get("positions"),
+        segment_ids=batch.get("segment_ids"),
+        mesh=mesh,
+        rules=rules,
+        with_aux=True,
+    )
+    targets = batch["input_ids"][:, 1:]
+    logits = logits[:, :-1]
+    mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(logits, targets[..., None].astype(jnp.int32), axis=-1)[
+        ..., 0
+    ]
+    nll = (logz - target_logit) * mask
+    n_tokens = jnp.maximum(mask.sum(), 1.0)
+    ce = nll.sum() / n_tokens
+    loss = ce + cfg.router_aux_coef * aux if cfg.num_experts > 0 else ce
+    return loss, {"loss": ce, "n_tokens": mask.sum()}
+
+
+def batch_logical_axes(example_batch: dict[str, Any]) -> dict[str, tuple]:
+    return {k: ("batch",) + (None,) * (v.ndim - 1) for k, v in example_batch.items()}
+
+
+def make_train_step(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    mesh,
+    example_batch: dict[str, Any],
+    rules: dict | None = None,
+) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
+    """Build the compiled train step with explicit in/out shardings."""
+    rules = rules if rules is not None else DEFAULT_RULES
+    tx = None
+
+    def get_tx(params):
+        nonlocal tx
+        if tx is None:
+            tx = make_optimizer(train_cfg, params)
+        return tx
+
+    accum = train_cfg.grad_accum_steps
+
+    def single_loss(params, batch):
+        return loss_fn(params, batch, model_cfg, mesh=mesh, rules=rules)
+
+    def step(state: TrainState, batch: dict) -> tuple[TrainState, dict]:
+        tx = get_tx(state.params)
+        if accum > 1:
+            # (B, ...) -> (accum, B/accum, ...): scan microbatches on device.
+            micro = jax.tree.map(
+                lambda x: x.reshape((accum, x.shape[0] // accum) + x.shape[1:]), batch
+            )
+
+            def micro_step(carry, mb):
+                grads_acc, loss_acc, tok_acc = carry
+                (loss, aux), grads = jax.value_and_grad(single_loss, has_aux=True)(
+                    state.params, mb
+                )
+                grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+                return (grads_acc, loss_acc + loss, tok_acc + aux["n_tokens"]), None
+
+            zero_grads = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, loss_sum, tokens), _ = jax.lax.scan(
+                micro_step, (zero_grads, 0.0, 0.0), micro
+            )
+            grads = jax.tree.map(lambda g: g / accum, grads)
+            loss = loss_sum / accum
+        else:
+            (loss, aux), grads = jax.value_and_grad(single_loss, has_aux=True)(
+                state.params, batch
+            )
+            tokens = aux["n_tokens"]
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = jax.tree.map(
+            lambda p, u: (p + u.astype(p.dtype)), state.params, updates
+        )
+        grad_norm = optax_global_norm(grads)
+        new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
+        metrics = {"loss": loss, "n_tokens": tokens, "grad_norm": grad_norm}
+        return new_state, metrics
+
+    state_shardings = named_sharding_tree(
+        mesh, state_logical_axes(model_cfg, train_cfg), rules
+    )
+    batch_shardings = named_sharding_tree(mesh, batch_logical_axes(example_batch), rules)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicated = NamedSharding(mesh, P())
+    metric_shardings = {"loss": replicated, "n_tokens": replicated, "grad_norm": replicated}
+
+    return jax.jit(
+        step,
+        in_shardings=(state_shardings, batch_shardings),
+        out_shardings=(state_shardings, metric_shardings),
+        donate_argnums=(0,),
+    )
+
+
+def optax_global_norm(tree: Any) -> jax.Array:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def make_eval_step(model_cfg: ModelConfig, mesh, rules: dict | None = None):
+    """Compiled forward-only step returning per-batch mean NLL."""
+    rules = rules if rules is not None else DEFAULT_RULES
+
+    @jax.jit
+    def eval_step(params, batch):
+        loss, aux = loss_fn(params, batch, model_cfg, mesh=mesh, rules=rules)
+        return aux
+
+    return eval_step

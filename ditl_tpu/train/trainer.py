@@ -1,0 +1,177 @@
+"""End-to-end trainer (L5) — the TPU-native analog of the reference's
+``main()`` (ref ``src/distributed_inference.py:43-84``), upgraded from a fake
+per-example device op to a real sharded fine-tune:
+
+  setup_logging -> init_runtime -> mesh -> consistency check -> data pipeline
+  -> sharded state init -> compiled train loop (metrics, checkpoints, optional
+  process-0 API eval) -> clean teardown.
+
+Every host runs this identical program (SPMD); they differ only in which data
+shards and array shards they hold.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from ditl_tpu.client.eval_loop import run_api_eval
+from ditl_tpu.client.llm import LLMClient
+from ditl_tpu.config import Config
+from ditl_tpu.data.dataset import load_text_dataset
+from ditl_tpu.data.loader import DataPipeline
+from ditl_tpu.data.tokenizer import get_tokenizer
+from ditl_tpu.models import llama
+from ditl_tpu.parallel.sharding import named_sharding_tree
+from ditl_tpu.runtime.consistency import check_cross_host_consistency
+from ditl_tpu.runtime.distributed import (
+    barrier,
+    init_runtime,
+    is_coordinator,
+    shutdown_runtime,
+)
+from ditl_tpu.runtime.mesh import build_mesh
+from ditl_tpu.train.checkpoint import CheckpointManager, DataIterState
+from ditl_tpu.train.metrics import MetricsLogger
+from ditl_tpu.train.state import TrainState, create_train_state, state_logical_axes
+from ditl_tpu.train.step import make_train_step
+from ditl_tpu.utils.logging import get_logger, setup_logging
+
+logger = get_logger(__name__)
+
+__all__ = ["train"]
+
+
+def train(config: Config) -> dict[str, Any]:
+    """Run the full fine-tune. Returns summary metrics (also logged)."""
+    t_start = time.time()
+    init_runtime(config.runtime)
+    setup_logging(config.runtime.log_level)
+    mesh = build_mesh(config.mesh)
+    model_cfg = config.model  # preset resolution happens in launch.build_config
+
+    tokenizer = get_tokenizer(config.data.tokenizer)
+    if model_cfg.vocab_size < tokenizer.vocab_size:
+        raise ValueError(
+            f"model vocab {model_cfg.vocab_size} < tokenizer vocab {tokenizer.vocab_size}"
+        )
+    dataset = load_text_dataset(config.data)
+    # Consistency check runs AFTER data loading so a host that silently fell
+    # back to the synthetic corpus (hub hiccup) is caught before any
+    # collective, not after a divergent epoch hangs one (SURVEY.md §5).
+    check_cross_host_consistency(
+        config,
+        extra={
+            "dataset_len": len(dataset),
+            "dataset_head": [dataset[i]["text"][:64] for i in range(min(3, len(dataset)))],
+        },
+    )
+    pipeline = DataPipeline(dataset, tokenizer, config.data, mesh)
+    logger.info(
+        "dataset: %d examples, %d steps/epoch (host batch %d, global %d)",
+        len(dataset),
+        pipeline.steps_per_epoch,
+        pipeline.host_batch_size,
+        config.data.batch_size,
+    )
+
+    # Sharded-from-birth state init: jit with out_shardings so every param is
+    # created directly on its mesh shards (a 70B state never fits one chip).
+    state_shardings = named_sharding_tree(
+        mesh, state_logical_axes(model_cfg, config.train)
+    )
+    rng = jax.random.key(config.train.seed)
+    with mesh:
+        init_fn = jax.jit(
+            lambda r: create_train_state(r, model_cfg, config.train),
+            out_shardings=state_shardings,
+        )
+        state = init_fn(rng)
+    n_params = llama.num_params(state.params)
+    logger.info("model %s: %.2fM params", model_cfg.name, n_params / 1e6)
+
+    # Checkpoint manager + resume.
+    ckpt: CheckpointManager | None = None
+    data_iter = DataIterState()
+    if config.train.checkpoint_dir:
+        ckpt = CheckpointManager(
+            config.train.checkpoint_dir,
+            max_to_keep=config.train.keep_checkpoints,
+            save_every=config.train.checkpoint_every,
+        )
+        if config.train.resume:
+            abstract = jax.tree.map(
+                lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+                jax.eval_shape(lambda: state),
+                state_shardings,
+            )
+            restored = ckpt.restore_latest(abstract)
+            if restored is not None:
+                state, data_iter = restored
+
+    example = next(iter(pipeline.epoch(0)))
+    train_step = make_train_step(model_cfg, config.train, mesh, example)
+
+    metrics = MetricsLogger(log_every=config.train.log_every)
+    client = LLMClient(config.api)
+    total_steps = config.train.total_steps
+    global_step = data_iter.global_step
+    step_metrics = None
+    last_saved = None
+    epoch = data_iter.epoch
+
+    try:
+        for epoch in range(data_iter.epoch, config.data.num_epochs):
+            # Resume skips already-consumed batches at the sampler level.
+            start = data_iter.step_in_epoch if epoch == data_iter.epoch else 0
+            for step_in_epoch, batch in enumerate(
+                pipeline.epoch(epoch, start_step=start), start=start
+            ):
+                if global_step >= total_steps:
+                    break
+                metrics.start_step()
+                state, step_metrics = train_step(state, batch)
+                metrics.end_step(global_step, step_metrics)
+                global_step += 1
+                position = DataIterState(epoch, step_in_epoch + 1, global_step)
+                if ckpt is not None and ckpt.should_save(global_step):
+                    ckpt.save(global_step, state, position)
+                    last_saved = global_step
+                if (
+                    config.train.eval_every
+                    and global_step % config.train.eval_every == 0
+                ):
+                    idx = np.arange(min(config.train.eval_samples, len(dataset)))
+                    run_api_eval(
+                        client,
+                        [dataset[int(i)]["text"] for i in idx],
+                        [dataset[int(i)]["label"] for i in idx],
+                        max_samples=config.train.eval_samples,
+                    )
+            if global_step >= total_steps:
+                break
+        metrics.flush()
+        if ckpt is not None and last_saved != global_step:
+            ckpt.save(global_step, state, DataIterState(epoch, 0, global_step))
+            ckpt.wait()
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+        barrier("end-of-training")
+
+    summary = metrics.summary()
+    summary["final_loss"] = (
+        float(jax.device_get(step_metrics["loss"]))
+        if step_metrics is not None
+        else float("nan")
+    )
+    summary["steps"] = global_step
+    summary["params_m"] = n_params / 1e6
+    summary["wall_s"] = time.time() - t_start
+    if is_coordinator():
+        logger.info("training done: %s", summary)
+    shutdown_runtime()
+    return summary

@@ -1,0 +1,1 @@
+from ditl_tpu.utils.logging import get_logger, setup_logging  # noqa: F401

@@ -1,0 +1,56 @@
+"""Process-0-gated logging (L0).
+
+Parity surface: ``setup_logging()`` (ref ``src/utils.py:5-10``) configured
+INFO-level timestamped logging, and the driver gated per-example output on
+``rank == 0`` (ref ``src/distributed_inference.py:71-76``). Here the gating is
+built into the logger itself so every module gets it for free: non-zero
+processes log only WARNING and above unless ``all_processes=True``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FORMAT = "%(asctime)s - %(levelname)s - [p%(process_index)s] %(name)s - %(message)s"
+_configured = False
+
+
+class _ProcessIndexFilter(logging.Filter):
+    """Injects the JAX process index into every record (lazily — jax may not be
+    initialized when logging is configured)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.process_index = _process_index()
+        return True
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def setup_logging(level: str = "INFO", all_processes: bool = False) -> None:
+    """Configure root logging. On processes != 0, raise the threshold to
+    WARNING (the reference's ``if rank == 0`` gate, made structural)."""
+    global _configured
+    effective = level.upper()
+    if not all_processes and _process_index() != 0:
+        effective = "WARNING"
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    handler.addFilter(_ProcessIndexFilter())
+    root = logging.getLogger()
+    if _configured:
+        root.handlers.clear()
+    root.addHandler(handler)
+    root.setLevel(effective)
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    return logging.getLogger(name)

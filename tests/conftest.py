@@ -1,0 +1,68 @@
+"""Test harness: simulate an 8-device pod on CPU.
+
+This is the repaired version of the reference's broken distributed fixture —
+its ``setUpClass`` ran a real ``init_process_group(world_size=2)`` in a single
+process and deadlocked at the barrier (ref
+``tests/test_distributed_finetuning.py:8-13``, SURVEY.md §3.5). Here
+multi-device behavior is tested honestly: 8 virtual CPU devices via XLA's
+host-platform device-count override, configured *before JAX's backend
+initializes* (hence env mutation at conftest import time).
+"""
+
+import os
+
+# Must happen before JAX's backends initialize (first jax.devices() call).
+# Env vars alone are not enough when something (e.g. a site hook) imported jax
+# before pytest loaded this file — jax snapshots env into its config at import
+# — so set the config directly too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 simulated devices, got {len(devices)}"
+    return devices
+
+
+@pytest.fixture(scope="session")
+def tiny_model_cfg():
+    from ditl_tpu.config import ModelConfig
+
+    return ModelConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+    )
+
+
+@pytest.fixture()
+def example_batch():
+    rng = np.random.default_rng(0)
+    b, s = 8, 32
+    return {
+        "input_ids": rng.integers(3, 500, size=(b, s)).astype(np.int32),
+        "loss_mask": np.ones((b, s), np.float32),
+        "labels": np.zeros((b,), np.int32),
+        "segment_ids": np.ones((b, s), np.int32),
+        "positions": np.tile(np.arange(s, dtype=np.int32), (b, 1)),
+    }

@@ -1,0 +1,142 @@
+"""Remote-LLM client tests (L4).
+
+Keeps the reference's one good testing idea — fake the model response by
+injection (ref ``tests/test_distributed_finetuning.py:27-36``) — via the
+transport seam, and adds what the reference only documented: retry/backoff on
+429/5xx (ref ``docs/troubleshooting.md:42-51``). Also runs one integration
+test against a real local OpenAI-compatible HTTP server (SURVEY.md §4 lesson)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from ditl_tpu.config import APIConfig
+from ditl_tpu.client.llm import ERROR_SENTINEL, LLMClient
+
+
+def _ok_response(content="positive"):
+    return {"choices": [{"message": {"role": "assistant", "content": content}}]}
+
+
+def _fast_cfg(**kw):
+    return APIConfig(backoff_base_s=0.001, backoff_max_s=0.002, max_retries=3, **kw)
+
+
+def test_complete_success():
+    calls = []
+
+    def transport(url, headers, body, timeout):
+        calls.append((url, json.loads(body)))
+        return 200, {}, json.dumps(_ok_response("hello")).encode()
+
+    client = LLMClient(_fast_cfg(), transport=transport)
+    assert client.complete("hi") == "hello"
+    url, payload = calls[0]
+    assert url.endswith("/chat/completions")
+    assert payload["messages"][-1] == {"role": "user", "content": "hi"}
+    assert payload["model"] == APIConfig().model_name
+
+
+def test_retry_on_429_then_success():
+    attempts = []
+
+    def transport(url, headers, body, timeout):
+        attempts.append(1)
+        if len(attempts) < 3:
+            return 429, {"Retry-After": "0.001"}, b"rate limited"
+        return 200, {}, json.dumps(_ok_response("ok")).encode()
+
+    client = LLMClient(_fast_cfg(), transport=transport)
+    assert client.complete("hi") == "ok"
+    assert len(attempts) == 3
+
+
+def test_total_function_on_persistent_failure():
+    """Never raises — sentinel string contract (ref ``:39-41``)."""
+
+    def transport(url, headers, body, timeout):
+        raise OSError("connection refused")
+
+    client = LLMClient(_fast_cfg(), transport=transport)
+    assert client.complete("hi") == ERROR_SENTINEL
+
+
+def test_no_retry_on_4xx():
+    attempts = []
+
+    def transport(url, headers, body, timeout):
+        attempts.append(1)
+        return 400, {}, b"bad request"
+
+    client = LLMClient(_fast_cfg(), transport=transport)
+    assert client.complete("hi") == ERROR_SENTINEL
+    assert len(attempts) == 1  # 400 is not retryable
+
+
+def test_complete_many_order_and_concurrency():
+    lock = threading.Lock()
+    in_flight = [0]
+    peak = [0]
+
+    def transport(url, headers, body, timeout):
+        with lock:
+            in_flight[0] += 1
+            peak[0] = max(peak[0], in_flight[0])
+        prompt = json.loads(body)["messages"][-1]["content"]
+        import time
+
+        time.sleep(0.01)
+        with lock:
+            in_flight[0] -= 1
+        return 200, {}, json.dumps(_ok_response(f"re:{prompt}")).encode()
+
+    client = LLMClient(_fast_cfg(max_concurrency=4), transport=transport)
+    prompts = [f"p{i}" for i in range(12)]
+    out = client.complete_many(prompts)
+    assert out == [f"re:p{i}" for i in range(12)]
+    assert peak[0] > 1  # actually concurrent
+    assert peak[0] <= 4  # bounded
+
+
+def test_auth_header_from_env(monkeypatch):
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-secret")
+    seen = {}
+
+    def transport(url, headers, body, timeout):
+        seen.update(headers)
+        return 200, {}, json.dumps(_ok_response()).encode()
+
+    LLMClient(_fast_cfg(), transport=transport).complete("hi")
+    assert seen["Authorization"] == "Bearer sk-secret"
+
+
+class _FakeOpenAIHandler(BaseHTTPRequestHandler):
+    def do_POST(self):
+        length = int(self.headers["Content-Length"])
+        payload = json.loads(self.rfile.read(length))
+        prompt = payload["messages"][-1]["content"]
+        body = json.dumps(_ok_response(f"echo:{prompt}")).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+def test_against_real_local_http_server():
+    server = HTTPServer(("127.0.0.1", 0), _FakeOpenAIHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = server.server_address[1]
+        cfg = APIConfig(api_base=f"http://127.0.0.1:{port}/v1", timeout_s=5.0)
+        client = LLMClient(cfg)
+        assert client.complete("ping") == "echo:ping"
+        assert client.complete_many(["a", "b"]) == ["echo:a", "echo:b"]
+    finally:
+        server.shutdown()

@@ -1,0 +1,76 @@
+"""Config system tests (L0). Covers the reference's config-module contract
+(5-key CONFIG dict, SURVEY.md §2 'Config module') in its typed replacement."""
+
+import pytest
+
+from ditl_tpu.config import (
+    APIConfig,
+    Config,
+    MeshConfig,
+    config_fingerprint,
+    parse_overrides,
+)
+
+
+def test_defaults_roundtrip():
+    cfg = Config()
+    again = Config.from_dict(cfg.to_dict())
+    assert again == cfg
+
+
+def test_overrides():
+    cfg = Config()
+    cfg = parse_overrides(
+        cfg,
+        [
+            "train.total_steps=50",
+            "mesh.fsdp=8",
+            "data.synthetic=true",
+            "model.dtype=float32",
+            "train.learning_rate=1e-4",
+        ],
+    )
+    assert cfg.train.total_steps == 50
+    assert cfg.mesh.fsdp == 8
+    assert cfg.data.synthetic is True
+    assert cfg.model.dtype == "float32"
+    assert cfg.train.learning_rate == pytest.approx(1e-4)
+
+
+def test_override_rejects_unknown():
+    with pytest.raises(ValueError):
+        parse_overrides(Config(), ["nope.key=1"])
+    with pytest.raises(ValueError):
+        parse_overrides(Config(), ["train.nope=1"])
+    with pytest.raises(ValueError):
+        parse_overrides(Config(), ["malformed"])
+
+
+def test_fingerprint_sensitivity():
+    a = Config()
+    b = parse_overrides(Config(), ["train.seed=43"])
+    assert config_fingerprint(a) == config_fingerprint(Config())
+    assert config_fingerprint(a) != config_fingerprint(b)
+
+
+def test_api_key_from_env_only(monkeypatch):
+    """Secrets never live in config objects (reference kept them in config.py;
+    good property was keeping that file out of git — here it's structural)."""
+    import dataclasses
+
+    api = APIConfig()
+    assert "api_key" not in dataclasses.asdict(api)  # only api_key_env is stored
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-test")
+    assert api.api_key() == "sk-test"
+    monkeypatch.delenv("OPENAI_API_KEY")
+    assert api.api_key() == ""
+
+
+def test_mesh_resolve():
+    assert MeshConfig(data=-1).resolve(8) == (8, 1, 1, 1, 1)
+    assert MeshConfig(data=2, fsdp=2, tensor=2).resolve(8) == (2, 2, 1, 2, 1)
+    assert MeshConfig(data=1, fsdp=-1).resolve(8) == (1, 8, 1, 1, 1)
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, fsdp=-1).resolve(8)

@@ -1,0 +1,134 @@
+"""Model tests: Llama forward semantics, causality, GQA, MoE, LoRA."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.models import llama
+from ditl_tpu.ops.attention import dot_product_attention
+
+
+def _f32(cfg):
+    return dataclasses.replace(cfg, dtype="float32")
+
+
+def test_forward_shapes(tiny_model_cfg):
+    cfg = tiny_model_cfg
+    params = llama.init_params(jax.random.key(0), cfg)
+    ids = jnp.ones((2, 16), jnp.int32)
+    logits = llama.forward(params, ids, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality(tiny_model_cfg):
+    """Changing a future token must not change past logits."""
+    cfg = _f32(tiny_model_cfg)
+    params = llama.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 500, size=(1, 16)).astype(np.int32)
+    ids2 = ids.copy()
+    ids2[0, 10:] = (ids2[0, 10:] + 7) % 500 + 3
+    l1 = llama.forward(params, jnp.asarray(ids), cfg)
+    l2 = llama.forward(params, jnp.asarray(ids2), cfg)
+    np.testing.assert_allclose(l1[0, :10], l2[0, :10], rtol=2e-4, atol=2e-4)
+    assert not np.allclose(l1[0, 10:], l2[0, 10:], rtol=1e-3)
+
+
+def test_segment_isolation(tiny_model_cfg):
+    """Tokens in different segments (packed docs) must not attend to each
+    other: logits for segment A are unchanged when segment B's tokens change."""
+    cfg = _f32(tiny_model_cfg)
+    params = llama.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(3, 500, size=(1, 16)).astype(np.int32)
+    seg = np.concatenate([np.ones(8), np.full(8, 2)]).astype(np.int32)[None]
+    pos = np.concatenate([np.arange(8), np.arange(8)]).astype(np.int32)[None]
+    ids2 = ids.copy()
+    ids2[0, 8:] = (ids2[0, 8:] + 11) % 500 + 3
+    kw = dict(segment_ids=jnp.asarray(seg), positions=jnp.asarray(pos))
+    l1 = llama.forward(params, jnp.asarray(ids), cfg, **kw)
+    l2 = llama.forward(params, jnp.asarray(ids2), cfg, **kw)
+    np.testing.assert_allclose(l1[0, :8], l2[0, :8], rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_matches_mha_when_equal_heads():
+    """With num_kv_heads == num_heads the GQA path is plain MHA."""
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.normal(size=(2, 8, 4, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(2, 8, 4, 16)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(2, 8, 4, 16)).astype(np.float32))
+    out = dot_product_attention(q, k, v, causal=True)
+    # manual reference
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / 4.0
+    mask = jnp.tril(jnp.ones((8, 8), bool))
+    scores = jnp.where(mask[None, None], scores, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(scores, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_rope_relative_shift_invariance():
+    """RoPE attention scores depend only on relative positions."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(1, 8, 2, 16)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(1, 8, 2, 16)).astype(np.float32))
+    p0 = jnp.arange(8, dtype=jnp.int32)[None]
+    p5 = p0 + 5
+    q0 = llama.apply_rope(q, p0, 10000.0)
+    k0 = llama.apply_rope(k, p0, 10000.0)
+    q5 = llama.apply_rope(q, p5, 10000.0)
+    k5 = llama.apply_rope(k, p5, 10000.0)
+    s0 = jnp.einsum("bqhd,bkhd->bhqk", q0, k0)
+    s5 = jnp.einsum("bqhd,bkhd->bhqk", q5, k5)
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s5), rtol=1e-4, atol=1e-4)
+
+
+def test_moe_forward(tiny_model_cfg):
+    cfg = dataclasses.replace(
+        tiny_model_cfg, num_experts=4, num_experts_per_tok=2, dtype="float32"
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    assert "moe" in params["layers"] and "mlp" not in params["layers"]
+    ids = jnp.ones((2, 16), jnp.int32)
+    logits = llama.forward(params, ids, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_lora_starts_identical_to_base(tiny_model_cfg):
+    """B=0 init => adapted model equals base model exactly at step 0."""
+    base_cfg = _f32(tiny_model_cfg)
+    lora_cfg = dataclasses.replace(base_cfg, lora_rank=4)
+    base = llama.init_params(jax.random.key(0), base_cfg)
+    adapted = llama.init_params(jax.random.key(0), lora_cfg)
+    ids = jnp.ones((1, 8), jnp.int32)
+    l_base = llama.forward(base, ids, base_cfg)
+    l_adapted = llama.forward(adapted, ids, lora_cfg)
+    np.testing.assert_allclose(np.asarray(l_base), np.asarray(l_adapted), rtol=1e-6)
+
+
+def test_param_axes_match_param_tree(tiny_model_cfg):
+    for num_experts, lora in [(0, 0), (4, 0), (0, 4)]:
+        cfg = dataclasses.replace(tiny_model_cfg, num_experts=num_experts, lora_rank=lora)
+        params = llama.init_params(jax.random.key(0), cfg)
+        axes = llama.param_logical_axes(cfg)
+        flat_p = jax.tree_util.tree_flatten_with_path(params)[0]
+        flat_a = jax.tree_util.tree_flatten_with_path(
+            axes, is_leaf=lambda x: isinstance(x, tuple)
+        )[0]
+        paths_p = [p for p, _ in flat_p]
+        paths_a = [p for p, _ in flat_a]
+        assert paths_p == paths_a
+        for (_, arr), (_, ax) in zip(flat_p, flat_a):
+            assert arr.ndim == len(ax)
+
+
+def test_num_params(tiny_model_cfg):
+    params = llama.init_params(jax.random.key(0), tiny_model_cfg)
+    n = llama.num_params(params)
+    assert n > 0
+    assert n == sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))

@@ -1,0 +1,81 @@
+"""ShardedSampler tests: the DistributedSampler-semantics contract
+(SURVEY.md §2 'Parallelism strategy', §7 'hard part (e)').
+
+Property parity is additionally cross-checked against
+``torch.utils.data.DistributedSampler`` itself (torch-cpu is available in the
+test image), not to copy its RNG stream but to pin the *semantics*: shard
+sizes, padding behavior, disjoint-cover, and epoch-reshuffle determinism.
+"""
+
+import numpy as np
+import pytest
+
+from ditl_tpu.data.sampler import ShardedSampler
+
+
+@pytest.mark.parametrize("n,replicas", [(100, 4), (101, 4), (7, 3), (3, 8), (250, 2)])
+def test_equal_split_and_cover(n, replicas):
+    shards = [
+        ShardedSampler(n, replicas, r, shuffle=True, seed=0).local_indices()
+        for r in range(replicas)
+    ]
+    expected = -(-n // replicas)
+    assert all(len(s) == expected for s in shards)
+    union = np.concatenate(shards)
+    # Padded union covers every dataset index.
+    assert set(union.tolist()) == set(range(n))
+
+
+@pytest.mark.parametrize("n,replicas", [(101, 4), (7, 3)])
+def test_drop_last_truncates(n, replicas):
+    shards = [
+        ShardedSampler(n, replicas, r, shuffle=False, drop_last=True).local_indices()
+        for r in range(replicas)
+    ]
+    assert all(len(s) == n // replicas for s in shards)
+    union = np.concatenate(shards)
+    assert len(union) == len(set(union.tolist()))  # no duplicates
+
+
+def test_epoch_reshuffle_deterministic():
+    a = ShardedSampler(100, 4, 1, shuffle=True, seed=7)
+    b = ShardedSampler(100, 4, 1, shuffle=True, seed=7)
+    a.set_epoch(3)
+    b.set_epoch(3)
+    assert np.array_equal(a.local_indices(), b.local_indices())
+    b.set_epoch(4)
+    assert not np.array_equal(a.local_indices(), b.local_indices())
+
+
+def test_replicas_agree_on_global_permutation():
+    perms = [
+        ShardedSampler(50, 5, r, shuffle=True, seed=1).global_permutation()
+        for r in range(5)
+    ]
+    for p in perms[1:]:
+        assert np.array_equal(perms[0], p)
+
+
+def test_no_shuffle_is_identity_order():
+    s = ShardedSampler(10, 2, 0, shuffle=False)
+    assert s.global_permutation()[:10].tolist() == list(range(10))
+
+
+def test_semantics_match_torch_distributed_sampler():
+    """Same num_samples / total_size / padding behavior as torch's sampler."""
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DistributedSampler
+
+    class _DS(torch.utils.data.Dataset):
+        def __len__(self):
+            return 101
+
+        def __getitem__(self, i):
+            return i
+
+    for rank in range(4):
+        theirs = DistributedSampler(_DS(), num_replicas=4, rank=rank, shuffle=False)
+        ours = ShardedSampler(101, 4, rank, shuffle=False)
+        assert len(ours) == theirs.num_samples
+        assert ours.total_size == theirs.total_size
+        assert ours.local_indices().tolist() == list(iter(theirs))

@@ -1,0 +1,124 @@
+"""Train-step tests: loss decreases, grad accumulation, LoRA freezing,
+sharded-state layouts on the 8-device mesh."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from ditl_tpu.config import MeshConfig, TrainConfig
+from ditl_tpu.data.loader import make_global_batch
+from ditl_tpu.runtime.mesh import build_mesh
+from ditl_tpu.train.state import create_train_state, state_logical_axes
+from ditl_tpu.train.step import make_train_step
+
+
+def _setup(tiny_model_cfg, example_batch, mesh_cfg=MeshConfig(), train_cfg=None):
+    mesh = build_mesh(mesh_cfg)
+    tcfg = train_cfg or TrainConfig(total_steps=20, warmup_steps=2, learning_rate=1e-3)
+    state = create_train_state(jax.random.key(0), tiny_model_cfg, tcfg)
+    gb = make_global_batch(mesh, example_batch)
+    step = make_train_step(tiny_model_cfg, tcfg, mesh, gb)
+    return mesh, state, gb, step
+
+
+def test_loss_decreases_dp(tiny_model_cfg, example_batch):
+    _, state, gb, step = _setup(tiny_model_cfg, example_batch)
+    state, m0 = step(state, gb)
+    first = float(m0["loss"])
+    for _ in range(10):
+        state, m = step(state, gb)
+    assert float(m["loss"]) < first - 0.3
+    assert np.isfinite(float(m["grad_norm"]))
+    assert float(m["n_tokens"]) == example_batch["loss_mask"][:, 1:].sum()
+
+
+def test_loss_decreases_fsdp_tp(tiny_model_cfg, example_batch):
+    mesh, state, gb, step = _setup(
+        tiny_model_cfg, example_batch, MeshConfig(data=2, fsdp=2, tensor=2)
+    )
+    # params actually sharded: wq's embed dim over fsdp, head dim over tensor
+    state, _ = step(state, gb)
+    wq = state.params["layers"]["attn"]["wq"]
+    shard_shape = wq.addressable_shards[0].data.shape
+    assert shard_shape[1] == wq.shape[1] // 2  # fsdp over embed
+    assert shard_shape[2] == wq.shape[2] // 2  # tensor over heads
+    prev = None
+    for _ in range(8):
+        state, m = step(state, gb)
+        cur = float(m["loss"])
+        if prev is not None:
+            assert cur < prev + 0.1
+        prev = cur
+
+
+def test_dp_and_fsdp_agree(tiny_model_cfg, example_batch):
+    """Same seed + data => same loss trajectory regardless of mesh layout
+    (SPMD invariance: parallelism must not change the math)."""
+    cfg = dataclasses.replace(tiny_model_cfg, dtype="float32", param_dtype="float32")
+    losses = {}
+    for name, mesh_cfg in [
+        ("dp", MeshConfig()),
+        ("fsdp", MeshConfig(data=1, fsdp=8)),
+        ("tp", MeshConfig(data=2, fsdp=2, tensor=2)),
+    ]:
+        _, state, gb, step = _setup(cfg, example_batch, mesh_cfg)
+        traj = []
+        for _ in range(3):
+            state, m = step(state, gb)
+            traj.append(float(m["loss"]))
+        losses[name] = traj
+    np.testing.assert_allclose(losses["dp"], losses["fsdp"], rtol=1e-4)
+    np.testing.assert_allclose(losses["dp"], losses["tp"], rtol=1e-4)
+
+
+def test_grad_accum_matches_full_batch(tiny_model_cfg, example_batch):
+    """accum=2 over half-batches == accum=1 over the full batch (same update
+    in exact arithmetic; f32 here so tolerance is tight)."""
+    cfg = dataclasses.replace(tiny_model_cfg, dtype="float32", param_dtype="float32")
+    tcfg1 = TrainConfig(total_steps=5, warmup_steps=1, grad_accum_steps=1)
+    tcfg2 = TrainConfig(total_steps=5, warmup_steps=1, grad_accum_steps=2)
+    mesh = build_mesh(MeshConfig())
+    gb = make_global_batch(mesh, example_batch)
+    s1 = create_train_state(jax.random.key(0), cfg, tcfg1)
+    s2 = create_train_state(jax.random.key(0), cfg, tcfg2)
+    step1 = make_train_step(cfg, tcfg1, mesh, gb)
+    step2 = make_train_step(cfg, tcfg2, mesh, gb)
+    s1, m1 = step1(s1, gb)
+    s2, m2 = step2(s2, gb)
+    # loss reported by accum path averages the two microbatch losses
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-5)
+    w1 = np.asarray(s1.params["layers"]["attn"]["wq"])
+    w2 = np.asarray(s2.params["layers"]["attn"]["wq"])
+    np.testing.assert_allclose(w1, w2, rtol=1e-4, atol=1e-6)
+
+
+def test_lora_freezes_base(tiny_model_cfg, example_batch):
+    cfg = dataclasses.replace(tiny_model_cfg, lora_rank=4)
+    tcfg = TrainConfig(total_steps=5, warmup_steps=1, learning_rate=1e-2)
+    mesh = build_mesh(MeshConfig())
+    gb = make_global_batch(mesh, example_batch)
+    state = create_train_state(jax.random.key(0), cfg, tcfg)
+    step = make_train_step(cfg, tcfg, mesh, gb)
+    wq_before = np.asarray(state.params["layers"]["attn"]["wq"]).copy()
+    lora_b_before = np.asarray(state.params["layers"]["lora"]["wq"]["b"]).copy()
+    for _ in range(3):
+        state, m = step(state, gb)
+    wq_after = np.asarray(state.params["layers"]["attn"]["wq"])
+    lora_b_after = np.asarray(state.params["layers"]["lora"]["wq"]["b"])
+    np.testing.assert_array_equal(wq_before, wq_after)  # base frozen
+    assert not np.allclose(lora_b_before, lora_b_after)  # adapters train
+
+
+def test_state_logical_axes_cover_state(tiny_model_cfg):
+    tcfg = TrainConfig()
+    axes = state_logical_axes(tiny_model_cfg, tcfg)
+    state = create_train_state(jax.random.key(1), tiny_model_cfg, tcfg)
+    from ditl_tpu.parallel.sharding import is_axes_leaf
+
+    flat_state = jax.tree_util.tree_flatten(state)[0]
+    flat_axes = jax.tree_util.tree_flatten(axes, is_leaf=is_axes_leaf)[0]
+    assert len(flat_state) == len(flat_axes)
+    for arr, ax in zip(flat_state, flat_axes):
+        assert arr.ndim == len(ax), f"{arr.shape} vs {ax}"
