@@ -109,6 +109,7 @@ class DataPipeline:
             seed=config.seed,
             drop_last=config.drop_last,
         )
+        self._doc_len_cache: dict[int, int] = {}
 
     @property
     def steps_per_epoch(self) -> int:
@@ -191,18 +192,26 @@ class DataPipeline:
                 "positions": positions[sl],
             }
 
+    def _doc_token_count(self, idx: int) -> int:
+        """Tokenized length of one document incl. bos/eos. Cached: document
+        lengths are epoch-invariant (only the permutation reshuffles), so the
+        global batch-count scan must not re-tokenize the dataset every epoch."""
+        cached = self._doc_len_cache.get(idx)
+        if cached is None:
+            cached = len(self.tokenizer.encode(self.dataset[idx]["text"])) + 2
+            self._doc_len_cache[idx] = cached
+        return cached
+
     def _global_min_batches(self) -> int:
         """Minimum packed batch count over all hosts' shards. Every host can
         compute every shard's token count locally (the permutation is shared),
         so this needs no collective."""
-        tok, seq_len = self.tokenizer, self.config.seq_len
+        seq_len = self.config.seq_len
         perm = self.sampler.global_permutation()
         counts = []
         for rank in range(self.process_count):
             shard = perm[rank :: self.process_count]
-            tokens = sum(
-                len(tok.encode(self.dataset[int(i)]["text"])) + 2 for i in shard
-            )
+            tokens = sum(self._doc_token_count(int(i)) for i in shard)
             counts.append((tokens // seq_len) // self.host_batch_size)
         return min(counts)
 

@@ -214,7 +214,8 @@ def _decoder_layer(
     q = _constrain(q, ("batch", "seq", "act_heads", "head_dim"), mesh, rules)
     k = _constrain(k, ("batch", "seq", "act_kv_heads", "head_dim"), mesh, rules)
     attn_out = dot_product_attention(
-        q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl, mesh=mesh
+        q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
+        mesh=mesh, rules=rules,
     )
     attn_out = attn_out.reshape(b, s, nh * hd)
     x = x + proj(attn_out, attn["wo"], "wo")

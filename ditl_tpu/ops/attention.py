@@ -53,6 +53,18 @@ def _xla_attention(
     return out.reshape(b, s_q, h, d)
 
 
+def _mesh_axes_size(mesh, axes) -> int:
+    """Product of mesh-axis sizes for a rules value (str, tuple, or None)."""
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return size
+
+
 def dot_product_attention(
     q: jax.Array,
     k: jax.Array,
@@ -62,22 +74,72 @@ def dot_product_attention(
     segment_ids: jax.Array | None = None,
     impl: str = "xla",
     mesh=None,
+    rules=None,
 ) -> jax.Array:
     """Grouped-query attention. ``segment_ids`` (B, S) int32 restricts
     attention to tokens of the same segment (sequence packing / padding:
-    give pad tokens a segment id of -1-ish sentinel distinct from real ones)."""
+    give pad tokens a segment id of -1-ish sentinel distinct from real ones).
+
+    ``rules`` is the logical-axis table (parallel/sharding.py) used to derive
+    shard_map specs for the flash and ring paths — the same single source of
+    truth the rest of the model uses for its sharding constraints."""
     if q.shape[2] % k.shape[2]:
         raise ValueError(f"q heads {q.shape[2]} not divisible by kv heads {k.shape[2]}")
     if impl == "xla":
         return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
-    if impl == "flash":
-        from ditl_tpu.ops.flash_attention import flash_attention
-
-        return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     if impl == "ring":
         from ditl_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, mesh=mesh
+            q, k, v, causal=causal, segment_ids=segment_ids, mesh=mesh, rules=rules
         )
+    if impl == "flash":
+        from ditl_tpu.ops import flash_attention as fa
+        from ditl_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+        rules = rules if rules is not None else DEFAULT_RULES
+        if mesh is not None and _mesh_axes_size(mesh, rules.get("seq")) > 1:
+            # Sequence-sharded activations: ring attention IS the flash path
+            # for context parallelism (blockwise kernel distributed over the
+            # ring instead of the Pallas grid).
+            from ditl_tpu.ops.ring_attention import ring_attention
+
+            return ring_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids, mesh=mesh,
+                rules=rules,
+            )
+        if not fa.supports(q.shape[1], k.shape[1], q.shape[3]):
+            # Shapes the kernel can't tile (tiny tests, odd seq lens): XLA.
+            return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        if mesh is None:
+            return fa.flash_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids
+            )
+        # Pallas calls carry no GSPMD partitioning rules — under pjit they
+        # must be explicitly mapped over the mesh. Batch splits over the
+        # batch axes and heads over the heads axis; attention is independent
+        # along both, so no collectives are induced.
+        dp = _mesh_axes_size(mesh, rules.get("batch"))
+        tp = _mesh_axes_size(mesh, rules.get("act_heads"))
+        if q.shape[0] % dp or q.shape[2] % tp or k.shape[2] % tp:
+            # Mesh doesn't divide batch/heads: the shard_map would fail at
+            # trace time — use the GSPMD-partitionable XLA path instead.
+            return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        qkv_spec = logical_to_spec(("batch", None, "act_heads", None), rules)
+        args = [q, k, v]
+        in_specs = [qkv_spec, qkv_spec, qkv_spec]
+        if segment_ids is not None:
+            args.append(segment_ids)
+            in_specs.append(logical_to_spec(("batch", None), rules))
+
+        def local(q_, k_, v_, seg_=None):
+            return fa.flash_attention(q_, k_, v_, causal=causal, segment_ids=seg_)
+
+        return jax.shard_map(
+            local,
+            mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=qkv_spec,
+            check_vma=False,
+        )(*args)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,656 @@
+"""Blockwise FlashAttention as a Pallas (Mosaic) TPU kernel.
+
+The reference has no attention code at all (its model lives behind an HTTP
+API — ref ``src/distributed_inference.py:34-41``); this kernel is part of the
+TPU-native compute path that replaces the reference's device op
+(``src/utils.py:25-28``) with a real transformer forward.
+
+Design (TPU-first):
+- **O(S) memory**: online softmax over KV blocks; the (S, S) score matrix is
+  never materialized in HBM. Residuals for the backward pass are ``o`` and the
+  per-row log-sum-exp.
+- **MXU tiling**: q/k/v are consumed in (block, head_dim) tiles; both matmuls
+  (``q·kᵀ`` and ``p·v``) run on the MXU with f32 accumulation; the second
+  matmul feeds ``p`` in the value dtype (bf16) for MXU throughput.
+- **Lane-replicated row stats**: running max ``m`` and normalizer ``l`` are
+  kept as (block_q, 128) with all lanes equal — row-broadcasts become free
+  elementwise ops, avoiding sublane↔lane transposes Mosaic handles poorly.
+  The log-sum-exp residual is stored lane-replicated the same way.
+- **GQA-native**: H query heads share H//K KV heads; the KV block index map
+  divides the head index, so KV tiles are fetched once per group.
+- **Causal block skipping**: fully-masked KV blocks are predicated off with
+  ``pl.when`` (the grid still visits them; compute and the second matmul are
+  skipped).
+- **Custom VJP**: backward runs two Pallas kernels — one accumulating dq over
+  KV blocks, one accumulating dk/dv over (group × query) blocks — both
+  recomputing p from the saved log-sum-exp (FlashAttention-2 style).
+
+Layouts are (B, H, S, D) inside the kernels (callers pass (B, S, H, D); the
+wrapper transposes — XLA fuses the transpose into neighboring ops).
+Automatically runs in interpreter mode off-TPU so the same tests run on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+from ditl_tpu.ops.attention import NEG_INF  # single source of the mask value
+
+NUM_LANES = 128
+NUM_SUBLANES = 8
+
+
+class BlockSizes(NamedTuple):
+    block_q: int
+    block_kv: int
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_blocks(s_q: int, s_kv: int, block_q: int, block_kv: int) -> BlockSizes:
+    return BlockSizes(min(block_q, s_q), min(block_kv, s_kv))
+
+
+def supports(s_q: int, s_kv: int, head_dim: int, block_q: int = 512,
+             block_kv: int = 512) -> bool:
+    """True if the kernel can handle these shapes (callers fall back to XLA)."""
+    bq, bkv = _pick_blocks(s_q, s_kv, block_q, block_kv)
+    return (
+        s_q % bq == 0
+        and s_kv % bkv == 0
+        and bkv % NUM_LANES == 0
+        and bq % NUM_SUBLANES == 0
+        # _lane_tile can slice (64) or tile whole lanes (128k), nothing else.
+        and (head_dim == 64 or head_dim % NUM_LANES == 0)
+    )
+
+
+def _lane_tile(x: jax.Array, width: int) -> jax.Array:
+    """Tile a lane-replicated (rows, 128) array to (rows, width)."""
+    if width == NUM_LANES:
+        return x
+    if width < NUM_LANES:
+        return x[:, :width]
+    return jnp.tile(x, (1, width // NUM_LANES))
+
+
+def _block_mask(
+    s: jax.Array,
+    *,
+    iq: jax.Array,
+    ikv: jax.Array,
+    block_q: int,
+    block_kv: int,
+    causal: bool,
+    q_seg: jax.Array | None,
+    kv_seg: jax.Array | None,
+) -> jax.Array:
+    """Apply causal + segment masking to a (block_q, block_kv) score tile."""
+    mask = None
+    if causal:
+        rows = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=0
+        )
+        cols = ikv * block_kv + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, dimension=1
+        )
+        mask = rows >= cols
+    if q_seg is not None:
+        # q_seg: (block_q, 128) lane-replicated; kv_seg: (8, block_kv)
+        # sublane-replicated. Tile q over lanes, slice kv's first sublane row
+        # via broadcasting: both end up (block_q, block_kv).
+        qs = _lane_tile(q_seg, s.shape[1])
+        ks = kv_seg[:1, :]
+        seg = qs == ks
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is None:
+        return s
+    return jnp.where(mask, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    q_seg_ref,
+    kv_seg_ref,
+    o_ref,
+    lse_ref,
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+    n_kv: int,
+):
+    iq = pl.program_id(2)
+    ikv = pl.program_id(3)
+
+    @pl.when(ikv == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # With causal masking, blocks strictly above the diagonal contribute
+    # nothing: skip their compute (the grid still visits them).
+    needed = (
+        (iq + 1) * block_q - 1 >= ikv * block_kv if causal else True
+    )
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale  # (block_q, D)
+        k = k_ref[0, 0]  # (block_kv, D)
+        s = jax.lax.dot_general(
+            q,
+            k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_kv)
+        s = _block_mask(
+            s,
+            iq=iq,
+            ikv=ikv,
+            block_q=block_q,
+            block_kv=block_kv,
+            causal=causal,
+            q_seg=q_seg_ref[0] if q_seg_ref is not None else None,
+            kv_seg=kv_seg_ref[0] if kv_seg_ref is not None else None,
+        )
+
+        m_prev = m_scr[...]  # (block_q, 128) lane-replicated
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=1, keepdims=True)  # (block_q, 1)
+        m_next = jnp.maximum(m_prev, m_cur)  # lane-replicated again
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - _lane_tile(m_next, block_kv))
+        l_scr[...] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = m_next
+
+        v = v_ref[0, 0]  # (block_kv, D)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype),
+            v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, D)
+        acc_scr[...] = acc_scr[...] * _lane_tile(alpha, acc_scr.shape[-1]) + pv
+
+    @pl.when(ikv == n_kv - 1)
+    def _finalize():
+        l = l_scr[...]
+        # Fully-masked rows have l == 0; emit 0 there instead of NaN.
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (
+            acc_scr[...] / _lane_tile(l_safe, acc_scr.shape[-1])
+        ).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(l_safe)
+
+
+def _fwd(
+    q: jax.Array,  # (B, H, Sq, D)
+    k: jax.Array,  # (B, K, Skv, D)
+    v: jax.Array,
+    q_seg: jax.Array | None,  # (B, Sq)
+    kv_seg: jax.Array | None,  # (B, Skv)
+    *,
+    causal: bool,
+    scale: float,
+    blocks: BlockSizes,
+    interpret: bool,
+) -> tuple[jax.Array, jax.Array]:
+    b, h, s_q, d = q.shape
+    _, kv_heads, s_kv, _ = k.shape
+    groups = h // kv_heads
+    bq, bkv = blocks
+    n_q, n_kv = s_q // bq, s_kv // bkv
+    grid = (b, h, n_q, n_kv)
+
+    def q_map(ib, ih, iq, ikv):
+        return (ib, ih, iq, 0)
+
+    def kv_map(ib, ih, iq, ikv):
+        return (ib, ih // groups, ikv, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+    ]
+    args = [q, k, v]
+    if q_seg is not None:
+        in_specs.append(
+            pl.BlockSpec((1, bq, NUM_LANES), lambda ib, ih, iq, ikv: (ib, iq, 0))
+        )
+        in_specs.append(
+            pl.BlockSpec(
+                (1, NUM_SUBLANES, bkv), lambda ib, ih, iq, ikv: (ib, 0, ikv)
+            )
+        )
+        args.append(
+            jax.lax.broadcast_in_dim(q_seg, (b, s_q, NUM_LANES), (0, 1))
+        )
+        args.append(
+            jax.lax.broadcast_in_dim(kv_seg, (b, NUM_SUBLANES, s_kv), (0, 2))
+        )
+    else:
+        in_specs += [None, None]
+        args += [None, None]
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=bq,
+        block_kv=bkv,
+        n_kv=n_kv,
+    )
+    out_shapes = (
+        jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        jax.ShapeDtypeStruct((b, h, s_q, NUM_LANES), jnp.float32),
+    )
+    out_specs = (
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bq, NUM_LANES), q_map),
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((bq, NUM_LANES), jnp.float32),  # m
+            pltpu.VMEM((bq, NUM_LANES), jnp.float32),  # l
+            pltpu.VMEM((bq, d), jnp.float32),  # acc
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(*args)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    q_seg_ref,
+    kv_seg_ref,
+    dq_ref,
+    dq_scr,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+    n_kv: int,
+):
+    iq = pl.program_id(2)
+    ikv = pl.program_id(3)
+
+    @pl.when(ikv == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    needed = (
+        (iq + 1) * block_q - 1 >= ikv * block_kv if causal else True
+    )
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = _block_mask(
+            s,
+            iq=iq,
+            ikv=ikv,
+            block_q=block_q,
+            block_kv=block_kv,
+            causal=causal,
+            q_seg=q_seg_ref[0] if q_seg_ref is not None else None,
+            kv_seg=kv_seg_ref[0] if kv_seg_ref is not None else None,
+        )
+        p = jnp.exp(s - _lane_tile(lse_ref[0, 0], block_kv))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - _lane_tile(delta_ref[0, 0], block_kv))
+        dq_scr[...] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ikv == n_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref,
+    k_ref,
+    v_ref,
+    do_ref,
+    lse_ref,
+    delta_ref,
+    q_seg_ref,
+    kv_seg_ref,
+    dk_ref,
+    dv_ref,
+    dk_scr,
+    dv_scr,
+    *,
+    scale: float,
+    causal: bool,
+    block_q: int,
+    block_kv: int,
+    n_q: int,
+    n_inner: int,
+):
+    """Grid (B, K, n_kv, groups * n_q): the innermost (sequential) dim folds
+    the GQA group loop into the q loop so dk/dv accumulation is race-free."""
+    ikv = pl.program_id(2)
+    inner = pl.program_id(3)
+    iq = inner % n_q
+
+    @pl.when(inner == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    needed = (
+        (iq + 1) * block_q - 1 >= ikv * block_kv if causal else True
+    )
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = _block_mask(
+            s,
+            iq=iq,
+            ikv=ikv,
+            block_q=block_q,
+            block_kv=block_kv,
+            causal=causal,
+            q_seg=q_seg_ref[0] if q_seg_ref is not None else None,
+            kv_seg=kv_seg_ref[0] if kv_seg_ref is not None else None,
+        )
+        p = jnp.exp(s - _lane_tile(lse_ref[0, 0], block_kv))
+        # dv += pᵀ @ do
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - _lane_tile(delta_ref[0, 0], block_kv))
+        # dk = scale·dsᵀ@q_unscaled = dsᵀ@q_scaled (q was pre-scaled above).
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(inner == n_inner - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_impl(
+    q,
+    k,
+    v,
+    q_seg,
+    kv_seg,
+    o,
+    lse,
+    do,
+    *,
+    causal: bool,
+    scale: float,
+    blocks: BlockSizes,
+    interpret: bool,
+):
+    b, h, s_q, d = q.shape
+    _, kv_heads, s_kv, _ = k.shape
+    groups = h // kv_heads
+    bq, bkv = blocks
+    n_q, n_kv = s_q // bq, s_kv // bkv
+
+    # delta_i = rowsum(do ⊙ o): cheap elementwise+reduce, XLA fuses it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )  # (B, H, Sq)
+    delta = jax.lax.broadcast_in_dim(
+        delta, (b, h, s_q, NUM_LANES), (0, 1, 2)
+    )
+
+    seg_args = [None, None]
+    if q_seg is not None:
+        q_seg_b = jax.lax.broadcast_in_dim(q_seg, (b, s_q, NUM_LANES), (0, 1))
+        kv_seg_b = jax.lax.broadcast_in_dim(
+            kv_seg, (b, NUM_SUBLANES, s_kv), (0, 2)
+        )
+        seg_args = [q_seg_b, kv_seg_b]
+
+    # dk = scale·dsᵀq_unscaled = dsᵀ(scale·q): pre-scaling q once inside the
+    # kernels folds the scale into both s and dk, so no post-multiply needed.
+
+    # ---- dq: grid (B, H, n_q, n_kv), accumulate over kv blocks ----
+    def q_map(ib, ih, iq, ikv):
+        return (ib, ih, iq, 0)
+
+    def kv_map(ib, ih, iq, ikv):
+        return (ib, ih // groups, ikv, 0)
+
+    dq_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        pl.BlockSpec((1, 1, bkv, d), kv_map),
+        pl.BlockSpec((1, 1, bq, d), q_map),
+        pl.BlockSpec((1, 1, bq, NUM_LANES), q_map),
+        pl.BlockSpec((1, 1, bq, NUM_LANES), q_map),
+    ]
+    if q_seg is not None:
+        dq_in_specs.append(
+            pl.BlockSpec((1, bq, NUM_LANES), lambda ib, ih, iq, ikv: (ib, iq, 0))
+        )
+        dq_in_specs.append(
+            pl.BlockSpec(
+                (1, NUM_SUBLANES, bkv), lambda ib, ih, iq, ikv: (ib, 0, ikv)
+            )
+        )
+    else:
+        dq_in_specs += [None, None]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel,
+            scale=scale,
+            causal=causal,
+            block_q=bq,
+            block_kv=bkv,
+            n_kv=n_kv,
+        ),
+        grid=(b, h, n_q, n_kv),
+        in_specs=dq_in_specs,
+        out_specs=pl.BlockSpec((1, 1, bq, d), q_map),
+        out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, *seg_args)
+
+    # ---- dk/dv: grid (B, K, n_kv, groups·n_q), accumulate over (g, q) ----
+    n_inner = groups * n_q
+
+    def q_map2(ib, ikh, ikv, inner):
+        return (ib, ikh * groups + inner // n_q, inner % n_q, 0)
+
+    def kv_map2(ib, ikh, ikv, inner):
+        return (ib, ikh, ikv, 0)
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, 1, bq, d), q_map2),
+        pl.BlockSpec((1, 1, bkv, d), kv_map2),
+        pl.BlockSpec((1, 1, bkv, d), kv_map2),
+        pl.BlockSpec((1, 1, bq, d), q_map2),
+        pl.BlockSpec((1, 1, bq, NUM_LANES), q_map2),
+        pl.BlockSpec((1, 1, bq, NUM_LANES), q_map2),
+    ]
+    if q_seg is not None:
+        dkv_in_specs.append(
+            pl.BlockSpec(
+                (1, bq, NUM_LANES),
+                lambda ib, ikh, ikv, inner: (ib, inner % n_q, 0),
+            )
+        )
+        dkv_in_specs.append(
+            pl.BlockSpec(
+                (1, NUM_SUBLANES, bkv),
+                lambda ib, ikh, ikv, inner: (ib, 0, ikv),
+            )
+        )
+    else:
+        dkv_in_specs += [None, None]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel,
+            scale=scale,
+            causal=causal,
+            block_q=bq,
+            block_kv=bkv,
+            n_q=n_q,
+            n_inner=n_inner,
+        ),
+        grid=(b, kv_heads, n_kv, n_inner),
+        in_specs=dkv_in_specs,
+        out_specs=(
+            pl.BlockSpec((1, 1, bkv, d), kv_map2),
+            pl.BlockSpec((1, 1, bkv, d), kv_map2),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, kv_heads, s_kv, d), k.dtype),
+            jax.ShapeDtypeStruct((b, kv_heads, s_kv, d), v.dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bkv, d), jnp.float32),
+            pltpu.VMEM((bkv, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta, *seg_args)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp plumbing (on (B, H, S, D) layouts)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_bhsd(q, k, v, q_seg, kv_seg, causal, scale, blocks, interpret):
+    o, _ = _fwd(
+        q, k, v, q_seg, kv_seg,
+        causal=causal, scale=scale, blocks=blocks, interpret=interpret,
+    )
+    return o
+
+
+def _flash_bhsd_fwd(q, k, v, q_seg, kv_seg, causal, scale, blocks, interpret):
+    o, lse = _fwd(
+        q, k, v, q_seg, kv_seg,
+        causal=causal, scale=scale, blocks=blocks, interpret=interpret,
+    )
+    return o, (q, k, v, q_seg, kv_seg, o, lse)
+
+
+def _flash_bhsd_bwd(causal, scale, blocks, interpret, residuals, do):
+    q, k, v, q_seg, kv_seg, o, lse = residuals
+    dq, dk, dv = _bwd_impl(
+        q, k, v, q_seg, kv_seg, o, lse, do,
+        causal=causal, scale=scale, blocks=blocks, interpret=interpret,
+    )
+    return dq, dk, dv, None, None
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # (B, S, H, D)
+    k: jax.Array,  # (B, S, K, D)
+    v: jax.Array,  # (B, S, K, D)
+    *,
+    causal: bool = True,
+    segment_ids: jax.Array | None = None,  # (B, S) int32
+    block_q: int = 512,
+    block_kv: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """FlashAttention with GQA + sequence-packing segment masks.
+
+    Takes/returns the model's (B, S, H, D) layout. Raises ``ValueError`` on
+    shapes the kernel cannot tile — callers (``ops.attention``) fall back to
+    the XLA implementation.
+    """
+    b, s_q, h, d = q.shape
+    _, s_kv, kv_heads, _ = k.shape
+    if h % kv_heads:
+        raise ValueError(f"q heads {h} not divisible by kv heads {kv_heads}")
+    if not supports(s_q, s_kv, d, block_q, block_kv):
+        raise ValueError(
+            f"flash_attention cannot tile Sq={s_q} Skv={s_kv} D={d} "
+            f"(block_q={block_q}, block_kv={block_kv})"
+        )
+    blocks = _pick_blocks(s_q, s_kv, block_q, block_kv)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    o = _flash_bhsd(
+        qt, kt, vt, segment_ids, segment_ids,
+        causal, d**-0.5, blocks, interpret,
+    )
+    return jnp.transpose(o, (0, 2, 1, 3))

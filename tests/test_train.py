@@ -122,3 +122,43 @@ def test_state_logical_axes_cover_state(tiny_model_cfg):
     assert len(flat_state) == len(flat_axes)
     for arr, ax in zip(flat_state, flat_axes):
         assert arr.ndim == len(ax), f"{arr.shape} vs {ax}"
+
+
+def test_train_step_attention_impls(tiny_model_cfg):
+    """The same train step runs with every attention implementation; flash
+    (Pallas, shard_mapped) and ring (sequence-parallel) agree with the XLA
+    path on the loss to float tolerance."""
+    # seq 128 so the flash kernel's tiling gate passes (kv blocks are
+    # 128-lane); the default 32-token example batch would silently fall back.
+    rng = np.random.default_rng(0)
+    b, s = 8, 128
+    example_batch = {
+        "input_ids": rng.integers(3, 500, size=(b, s)).astype(np.int32),
+        "loss_mask": np.ones((b, s), np.float32),
+        "labels": np.zeros((b,), np.int32),
+        "segment_ids": np.ones((b, s), np.int32),
+        "positions": np.tile(np.arange(s, dtype=np.int32), (b, 1)),
+    }
+    losses = {}
+    for impl, mesh_cfg in [
+        ("xla", MeshConfig(data=4, tensor=2)),
+        ("flash", MeshConfig(data=4, tensor=2)),
+        ("ring", MeshConfig(data=2, sequence=4)),
+    ]:
+        cfg = dataclasses.replace(
+            tiny_model_cfg,
+            attention_impl=impl,
+            dtype="float32",
+            param_dtype="float32",
+            # flash kernel tiling needs seq % 8 == 0 and head_dim 64/128;
+            # the tiny cfg uses head_dim 16 -> widen for this test
+            head_dim=64,
+            num_heads=4,
+            num_kv_heads=2,
+        )
+        _, state, gb, step = _setup(cfg, example_batch, mesh_cfg)
+        state, m = step(state, gb)
+        losses[impl] = float(m["loss"])
+        assert np.isfinite(losses[impl]), impl
+    np.testing.assert_allclose(losses["flash"], losses["xla"], rtol=1e-4)
+    np.testing.assert_allclose(losses["ring"], losses["xla"], rtol=1e-4)
