@@ -45,7 +45,13 @@ class ByteTokenizer:
         return [b + self._offset for b in text.encode("utf-8")]
 
     def decode(self, ids: Sequence[int]) -> str:
-        data = bytes(i - self._offset for i in ids if i >= self._offset)
+        # Skip specials and out-of-vocab ids (a model head can be wider than
+        # the tokenizer — e.g. vocab padded up for MXU tiling).
+        data = bytes(
+            i - self._offset
+            for i in ids
+            if self._offset <= i < self._offset + 256
+        )
         return data.decode("utf-8", errors="replace")
 
 

@@ -1,0 +1,22 @@
+"""TPU-native inference/serving subsystem.
+
+The reference performs "distributed inference" by HTTP-calling a remote 70B
+model through LiteLLM (ref ``src/distributed_inference.py:34-41``) — it never
+runs a model locally. This package is the local, TPU-native half of that
+story: KV-cache incremental decoding over the sharded Llama/MoE models
+(engine.py), jit-compiled sampling (sampling.py), and an OpenAI-compatible
+HTTP server (server.py) that the existing L4 client (client/llm.py) — or any
+LiteLLM user — can point at, closing the loop entirely on-TPU.
+"""
+
+from ditl_tpu.infer.cache import cache_logical_axes, init_cache
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.infer.sampling import sample_logits
+
+__all__ = [
+    "GenerateConfig",
+    "Generator",
+    "cache_logical_axes",
+    "init_cache",
+    "sample_logits",
+]

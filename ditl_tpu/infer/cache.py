@@ -1,0 +1,34 @@
+"""KV cache for incremental decoding (L1).
+
+Layout mirrors the model's scanned-layer convention (models/llama.py): all
+layers stacked on a leading ``layers`` axis so the decode forward scans
+``(layer_params, k_cache, v_cache)`` together — one layer's HLO compiled once.
+
+Shapes: ``k``/``v`` are ``(L, B, Smax, K, D)`` in the model's compute dtype
+(bf16 on TPU — cache reads are the HBM-bandwidth cost of decoding, so half
+the bytes is double the decode speed). Sharding: batch over the data/fsdp
+axes, KV heads over the tensor axis — the same rule table as training
+(parallel/sharding.py), so a TP-sharded model decodes with a TP-sharded cache
+and no resharding.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ditl_tpu.config import ModelConfig
+
+__all__ = ["init_cache", "cache_logical_axes"]
+
+
+def init_cache(cfg: ModelConfig, batch_size: int, max_len: int) -> dict:
+    """Zero-filled cache pytree for ``batch_size`` sequences of ≤ ``max_len``."""
+    shape = (cfg.num_layers, batch_size, max_len, cfg.num_kv_heads, cfg.head_dim)
+    dtype = jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def cache_logical_axes(cfg: ModelConfig) -> dict:
+    """Logical axes for the cache pytree (same table as params/activations)."""
+    axes = ("layers", "batch", None, "act_kv_heads", "head_dim")
+    return {"k": axes, "v": axes}

@@ -1,0 +1,226 @@
+"""KV-cache generation engine (L1/L5) — the local, TPU-native "inference" the
+reference only reaches over HTTP (ref ``src/distributed_inference.py:34-41``).
+
+Design (TPU-first):
+- **Prefill + decode split**: the prompt is processed in one batched forward
+  (MXU-friendly big matmuls) writing the KV cache; decode then feeds one token
+  per step through a ``lax.scan`` — the whole generation loop is a single XLA
+  program, no host round-trips between tokens.
+- **Static shapes**: prompts are right-padded to a power-of-two bucket and the
+  decode loop has a static ``max_new_tokens``, so each (batch, bucket,
+  GenerateConfig) compiles once and is cached.
+- **Masked-slot validity instead of causal masks**: every (b, slot) pair in
+  the cache carries an implicit validity rule — prompt slots ``< lengths[b]``
+  plus generated slots — so right-padding, per-example prompt lengths, and
+  EOS freezing all work inside one jitted program.
+- **Sharding-aware**: with a mesh, the cache is sharded batch-over-data/fsdp
+  and KV-heads-over-tensor via the same rule table as training
+  (parallel/sharding.py), so a TP/FSDP-sharded model decodes without
+  resharding its weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.data.tokenizer import Tokenizer
+from ditl_tpu.infer.cache import cache_logical_axes, init_cache
+from ditl_tpu.infer.sampling import sample_logits
+from ditl_tpu.models import llama
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["GenerateConfig", "Generator"]
+
+
+@dataclass(frozen=True)
+class GenerateConfig:
+    """Per-request sampling parameters (static: part of the compile key)."""
+
+    max_new_tokens: int = 64
+    temperature: float = 0.0  # 0 => greedy
+    top_k: int = 0  # 0 => disabled
+    top_p: float = 1.0  # 1.0 => disabled
+    seed: int = 0
+
+
+def _next_pow2(n: int, floor: int = 16) -> int:
+    p = floor
+    while p < n:
+        p *= 2
+    return p
+
+
+class Generator:
+    """Batch text generation over a (possibly sharded) Llama-family model."""
+
+    def __init__(
+        self,
+        params: llama.Params,
+        model_cfg: ModelConfig,
+        tokenizer: Tokenizer,
+        *,
+        mesh=None,
+        rules=None,
+    ):
+        self.params = params
+        self.cfg = model_cfg
+        self.tokenizer = tokenizer
+        self.mesh = mesh
+        self.rules = rules
+        self._compiled: dict = {}
+
+    # -- compiled program ---------------------------------------------------
+
+    def _build(self, batch: int, prompt_len: int, gen: GenerateConfig):
+        """Compile the full prefill+decode program for one shape bucket."""
+        cfg, mesh, rules = self.cfg, self.mesh, self.rules
+        max_len = prompt_len + gen.max_new_tokens
+        if max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {prompt_len} + max_new {gen.max_new_tokens} exceeds "
+                f"model max_seq_len {cfg.max_seq_len}"
+            )
+        pad_id = jnp.int32(self.tokenizer.pad_id)
+        eos_id = jnp.int32(self.tokenizer.eos_id)
+        slots = jnp.arange(max_len, dtype=jnp.int32)
+
+        def run(params, input_ids, lengths, rng):
+            cache = init_cache(cfg, batch, max_len)
+            if mesh is not None:
+                from ditl_tpu.parallel.sharding import spec_tree
+                cache = jax.lax.with_sharding_constraint(
+                    cache,
+                    jax.tree.map(
+                        lambda s: jax.sharding.NamedSharding(mesh, s),
+                        spec_tree(cache_logical_axes(cfg), rules),
+                    ),
+                )
+            # Prefill: causal over real (non-pad) prompt slots.
+            q_pos = jnp.arange(prompt_len, dtype=jnp.int32)
+            prefill_mask = (slots[None, None, :] <= q_pos[None, :, None]) & (
+                slots[None, None, :] < lengths[:, None, None]
+            )
+            positions = jnp.broadcast_to(q_pos, (batch, prompt_len))
+            logits, cache = llama.forward(
+                params,
+                input_ids,
+                cfg,
+                positions=positions,
+                mesh=mesh,
+                rules=rules,
+                cache=cache,
+                cache_index=jnp.int32(0),
+                attn_mask=prefill_mask,
+            )
+            last = jnp.take_along_axis(
+                logits, (lengths - 1)[:, None, None], axis=1
+            )[:, 0]  # (B, V)
+            rng, sub = jax.random.split(rng)
+            first = sample_logits(
+                last, sub, temperature=gen.temperature, top_k=gen.top_k,
+                top_p=gen.top_p,
+            )
+            done0 = first == eos_id
+
+            def body(carry, t):
+                cache, cur, done, rng = carry
+                rng, sub = jax.random.split(rng)
+                write_idx = prompt_len + t
+                # Attend to: real prompt slots + generated slots so far
+                # (including the one being written at write_idx).
+                mask = (
+                    (slots[None, :] < lengths[:, None])
+                    | ((slots[None, :] >= prompt_len) & (slots[None, :] <= write_idx))
+                )[:, None, :]
+                step_logits, cache = llama.forward(
+                    params,
+                    cur[:, None],
+                    cfg,
+                    positions=(lengths + t)[:, None],
+                    mesh=mesh,
+                    rules=rules,
+                    cache=cache,
+                    cache_index=write_idx,
+                    attn_mask=mask,
+                )
+                nxt = sample_logits(
+                    step_logits[:, 0], sub, temperature=gen.temperature,
+                    top_k=gen.top_k, top_p=gen.top_p,
+                )
+                new_done = done | (cur == eos_id)
+                nxt = jnp.where(new_done, pad_id, nxt)
+                return (cache, nxt, new_done, rng), cur
+
+            (_, _, _, _), tokens = jax.lax.scan(
+                body,
+                (cache, first, done0, rng),
+                jnp.arange(gen.max_new_tokens, dtype=jnp.int32),
+            )
+            return tokens.T  # (steps, B) -> (B, steps)
+
+        jitted = jax.jit(run)
+        logger.info(
+            "compiling generate program: batch=%d prompt_len=%d max_new=%d",
+            batch, prompt_len, gen.max_new_tokens,
+        )
+        return jitted
+
+    def _get_compiled(self, batch: int, prompt_len: int, gen: GenerateConfig):
+        # seed is runtime data (the rng argument), not part of the program —
+        # keep it out of the compile key or every new seed recompiles.
+        key = (batch, prompt_len, dataclasses.replace(gen, seed=0))
+        if key not in self._compiled:
+            self._compiled[key] = self._build(batch, prompt_len, gen)
+        return self._compiled[key]
+
+    # -- public surface -----------------------------------------------------
+
+    def generate_tokens(
+        self, token_lists: list[list[int]], gen: GenerateConfig | None = None
+    ) -> list[list[int]]:
+        """Token-id prompts in, generated token ids out (EOS-trimmed)."""
+        gen = gen or GenerateConfig()
+        n = len(token_lists)
+        if n == 0:
+            return []
+        token_lists = [t if t else [self.tokenizer.bos_id] for t in token_lists]
+        batch = _next_pow2(n, floor=1)
+        prompt_len = _next_pow2(max(len(t) for t in token_lists))
+        ids = np.full((batch, prompt_len), self.tokenizer.pad_id, np.int32)
+        lengths = np.ones((batch,), np.int32)  # dummy rows attend to slot 0
+        for i, toks in enumerate(token_lists):
+            ids[i, : len(toks)] = toks
+            lengths[i] = len(toks)
+        run = self._get_compiled(batch, prompt_len, gen)
+        rng = jax.random.key(gen.seed)
+        out = np.asarray(
+            jax.device_get(run(self.params, jnp.asarray(ids), jnp.asarray(lengths), rng))
+        )
+        results = []
+        for i in range(n):
+            row = out[i].tolist()
+            trimmed = []
+            for tok in row:
+                if tok == self.tokenizer.eos_id or tok == self.tokenizer.pad_id:
+                    break
+                trimmed.append(tok)
+            results.append(trimmed)
+        return results
+
+    def generate(
+        self, prompts: list[str], gen: GenerateConfig | None = None
+    ) -> list[str]:
+        """Text prompts in, generated continuations out."""
+        encoded = [
+            [self.tokenizer.bos_id] + self.tokenizer.encode(p) for p in prompts
+        ]
+        out = self.generate_tokens(encoded, gen)
+        return [self.tokenizer.decode(toks) for toks in out]

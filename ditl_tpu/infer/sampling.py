@@ -1,0 +1,60 @@
+"""Token sampling (L1) — jit-compatible, static-config branching.
+
+Greedy, temperature, top-k, and nucleus (top-p) sampling over a (B, V) logits
+slab. All control flow branches on *static* Python config values, so each
+``GenerateConfig`` compiles to a straight-line XLA program — no data-dependent
+Python control flow inside jit (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["sample_logits"]
+
+
+def _apply_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Mask everything below the k-th largest logit (per row)."""
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]  # (B, 1)
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus sampling: keep the smallest prefix of the sorted distribution
+    whose cumulative probability exceeds ``p`` (always keeping the top token)."""
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Token i is kept if the cumulative mass *before* it is still < p.
+    keep_sorted = (cum - probs) < p
+    # Threshold = smallest kept logit; everything below it is masked.
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < threshold, -jnp.inf, logits)
+
+
+def sample_logits(
+    logits: jax.Array,
+    rng: jax.Array,
+    *,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """(B, V) float logits -> (B,) int32 token ids.
+
+    ``temperature == 0`` is greedy argmax; otherwise logits are scaled by
+    1/temperature, optionally truncated by top-k and/or top-p, and sampled
+    with ``jax.random.categorical``.
+    """
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        logits = _apply_top_k(logits, min(top_k, logits.shape[-1]))
+    if top_p < 1.0:
+        logits = _apply_top_p(logits, top_p)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,228 @@
+"""OpenAI-compatible HTTP server over the local TPU model (L4/L6).
+
+The reference points LiteLLM at an external OpenAI-compatible endpoint
+(``CONFIG['API_BASE']``, ref ``src/distributed_inference.py:53-54``) — the
+serving side is someone else's. This module supplies it: a ``/v1/chat/
+completions`` + ``/v1/completions`` server backed by the KV-cache Generator,
+so the framework's own L4 client (client/llm.py) — or litellm, or the openai
+SDK — can evaluate against a model running on *this* TPU.
+
+Threading model: stdlib ``ThreadingHTTPServer`` accepts concurrently; a lock
+serializes device work (one XLA program at a time per chip — queueing at the
+device is the natural batching point; request batching across connections is
+future work and noted in README).
+
+CLI (any host of a pod; serving is process-0-gated):
+
+    python -m ditl_tpu.infer.server --preset tiny-llama --port 8300
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.data.tokenizer import Tokenizer
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["serve", "make_server"]
+
+
+def _chat_prompt(messages: list[dict]) -> str:
+    """Minimal chat template: the byte/debug tokenizer has no special chat
+    tokens, so roles are rendered as plain text turns."""
+    parts = [f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages]
+    return "\n".join(parts) + "\nassistant:"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    generator: Generator = None  # injected by make_server
+    model_name: str = "ditl-tpu"
+    device_lock: threading.Lock = None
+    default_max_tokens: int = 64
+
+    def log_message(self, *args):  # route through our logger, not stderr
+        logger.debug("http: " + args[0], *args[1:])
+
+    def _send_json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path in ("/health", "/v1/health"):
+            self._send_json(200, {"status": "ok", "model": self.model_name})
+        elif self.path in ("/v1/models", "/models"):
+            self._send_json(
+                200,
+                {"object": "list", "data": [{"id": self.model_name, "object": "model"}]},
+            )
+        else:
+            self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(length) or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": {"message": f"bad request: {e}"}})
+            return
+        path = self.path.rstrip("/")
+        if path.endswith("/chat/completions"):
+            self._complete(payload, chat=True)
+        elif path.endswith("/completions"):
+            self._complete(payload, chat=False)
+        else:
+            self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _complete(self, payload: dict, *, chat: bool) -> None:
+        try:
+            if chat:
+                messages = payload.get("messages") or []
+                prompt = _chat_prompt(messages)
+            else:
+                prompt = payload.get("prompt") or ""
+                if isinstance(prompt, list):
+                    prompt = prompt[0] if prompt else ""
+            # Fresh seed per request unless the client pins one — otherwise
+            # every temperature>0 request would replay jax.random.key(0).
+            seed = payload.get("seed")
+            if seed is None:
+                import random as _random
+
+                seed = _random.getrandbits(31)
+            gen = GenerateConfig(
+                max_new_tokens=int(
+                    payload.get("max_tokens") or self.default_max_tokens
+                ),
+                temperature=float(payload.get("temperature") or 0.0),
+                top_p=float(payload.get("top_p") or 1.0),
+                seed=int(seed),
+            )
+            t0 = time.time()
+            with self.device_lock:
+                text = self.generator.generate([prompt], gen)[0]
+            tok = self.generator.tokenizer
+            n_prompt = len(tok.encode(prompt)) + 1
+            n_out = len(tok.encode(text))
+            kind = "chat.completion" if chat else "text_completion"
+            choice = (
+                {"index": 0, "message": {"role": "assistant", "content": text},
+                 "finish_reason": "stop"}
+                if chat
+                else {"index": 0, "text": text, "finish_reason": "stop"}
+            )
+            self._send_json(
+                200,
+                {
+                    "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+                    "object": kind,
+                    "created": int(t0),
+                    "model": payload.get("model") or self.model_name,
+                    "choices": [choice],
+                    "usage": {
+                        "prompt_tokens": n_prompt,
+                        "completion_tokens": n_out,
+                        "total_tokens": n_prompt + n_out,
+                    },
+                },
+            )
+            logger.info(
+                "served %s: %d prompt + %d completion tokens in %.2fs",
+                kind, n_prompt, n_out, time.time() - t0,
+            )
+        except Exception as e:  # total-server: errors become JSON, not crashes
+            logger.exception("completion failed")
+            self._send_json(500, {"error": {"message": str(e)}})
+
+
+def make_server(
+    generator: Generator,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 8300,
+    model_name: str = "ditl-tpu",
+    default_max_tokens: int = 64,
+) -> ThreadingHTTPServer:
+    """Build (not start) the HTTP server — tests drive it on a thread."""
+    handler = type(
+        "BoundHandler",
+        (_Handler,),
+        {
+            "generator": generator,
+            "model_name": model_name,
+            "device_lock": threading.Lock(),
+            "default_max_tokens": default_max_tokens,
+        },
+    )
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve(argv: list[str] | None = None) -> int:
+    import jax
+
+    from ditl_tpu.data.tokenizer import get_tokenizer
+    from ditl_tpu.models import llama
+    from ditl_tpu.models.presets import get_preset
+
+    parser = argparse.ArgumentParser(prog="ditl_tpu.infer.server")
+    parser.add_argument("--preset", default=None)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8300)
+    parser.add_argument("--tokenizer", default="byte")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--max-tokens", type=int, default=64)
+    args = parser.parse_args(argv)
+
+    if jax.process_index() != 0:
+        # Pod serving is process-0-gated: one process binds the port; the
+        # others exit (multi-host sharded serving would need all processes in
+        # a collective decode loop — future work, documented in README).
+        logger.info("process %d: serving is process-0 only, exiting", jax.process_index())
+        return 0
+
+    cfg = get_preset(args.preset) if args.preset else ModelConfig()
+    tokenizer = get_tokenizer(args.tokenizer)
+    params = llama.init_params(jax.random.key(0), cfg)
+    if args.checkpoint_dir:
+        from ditl_tpu.train.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(args.checkpoint_dir)
+        restored = ckpt.restore_latest_params(jax.eval_shape(lambda: params))
+        if restored is not None:
+            params = restored
+            logger.info("restored params from %s", args.checkpoint_dir)
+        ckpt.close()
+    generator = Generator(params, cfg, tokenizer)
+    server = make_server(
+        generator, host=args.host, port=args.port, model_name=cfg.name,
+        default_max_tokens=args.max_tokens,
+    )
+    logger.info("serving %s on %s:%d", cfg.name, args.host, args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    from ditl_tpu.utils.logging import setup_logging
+
+    setup_logging()
+    sys.exit(serve())

@@ -189,7 +189,14 @@ def _decoder_layer(
     segment_ids: jax.Array | None,
     mesh,
     rules,
-) -> tuple[jax.Array, jax.Array]:
+    layer_cache: tuple[jax.Array, jax.Array] | None = None,
+    cache_index: jax.Array | None = None,
+    attn_mask: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, tuple[jax.Array, jax.Array]]:
+    """One decoder block. With ``layer_cache`` (this layer's (k, v) cache,
+    shape (B, Smax, K, D)), the chunk's keys/values are written at slot
+    ``cache_index`` and attention runs against the whole cache under
+    ``attn_mask`` — the KV-cache prefill/decode path (infer/engine.py)."""
     b, s, d = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     cd = _dtype(cfg.dtype)
@@ -213,10 +220,26 @@ def _decoder_layer(
     k = apply_rope(k, positions, cfg.rope_theta)
     q = _constrain(q, ("batch", "seq", "act_heads", "head_dim"), mesh, rules)
     k = _constrain(k, ("batch", "seq", "act_kv_heads", "head_dim"), mesh, rules)
-    attn_out = dot_product_attention(
-        q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
-        mesh=mesh, rules=rules,
-    )
+    new_kv = None
+    if layer_cache is not None:
+        k_cache, v_cache = layer_cache
+        idx = jnp.asarray(cache_index, jnp.int32)
+        k_full = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0)
+        )
+        v_full = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0)
+        )
+        new_kv = (k_full, v_full)
+        attn_out = dot_product_attention(
+            q, k_full, v_full, causal=False, mask=attn_mask,
+            impl=cfg.attention_impl, mesh=mesh, rules=rules,
+        )
+    else:
+        attn_out = dot_product_attention(
+            q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
+            mesh=mesh, rules=rules,
+        )
     attn_out = attn_out.reshape(b, s, nh * hd)
     x = x + proj(attn_out, attn["wo"], "wo")
     x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
@@ -238,7 +261,10 @@ def _decoder_layer(
             "bsf,fd->bsd", inner, mlp["w_down"].astype(cd), preferred_element_type=cd
         )
     x = x + mlp_out
-    return _constrain(x, ("batch", "seq", "act_embed"), mesh, rules), aux
+    x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
+    if new_kv is not None:
+        return x, aux, new_kv
+    return x, aux
 
 
 def forward(
@@ -251,11 +277,20 @@ def forward(
     mesh=None,
     rules=None,
     with_aux: bool = False,
-) -> jax.Array:
+    cache: dict[str, jax.Array] | None = None,
+    cache_index: jax.Array | None = None,
+    attn_mask: jax.Array | None = None,
+) -> Any:
     """Token ids (B, S) -> logits (B, S, V) in float32.
 
     ``with_aux=True`` additionally returns the summed per-layer auxiliary loss
-    (MoE router load balancing; zero for dense models)."""
+    (MoE router load balancing; zero for dense models).
+
+    ``cache`` (``{"k": (L,B,Smax,K,D), "v": ...}``, see infer/cache.py) turns
+    this into the incremental-decode forward: the chunk's K/V are written into
+    the cache at ``cache_index`` and attention uses ``attn_mask`` (B, S, Smax)
+    instead of the causal mask. Returns ``(logits, new_cache)`` (plus aux when
+    requested). No remat in this mode — there is no backward pass."""
     cd = _dtype(cfg.dtype)
     b, s = input_ids.shape
     if positions is None:
@@ -264,24 +299,48 @@ def forward(
     x = params["embed"]["embedding"].astype(cd)[input_ids]
     x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
 
-    def layer_fn(carry, layer_params):
-        return _decoder_layer(
-            layer_params,
-            carry,
-            cfg=cfg,
-            positions=positions,
-            segment_ids=segment_ids,
-            mesh=mesh,
-            rules=rules,
-        )
+    if cache is not None:
+        def cached_layer_fn(carry, xs):
+            layer_params, k_cache, v_cache = xs
+            y, aux, (new_k, new_v) = _decoder_layer(
+                layer_params,
+                carry,
+                cfg=cfg,
+                positions=positions,
+                segment_ids=segment_ids,
+                mesh=mesh,
+                rules=rules,
+                layer_cache=(k_cache, v_cache),
+                cache_index=cache_index,
+                attn_mask=attn_mask,
+            )
+            return y, (aux, new_k, new_v)
 
-    if cfg.remat == "full":
-        layer_fn = jax.checkpoint(layer_fn)
-    elif cfg.remat == "dots":
-        layer_fn = jax.checkpoint(
-            layer_fn, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+        x, (layer_aux, new_k, new_v) = jax.lax.scan(
+            cached_layer_fn, x, (params["layers"], cache["k"], cache["v"])
         )
-    x, layer_aux = jax.lax.scan(layer_fn, x, params["layers"])
+        new_cache = {"k": new_k, "v": new_v}
+    else:
+        def layer_fn(carry, layer_params):
+            return _decoder_layer(
+                layer_params,
+                carry,
+                cfg=cfg,
+                positions=positions,
+                segment_ids=segment_ids,
+                mesh=mesh,
+                rules=rules,
+            )
+
+        if cfg.remat == "full":
+            layer_fn = jax.checkpoint(layer_fn)
+        elif cfg.remat == "dots":
+            layer_fn = jax.checkpoint(
+                layer_fn,
+                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            )
+        x, layer_aux = jax.lax.scan(layer_fn, x, params["layers"])
+        new_cache = None
 
     x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)
     head = (
@@ -291,6 +350,9 @@ def forward(
         "bsd,dv->bsv", x, head.astype(cd), preferred_element_type=jnp.float32
     )
     logits = _constrain(logits, ("batch", "seq", "act_vocab"), mesh, rules)
+    out = (logits,)
     if with_aux:
-        return logits, jnp.sum(layer_aux)
-    return logits
+        out = out + (jnp.sum(layer_aux),)
+    if cache is not None:
+        out = out + (new_cache,)
+    return out if len(out) > 1 else logits

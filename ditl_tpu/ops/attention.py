@@ -32,6 +32,7 @@ def _xla_attention(
     *,
     causal: bool,
     segment_ids: jax.Array | None,
+    mask: jax.Array | None = None,
 ) -> jax.Array:
     b, s_q, h, d = q.shape
     _, s_kv, kv_heads, _ = k.shape
@@ -48,6 +49,8 @@ def _xla_attention(
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]  # (B,Sq,Skv)
         scores = jnp.where(seg_mask[:, None, None], scores, NEG_INF)
+    if mask is not None:  # explicit (B, Sq, Skv) mask — KV-cache decode path
+        scores = jnp.where(mask[:, None, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
     return out.reshape(b, s_q, h, d)
@@ -72,6 +75,7 @@ def dot_product_attention(
     *,
     causal: bool = True,
     segment_ids: jax.Array | None = None,
+    mask: jax.Array | None = None,
     impl: str = "xla",
     mesh=None,
     rules=None,
@@ -79,12 +83,20 @@ def dot_product_attention(
     """Grouped-query attention. ``segment_ids`` (B, S) int32 restricts
     attention to tokens of the same segment (sequence packing / padding:
     give pad tokens a segment id of -1-ish sentinel distinct from real ones).
+    ``mask`` is an explicit (B, Sq, Skv) boolean mask (True = attend), used by
+    the KV-cache decode path where validity is per-slot, not causal.
 
     ``rules`` is the logical-axis table (parallel/sharding.py) used to derive
     shard_map specs for the flash and ring paths — the same single source of
     truth the rest of the model uses for its sharding constraints."""
     if q.shape[2] % k.shape[2]:
         raise ValueError(f"q heads {q.shape[2]} not divisible by kv heads {k.shape[2]}")
+    if mask is not None:
+        # Explicit-mask (decode) path: bandwidth-bound, XLA fuses it fine; the
+        # flash/ring kernels are for long training chunks, not 1-token queries.
+        return _xla_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, mask=mask
+        )
     if impl == "xla":
         return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     if impl == "ring":

@@ -81,6 +81,44 @@ class CheckpointManager:
         logger.info("restored checkpoint at step %d", step)
         return restored["state"], data_iter
 
+    def restore_latest_params(self, abstract_params: Any = None) -> Any | None:
+        """Restore only the ``params`` subtree of the newest checkpoint — the
+        serving path (infer/server.py), which has no optimizer state to
+        describe. Arrays come back exactly as saved (host-local numpy), fine
+        for single-host serving. ``abstract_params`` (a ``jax.eval_shape``
+        tree) is validated against the restored tree so a preset/checkpoint
+        mismatch fails loudly here, not as a shape error mid-forward."""
+        import orbax.checkpoint as ocp
+
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        restored = self._mgr.restore(
+            step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+        )
+        state = restored["state"]
+        params = state["params"] if isinstance(state, dict) else state.params
+        if abstract_params is not None:
+            import jax
+
+            expect = {
+                p: (l.shape,) for p, l in jax.tree.leaves_with_path(abstract_params)
+            }
+            got = {p: (l.shape,) for p, l in jax.tree.leaves_with_path(params)}
+            if expect != got:
+                missing = sorted(set(expect) - set(got))
+                extra = sorted(set(got) - set(expect))
+                shape_diff = sorted(
+                    k for k in expect.keys() & got.keys() if expect[k] != got[k]
+                )
+                raise ValueError(
+                    f"checkpoint at step {step} does not match the model config: "
+                    f"missing={missing[:3]} extra={extra[:3]} "
+                    f"shape_mismatch={[(str(k), expect[k], got[k]) for k in shape_diff[:3]]}"
+                )
+        logger.info("restored params from checkpoint at step %d", step)
+        return params
+
     def wait(self) -> None:
         self._mgr.wait_until_finished()
 

@@ -1,0 +1,262 @@
+"""Inference engine tests (KV cache, sampling, generation, serving).
+
+The reference's only inference is a blocking HTTP call to a remote model (ref
+``src/distributed_inference.py:34-41``); its test suite fakes that call by
+injection. Here the model is local, so the tests assert the real contracts:
+cached incremental decode is numerically equivalent to the full forward pass,
+generation is deterministic under greedy decoding and independent of batch
+padding, and the OpenAI-compatible server round-trips through the framework's
+own L4 client."""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer.cache import init_cache
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.infer.sampling import sample_logits
+from ditl_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    from ditl_tpu.config import ModelConfig
+
+    cfg = ModelConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def _causal_mask(b, s, smax):
+    q = np.arange(s)
+    j = np.arange(smax)
+    return np.broadcast_to((j[None, :] <= q[:, None]), (b, s, smax))
+
+
+def test_cached_prefill_matches_uncached_forward(tiny_setup):
+    cfg, params = tiny_setup
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(3, 500, size=(2, 16)), jnp.int32)
+    full = llama.forward(params, ids, cfg)
+    cache = init_cache(cfg, 2, 16)
+    cached, new_cache = llama.forward(
+        params,
+        ids,
+        cfg,
+        cache=cache,
+        cache_index=jnp.int32(0),
+        attn_mask=jnp.asarray(_causal_mask(2, 16, 16)),
+    )
+    np.testing.assert_allclose(
+        np.asarray(full), np.asarray(cached), rtol=2e-2, atol=2e-2
+    )
+    # Cache was actually written (not still zeros).
+    assert float(jnp.abs(new_cache["k"]).sum()) > 0
+
+
+def test_stepwise_decode_matches_full_forward(tiny_setup):
+    """Teacher-forced decode: feed tokens one at a time through the cache and
+    check every step's logits against the full-sequence forward pass."""
+    cfg, params = tiny_setup
+    rng = np.random.default_rng(1)
+    s_total, s_prompt = 12, 4
+    ids = jnp.asarray(rng.integers(3, 500, size=(1, s_total)), jnp.int32)
+    full = np.asarray(llama.forward(params, ids, cfg))
+
+    cache = init_cache(cfg, 1, s_total)
+    prefill_mask = jnp.asarray(_causal_mask(1, s_prompt, s_total))
+    logits, cache = llama.forward(
+        params,
+        ids[:, :s_prompt],
+        cfg,
+        cache=cache,
+        cache_index=jnp.int32(0),
+        attn_mask=prefill_mask,
+    )
+    np.testing.assert_allclose(
+        full[:, :s_prompt], np.asarray(logits), rtol=2e-2, atol=2e-2
+    )
+    for t in range(s_prompt, s_total):
+        mask = jnp.asarray(np.arange(s_total)[None, None, :] <= t)
+        step_logits, cache = llama.forward(
+            params,
+            ids[:, t : t + 1],
+            cfg,
+            positions=jnp.full((1, 1), t, jnp.int32),
+            cache=cache,
+            cache_index=jnp.int32(t),
+            attn_mask=mask,
+        )
+        np.testing.assert_allclose(
+            full[:, t], np.asarray(step_logits)[:, 0], rtol=2e-2, atol=2e-2
+        )
+
+
+# ---------------------------------------------------------------------------
+# Sampling
+# ---------------------------------------------------------------------------
+
+
+def test_greedy_is_argmax():
+    logits = jnp.asarray([[0.1, 3.0, -1.0], [5.0, 0.0, 4.9]])
+    out = sample_logits(logits, jax.random.key(0), temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(out), [1, 0])
+
+
+def test_top_k_restricts_support():
+    logits = jnp.asarray([[0.0, 1.0, 2.0, 3.0]] * 64, jnp.float32)
+    toks = np.asarray(
+        sample_logits(logits, jax.random.key(1), temperature=1.0, top_k=2)
+    )
+    assert set(toks.tolist()) <= {2, 3}
+
+
+def test_top_p_keeps_top_token():
+    # One dominant token: nucleus with tiny p must always pick it.
+    logits = jnp.asarray([[10.0, 0.0, 0.0, 0.0]] * 16, jnp.float32)
+    toks = np.asarray(
+        sample_logits(logits, jax.random.key(2), temperature=1.0, top_p=0.1)
+    )
+    assert set(toks.tolist()) == {0}
+
+
+# ---------------------------------------------------------------------------
+# Generator
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_setup_f32(tiny_setup):
+    """Float32 variant: cross-bucket batch-independence is an exact-equality
+    property only in f32 — bf16 rounding shifts with XLA reduction tiling,
+    which legitimately varies with padded shapes."""
+    import dataclasses
+
+    cfg, _ = tiny_setup
+    cfg = dataclasses.replace(cfg, dtype="float32")
+    params = llama.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_generate_deterministic_and_batch_independent(tiny_setup_f32):
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    gen = Generator(params, cfg, tok)
+    gcfg = GenerateConfig(max_new_tokens=8)
+
+    solo = gen.generate_tokens([tok.encode("hello")], gcfg)
+    again = gen.generate_tokens([tok.encode("hello")], gcfg)
+    assert solo == again  # greedy => deterministic
+
+    # Same prompt inside a ragged batch: padding and dummy rows must not
+    # change the result (mask correctness).
+    batch = gen.generate_tokens(
+        [tok.encode("hello"), tok.encode("a much longer prompt here")], gcfg
+    )
+    assert batch[0] == solo[0]
+    assert len(batch) == 2
+
+
+def test_generate_text_roundtrip(tiny_setup):
+    cfg, params = tiny_setup
+    tok = ByteTokenizer()
+    gen = Generator(params, cfg, tok)
+    out = gen.generate(["ab"], GenerateConfig(max_new_tokens=4))
+    assert len(out) == 1
+    assert isinstance(out[0], str)
+
+
+def test_generate_sampled_respects_seed(tiny_setup):
+    cfg, params = tiny_setup
+    tok = ByteTokenizer()
+    gen = Generator(params, cfg, tok)
+    g1 = GenerateConfig(max_new_tokens=6, temperature=1.0, seed=7)
+    a = gen.generate_tokens([tok.encode("xy")], g1)
+    b = gen.generate_tokens([tok.encode("xy")], g1)
+    assert a == b  # same seed => same sample
+
+
+def test_generate_on_mesh_matches_single_device(tiny_setup_f32):
+    from ditl_tpu.config import MeshConfig
+    from ditl_tpu.runtime.mesh import build_mesh
+
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    gcfg = GenerateConfig(max_new_tokens=6)
+    prompts = [tok.encode(p) for p in ["aa", "bbbb", "c", "dd ee ff"]]
+
+    plain = Generator(params, cfg, tok).generate_tokens(prompts, gcfg)
+    mesh = build_mesh(MeshConfig(data=-1, tensor=2))
+    sharded = Generator(params, cfg, tok, mesh=mesh).generate_tokens(prompts, gcfg)
+    assert plain == sharded
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+
+def test_openai_server_roundtrip_with_framework_client(tiny_setup):
+    from ditl_tpu.client.llm import ERROR_SENTINEL, LLMClient
+    from ditl_tpu.config import APIConfig
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params = tiny_setup
+    gen = Generator(params, cfg, ByteTokenizer())
+    server = make_server(gen, port=0, default_max_tokens=4)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = server.server_address[1]
+        client = LLMClient(
+            APIConfig(api_base=f"http://127.0.0.1:{port}/v1", timeout_s=60.0)
+        )
+        out = client.complete("hi there")
+        assert out != ERROR_SENTINEL
+        assert isinstance(out, str)
+    finally:
+        server.shutdown()
+
+
+def test_server_completions_and_health(tiny_setup):
+    import json
+    import urllib.request
+
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params = tiny_setup
+    gen = Generator(params, cfg, ByteTokenizer())
+    server = make_server(gen, port=0, default_max_tokens=4)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/health") as r:
+            assert json.loads(r.read())["status"] == "ok"
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": "ab", "max_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            payload = json.loads(r.read())
+        assert payload["object"] == "text_completion"
+        assert payload["usage"]["completion_tokens"] >= 0
+        assert "text" in payload["choices"][0]
+    finally:
+        server.shutdown()
