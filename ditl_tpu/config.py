@@ -187,6 +187,10 @@ class TrainConfig:
     keep_checkpoints: int = 3
     resume: bool = True  # resume from latest checkpoint if present
     seed: int = 42
+    # Step-window trace capture (utils/profiling.py); "" => disabled.
+    profile_dir: str = ""
+    profile_start_step: int = 2  # skip the compile step
+    profile_num_steps: int = 3
 
 
 @dataclass(frozen=True)

@@ -95,13 +95,9 @@ class Generator:
         def run(params, input_ids, lengths, rng):
             cache = init_cache(cfg, batch, max_len)
             if mesh is not None:
-                from ditl_tpu.parallel.sharding import spec_tree
+                from ditl_tpu.parallel.sharding import named_sharding_tree
                 cache = jax.lax.with_sharding_constraint(
-                    cache,
-                    jax.tree.map(
-                        lambda s: jax.sharding.NamedSharding(mesh, s),
-                        spec_tree(cache_logical_axes(cfg), rules),
-                    ),
+                    cache, named_sharding_tree(mesh, cache_logical_axes(cfg), rules)
                 )
             # Prefill: causal over real (non-pad) prompt slots.
             q_pos = jnp.arange(prompt_len, dtype=jnp.int32)

@@ -82,29 +82,37 @@ class CheckpointManager:
         return restored["state"], data_iter
 
     def restore_latest_params(self, abstract_params: Any = None) -> Any | None:
-        """Restore only the ``params`` subtree of the newest checkpoint — the
-        serving path (infer/server.py), which has no optimizer state to
-        describe. Arrays come back exactly as saved (host-local numpy), fine
-        for single-host serving. ``abstract_params`` (a ``jax.eval_shape``
-        tree) is validated against the restored tree so a preset/checkpoint
-        mismatch fails loudly here, not as a shape error mid-forward."""
+        """Restore ONLY the ``params`` subtree of the newest checkpoint — the
+        serving path (infer/server.py). Partial restore means the optimizer
+        moments (2x the params for AdamW) are never read off storage, which is
+        the difference between serving a 70B checkpoint and OOMing on it.
+        ``abstract_params`` (a ``jax.eval_shape`` tree) is validated against
+        the checkpoint metadata so a preset/checkpoint mismatch fails loudly
+        here, not as a shape error mid-forward."""
+        import jax
         import orbax.checkpoint as ocp
 
         step = self._mgr.latest_step()
         if step is None:
             return None
-        restored = self._mgr.restore(
-            step, args=ocp.args.Composite(state=ocp.args.StandardRestore())
+        ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+        path = f"{self.directory}/{step}/state"
+        meta_tree = ckptr.metadata(path).item_metadata.tree
+        if "params" not in meta_tree:
+            raise ValueError(f"checkpoint at {path} has no 'params' subtree")
+        abstract = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
+            {"params": meta_tree["params"]},
         )
-        state = restored["state"]
-        params = state["params"] if isinstance(state, dict) else state.params
         if abstract_params is not None:
-            import jax
-
             expect = {
-                p: (l.shape,) for p, l in jax.tree.leaves_with_path(abstract_params)
+                jax.tree_util.keystr(p): l.shape
+                for p, l in jax.tree_util.tree_leaves_with_path(abstract_params)
             }
-            got = {p: (l.shape,) for p, l in jax.tree.leaves_with_path(params)}
+            got = {
+                jax.tree_util.keystr(p): l.shape
+                for p, l in jax.tree_util.tree_leaves_with_path(abstract["params"])
+            }
             if expect != got:
                 missing = sorted(set(expect) - set(got))
                 extra = sorted(set(got) - set(expect))
@@ -113,11 +121,14 @@ class CheckpointManager:
                 )
                 raise ValueError(
                     f"checkpoint at step {step} does not match the model config: "
-                    f"missing={missing[:3]} extra={extra[:3]} "
-                    f"shape_mismatch={[(str(k), expect[k], got[k]) for k in shape_diff[:3]]}"
+                    f"missing={missing[:3]} extra={extra[:3]} shape_mismatch="
+                    f"{[(k, expect[k], got[k]) for k in shape_diff[:3]]}"
                 )
-        logger.info("restored params from checkpoint at step %d", step)
-        return params
+        restored = ckptr.restore(
+            path, args=ocp.args.PyTreeRestore(item=abstract, partial_restore=True)
+        )
+        logger.info("restored params (only) from checkpoint at step %d", step)
+        return restored["params"]
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -39,6 +39,7 @@ from ditl_tpu.train.metrics import MetricsLogger
 from ditl_tpu.train.state import TrainState, create_train_state, state_logical_axes
 from ditl_tpu.train.step import make_train_step
 from ditl_tpu.utils.logging import get_logger, setup_logging
+from ditl_tpu.utils.profiling import StepProfiler
 
 logger = get_logger(__name__)
 
@@ -116,6 +117,11 @@ def train(config: Config) -> dict[str, Any]:
     train_step = make_train_step(model_cfg, config.train, mesh, example)
 
     metrics = MetricsLogger(log_every=config.train.log_every)
+    profiler = StepProfiler(
+        config.train.profile_dir,
+        config.train.profile_start_step,
+        config.train.profile_num_steps,
+    )
     client = LLMClient(config.api)
     total_steps = config.train.total_steps
     global_step = data_iter.global_step
@@ -133,7 +139,10 @@ def train(config: Config) -> dict[str, Any]:
                 if global_step >= total_steps:
                     break
                 metrics.start_step()
-                state, step_metrics = train_step(state, batch)
+                profiler.maybe_start(global_step)
+                with profiler.annotate(global_step):
+                    state, step_metrics = train_step(state, batch)
+                profiler.maybe_stop(global_step)
                 metrics.end_step(global_step, step_metrics)
                 global_step += 1
                 position = DataIterState(epoch, step_in_epoch + 1, global_step)
@@ -158,6 +167,7 @@ def train(config: Config) -> dict[str, Any]:
             ckpt.save(global_step, state, DataIterState(epoch, 0, global_step))
             ckpt.wait()
     finally:
+        profiler.close()
         if ckpt is not None:
             ckpt.close()
         barrier("end-of-training")

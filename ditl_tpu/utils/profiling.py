@@ -1,0 +1,84 @@
+"""Tracing / profiling (SURVEY.md §5: absent in the reference, whose
+monitoring story is 'check console output' + nvidia-smi, ref
+``docs/setup_guide.md:68-71``).
+
+Two mechanisms, both process-0-gated and off by default:
+
+- ``jax.profiler.start_server(port)`` (runtime/distributed.py, config
+  ``runtime.profiler_port``) — live capture from TensorBoard/XProf.
+- ``StepProfiler`` (here) — programmatic capture of a step window
+  [``profile_start_step``, ``profile_start_step + profile_num_steps``) to
+  ``profile_dir``, viewable in TensorBoard. Each step inside the window is
+  wrapped in a ``StepTraceAnnotation`` so XProf's step view lines up with
+  train steps. Capturing a *window* (not the whole run) keeps trace files
+  bounded and skips the untypical compile step.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["StepProfiler", "annotate_step"]
+
+
+def annotate_step(step: int):
+    """Context manager naming this step in the trace timeline."""
+    return jax.profiler.StepTraceAnnotation("train_step", step_num=step)
+
+
+class StepProfiler:
+    """Captures steps [start, start+num) to ``directory`` on process 0.
+
+    Usage (trainer loop):
+        prof.maybe_start(global_step)
+        with prof.annotate(global_step):
+            state, metrics = train_step(state, batch)
+        prof.maybe_stop(global_step)
+    """
+
+    def __init__(self, directory: str, start_step: int, num_steps: int = 3):
+        self.directory = directory
+        self.start_step = start_step
+        self.num_steps = num_steps
+        self._active = False
+        self._done = False
+        self._stop_after = start_step + num_steps - 1
+        self._enabled = bool(directory) and num_steps > 0 and jax.process_index() == 0
+
+    def maybe_start(self, step: int) -> None:
+        # >= not ==: a resumed run whose restored step is already past
+        # start_step still gets its window (shifted to the resume point).
+        if self._enabled and not self._active and not self._done and step >= self.start_step:
+            jax.profiler.start_trace(self.directory)
+            self._active = True
+            self._stop_after = step + self.num_steps - 1
+            logger.info(
+                "profiler: tracing steps %d..%d to %s",
+                step, self._stop_after, self.directory,
+            )
+
+    def annotate(self, step: int):
+        if self._active:
+            return annotate_step(step)
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def maybe_stop(self, step: int) -> None:
+        if self._active and step >= self._stop_after:
+            # Block until device work from the traced steps has finished so
+            # the trace actually contains the device timeline.
+            jax.effects_barrier()
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            logger.info("profiler: trace written to %s", self.directory)
+
+    def close(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
