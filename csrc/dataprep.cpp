@@ -1,0 +1,94 @@
+// Native host-side data preparation for the TPU data pipeline.
+//
+// The reference has no native code of its own (SURVEY.md §2: 0 first-party
+// C++/CUDA files) and its host loop is serial Python per example (ref
+// src/distributed_inference.py:64-69). Here the host-side hot path — byte
+// tokenization and sequence packing that must keep TPU chips fed
+// (SURVEY.md §7 hard part (c)) — is C++, loaded via ctypes
+// (ditl_tpu/native/dataprep.py) with a pure-Python fallback.
+//
+// Semantics mirror ditl_tpu/data/loader.py exactly:
+//   stream   = concat over docs of [bos] + (byte + offset)* + [eos]
+//   segments = 1 + cumulative count of bos tokens within each row (1-based)
+//   positions= column index minus column of the last bos at-or-before it
+//              (position restarts at every document start)
+//
+// Build: g++ -O3 -march=native -shared -fPIC dataprep.cpp -o libdataprep.so
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// Total tokens the packed stream will need (docs' byte lengths + 2 specials
+// per doc). Lets the caller allocate exactly once.
+int64_t dp_stream_size(const int64_t* doc_offsets, int64_t n_docs) {
+  if (n_docs <= 0) return 0;
+  return (doc_offsets[n_docs] - doc_offsets[0]) + 2 * n_docs;
+}
+
+// Byte-tokenize + pack: writes [bos] doc0 [eos] [bos] doc1 [eos] ... into
+// out_tokens. text_bytes holds all docs concatenated; doc_offsets (n_docs+1)
+// delimits them. Returns tokens written, or -1 if out_capacity is too small.
+int64_t dp_pack_stream(const uint8_t* text_bytes, const int64_t* doc_offsets,
+                       int64_t n_docs, int32_t bos, int32_t eos,
+                       int32_t byte_offset, int32_t* out_tokens,
+                       int64_t out_capacity) {
+  int64_t need = dp_stream_size(doc_offsets, n_docs);
+  if (need > out_capacity) return -1;
+  int64_t w = 0;
+  for (int64_t d = 0; d < n_docs; ++d) {
+    out_tokens[w++] = bos;
+    const int64_t start = doc_offsets[d], end = doc_offsets[d + 1];
+    for (int64_t i = start; i < end; ++i) {
+      out_tokens[w++] = static_cast<int32_t>(text_bytes[i]) + byte_offset;
+    }
+    out_tokens[w++] = eos;
+  }
+  return w;
+}
+
+// Per-row document segment ids (1-based cumsum of bos) and within-document
+// positions (restart at each bos) for packed rows of shape (rows, seq_len).
+void dp_segments_positions(const int32_t* tokens, int64_t rows,
+                           int64_t seq_len, int32_t bos, int32_t* segments,
+                           int32_t* positions) {
+  for (int64_t r = 0; r < rows; ++r) {
+    const int32_t* row = tokens + r * seq_len;
+    int32_t* seg = segments + r * seq_len;
+    int32_t* pos = positions + r * seq_len;
+    int32_t seg_id = 1;
+    int64_t last_bos = 0;  // matches loader.py: column 0 if no bos seen yet
+    for (int64_t c = 0; c < seq_len; ++c) {
+      if (row[c] == bos) {
+        ++seg_id;
+        last_bos = c;
+      }
+      seg[c] = seg_id;
+      pos[c] = static_cast<int32_t>(c - last_bos);
+    }
+  }
+}
+
+// Padded per-example path: tokenize one doc into a fixed-length row
+// ([bos] + bytes + [eos], truncated to seq_len, padded with pad_id) and its
+// float32 loss mask. Returns the number of real (non-pad) tokens.
+int64_t dp_tokenize_padded(const uint8_t* text_bytes, int64_t n_bytes,
+                           int64_t seq_len, int32_t bos, int32_t eos,
+                           int32_t pad, int32_t byte_offset,
+                           int32_t* out_row, float* out_mask) {
+  if (seq_len < 2) return -1;  // bos+eos need 2 slots; don't overrun out_row
+  int64_t body = n_bytes < seq_len - 2 ? n_bytes : seq_len - 2;
+  int64_t w = 0;
+  out_row[w++] = bos;
+  for (int64_t i = 0; i < body; ++i) {
+    out_row[w++] = static_cast<int32_t>(text_bytes[i]) + byte_offset;
+  }
+  out_row[w++] = eos;
+  const int64_t real = w;
+  for (; w < seq_len; ++w) out_row[w] = pad;
+  for (int64_t i = 0; i < seq_len; ++i) out_mask[i] = i < real ? 1.0f : 0.0f;
+  return real;
+}
+
+}  // extern "C"

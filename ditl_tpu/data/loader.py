@@ -135,9 +135,22 @@ class DataPipeline:
             ids = np.empty((len(chunk), seq_len), dtype=np.int32)
             mask = np.empty((len(chunk), seq_len), dtype=np.float32)
             labels = np.empty((len(chunk),), dtype=np.int32)
+            from ditl_tpu.data.tokenizer import ByteTokenizer
+            from ditl_tpu.native import dataprep
+
+            is_byte = isinstance(self.tokenizer, ByteTokenizer)
             for i, idx in enumerate(chunk):
                 item = self.dataset[int(idx)]
-                ids[i], mask[i] = tokenize_example(self.tokenizer, item["text"], seq_len)
+                if is_byte:  # native C++ tokenize+pad (csrc/dataprep.cpp)
+                    tok = self.tokenizer
+                    ids[i], mask[i] = dataprep.tokenize_padded(
+                        item["text"], seq_len, bos=tok.bos_id, eos=tok.eos_id,
+                        pad=tok.pad_id, byte_offset=tok.byte_offset,
+                    )
+                else:
+                    ids[i], mask[i] = tokenize_example(
+                        self.tokenizer, item["text"], seq_len
+                    )
                 labels[i] = item["label"]
             # Segment ids isolate real tokens (1) from padding (0) in attention.
             yield {
@@ -164,24 +177,17 @@ class DataPipeline:
         permutation) and truncates to it, keeping step counts identical.
         """
         tok, seq_len = self.tokenizer, self.config.seq_len
-        stream: list[int] = []
-        for idx in indices:
-            item = self.dataset[int(idx)]
-            stream.extend([tok.bos_id] + tok.encode(item["text"]) + [tok.eos_id])
+        stream = self._pack_stream(indices)
         rows_total = len(stream) // seq_len
         n_batches = rows_total // self.host_batch_size
         if self.process_count > 1:
             n_batches = min(n_batches, self._global_min_batches())
-        arr = np.asarray(stream[: rows_total * seq_len], dtype=np.int32).reshape(
-            rows_total, seq_len
-        )
-        is_bos = arr == tok.bos_id
-        # Per-row document segments (1-based; every row starts mid- or at-doc).
-        segments = np.cumsum(is_bos, axis=1).astype(np.int32) + 1
-        # Positions restart at each bos: index within the current document.
-        col = np.broadcast_to(np.arange(seq_len), arr.shape)
-        last_bos = np.maximum.accumulate(np.where(is_bos, col, 0), axis=1)
-        positions = (col - last_bos).astype(np.int32)
+        arr = stream[: rows_total * seq_len].reshape(rows_total, seq_len)
+        # Per-row document segments (1-based) and positions restarting at each
+        # bos — native C++ when available, numpy otherwise (same semantics).
+        from ditl_tpu.native import dataprep
+
+        segments, positions = dataprep.segments_positions(arr, bos=tok.bos_id)
         for b in range(start_step, n_batches):
             sl = slice(b * self.host_batch_size, (b + 1) * self.host_batch_size)
             yield {
@@ -191,6 +197,25 @@ class DataPipeline:
                 "segment_ids": segments[sl],
                 "positions": positions[sl],
             }
+
+    def _pack_stream(self, indices: np.ndarray) -> np.ndarray:
+        """Tokenized [bos]doc[eos] stream for this shard. The byte tokenizer
+        goes through the native C++ path (csrc/dataprep.cpp) — the host-side
+        hot loop, SURVEY.md §7 hard part (c); other tokenizers (HF: their own
+        native code) take the generic path."""
+        from ditl_tpu.data.tokenizer import ByteTokenizer
+        from ditl_tpu.native import dataprep
+
+        tok = self.tokenizer
+        texts = [self.dataset[int(idx)]["text"] for idx in indices]
+        if isinstance(tok, ByteTokenizer):
+            return dataprep.pack_stream(
+                texts, bos=tok.bos_id, eos=tok.eos_id, byte_offset=tok.byte_offset
+            )
+        stream: list[int] = []
+        for text in texts:
+            stream.extend([tok.bos_id] + tok.encode(text) + [tok.eos_id])
+        return np.asarray(stream, dtype=np.int32)
 
     def _doc_token_count(self, idx: int) -> int:
         """Tokenized length of one document incl. bos/eos. Cached: document

@@ -38,19 +38,20 @@ class ByteTokenizer:
         self.pad_id = 0
         self.bos_id = 1
         self.eos_id = 2
-        self._offset = 3
-        self.vocab_size = 256 + self._offset
+        self.byte_offset = 3  # id of byte b is b + byte_offset (public:
+        # the native packer, loader, and tests key off it)
+        self.vocab_size = 256 + self.byte_offset
 
     def encode(self, text: str) -> list[int]:
-        return [b + self._offset for b in text.encode("utf-8")]
+        return [b + self.byte_offset for b in text.encode("utf-8")]
 
     def decode(self, ids: Sequence[int]) -> str:
         # Skip specials and out-of-vocab ids (a model head can be wider than
         # the tokenizer — e.g. vocab padded up for MXU tiling).
         data = bytes(
-            i - self._offset
+            i - self.byte_offset
             for i in ids
-            if self._offset <= i < self._offset + 256
+            if self.byte_offset <= i < self.byte_offset + 256
         )
         return data.decode("utf-8", errors="replace")
 

@@ -1,0 +1,3 @@
+"""Native (C++) host-side components, loaded via ctypes with pure-Python
+fallbacks. See csrc/ for sources and native/dataprep.py for the build/load
+logic."""
