@@ -66,6 +66,7 @@ class MeshConfig:
 
     ``data``: pure data parallelism (batch split, the reference's only strategy).
     ``fsdp``: parameter/optimizer sharding (ZeRO-3/GSPMD style) — also splits batch.
+    ``stage``: GPipe-style pipeline parallelism (layer dim split, parallel/pipeline.py).
     ``sequence``: sequence/context parallelism (ring attention axis).
     ``tensor``: megatron-style tensor parallelism within a layer.
     ``expert``: MoE expert parallelism.
@@ -74,16 +75,17 @@ class MeshConfig:
 
     data: int = -1
     fsdp: int = 1
+    stage: int = 1
     sequence: int = 1
     tensor: int = 1
     expert: int = 1
 
     @property
     def axis_names(self) -> tuple[str, ...]:
-        return ("data", "fsdp", "sequence", "tensor", "expert")
+        return ("data", "fsdp", "stage", "sequence", "tensor", "expert")
 
     def sizes(self) -> tuple[int, ...]:
-        return (self.data, self.fsdp, self.sequence, self.tensor, self.expert)
+        return (self.data, self.fsdp, self.stage, self.sequence, self.tensor, self.expert)
 
     def resolve(self, n_devices: int) -> tuple[int, ...]:
         """Resolve -1 axes against the actual device count; validate product."""
@@ -144,6 +146,14 @@ class ModelConfig:
     attention_impl: str = "xla"
     # Gradient checkpointing policy for the layer scan: "none" | "full" | "dots"
     remat: str = "full"
+    # Loss head: "naive" materializes (B, S, V) f32 logits; "fused" computes
+    # the lm-head matmul + cross-entropy blockwise (ops/fused_ce.py) so peak
+    # logits memory is loss_block_tokens x V instead of B*S*V.
+    loss_impl: str = "naive"
+    loss_block_tokens: int = 1024
+    # Pipeline parallelism (active when the mesh's "stage" axis > 1):
+    # microbatches per pipeline flush; 0 => one per stage.
+    pipeline_microbatches: int = 0
 
 
 @dataclass(frozen=True)

@@ -135,6 +135,13 @@ def num_params(params: Params) -> int:
     return sum(x.size for x in jax.tree.leaves(params))
 
 
+def head_weights(params: Params, cfg: ModelConfig) -> jax.Array:
+    """The (D, V) lm-head matrix (transposed embedding when tied)."""
+    if cfg.tie_embeddings:
+        return params["embed"]["embedding"].T
+    return params["lm_head"]["kernel"]
+
+
 # ---------------------------------------------------------------------------
 # Building blocks
 # ---------------------------------------------------------------------------
@@ -173,6 +180,18 @@ def _constrain(x: jax.Array, logical_axes, mesh, rules):
     return jax.lax.with_sharding_constraint(
         x, NamedSharding(mesh, logical_to_spec(logical_axes, rules))
     )
+
+
+def _apply_remat(layer_fn, cfg: ModelConfig):
+    """Wrap a layer body with the configured rematerialization policy."""
+    if cfg.remat == "full":
+        return jax.checkpoint(layer_fn)
+    if cfg.remat == "dots":
+        return jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+        )
+    return layer_fn
 
 
 # ---------------------------------------------------------------------------
@@ -280,8 +299,14 @@ def forward(
     cache: dict[str, jax.Array] | None = None,
     cache_index: jax.Array | None = None,
     attn_mask: jax.Array | None = None,
+    return_hidden: bool = False,
 ) -> Any:
     """Token ids (B, S) -> logits (B, S, V) in float32.
+
+    ``return_hidden=True`` skips the lm-head projection and returns the
+    final-normed hidden states (B, S, D) instead of logits — the fused
+    blockwise cross-entropy (ops/fused_ce.py) applies the head itself so the
+    full logits tensor is never materialized.
 
     ``with_aux=True`` additionally returns the summed per-layer auxiliary loss
     (MoE router load balancing; zero for dense models).
@@ -320,6 +345,30 @@ def forward(
             cached_layer_fn, x, (params["layers"], cache["k"], cache["v"])
         )
         new_cache = {"k": new_k, "v": new_v}
+    elif mesh is not None and mesh.shape.get("stage", 1) > 1:
+        # Pipeline parallelism: layers are stage-sharded; microbatches flow
+        # through the stages via ppermute (parallel/pipeline.py). Layer bodies
+        # run inside shard_map, so no GSPMD constraints (mesh=None).
+        from ditl_tpu.parallel.pipeline import pipeline_apply
+
+        def pipe_layer(h, layer_params, ex):
+            pos, seg = ex
+            return _decoder_layer(
+                layer_params, h, cfg=cfg, positions=pos, segment_ids=seg,
+                mesh=None, rules=None,
+            )
+
+        pipe_layer = _apply_remat(pipe_layer, cfg)
+        x, layer_aux = pipeline_apply(
+            pipe_layer,
+            params["layers"],
+            x,
+            (positions, segment_ids),
+            mesh=mesh,
+            rules=rules,
+            n_microbatches=cfg.pipeline_microbatches or None,
+        )
+        new_cache = None
     else:
         def layer_fn(carry, layer_params):
             return _decoder_layer(
@@ -332,20 +381,19 @@ def forward(
                 rules=rules,
             )
 
-        if cfg.remat == "full":
-            layer_fn = jax.checkpoint(layer_fn)
-        elif cfg.remat == "dots":
-            layer_fn = jax.checkpoint(
-                layer_fn,
-                policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            )
+        layer_fn = _apply_remat(layer_fn, cfg)
         x, layer_aux = jax.lax.scan(layer_fn, x, params["layers"])
         new_cache = None
 
     x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)
-    head = (
-        params["embed"]["embedding"].T if cfg.tie_embeddings else params["lm_head"]["kernel"]
-    )
+    if return_hidden:
+        out = (x,)
+        if with_aux:
+            out = out + (jnp.sum(layer_aux),)
+        if cache is not None:
+            out = out + (new_cache,)
+        return out if len(out) > 1 else x
+    head = head_weights(params, cfg)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, head.astype(cd), preferred_element_type=jnp.float32
     )

@@ -22,8 +22,10 @@ from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-# Outer -> inner. DCN-friendly axes first, ICI-hungry axes last.
-AXIS_ORDER = ("data", "fsdp", "sequence", "expert", "tensor")
+# Outer -> inner. DCN-friendly axes first, ICI-hungry axes last. Pipeline
+# stages exchange one activation per microbatch per stage (point-to-point,
+# modest bandwidth) so "stage" sits on the DCN-friendly side.
+AXIS_ORDER = ("data", "stage", "fsdp", "sequence", "expert", "tensor")
 
 
 def build_mesh(config: MeshConfig | None = None, devices=None) -> "jax.sharding.Mesh":

@@ -37,27 +37,57 @@ def loss_fn(
     rules=None,
 ) -> tuple[jax.Array, dict[str, jax.Array]]:
     """Masked next-token cross-entropy (float32 logits), plus the MoE router
-    load-balancing aux term when the model is sparse."""
-    logits, aux = llama.forward(
-        params,
-        batch["input_ids"],
-        cfg,
-        positions=batch.get("positions"),
-        segment_ids=batch.get("segment_ids"),
-        mesh=mesh,
-        rules=rules,
-        with_aux=True,
-    )
+    load-balancing aux term when the model is sparse.
+
+    ``cfg.loss_impl == "fused"`` routes through the blockwise fused
+    lm-head+CE (ops/fused_ce.py) — same value, no (B, S, V) logits tensor."""
+    if cfg.loss_impl not in ("naive", "fused"):
+        raise ValueError(f"unknown loss_impl {cfg.loss_impl!r} (naive|fused)")
     targets = batch["input_ids"][:, 1:]
-    logits = logits[:, :-1]
     mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    target_logit = jnp.take_along_axis(logits, targets[..., None].astype(jnp.int32), axis=-1)[
-        ..., 0
-    ]
-    nll = (logz - target_logit) * mask
     n_tokens = jnp.maximum(mask.sum(), 1.0)
-    ce = nll.sum() / n_tokens
+    if cfg.loss_impl == "fused":
+        from ditl_tpu.ops.fused_ce import fused_cross_entropy
+
+        hidden, aux = llama.forward(
+            params,
+            batch["input_ids"],
+            cfg,
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
+            mesh=mesh,
+            rules=rules,
+            with_aux=True,
+            return_hidden=True,
+        )
+        d = hidden.shape[-1]
+        nll_sum = fused_cross_entropy(
+            hidden[:, :-1].reshape(-1, d),
+            llama.head_weights(params, cfg),
+            targets.reshape(-1).astype(jnp.int32),
+            mask.reshape(-1),
+            block_tokens=cfg.loss_block_tokens,
+            compute_dtype=jnp.dtype(cfg.dtype),
+        )
+        ce = nll_sum / n_tokens
+    else:
+        logits, aux = llama.forward(
+            params,
+            batch["input_ids"],
+            cfg,
+            positions=batch.get("positions"),
+            segment_ids=batch.get("segment_ids"),
+            mesh=mesh,
+            rules=rules,
+            with_aux=True,
+        )
+        logits = logits[:, :-1]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        target_logit = jnp.take_along_axis(
+            logits, targets[..., None].astype(jnp.int32), axis=-1
+        )[..., 0]
+        nll = (logz - target_logit) * mask
+        ce = nll.sum() / n_tokens
     loss = ce + cfg.router_aux_coef * aux if cfg.num_experts > 0 else ce
     return loss, {"loss": ce, "n_tokens": mask.sum()}
 
@@ -73,8 +103,10 @@ def make_train_step(
     example_batch: dict[str, Any],
     rules: dict | None = None,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
-    """Build the compiled train step with explicit in/out shardings."""
-    rules = rules if rules is not None else DEFAULT_RULES
+    """Build the compiled train step with explicit in/out shardings. When the
+    mesh has a pipeline axis (stage > 1), the stage-sharded rule table is
+    selected automatically (parallel/pipeline.py)."""
+    rules = rules if rules is not None else _default_rules(mesh)
     tx = None
 
     def get_tx(params):
@@ -141,6 +173,14 @@ def make_train_step(
     )
 
 
+def _default_rules(mesh) -> dict:
+    if mesh is not None and mesh.shape.get("stage", 1) > 1:
+        from ditl_tpu.parallel.pipeline import PIPELINE_RULES
+
+        return PIPELINE_RULES
+    return DEFAULT_RULES
+
+
 def optax_global_norm(tree: Any) -> jax.Array:
     leaves = jax.tree.leaves(tree)
     return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
@@ -148,7 +188,7 @@ def optax_global_norm(tree: Any) -> jax.Array:
 
 def make_eval_step(model_cfg: ModelConfig, mesh, rules: dict | None = None):
     """Compiled forward-only step returning per-batch mean NLL."""
-    rules = rules if rules is not None else DEFAULT_RULES
+    rules = rules if rules is not None else _default_rules(mesh)
 
     @jax.jit
     def eval_step(params, batch):

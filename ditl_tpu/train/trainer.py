@@ -81,8 +81,12 @@ def train(config: Config) -> dict[str, Any]:
 
     # Sharded-from-birth state init: jit with out_shardings so every param is
     # created directly on its mesh shards (a 70B state never fits one chip).
+    # Rule table must match the train step's (stage-sharded when pipelined).
+    from ditl_tpu.train.step import _default_rules
+
+    rules = _default_rules(mesh)
     state_shardings = named_sharding_tree(
-        mesh, state_logical_axes(model_cfg, config.train)
+        mesh, state_logical_axes(model_cfg, config.train), rules
     )
     rng = jax.random.key(config.train.seed)
     with mesh:

@@ -67,9 +67,9 @@ def test_api_key_from_env_only(monkeypatch):
 
 
 def test_mesh_resolve():
-    assert MeshConfig(data=-1).resolve(8) == (8, 1, 1, 1, 1)
-    assert MeshConfig(data=2, fsdp=2, tensor=2).resolve(8) == (2, 2, 1, 2, 1)
-    assert MeshConfig(data=1, fsdp=-1).resolve(8) == (1, 8, 1, 1, 1)
+    assert MeshConfig(data=-1).resolve(8) == (8, 1, 1, 1, 1, 1)
+    assert MeshConfig(data=2, fsdp=2, tensor=2).resolve(8) == (2, 2, 1, 1, 2, 1)
+    assert MeshConfig(data=1, fsdp=-1).resolve(8) == (1, 8, 1, 1, 1, 1)
     with pytest.raises(ValueError):
         MeshConfig(data=3).resolve(8)
     with pytest.raises(ValueError):
