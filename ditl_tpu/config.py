@@ -144,7 +144,9 @@ class ModelConfig:
     lora_dropout: float = 0.0
     # Attention implementation: "xla" | "flash" (Pallas) | "ring" (SP ring attention)
     attention_impl: str = "xla"
-    # Gradient checkpointing policy for the layer scan: "none" | "full" | "dots"
+    # Gradient checkpointing policy for the layer scan:
+    # "none" | "full" | "dots" | "attn" (save only attention outputs, so the
+    # backward never re-runs the attention kernel).
     remat: str = "full"
     # Loss head: "naive" materializes (B, S, V) f32 logits; "fused" computes
     # the lm-head matmul + cross-entropy blockwise (ops/fused_ce.py) so peak
@@ -196,6 +198,9 @@ class TrainConfig:
     checkpoint_every: int = 0
     keep_checkpoints: int = 3
     resume: bool = True  # resume from latest checkpoint if present
+    # Path to a local HF checkpoint directory (transformers format) to
+    # initialize parameters from instead of random init (models/convert.py).
+    init_from_hf: str = ""
     seed: int = 42
     # Step-window trace capture (utils/profiling.py); "" => disabled.
     profile_dir: str = ""

@@ -30,6 +30,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from ditl_tpu.config import ModelConfig
 from ditl_tpu.ops.attention import dot_product_attention
@@ -191,6 +192,14 @@ def _apply_remat(layer_fn, cfg: ModelConfig):
             layer_fn,
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
         )
+    if cfg.remat == "attn":
+        # Save only the per-layer attention outputs; recompute the rest.
+        return jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.save_only_these_names("attn_out"),
+        )
+    if cfg.remat != "none":
+        raise ValueError(f"unknown remat policy {cfg.remat!r} (none|full|dots|attn)")
     return layer_fn
 
 
@@ -260,6 +269,11 @@ def _decoder_layer(
             mesh=mesh, rules=rules,
         )
     attn_out = attn_out.reshape(b, s, nh * hd)
+    # Named for the remat="attn" policy: saving this one activation means the
+    # backward pass never re-runs the attention kernel itself (its recompute
+    # is the expensive part of full remat), while everything else (norms,
+    # projections, SwiGLU) is still rematerialized.
+    attn_out = checkpoint_name(attn_out, "attn_out")
     x = x + proj(attn_out, attn["wo"], "wo")
     x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
 

@@ -46,23 +46,24 @@ def loss_fn(
     targets = batch["input_ids"][:, 1:]
     mask = batch["loss_mask"][:, 1:].astype(jnp.float32)
     n_tokens = jnp.maximum(mask.sum(), 1.0)
-    if cfg.loss_impl == "fused":
+    fused = cfg.loss_impl == "fused"
+    out, aux = llama.forward(
+        params,
+        batch["input_ids"],
+        cfg,
+        positions=batch.get("positions"),
+        segment_ids=batch.get("segment_ids"),
+        mesh=mesh,
+        rules=rules,
+        with_aux=True,
+        return_hidden=fused,
+    )
+    if fused:
         from ditl_tpu.ops.fused_ce import fused_cross_entropy
 
-        hidden, aux = llama.forward(
-            params,
-            batch["input_ids"],
-            cfg,
-            positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"),
-            mesh=mesh,
-            rules=rules,
-            with_aux=True,
-            return_hidden=True,
-        )
-        d = hidden.shape[-1]
+        d = out.shape[-1]
         nll_sum = fused_cross_entropy(
-            hidden[:, :-1].reshape(-1, d),
+            out[:, :-1].reshape(-1, d),
             llama.head_weights(params, cfg),
             targets.reshape(-1).astype(jnp.int32),
             mask.reshape(-1),
@@ -71,17 +72,7 @@ def loss_fn(
         )
         ce = nll_sum / n_tokens
     else:
-        logits, aux = llama.forward(
-            params,
-            batch["input_ids"],
-            cfg,
-            positions=batch.get("positions"),
-            segment_ids=batch.get("segment_ids"),
-            mesh=mesh,
-            rules=rules,
-            with_aux=True,
-        )
-        logits = logits[:, :-1]
+        logits = out[:, :-1]
         logz = jax.nn.logsumexp(logits, axis=-1)
         target_logit = jnp.take_along_axis(
             logits, targets[..., None].astype(jnp.int32), axis=-1

@@ -46,6 +46,46 @@ logger = get_logger(__name__)
 __all__ = ["train"]
 
 
+def _params_from_hf_checkpoint(path: str, model_cfg, current_params, param_shardings):
+    """Convert a local HF checkpoint and merge it over the live param tree.
+
+    Subtrees the checkpoint cannot provide (LoRA adapters) keep their fresh
+    init; everything else is validated against the model config (a silently
+    wrong vocab/hidden size would otherwise train on garbage gathers) and
+    device_put leaf-wise onto its existing sharding.
+    """
+    from ditl_tpu.models.convert import load_hf_model
+
+    logger.info("initializing params from HF checkpoint %s", path)
+    np_params, hf_cfg = load_hf_model(path)
+    mismatches = [
+        f"{f}: checkpoint {getattr(hf_cfg, f)} != model {getattr(model_cfg, f)}"
+        for f in (
+            "vocab_size", "hidden_size", "intermediate_size", "num_layers",
+            "num_heads", "num_kv_heads", "head_dim", "num_experts",
+            "tie_embeddings",
+        )
+        if getattr(hf_cfg, f) != getattr(model_cfg, f)
+    ]
+    if mismatches:
+        raise ValueError(
+            f"HF checkpoint {path} does not match the model config: "
+            + "; ".join(mismatches)
+        )
+
+    def merge(hf_sub, cur_sub, shard_sub):
+        if isinstance(cur_sub, dict):
+            return {
+                k: merge(hf_sub.get(k) if hf_sub else None, v, shard_sub[k])
+                for k, v in cur_sub.items()
+            }
+        if hf_sub is None:  # e.g. LoRA adapters: keep fresh init
+            return cur_sub
+        return jax.device_put(hf_sub.astype(model_cfg.param_dtype), shard_sub)
+
+    return merge(np_params, current_params, param_shardings)
+
+
 def train(config: Config) -> dict[str, Any]:
     """Run the full fine-tune. Returns summary metrics (also logged)."""
     t_start = time.time()
@@ -101,6 +141,7 @@ def train(config: Config) -> dict[str, Any]:
     # Checkpoint manager + resume.
     ckpt: CheckpointManager | None = None
     data_iter = DataIterState()
+    resumed = False
     if config.train.checkpoint_dir:
         ckpt = CheckpointManager(
             config.train.checkpoint_dir,
@@ -116,6 +157,19 @@ def train(config: Config) -> dict[str, Any]:
             restored = ckpt.restore_latest(abstract)
             if restored is not None:
                 state, data_iter = restored
+                resumed = True
+
+    if config.train.init_from_hf and not resumed:
+        # Overwrite the random base weights with a converted HF checkpoint
+        # (skipped on resume — the Orbax checkpoint supersedes it). Leaf-wise
+        # device_put onto each param's existing sharding; the full model is
+        # never resident on one chip.
+        state = state.replace(
+            params=_params_from_hf_checkpoint(
+                config.train.init_from_hf, model_cfg, state.params,
+                state_shardings.params,
+            )
+        )
 
     example = next(iter(pipeline.epoch(0)))
     train_step = make_train_step(model_cfg, config.train, mesh, example)

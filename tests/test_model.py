@@ -132,3 +132,32 @@ def test_num_params(tiny_model_cfg):
     n = llama.num_params(params)
     assert n > 0
     assert n == sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+
+
+@pytest.mark.parametrize("remat", ["none", "full", "dots", "attn"])
+def test_remat_policies_preserve_loss_and_grads(tiny_model_cfg, remat):
+    """Every remat policy is a memory schedule, not a math change."""
+    from ditl_tpu.train.step import loss_fn
+
+    cfg_ref = dataclasses.replace(_f32(tiny_model_cfg), remat="none")
+    cfg = dataclasses.replace(_f32(tiny_model_cfg), remat=remat)
+    params = llama.init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(3, 500, size=(2, 16)), jnp.int32),
+        "loss_mask": jnp.ones((2, 16), jnp.float32),
+    }
+    l_ref, g_ref = jax.value_and_grad(lambda p: loss_fn(p, batch, cfg_ref)[0])(params)
+    l, g = jax.value_and_grad(lambda p: loss_fn(p, batch, cfg)[0])(params)
+    np.testing.assert_allclose(float(l), float(l_ref), rtol=1e-6)
+    flat, _ = jax.flatten_util.ravel_pytree(g)
+    flat_ref, _ = jax.flatten_util.ravel_pytree(g_ref)
+    np.testing.assert_allclose(np.asarray(flat), np.asarray(flat_ref), rtol=1e-5, atol=1e-6)
+
+
+def test_remat_unknown_policy_raises(tiny_model_cfg):
+    cfg = dataclasses.replace(tiny_model_cfg, remat="bogus")
+    params = llama.init_params(jax.random.key(0), cfg)
+    ids = jnp.ones((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="unknown remat"):
+        llama.forward(params, ids, cfg)
