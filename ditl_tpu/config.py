@@ -191,7 +191,12 @@ class TrainConfig:
     beta2: float = 0.95
     grad_clip_norm: float = 1.0
     grad_accum_steps: int = 1
+    # Optimizer steps per compiled call (lax.scan window; train/step.py
+    # make_multi_step). >1 removes host dispatch overhead between steps —
+    # significant over remote device transports.
+    steps_per_call: int = 1
     log_every: int = 10
+    metrics_file: str = ""  # "" => no JSONL scalar stream (metrics.py)
     eval_every: int = 0  # 0 => no API eval loop
     eval_samples: int = 8
     checkpoint_dir: str = ""  # "" => checkpointing disabled

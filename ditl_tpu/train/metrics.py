@@ -24,7 +24,16 @@ __all__ = ["MetricsLogger"]
 
 
 class MetricsLogger:
-    def __init__(self, log_every: int = 10, n_chips: int | None = None):
+    def __init__(
+        self,
+        log_every: int = 10,
+        n_chips: int | None = None,
+        metrics_file: str = "",
+    ):
+        """``metrics_file``: optional coordinator-only JSONL scalar stream
+        (one ``{"step": ..., "loss": ..., ...}`` object per flush) — the
+        TensorBoard-scalar equivalent without a TF dependency; any dashboard
+        can tail it."""
         import jax
 
         self.log_every = max(1, log_every)
@@ -33,28 +42,34 @@ class MetricsLogger:
         self.tokens_per_sec_chip: list[float] = []
         self._last_t: float | None = None
         self._pending: list[tuple[int, Any]] = []
+        self._metrics_fh = None
+        if metrics_file and is_coordinator():
+            self._metrics_fh = open(metrics_file, "a", buffering=1)
 
     def start_step(self) -> None:
         self._last_t = time.perf_counter()
 
-    def end_step(self, step: int, device_metrics: Any) -> None:
-        """Record wall time; stash device metrics without forcing a sync."""
+    def end_step(self, step: int, device_metrics: Any, n_steps: int = 1) -> None:
+        """Record wall time; stash device metrics without forcing a sync.
+        ``n_steps > 1`` when one call ran a whole compiled step window
+        (train/step.make_multi_step): wall time is divided per step, and
+        ``device_metrics['n_tokens']`` is expected to cover the window."""
         now = time.perf_counter()
         if self._last_t is not None:
-            self.step_times.append(now - self._last_t)
+            self.step_times.append((now - self._last_t) / max(1, n_steps))
         self._last_t = None
-        self._pending.append((step, device_metrics))
-        if step % self.log_every == 0:
+        self._pending.append((step, device_metrics, max(1, n_steps)))
+        if step % self.log_every < n_steps:
             self.flush()
 
     def flush(self) -> None:
         if not self._pending:
             return
-        step, metrics = self._pending[-1]
+        step, metrics, n_steps = self._pending[-1]
         host = {k: float(v) for k, v in metrics.items()}  # device sync point
         if self.step_times:
             dt = self.step_times[-1]
-            tps_chip = host.get("n_tokens", 0.0) / dt / self.n_chips
+            tps_chip = host.get("n_tokens", 0.0) / (dt * n_steps) / self.n_chips
             self.tokens_per_sec_chip.append(tps_chip)
             if is_coordinator():
                 logger.info(
@@ -66,7 +81,26 @@ class MetricsLogger:
                     dt,
                     tps_chip,
                 )
+            if self._metrics_fh is not None:
+                self._metrics_fh.write(
+                    json.dumps(
+                        {
+                            "step": step,
+                            "step_time_s": round(dt, 6),
+                            "tokens_per_sec_per_chip": round(tps_chip, 2),
+                            **{k: round(v, 6) for k, v in host.items()},
+                        },
+                        sort_keys=True,
+                    )
+                    + "\n"
+                )
         self._pending.clear()
+
+    def close(self) -> None:
+        self.flush()
+        if self._metrics_fh is not None:
+            self._metrics_fh.close()
+            self._metrics_fh = None
 
     def summary(self) -> dict[str, float]:
         """BASELINE.md numbers. p50 over steps after compile warm-up."""

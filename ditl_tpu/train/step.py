@@ -25,7 +25,13 @@ from ditl_tpu.models import llama
 from ditl_tpu.parallel.sharding import DEFAULT_RULES, named_sharding_tree
 from ditl_tpu.train.state import TrainState, make_optimizer, state_logical_axes
 
-__all__ = ["loss_fn", "make_train_step", "make_eval_step", "batch_logical_axes"]
+__all__ = [
+    "loss_fn",
+    "make_train_step",
+    "make_multi_step",
+    "make_eval_step",
+    "batch_logical_axes",
+]
 
 
 def loss_fn(
@@ -87,17 +93,13 @@ def batch_logical_axes(example_batch: dict[str, Any]) -> dict[str, tuple]:
     return {k: ("batch",) + (None,) * (v.ndim - 1) for k, v in example_batch.items()}
 
 
-def make_train_step(
+def _build_step_fn(
     model_cfg: ModelConfig,
     train_cfg: TrainConfig,
     mesh,
-    example_batch: dict[str, Any],
-    rules: dict | None = None,
+    rules: dict,
 ) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
-    """Build the compiled train step with explicit in/out shardings. When the
-    mesh has a pipeline axis (stage > 1), the stage-sharded rule table is
-    selected automatically (parallel/pipeline.py)."""
-    rules = rules if rules is not None else _default_rules(mesh)
+    """The un-jitted single train step (loss -> grads -> optax update)."""
     tx = None
 
     def get_tx(params):
@@ -147,19 +149,83 @@ def make_train_step(
         metrics = {"loss": loss, "n_tokens": tokens, "grad_norm": grad_norm}
         return new_state, metrics
 
+    return step
+
+
+def _shardings_for(model_cfg, train_cfg, mesh, example_batch, rules):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     state_shardings = named_sharding_tree(
         mesh, state_logical_axes(model_cfg, train_cfg), rules
     )
     batch_shardings = named_sharding_tree(mesh, batch_logical_axes(example_batch), rules)
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     replicated = NamedSharding(mesh, P())
-    metric_shardings = {"loss": replicated, "n_tokens": replicated, "grad_norm": replicated}
+    metric_shardings = {
+        "loss": replicated, "n_tokens": replicated, "grad_norm": replicated
+    }
+    return state_shardings, batch_shardings, metric_shardings
 
+
+def make_train_step(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    mesh,
+    example_batch: dict[str, Any],
+    rules: dict | None = None,
+) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
+    """Build the compiled train step with explicit in/out shardings. When the
+    mesh has a pipeline axis (stage > 1), the stage-sharded rule table is
+    selected automatically (parallel/pipeline.py)."""
+    rules = rules if rules is not None else _default_rules(mesh)
+    step = _build_step_fn(model_cfg, train_cfg, mesh, rules)
+    state_sh, batch_sh, metric_sh = _shardings_for(
+        model_cfg, train_cfg, mesh, example_batch, rules
+    )
     return jax.jit(
         step,
-        in_shardings=(state_shardings, batch_shardings),
-        out_shardings=(state_shardings, metric_shardings),
+        in_shardings=(state_sh, batch_sh),
+        out_shardings=(state_sh, metric_sh),
+        donate_argnums=(0,),
+    )
+
+
+def make_multi_step(
+    model_cfg: ModelConfig,
+    train_cfg: TrainConfig,
+    mesh,
+    example_batch: dict[str, Any],
+    n_steps: int,
+    rules: dict | None = None,
+) -> Callable[[TrainState, dict], tuple[TrainState, dict]]:
+    """Compiled ``n_steps`` optimizer steps per call: a ``lax.scan`` over a
+    stacked batch window, so the device runs autonomously for the whole window
+    with zero host dispatch between steps.
+
+    Host-side per-step dispatch is pure overhead on TPU (the device idles
+    while the host round-trips; tens of ms/step through remote transports).
+    The reference's per-example host loop (ref
+    ``src/distributed_inference.py:64-69``) is the extreme version of that
+    anti-pattern. Input batches are stacked on a leading window dim
+    ``(n_steps, B, ...)``; returned metrics carry the same leading dim (the
+    caller logs the last row / aggregates)."""
+    rules = rules if rules is not None else _default_rules(mesh)
+    step = _build_step_fn(model_cfg, train_cfg, mesh, rules)
+
+    def multi(state: TrainState, batches: dict) -> tuple[TrainState, dict]:
+        return jax.lax.scan(step, state, batches)
+
+    state_sh, batch_sh, metric_sh = _shardings_for(
+        model_cfg, train_cfg, mesh, example_batch, rules
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def window(sh):
+        return jax.tree.map(lambda s: NamedSharding(mesh, P(None, *s.spec)), sh)
+
+    return jax.jit(
+        multi,
+        in_shardings=(state_sh, window(batch_sh)),
+        out_shardings=(state_sh, window(metric_sh)),
         donate_argnums=(0,),
     )
 

@@ -37,7 +37,7 @@ from ditl_tpu.runtime.mesh import build_mesh
 from ditl_tpu.train.checkpoint import CheckpointManager, DataIterState
 from ditl_tpu.train.metrics import MetricsLogger
 from ditl_tpu.train.state import TrainState, create_train_state, state_logical_axes
-from ditl_tpu.train.step import make_train_step
+from ditl_tpu.train.step import make_multi_step, make_train_step
 from ditl_tpu.utils.logging import get_logger, setup_logging
 from ditl_tpu.utils.profiling import StepProfiler
 
@@ -84,6 +84,25 @@ def _params_from_hf_checkpoint(path: str, model_cfg, current_params, param_shard
         return jax.device_put(hf_sub.astype(model_cfg.param_dtype), shard_sub)
 
     return merge(np_params, current_params, param_shardings)
+
+
+def _windows(it, size: int):
+    """Group an iterator into lists of up to ``size`` items."""
+    import itertools
+
+    while True:
+        window = list(itertools.islice(it, size))
+        if not window:
+            return
+        yield window
+
+
+def _crossed(step: int, n_advanced: int, every: int) -> bool:
+    """True if the last ``n_advanced`` steps ending at ``step`` crossed a
+    multiple of ``every`` — cadence checks that stay correct when the loop
+    advances in windows (steps_per_call > 1), where ``step % every == 0``
+    would fire only when a window boundary happens to align."""
+    return every > 0 and step > 0 and (step // every) > ((step - n_advanced) // every)
 
 
 def train(config: Config) -> dict[str, Any]:
@@ -173,8 +192,17 @@ def train(config: Config) -> dict[str, Any]:
 
     example = next(iter(pipeline.epoch(0)))
     train_step = make_train_step(model_cfg, config.train, mesh, example)
+    spc = max(1, config.train.steps_per_call)
+    train_multi = (
+        make_multi_step(model_cfg, config.train, mesh, example, spc)
+        if spc > 1
+        else None
+    )
 
-    metrics = MetricsLogger(log_every=config.train.log_every)
+    metrics = MetricsLogger(
+        log_every=config.train.log_every,
+        metrics_file=config.train.metrics_file,
+    )
     profiler = StepProfiler(
         config.train.profile_dir,
         config.train.profile_start_step,
@@ -191,26 +219,53 @@ def train(config: Config) -> dict[str, Any]:
         for epoch in range(data_iter.epoch, config.data.num_epochs):
             # Resume skips already-consumed batches at the sampler level.
             start = data_iter.step_in_epoch if epoch == data_iter.epoch else 0
-            for step_in_epoch, batch in enumerate(
-                pipeline.epoch(epoch, start_step=start), start=start
-            ):
+            batch_iter = iter(pipeline.epoch(epoch, start_step=start))
+            step_in_epoch = start
+            for window in _windows(batch_iter, spc):
                 if global_step >= total_steps:
                     break
+                window = window[: total_steps - global_step]
                 metrics.start_step()
                 profiler.maybe_start(global_step)
                 with profiler.annotate(global_step):
-                    state, step_metrics = train_step(state, batch)
-                profiler.maybe_stop(global_step)
-                metrics.end_step(global_step, step_metrics)
-                global_step += 1
-                position = DataIterState(epoch, step_in_epoch + 1, global_step)
-                if ckpt is not None and ckpt.should_save(global_step):
+                    if train_multi is not None and len(window) == spc:
+                        # One device program runs the whole window: zero host
+                        # dispatch between steps (train/step.make_multi_step).
+                        import jax.numpy as jnp
+
+                        stacked = jax.tree.map(
+                            lambda *xs: jnp.stack(xs, axis=0), *window
+                        )
+                        state, ms = train_multi(state, stacked)
+                        step_metrics = {k: v[-1] for k, v in ms.items()}
+                        window_metrics = dict(
+                            step_metrics, n_tokens=ms["n_tokens"].sum()
+                        )
+                    else:  # window shorter than spc (epoch tail): single steps
+                        window_tokens = None
+                        for batch in window:
+                            state, step_metrics = train_step(state, batch)
+                            window_tokens = (
+                                step_metrics["n_tokens"]
+                                if window_tokens is None
+                                else window_tokens + step_metrics["n_tokens"]
+                            )
+                        window_metrics = dict(step_metrics, n_tokens=window_tokens)
+                profiler.maybe_stop(global_step + len(window) - 1)
+                global_step += len(window)
+                step_in_epoch += len(window)
+                metrics.end_step(
+                    global_step - 1, window_metrics, n_steps=len(window)
+                )
+                position = DataIterState(epoch, step_in_epoch, global_step)
+                if (
+                    ckpt is not None
+                    and ckpt.save_every > 0
+                    and _crossed(global_step, len(window), ckpt.save_every)
+                ):
                     ckpt.save(global_step, state, position)
                     last_saved = global_step
-                if (
-                    config.train.eval_every
-                    and global_step % config.train.eval_every == 0
-                ):
+                if _crossed(global_step, len(window), config.train.eval_every):
                     idx = np.arange(min(config.train.eval_samples, len(dataset)))
                     run_api_eval(
                         client,
@@ -225,6 +280,7 @@ def train(config: Config) -> dict[str, Any]:
             ckpt.save(global_step, state, DataIterState(epoch, 0, global_step))
             ckpt.wait()
     finally:
+        metrics.close()
         profiler.close()
         if ckpt is not None:
             ckpt.close()

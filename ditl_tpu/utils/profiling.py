@@ -69,6 +69,11 @@ class StepProfiler:
         return contextlib.nullcontext()
 
     def maybe_stop(self, step: int) -> None:
+        """``step`` is the LAST completed step since ``maybe_start`` — with
+        step windows (train.steps_per_call > 1) the caller passes the window's
+        last step, so the trace covers whole windows (rounding the configured
+        step count up to a window boundary, never running a full extra
+        window)."""
         if self._active and step >= self._stop_after:
             # Block until device work from the traced steps has finished so
             # the trace actually contains the device timeline.

@@ -109,3 +109,34 @@ def test_trainer_resume_continues_from_checkpoint(tmp_path):
     second = train(cfg(8))
     # Resumed from step 4: only 4 more steps were run in the second call.
     assert second["steps"] == 8
+
+
+def test_checkpoint_cadence_with_step_windows(tmp_path):
+    """steps_per_call misaligned with checkpoint_every must still checkpoint
+    every time a save boundary is crossed (not only on exact multiples)."""
+    from ditl_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from ditl_tpu.train.checkpoint import CheckpointManager
+    from ditl_tpu.train.trainer import train
+
+    out = train(
+        Config(
+            model=ModelConfig(
+                vocab_size=512, hidden_size=64, intermediate_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                max_seq_len=64,
+            ),
+            data=DataConfig(synthetic=True, synthetic_examples=256, batch_size=8,
+                            seq_len=32, num_epochs=2),
+            train=TrainConfig(
+                total_steps=12, warmup_steps=1, log_every=100,
+                steps_per_call=4,
+                checkpoint_dir=str(tmp_path), checkpoint_every=6,
+                keep_checkpoints=10,
+            ),
+        )
+    )
+    assert out["steps"] == 12
+    mgr = CheckpointManager(str(tmp_path))
+    # Boundaries crossed: step 6 (inside window ending at 8) and step 12.
+    assert len(list(mgr._mgr.all_steps())) >= 2
+    mgr.close()

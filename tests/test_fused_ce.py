@@ -7,6 +7,7 @@ in value and in gradients — it is a memory-layout change, not a math change.
 import dataclasses
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
 import numpy as np
 import pytest

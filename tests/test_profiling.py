@@ -65,3 +65,33 @@ def test_trainer_profile_config_end_to_end(tmp_path):
     assert summary["steps"] == 5
     traces = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
     assert traces, "trainer did not write a profiler trace"
+
+
+def test_metrics_jsonl_stream(tmp_path):
+    """train.metrics_file writes a tail-able JSONL scalar stream."""
+    import json
+
+    import numpy as np
+
+    from ditl_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from ditl_tpu.train.trainer import train
+
+    out = train(
+        Config(
+            model=ModelConfig(
+                vocab_size=512, hidden_size=64, intermediate_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                max_seq_len=64,
+            ),
+            data=DataConfig(synthetic=True, synthetic_examples=64, batch_size=8,
+                            seq_len=32, num_epochs=1),
+            train=TrainConfig(total_steps=4, warmup_steps=1, log_every=2,
+                              metrics_file=str(tmp_path / "metrics.jsonl")),
+        )
+    )
+    assert out["steps"] == 4
+    lines = [json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert lines, "no metrics rows written"
+    for row in lines:
+        assert {"step", "loss", "step_time_s", "tokens_per_sec_per_chip"} <= row.keys()
+        assert np.isfinite(row["loss"])

@@ -4,6 +4,7 @@ sharded-state layouts on the 8-device mesh."""
 import dataclasses
 
 import jax
+import jax.flatten_util
 import numpy as np
 import pytest
 
@@ -162,3 +163,36 @@ def test_train_step_attention_impls(tiny_model_cfg):
         assert np.isfinite(losses[impl]), impl
     np.testing.assert_allclose(losses["flash"], losses["xla"], rtol=1e-4)
     np.testing.assert_allclose(losses["ring"], losses["xla"], rtol=1e-4)
+
+
+def test_multi_step_matches_single_steps(tiny_model_cfg, example_batch):
+    """K steps inside one compiled scan == K sequential single-step calls."""
+    import jax.numpy as jnp
+
+    from ditl_tpu.train.step import make_multi_step
+
+    cfg = dataclasses.replace(tiny_model_cfg, dtype="float32", param_dtype="float32")
+    mesh, state, gb, step = _setup(cfg, example_batch)
+    k = 3
+    # K distinct batches: rotate the example batch so steps differ.
+    hosts = []
+    for i in range(k):
+        hb = {kk: np.roll(v, i, axis=0) for kk, v in example_batch.items()}
+        hosts.append(make_global_batch(mesh, hb))
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs, axis=0), *hosts)
+
+    s_ref = state
+    for i in range(k):
+        s_ref, m_ref = step(s_ref, hosts[i])
+
+    tcfg = TrainConfig(total_steps=20, warmup_steps=2, learning_rate=1e-3)
+    s2 = create_train_state(jax.random.key(0), cfg, tcfg)
+    multi = make_multi_step(cfg, tcfg, mesh, hosts[0], k)
+    s2, ms = multi(s2, stacked)
+
+    assert int(s2.step) == int(s_ref.step) == k
+    assert ms["loss"].shape == (k,)
+    np.testing.assert_allclose(float(ms["loss"][-1]), float(m_ref["loss"]), rtol=1e-5)
+    ref_flat, _ = jax.flatten_util.ravel_pytree(s_ref.params)
+    got_flat, _ = jax.flatten_util.ravel_pytree(s2.params)
+    np.testing.assert_allclose(np.asarray(got_flat), np.asarray(ref_flat), rtol=1e-4, atol=1e-6)
