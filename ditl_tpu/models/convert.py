@@ -28,7 +28,13 @@ import numpy as np
 
 from ditl_tpu.config import ModelConfig
 
-__all__ = ["config_from_hf", "params_from_state_dict", "load_hf_model"]
+__all__ = [
+    "config_from_hf",
+    "params_from_state_dict",
+    "state_dict_from_params",
+    "load_hf_model",
+    "export_hf_model",
+]
 
 
 def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
@@ -154,6 +160,93 @@ def params_from_state_dict(
     if not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": cast(_np(sd["lm_head.weight"]).T)}
     return params
+
+
+def state_dict_from_params(params: Mapping[str, Any], cfg: ModelConfig) -> dict[str, np.ndarray]:
+    """Inverse of ``params_from_state_dict``: param pytree -> HF state dict
+    (numpy, f32) — so a TPU fine-tune can be served by any HF-stack consumer.
+    LoRA adapters, if present, must be merged into the base weights first
+    (models/lora.py ``merge_lora``); they have no HF-side representation here."""
+
+    def host(x) -> np.ndarray:
+        return np.asarray(x, np.float32)
+
+    L = cfg.num_layers
+    layers = params["layers"]
+    if "lora" in layers:
+        raise ValueError(
+            "param tree still carries LoRA adapters — exporting would silently "
+            "drop the fine-tune (base weights are frozen under LoRA). Call "
+            "models.lora.merge_lora(params, cfg) first."
+        )
+    sd: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": host(params["embed"]["embedding"]),
+        "model.norm.weight": host(params["final_norm"]["scale"]),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}"
+        sd[f"{p}.input_layernorm.weight"] = host(layers["attn_norm"]["scale"][i])
+        sd[f"{p}.post_attention_layernorm.weight"] = host(layers["mlp_norm"]["scale"][i])
+        for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
+            sd[f"{p}.self_attn.{theirs}.weight"] = host(layers["attn"][ours][i]).T
+        if cfg.num_experts > 0:
+            moe = layers["moe"]
+            sd[f"{p}.block_sparse_moe.gate.weight"] = host(moe["router"][i]).T
+            for j in range(cfg.num_experts):
+                q = f"{p}.block_sparse_moe.experts.{j}"
+                sd[f"{q}.w1.weight"] = host(moe["w_gate"][i, j]).T
+                sd[f"{q}.w3.weight"] = host(moe["w_up"][i, j]).T
+                sd[f"{q}.w2.weight"] = host(moe["w_down"][i, j]).T
+        else:
+            mlp = layers["mlp"]
+            sd[f"{p}.mlp.gate_proj.weight"] = host(mlp["w_gate"][i]).T
+            sd[f"{p}.mlp.up_proj.weight"] = host(mlp["w_up"][i]).T
+            sd[f"{p}.mlp.down_proj.weight"] = host(mlp["w_down"][i]).T
+    if not cfg.tie_embeddings:
+        sd["lm_head.weight"] = host(params["lm_head"]["kernel"]).T
+    return sd
+
+
+def export_hf_model(params: Mapping[str, Any], cfg: ModelConfig, path: str) -> None:
+    """Write a ``transformers``-loadable checkpoint directory from a param
+    pytree (the serve-anywhere exit path the reference's API-only design never
+    needed — its model lived behind someone else's server)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM, MixtralConfig, MixtralForCausalLM
+
+    common = dict(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        max_position_embeddings=cfg.max_seq_len,
+        rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rope_theta,
+        tie_word_embeddings=cfg.tie_embeddings,
+    )
+    if cfg.num_experts > 0:
+        hf_cfg = MixtralConfig(
+            num_local_experts=cfg.num_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            **common,
+        )
+        model = MixtralForCausalLM(hf_cfg)
+    else:
+        hf_cfg = LlamaConfig(attention_bias=False, mlp_bias=False, **common)
+        model = LlamaForCausalLM(hf_cfg)
+    sd = {k: torch.from_numpy(v) for k, v in state_dict_from_params(params, cfg).items()}
+    missing, unexpected = model.load_state_dict(sd, strict=False)
+    # Tied-embedding models have no lm_head entry; anything else missing is a bug.
+    real_missing = [m for m in missing if not (cfg.tie_embeddings and "lm_head" in m)]
+    if real_missing or unexpected:
+        raise ValueError(
+            f"state dict mismatch exporting to HF: missing={real_missing} "
+            f"unexpected={unexpected}"
+        )
+    model.save_pretrained(path)
 
 
 def load_hf_model(model_or_path: Any, **config_overrides):

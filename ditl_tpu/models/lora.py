@@ -24,7 +24,13 @@ from ditl_tpu.config import ModelConfig
 # Base-projection names that receive adapters (classic attention-only LoRA).
 LORA_TARGETS = ("wq", "wv")
 
-__all__ = ["LORA_TARGETS", "init_lora_params", "lora_logical_axes", "lora_delta"]
+__all__ = [
+    "LORA_TARGETS",
+    "init_lora_params",
+    "lora_logical_axes",
+    "lora_delta",
+    "merge_lora",
+]
 
 
 def _target_out_dim(name: str, cfg: ModelConfig) -> int:
@@ -68,3 +74,27 @@ def lora_delta(p: dict[str, Any], h: jax.Array, cfg: ModelConfig) -> jax.Array:
     return scale * jnp.einsum(
         "bsr,rf->bsf", low, p["b"].astype(cd), preferred_element_type=cd
     )
+
+
+def merge_lora(params: dict[str, Any], cfg: ModelConfig) -> dict[str, Any]:
+    """Fold adapters into the base weights: W' = W + (alpha/r)·A@B per layer.
+
+    Returns a new param tree with no ``lora`` subtree — loadable by a
+    ``lora_rank=0`` config and exportable to HF (models/convert.py). The
+    merged model computes exactly what the adapted model computed (same
+    identity ``h@W + Δ(h) = h@(W + (alpha/r)A@B)``)."""
+    lora = params["layers"].get("lora")
+    if lora is None:
+        return params
+    scale = cfg.lora_alpha / cfg.lora_rank
+    new_layers = {k: v for k, v in params["layers"].items() if k != "lora"}
+    attn = dict(new_layers["attn"])
+    for name, p in lora.items():
+        delta = scale * jnp.einsum(
+            "ldr,lrf->ldf",
+            p["a"].astype(jnp.float32),
+            p["b"].astype(jnp.float32),
+        )
+        attn[name] = (attn[name].astype(jnp.float32) + delta).astype(attn[name].dtype)
+    new_layers["attn"] = attn
+    return {**params, "layers": new_layers}

@@ -46,8 +46,16 @@ class CheckpointManager:
             ),
         )
 
-    def should_save(self, step: int) -> bool:
-        return self.save_every > 0 and step > 0 and step % self.save_every == 0
+    def should_save(self, step: int, n_advanced: int = 1) -> bool:
+        """True if the last ``n_advanced`` steps ending at ``step`` crossed a
+        save boundary — stays correct when the trainer advances in compiled
+        step windows (train.steps_per_call > 1), where an exact-multiple check
+        would only fire on aligned window boundaries."""
+        return (
+            self.save_every > 0
+            and step > 0
+            and (step // self.save_every) > ((step - n_advanced) // self.save_every)
+        )
 
     def save(self, step: int, state: Any, data_iter: DataIterState) -> None:
         import orbax.checkpoint as ocp

@@ -41,7 +41,7 @@ class MetricsLogger:
         self.step_times: list[float] = []
         self.tokens_per_sec_chip: list[float] = []
         self._last_t: float | None = None
-        self._pending: list[tuple[int, Any]] = []
+        self._pending: list[tuple[int, Any, int]] = []  # (step, metrics, n_steps)
         self._metrics_fh = None
         if metrics_file and is_coordinator():
             self._metrics_fh = open(metrics_file, "a", buffering=1)

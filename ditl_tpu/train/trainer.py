@@ -258,11 +258,7 @@ def train(config: Config) -> dict[str, Any]:
                     global_step - 1, window_metrics, n_steps=len(window)
                 )
                 position = DataIterState(epoch, step_in_epoch, global_step)
-                if (
-                    ckpt is not None
-                    and ckpt.save_every > 0
-                    and _crossed(global_step, len(window), ckpt.save_every)
-                ):
+                if ckpt is not None and ckpt.should_save(global_step, len(window)):
                     ckpt.save(global_step, state, position)
                     last_saved = global_step
                 if _crossed(global_step, len(window), config.train.eval_every):

@@ -173,3 +173,78 @@ def test_trainer_init_from_hf_with_lora(tmp_path):
                                   init_from_hf=str(tmp_path / "hf")),
             )
         )
+
+
+def test_export_roundtrip(tmp_path):
+    """params -> HF export dir -> from_pretrained -> logits parity."""
+    import dataclasses
+
+    import jax
+
+    from ditl_tpu.models.convert import export_hf_model, load_hf_model
+
+    cfg0 = config_from_hf(_tiny_hf_llama().config, dtype="float32")
+    params = llama.init_params(jax.random.key(7), cfg0)
+    export_hf_model(params, cfg0, str(tmp_path / "export"))
+
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(tmp_path / "export"), local_files_only=True
+    ).eval()
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, 256, size=(2, 16)).astype(np.int64)
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(ids, jnp.int32), cfg0))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+    # Round-trip through load_hf_model reproduces the exact same tree.
+    params2, cfg2 = load_hf_model(str(tmp_path / "export"))
+    flat1 = jax.tree_util.tree_leaves_with_path(params)
+    flat2 = dict(
+        (jax.tree_util.keystr(p), a) for p, a in jax.tree_util.tree_leaves_with_path(params2)
+    )
+    for path, leaf in flat1:
+        np.testing.assert_allclose(
+            np.asarray(leaf), flat2[jax.tree_util.keystr(path)], rtol=1e-6, atol=1e-6
+        )
+
+
+def test_merge_lora_preserves_function():
+    """Merged W + (alpha/r)AB computes exactly the adapted model's logits."""
+    import dataclasses
+
+    import jax
+
+    from ditl_tpu.models.lora import merge_lora
+
+    base_cfg = config_from_hf(_tiny_hf_llama().config, dtype="float32")
+    lora_cfg = dataclasses.replace(base_cfg, lora_rank=4)
+    params = llama.init_params(jax.random.key(5), lora_cfg)
+    # Give B nonzero values so the adapters actually do something.
+    params["layers"]["lora"] = jax.tree.map(
+        lambda x: x + 0.01, params["layers"]["lora"]
+    )
+    ids = jnp.asarray(np.random.default_rng(4).integers(0, 256, size=(2, 16)), jnp.int32)
+    adapted = llama.forward(params, ids, lora_cfg)
+
+    merged = merge_lora(params, lora_cfg)
+    assert "lora" not in merged["layers"]
+    merged_logits = llama.forward(merged, ids, base_cfg)
+    np.testing.assert_allclose(
+        np.asarray(merged_logits), np.asarray(adapted), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_export_rejects_unmerged_lora():
+    import dataclasses
+
+    from ditl_tpu.models.convert import state_dict_from_params
+
+    cfg = dataclasses.replace(
+        config_from_hf(_tiny_hf_llama().config, dtype="float32"), lora_rank=4
+    )
+    import jax
+
+    params = llama.init_params(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="merge_lora"):
+        state_dict_from_params(params, cfg)
