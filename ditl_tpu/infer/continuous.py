@@ -1,0 +1,377 @@
+"""Continuous batching: slot-based decoding where requests join and leave
+between decode ticks.
+
+The reference's "serving" story is one blocking HTTP call per example to
+someone else's server (ref ``src/distributed_inference.py:34-41,69``); the
+batch Generator (infer/engine.py) already beats that, but it decodes a fixed
+batch in lock-step — a long request stalls the whole batch, and new requests
+wait for the batch to drain. This engine removes both limits the TPU way:
+
+- **Fixed-shape slot state**: ``n_slots`` sequences decode together; every
+  array (cache, positions, tokens) has a static shape, so exactly TWO
+  programs compile — one prefill per prompt-length bucket, one decode tick.
+- **Per-slot depth**: each slot sits at its own position; the cache write is
+  a per-row scatter (models/llama.py ``_scatter_rows``) and the attention
+  mask is ``slot_index <= pos[row]`` — no re-padding, no re-batching.
+- **Prefill into a slot**: a new prompt runs one batched forward over its
+  length bucket against a 1-row slice of the shared cache, then the slice is
+  written back at the slot index. Other slots' state is untouched, so
+  admission never disturbs in-flight decodes.
+- **Chunked ticks**: decode runs ``decode_chunk`` steps per program call
+  (a ``lax.scan``; zero host round-trips inside), then the host harvests
+  finished slots, trims at EOS, and admits queued requests.
+
+The scheduler (``submit``/``step``/``run``) is deliberately host-side and
+simple — admission policy is not a TPU problem. Per-request sampling params
+are supported for temperature 0/>0 mixtures by keeping sampling greedy when
+``temperature == 0`` per-slot (a (B,) vector fed to the tick program).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.data.tokenizer import Tokenizer
+from ditl_tpu.infer.cache import init_cache
+from ditl_tpu.infer.engine import GenerateConfig, _next_pow2
+from ditl_tpu.infer.sampling import sample_logits
+from ditl_tpu.models import llama
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["ContinuousEngine", "Request", "ThreadedEngine"]
+
+
+@dataclass
+class Request:
+    """One in-flight generation request (host bookkeeping)."""
+
+    req_id: int
+    prompt: list[int]
+    max_new_tokens: int
+    temperature: float
+    top_p: float
+    seed: int
+    tokens: list[int] = field(default_factory=list)
+    slot: int | None = None
+    finished: bool = False
+
+
+class ContinuousEngine:
+    """Slot-based continuous-batching text generation."""
+
+    def __init__(
+        self,
+        params: llama.Params,
+        model_cfg: ModelConfig,
+        tokenizer: Tokenizer,
+        *,
+        n_slots: int = 8,
+        decode_chunk: int = 16,
+        gen: GenerateConfig | None = None,
+        seed: int = 0,
+    ):
+        self.params = params
+        self.cfg = model_cfg
+        self.tokenizer = tokenizer
+        self.n_slots = n_slots
+        self.decode_chunk = decode_chunk
+        self.gen = gen or GenerateConfig()
+        self.smax = model_cfg.max_seq_len
+
+        self.cache = init_cache(model_cfg, n_slots, self.smax)
+        self.cur = jnp.full((n_slots,), tokenizer.pad_id, jnp.int32)
+        self.pos = jnp.zeros((n_slots,), jnp.int32)
+        self.temps = jnp.zeros((n_slots,), jnp.float32)
+        self.top_ps = jnp.ones((n_slots,), jnp.float32)
+        # One PRNG stream per slot: per-request seeds stay reproducible no
+        # matter which other requests share the batch.
+        self.keys = jax.vmap(jax.random.key)(jnp.arange(n_slots, dtype=jnp.uint32))
+        self._base_seed = seed
+
+        self._slots: list[Request | None] = [None] * n_slots
+        self._queue: list[Request] = []
+        self._completed: dict[int, Request] = {}
+        self._next_id = 0
+        self._prefill_cache: dict[int, Any] = {}
+        self._decode = self._build_decode()
+
+    # -- compiled programs --------------------------------------------------
+
+    def _build_prefill(self, p_bucket: int):
+        cfg, smax = self.cfg, self.smax
+        slots_iota = jnp.arange(smax, dtype=jnp.int32)
+
+        def run(params, cache, ids, length, slot, temp, top_p, rng):
+            # 1-row view of the shared cache: prefill never touches other slots.
+            row = jax.tree.map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), cache
+            )
+            q_pos = jnp.arange(p_bucket, dtype=jnp.int32)
+            mask = (slots_iota[None, None, :] <= q_pos[None, :, None]) & (
+                slots_iota[None, None, :] < length
+            )
+            logits, row = llama.forward(
+                params,
+                ids,
+                cfg,
+                positions=q_pos[None],
+                cache=row,
+                cache_index=jnp.int32(0),
+                attn_mask=mask,
+            )
+            cache = jax.tree.map(
+                lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
+                cache,
+                row,
+            )
+            last = logits[0, length - 1]
+            first = sample_logits(
+                last[None], rng, temperature=temp,
+                top_k=self.gen.top_k, top_p=top_p,
+            )[0]
+            return cache, first
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _build_decode(self):
+        cfg, smax, pad, eos = self.cfg, self.smax, self.tokenizer.pad_id, self.tokenizer.eos_id
+        slots_iota = jnp.arange(smax, dtype=jnp.int32)
+        chunk = self.decode_chunk
+
+        def run(params, cache, cur, pos, alive, temps, top_ps, keys):
+            def body(carry, _):
+                cache, cur, pos, done, keys = carry
+                split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+                keys, subs = split[:, 0], split[:, 1]
+                mask = (slots_iota[None, :] <= pos[:, None])[:, None, :]  # (B,1,Smax)
+                logits, cache = llama.forward(
+                    params,
+                    cur[:, None],
+                    cfg,
+                    positions=pos[:, None],
+                    cache=cache,
+                    cache_index=pos,
+                    attn_mask=mask,
+                )
+                nxt = sample_logits(
+                    logits[:, 0], subs, temperature=temps,
+                    top_k=self.gen.top_k, top_p=top_ps,
+                )
+                step_alive = ~done
+                emit = jnp.where(step_alive, cur, pad)
+                done = done | (cur == eos)
+                pos = jnp.where(step_alive, jnp.minimum(pos + 1, smax - 1), pos)
+                cur = jnp.where(done, pad, nxt)
+                return (cache, cur, pos, done, keys), emit
+
+            (cache, cur, pos, done, keys), toks = jax.lax.scan(
+                body, (cache, cur, pos, ~alive, keys), None, length=chunk
+            )
+            return cache, cur, pos, keys, toks.T  # toks: (B, chunk)
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    # -- scheduler ----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_tokens: list[int],
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+    ) -> int:
+        """Queue a request; returns its id (see ``results``/``run``)."""
+        gen = self.gen
+        max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
+        prompt = prompt_tokens or [self.tokenizer.bos_id]
+        if len(prompt) + max_new > self.smax:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new} exceeds max_seq_len {self.smax}"
+            )
+        req = Request(
+            req_id=self._next_id,
+            prompt=list(prompt),
+            max_new_tokens=max_new,
+            temperature=gen.temperature if temperature is None else temperature,
+            top_p=gen.top_p if top_p is None else top_p,
+            seed=(self._base_seed + self._next_id) if seed is None else seed,
+        )
+        self._next_id += 1
+        self._queue.append(req)
+        return req.req_id
+
+    def _admit(self) -> None:
+        for slot in range(self.n_slots):
+            if self._slots[slot] is not None or not self._queue:
+                continue
+            req = self._queue.pop(0)
+            p_bucket = _next_pow2(len(req.prompt), floor=16)
+            p_bucket = min(p_bucket, self.smax)
+            if p_bucket not in self._prefill_cache:
+                logger.info("compiling prefill program for bucket %d", p_bucket)
+                self._prefill_cache[p_bucket] = self._build_prefill(p_bucket)
+            ids = np.full((1, p_bucket), self.tokenizer.pad_id, np.int32)
+            ids[0, : len(req.prompt)] = req.prompt
+            slot_key = jax.random.key(req.seed)
+            slot_key, sub = jax.random.split(slot_key)
+            self.cache, first = self._prefill_cache[p_bucket](
+                self.params,
+                self.cache,
+                jnp.asarray(ids),
+                jnp.int32(len(req.prompt)),
+                jnp.int32(slot),
+                jnp.float32(req.temperature),
+                jnp.float32(req.top_p),
+                sub,
+            )
+            req.slot = slot
+            self._slots[slot] = req
+            self.cur = self.cur.at[slot].set(first)
+            self.pos = self.pos.at[slot].set(len(req.prompt))
+            self.temps = self.temps.at[slot].set(req.temperature)
+            self.top_ps = self.top_ps.at[slot].set(req.top_p)
+            self.keys = self.keys.at[slot].set(slot_key)
+
+    def _harvest(self, emitted: np.ndarray) -> None:
+        eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
+        for slot, req in enumerate(self._slots):
+            if req is None:
+                continue
+            for tok in emitted[slot]:
+                tok = int(tok)
+                if tok in (eos, pad) or len(req.tokens) >= req.max_new_tokens:
+                    req.finished = True
+                    break
+                req.tokens.append(tok)
+            if len(req.tokens) >= req.max_new_tokens:
+                req.finished = True
+            if req.finished:
+                self._completed[req.req_id] = req
+                self._slots[slot] = None
+
+    def step(self) -> None:
+        """One scheduler tick: admit queued requests, decode one chunk."""
+        self._admit()
+        occupied = [r is not None for r in self._slots]
+        if not any(occupied):  # host-side check: no device sync on idle ticks
+            return
+        alive = jnp.asarray(occupied, bool)
+        self.cache, self.cur, self.pos, self.keys, toks = self._decode(
+            self.params, self.cache, self.cur, self.pos, alive,
+            self.temps, self.top_ps, self.keys,
+        )
+        self._harvest(np.asarray(jax.device_get(toks)))
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + sum(r is not None for r in self._slots)
+
+    def run(self) -> dict[int, list[int]]:
+        """Drive until all submitted requests complete; token lists by id."""
+        while self.pending:
+            self.step()
+        return {rid: req.tokens for rid, req in sorted(self._completed.items())}
+
+    def generate(self, prompts: list[str], **submit_kw) -> list[str]:
+        """Text in, text out (convenience parity with engine.Generator)."""
+        ids = [
+            self.submit([self.tokenizer.bos_id] + self.tokenizer.encode(p), **submit_kw)
+            for p in prompts
+        ]
+        results = self.run()
+        return [self.tokenizer.decode(results[i]) for i in ids]
+
+    def take_result(self, req_id: int) -> list[int] | None:
+        """Pop a finished request's tokens, or None if still in flight."""
+        req = self._completed.pop(req_id, None)
+        return None if req is None else req.tokens
+
+
+class ThreadedEngine:
+    """Thread-safe front for ``ContinuousEngine``: HTTP handler threads
+    submit and block on their own request while one background driver thread
+    ticks the engine — concurrent requests share decode ticks (true
+    continuous batching across connections), unlike the lock-step server
+    path where each request runs the device exclusively."""
+
+    def __init__(self, engine: ContinuousEngine):
+        import threading
+
+        self._engine = engine
+        self._cond = threading.Condition()
+        self._results: dict[int, list[int]] = {}
+        self._error: BaseException | None = None
+        self._stop = False
+        self._thread = threading.Thread(target=self._drive, daemon=True)
+        self._thread.start()
+
+    @property
+    def tokenizer(self) -> Tokenizer:
+        return self._engine.tokenizer
+
+    def _drive(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and self._engine.pending == 0:
+                    self._cond.wait(timeout=0.05)
+                if self._stop:
+                    return
+                try:
+                    self._engine.step()
+                except BaseException as e:  # device/compile errors must not
+                    # wedge the server: fail every waiter loudly and stop.
+                    logger.exception("continuous engine driver died")
+                    self._error = e
+                    self._stop = True
+                    self._cond.notify_all()
+                    return
+                for rid in list(self._engine._completed):
+                    self._results[rid] = self._engine.take_result(rid)
+                self._cond.notify_all()
+
+    def generate_one(
+        self,
+        prompt_tokens: list[int],
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+    ) -> list[int]:
+        """Submit one request and block until it completes. Raises if the
+        driver has stopped (shutdown or device error) — callers turn that
+        into an HTTP 500 instead of hanging the connection."""
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("continuous engine is stopped") from self._error
+            rid = self._engine.submit(
+                prompt_tokens,
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                seed=seed,
+            )
+            self._cond.notify_all()
+            while rid not in self._results:
+                if self._stop:
+                    raise RuntimeError(
+                        "continuous engine stopped mid-request"
+                    ) from self._error
+                self._cond.wait()
+            return self._results.pop(rid)
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5)

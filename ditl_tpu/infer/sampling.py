@@ -20,12 +20,15 @@ def _apply_top_k(logits: jax.Array, k: int) -> jax.Array:
     return jnp.where(logits < kth, -jnp.inf, logits)
 
 
-def _apply_top_p(logits: jax.Array, p: float) -> jax.Array:
+def _apply_top_p(logits: jax.Array, p) -> jax.Array:
     """Nucleus sampling: keep the smallest prefix of the sorted distribution
-    whose cumulative probability exceeds ``p`` (always keeping the top token)."""
+    whose cumulative probability exceeds ``p`` (always keeping the top token).
+    ``p`` may be a float or a per-row (B,) array."""
     sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]  # descending
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
+    if not isinstance(p, (int, float)):
+        p = jnp.asarray(p, jnp.float32)[..., None]
     # Token i is kept if the cumulative mass *before* it is still < p.
     keep_sorted = (cum - probs) < p
     # Threshold = smallest kept logit; everything below it is masked.
@@ -45,16 +48,35 @@ def sample_logits(
 ) -> jax.Array:
     """(B, V) float logits -> (B,) int32 token ids.
 
-    ``temperature == 0`` is greedy argmax; otherwise logits are scaled by
-    1/temperature, optionally truncated by top-k and/or top-p, and sampled
-    with ``jax.random.categorical``.
+    ``temperature`` may be a static float (``0`` compiles to pure greedy
+    argmax) or a traced (B,) array — per-row temperatures for continuous
+    batching, where rows with ``temperature <= 0`` are greedy and the rest
+    sample; both paths are computed and selected with ``where`` (static
+    shapes, no data-dependent control flow).
     """
     logits = logits.astype(jnp.float32)
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits / temperature
+    if isinstance(temperature, (int, float)):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k > 0:
+            logits = _apply_top_k(logits, min(top_k, logits.shape[-1]))
+        if top_p < 1.0:
+            logits = _apply_top_p(logits, top_p)
+        return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+    temperature = jnp.asarray(temperature, jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[..., None]
     if top_k > 0:
-        logits = _apply_top_k(logits, min(top_k, logits.shape[-1]))
-    if top_p < 1.0:
-        logits = _apply_top_p(logits, top_p)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+        scaled = _apply_top_k(scaled, min(top_k, logits.shape[-1]))
+    per_row_p = not isinstance(top_p, (int, float))
+    if per_row_p or top_p < 1.0:
+        scaled = _apply_top_p(scaled, top_p)
+    if rng.ndim >= 1:  # per-row keys (continuous batching: per-request seeds)
+        sampled = jax.vmap(
+            lambda k, row: jax.random.categorical(k, row).astype(jnp.int32)
+        )(rng, scaled)
+    else:
+        sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -7,10 +7,14 @@ completions`` + ``/v1/completions`` server backed by the KV-cache Generator,
 so the framework's own L4 client (client/llm.py) — or litellm, or the openai
 SDK — can evaluate against a model running on *this* TPU.
 
-Threading model: stdlib ``ThreadingHTTPServer`` accepts concurrently; a lock
-serializes device work (one XLA program at a time per chip — queueing at the
-device is the natural batching point; request batching across connections is
-future work and noted in README).
+Threading model: stdlib ``ThreadingHTTPServer`` accepts concurrently. Two
+engines (``--engine``):
+
+- ``lockstep`` (default): a lock serializes device work; each request runs
+  the batch Generator exclusively.
+- ``continuous``: requests from all connections share slot-based decode
+  ticks (infer/continuous.py) — concurrent requests batch on the device
+  automatically, and a long generation no longer blocks short ones.
 
 CLI (any host of a pod; serving is process-0-gated):
 
@@ -45,6 +49,7 @@ def _chat_prompt(messages: list[dict]) -> str:
 
 class _Handler(BaseHTTPRequestHandler):
     generator: Generator = None  # injected by make_server
+    threaded_engine = None  # ContinuousEngine driver; None => lockstep path
     model_name: str = "ditl-tpu"
     device_lock: threading.Lock = None
     default_max_tokens: int = 64
@@ -111,9 +116,20 @@ class _Handler(BaseHTTPRequestHandler):
                 seed=int(seed),
             )
             t0 = time.time()
-            with self.device_lock:
-                text = self.generator.generate([prompt], gen)[0]
-            tok = self.generator.tokenizer
+            if self.threaded_engine is not None:
+                tok = self.threaded_engine.tokenizer
+                out = self.threaded_engine.generate_one(
+                    [tok.bos_id] + tok.encode(prompt),
+                    max_new_tokens=gen.max_new_tokens,
+                    temperature=gen.temperature,
+                    top_p=gen.top_p,
+                    seed=gen.seed,
+                )
+                text = tok.decode(out)
+            else:
+                with self.device_lock:
+                    text = self.generator.generate([prompt], gen)[0]
+                tok = self.generator.tokenizer
             n_prompt = len(tok.encode(prompt)) + 1
             n_out = len(tok.encode(text))
             kind = "chat.completion" if chat else "text_completion"
@@ -154,13 +170,17 @@ def make_server(
     port: int = 8300,
     model_name: str = "ditl-tpu",
     default_max_tokens: int = 64,
+    threaded_engine=None,
 ) -> ThreadingHTTPServer:
-    """Build (not start) the HTTP server — tests drive it on a thread."""
+    """Build (not start) the HTTP server — tests drive it on a thread.
+    Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
+    continuous batching instead of the lock-step Generator."""
     handler = type(
         "BoundHandler",
         (_Handler,),
         {
             "generator": generator,
+            "threaded_engine": threaded_engine,
             "model_name": model_name,
             "device_lock": threading.Lock(),
             "default_max_tokens": default_max_tokens,
@@ -183,6 +203,11 @@ def serve(argv: list[str] | None = None) -> int:
     parser.add_argument("--tokenizer", default="byte")
     parser.add_argument("--checkpoint-dir", default="")
     parser.add_argument("--max-tokens", type=int, default=64)
+    parser.add_argument(
+        "--engine", choices=("lockstep", "continuous"), default="lockstep"
+    )
+    parser.add_argument("--slots", type=int, default=8,
+                        help="decode slots for --engine continuous")
     args = parser.parse_args(argv)
 
     if jax.process_index() != 0:
@@ -205,17 +230,26 @@ def serve(argv: list[str] | None = None) -> int:
             logger.info("restored params from %s", args.checkpoint_dir)
         ckpt.close()
     generator = Generator(params, cfg, tokenizer)
+    threaded = None
+    if args.engine == "continuous":
+        from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
+
+        threaded = ThreadedEngine(
+            ContinuousEngine(params, cfg, tokenizer, n_slots=args.slots)
+        )
     server = make_server(
         generator, host=args.host, port=args.port, model_name=cfg.name,
-        default_max_tokens=args.max_tokens,
+        default_max_tokens=args.max_tokens, threaded_engine=threaded,
     )
-    logger.info("serving %s on %s:%d", cfg.name, args.host, args.port)
+    logger.info("serving %s (%s) on %s:%d", cfg.name, args.engine, args.host, args.port)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
         server.shutdown()
+        if threaded is not None:
+            threaded.close()
     return 0
 
 

@@ -183,6 +183,16 @@ def _constrain(x: jax.Array, logical_axes, mesh, rules):
     )
 
 
+def _scatter_rows(cache: jax.Array, chunk: jax.Array, idx: jax.Array) -> jax.Array:
+    """Write ``chunk`` (B, S, K, D) into ``cache`` (B, Smax, K, D) at per-row
+    slot offsets ``idx`` (B,). Used by the continuous-batching decode path
+    where each sequence sits at a different depth."""
+    b, s = chunk.shape[:2]
+    rows = jnp.arange(b, dtype=jnp.int32)[:, None]  # (B, 1)
+    cols = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # (B, S)
+    return cache.at[rows, cols].set(chunk.astype(cache.dtype))
+
+
 def _apply_remat(layer_fn, cfg: ModelConfig):
     """Wrap a layer body with the configured rematerialization policy."""
     if cfg.remat == "full":
@@ -252,12 +262,18 @@ def _decoder_layer(
     if layer_cache is not None:
         k_cache, v_cache = layer_cache
         idx = jnp.asarray(cache_index, jnp.int32)
-        k_full = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0)
-        )
-        v_full = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0)
-        )
+        if idx.ndim == 1:
+            # Per-slot write position (continuous batching: every sequence is
+            # at a different decode depth). One scatter per layer; S must be 1.
+            k_full = _scatter_rows(k_cache, k, idx)
+            v_full = _scatter_rows(v_cache, v, idx)
+        else:
+            k_full = jax.lax.dynamic_update_slice(
+                k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0)
+            )
+            v_full = jax.lax.dynamic_update_slice(
+                v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0)
+            )
         new_kv = (k_full, v_full)
         attn_out = dot_product_attention(
             q, k_full, v_full, causal=False, mask=attn_mask,
