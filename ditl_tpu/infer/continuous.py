@@ -81,6 +81,10 @@ class ContinuousEngine:
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.n_slots = n_slots
         self.decode_chunk = decode_chunk
         self.gen = gen or GenerateConfig()
@@ -96,12 +100,14 @@ class ContinuousEngine:
         self.keys = jax.vmap(jax.random.key)(jnp.arange(n_slots, dtype=jnp.uint32))
         self._base_seed = seed
 
+        import collections
+
         self._slots: list[Request | None] = [None] * n_slots
-        self._queue: list[Request] = []
+        self._queue: collections.deque[Request] = collections.deque()
         self._completed: dict[int, Request] = {}
         self._next_id = 0
         self._prefill_cache: dict[int, Any] = {}
-        self._decode = self._build_decode()
+        self._decode_cache: dict[tuple[bool, bool], Any] = {}
 
     # -- compiled programs --------------------------------------------------
 
@@ -141,7 +147,10 @@ class ContinuousEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
-    def _build_decode(self):
+    def _build_decode(self, sampled: bool, topp: bool):
+        """One decode program per (any-slot-sampled, any-top-p) combination:
+        all-greedy ticks compile to pure argmax — no per-step vocab sort,
+        softmax, or categorical that a ``where`` would discard."""
         cfg, smax, pad, eos = self.cfg, self.smax, self.tokenizer.pad_id, self.tokenizer.eos_id
         slots_iota = jnp.arange(smax, dtype=jnp.int32)
         chunk = self.decode_chunk
@@ -162,8 +171,10 @@ class ContinuousEngine:
                     attn_mask=mask,
                 )
                 nxt = sample_logits(
-                    logits[:, 0], subs, temperature=temps,
-                    top_k=self.gen.top_k, top_p=top_ps,
+                    logits[:, 0], subs,
+                    temperature=temps if sampled else 0.0,
+                    top_k=self.gen.top_k,
+                    top_p=top_ps if topp else 1.0,
                 )
                 step_alive = ~done
                 emit = jnp.where(step_alive, cur, pad)
@@ -214,7 +225,7 @@ class ContinuousEngine:
         for slot in range(self.n_slots):
             if self._slots[slot] is not None or not self._queue:
                 continue
-            req = self._queue.pop(0)
+            req = self._queue.popleft()
             p_bucket = _next_pow2(len(req.prompt), floor=16)
             p_bucket = min(p_bucket, self.smax)
             if p_bucket not in self._prefill_cache:
@@ -266,7 +277,14 @@ class ContinuousEngine:
         if not any(occupied):  # host-side check: no device sync on idle ticks
             return
         alive = jnp.asarray(occupied, bool)
-        self.cache, self.cur, self.pos, self.keys, toks = self._decode(
+        active = [r for r in self._slots if r is not None]
+        key = (
+            any(r.temperature > 0.0 for r in active),
+            any(r.top_p < 1.0 for r in active),
+        )
+        if key not in self._decode_cache:
+            self._decode_cache[key] = self._build_decode(*key)
+        self.cache, self.cur, self.pos, self.keys, toks = self._decode_cache[key](
             self.params, self.cache, self.cur, self.pos, alive,
             self.temps, self.top_ps, self.keys,
         )
@@ -277,10 +295,13 @@ class ContinuousEngine:
         return len(self._queue) + sum(r is not None for r in self._slots)
 
     def run(self) -> dict[int, list[int]]:
-        """Drive until all submitted requests complete; token lists by id."""
+        """Drive until all submitted requests complete; pops and returns the
+        finished requests' token lists by id (no unbounded history kept)."""
         while self.pending:
             self.step()
-        return {rid: req.tokens for rid, req in sorted(self._completed.items())}
+        out = {rid: req.tokens for rid, req in sorted(self._completed.items())}
+        self._completed.clear()
+        return out
 
     def generate(self, prompts: list[str], **submit_kw) -> list[str]:
         """Text in, text out (convenience parity with engine.Generator)."""
@@ -326,15 +347,20 @@ class ThreadedEngine:
                     self._cond.wait(timeout=0.05)
                 if self._stop:
                     return
-                try:
-                    self._engine.step()
-                except BaseException as e:  # device/compile errors must not
-                    # wedge the server: fail every waiter loudly and stop.
-                    logger.exception("continuous engine driver died")
+            # Device work runs OUTSIDE the lock: submissions (queue appends,
+            # thread-safe deque) land while a chunk decodes and are admitted
+            # on the next tick; only result handoff needs the lock.
+            try:
+                self._engine.step()
+            except BaseException as e:  # device/compile errors must not
+                # wedge the server: fail every waiter loudly and stop.
+                logger.exception("continuous engine driver died")
+                with self._cond:
                     self._error = e
                     self._stop = True
                     self._cond.notify_all()
-                    return
+                return
+            with self._cond:
                 for rid in list(self._engine._completed):
                     self._results[rid] = self._engine.take_result(rid)
                 self._cond.notify_all()

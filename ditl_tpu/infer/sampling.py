@@ -28,7 +28,10 @@ def _apply_top_p(logits: jax.Array, p) -> jax.Array:
     probs = jax.nn.softmax(sorted_logits, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     if not isinstance(p, (int, float)):
+        # Rows with p >= 1 mean "disabled": use +inf so float cumsum error
+        # can never mask extreme-tail tokens on those rows.
         p = jnp.asarray(p, jnp.float32)[..., None]
+        p = jnp.where(p >= 1.0, jnp.inf, p)
     # Token i is kept if the cumulative mass *before* it is still < p.
     keep_sorted = (cum - probs) < p
     # Threshold = smallest kept logit; everything below it is masked.

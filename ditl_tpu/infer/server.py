@@ -118,19 +118,21 @@ class _Handler(BaseHTTPRequestHandler):
             t0 = time.time()
             if self.threaded_engine is not None:
                 tok = self.threaded_engine.tokenizer
+                prompt_ids = [tok.bos_id] + tok.encode(prompt)
                 out = self.threaded_engine.generate_one(
-                    [tok.bos_id] + tok.encode(prompt),
+                    prompt_ids,
                     max_new_tokens=gen.max_new_tokens,
                     temperature=gen.temperature,
                     top_p=gen.top_p,
                     seed=gen.seed,
                 )
                 text = tok.decode(out)
+                n_prompt = len(prompt_ids)
             else:
                 with self.device_lock:
                     text = self.generator.generate([prompt], gen)[0]
                 tok = self.generator.tokenizer
-            n_prompt = len(tok.encode(prompt)) + 1
+                n_prompt = len(tok.encode(prompt)) + 1
             n_out = len(tok.encode(text))
             kind = "chat.completion" if chat else "text_completion"
             choice = (
@@ -208,6 +210,10 @@ def serve(argv: list[str] | None = None) -> int:
     )
     parser.add_argument("--slots", type=int, default=8,
                         help="decode slots for --engine continuous")
+    parser.add_argument(
+        "--quantize", choices=("none", "int8"), default="none",
+        help="weight-only int8 (halves decode HBM reads; ops/quant.py)",
+    )
     args = parser.parse_args(argv)
 
     if jax.process_index() != 0:
@@ -229,6 +235,11 @@ def serve(argv: list[str] | None = None) -> int:
             params = restored
             logger.info("restored params from %s", args.checkpoint_dir)
         ckpt.close()
+    if args.quantize == "int8":
+        from ditl_tpu.ops.quant import quantize_weights
+
+        params = quantize_weights(params)
+        logger.info("quantized weights to int8 (weight-only)")
     generator = Generator(params, cfg, tokenizer)
     threaded = None
     if args.engine == "continuous":

@@ -241,8 +241,10 @@ def _decoder_layer(
     attn = layer_params["attn"]
     lora = layer_params.get("lora")
 
+    from ditl_tpu.ops.quant import weight_einsum
+
     def proj(h, w, name):
-        out = jnp.einsum("bsd,df->bsf", h, w.astype(cd), preferred_element_type=cd)
+        out = weight_einsum("bsd,df->bsf", h, w, compute_dtype=cd)
         if lora is not None and name in lora:
             from ditl_tpu.models.lora import lora_delta
 
@@ -302,13 +304,11 @@ def _decoder_layer(
         mlp_out, aux = moe_block(layer_params["moe"], h, cfg, mesh=mesh, rules=rules)
     else:
         mlp = layer_params["mlp"]
-        gate = jnp.einsum("bsd,df->bsf", h, mlp["w_gate"].astype(cd), preferred_element_type=cd)
-        up = jnp.einsum("bsd,df->bsf", h, mlp["w_up"].astype(cd), preferred_element_type=cd)
+        gate = weight_einsum("bsd,df->bsf", h, mlp["w_gate"], compute_dtype=cd)
+        up = weight_einsum("bsd,df->bsf", h, mlp["w_up"], compute_dtype=cd)
         inner = jax.nn.silu(gate) * up
         inner = _constrain(inner, ("batch", "seq", "act_mlp"), mesh, rules)
-        mlp_out = jnp.einsum(
-            "bsf,fd->bsd", inner, mlp["w_down"].astype(cd), preferred_element_type=cd
-        )
+        mlp_out = weight_einsum("bsf,fd->bsd", inner, mlp["w_down"], compute_dtype=cd)
     x = x + mlp_out
     x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
     if new_kv is not None:
@@ -423,9 +423,11 @@ def forward(
         if cache is not None:
             out = out + (new_cache,)
         return out if len(out) > 1 else x
-    head = head_weights(params, cfg)
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, head.astype(cd), preferred_element_type=jnp.float32
+    from ditl_tpu.ops.quant import weight_einsum
+
+    logits = weight_einsum(
+        "bsd,dv->bsv", x, head_weights(params, cfg),
+        compute_dtype=cd, preferred=jnp.float32,
     )
     logits = _constrain(logits, ("batch", "seq", "act_vocab"), mesh, rules)
     out = (logits,)

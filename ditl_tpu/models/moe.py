@@ -106,12 +106,13 @@ def moe_block(
     token_x = x[jnp.arange(t * k) // k]  # (TK, D)
     expert_in = jnp.einsum("xec,xd->ecd", dispatch, token_x.astype(jnp.float32)).astype(cd)
 
+    from ditl_tpu.ops.quant import weight_einsum
+
     def ffn(w_gate, w_up, w_down, xe):
-        gate = jnp.einsum("ecd,edf->ecf", xe, w_gate.astype(cd), preferred_element_type=cd)
-        up = jnp.einsum("ecd,edf->ecf", xe, w_up.astype(cd), preferred_element_type=cd)
-        return jnp.einsum(
-            "ecf,efd->ecd", jax.nn.silu(gate) * up, w_down.astype(cd),
-            preferred_element_type=cd,
+        gate = weight_einsum("ecd,edf->ecf", xe, w_gate, compute_dtype=cd)
+        up = weight_einsum("ecd,edf->ecf", xe, w_up, compute_dtype=cd)
+        return weight_einsum(
+            "ecf,efd->ecd", jax.nn.silu(gate) * up, w_down, compute_dtype=cd
         )
 
     expert_out = ffn(moe["w_gate"], moe["w_up"], moe["w_down"], expert_in)  # (E, C, D)

@@ -1,0 +1,106 @@
+"""Weight-only int8 quantization: accuracy bounds and engine integration."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.models import llama
+from ditl_tpu.ops.quant import is_quantized_leaf, quantize_weights, weight_einsum
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        dtype="float32",
+        param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    return params, cfg
+
+
+def test_weight_einsum_matches_dequantized():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(32, 48)) * 0.2, jnp.float32)
+    x = jnp.asarray(rng.normal(size=(4, 32)), jnp.float32)
+    from ditl_tpu.ops.quant import _quantize_matrix
+
+    qw = _quantize_matrix(w)
+    assert qw["q"].dtype == jnp.int8
+    got = weight_einsum("bd,df->bf", x, qw, compute_dtype=jnp.float32)
+    dequant = qw["q"].astype(jnp.float32) * qw["scale"]
+    expected = x @ dequant
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=1e-5, atol=1e-5)
+    # And the dequantized matrix is close to the original (per-channel bound).
+    assert float(jnp.abs(dequant - w).max()) <= float(qw["scale"].max()) * 0.51
+
+
+def test_quantized_forward_close_to_float(setup):
+    params, cfg = setup
+    qparams = quantize_weights(params)
+    assert is_quantized_leaf(qparams["layers"]["attn"]["wq"])
+    assert is_quantized_leaf(qparams["lm_head"]["kernel"])
+    assert not isinstance(qparams["embed"]["embedding"], dict)
+
+    ids = jnp.asarray(np.random.default_rng(1).integers(3, 500, size=(2, 24)), jnp.int32)
+    ref = np.asarray(llama.forward(params, ids, cfg))
+    got = np.asarray(llama.forward(qparams, ids, cfg))
+    # int8 weight-only: logits track closely relative to their spread.
+    err = np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9)
+    assert err < 0.05, f"relative logits error {err:.3f}"
+    # Greedy top-1 agreement on the vast majority of positions.
+    agree = (got.argmax(-1) == ref.argmax(-1)).mean()
+    assert agree > 0.9, f"top-1 agreement {agree:.2f}"
+
+
+def test_quantized_generator_and_continuous_agree(setup):
+    """Both engines run quantized and agree with each other (greedy)."""
+    from ditl_tpu.infer.continuous import ContinuousEngine
+    from ditl_tpu.infer.engine import GenerateConfig, Generator
+
+    params, cfg = setup
+    tok = ByteTokenizer()
+    qparams = quantize_weights(params)
+    gen = GenerateConfig(max_new_tokens=8, temperature=0.0)
+    prompts = ["hello quantized", "abc"]
+    ref = Generator(qparams, cfg, tok).generate(prompts, gen)
+    got = ContinuousEngine(qparams, cfg, tok, n_slots=2, decode_chunk=3, gen=gen).generate(prompts)
+    assert got == ref
+
+
+def test_quantize_rejects_unmerged_lora(setup):
+    params, cfg = setup
+    lcfg = dataclasses.replace(cfg, lora_rank=4)
+    lparams = llama.init_params(jax.random.key(1), lcfg)
+    with pytest.raises(ValueError, match="merge"):
+        quantize_weights(lparams)
+
+
+def test_quantized_moe_forward():
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=64,
+        num_experts=4, num_experts_per_tok=2, dtype="float32",
+        param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(2), cfg)
+    qparams = quantize_weights(params)
+    assert is_quantized_leaf(qparams["layers"]["moe"]["w_gate"])
+    assert not isinstance(qparams["layers"]["moe"]["router"], dict)  # routing stays f32
+    ids = jnp.ones((1, 16), jnp.int32)
+    ref = np.asarray(llama.forward(params, ids, cfg))
+    got = np.asarray(llama.forward(qparams, ids, cfg))
+    err = np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9)
+    assert err < 0.08
