@@ -173,6 +173,9 @@ class DataConfig:
     shuffle: bool = True
     seed: int = 0
     drop_last: bool = False
+    # Fraction of the dataset held out for local validation-loss eval
+    # (train.val_every); 0 disables. Deterministic tail split.
+    eval_fraction: float = 0.0
     num_epochs: int = 3  # ref :61
     tokenizer: str = "byte"  # "byte" | HF tokenizer name
     pack_sequences: bool = True
@@ -199,6 +202,10 @@ class TrainConfig:
     metrics_file: str = ""  # "" => no JSONL scalar stream (metrics.py)
     eval_every: int = 0  # 0 => no API eval loop
     eval_samples: int = 8
+    # Local validation: every N steps run the compiled eval step over
+    # val_batches batches of the held-out split (data.eval_fraction).
+    val_every: int = 0
+    val_batches: int = 8
     checkpoint_dir: str = ""  # "" => checkpointing disabled
     checkpoint_every: int = 0
     keep_checkpoints: int = 3

@@ -260,7 +260,12 @@ class DataPipeline:
 
 def _prefetch(it: Iterator, depth: int) -> Iterator:
     """Background-thread prefetch of up to ``depth`` items (device transfer is
-    async in JAX, so buffering the host side is enough for double buffering)."""
+    async in JAX, so buffering the host side is enough for double buffering).
+
+    Abandoning the returned generator (partial consumption + ``close()`` /
+    garbage collection) stops the worker thread — without that, every
+    partially-read epoch (validation loops!) would leak a blocked thread
+    pinning ``depth`` device batches."""
     if depth <= 0:
         yield from it
         return
@@ -268,13 +273,17 @@ def _prefetch(it: Iterator, depth: int) -> Iterator:
     lock = threading.Condition()
     done = object()
     failed = object()
+    stop = False
 
     def worker():
+        nonlocal stop
         try:
             for item in it:
                 with lock:
-                    while len(queue) >= depth:
+                    while len(queue) >= depth and not stop:
                         lock.wait()
+                    if stop:
+                        return
                     queue.append(item)
                     lock.notify_all()
         except BaseException as e:  # surface producer errors to the consumer
@@ -288,14 +297,19 @@ def _prefetch(it: Iterator, depth: int) -> Iterator:
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
+    try:
+        while True:
+            with lock:
+                while not queue:
+                    lock.wait()
+                item = queue.popleft()
+                lock.notify_all()
+            if item is done:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is failed:
+                raise item[1]
+            yield item
+    finally:
         with lock:
-            while not queue:
-                lock.wait()
-            item = queue.popleft()
+            stop = True
             lock.notify_all()
-        if item is done:
-            return
-        if isinstance(item, tuple) and len(item) == 2 and item[0] is failed:
-            raise item[1]
-        yield item

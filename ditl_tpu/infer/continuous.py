@@ -278,10 +278,10 @@ class ContinuousEngine:
             return
         alive = jnp.asarray(occupied, bool)
         active = [r for r in self._slots if r is not None]
-        key = (
-            any(r.temperature > 0.0 for r in active),
-            any(r.top_p < 1.0 for r in active),
-        )
+        sampled = any(r.temperature > 0.0 for r in active)
+        # top_p only matters when something actually samples — greedy rows
+        # ignore it, so (False, True) would compile a redundant program.
+        key = (sampled, sampled and any(r.top_p < 1.0 for r in active))
         if key not in self._decode_cache:
             self._decode_cache[key] = self._build_decode(*key)
         self.cache, self.cur, self.pos, self.keys, toks = self._decode_cache[key](

@@ -37,7 +37,7 @@ from ditl_tpu.runtime.mesh import build_mesh
 from ditl_tpu.train.checkpoint import CheckpointManager, DataIterState
 from ditl_tpu.train.metrics import MetricsLogger
 from ditl_tpu.train.state import TrainState, create_train_state, state_logical_axes
-from ditl_tpu.train.step import make_multi_step, make_train_step
+from ditl_tpu.train.step import make_eval_step, make_multi_step, make_train_step
 from ditl_tpu.utils.logging import get_logger, setup_logging
 from ditl_tpu.utils.profiling import StepProfiler
 
@@ -97,6 +97,27 @@ def _windows(it, size: int):
         yield window
 
 
+def _run_validation(eval_step, params, val_pipeline, n_batches: int) -> float:
+    """Token-weighted mean NLL over up to ``n_batches`` held-out batches."""
+    tot_nll = tot_tok = 0.0
+    epoch_iter = iter(val_pipeline.epoch(0))
+    try:
+        for _ in range(n_batches):
+            batch = next(epoch_iter, None)
+            if batch is None:
+                break
+            aux = eval_step(params, batch)
+            n = float(aux["n_tokens"])
+            tot_nll += float(aux["loss"]) * n
+            tot_tok += n
+    finally:
+        epoch_iter.close()  # stop the prefetch worker (loader._prefetch)
+    if tot_tok == 0:
+        logger.warning("validation produced no batches; val_loss is undefined")
+        return float("nan")
+    return tot_nll / tot_tok
+
+
 def _crossed(step: int, n_advanced: int, every: int) -> bool:
     """True if the last ``n_advanced`` steps ending at ``step`` crossed a
     multiple of ``every`` — cadence checks that stay correct when the loop
@@ -119,6 +140,27 @@ def train(config: Config) -> dict[str, Any]:
             f"model vocab {model_cfg.vocab_size} < tokenizer vocab {tokenizer.vocab_size}"
         )
     dataset = load_text_dataset(config.data)
+    if (config.data.eval_fraction > 0) != (config.train.val_every > 0):
+        raise ValueError(
+            "data.eval_fraction and train.val_every must be set together "
+            f"(got eval_fraction={config.data.eval_fraction}, "
+            f"val_every={config.train.val_every}): one without the other "
+            "either wastes held-out data or never validates"
+        )
+    val_dataset = None
+    if config.data.eval_fraction > 0:
+        # Deterministic tail holdout: the split depends only on dataset order
+        # and the fraction, so every host computes the same boundary.
+        n_val = max(1, int(len(dataset) * config.data.eval_fraction))
+        n_train = len(dataset) - n_val
+        if n_train < 1:
+            raise ValueError(
+                f"eval_fraction {config.data.eval_fraction} leaves no training data"
+            )
+        from ditl_tpu.data.dataset import TextDataset
+
+        val_dataset = TextDataset(dataset.texts[n_train:], dataset.labels[n_train:])
+        dataset = TextDataset(dataset.texts[:n_train], dataset.labels[:n_train])
     # Consistency check runs AFTER data loading so a host that silently fell
     # back to the synthetic corpus (hub hiccup) is caught before any
     # collective, not after a divergent epoch hangs one (SURVEY.md §5).
@@ -190,8 +232,26 @@ def train(config: Config) -> dict[str, Any]:
             )
         )
 
+    val_pipeline = None
+    if val_dataset is not None and config.train.val_every > 0:
+        import dataclasses as _dc
+
+        val_pipeline = DataPipeline(
+            val_dataset,
+            tokenizer,
+            _dc.replace(config.data, shuffle=False),
+            mesh,
+        )
+        if val_pipeline.steps_per_epoch < 1:
+            raise ValueError(
+                f"eval_fraction {config.data.eval_fraction} holds out too few "
+                f"tokens for even one validation batch (batch {config.data.batch_size}"
+                f" x seq {config.data.seq_len}); increase it or shrink the batch"
+            )
+
     example = next(iter(pipeline.epoch(0)))
     train_step = make_train_step(model_cfg, config.train, mesh, example)
+    eval_step = None
     spc = max(1, config.train.steps_per_call)
     train_multi = (
         make_multi_step(model_cfg, config.train, mesh, example, spc)
@@ -212,6 +272,7 @@ def train(config: Config) -> dict[str, Any]:
     total_steps = config.train.total_steps
     global_step = data_iter.global_step
     step_metrics = None
+    last_val_loss = None
     last_saved = None
     epoch = data_iter.epoch
 
@@ -261,6 +322,19 @@ def train(config: Config) -> dict[str, Any]:
                 if ckpt is not None and ckpt.should_save(global_step, len(window)):
                     ckpt.save(global_step, state, position)
                     last_saved = global_step
+                if val_pipeline is not None and _crossed(
+                    global_step, len(window), config.train.val_every
+                ):
+                    if eval_step is None:
+                        eval_step = make_eval_step(model_cfg, mesh)
+                    last_val_loss = _run_validation(
+                        eval_step, state.params, val_pipeline,
+                        config.train.val_batches,
+                    )
+                    if is_coordinator():
+                        logger.info(
+                            "step %d: val_loss=%.4f", global_step, last_val_loss
+                        )
                 if _crossed(global_step, len(window), config.train.eval_every):
                     idx = np.arange(min(config.train.eval_samples, len(dataset)))
                     run_api_eval(
@@ -289,6 +363,8 @@ def train(config: Config) -> dict[str, Any]:
         else float("nan")
     )
     summary["steps"] = global_step
+    if last_val_loss is not None:
+        summary["val_loss"] = last_val_loss
     summary["params_m"] = n_params / 1e6
     summary["wall_s"] = time.time() - t_start
     if is_coordinator():

@@ -196,3 +196,30 @@ def test_multi_step_matches_single_steps(tiny_model_cfg, example_batch):
     ref_flat, _ = jax.flatten_util.ravel_pytree(s_ref.params)
     got_flat, _ = jax.flatten_util.ravel_pytree(s2.params)
     np.testing.assert_allclose(np.asarray(got_flat), np.asarray(ref_flat), rtol=1e-4, atol=1e-6)
+
+
+def test_local_validation_eval(tmp_path):
+    """data.eval_fraction + train.val_every: held-out NLL is computed and
+    logged without touching any network."""
+    from ditl_tpu.config import Config, DataConfig, ModelConfig
+    from ditl_tpu.train.trainer import train
+
+    out = train(
+        Config(
+            model=ModelConfig(
+                vocab_size=512, hidden_size=64, intermediate_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+                max_seq_len=64,
+            ),
+            data=DataConfig(
+                synthetic=True, synthetic_examples=256, batch_size=8,
+                seq_len=32, num_epochs=2, eval_fraction=0.25,
+            ),
+            train=TrainConfig(
+                total_steps=6, warmup_steps=1, log_every=100,
+                val_every=3, val_batches=2,
+            ),
+        )
+    )
+    assert out["steps"] == 6
+    assert "val_loss" in out and np.isfinite(out["val_loss"])
