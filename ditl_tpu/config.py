@@ -194,6 +194,9 @@ class TrainConfig:
     beta2: float = 0.95
     grad_clip_norm: float = 1.0
     grad_accum_steps: int = 1
+    # Adam first-moment storage dtype ("float32" | "bfloat16"): bf16 halves
+    # the moment's HBM footprint; variance always stays float32.
+    adam_mu_dtype: str = "float32"
     # Optimizer steps per compiled call (lax.scan window; train/step.py
     # make_multi_step). >1 removes host dispatch overhead between steps —
     # significant over remote device transports.

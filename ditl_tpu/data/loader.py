@@ -276,7 +276,6 @@ def _prefetch(it: Iterator, depth: int) -> Iterator:
     stop = False
 
     def worker():
-        nonlocal stop
         try:
             for item in it:
                 with lock:

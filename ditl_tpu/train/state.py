@@ -57,6 +57,10 @@ def make_optimizer(cfg: TrainConfig, params: Any) -> optax.GradientTransformatio
             b1=cfg.beta1,
             b2=cfg.beta2,
             weight_decay=cfg.weight_decay,
+            # bf16 first moment halves its HBM footprint/traffic; the
+            # variance (nu) stays f32 — it is the precision-sensitive one
+            # (sqrt of tiny values).
+            mu_dtype=cfg.adam_mu_dtype,
         ),
     )
     mask = lora_mask(params)

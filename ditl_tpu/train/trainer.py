@@ -97,25 +97,15 @@ def _windows(it, size: int):
         yield window
 
 
-def _run_validation(eval_step, params, val_pipeline, n_batches: int) -> float:
-    """Token-weighted mean NLL over up to ``n_batches`` held-out batches."""
+def _run_validation(eval_step, params, val_batches) -> float:
+    """Token-weighted mean NLL over the pre-materialized held-out batches."""
     tot_nll = tot_tok = 0.0
-    epoch_iter = iter(val_pipeline.epoch(0))
-    try:
-        for _ in range(n_batches):
-            batch = next(epoch_iter, None)
-            if batch is None:
-                break
-            aux = eval_step(params, batch)
-            n = float(aux["n_tokens"])
-            tot_nll += float(aux["loss"]) * n
-            tot_tok += n
-    finally:
-        epoch_iter.close()  # stop the prefetch worker (loader._prefetch)
-    if tot_tok == 0:
-        logger.warning("validation produced no batches; val_loss is undefined")
-        return float("nan")
-    return tot_nll / tot_tok
+    for batch in val_batches:
+        aux = eval_step(params, batch)
+        n = float(aux["n_tokens"])
+        tot_nll += float(aux["loss"]) * n
+        tot_tok += n
+    return tot_nll / max(tot_tok, 1.0)
 
 
 def _crossed(step: int, n_advanced: int, every: int) -> bool:
@@ -149,8 +139,9 @@ def train(config: Config) -> dict[str, Any]:
         )
     val_dataset = None
     if config.data.eval_fraction > 0:
-        # Deterministic tail holdout: the split depends only on dataset order
-        # and the fraction, so every host computes the same boundary.
+        # Deterministic seeded permutation before the split: every host
+        # computes the same boundary, and label-ordered corpora (HF imdb is
+        # stored label-sorted) don't produce a single-class holdout.
         n_val = max(1, int(len(dataset) * config.data.eval_fraction))
         n_train = len(dataset) - n_val
         if n_train < 1:
@@ -159,8 +150,11 @@ def train(config: Config) -> dict[str, Any]:
             )
         from ditl_tpu.data.dataset import TextDataset
 
-        val_dataset = TextDataset(dataset.texts[n_train:], dataset.labels[n_train:])
-        dataset = TextDataset(dataset.texts[:n_train], dataset.labels[:n_train])
+        perm = np.random.default_rng(config.data.seed).permutation(len(dataset))
+        texts = [dataset.texts[i] for i in perm]
+        labels = [dataset.labels[i] for i in perm]
+        val_dataset = TextDataset(texts[n_train:], labels[n_train:])
+        dataset = TextDataset(texts[:n_train], labels[:n_train])
     # Consistency check runs AFTER data loading so a host that silently fell
     # back to the synthetic corpus (hub hiccup) is caught before any
     # collective, not after a divergent epoch hangs one (SURVEY.md §5).
@@ -232,9 +226,10 @@ def train(config: Config) -> dict[str, Any]:
             )
         )
 
-    val_pipeline = None
+    val_batches = None
     if val_dataset is not None and config.train.val_every > 0:
         import dataclasses as _dc
+        import itertools as _it
 
         val_pipeline = DataPipeline(
             val_dataset,
@@ -242,7 +237,17 @@ def train(config: Config) -> dict[str, Any]:
             _dc.replace(config.data, shuffle=False),
             mesh,
         )
-        if val_pipeline.steps_per_epoch < 1:
+        # Materialize the validation window ONCE: shuffle is off, so the
+        # batches are identical every run — re-tokenizing/packing the whole
+        # holdout at each val_every would stall training for nothing. This
+        # is also the only accurate emptiness check for the packed path
+        # (document counts don't predict packed batch counts).
+        epoch_iter = iter(val_pipeline.epoch(0))
+        try:
+            val_batches = list(_it.islice(epoch_iter, config.train.val_batches))
+        finally:
+            epoch_iter.close()
+        if not val_batches:
             raise ValueError(
                 f"eval_fraction {config.data.eval_fraction} holds out too few "
                 f"tokens for even one validation batch (batch {config.data.batch_size}"
@@ -322,14 +327,13 @@ def train(config: Config) -> dict[str, Any]:
                 if ckpt is not None and ckpt.should_save(global_step, len(window)):
                     ckpt.save(global_step, state, position)
                     last_saved = global_step
-                if val_pipeline is not None and _crossed(
+                if val_batches is not None and _crossed(
                     global_step, len(window), config.train.val_every
                 ):
                     if eval_step is None:
                         eval_step = make_eval_step(model_cfg, mesh)
                     last_val_loss = _run_validation(
-                        eval_step, state.params, val_pipeline,
-                        config.train.val_batches,
+                        eval_step, state.params, val_batches
                     )
                     if is_coordinator():
                         logger.info(

@@ -223,3 +223,24 @@ def test_local_validation_eval(tmp_path):
     )
     assert out["steps"] == 6
     assert "val_loss" in out and np.isfinite(out["val_loss"])
+
+
+def test_bf16_adam_mu(tiny_model_cfg, example_batch):
+    """adam_mu_dtype=bfloat16 stores a bf16 first moment and still trains."""
+    import jax.numpy as jnp
+
+    tcfg = TrainConfig(total_steps=10, warmup_steps=1, adam_mu_dtype="bfloat16")
+    mesh, state, gb, step = _setup(
+        tiny_model_cfg, example_batch, train_cfg=tcfg
+    )
+    mus = [
+        leaf
+        for path, leaf in jax.tree_util.tree_leaves_with_path(state.opt_state)
+        if any(getattr(k, "name", "") == "mu" for k in path)
+    ]
+    assert mus and all(m.dtype == jnp.bfloat16 for m in mus)
+    losses = []
+    for _ in range(5):
+        state, m = step(state, gb)
+        losses.append(float(m["loss"]))
+    assert losses[-1] < losses[0]
