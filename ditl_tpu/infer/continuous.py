@@ -62,6 +62,9 @@ class Request:
     tokens: list[int] = field(default_factory=list)
     slot: int | None = None
     finished: bool = False
+    # Streaming: when set, every harvest pushes this chunk's new token ids
+    # (list[int]); a final ``None`` marks completion.
+    stream: Any = None
 
 
 class ContinuousEngine:
@@ -200,8 +203,11 @@ class ContinuousEngine:
         temperature: float | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        stream: Any = None,
     ) -> int:
-        """Queue a request; returns its id (see ``results``/``run``)."""
+        """Queue a request; returns its id (see ``results``/``run``).
+        ``stream``: optional ``queue.Queue`` receiving per-chunk token lists
+        and a final ``None``."""
         gen = self.gen
         max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
         prompt = prompt_tokens or [self.tokenizer.bos_id]
@@ -216,6 +222,7 @@ class ContinuousEngine:
             temperature=gen.temperature if temperature is None else temperature,
             top_p=gen.top_p if top_p is None else top_p,
             seed=(self._base_seed + self._next_id) if seed is None else seed,
+            stream=stream,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -258,15 +265,21 @@ class ContinuousEngine:
         for slot, req in enumerate(self._slots):
             if req is None:
                 continue
+            fresh: list[int] = []
             for tok in emitted[slot]:
                 tok = int(tok)
                 if tok in (eos, pad) or len(req.tokens) >= req.max_new_tokens:
                     req.finished = True
                     break
                 req.tokens.append(tok)
+                fresh.append(tok)
             if len(req.tokens) >= req.max_new_tokens:
                 req.finished = True
+            if req.stream is not None and fresh:
+                req.stream.put(fresh)
             if req.finished:
+                if req.stream is not None:
+                    req.stream.put(None)
                 self._completed[req.req_id] = req
                 self._slots[slot] = None
 
@@ -317,6 +330,12 @@ class ContinuousEngine:
         req = self._completed.pop(req_id, None)
         return None if req is None else req.tokens
 
+    def take_finished(self) -> list[Request]:
+        """Pop and return all finished requests."""
+        out = list(self._completed.values())
+        self._completed.clear()
+        return out
+
 
 class ThreadedEngine:
     """Thread-safe front for ``ContinuousEngine``: HTTP handler threads
@@ -361,8 +380,12 @@ class ThreadedEngine:
                     self._cond.notify_all()
                 return
             with self._cond:
-                for rid in list(self._engine._completed):
-                    self._results[rid] = self._engine.take_result(rid)
+                for req in self._engine.take_finished():
+                    # Streamed requests deliver through their queue (the final
+                    # None already went out in _harvest); recording them here
+                    # would leak entries nobody pops.
+                    if req.stream is None:
+                        self._results[req.req_id] = req.tokens
                 self._cond.notify_all()
 
     def generate_one(
@@ -395,6 +418,45 @@ class ThreadedEngine:
                     ) from self._error
                 self._cond.wait()
             return self._results.pop(rid)
+
+    def stream_one(
+        self,
+        prompt_tokens: list[int],
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+    ):
+        """Submit one request and yield per-chunk token-id lists as they are
+        decoded (SSE streaming). Raises if the driver stops mid-stream."""
+        import queue as _queue
+
+        stream: _queue.Queue = _queue.Queue()
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("continuous engine is stopped") from self._error
+            rid = self._engine.submit(
+                prompt_tokens,
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                seed=seed,
+                stream=stream,
+            )
+            self._cond.notify_all()
+        while True:
+            try:
+                chunk = stream.get(timeout=1.0)
+            except _queue.Empty:
+                if self._stop:
+                    raise RuntimeError(
+                        "continuous engine stopped mid-stream"
+                    ) from self._error
+                continue
+            if chunk is None:
+                return
+            yield chunk
 
     def close(self) -> None:
         with self._cond:

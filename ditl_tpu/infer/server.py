@@ -91,6 +91,64 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
+    def _send_sse(self, events) -> None:
+        """Stream pre-serialized JSON events as Server-Sent Events."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        for event in events:
+            self.wfile.write(f"data: {json.dumps(event)}\n\n".encode())
+            self.wfile.flush()
+        self.wfile.write(b"data: [DONE]\n\n")
+        self.wfile.flush()
+
+    def _stream_complete(self, payload: dict, prompt: str, gen, *, chat: bool) -> None:
+        """OpenAI streaming: real incremental chunks from the continuous
+        engine; the lockstep engine generates fully, then emits one chunk."""
+        cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+        model = payload.get("model") or self.model_name
+        kind = "chat.completion.chunk" if chat else "text_completion"
+
+        def event(text, finish=None, role=None):
+            if chat:
+                delta = {}
+                if role is not None:
+                    delta["role"] = role
+                    delta["content"] = ""
+                elif text:
+                    delta = {"content": text}
+                choice = {"index": 0, "delta": delta, "finish_reason": finish}
+            else:
+                choice = {"index": 0, "text": text, "finish_reason": finish}
+            return {"id": cmpl_id, "object": kind, "created": created,
+                    "model": model, "choices": [choice]}
+
+        def events():
+            if chat:
+                yield event("", role="assistant")  # role-announcement chunk
+            if self.threaded_engine is not None:
+                tok = self.threaded_engine.tokenizer
+                for chunk in self.threaded_engine.stream_one(
+                    [tok.bos_id] + tok.encode(prompt),
+                    max_new_tokens=gen.max_new_tokens,
+                    temperature=gen.temperature,
+                    top_p=gen.top_p,
+                    seed=gen.seed,
+                ):
+                    text = tok.decode(chunk)
+                    if text:
+                        yield event(text)
+            else:
+                with self.device_lock:
+                    text = self.generator.generate([prompt], gen)[0]
+                if text:
+                    yield event(text)
+            yield event("", finish="stop")
+
+        self._send_sse(events())
+
     def _complete(self, payload: dict, *, chat: bool) -> None:
         try:
             if chat:
@@ -115,6 +173,16 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=float(payload.get("top_p") or 1.0),
                 seed=int(seed),
             )
+            if payload.get("stream"):
+                try:
+                    self._stream_complete(payload, prompt, gen, chat=chat)
+                except (BrokenPipeError, ConnectionError):
+                    logger.info("client disconnected mid-stream")
+                except Exception:
+                    # Headers (200/text-event-stream) may already be out —
+                    # a JSON 500 would corrupt the stream; just log and close.
+                    logger.exception("streaming completion failed")
+                return
             t0 = time.time()
             if self.threaded_engine is not None:
                 tok = self.threaded_engine.tokenizer

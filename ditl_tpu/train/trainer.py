@@ -97,10 +97,14 @@ def _windows(it, size: int):
         yield window
 
 
-def _run_validation(eval_step, params, val_batches) -> float:
-    """Token-weighted mean NLL over the pre-materialized held-out batches."""
+def _run_validation(eval_step, params, val_batches, mesh) -> float:
+    """Token-weighted mean NLL over the pre-materialized held-out batches
+    (host numpy; shipped to the mesh per pass)."""
+    from ditl_tpu.data.loader import make_global_batch
+
     tot_nll = tot_tok = 0.0
-    for batch in val_batches:
+    for host_batch in val_batches:
+        batch = make_global_batch(mesh, host_batch)
         aux = eval_step(params, batch)
         n = float(aux["n_tokens"])
         tot_nll += float(aux["loss"]) * n
@@ -237,16 +241,16 @@ def train(config: Config) -> dict[str, Any]:
             _dc.replace(config.data, shuffle=False),
             mesh,
         )
-        # Materialize the validation window ONCE: shuffle is off, so the
-        # batches are identical every run — re-tokenizing/packing the whole
-        # holdout at each val_every would stall training for nothing. This
-        # is also the only accurate emptiness check for the packed path
-        # (document counts don't predict packed batch counts).
-        epoch_iter = iter(val_pipeline.epoch(0))
-        try:
-            val_batches = list(_it.islice(epoch_iter, config.train.val_batches))
-        finally:
-            epoch_iter.close()
+        # Materialize the validation window ONCE as HOST batches: shuffle is
+        # off, so they are identical every run — re-tokenizing/packing the
+        # holdout at each val_every would stall training — but keeping them
+        # in host RAM (not HBM) means validation costs no standing device
+        # memory; each pass device_puts them transiently. This is also the
+        # only accurate emptiness check for the packed path (document counts
+        # don't predict packed batch counts).
+        val_batches = list(
+            _it.islice(val_pipeline._host_batches(0), config.train.val_batches)
+        )
         if not val_batches:
             raise ValueError(
                 f"eval_fraction {config.data.eval_fraction} holds out too few "
@@ -333,7 +337,7 @@ def train(config: Config) -> dict[str, Any]:
                     if eval_step is None:
                         eval_step = make_eval_step(model_cfg, mesh)
                     last_val_loss = _run_validation(
-                        eval_step, state.params, val_batches
+                        eval_step, state.params, val_batches, mesh
                     )
                     if is_coordinator():
                         logger.info(

@@ -170,3 +170,74 @@ def test_per_request_seed_reproducible_across_batch_mixes(setup):
         return out[rid]
 
     assert run_alone() == run_crowded()
+
+
+def test_stream_one_yields_incremental_chunks(setup):
+    """stream_one yields multiple chunks whose concatenation equals the
+    non-streamed greedy result."""
+    from ditl_tpu.infer.continuous import ThreadedEngine
+
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=12, temperature=0.0)
+    threaded = ThreadedEngine(
+        ContinuousEngine(params, cfg, tok, n_slots=2, decode_chunk=3, gen=gen)
+    )
+    try:
+        prompt = [tok.bos_id] + tok.encode("stream this")
+        chunks = list(threaded.stream_one(prompt, max_new_tokens=12))
+        assert len(chunks) >= 2, "expected multiple incremental chunks"
+        streamed = [t for c in chunks for t in c]
+        ref = Generator(params, cfg, tok).generate_tokens(
+            [prompt], GenerateConfig(max_new_tokens=12, temperature=0.0)
+        )[0]
+        assert streamed == ref
+    finally:
+        threaded.close()
+
+
+def test_server_sse_streaming(setup):
+    """"stream": true returns SSE chunks ending in [DONE]; assembled text
+    equals the non-streamed completion."""
+    import http.client
+    import json as _json
+    import threading
+
+    from ditl_tpu.infer.continuous import ThreadedEngine
+    from ditl_tpu.infer.server import make_server
+
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=10, temperature=0.0)
+    threaded = ThreadedEngine(
+        ContinuousEngine(params, cfg, tok, n_slots=2, decode_chunk=3, gen=gen)
+    )
+    server = make_server(
+        Generator(params, cfg, tok), host="127.0.0.1", port=0,
+        threaded_engine=threaded, default_max_tokens=10,
+    )
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request(
+            "POST", "/v1/completions",
+            body=_json.dumps({"prompt": "sse prompt", "max_tokens": 10, "stream": True}),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type").startswith("text/event-stream")
+        raw = resp.read().decode()
+        events = [
+            line[len("data: "):]
+            for line in raw.splitlines()
+            if line.startswith("data: ")
+        ]
+        assert events[-1] == "[DONE]"
+        text = "".join(
+            _json.loads(e)["choices"][0]["text"] for e in events[:-1]
+        )
+        ref = Generator(params, cfg, tok).generate(["sse prompt"], gen)[0]
+        assert text == ref
+    finally:
+        server.shutdown()
+        threaded.close()
