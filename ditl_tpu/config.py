@@ -130,6 +130,12 @@ class ModelConfig:
     head_dim: int = 32
     max_seq_len: int = 2048
     rope_theta: float = 500000.0
+    # Llama-3.1-style NTK RoPE scaling; factor 0 disables. Matches HF's
+    # "llama3" rope_scaling semantics (models/llama.py rope_frequencies).
+    rope_scaling_factor: float = 0.0
+    rope_scaling_low_freq_factor: float = 1.0
+    rope_scaling_high_freq_factor: float = 4.0
+    rope_scaling_original_max_len: int = 8192
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
     dtype: str = "bfloat16"  # activation/compute dtype

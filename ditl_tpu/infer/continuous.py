@@ -80,7 +80,12 @@ class ContinuousEngine:
         decode_chunk: int = 16,
         gen: GenerateConfig | None = None,
         seed: int = 0,
+        max_cache_len: int | None = None,
     ):
+        """``max_cache_len`` caps the per-slot KV cache below the model's
+        ``max_seq_len`` — essential for long-context models (Llama-3.1's
+        131072 would be ~17 GB of cache PER SLOT at 8B scale); requests are
+        validated against the cap at submit."""
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer
@@ -91,7 +96,7 @@ class ContinuousEngine:
         self.n_slots = n_slots
         self.decode_chunk = decode_chunk
         self.gen = gen or GenerateConfig()
-        self.smax = model_cfg.max_seq_len
+        self.smax = min(model_cfg.max_seq_len, max_cache_len or model_cfg.max_seq_len)
 
         self.cache = init_cache(model_cfg, n_slots, self.smax)
         self.cur = jnp.full((n_slots,), tokenizer.pad_id, jnp.int32)
@@ -213,7 +218,8 @@ class ContinuousEngine:
         prompt = prompt_tokens or [self.tokenizer.bos_id]
         if len(prompt) + max_new > self.smax:
             raise ValueError(
-                f"prompt {len(prompt)} + max_new {max_new} exceeds max_seq_len {self.smax}"
+                f"prompt {len(prompt)} + max_new {max_new} exceeds max_seq_len "
+                f"/ cache cap {self.smax}"
             )
         req = Request(
             req_id=self._next_id,

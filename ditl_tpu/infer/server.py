@@ -282,6 +282,11 @@ def serve(argv: list[str] | None = None) -> int:
         "--quantize", choices=("none", "int8"), default="none",
         help="weight-only int8 (halves decode HBM reads; ops/quant.py)",
     )
+    parser.add_argument(
+        "--max-cache-len", type=int, default=4096,
+        help="per-slot KV cache cap for --engine continuous (long-context "
+        "models would otherwise allocate max_seq_len-sized caches)",
+    )
     args = parser.parse_args(argv)
 
     if jax.process_index() != 0:
@@ -314,7 +319,10 @@ def serve(argv: list[str] | None = None) -> int:
         from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
 
         threaded = ThreadedEngine(
-            ContinuousEngine(params, cfg, tokenizer, n_slots=args.slots)
+            ContinuousEngine(
+                params, cfg, tokenizer, n_slots=args.slots,
+                max_cache_len=args.max_cache_len,
+            )
         )
     server = make_server(
         generator, host=args.host, port=args.port, model_name=cfg.name,

@@ -60,6 +60,19 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
     if getattr(hf_config, "num_local_experts", 0):  # Mixtral
         kwargs["num_experts"] = hf_config.num_local_experts
         kwargs["num_experts_per_tok"] = hf_config.num_experts_per_tok
+    scaling = getattr(hf_config, "rope_scaling", None)
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        kwargs["rope_scaling_factor"] = scaling["factor"]
+        kwargs["rope_scaling_low_freq_factor"] = scaling["low_freq_factor"]
+        kwargs["rope_scaling_high_freq_factor"] = scaling["high_freq_factor"]
+        kwargs["rope_scaling_original_max_len"] = scaling[
+            "original_max_position_embeddings"
+        ]
+    elif scaling:
+        raise ValueError(
+            f"unsupported rope_scaling type {scaling!r} (only 'llama3' NTK "
+            "scaling is implemented)"
+        )
     kwargs.update(overrides)
     return ModelConfig(**kwargs)
 
@@ -227,6 +240,16 @@ def export_hf_model(params: Mapping[str, Any], cfg: ModelConfig, path: str) -> N
         rope_theta=cfg.rope_theta,
         tie_word_embeddings=cfg.tie_embeddings,
     )
+    if cfg.rope_scaling_factor > 0:
+        # Round-trip the Llama-3.1 NTK scaling — omitting it would make the
+        # exported model compute different (unscaled) RoPE than this one.
+        common["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": cfg.rope_scaling_factor,
+            "low_freq_factor": cfg.rope_scaling_low_freq_factor,
+            "high_freq_factor": cfg.rope_scaling_high_freq_factor,
+            "original_max_position_embeddings": cfg.rope_scaling_original_max_len,
+        }
     if cfg.num_experts > 0:
         hf_cfg = MixtralConfig(
             num_local_experts=cfg.num_experts,

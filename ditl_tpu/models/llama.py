@@ -156,13 +156,32 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     return (out * scale.astype(jnp.float32)).astype(x.dtype)
 
 
-def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
-    return 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+def rope_frequencies(head_dim: int, theta: float, cfg: ModelConfig | None = None) -> jax.Array:
+    """Inverse RoPE frequencies; applies Llama-3.1 NTK scaling when
+    ``cfg.rope_scaling_factor > 0`` (same piecewise-by-wavelength rule as
+    HF's "llama3" rope_scaling: long wavelengths divided by ``factor``,
+    short ones untouched, a smooth interpolation between)."""
+    inv_freq = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if cfg is None or cfg.rope_scaling_factor <= 0:
+        return inv_freq
+    factor = cfg.rope_scaling_factor
+    low_f, high_f = cfg.rope_scaling_low_freq_factor, cfg.rope_scaling_high_freq_factor
+    old_len = cfg.rope_scaling_original_max_len
+    wavelen = 2.0 * math.pi / inv_freq
+    scaled = jnp.where(wavelen > old_len / low_f, inv_freq / factor, inv_freq)
+    smooth = (old_len / wavelen - low_f) / (high_f - low_f)
+    smoothed = (1.0 - smooth) * scaled / factor + smooth * scaled
+    medium = (wavelen >= old_len / high_f) & (wavelen <= old_len / low_f)
+    return jnp.where(medium, smoothed, scaled)
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float, cfg: ModelConfig | None = None
+) -> jax.Array:
     """Rotary position embedding. x: (B, S, H, D); positions: (B, S)."""
-    freqs = rope_frequencies(x.shape[-1], theta)  # (D/2,)
+    freqs = rope_frequencies(x.shape[-1], theta, cfg)  # (D/2,)
     angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, D/2)
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -256,8 +275,8 @@ def _decoder_layer(
     q = proj(h, attn["wq"], "wq").reshape(b, s, nh, hd)
     k = proj(h, attn["wk"], "wk").reshape(b, s, nkv, hd)
     v = proj(h, attn["wv"], "wv").reshape(b, s, nkv, hd)
-    q = apply_rope(q, positions, cfg.rope_theta)
-    k = apply_rope(k, positions, cfg.rope_theta)
+    q = apply_rope(q, positions, cfg.rope_theta, cfg)
+    k = apply_rope(k, positions, cfg.rope_theta, cfg)
     q = _constrain(q, ("batch", "seq", "act_heads", "head_dim"), mesh, rules)
     k = _constrain(k, ("batch", "seq", "act_kv_heads", "head_dim"), mesh, rules)
     new_kv = None

@@ -21,8 +21,9 @@ PRESETS: dict[str, ModelConfig] = {
         num_heads=32,
         num_kv_heads=8,
         head_dim=128,
-        max_seq_len=8192,
+        max_seq_len=131072,  # Llama-3.1 long context via NTK rope scaling
         rope_theta=500000.0,
+        rope_scaling_factor=8.0,
     ),
     "llama3-70b": ModelConfig(
         name="llama3-70b",
@@ -33,8 +34,9 @@ PRESETS: dict[str, ModelConfig] = {
         num_heads=64,
         num_kv_heads=8,
         head_dim=128,
-        max_seq_len=8192,
+        max_seq_len=131072,  # Llama-3.1 long context via NTK rope scaling
         rope_theta=500000.0,
+        rope_scaling_factor=8.0,
     ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",

@@ -241,3 +241,16 @@ def test_server_sse_streaming(setup):
     finally:
         server.shutdown()
         threaded.close()
+
+
+def test_max_cache_len_caps_allocation(setup):
+    params, cfg, tok = setup
+    eng = ContinuousEngine(params, cfg, tok, n_slots=2, max_cache_len=32)
+    assert eng.cache["k"].shape[2] == 32
+    with pytest.raises(ValueError, match="cache cap"):
+        eng.submit([1] * 20, max_new_tokens=20)
+    # Within the cap everything still works.
+    gen = GenerateConfig(max_new_tokens=6, temperature=0.0)
+    ref = Generator(params, cfg, tok).generate(["hi"], gen)
+    eng2 = ContinuousEngine(params, cfg, tok, n_slots=2, max_cache_len=32, gen=gen)
+    assert eng2.generate(["hi"]) == ref

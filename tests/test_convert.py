@@ -248,3 +248,73 @@ def test_export_rejects_unmerged_lora():
     params = llama.init_params(jax.random.key(0), cfg)
     with pytest.raises(ValueError, match="merge_lora"):
         state_dict_from_params(params, cfg)
+
+
+def test_llama31_rope_scaling_parity():
+    """HF 'llama3' rope_scaling (the Llama-3.1 long-context NTK scheme) is
+    reproduced exactly — including at positions past the original context."""
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 4.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+    )
+    torch.manual_seed(3)
+    model = transformers.LlamaForCausalLM(cfg_hf).eval()
+    cfg = config_from_hf(model.config, dtype="float32")
+    assert cfg.rope_scaling_factor == 4.0
+    assert cfg.rope_scaling_original_max_len == 32
+    params = params_from_state_dict(model.state_dict(), cfg)
+
+    rng = np.random.default_rng(5)
+    # 96 tokens: well past the 32-token original context, where scaling bites.
+    ids = rng.integers(0, 256, size=(1, 96)).astype(np.int64)
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(ids, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_unsupported_rope_scaling_rejected():
+    cfg_hf = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128,
+        rope_scaling={"rope_type": "yarn", "factor": 2.0},
+    )
+    with pytest.raises(ValueError, match="unsupported rope_scaling"):
+        config_from_hf(cfg_hf)
+
+
+def test_export_roundtrips_rope_scaling(tmp_path):
+    """Exported HF config carries the llama3 rope_scaling block."""
+    import dataclasses
+
+    import jax
+
+    from ditl_tpu.models.convert import export_hf_model
+
+    cfg = dataclasses.replace(
+        config_from_hf(_tiny_hf_llama().config, dtype="float32"),
+        rope_scaling_factor=8.0,
+        rope_scaling_original_max_len=32,
+    )
+    params = llama.init_params(jax.random.key(9), cfg)
+    export_hf_model(params, cfg, str(tmp_path / "scaled"))
+    reloaded = transformers.AutoConfig.from_pretrained(
+        str(tmp_path / "scaled"), local_files_only=True
+    )
+    assert reloaded.rope_scaling is not None
+    assert reloaded.rope_scaling.get("rope_type") == "llama3"
+    assert reloaded.rope_scaling["factor"] == 8.0
