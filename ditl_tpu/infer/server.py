@@ -283,9 +283,10 @@ def serve(argv: list[str] | None = None) -> int:
         help="weight-only int8 (halves decode HBM reads; ops/quant.py)",
     )
     parser.add_argument(
-        "--max-cache-len", type=int, default=4096,
-        help="per-slot KV cache cap for --engine continuous (long-context "
-        "models would otherwise allocate max_seq_len-sized caches)",
+        "--max-cache-len", type=int, default=0,
+        help="per-slot KV cache cap for --engine continuous; 0 = model "
+        "max_seq_len (set this for long-context presets like llama31-8b, "
+        "whose 131072-token cache would be ~17 GB per slot)",
     )
     args = parser.parse_args(argv)
 
@@ -321,7 +322,7 @@ def serve(argv: list[str] | None = None) -> int:
         threaded = ThreadedEngine(
             ContinuousEngine(
                 params, cfg, tokenizer, n_slots=args.slots,
-                max_cache_len=args.max_cache_len,
+                max_cache_len=args.max_cache_len or None,
             )
         )
     server = make_server(

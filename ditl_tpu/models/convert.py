@@ -272,6 +272,53 @@ def export_hf_model(params: Mapping[str, Any], cfg: ModelConfig, path: str) -> N
     model.save_pretrained(path)
 
 
+def main(argv: list[str] | None = None) -> int:
+    """CLI: convert an Orbax training checkpoint to a HF checkpoint dir.
+
+        python -m ditl_tpu.models.convert \\
+            --checkpoint-dir /mnt/ckpt --preset llama3-8b --out /mnt/hf_export
+
+    LoRA runs are merged automatically (models/lora.py) before export.
+    """
+    import argparse
+
+    import jax
+
+    from ditl_tpu.models import llama
+    from ditl_tpu.models.presets import get_preset
+    from ditl_tpu.train.checkpoint import CheckpointManager
+    from ditl_tpu.utils.logging import get_logger, setup_logging
+
+    setup_logging()
+    logger = get_logger(__name__)
+    parser = argparse.ArgumentParser(prog="ditl_tpu.models.convert")
+    parser.add_argument("--checkpoint-dir", required=True)
+    parser.add_argument("--preset", required=True)
+    parser.add_argument("--out", required=True)
+    parser.add_argument("--lora-rank", type=int, default=0,
+                        help="set if the checkpoint was a LoRA fine-tune")
+    args = parser.parse_args(argv)
+
+    cfg = get_preset(args.preset, lora_rank=args.lora_rank)
+    abstract = jax.eval_shape(lambda: llama.init_params(jax.random.key(0), cfg))
+    mgr = CheckpointManager(args.checkpoint_dir)
+    params = mgr.restore_latest_params(abstract)
+    mgr.close()
+    if params is None:
+        raise SystemExit(f"no checkpoint found in {args.checkpoint_dir}")
+    if cfg.lora_rank > 0:
+        from ditl_tpu.models.lora import merge_lora
+
+        logger.info("merging LoRA adapters (rank %d) into base weights", cfg.lora_rank)
+        params = merge_lora(params, cfg)
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, lora_rank=0)
+    export_hf_model(params, cfg, args.out)
+    logger.info("exported HF checkpoint to %s", args.out)
+    return 0
+
+
 def load_hf_model(model_or_path: Any, **config_overrides):
     """Convenience: a ``transformers`` model instance *or* a local checkpoint
     path -> ``(params, ModelConfig)``. Network access is never attempted for
@@ -290,3 +337,9 @@ def load_hf_model(model_or_path: Any, **config_overrides):
     cfg = config_from_hf(model.config, **config_overrides)
     params = params_from_state_dict(model.state_dict(), cfg)
     return params, cfg
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

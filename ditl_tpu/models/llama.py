@@ -156,11 +156,19 @@ def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
     return (out * scale.astype(jnp.float32)).astype(x.dtype)
 
 
-def rope_frequencies(head_dim: int, theta: float, cfg: ModelConfig | None = None) -> jax.Array:
+def rope_frequencies(
+    head_dim: int, theta: float | None = None, cfg: ModelConfig | None = None
+) -> jax.Array:
     """Inverse RoPE frequencies; applies Llama-3.1 NTK scaling when
     ``cfg.rope_scaling_factor > 0`` (same piecewise-by-wavelength rule as
     HF's "llama3" rope_scaling: long wavelengths divided by ``factor``,
-    short ones untouched, a smooth interpolation between)."""
+    short ones untouched, a smooth interpolation between). With ``cfg``
+    given, theta comes from the config — one source of truth for both the
+    base frequencies and the scaling wavelength bands."""
+    if cfg is not None:
+        theta = cfg.rope_theta
+    if theta is None:
+        raise ValueError("rope_frequencies needs theta or cfg")
     inv_freq = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
@@ -178,9 +186,13 @@ def rope_frequencies(head_dim: int, theta: float, cfg: ModelConfig | None = None
 
 
 def apply_rope(
-    x: jax.Array, positions: jax.Array, theta: float, cfg: ModelConfig | None = None
+    x: jax.Array,
+    positions: jax.Array,
+    theta: float | None = None,
+    cfg: ModelConfig | None = None,
 ) -> jax.Array:
-    """Rotary position embedding. x: (B, S, H, D); positions: (B, S)."""
+    """Rotary position embedding. x: (B, S, H, D); positions: (B, S).
+    Pass ``cfg`` (theta + scaling from config) or a bare ``theta``."""
     freqs = rope_frequencies(x.shape[-1], theta, cfg)  # (D/2,)
     angles = positions[..., None].astype(jnp.float32) * freqs  # (B, S, D/2)
     cos = jnp.cos(angles)[:, :, None, :]
@@ -275,8 +287,8 @@ def _decoder_layer(
     q = proj(h, attn["wq"], "wq").reshape(b, s, nh, hd)
     k = proj(h, attn["wk"], "wk").reshape(b, s, nkv, hd)
     v = proj(h, attn["wv"], "wv").reshape(b, s, nkv, hd)
-    q = apply_rope(q, positions, cfg.rope_theta, cfg)
-    k = apply_rope(k, positions, cfg.rope_theta, cfg)
+    q = apply_rope(q, positions, cfg=cfg)
+    k = apply_rope(k, positions, cfg=cfg)
     q = _constrain(q, ("batch", "seq", "act_heads", "head_dim"), mesh, rules)
     k = _constrain(k, ("batch", "seq", "act_kv_heads", "head_dim"), mesh, rules)
     new_kv = None

@@ -21,9 +21,8 @@ PRESETS: dict[str, ModelConfig] = {
         num_heads=32,
         num_kv_heads=8,
         head_dim=128,
-        max_seq_len=131072,  # Llama-3.1 long context via NTK rope scaling
+        max_seq_len=8192,
         rope_theta=500000.0,
-        rope_scaling_factor=8.0,
     ),
     "llama3-70b": ModelConfig(
         name="llama3-70b",
@@ -34,7 +33,34 @@ PRESETS: dict[str, ModelConfig] = {
         num_heads=64,
         num_kv_heads=8,
         head_dim=128,
-        max_seq_len=131072,  # Llama-3.1 long context via NTK rope scaling
+        max_seq_len=8192,
+        rope_theta=500000.0,
+    ),
+    # Llama-3.1: long context via NTK rope scaling (separate names so
+    # checkpoints trained under the 3.0-style presets keep their RoPE).
+    "llama31-8b": ModelConfig(
+        name="llama31-8b",
+        vocab_size=128256,
+        hidden_size=4096,
+        intermediate_size=14336,
+        num_layers=32,
+        num_heads=32,
+        num_kv_heads=8,
+        head_dim=128,
+        max_seq_len=131072,
+        rope_theta=500000.0,
+        rope_scaling_factor=8.0,
+    ),
+    "llama31-70b": ModelConfig(
+        name="llama31-70b",
+        vocab_size=128256,
+        hidden_size=8192,
+        intermediate_size=28672,
+        num_layers=80,
+        num_heads=64,
+        num_kv_heads=8,
+        head_dim=128,
+        max_seq_len=131072,
         rope_theta=500000.0,
         rope_scaling_factor=8.0,
     ),

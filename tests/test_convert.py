@@ -318,3 +318,44 @@ def test_export_roundtrips_rope_scaling(tmp_path):
     assert reloaded.rope_scaling is not None
     assert reloaded.rope_scaling.get("rope_type") == "llama3"
     assert reloaded.rope_scaling["factor"] == 8.0
+
+
+def test_export_cli_from_orbax_checkpoint(tmp_path):
+    """Orbax training checkpoint -> `python -m ditl_tpu.models.convert` ->
+    loadable HF directory (full train-to-serve-anywhere workflow)."""
+    import jax
+
+    from ditl_tpu.models.convert import main as convert_main
+    from ditl_tpu.models.presets import PRESETS
+    from ditl_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+    from ditl_tpu.train.trainer import train
+
+    model = ModelConfig(
+        name="tiny-export", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=64,
+    )
+    PRESETS["tiny-export"] = model  # register so the CLI can resolve it
+    try:
+        train(
+            Config(
+                model=model,
+                data=DataConfig(synthetic=True, synthetic_examples=64,
+                                batch_size=8, seq_len=32, num_epochs=1),
+                train=TrainConfig(total_steps=2, warmup_steps=1, log_every=100,
+                                  checkpoint_dir=str(tmp_path / "ckpt"),
+                                  checkpoint_every=1),
+            )
+        )
+        rc = convert_main([
+            "--checkpoint-dir", str(tmp_path / "ckpt"),
+            "--preset", "tiny-export",
+            "--out", str(tmp_path / "hf_out"),
+        ])
+        assert rc == 0
+        reloaded = transformers.AutoModelForCausalLM.from_pretrained(
+            str(tmp_path / "hf_out"), local_files_only=True
+        )
+        assert reloaded.config.vocab_size == 512
+    finally:
+        PRESETS.pop("tiny-export", None)
