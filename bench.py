@@ -8,6 +8,8 @@ publishes no performance numbers (SURVEY.md §6, ``BASELINE.json.published ==
 BASELINE.md — 1.0 until a prior round's number exists to compare against.
 
 Prints exactly ONE JSON line to stdout; all logging goes to stderr.
+``--infer`` switches to the decode benchmark (tokens/sec, lock-step
+Generator, optionally ``--quantize int8``) — same one-JSON-line contract.
 """
 
 from __future__ import annotations
@@ -16,6 +18,54 @@ import json
 import statistics
 import sys
 import time
+
+
+def bench_infer(quantize: bool) -> int:
+    import jax
+
+    from ditl_tpu.config import ModelConfig
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.infer.engine import GenerateConfig, Generator
+    from ditl_tpu.models import llama
+
+    platform = jax.devices()[0].platform
+    cfg = ModelConfig(
+        name="bench-420m", vocab_size=32768, hidden_size=1024,
+        intermediate_size=2816, num_layers=24, num_heads=16, num_kv_heads=8,
+        head_dim=64, max_seq_len=1024, dtype="bfloat16", param_dtype="float32",
+        attention_impl="xla",
+    )
+    batch, max_new = (8, 128) if platform == "tpu" else (2, 16)
+    if platform != "tpu":
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, num_layers=2, hidden_size=256,
+                                  intermediate_size=688, vocab_size=4096)
+    params = llama.init_params(jax.random.key(0), cfg)
+    if quantize:
+        from ditl_tpu.ops.quant import quantize_weights
+
+        params = quantize_weights(params)
+    tok = ByteTokenizer()
+    prompts = [[tok.bos_id] + list(range(10, 70))] * batch
+    gen = GenerateConfig(max_new_tokens=max_new, temperature=1.0, seed=1)
+    g = Generator(params, cfg, tok)
+    g.generate_tokens(prompts, gen)  # compile
+    times = []
+    for _ in range(3):
+        t = time.perf_counter()
+        g.generate_tokens(prompts, gen)
+        times.append(time.perf_counter() - t)
+    dt = statistics.median(times)
+    print(json.dumps({
+        "metric": "decode tokens/sec (Llama-style 420M, batch %d%s)" % (
+            batch, ", int8" if quantize else ""),
+        "value": round(max_new * batch / dt, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+        "platform": platform,
+    }))
+    return 0
 
 
 def main() -> int:
@@ -121,4 +171,16 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="bench.py")
+    parser.add_argument("--infer", action="store_true",
+                        help="decode benchmark instead of the fine-tune one")
+    parser.add_argument("--quantize", choices=("int8",), default=None,
+                        help="weight-only quantization (only with --infer)")
+    args = parser.parse_args()
+    if args.quantize and not args.infer:
+        parser.error("--quantize requires --infer")
+    if args.infer:
+        sys.exit(bench_infer(quantize=args.quantize == "int8"))
     sys.exit(main())

@@ -297,9 +297,13 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--out", required=True)
     parser.add_argument("--lora-rank", type=int, default=0,
                         help="set if the checkpoint was a LoRA fine-tune")
+    parser.add_argument("--lora-alpha", type=float, default=16.0,
+                        help="must match the training run's model.lora_alpha "
+                        "(the merge scale is alpha/rank)")
     args = parser.parse_args(argv)
 
-    cfg = get_preset(args.preset, lora_rank=args.lora_rank)
+    cfg = get_preset(args.preset, lora_rank=args.lora_rank,
+                     lora_alpha=args.lora_alpha)
     abstract = jax.eval_shape(lambda: llama.init_params(jax.random.key(0), cfg))
     mgr = CheckpointManager(args.checkpoint_dir)
     params = mgr.restore_latest_params(abstract)

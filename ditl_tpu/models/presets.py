@@ -1,4 +1,11 @@
-"""Named model presets for the BASELINE.json target configs."""
+"""Named model presets for the BASELINE.json target configs.
+
+Preset definitions are part of checkpoint provenance: serving or exporting a
+checkpoint under a preset whose architecture/RoPE fields changed since
+training silently changes the math (RoPE scaling and context length are not
+stored in the param tree, so restore cannot detect it). Treat existing preset
+names as frozen — new variants get NEW names (e.g. llama31-8b vs llama3-8b).
+"""
 
 from __future__ import annotations
 

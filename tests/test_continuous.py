@@ -254,3 +254,38 @@ def test_max_cache_len_caps_allocation(setup):
     ref = Generator(params, cfg, tok).generate(["hi"], gen)
     eng2 = ContinuousEngine(params, cfg, tok, n_slots=2, max_cache_len=32, gen=gen)
     assert eng2.generate(["hi"]) == ref
+
+
+def test_server_sse_streaming_lockstep_fallback(setup):
+    """Without a continuous engine, streaming still speaks SSE (one chunk)."""
+    import http.client
+    import json as _json
+    import threading
+
+    from ditl_tpu.infer.server import make_server
+
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=8, temperature=0.0)
+    server = make_server(
+        Generator(params, cfg, tok), host="127.0.0.1", port=0,
+        default_max_tokens=8,
+    )
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request(
+            "POST", "/v1/completions",
+            body=_json.dumps({"prompt": "lockstep", "max_tokens": 8, "stream": True}),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        raw = resp.read().decode()
+        events = [l[len("data: "):] for l in raw.splitlines() if l.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        text = "".join(_json.loads(e)["choices"][0]["text"] for e in events[:-1])
+        ref = Generator(params, cfg, tok).generate(["lockstep"], gen)[0]
+        assert text == ref
+    finally:
+        server.shutdown()
