@@ -148,7 +148,8 @@ class ModelConfig:
     lora_rank: int = 0
     lora_alpha: float = 16.0
     lora_dropout: float = 0.0
-    # Attention implementation: "xla" | "flash" (Pallas) | "ring" (SP ring attention)
+    # Attention implementation: "xla" | "flash" (Pallas) | "ring" (SP ring
+    # attention) | "ulysses" (SP via all-to-all head/sequence transposition)
     attention_impl: str = "xla"
     # Gradient checkpointing policy for the layer scan:
     # "none" | "full" | "dots" | "attn" (save only attention outputs, so the

@@ -2,7 +2,7 @@
 
 The reference contains no attention code at all (SURVEY.md §5 'long-context':
 the 70B model lives behind an HTTP API). Here attention is a first-class op
-with three interchangeable implementations selected by
+with four interchangeable implementations selected by
 ``ModelConfig.attention_impl``:
 
 - ``"xla"``:   einsum + softmax, fully fused by XLA. Correctness reference.
@@ -10,6 +10,8 @@ with three interchangeable implementations selected by
                tiles sized for MXU/VMEM (ops/flash_attention.py).
 - ``"ring"``:  ring attention over the ``sequence`` mesh axis for contexts
                longer than one chip's HBM (ops/ring_attention.py).
+- ``"ulysses"``: all-to-all sequence parallelism over the same axis — heads
+               re-sharded instead of KV rotated (ops/ulysses.py).
 
 All take GQA-layout tensors: q ``(B, S, H, D)``, k/v ``(B, S, K, D)`` with
 ``H % K == 0``; softmax is computed in float32 regardless of input dtype.
@@ -103,6 +105,12 @@ def dot_product_attention(
         from ditl_tpu.ops.ring_attention import ring_attention
 
         return ring_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, mesh=mesh, rules=rules
+        )
+    if impl == "ulysses":
+        from ditl_tpu.ops.ulysses import ulysses_attention
+
+        return ulysses_attention(
             q, k, v, causal=causal, segment_ids=segment_ids, mesh=mesh, rules=rules
         )
     if impl == "flash":

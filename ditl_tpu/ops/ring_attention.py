@@ -156,7 +156,7 @@ def ring_attention(
     with the surrounding sharding constraints. Falls back to the XLA
     implementation when there is no mesh or the sequence axis has size 1.
     """
-    from ditl_tpu.ops.attention import _xla_attention
+    from ditl_tpu.ops.attention import _mesh_axes_size, _xla_attention
     from ditl_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
 
     rules = rules if rules is not None else DEFAULT_RULES
@@ -167,6 +167,17 @@ def ring_attention(
         or axis_name not in mesh.shape
         or mesh.shape[axis_name] == 1
     ):
+        return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    dp = _mesh_axes_size(mesh, rules.get("batch"))
+    tp = _mesh_axes_size(mesh, rules.get("act_heads"))
+    if (
+        q.shape[0] % dp
+        or q.shape[2] % tp
+        or k.shape[2] % tp
+        or q.shape[1] % mesh.shape[axis_name]
+    ):
+        # Batch/heads/seq don't divide the mesh: shard_map would fail at trace
+        # time. XLA's GSPMD attention partitions any layout (at more comms).
         return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
     qkv_spec = logical_to_spec(("batch", "seq", "act_heads", None), rules)
