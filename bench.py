@@ -20,7 +20,7 @@ import sys
 import time
 
 
-def bench_infer(quantize: bool) -> int:
+def bench_infer(quantize: bool, kv_quant: bool = False) -> int:
     import jax
 
     from ditl_tpu.config import ModelConfig
@@ -33,7 +33,7 @@ def bench_infer(quantize: bool) -> int:
         name="bench-420m", vocab_size=32768, hidden_size=1024,
         intermediate_size=2816, num_layers=24, num_heads=16, num_kv_heads=8,
         head_dim=64, max_seq_len=1024, dtype="bfloat16", param_dtype="float32",
-        attention_impl="xla",
+        attention_impl="xla", kv_cache_dtype="int8" if kv_quant else "",
     )
     batch, max_new = (8, 128) if platform == "tpu" else (2, 16)
     if platform != "tpu":
@@ -58,8 +58,9 @@ def bench_infer(quantize: bool) -> int:
         times.append(time.perf_counter() - t)
     dt = statistics.median(times)
     print(json.dumps({
-        "metric": "decode tokens/sec (Llama-style 420M, batch %d%s)" % (
-            batch, ", int8" if quantize else ""),
+        "metric": "decode tokens/sec (Llama-style 420M, batch %d%s%s)" % (
+            batch, ", int8" if quantize else "",
+            ", int8-kv" if kv_quant else ""),
         "value": round(max_new * batch / dt, 1),
         "unit": "tokens/sec",
         "vs_baseline": 1.0,
@@ -178,9 +179,12 @@ if __name__ == "__main__":
                         help="decode benchmark instead of the fine-tune one")
     parser.add_argument("--quantize", choices=("int8",), default=None,
                         help="weight-only quantization (only with --infer)")
+    parser.add_argument("--kv-quant", choices=("int8",), default=None,
+                        help="int8 KV-cache quantization (only with --infer)")
     args = parser.parse_args()
-    if args.quantize and not args.infer:
-        parser.error("--quantize requires --infer")
+    if (args.quantize or args.kv_quant) and not args.infer:
+        parser.error("--quantize/--kv-quant require --infer")
     if args.infer:
-        sys.exit(bench_infer(quantize=args.quantize == "int8"))
+        sys.exit(bench_infer(quantize=args.quantize == "int8",
+                             kv_quant=args.kv_quant == "int8"))
     sys.exit(main())

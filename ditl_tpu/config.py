@@ -151,6 +151,9 @@ class ModelConfig:
     # Attention implementation: "xla" | "flash" (Pallas) | "ring" (SP ring
     # attention) | "ulysses" (SP via all-to-all head/sequence transposition)
     attention_impl: str = "xla"
+    # KV-cache storage for inference: "" / "model" (compute dtype, bf16 on
+    # TPU) | "int8" (symmetric per-head absmax quantization, infer/cache.py)
+    kv_cache_dtype: str = ""
     # Gradient checkpointing policy for the layer scan:
     # "none" | "full" | "dots" | "attn" (save only attention outputs, so the
     # backward never re-runs the attention kernel).
@@ -220,6 +223,15 @@ class TrainConfig:
     checkpoint_every: int = 0
     keep_checkpoints: int = 3
     resume: bool = True  # resume from latest checkpoint if present
+    # Elastic recovery (launch.run_supervised — the torchrun --max_restarts
+    # analog the reference never configured, SURVEY.md §5 'failure
+    # detection'): on an unhandled training exception, re-enter train() up to
+    # this many times, resuming from the latest checkpoint. 0 => fail fast.
+    max_restarts: int = 0
+    # Fault injection for drilling the recovery path: raise at this global
+    # step on the FIRST run (never after a resume). 0 => off. Pick a step
+    # past checkpoint_every so the restart has something to resume from.
+    fault_inject_step: int = 0
     # Path to a local HF checkpoint directory (transformers format) to
     # initialize parameters from instead of random init (models/convert.py).
     init_from_hf: str = ""

@@ -11,7 +11,7 @@ wait for the batch to drain. This engine removes both limits the TPU way:
   array (cache, positions, tokens) has a static shape, so exactly TWO
   programs compile — one prefill per prompt-length bucket, one decode tick.
 - **Per-slot depth**: each slot sits at its own position; the cache write is
-  a per-row scatter (models/llama.py ``_scatter_rows``) and the attention
+  a per-row scatter (infer/cache.py ``_scatter_rows``) and the attention
   mask is ``slot_index <= pos[row]`` — no re-padding, no re-batching.
 - **Prefill into a slot**: a new prompt runs one batched forward over its
   length bucket against a 1-row slice of the shared cache, then the slice is

@@ -283,6 +283,10 @@ def serve(argv: list[str] | None = None) -> int:
         help="weight-only int8 (halves decode HBM reads; ops/quant.py)",
     )
     parser.add_argument(
+        "--kv-quant", choices=("none", "int8"), default="none",
+        help="int8 KV cache (halves cache reads/footprint; infer/cache.py)",
+    )
+    parser.add_argument(
         "--max-cache-len", type=int, default=0,
         help="per-slot KV cache cap for --engine continuous; 0 = model "
         "max_seq_len (set this for long-context presets like llama31-8b, "
@@ -298,6 +302,10 @@ def serve(argv: list[str] | None = None) -> int:
         return 0
 
     cfg = get_preset(args.preset) if args.preset else ModelConfig()
+    if args.kv_quant == "int8":
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
     tokenizer = get_tokenizer(args.tokenizer)
     params = llama.init_params(jax.random.key(0), cfg)
     if args.checkpoint_dir:

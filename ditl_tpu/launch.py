@@ -77,12 +77,44 @@ def build_config(argv: list[str] | None = None) -> Config:
     return config
 
 
-def main(argv: list[str] | None = None) -> int:
-    config = build_config(argv)
+def run_supervised(config: Config) -> dict:
+    """Restart supervisor — the analog of torchrun's elastic ``--max_restarts``
+    (which the reference launches through but never configures, ref
+    ``scripts/run_node0.sh:10``, SURVEY.md §5 'failure detection'). On an
+    unhandled training exception, re-enters ``train()`` up to
+    ``train.max_restarts`` times; each retry resumes from the latest Orbax
+    checkpoint (``init_runtime`` is idempotent, so re-entry is in-process).
+    Recovery requires somewhere to recover FROM: without ``checkpoint_dir`` +
+    ``resume`` the exception propagates immediately."""
+    import logging
+
     from ditl_tpu.train.trainer import train
 
+    restarts = 0
+    while True:
+        try:
+            summary = train(config)
+            summary["restarts"] = restarts
+            return summary
+        except Exception:
+            if (
+                restarts >= config.train.max_restarts
+                or not config.train.checkpoint_dir
+                or not config.train.resume
+            ):
+                raise
+            restarts += 1
+            logging.getLogger(__name__).exception(
+                "training failed; restart %d/%d from latest checkpoint",
+                restarts,
+                config.train.max_restarts,
+            )
+
+
+def main(argv: list[str] | None = None) -> int:
+    config = build_config(argv)
     try:
-        summary = train(config)
+        summary = run_supervised(config)
     except Exception:
         import logging
 

@@ -214,16 +214,6 @@ def _constrain(x: jax.Array, logical_axes, mesh, rules):
     )
 
 
-def _scatter_rows(cache: jax.Array, chunk: jax.Array, idx: jax.Array) -> jax.Array:
-    """Write ``chunk`` (B, S, K, D) into ``cache`` (B, Smax, K, D) at per-row
-    slot offsets ``idx`` (B,). Used by the continuous-batching decode path
-    where each sequence sits at a different depth."""
-    b, s = chunk.shape[:2]
-    rows = jnp.arange(b, dtype=jnp.int32)[:, None]  # (B, 1)
-    cols = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # (B, S)
-    return cache.at[rows, cols].set(chunk.astype(cache.dtype))
-
-
 def _apply_remat(layer_fn, cfg: ModelConfig):
     """Wrap a layer body with the configured rematerialization policy."""
     if cfg.remat == "full":
@@ -258,12 +248,13 @@ def _decoder_layer(
     segment_ids: jax.Array | None,
     mesh,
     rules,
-    layer_cache: tuple[jax.Array, jax.Array] | None = None,
+    layer_cache: dict | None = None,
     cache_index: jax.Array | None = None,
     attn_mask: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, tuple[jax.Array, jax.Array]]:
-    """One decoder block. With ``layer_cache`` (this layer's (k, v) cache,
-    shape (B, Smax, K, D)), the chunk's keys/values are written at slot
+) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, dict]:
+    """One decoder block. With ``layer_cache`` (this layer's slice of the KV
+    cache pytree, values shaped (B, Smax, K, D) — plus scales when int8,
+    infer/cache.py), the chunk's keys/values are written at slot
     ``cache_index`` and attention runs against the whole cache under
     ``attn_mask`` — the KV-cache prefill/decode path (infer/engine.py)."""
     b, s, d = x.shape
@@ -293,25 +284,23 @@ def _decoder_layer(
     k = _constrain(k, ("batch", "seq", "act_kv_heads", "head_dim"), mesh, rules)
     new_kv = None
     if layer_cache is not None:
-        k_cache, v_cache = layer_cache
-        idx = jnp.asarray(cache_index, jnp.int32)
-        if idx.ndim == 1:
-            # Per-slot write position (continuous batching: every sequence is
-            # at a different decode depth). One scatter per layer; S must be 1.
-            k_full = _scatter_rows(k_cache, k, idx)
-            v_full = _scatter_rows(v_cache, v, idx)
+        from ditl_tpu.infer.cache import read_kv, write_kv
+
+        new_kv = write_kv(layer_cache, k, v, cache_index)
+        if "k_scale" in new_kv:
+            # int8 cache: hand the raw int8 values + scales to attention so
+            # the dequant fuses into the dots (HBM reads stay int8-sized).
+            attn_out = dot_product_attention(
+                q, new_kv["k"], new_kv["v"], causal=False, mask=attn_mask,
+                impl=cfg.attention_impl, mesh=mesh, rules=rules,
+                k_scale=new_kv["k_scale"], v_scale=new_kv["v_scale"],
+            )
         else:
-            k_full = jax.lax.dynamic_update_slice(
-                k_cache, k.astype(k_cache.dtype), (0, idx, 0, 0)
+            k_full, v_full = read_kv(new_kv, cd)
+            attn_out = dot_product_attention(
+                q, k_full, v_full, causal=False, mask=attn_mask,
+                impl=cfg.attention_impl, mesh=mesh, rules=rules,
             )
-            v_full = jax.lax.dynamic_update_slice(
-                v_cache, v.astype(v_cache.dtype), (0, idx, 0, 0)
-            )
-        new_kv = (k_full, v_full)
-        attn_out = dot_product_attention(
-            q, k_full, v_full, causal=False, mask=attn_mask,
-            impl=cfg.attention_impl, mesh=mesh, rules=rules,
-        )
     else:
         attn_out = dot_product_attention(
             q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
@@ -387,8 +376,8 @@ def forward(
 
     if cache is not None:
         def cached_layer_fn(carry, xs):
-            layer_params, k_cache, v_cache = xs
-            y, aux, (new_k, new_v) = _decoder_layer(
+            layer_params, layer_cache = xs
+            y, aux, new_kv = _decoder_layer(
                 layer_params,
                 carry,
                 cfg=cfg,
@@ -396,16 +385,15 @@ def forward(
                 segment_ids=segment_ids,
                 mesh=mesh,
                 rules=rules,
-                layer_cache=(k_cache, v_cache),
+                layer_cache=layer_cache,
                 cache_index=cache_index,
                 attn_mask=attn_mask,
             )
-            return y, (aux, new_k, new_v)
+            return y, (aux, new_kv)
 
-        x, (layer_aux, new_k, new_v) = jax.lax.scan(
-            cached_layer_fn, x, (params["layers"], cache["k"], cache["v"])
+        x, (layer_aux, new_cache) = jax.lax.scan(
+            cached_layer_fn, x, (params["layers"], cache)
         )
-        new_cache = {"k": new_k, "v": new_v}
     elif mesh is not None and mesh.shape.get("stage", 1) > 1:
         # Pipeline parallelism: layers are stage-sharded; microbatches flow
         # through the stages via ppermute (parallel/pipeline.py). Layer bodies

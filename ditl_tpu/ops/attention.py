@@ -35,16 +35,30 @@ def _xla_attention(
     causal: bool,
     segment_ids: jax.Array | None,
     mask: jax.Array | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
+    """``k_scale``/``v_scale`` (B, Skv, K) mark k/v as int8-quantized
+    (infer/cache.py). The scales are factored OUT of the dots: the score
+    matmul consumes raw int8 K (the int8->bf16 convert fuses into the dot's
+    operand read, so HBM traffic stays int8-sized) and the per-slot scale
+    multiplies the (B,K,G,Sq,Skv) score tile afterwards; likewise V's scale
+    folds into the probabilities. Dequantizing before the dot instead would
+    materialize a full bf16 cache copy in HBM and forfeit the bandwidth win."""
     b, s_q, h, d = q.shape
     _, s_kv, kv_heads, _ = k.shape
     groups = h // kv_heads
     qg = q.reshape(b, s_q, kv_heads, groups, d)
     scale = d**-0.5
+    if k_scale is not None:
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
     # (B, K, G, Sq, Skv) scores; accumulate in f32 on the MXU.
     scores = jnp.einsum(
         "bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32
     ) * scale
+    if k_scale is not None:
+        scores = scores * jnp.moveaxis(k_scale, 1, 2)[:, :, None, None, :]
     if causal:
         causal_mask = jnp.tril(jnp.ones((s_q, s_kv), dtype=bool))
         scores = jnp.where(causal_mask[None, None, None], scores, NEG_INF)
@@ -53,7 +67,10 @@ def _xla_attention(
         scores = jnp.where(seg_mask[:, None, None], scores, NEG_INF)
     if mask is not None:  # explicit (B, Sq, Skv) mask — KV-cache decode path
         scores = jnp.where(mask[:, None, None], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        probs = probs * jnp.moveaxis(v_scale, 1, 2)[:, :, None, None, :]
+    probs = probs.astype(v.dtype)
     out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
     return out.reshape(b, s_q, h, d)
 
@@ -81,6 +98,8 @@ def dot_product_attention(
     impl: str = "xla",
     mesh=None,
     rules=None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Grouped-query attention. ``segment_ids`` (B, S) int32 restricts
     attention to tokens of the same segment (sequence packing / padding:
@@ -93,11 +112,14 @@ def dot_product_attention(
     truth the rest of the model uses for its sharding constraints."""
     if q.shape[2] % k.shape[2]:
         raise ValueError(f"q heads {q.shape[2]} not divisible by kv heads {k.shape[2]}")
+    if k_scale is not None and mask is None:
+        raise ValueError("quantized K/V (k_scale/v_scale) require the mask path")
     if mask is not None:
         # Explicit-mask (decode) path: bandwidth-bound, XLA fuses it fine; the
         # flash/ring kernels are for long training chunks, not 1-token queries.
         return _xla_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, mask=mask
+            q, k, v, causal=causal, segment_ids=segment_ids, mask=mask,
+            k_scale=k_scale, v_scale=v_scale,
         )
     if impl == "xla":
         return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)

@@ -351,6 +351,18 @@ def train(config: Config) -> dict[str, Any]:
                         [dataset[int(i)]["label"] for i in idx],
                         max_samples=config.train.eval_samples,
                     )
+                if (
+                    config.train.fault_inject_step > 0
+                    and not resumed
+                    and global_step >= config.train.fault_inject_step
+                ):
+                    # Recovery drill (after the save check above, so the
+                    # supervisor has a checkpoint to resume from): only on a
+                    # first run — a resumed run must complete.
+                    raise RuntimeError(
+                        f"injected fault at step {global_step} "
+                        "(train.fault_inject_step)"
+                    )
             if global_step >= total_steps:
                 break
         metrics.flush()
