@@ -196,6 +196,10 @@ class DataConfig:
 
 @dataclass(frozen=True)
 class TrainConfig:
+    # "adamw" | "adafactor" (factored second moment — the TPU-lineage
+    # memory-efficient choice: O(rows+cols) stats instead of O(params)) |
+    # "lion" (sign-momentum, one bf16-able moment) | "sgd" (momentum=beta1)
+    optimizer: str = "adamw"
     learning_rate: float = 3e-4
     warmup_steps: int = 10
     total_steps: int = 100

@@ -61,12 +61,24 @@ def cache_logical_axes(cfg: ModelConfig) -> dict:
 
 def _scatter_rows(cache: jax.Array, chunk: jax.Array, idx: jax.Array) -> jax.Array:
     """Write ``chunk`` (B, S, ...) into ``cache`` (B, Smax, ...) at per-row
-    slot offsets ``idx`` (B,). Used by the continuous-batching decode path
-    where each sequence sits at a different depth."""
-    b, s = chunk.shape[:2]
-    rows = jnp.arange(b, dtype=jnp.int32)[:, None]  # (B, 1)
-    cols = idx[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :]  # (B, S)
-    return cache.at[rows, cols].set(chunk.astype(cache.dtype))
+    slot offsets ``idx`` (B,). Used by the continuous-batching and
+    speculative decode paths where each sequence sits at a different depth.
+
+    Implemented as gather + select over the whole slot axis, NOT an XLA
+    scatter: TPU lowers multi-row scatters poorly (serialized updates),
+    while this form is a dense vectorized rewrite of the cache — and cache
+    bytes are noise next to the weight reads that bound decode."""
+    s = chunk.shape[1]
+    smax = cache.shape[1]
+    tail = (1,) * (cache.ndim - 2)  # broadcast over trailing (K, D, ...) dims
+    rel = jnp.arange(smax, dtype=jnp.int32)[None, :] - idx[:, None]  # (B, Smax)
+    in_chunk = (rel >= 0) & (rel < s)
+    gathered = jnp.take_along_axis(
+        chunk.astype(cache.dtype),
+        jnp.clip(rel, 0, s - 1).reshape(rel.shape + tail),
+        axis=1,
+    )
+    return jnp.where(in_chunk.reshape(in_chunk.shape + tail), gathered, cache)
 
 
 def _quantize(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:

@@ -1,0 +1,214 @@
+"""Multi-host (pod) serving: every process runs the same SPMD decode,
+process 0 talks HTTP.
+
+The reference's serving story is an external endpoint; its multi-node story
+is two hand-launched ranks that never communicate after a startup barrier
+(ref ``scripts/run_node0.sh``, ``src/distributed_inference.py:18``). Here a
+sharded model spanning several hosts must run its generate program on EVERY
+process simultaneously (an XLA SPMD program is a lockstep pod-wide program),
+while HTTP naturally arrives at one host. This module bridges the two:
+
+- Process 0 owns the listener. Its request threads hand work to a single
+  **pump thread** which, on a fixed cadence, broadcasts one fixed-layout
+  header (+ payload when work is pending) to all processes
+  (``multihost_utils.broadcast_one_to_all`` — the same collective substrate
+  as training).
+- Every process (0 included) then calls the *identical*
+  ``Generator.generate_tokens`` on the broadcast prompts; GSPMD executes the
+  sharded program across the pod. Results are fully replicated, so process 0
+  answers HTTP locally and the others discard.
+- At ``jax.process_count() == 1`` the broadcasts are identity and this
+  degenerates to a slightly-buffered Generator — which is how the protocol
+  is unit-tested (tests/test_podserve.py); multi-host execution reuses the
+  exact code path.
+
+Protocol (per tick): header ``(8,) int32`` =
+``[opcode, batch, prompt_len, max_new, temp_bits, top_p_bits, seed, top_k]``
+(floats bit-cast); opcode 0 = idle, 1 = generate (followed by an
+``(batch, prompt_len)`` ids broadcast and a ``(batch,)`` lengths broadcast),
+2 = shutdown. Fixed layout means every process always issues the same
+collective sequence — the SPMD discipline that makes this deadlock-free.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["PodGenerator", "worker_loop"]
+
+_IDLE, _GENERATE, _SHUTDOWN = 0, 1, 2
+
+
+def _f2i(x: float) -> int:
+    return int(np.float32(x).view(np.int32))
+
+
+def _i2f(x: int) -> float:
+    return float(np.int32(x).view(np.float32))
+
+
+def _broadcast(arr: np.ndarray) -> np.ndarray:
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.broadcast_one_to_all(arr))
+
+
+class _Job:
+    def __init__(self, token_lists, gen):
+        self.token_lists = token_lists
+        self.gen = gen
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+
+
+def _run_tick(
+    generator: Generator,
+    header: np.ndarray,
+    ids: np.ndarray | None,
+    lengths: np.ndarray | None,
+):
+    """Execute one broadcast generate tick — identical on every process."""
+    _, batch, _, max_new, temp_bits, top_p_bits, seed, top_k = (
+        int(v) for v in header
+    )
+    token_lists = [ids[i, : lengths[i]].tolist() for i in range(batch)]
+    gen = GenerateConfig(
+        max_new_tokens=max_new,
+        temperature=_i2f(temp_bits),
+        top_k=top_k,
+        top_p=_i2f(top_p_bits),
+        seed=seed,
+    )
+    return generator.generate_tokens(token_lists, gen)
+
+
+class PodGenerator:
+    """Process-0 front: queues HTTP requests and pumps them through the
+    pod-wide broadcast protocol. Exposes the ``Generator`` surface the HTTP
+    handler uses (``generate``/``generate_tokens``/``tokenizer``)."""
+
+    def __init__(self, generator: Generator, *, poll_s: float = 0.05):
+        self.generator = generator
+        self.tokenizer = generator.tokenizer
+        self.poll_s = poll_s
+        self._jobs: queue.Queue[_Job] = queue.Queue()
+        self._stop = False
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+        self._pump.start()
+
+    # -- pump (the only thread issuing collectives on process 0) -------------
+
+    def _pump_loop(self) -> None:
+        while True:
+            try:
+                job = self._jobs.get(timeout=self.poll_s)
+            except queue.Empty:
+                job = None
+            if self._stop:
+                _broadcast(np.asarray([_SHUTDOWN, 0, 0, 0, 0, 0, 0, 0], np.int32))
+                if job is not None:
+                    job.error = RuntimeError("pod serving stopped")
+                    job.done.set()
+                return
+            if job is None:
+                _broadcast(np.asarray([_IDLE, 0, 0, 0, 0, 0, 0, 0], np.int32))
+                continue
+            try:
+                gen = job.gen
+                batch = len(job.token_lists)
+                plen = max(1, max(len(t) for t in job.token_lists))
+                ids = np.zeros((batch, plen), np.int32)
+                lengths = np.zeros((batch,), np.int32)
+                for i, toks in enumerate(job.token_lists):
+                    ids[i, : len(toks)] = toks
+                    lengths[i] = len(toks)
+                header = np.asarray(
+                    [
+                        _GENERATE, batch, plen, gen.max_new_tokens,
+                        _f2i(gen.temperature), _f2i(gen.top_p), gen.seed,
+                        gen.top_k,
+                    ],
+                    np.int32,
+                )
+                _broadcast(header)
+                ids = _broadcast(ids)
+                lengths = _broadcast(lengths)
+                job.result = _run_tick(self.generator, header, ids, lengths)
+                job.done.set()
+            except BaseException as e:  # noqa: BLE001 — handed to the waiter
+                job.error = e
+                job.done.set()
+
+    # -- Generator surface ----------------------------------------------------
+
+    def generate_tokens(
+        self, token_lists: list[list[int]], gen: GenerateConfig | None = None
+    ) -> list[list[int]]:
+        if not token_lists:
+            return []
+        gen = gen or GenerateConfig()
+        token_lists = [t if t else [self.tokenizer.bos_id] for t in token_lists]
+        job = _Job(token_lists, gen)
+        self._jobs.put(job)
+        job.done.wait()
+        if job.error is not None:
+            raise job.error
+        return job.result
+
+    def generate(
+        self, prompts: list[str], gen: GenerateConfig | None = None
+    ) -> list[str]:
+        encoded = [
+            [self.tokenizer.bos_id] + self.tokenizer.encode(p) for p in prompts
+        ]
+        return [self.tokenizer.decode(t) for t in self.generate_tokens(encoded, gen)]
+
+    def close(self) -> None:
+        """Broadcast shutdown to the pod and stop the pump. Waits long enough
+        for an in-flight generate (first-request compiles routinely exceed
+        10s) to drain — exiting before the shutdown opcode goes out would
+        strand every worker in its blocking broadcast."""
+        self._stop = True
+        self._pump.join(timeout=600)
+        if self._pump.is_alive():
+            logger.error(
+                "pod pump did not drain within 600s; workers may be left "
+                "blocked in their broadcast loop"
+            )
+
+
+def worker_loop(generator: Generator) -> None:
+    """Run on every process with ``jax.process_index() != 0``: mirror process
+    0's collective sequence forever, executing each generate tick, until a
+    shutdown opcode arrives. Results are replicated; non-zero processes
+    simply drop them."""
+    logger.info("pod serve worker: entering broadcast loop")
+    while True:
+        header = _broadcast(np.zeros((8,), np.int32))
+        op = int(header[0])
+        if op == _SHUTDOWN:
+            logger.info("pod serve worker: shutdown")
+            return
+        if op == _IDLE:
+            continue
+        batch, plen = int(header[1]), int(header[2])
+        ids = _broadcast(np.zeros((batch, plen), np.int32))
+        lengths = _broadcast(np.zeros((batch,), np.int32))
+        try:
+            _run_tick(generator, header, ids, lengths)
+        except Exception:
+            # Mirror the coordinator: its pump catches per-request errors
+            # (deterministic ones — validation, OOM-at-shape — raise
+            # identically on every process) and serves the next request; a
+            # worker that died here instead would strand the whole pod at
+            # the next broadcast.
+            logger.exception("pod serve worker: tick failed; continuing")

@@ -287,6 +287,16 @@ def serve(argv: list[str] | None = None) -> int:
         help="int8 KV cache (halves cache reads/footprint; infer/cache.py)",
     )
     parser.add_argument(
+        "--mesh", default="",
+        help='shard the model over a device mesh, e.g. "tensor=4" or '
+        '"fsdp=2,tensor=4" (axes as in MeshConfig); spans all pod devices',
+    )
+    parser.add_argument(
+        "--pod", action="store_true",
+        help="multi-host serving: every process joins the broadcast-driven "
+        "SPMD decode loop (infer/podserve.py); process 0 serves HTTP",
+    )
+    parser.add_argument(
         "--max-cache-len", type=int, default=0,
         help="per-slot KV cache cap for --engine continuous; 0 = model "
         "max_seq_len (set this for long-context presets like llama31-8b, "
@@ -294,12 +304,30 @@ def serve(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    if jax.process_index() != 0:
-        # Pod serving is process-0-gated: one process binds the port; the
-        # others exit (multi-host sharded serving would need all processes in
-        # a collective decode loop — future work, documented in README).
+    if args.pod and args.engine == "continuous":
+        parser.error("--pod composes with --engine lockstep only (the "
+                     "continuous scheduler is host-side per-process state)")
+    if args.mesh and not args.pod and jax.process_count() > 1:
+        parser.error("--mesh on a multi-host pod requires --pod: the mesh "
+                     "spans all hosts' devices, so every process must join "
+                     "the collective decode loop")
+    if jax.process_index() != 0 and not args.pod:
+        # Without --pod, one process binds the port and the others exit; with
+        # --pod every process joins the collective decode loop below.
         logger.info("process %d: serving is process-0 only, exiting", jax.process_index())
         return 0
+
+    mesh = None
+    if args.mesh:
+        import dataclasses as _dc
+
+        from ditl_tpu.config import MeshConfig
+        from ditl_tpu.runtime.mesh import build_mesh
+
+        axes = dict(kv.split("=", 1) for kv in args.mesh.split(","))
+        mesh = build_mesh(
+            _dc.replace(MeshConfig(), **{k: int(v) for k, v in axes.items()})
+        )
 
     cfg = get_preset(args.preset) if args.preset else ModelConfig()
     if args.kv_quant == "int8":
@@ -322,7 +350,17 @@ def serve(argv: list[str] | None = None) -> int:
 
         params = quantize_weights(params)
         logger.info("quantized weights to int8 (weight-only)")
-    generator = Generator(params, cfg, tokenizer)
+    generator = Generator(params, cfg, tokenizer, mesh=mesh)
+    if args.pod and jax.process_index() != 0:
+        from ditl_tpu.infer.podserve import worker_loop
+
+        worker_loop(generator)  # returns on the coordinator's shutdown opcode
+        return 0
+    pod = None
+    if args.pod:
+        from ditl_tpu.infer.podserve import PodGenerator
+
+        generator = pod = PodGenerator(generator)
     threaded = None
     if args.engine == "continuous":
         from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
@@ -343,6 +381,8 @@ def serve(argv: list[str] | None = None) -> int:
     except KeyboardInterrupt:
         pass
     finally:
+        if pod is not None:
+            pod.close()  # broadcast shutdown so workers exit their loop
         server.shutdown()
         if threaded is not None:
             threaded.close()

@@ -50,9 +50,8 @@ def make_optimizer(cfg: TrainConfig, params: Any) -> optax.GradientTransformatio
         decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
         end_value=cfg.learning_rate * 0.1,
     )
-    tx = optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.adamw(
+    if cfg.optimizer == "adamw":
+        opt = optax.adamw(
             schedule,
             b1=cfg.beta1,
             b2=cfg.beta2,
@@ -61,8 +60,27 @@ def make_optimizer(cfg: TrainConfig, params: Any) -> optax.GradientTransformatio
             # variance (nu) stays f32 — it is the precision-sensitive one
             # (sqrt of tiny values).
             mu_dtype=cfg.adam_mu_dtype,
-        ),
-    )
+        )
+    elif cfg.optimizer == "adafactor":
+        # Factored second moment: O(rows+cols) statistics instead of a full
+        # parameter-shaped moment — the classic TPU big-model optimizer.
+        # Factored stats are vectors, so they restore replicated (the
+        # state_logical_axes ndim guard); that is by design, they're tiny.
+        opt = optax.adafactor(
+            learning_rate=schedule, weight_decay_rate=cfg.weight_decay or None
+        )
+    elif cfg.optimizer == "lion":
+        opt = optax.lion(
+            schedule, b1=cfg.beta1, b2=cfg.beta2,
+            weight_decay=cfg.weight_decay, mu_dtype=cfg.adam_mu_dtype,
+        )
+    elif cfg.optimizer == "sgd":
+        opt = optax.sgd(schedule, momentum=cfg.beta1)
+    else:
+        raise ValueError(
+            f"unknown optimizer {cfg.optimizer!r} (adamw|adafactor|lion|sgd)"
+        )
+    tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), opt)
     mask = lora_mask(params)
     if not all(jax.tree.leaves(mask)):
         # Freeze non-LoRA leaves: their updates are hard zeros (optax.masked

@@ -1,0 +1,145 @@
+"""Speculative decoding (infer/speculative.py).
+
+The load-bearing contract: greedy speculative output is TOKEN-IDENTICAL to
+lock-step greedy decode — speculation is a schedule change, not a sampling
+change. Run in float32 so exact equality is well-defined (same policy as the
+batch-independence tests in test_infer.py).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.infer.speculative import SpeculativeGenerator, lookup_draft
+
+
+@pytest.fixture(scope="module")
+def tiny_setup_f32():
+    from ditl_tpu.config import ModelConfig
+    from ditl_tpu.models import llama
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=256,
+        dtype="float32", param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+# -- drafting -----------------------------------------------------------------
+
+
+def test_lookup_draft_finds_recent_ngram():
+    #           0  1  2  3  4  5  6  7
+    context = [5, 6, 7, 8, 9, 5, 6]  # trailing bigram (5, 6) occurred at 0
+    assert lookup_draft(context, k=3, ngram=2) == [7, 8, 9]
+
+
+def test_lookup_draft_prefers_most_recent_match():
+    context = [1, 2, 3, 1, 2, 4, 1, 2]
+    assert lookup_draft(context, k=1, ngram=2) == [4]  # match at 3, not 0
+
+
+def test_lookup_draft_pads_when_no_match():
+    assert lookup_draft([1, 2, 3], k=4, ngram=2) == [0, 0, 0, 0]
+    assert lookup_draft([7], k=2, ngram=2) == [0, 0]
+
+
+def test_lookup_draft_truncated_follow_is_padded():
+    context = [1, 2, 9, 1, 2]
+    assert lookup_draft(context, k=3, ngram=2) == [9, 1, 2][:3]
+
+
+def test_device_draft_matches_host_reference():
+    import jax.numpy as jnp
+
+    from ditl_tpu.infer.speculative import device_lookup_draft
+
+    rng = np.random.default_rng(0)
+    b, t, k, ngram = 8, 64, 5, 2
+    tokens = rng.integers(0, 7, size=(b, t)).astype(np.int32)  # small vocab
+    ctx_len = rng.integers(1, t, size=(b,)).astype(np.int32)   # => many matches
+    dev = np.asarray(
+        device_lookup_draft(jnp.asarray(tokens), jnp.asarray(ctx_len), k=k, ngram=ngram)
+    )
+    for i in range(b):
+        host = lookup_draft(tokens[i, : ctx_len[i]].tolist(), k, ngram)
+        assert dev[i].tolist() == host, f"row {i} (ctx_len {ctx_len[i]})"
+
+
+# -- exactness vs lock-step greedy decode -------------------------------------
+
+
+@pytest.mark.parametrize("k", [1, 4, 8])
+def test_matches_lockstep_greedy(tiny_setup_f32, k):
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    prompts = [
+        [tok.bos_id] + tok.encode("abcabcabcabc"),
+        [tok.bos_id] + tok.encode("the quick brown fox"),
+        [tok.bos_id] + tok.encode("xy"),
+    ]
+    ref = Generator(params, cfg, tok).generate_tokens(
+        prompts, GenerateConfig(max_new_tokens=24)
+    )
+    spec = SpeculativeGenerator(params, cfg, tok, k=k).generate_tokens(
+        prompts, max_new_tokens=24
+    )
+    assert spec == ref
+
+
+def test_matches_lockstep_on_repetitive_prompt(tiny_setup_f32):
+    # Repetitive context is where prompt-lookup actually accepts drafts; the
+    # output must STILL be identical to lock-step greedy.
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    prompts = [[tok.bos_id] + (tok.encode("jax tpu ") * 12)]
+    ref = Generator(params, cfg, tok).generate_tokens(
+        prompts, GenerateConfig(max_new_tokens=32)
+    )
+    spec = SpeculativeGenerator(params, cfg, tok, k=6).generate_tokens(
+        prompts, max_new_tokens=32
+    )
+    assert spec == ref
+
+
+def test_single_and_empty_prompts(tiny_setup_f32):
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    gen = SpeculativeGenerator(params, cfg, tok, k=4)
+    assert gen.generate_tokens([], 8) == []
+    ref = Generator(params, cfg, tok).generate_tokens(
+        [[]], GenerateConfig(max_new_tokens=8)
+    )
+    assert gen.generate_tokens([[]], 8) == ref
+
+
+def test_max_new_tokens_respected(tiny_setup_f32):
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    out = SpeculativeGenerator(params, cfg, tok, k=8).generate_tokens(
+        [[tok.bos_id] + tok.encode("hello world hello world")], max_new_tokens=5
+    )
+    assert len(out[0]) <= 5
+
+
+def test_seq_len_overflow_raises(tiny_setup_f32):
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    gen = SpeculativeGenerator(params, cfg, tok, k=4)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        gen.generate_tokens([list(range(10, 200))], max_new_tokens=200)
+
+
+def test_int8_kv_cache_composes(tiny_setup_f32):
+    cfg, params = tiny_setup_f32
+    qcfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    tok = ByteTokenizer()
+    gen = SpeculativeGenerator(params, qcfg, tok, k=4)
+    out = gen.generate_tokens([[tok.bos_id] + tok.encode("abc abc abc")], 12)
+    assert gen.generate_tokens([[tok.bos_id] + tok.encode("abc abc abc")], 12) == out

@@ -244,3 +244,29 @@ def test_bf16_adam_mu(tiny_model_cfg, example_batch):
         state, m = step(state, gb)
         losses.append(float(m["loss"]))
     assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("opt", ["adafactor", "lion", "sgd"])
+def test_alternate_optimizers_train(tiny_model_cfg, example_batch, opt):
+    # Each optimizer family builds, shards (factored adafactor stats restore
+    # replicated by the ndim guard in state_logical_axes), and reduces loss.
+    lr = 3e-4 if opt == "lion" else 1e-3  # lion's sign updates want a lower lr
+    _, state, gb, step = _setup(
+        tiny_model_cfg, example_batch,
+        train_cfg=TrainConfig(
+            total_steps=20, warmup_steps=2, learning_rate=lr, optimizer=opt
+        ),
+    )
+    state, m0 = step(state, gb)
+    first = float(m0["loss"])
+    for _ in range(10):
+        state, m = step(state, gb)
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["loss"]) < first
+
+
+def test_unknown_optimizer_raises(tiny_model_cfg):
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        create_train_state(
+            jax.random.key(0), tiny_model_cfg, TrainConfig(optimizer="frobnicate")
+        )
