@@ -116,6 +116,15 @@ class ContinuousEngine:
         self._next_id = 0
         self._prefill_cache: dict[int, Any] = {}
         self._decode_cache: dict[tuple[bool, bool], Any] = {}
+        # Prefix cache: prompt-prefix tokens -> (1-row KV slice over P slots,
+        # last-token logits, real length). Explicit registration, not
+        # automatic block hashing: slots are contiguous (not paged), so
+        # sharing is prefix-granular by design (see register_prefix).
+        self._prefixes: dict[tuple[int, ...], tuple[Any, Any, int]] = {}
+        self._prefix_prefill: dict[int, Any] = {}
+        self._seed_cache: dict[int, Any] = {}
+        self._suffix_prefill: dict[int, Any] = {}  # keyed by suffix bucket
+        self._first_sampler: Any = None
 
     # -- compiled programs --------------------------------------------------
 
@@ -198,6 +207,119 @@ class ContinuousEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
+    # -- prefix caching ------------------------------------------------------
+
+    def _build_prefix_prefill(self, p_bucket: int):
+        """Prefill a standalone 1-row cache of ``p_bucket`` slots; returns the
+        KV slice plus the last real token's logits (for prompts that are
+        exactly the prefix)."""
+        cfg = self.cfg
+
+        def run(params, ids, length):
+            row = init_cache(cfg, 1, p_bucket)
+            q_pos = jnp.arange(p_bucket, dtype=jnp.int32)
+            slots = jnp.arange(p_bucket, dtype=jnp.int32)
+            mask = (slots[None, None, :] <= q_pos[None, :, None]) & (
+                slots[None, None, :] < length
+            )
+            logits, row = llama.forward(
+                params, ids, cfg, positions=q_pos[None],
+                cache=row, cache_index=jnp.int32(0), attn_mask=mask,
+            )
+            return row, logits[0, length - 1]
+
+        return jax.jit(run)
+
+    def _build_seed(self, p_bucket: int):
+        """Copy a registered prefix's KV slice into one slot of the shared
+        cache (slots 0..p_bucket of the slot's sequence axis)."""
+
+        def run(cache, row, slot):
+            return jax.tree.map(
+                lambda c, r: jax.lax.dynamic_update_slice(
+                    c, r.astype(c.dtype), (0, slot, 0) + (0,) * (c.ndim - 3)
+                ),
+                cache,
+                row,
+            )
+
+        return jax.jit(run, donate_argnums=(0,))
+
+    def _build_suffix_prefill(self, s_bucket: int):
+        """Prefill only the suffix of a prompt whose first ``offset`` tokens
+        are already seeded in the slot's cache; same write-then-unmask
+        invariant as full prefill (garbage beyond the suffix is overwritten
+        by decode writes before ``pos`` unmasks it)."""
+        cfg, smax = self.cfg, self.smax
+        slots_iota = jnp.arange(smax, dtype=jnp.int32)
+
+        def run(params, cache, ids, offset, s_len, slot, temp, top_p, rng):
+            row = jax.tree.map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), cache
+            )
+            q_pos = offset + jnp.arange(s_bucket, dtype=jnp.int32)
+            mask = slots_iota[None, None, :] <= q_pos[None, :, None]
+            logits, row = llama.forward(
+                params, ids, cfg, positions=q_pos[None],
+                cache=row, cache_index=offset, attn_mask=mask,
+            )
+            cache = jax.tree.map(
+                lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
+                cache,
+                row,
+            )
+            last = logits[0, s_len - 1]
+            first = sample_logits(
+                last[None], rng, temperature=temp, top_k=self.gen.top_k,
+                top_p=top_p,
+            )[0]
+            return cache, first
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def register_prefix(self, prefix_tokens: list[int]) -> None:
+        """Prefill ``prefix_tokens`` once and reuse the KV for every future
+        request whose prompt starts with them (longest registered match wins).
+        The natural use is a shared system prompt. Sharing is whole-prefix
+        (contiguous slot cache, no paging), and the prefix slice lives in
+        device memory until ``clear_prefixes``."""
+        if not prefix_tokens:
+            raise ValueError("prefix must be non-empty")
+        if len(prefix_tokens) + 1 > self.smax:
+            raise ValueError(
+                f"prefix {len(prefix_tokens)} leaves no room in cache {self.smax}"
+            )
+        key = tuple(prefix_tokens)
+        if key in self._prefixes:
+            return
+        p_bucket = min(_next_pow2(len(prefix_tokens), floor=16), self.smax)
+        if p_bucket not in self._prefix_prefill:
+            logger.info("compiling prefix prefill for bucket %d", p_bucket)
+            self._prefix_prefill[p_bucket] = self._build_prefix_prefill(p_bucket)
+        ids = np.full((1, p_bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, : len(prefix_tokens)] = prefix_tokens
+        row, last_logits = self._prefix_prefill[p_bucket](
+            self.params, jnp.asarray(ids), jnp.int32(len(prefix_tokens))
+        )
+        self._prefixes[key] = (row, last_logits, len(prefix_tokens))
+        logger.info(
+            "registered prefix of %d tokens (bucket %d)", len(prefix_tokens), p_bucket
+        )
+
+    def clear_prefixes(self) -> None:
+        """Drop all registered prefixes (frees their device memory)."""
+        self._prefixes.clear()
+
+    def _match_prefix(self, prompt: list[int]):
+        """Longest registered prefix that prefixes ``prompt``, or None."""
+        best = None
+        for key, entry in self._prefixes.items():
+            d = entry[2]
+            if d <= len(prompt) and tuple(prompt[:d]) == key:
+                if best is None or d > best[2]:
+                    best = entry
+        return best
+
     # -- scheduler ----------------------------------------------------------
 
     def submit(
@@ -234,30 +356,64 @@ class ContinuousEngine:
         self._queue.append(req)
         return req.req_id
 
-    def _admit(self) -> None:
-        for slot in range(self.n_slots):
-            if self._slots[slot] is not None or not self._queue:
-                continue
-            req = self._queue.popleft()
-            p_bucket = _next_pow2(len(req.prompt), floor=16)
-            p_bucket = min(p_bucket, self.smax)
+    def _prefill_into_slot(self, req: Request, slot: int, rng) -> jax.Array:
+        """Fill the slot's cache for ``req``'s prompt and return the first
+        sampled token. Uses a registered prefix's KV when one matches (seed
+        copy + suffix-only prefill), else the full prefill program."""
+        prefix = self._match_prefix(req.prompt)
+        if prefix is None:
+            p_bucket = min(_next_pow2(len(req.prompt), floor=16), self.smax)
             if p_bucket not in self._prefill_cache:
                 logger.info("compiling prefill program for bucket %d", p_bucket)
                 self._prefill_cache[p_bucket] = self._build_prefill(p_bucket)
             ids = np.full((1, p_bucket), self.tokenizer.pad_id, np.int32)
             ids[0, : len(req.prompt)] = req.prompt
+            self.cache, first = self._prefill_cache[p_bucket](
+                self.params, self.cache, jnp.asarray(ids),
+                jnp.int32(len(req.prompt)), jnp.int32(slot),
+                jnp.float32(req.temperature), jnp.float32(req.top_p), rng,
+            )
+            return first
+        row, last_logits, d = prefix
+        p_bucket = row["k"].shape[2]
+        if p_bucket not in self._seed_cache:
+            self._seed_cache[p_bucket] = self._build_seed(p_bucket)
+        self.cache = self._seed_cache[p_bucket](self.cache, row, jnp.int32(slot))
+        s = len(req.prompt) - d
+        if s == 0:
+            # Prompt == prefix: first token comes from the stored logits.
+            if self._first_sampler is None:
+                self._first_sampler = jax.jit(
+                    lambda lg, key, t, p: sample_logits(
+                        lg[None], key, temperature=t,
+                        top_k=self.gen.top_k, top_p=p,
+                    )[0]
+                )
+            return self._first_sampler(
+                last_logits, rng, jnp.float32(req.temperature),
+                jnp.float32(req.top_p),
+            )
+        s_bucket = min(_next_pow2(s, floor=16), self.smax - d)
+        if s_bucket not in self._suffix_prefill:
+            logger.info("compiling suffix prefill for bucket %d", s_bucket)
+            self._suffix_prefill[s_bucket] = self._build_suffix_prefill(s_bucket)
+        ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, :s] = req.prompt[d:]
+        self.cache, first = self._suffix_prefill[s_bucket](
+            self.params, self.cache, jnp.asarray(ids), jnp.int32(d),
+            jnp.int32(s), jnp.int32(slot), jnp.float32(req.temperature),
+            jnp.float32(req.top_p), rng,
+        )
+        return first
+
+    def _admit(self) -> None:
+        for slot in range(self.n_slots):
+            if self._slots[slot] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
             slot_key = jax.random.key(req.seed)
             slot_key, sub = jax.random.split(slot_key)
-            self.cache, first = self._prefill_cache[p_bucket](
-                self.params,
-                self.cache,
-                jnp.asarray(ids),
-                jnp.int32(len(req.prompt)),
-                jnp.int32(slot),
-                jnp.float32(req.temperature),
-                jnp.float32(req.top_p),
-                sub,
-            )
+            first = self._prefill_into_slot(req, slot, sub)
             req.slot = slot
             self._slots[slot] = req
             self.cur = self.cur.at[slot].set(first)

@@ -115,9 +115,15 @@ class PodGenerator:
                 job = None
             if self._stop:
                 _broadcast(np.asarray([_SHUTDOWN, 0, 0, 0, 0, 0, 0, 0], np.int32))
-                if job is not None:
+                # Fail every queued waiter — leaving any job un-signalled
+                # would deadlock its HTTP thread in done.wait().
+                while job is not None:
                     job.error = RuntimeError("pod serving stopped")
                     job.done.set()
+                    try:
+                        job = self._jobs.get_nowait()
+                    except queue.Empty:
+                        job = None
                 return
             if job is None:
                 _broadcast(np.asarray([_IDLE, 0, 0, 0, 0, 0, 0, 0], np.int32))
@@ -155,6 +161,8 @@ class PodGenerator:
     ) -> list[list[int]]:
         if not token_lists:
             return []
+        if self._stop:
+            raise RuntimeError("pod serving stopped")
         gen = gen or GenerateConfig()
         token_lists = [t if t else [self.tokenizer.bos_id] for t in token_lists]
         job = _Job(token_lists, gen)

@@ -311,6 +311,11 @@ def serve(argv: list[str] | None = None) -> int:
         parser.error("--mesh on a multi-host pod requires --pod: the mesh "
                      "spans all hosts' devices, so every process must join "
                      "the collective decode loop")
+    if args.mesh and args.engine == "continuous":
+        parser.error("--mesh composes with --engine lockstep only (the "
+                     "continuous engine's cache/scheduler is single-device; "
+                     "shard it with --engine lockstep --mesh, or serve "
+                     "continuous unsharded)")
     if jax.process_index() != 0 and not args.pod:
         # Without --pod, one process binds the port and the others exit; with
         # --pod every process joins the collective decode loop below.

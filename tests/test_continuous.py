@@ -289,3 +289,84 @@ def test_server_sse_streaming_lockstep_fallback(setup):
         assert text == ref
     finally:
         server.shutdown()
+
+
+def test_prefix_cache_exact_outputs(setup):
+    """Seed-from-prefix + suffix-only prefill must produce exactly the same
+    greedy outputs as full prefill (f32: the math is identical, only the
+    schedule differs)."""
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=10, temperature=0.0)
+    system = "system: you are a helpful assistant\n"
+    prompts = [system + q for q in ("hello", "what is jax?", "abc abc")]
+
+    plain = ContinuousEngine(params, cfg, tok, n_slots=4, gen=gen)
+    ref = plain.generate(prompts)
+
+    cached = ContinuousEngine(params, cfg, tok, n_slots=4, gen=gen)
+    cached.register_prefix([tok.bos_id] + tok.encode(system))
+    # generate() prepends bos+encode, so the registered prefix matches.
+    got = cached.generate(prompts)
+    assert got == ref
+
+
+def test_prefix_cache_whole_prompt(setup):
+    """Prompt exactly equal to the registered prefix: first token comes from
+    the stored logits, zero prefill work at admission."""
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=8, temperature=0.0)
+    text = "the quick brown fox"
+    ref = ContinuousEngine(params, cfg, tok, gen=gen).generate([text])
+
+    eng = ContinuousEngine(params, cfg, tok, gen=gen)
+    eng.register_prefix([tok.bos_id] + tok.encode(text))
+    assert eng._suffix_prefill == {} and eng._prefill_cache == {}
+    got = eng.generate([text])
+    assert got == ref
+    assert eng._prefill_cache == {}  # full prefill never compiled
+
+
+def test_prefix_cache_longest_match_wins(setup):
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=6, temperature=0.0)
+    short = [tok.bos_id] + tok.encode("sys: ")
+    long = [tok.bos_id] + tok.encode("sys: be terse\n")
+    eng = ContinuousEngine(params, cfg, tok, gen=gen)
+    eng.register_prefix(short)
+    eng.register_prefix(long)
+    prompt = long + tok.encode("hi")
+    assert eng._match_prefix(prompt)[2] == len(long)
+    assert eng._match_prefix(short + tok.encode("zz"))[2] == len(short)
+    assert eng._match_prefix(tok.encode("unrelated")) is None
+    # And generation through the longest match is still exact.
+    plain = ContinuousEngine(params, cfg, tok, gen=gen)
+    rid = plain.submit(prompt)
+    want = plain.run()[rid]
+    rid2 = eng.submit(prompt)
+    assert eng.run()[rid2] == want
+
+
+def test_prefix_cache_mixed_with_uncached(setup):
+    """Cached-prefix and no-prefix requests share decode ticks."""
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=8, temperature=0.0)
+    system = "ctx: "
+    prompts = [system + "one", "no prefix here", system + "two"]
+    ref = ContinuousEngine(params, cfg, tok, n_slots=2, gen=gen).generate(prompts)
+    eng = ContinuousEngine(params, cfg, tok, n_slots=2, gen=gen)
+    eng.register_prefix([tok.bos_id] + tok.encode(system))
+    assert eng.generate(prompts) == ref
+
+
+def test_prefix_register_validation(setup):
+    params, cfg, tok = setup
+    eng = ContinuousEngine(params, cfg, tok)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.register_prefix([])
+    with pytest.raises(ValueError, match="no room"):
+        eng.register_prefix(list(range(3, 3 + cfg.max_seq_len)))
+    eng.register_prefix([5, 6, 7])
+    eng.register_prefix([5, 6, 7])  # idempotent
+    assert len(eng._prefixes) == 1
+    eng.clear_prefixes()
+    assert eng._prefixes == {}

@@ -98,3 +98,12 @@ def test_pod_close_rejects_new_work(tiny_setup):
     pod = PodGenerator(Generator(params, cfg, tok), poll_s=0.01)
     pod.close()
     assert not pod._pump.is_alive()
+
+
+def test_pod_close_fails_queued_and_new_work(tiny_setup):
+    cfg, params = tiny_setup
+    tok = ByteTokenizer()
+    pod = PodGenerator(Generator(params, cfg, tok), poll_s=0.01)
+    pod.close()
+    with pytest.raises(RuntimeError, match="stopped"):
+        pod.generate_tokens([tok.encode("late")], GenerateConfig(max_new_tokens=4))
