@@ -49,6 +49,10 @@ class GenerateConfig:
     top_k: int = 0  # 0 => disabled
     top_p: float = 1.0  # 1.0 => disabled
     seed: int = 0
+    # >0 => also return the chosen token's logprob and the top-N
+    # alternatives per step (OpenAI `logprobs` semantics; engine
+    # `generate_with_logprobs`). Part of the compile key.
+    logprobs: int = 0
 
 
 def _next_pow2(n: int, floor: int = 16) -> int:
@@ -125,9 +129,21 @@ class Generator:
                 top_p=gen.top_p,
             )
             done0 = first == eos_id
+            n_lp = gen.logprobs
+
+            def lp_stats(step_logits, tok):
+                """Chosen-token logprob + top-N alternatives (OpenAI
+                `logprobs` semantics: of the raw distribution, before any
+                temperature/top-k/top-p shaping)."""
+                lp = jax.nn.log_softmax(step_logits.astype(jnp.float32), -1)
+                chosen = jnp.take_along_axis(lp, tok[:, None], 1)[:, 0]
+                top_lp, top_id = jax.lax.top_k(lp, n_lp)
+                return chosen, top_id.astype(jnp.int32), top_lp
+
+            stats0 = lp_stats(last, first) if n_lp else None
 
             def body(carry, t):
-                cache, cur, done, rng = carry
+                cache, cur, cur_stats, done, rng = carry
                 rng, sub = jax.random.split(rng)
                 write_idx = prompt_len + t
                 # Attend to: real prompt slots + generated slots so far
@@ -151,16 +167,25 @@ class Generator:
                     step_logits[:, 0], sub, temperature=gen.temperature,
                     top_k=gen.top_k, top_p=gen.top_p,
                 )
+                # cur's stats were computed when cur was sampled (previous
+                # iteration / prefill); emit them alongside cur.
+                nxt_stats = lp_stats(step_logits[:, 0], nxt) if n_lp else None
                 new_done = done | (cur == eos_id)
                 nxt = jnp.where(new_done, pad_id, nxt)
-                return (cache, nxt, new_done, rng), cur
+                return (cache, nxt, nxt_stats, new_done, rng), (cur, cur_stats)
 
-            (_, _, _, _), tokens = jax.lax.scan(
+            _, (tokens, stats) = jax.lax.scan(
                 body,
-                (cache, first, done0, rng),
+                (cache, first, stats0, done0, rng),
                 jnp.arange(gen.max_new_tokens, dtype=jnp.int32),
             )
-            return tokens.T  # (steps, B) -> (B, steps)
+            out = {"tokens": tokens.T}  # (steps, B) -> (B, steps)
+            if n_lp:
+                chosen, top_id, top_lp = stats
+                out["token_logprobs"] = chosen.T  # (B, steps)
+                out["top_ids"] = jnp.swapaxes(top_id, 0, 1)  # (B, steps, N)
+                out["top_logprobs"] = jnp.swapaxes(top_lp, 0, 1)
+            return out
 
         jitted = jax.jit(run)
         logger.info(
@@ -183,10 +208,26 @@ class Generator:
         self, token_lists: list[list[int]], gen: GenerateConfig | None = None
     ) -> list[list[int]]:
         """Token-id prompts in, generated token ids out (EOS-trimmed)."""
+        return self._generate(token_lists, gen)[0]
+
+    def generate_tokens_with_logprobs(
+        self, token_lists: list[list[int]], gen: GenerateConfig
+    ) -> tuple[list[list[int]], list[dict]]:
+        """Like ``generate_tokens`` but also returns, per prompt, a dict of
+        ``token_logprobs`` (chosen token, raw distribution) and aligned
+        ``top_ids``/``top_logprobs`` (N = ``gen.logprobs``) lists."""
+        if gen.logprobs < 1:
+            raise ValueError("generate_tokens_with_logprobs needs gen.logprobs >= 1")
+        results, lps = self._generate(token_lists, gen)
+        return results, lps
+
+    def _generate(
+        self, token_lists: list[list[int]], gen: GenerateConfig | None
+    ) -> tuple[list[list[int]], list[dict]]:
         gen = gen or GenerateConfig()
         n = len(token_lists)
         if n == 0:
-            return []
+            return [], []
         token_lists = [t if t else [self.tokenizer.bos_id] for t in token_lists]
         batch = _next_pow2(n, floor=1)
         prompt_len = _next_pow2(max(len(t) for t in token_lists))
@@ -197,19 +238,32 @@ class Generator:
             lengths[i] = len(toks)
         run = self._get_compiled(batch, prompt_len, gen)
         rng = jax.random.key(gen.seed)
-        out = np.asarray(
-            jax.device_get(run(self.params, jnp.asarray(ids), jnp.asarray(lengths), rng))
+        out = jax.device_get(
+            run(self.params, jnp.asarray(ids), jnp.asarray(lengths), rng)
         )
+        tokens = np.asarray(out["tokens"])
         results = []
+        keep: list[int] = []
         for i in range(n):
-            row = out[i].tolist()
+            row = tokens[i].tolist()
             trimmed = []
             for tok in row:
                 if tok == self.tokenizer.eos_id or tok == self.tokenizer.pad_id:
                     break
                 trimmed.append(tok)
             results.append(trimmed)
-        return results
+            keep.append(len(trimmed))
+        lps: list[dict] = []
+        if gen.logprobs:
+            lps = [
+                {
+                    "token_logprobs": np.asarray(out["token_logprobs"])[i, : keep[i]].tolist(),
+                    "top_ids": np.asarray(out["top_ids"])[i, : keep[i]].tolist(),
+                    "top_logprobs": np.asarray(out["top_logprobs"])[i, : keep[i]].tolist(),
+                }
+                for i in range(n)
+            ]
+        return results, lps
 
     def generate(
         self, prompts: list[str], gen: GenerateConfig | None = None

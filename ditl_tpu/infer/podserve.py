@@ -102,6 +102,10 @@ class PodGenerator:
         self.poll_s = poll_s
         self._jobs: queue.Queue[_Job] = queue.Queue()
         self._stop = False
+        # Guards the (_stop check, enqueue) pair in generate_tokens against
+        # close(): without it a job could slip in after the pump drained the
+        # queue and block its waiter forever.
+        self._submit_lock = threading.Lock()
         self._pump = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump.start()
 
@@ -116,14 +120,18 @@ class PodGenerator:
             if self._stop:
                 _broadcast(np.asarray([_SHUTDOWN, 0, 0, 0, 0, 0, 0, 0], np.int32))
                 # Fail every queued waiter — leaving any job un-signalled
-                # would deadlock its HTTP thread in done.wait().
-                while job is not None:
-                    job.error = RuntimeError("pod serving stopped")
-                    job.done.set()
-                    try:
-                        job = self._jobs.get_nowait()
-                    except queue.Empty:
-                        job = None
+                # would deadlock its HTTP thread in done.wait(). The submit
+                # lock guarantees nothing is enqueued after this drain.
+                with self._submit_lock:
+                    pending = [job] if job is not None else []
+                    while True:
+                        try:
+                            pending.append(self._jobs.get_nowait())
+                        except queue.Empty:
+                            break
+                    for j in pending:
+                        j.error = RuntimeError("pod serving stopped")
+                        j.done.set()
                 return
             if job is None:
                 _broadcast(np.asarray([_IDLE, 0, 0, 0, 0, 0, 0, 0], np.int32))
@@ -161,12 +169,13 @@ class PodGenerator:
     ) -> list[list[int]]:
         if not token_lists:
             return []
-        if self._stop:
-            raise RuntimeError("pod serving stopped")
         gen = gen or GenerateConfig()
         token_lists = [t if t else [self.tokenizer.bos_id] for t in token_lists]
         job = _Job(token_lists, gen)
-        self._jobs.put(job)
+        with self._submit_lock:
+            if self._stop:
+                raise RuntimeError("pod serving stopped")
+            self._jobs.put(job)
         job.done.wait()
         if job.error is not None:
             raise job.error

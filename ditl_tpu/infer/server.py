@@ -24,6 +24,7 @@ CLI (any host of a pod; serving is process-0-gated):
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import threading
 import time
@@ -173,7 +174,17 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=float(payload.get("top_p") or 1.0),
                 seed=int(seed),
             )
+            lp_req = payload.get("logprobs")
             if payload.get("stream"):
+                if lp_req:
+                    # Streaming logprobs are unsupported; failing loudly beats
+                    # silently returning chunks without them.
+                    self._send_json(
+                        400,
+                        {"error": {"message": "logprobs with stream=true is "
+                                   "not supported by this server"}},
+                    )
+                    return
                 try:
                     self._stream_complete(payload, prompt, gen, chat=chat)
                 except (BrokenPipeError, ConnectionError):
@@ -184,7 +195,75 @@ class _Handler(BaseHTTPRequestHandler):
                     logger.exception("streaming completion failed")
                 return
             t0 = time.time()
-            if self.threaded_engine is not None:
+            logprobs_json = None
+            if lp_req:
+                if not hasattr(self.generator, "generate_tokens_with_logprobs"):
+                    # --pod wraps the generator in PodGenerator; its broadcast
+                    # protocol doesn't carry logprobs (and device work must
+                    # not bypass it).
+                    self._send_json(
+                        400,
+                        {"error": {"message": "logprobs is not supported "
+                                   "with --pod serving"}},
+                    )
+                    return
+                # OpenAI logprobs: completions' `logprobs: N` = top-N; chat's
+                # `logprobs: true` + `top_logprobs: N`. Served by the
+                # lock-step generator (exact per-step logits) even when the
+                # continuous engine handles plain requests. N is clamped
+                # (OpenAI caps at 5/20) — it is part of the compile key, so
+                # unbounded client values would compile unbounded programs.
+                n_top = (
+                    int(payload.get("top_logprobs") or 1) if chat else int(lp_req)
+                )
+                n_top = max(1, min(n_top, 20))
+                tok = self.generator.tokenizer
+                prompt_ids = [tok.bos_id] + tok.encode(prompt)
+                lp_gen = dataclasses.replace(gen, logprobs=n_top)
+                with self.device_lock:
+                    outs, lps = self.generator.generate_tokens_with_logprobs(
+                        [prompt_ids], lp_gen
+                    )
+                text = tok.decode(outs[0])
+                lp = lps[0]
+                tok_strs = [tok.decode([t]) for t in outs[0]]
+                if chat:
+                    logprobs_json = {
+                        "content": [
+                            {
+                                "token": s,
+                                "logprob": lp["token_logprobs"][i],
+                                "top_logprobs": [
+                                    {"token": tok.decode([tid]), "logprob": tlp}
+                                    for tid, tlp in zip(
+                                        lp["top_ids"][i], lp["top_logprobs"][i]
+                                    )
+                                ],
+                            }
+                            for i, s in enumerate(tok_strs)
+                        ]
+                    }
+                else:
+                    offsets, pos = [], len(prompt)
+                    for s in tok_strs:
+                        offsets.append(pos)
+                        pos += len(s)
+                    logprobs_json = {
+                        "tokens": tok_strs,
+                        "token_logprobs": lp["token_logprobs"],
+                        "top_logprobs": [
+                            {
+                                tok.decode([tid]): tlp
+                                for tid, tlp in zip(
+                                    lp["top_ids"][i], lp["top_logprobs"][i]
+                                )
+                            }
+                            for i in range(len(tok_strs))
+                        ],
+                        "text_offset": offsets,
+                    }
+                n_prompt = len(prompt_ids)
+            elif self.threaded_engine is not None:
                 tok = self.threaded_engine.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
                 out = self.threaded_engine.generate_one(
@@ -209,6 +288,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if chat
                 else {"index": 0, "text": text, "finish_reason": "stop"}
             )
+            if logprobs_json is not None:
+                choice["logprobs"] = logprobs_json
             self._send_json(
                 200,
                 {

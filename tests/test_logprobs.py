@@ -1,0 +1,155 @@
+"""Logprobs surface (engine `generate_tokens_with_logprobs` + OpenAI API).
+
+Contracts: chosen-token logprobs come from the raw (unshaped) distribution,
+greedy decoding's chosen token is exactly the top-1 alternative, all
+logprobs are valid (<= 0, finite), and the server renders both the
+completions-style and chat-style OpenAI logprobs JSON aligned with the
+generated text.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    from ditl_tpu.config import ModelConfig
+    from ditl_tpu.models import llama
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+        dtype="float32", param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_engine_logprobs_greedy_top1_is_chosen(tiny_setup):
+    cfg, params = tiny_setup
+    tok = ByteTokenizer()
+    g = Generator(params, cfg, tok)
+    prompts = [[tok.bos_id] + tok.encode("hello"), [tok.bos_id] + tok.encode("ab")]
+    gen = GenerateConfig(max_new_tokens=6, logprobs=3)
+    outs, lps = g.generate_tokens_with_logprobs(prompts, gen)
+    assert len(outs) == len(lps) == 2
+    for toks, lp in zip(outs, lps):
+        n = len(toks)
+        assert len(lp["token_logprobs"]) == n
+        assert len(lp["top_ids"]) == n and len(lp["top_logprobs"]) == n
+        for i in range(n):
+            assert len(lp["top_ids"][i]) == 3
+            # Greedy: chosen == argmax == top-1; logprobs from the raw dist.
+            assert lp["top_ids"][i][0] == toks[i]
+            assert lp["top_logprobs"][i][0] == pytest.approx(
+                lp["token_logprobs"][i], abs=1e-5
+            )
+            assert all(v <= 1e-6 and np.isfinite(v) for v in lp["top_logprobs"][i])
+            # top-N is sorted descending
+            assert lp["top_logprobs"][i] == sorted(lp["top_logprobs"][i], reverse=True)
+
+
+def test_logprobs_do_not_change_tokens(tiny_setup):
+    cfg, params = tiny_setup
+    tok = ByteTokenizer()
+    g = Generator(params, cfg, tok)
+    prompts = [[tok.bos_id] + tok.encode("the quick")]
+    plain = g.generate_tokens(prompts, GenerateConfig(max_new_tokens=8))
+    with_lp, _ = g.generate_tokens_with_logprobs(
+        prompts, GenerateConfig(max_new_tokens=8, logprobs=2)
+    )
+    assert plain == with_lp
+
+
+def test_logprobs_requires_positive_n(tiny_setup):
+    cfg, params = tiny_setup
+    g = Generator(params, cfg, ByteTokenizer())
+    with pytest.raises(ValueError, match="logprobs"):
+        g.generate_tokens_with_logprobs([[1]], GenerateConfig(max_new_tokens=2))
+
+
+def _post(base, path, payload):
+    req = urllib.request.Request(
+        f"{base}{path}", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return json.loads(r.read())
+
+
+def test_server_logprobs_json(tiny_setup):
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params = tiny_setup
+    gen = Generator(params, cfg, ByteTokenizer())
+    server = make_server(gen, port=0, default_max_tokens=4)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        # completions style: logprobs: N
+        out = _post(base, "/v1/completions",
+                    {"prompt": "abc", "max_tokens": 4, "logprobs": 2})
+        lp = out["choices"][0]["logprobs"]
+        n = len(lp["tokens"])
+        assert len(lp["token_logprobs"]) == n == len(lp["top_logprobs"])
+        assert len(lp["text_offset"]) == n
+        if n:
+            assert lp["text_offset"][0] == len("abc")
+            assert all(len(d) <= 2 for d in lp["top_logprobs"])
+            assert "".join(lp["tokens"]) == out["choices"][0]["text"]
+        # chat style: logprobs: true + top_logprobs
+        out = _post(base, "/v1/chat/completions",
+                    {"messages": [{"role": "user", "content": "hi"}],
+                     "max_tokens": 4, "logprobs": True, "top_logprobs": 2})
+        content = out["choices"][0]["logprobs"]["content"]
+        text = out["choices"][0]["message"]["content"]
+        assert "".join(e["token"] for e in content) == text
+        for e in content:
+            assert e["logprob"] <= 1e-6
+            assert len(e["top_logprobs"]) == 2
+    finally:
+        server.shutdown()
+
+
+def test_server_logprobs_unsupported_combos(tiny_setup):
+    from ditl_tpu.infer.podserve import PodGenerator
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params = tiny_setup
+    pod = PodGenerator(Generator(params, cfg, ByteTokenizer()), poll_s=0.01)
+    server = make_server(pod, port=0, default_max_tokens=4)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        # streaming + logprobs: explicit 400, not silent omission
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": "a", "stream": True, "logprobs": 1}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+        # pod serving + logprobs: explicit 400 (protocol doesn't carry them)
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": "a", "logprobs": 1}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req)
+        assert e.value.code == 400
+    finally:
+        server.shutdown()
+        pod.close()
