@@ -62,6 +62,10 @@ class Request:
     tokens: list[int] = field(default_factory=list)
     slot: int | None = None
     finished: bool = False
+    # Chunked prefill progress: next prompt offset to prefill; the request
+    # joins decode ticks only once the whole prompt is in the cache.
+    prefill_pos: int = 0
+    prefilling: bool = False
     # Streaming: when set, every harvest pushes this chunk's new token ids
     # (list[int]); a final ``None`` marks completion.
     stream: Any = None
@@ -81,11 +85,19 @@ class ContinuousEngine:
         gen: GenerateConfig | None = None,
         seed: int = 0,
         max_cache_len: int | None = None,
+        prefill_chunk: int = 0,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
         131072 would be ~17 GB of cache PER SLOT at 8B scale); requests are
-        validated against the cap at submit."""
+        validated against the cap at submit.
+
+        ``prefill_chunk > 0`` enables chunked prefill: prompts longer than
+        the chunk are prefilled one chunk per scheduler tick, interleaved
+        with other slots' decode chunks — a 100k-token admission no longer
+        stalls every in-flight generation for the whole prefill (and one
+        chunk-sized program serves every prompt length, instead of one
+        compile per prompt-length bucket)."""
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer
@@ -95,6 +107,9 @@ class ContinuousEngine:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.n_slots = n_slots
         self.decode_chunk = decode_chunk
+        if prefill_chunk < 0:
+            raise ValueError(f"prefill_chunk must be >= 0, got {prefill_chunk}")
+        self.prefill_chunk = prefill_chunk
         self.gen = gen or GenerateConfig()
         self.smax = min(model_cfg.max_seq_len, max_cache_len or model_cfg.max_seq_len)
 
@@ -356,11 +371,26 @@ class ContinuousEngine:
         self._queue.append(req)
         return req.req_id
 
-    def _prefill_into_slot(self, req: Request, slot: int, rng) -> jax.Array:
+    def _prefill_into_slot(self, req: Request, slot: int, rng) -> jax.Array | None:
         """Fill the slot's cache for ``req``'s prompt and return the first
         sampled token. Uses a registered prefix's KV when one matches (seed
-        copy + suffix-only prefill), else the full prefill program."""
+        copy + suffix-only prefill), else the full prefill program. Returns
+        ``None`` when chunked prefill takes over (the request finishes
+        prefilling across subsequent ticks, see ``_advance_prefill``)."""
         prefix = self._match_prefix(req.prompt)
+        d0 = 0 if prefix is None else prefix[2]
+        if self.prefill_chunk and len(req.prompt) - d0 > self.prefill_chunk:
+            if prefix is not None:
+                row, _, _ = prefix
+                p_bucket = row["k"].shape[2]
+                if p_bucket not in self._seed_cache:
+                    self._seed_cache[p_bucket] = self._build_seed(p_bucket)
+                self.cache = self._seed_cache[p_bucket](
+                    self.cache, row, jnp.int32(slot)
+                )
+            req.prefill_pos = d0
+            req.prefilling = True
+            return None
         if prefix is None:
             p_bucket = min(_next_pow2(len(req.prompt), floor=16), self.smax)
             if p_bucket not in self._prefill_cache:
@@ -406,6 +436,40 @@ class ContinuousEngine:
         )
         return first
 
+    def _advance_prefill(self, req: Request) -> None:
+        """One chunk of a chunked prefill (reuses the suffix-prefill program —
+        a chunk IS a suffix continuation at offset ``prefill_pos``). The
+        final chunk's sample becomes the request's first token, and the slot
+        key is (re)derived from the request seed so sampling stays
+        reproducible no matter how many decode ticks ran while parked."""
+        d = req.prefill_pos
+        s = min(self.prefill_chunk, len(req.prompt) - d)
+        # The write window must fit: a clamped dynamic_update_slice would
+        # silently shift the chunk. Tail chunks near the cache end use a
+        # smaller bucket.
+        s_bucket = (
+            self.prefill_chunk
+            if d + self.prefill_chunk <= self.smax
+            else min(_next_pow2(s, floor=16), self.smax - d)
+        )
+        if s_bucket not in self._suffix_prefill:
+            logger.info("compiling suffix prefill for bucket %d", s_bucket)
+            self._suffix_prefill[s_bucket] = self._build_suffix_prefill(s_bucket)
+        ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, :s] = req.prompt[d: d + s]
+        slot_key, sub = jax.random.split(jax.random.key(req.seed))
+        self.cache, first = self._suffix_prefill[s_bucket](
+            self.params, self.cache, jnp.asarray(ids), jnp.int32(d),
+            jnp.int32(s), jnp.int32(req.slot), jnp.float32(req.temperature),
+            jnp.float32(req.top_p), sub,
+        )
+        req.prefill_pos += s
+        if req.prefill_pos >= len(req.prompt):
+            req.prefilling = False
+            self.cur = self.cur.at[req.slot].set(first)
+            self.pos = self.pos.at[req.slot].set(len(req.prompt))
+            self.keys = self.keys.at[req.slot].set(slot_key)
+
     def _admit(self) -> None:
         for slot in range(self.n_slots):
             if self._slots[slot] is not None or not self._queue:
@@ -413,11 +477,18 @@ class ContinuousEngine:
             req = self._queue.popleft()
             slot_key = jax.random.key(req.seed)
             slot_key, sub = jax.random.split(slot_key)
-            first = self._prefill_into_slot(req, slot, sub)
             req.slot = slot
+            first = self._prefill_into_slot(req, slot, sub)
             self._slots[slot] = req
-            self.cur = self.cur.at[slot].set(first)
-            self.pos = self.pos.at[slot].set(len(req.prompt))
+            if first is None:
+                # Chunked prefill in progress: park the row's decode writes
+                # on the last cache slot (never attended before it is
+                # legitimately overwritten) until the prompt is fully in.
+                self.cur = self.cur.at[slot].set(self.tokenizer.pad_id)
+                self.pos = self.pos.at[slot].set(self.smax - 1)
+            else:
+                self.cur = self.cur.at[slot].set(first)
+                self.pos = self.pos.at[slot].set(len(req.prompt))
             self.temps = self.temps.at[slot].set(req.temperature)
             self.top_ps = self.top_ps.at[slot].set(req.top_p)
             self.keys = self.keys.at[slot].set(slot_key)
@@ -425,7 +496,9 @@ class ContinuousEngine:
     def _harvest(self, emitted: np.ndarray) -> None:
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
         for slot, req in enumerate(self._slots):
-            if req is None:
+            if req is None or req.prefilling:
+                # A still-prefilling slot is parked: its decode-row output is
+                # pad filler, not a finished (empty) generation.
                 continue
             fresh: list[int] = []
             for tok in emitted[slot]:
@@ -446,13 +519,17 @@ class ContinuousEngine:
                 self._slots[slot] = None
 
     def step(self) -> None:
-        """One scheduler tick: admit queued requests, decode one chunk."""
+        """One scheduler tick: admit queued requests, advance one chunk of
+        every in-progress chunked prefill, decode one chunk."""
         self._admit()
-        occupied = [r is not None for r in self._slots]
+        for req in self._slots:
+            if req is not None and req.prefilling:
+                self._advance_prefill(req)
+        occupied = [r is not None and not r.prefilling for r in self._slots]
         if not any(occupied):  # host-side check: no device sync on idle ticks
             return
         alive = jnp.asarray(occupied, bool)
-        active = [r for r in self._slots if r is not None]
+        active = [r for r in self._slots if r is not None and not r.prefilling]
         sampled = any(r.temperature > 0.0 for r in active)
         # top_p only matters when something actually samples — greedy rows
         # ignore it, so (False, True) would compile a redundant program.

@@ -79,7 +79,14 @@ class Generator:
         self.tokenizer = tokenizer
         self.mesh = mesh
         self.rules = rules
-        self._compiled: dict = {}
+        # LRU: the compile key includes client-controlled GenerateConfig
+        # fields (temperature, top_p, max_new_tokens...), so an unbounded
+        # cache is an unbounded memory leak on a public server — a client
+        # sweeping temperatures would pin one program per distinct float.
+        import collections
+
+        self._compiled: collections.OrderedDict = collections.OrderedDict()
+        self._compile_cache_size = 32
 
     # -- compiled program ---------------------------------------------------
 
@@ -200,6 +207,10 @@ class Generator:
         key = (batch, prompt_len, dataclasses.replace(gen, seed=0))
         if key not in self._compiled:
             self._compiled[key] = self._build(batch, prompt_len, gen)
+            while len(self._compiled) > self._compile_cache_size:
+                self._compiled.popitem(last=False)
+        else:
+            self._compiled.move_to_end(key)
         return self._compiled[key]
 
     # -- public surface -----------------------------------------------------

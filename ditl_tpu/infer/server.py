@@ -211,8 +211,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # `logprobs: true` + `top_logprobs: N`. Served by the
                 # lock-step generator (exact per-step logits) even when the
                 # continuous engine handles plain requests. N is clamped
-                # (OpenAI caps at 5/20) — it is part of the compile key, so
-                # unbounded client values would compile unbounded programs.
+                # (OpenAI caps at 5/20); the Generator's LRU program cache
+                # bounds what other client-controlled compile-key fields
+                # (temperature, top_p, max_tokens) can pin in memory.
                 n_top = (
                     int(payload.get("top_logprobs") or 1) if chat else int(lp_req)
                 )
@@ -360,6 +361,12 @@ def serve(argv: list[str] | None = None) -> int:
     parser.add_argument("--slots", type=int, default=8,
                         help="decode slots for --engine continuous")
     parser.add_argument(
+        "--prefill-chunk", type=int, default=0,
+        help="chunked prefill for --engine continuous: prompts longer than "
+        "this prefill one chunk per tick, interleaved with in-flight "
+        "decodes (0 = whole-prompt prefill)",
+    )
+    parser.add_argument(
         "--quantize", choices=("none", "int8"), default="none",
         help="weight-only int8 (halves decode HBM reads; ops/quant.py)",
     )
@@ -455,6 +462,7 @@ def serve(argv: list[str] | None = None) -> int:
             ContinuousEngine(
                 params, cfg, tokenizer, n_slots=args.slots,
                 max_cache_len=args.max_cache_len or None,
+                prefill_chunk=args.prefill_chunk,
             )
         )
     server = make_server(
