@@ -79,6 +79,10 @@ class Generator:
         self.tokenizer = tokenizer
         self.mesh = mesh
         self.rules = rules
+        # Multi-LoRA serving tree (models/lora.stack_adapters): full-tree
+        # adapter leaves are (L, K, d, r); requests then pick adapters by id.
+        lora = params.get("layers", {}).get("lora") or {}
+        self.multi_lora = bool(lora) and next(iter(lora.values()))["a"].ndim == 4
         # LRU: the compile key includes client-controlled GenerateConfig
         # fields (temperature, top_p, max_new_tokens...), so an unbounded
         # cache is an unbounded memory leak on a public server — a client
@@ -103,7 +107,7 @@ class Generator:
         eos_id = jnp.int32(self.tokenizer.eos_id)
         slots = jnp.arange(max_len, dtype=jnp.int32)
 
-        def run(params, input_ids, lengths, rng):
+        def run(params, input_ids, lengths, rng, adapter_ids=None):
             cache = init_cache(cfg, batch, max_len)
             if mesh is not None:
                 from ditl_tpu.parallel.sharding import named_sharding_tree
@@ -126,6 +130,7 @@ class Generator:
                 cache=cache,
                 cache_index=jnp.int32(0),
                 attn_mask=prefill_mask,
+                adapter_ids=adapter_ids,
             )
             last = jnp.take_along_axis(
                 logits, (lengths - 1)[:, None, None], axis=1
@@ -169,6 +174,7 @@ class Generator:
                     cache=cache,
                     cache_index=write_idx,
                     attn_mask=mask,
+                    adapter_ids=adapter_ids,
                 )
                 nxt = sample_logits(
                     step_logits[:, 0], sub, temperature=gen.temperature,
@@ -216,29 +222,45 @@ class Generator:
     # -- public surface -----------------------------------------------------
 
     def generate_tokens(
-        self, token_lists: list[list[int]], gen: GenerateConfig | None = None
+        self,
+        token_lists: list[list[int]],
+        gen: GenerateConfig | None = None,
+        adapter_ids: list[int] | None = None,
     ) -> list[list[int]]:
-        """Token-id prompts in, generated token ids out (EOS-trimmed)."""
-        return self._generate(token_lists, gen)[0]
+        """Token-id prompts in, generated token ids out (EOS-trimmed).
+        ``adapter_ids`` selects each prompt's LoRA adapter when the params
+        tree is a multi-adapter stack (0 = the conventional base slot)."""
+        return self._generate(token_lists, gen, adapter_ids)[0]
 
     def generate_tokens_with_logprobs(
-        self, token_lists: list[list[int]], gen: GenerateConfig
+        self,
+        token_lists: list[list[int]],
+        gen: GenerateConfig,
+        adapter_ids: list[int] | None = None,
     ) -> tuple[list[list[int]], list[dict]]:
         """Like ``generate_tokens`` but also returns, per prompt, a dict of
         ``token_logprobs`` (chosen token, raw distribution) and aligned
         ``top_ids``/``top_logprobs`` (N = ``gen.logprobs``) lists."""
         if gen.logprobs < 1:
             raise ValueError("generate_tokens_with_logprobs needs gen.logprobs >= 1")
-        results, lps = self._generate(token_lists, gen)
+        results, lps = self._generate(token_lists, gen, adapter_ids)
         return results, lps
 
     def _generate(
-        self, token_lists: list[list[int]], gen: GenerateConfig | None
+        self,
+        token_lists: list[list[int]],
+        gen: GenerateConfig | None,
+        adapter_ids: list[int] | None = None,
     ) -> tuple[list[list[int]], list[dict]]:
         gen = gen or GenerateConfig()
         n = len(token_lists)
         if n == 0:
             return [], []
+        if adapter_ids is not None and not self.multi_lora:
+            raise ValueError(
+                "adapter_ids given but params are not a multi-adapter stack "
+                "(models/lora.stack_adapters)"
+            )
         token_lists = [t if t else [self.tokenizer.bos_id] for t in token_lists]
         batch = _next_pow2(n, floor=1)
         prompt_len = _next_pow2(max(len(t) for t in token_lists))
@@ -249,9 +271,24 @@ class Generator:
             lengths[i] = len(toks)
         run = self._get_compiled(batch, prompt_len, gen)
         rng = jax.random.key(gen.seed)
-        out = jax.device_get(
-            run(self.params, jnp.asarray(ids), jnp.asarray(lengths), rng)
-        )
+        args = [self.params, jnp.asarray(ids), jnp.asarray(lengths), rng]
+        if self.multi_lora:
+            aid = np.zeros((batch,), np.int32)
+            if adapter_ids is not None:
+                if len(adapter_ids) != n:
+                    raise ValueError(
+                        f"adapter_ids has {len(adapter_ids)} entries for {n} prompts"
+                    )
+                lora = self.params["layers"]["lora"]
+                k = next(iter(lora.values()))["a"].shape[1]
+                bad = [i for i in adapter_ids if not 0 <= i < k]
+                if bad:
+                    # JAX gathers clamp out-of-range indices under jit, which
+                    # would silently serve the wrong adapter.
+                    raise ValueError(f"adapter ids {bad} out of range [0, {k})")
+                aid[:n] = adapter_ids
+            args.append(jnp.asarray(aid))
+        out = jax.device_get(run(*args))
         tokens = np.asarray(out["tokens"])
         results = []
         keep: list[int] = []
@@ -277,11 +314,14 @@ class Generator:
         return results, lps
 
     def generate(
-        self, prompts: list[str], gen: GenerateConfig | None = None
+        self,
+        prompts: list[str],
+        gen: GenerateConfig | None = None,
+        adapter_ids: list[int] | None = None,
     ) -> list[str]:
         """Text prompts in, generated continuations out."""
         encoded = [
             [self.tokenizer.bos_id] + self.tokenizer.encode(p) for p in prompts
         ]
-        out = self.generate_tokens(encoded, gen)
+        out = self.generate_tokens(encoded, gen, adapter_ids)
         return [self.tokenizer.decode(toks) for toks in out]

@@ -165,8 +165,16 @@ class PodGenerator:
     # -- Generator surface ----------------------------------------------------
 
     def generate_tokens(
-        self, token_lists: list[list[int]], gen: GenerateConfig | None = None
+        self,
+        token_lists: list[list[int]],
+        gen: GenerateConfig | None = None,
+        adapter_ids=None,
     ) -> list[list[int]]:
+        if adapter_ids is not None:
+            raise ValueError(
+                "multi-LoRA adapter selection is not carried by the pod "
+                "broadcast protocol; serve adapters without --pod"
+            )
         if not token_lists:
             return []
         gen = gen or GenerateConfig()
@@ -182,12 +190,18 @@ class PodGenerator:
         return job.result
 
     def generate(
-        self, prompts: list[str], gen: GenerateConfig | None = None
+        self,
+        prompts: list[str],
+        gen: GenerateConfig | None = None,
+        adapter_ids=None,
     ) -> list[str]:
         encoded = [
             [self.tokenizer.bos_id] + self.tokenizer.encode(p) for p in prompts
         ]
-        return [self.tokenizer.decode(t) for t in self.generate_tokens(encoded, gen)]
+        return [
+            self.tokenizer.decode(t)
+            for t in self.generate_tokens(encoded, gen, adapter_ids)
+        ]
 
     def close(self) -> None:
         """Broadcast shutdown to the pod and stop the pump. Waits long enough

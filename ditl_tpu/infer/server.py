@@ -54,6 +54,7 @@ class _Handler(BaseHTTPRequestHandler):
     model_name: str = "ditl-tpu"
     device_lock: threading.Lock = None
     default_max_tokens: int = 64
+    adapter_names: dict = {}  # multi-LoRA: request "model" name -> adapter id
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
@@ -70,10 +71,11 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path in ("/health", "/v1/health"):
             self._send_json(200, {"status": "ok", "model": self.model_name})
         elif self.path in ("/v1/models", "/models"):
-            self._send_json(
-                200,
-                {"object": "list", "data": [{"id": self.model_name, "object": "model"}]},
-            )
+            models = [{"id": self.model_name, "object": "model"}] + [
+                {"id": name, "object": "model", "parent": self.model_name}
+                for name in self.adapter_names
+            ]
+            self._send_json(200, {"object": "list", "data": models})
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -104,7 +106,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(b"data: [DONE]\n\n")
         self.wfile.flush()
 
-    def _stream_complete(self, payload: dict, prompt: str, gen, *, chat: bool) -> None:
+    def _stream_complete(
+        self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None
+    ) -> None:
         """OpenAI streaming: real incremental chunks from the continuous
         engine; the lockstep engine generates fully, then emits one chunk."""
         cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
@@ -129,7 +133,7 @@ class _Handler(BaseHTTPRequestHandler):
         def events():
             if chat:
                 yield event("", role="assistant")  # role-announcement chunk
-            if self.threaded_engine is not None:
+            if self.threaded_engine is not None and adapter_ids is None:
                 tok = self.threaded_engine.tokenizer
                 for chunk in self.threaded_engine.stream_one(
                     [tok.bos_id] + tok.encode(prompt),
@@ -143,7 +147,7 @@ class _Handler(BaseHTTPRequestHandler):
                         yield event(text)
             else:
                 with self.device_lock:
-                    text = self.generator.generate([prompt], gen)[0]
+                    text = self.generator.generate([prompt], gen, adapter_ids)[0]
                 if text:
                     yield event(text)
             yield event("", finish="stop")
@@ -174,6 +178,10 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=float(payload.get("top_p") or 1.0),
                 seed=int(seed),
             )
+            # Multi-LoRA routing: the OpenAI "model" field selects an
+            # adapter by name; unknown/absent names serve the base (slot 0).
+            aid = self.adapter_names.get(str(payload.get("model") or ""))
+            adapter_ids = [aid] if aid is not None else None
             lp_req = payload.get("logprobs")
             if payload.get("stream"):
                 if lp_req:
@@ -186,7 +194,9 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     return
                 try:
-                    self._stream_complete(payload, prompt, gen, chat=chat)
+                    self._stream_complete(
+                        payload, prompt, gen, chat=chat, adapter_ids=adapter_ids
+                    )
                 except (BrokenPipeError, ConnectionError):
                     logger.info("client disconnected mid-stream")
                 except Exception:
@@ -223,7 +233,7 @@ class _Handler(BaseHTTPRequestHandler):
                 lp_gen = dataclasses.replace(gen, logprobs=n_top)
                 with self.device_lock:
                     outs, lps = self.generator.generate_tokens_with_logprobs(
-                        [prompt_ids], lp_gen
+                        [prompt_ids], lp_gen, adapter_ids
                     )
                 text = tok.decode(outs[0])
                 lp = lps[0]
@@ -264,7 +274,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "text_offset": offsets,
                     }
                 n_prompt = len(prompt_ids)
-            elif self.threaded_engine is not None:
+            elif self.threaded_engine is not None and adapter_ids is None:
                 tok = self.threaded_engine.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
                 out = self.threaded_engine.generate_one(
@@ -278,7 +288,7 @@ class _Handler(BaseHTTPRequestHandler):
                 n_prompt = len(prompt_ids)
             else:
                 with self.device_lock:
-                    text = self.generator.generate([prompt], gen)[0]
+                    text = self.generator.generate([prompt], gen, adapter_ids)[0]
                 tok = self.generator.tokenizer
                 n_prompt = len(tok.encode(prompt)) + 1
             n_out = len(tok.encode(text))
@@ -323,10 +333,13 @@ def make_server(
     model_name: str = "ditl-tpu",
     default_max_tokens: int = 64,
     threaded_engine=None,
+    adapter_names: dict | None = None,
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
-    continuous batching instead of the lock-step Generator."""
+    continuous batching instead of the lock-step Generator;
+    ``adapter_names`` maps OpenAI "model" names to multi-LoRA adapter ids
+    (the generator's params must be a stacked-adapter tree)."""
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -336,6 +349,7 @@ def make_server(
             "model_name": model_name,
             "device_lock": threading.Lock(),
             "default_max_tokens": default_max_tokens,
+            "adapter_names": adapter_names or {},
         },
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -375,6 +389,17 @@ def serve(argv: list[str] | None = None) -> int:
         help="int8 KV cache (halves cache reads/footprint; infer/cache.py)",
     )
     parser.add_argument(
+        "--override", action="append", default=[], metavar="FIELD=VALUE",
+        help="ModelConfig override (repeatable), e.g. lora_rank=16 or "
+        "hidden_size=64 — same dotted-override machinery as the launcher",
+    )
+    parser.add_argument(
+        "--adapter", action="append", default=[], metavar="NAME=ORBAX_DIR",
+        help="multi-LoRA serving (repeatable): load the LoRA adapters from "
+        "an Orbax checkpoint dir; requests with \"model\": NAME use that "
+        "adapter, any other model name serves the base weights",
+    )
+    parser.add_argument(
         "--mesh", default="",
         help='shard the model over a device mesh, e.g. "tensor=4" or '
         '"fsdp=2,tensor=4" (axes as in MeshConfig); spans all pod devices',
@@ -399,6 +424,12 @@ def serve(argv: list[str] | None = None) -> int:
         parser.error("--mesh on a multi-host pod requires --pod: the mesh "
                      "spans all hosts' devices, so every process must join "
                      "the collective decode loop")
+    if args.adapter and args.engine == "continuous":
+        parser.error("--adapter composes with --engine lockstep only (the "
+                     "continuous engine has no per-slot adapter selection)")
+    if args.adapter and args.pod:
+        parser.error("--adapter does not compose with --pod (the broadcast "
+                     "protocol does not carry adapter ids)")
     if args.mesh and args.engine == "continuous":
         parser.error("--mesh composes with --engine lockstep only (the "
                      "continuous engine's cache/scheduler is single-device; "
@@ -423,9 +454,13 @@ def serve(argv: list[str] | None = None) -> int:
         )
 
     cfg = get_preset(args.preset) if args.preset else ModelConfig()
-    if args.kv_quant == "int8":
-        import dataclasses
+    if args.override:
+        from ditl_tpu.config import Config, parse_overrides
 
+        cfg = parse_overrides(
+            Config(model=cfg), [f"model.{o}" for o in args.override]
+        ).model
+    if args.kv_quant == "int8":
         cfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
     tokenizer = get_tokenizer(args.tokenizer)
     params = llama.init_params(jax.random.key(0), cfg)
@@ -438,6 +473,43 @@ def serve(argv: list[str] | None = None) -> int:
             params = restored
             logger.info("restored params from %s", args.checkpoint_dir)
         ckpt.close()
+    adapter_names: dict[str, int] = {}
+    if args.adapter:
+        if cfg.lora_rank <= 0:
+            parser.error("--adapter needs a LoRA-capable config (a preset/"
+                         "checkpoint with model.lora_rank > 0)")
+        if args.quantize == "int8":
+            parser.error("--adapter does not compose with --quantize "
+                         "(adapters stay float; merge instead to quantize)")
+        from ditl_tpu.models import lora as lora_mod
+        from ditl_tpu.train.checkpoint import CheckpointManager
+
+        stacks = [lora_mod.zeros_adapter(cfg)]  # id 0 = base model
+        for item in args.adapter:
+            if "=" not in item:
+                parser.error(f"--adapter wants NAME=ORBAX_DIR, got {item!r}")
+            name, path = item.split("=", 1)
+            ckpt = CheckpointManager(path)
+            restored = ckpt.restore_latest_params(jax.eval_shape(lambda: params))
+            ckpt.close()
+            if restored is None:
+                parser.error(f"--adapter {name}: no checkpoint in {path}")
+            adapter = restored["layers"].get("lora")
+            if adapter is None:
+                parser.error(f"--adapter {name}: checkpoint has no LoRA tree")
+            stacks.append(adapter)
+            adapter_names[name] = len(stacks) - 1
+        params = {
+            **params,
+            "layers": {
+                **params["layers"],
+                "lora": lora_mod.stack_adapters(stacks),
+            },
+        }
+        logger.info(
+            "multi-LoRA serving: base + %d adapters (%s)",
+            len(adapter_names), ", ".join(adapter_names),
+        )
     if args.quantize == "int8":
         from ditl_tpu.ops.quant import quantize_weights
 
@@ -468,6 +540,7 @@ def serve(argv: list[str] | None = None) -> int:
     server = make_server(
         generator, host=args.host, port=args.port, model_name=cfg.name,
         default_max_tokens=args.max_tokens, threaded_engine=threaded,
+        adapter_names=adapter_names,
     )
     logger.info("serving %s (%s) on %s:%d", cfg.name, args.engine, args.host, args.port)
     try:

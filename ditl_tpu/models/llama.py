@@ -251,6 +251,7 @@ def _decoder_layer(
     layer_cache: dict | None = None,
     cache_index: jax.Array | None = None,
     attn_mask: jax.Array | None = None,
+    adapter_ids: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, dict]:
     """One decoder block. With ``layer_cache`` (this layer's slice of the KV
     cache pytree, values shaped (B, Smax, K, D) — plus scales when int8,
@@ -270,7 +271,7 @@ def _decoder_layer(
         if lora is not None and name in lora:
             from ditl_tpu.models.lora import lora_delta
 
-            out = out + lora_delta(lora[name], h, cfg)
+            out = out + lora_delta(lora[name], h, cfg, adapter_ids=adapter_ids)
         return out
 
     # Attention block
@@ -350,6 +351,7 @@ def forward(
     cache_index: jax.Array | None = None,
     attn_mask: jax.Array | None = None,
     return_hidden: bool = False,
+    adapter_ids: jax.Array | None = None,
 ) -> Any:
     """Token ids (B, S) -> logits (B, S, V) in float32.
 
@@ -388,6 +390,7 @@ def forward(
                 layer_cache=layer_cache,
                 cache_index=cache_index,
                 attn_mask=attn_mask,
+                adapter_ids=adapter_ids,
             )
             return y, (aux, new_kv)
 
@@ -428,6 +431,7 @@ def forward(
                 segment_ids=segment_ids,
                 mesh=mesh,
                 rules=rules,
+                adapter_ids=adapter_ids,
             )
 
         layer_fn = _apply_remat(layer_fn, cfg)

@@ -30,6 +30,8 @@ __all__ = [
     "lora_logical_axes",
     "lora_delta",
     "merge_lora",
+    "stack_adapters",
+    "zeros_adapter",
 ]
 
 
@@ -66,14 +68,50 @@ def lora_logical_axes(cfg: ModelConfig) -> dict[str, Any]:
     }
 
 
-def lora_delta(p: dict[str, Any], h: jax.Array, cfg: ModelConfig) -> jax.Array:
-    """(alpha/r) * (h @ A) @ B, computed in the activation dtype."""
+def lora_delta(
+    p: dict[str, Any],
+    h: jax.Array,
+    cfg: ModelConfig,
+    adapter_ids: jax.Array | None = None,
+) -> jax.Array:
+    """(alpha/r) * (h @ A) @ B, computed in the activation dtype.
+
+    With a multi-adapter tree (``stack_adapters``: per-layer slices carry a
+    leading adapter axis (K, d, r)), ``adapter_ids`` (B,) selects each row's
+    adapter — a (B, d, r) gather per layer, tiny next to the base matmuls.
+    Serving: index 0 is conventionally ``zeros_adapter`` (= the base model).
+    """
     cd = h.dtype
     scale = cfg.lora_alpha / cfg.lora_rank
+    if p["a"].ndim == 3:  # (K, d, r): multi-adapter serving tree
+        if adapter_ids is None:
+            raise ValueError("multi-adapter LoRA tree needs adapter_ids")
+        a_sel = p["a"][adapter_ids].astype(cd)  # (B, d, r)
+        b_sel = p["b"][adapter_ids].astype(cd)  # (B, r, f)
+        low = jnp.einsum("bsd,bdr->bsr", h, a_sel, preferred_element_type=cd)
+        return scale * jnp.einsum(
+            "bsr,brf->bsf", low, b_sel, preferred_element_type=cd
+        )
     low = jnp.einsum("bsd,dr->bsr", h, p["a"].astype(cd), preferred_element_type=cd)
     return scale * jnp.einsum(
         "bsr,rf->bsf", low, p["b"].astype(cd), preferred_element_type=cd
     )
+
+
+def stack_adapters(adapters: list[dict[str, Any]]) -> dict[str, Any]:
+    """Stack K adapter trees for multi-LoRA serving: leaves go from
+    (L, d, r) to (L, K, d, r) — the adapter axis sits AFTER the layer axis
+    so the model's layer scan still slices axis 0 and each layer body sees a
+    (K, d, r) slice. Put ``zeros_adapter`` first so id 0 serves the base
+    model."""
+    if not adapters:
+        raise ValueError("need at least one adapter")
+    return jax.tree.map(lambda *xs: jnp.stack(xs, axis=1), *adapters)
+
+
+def zeros_adapter(cfg: ModelConfig) -> dict[str, Any]:
+    """An all-zeros adapter (delta is exactly 0: the base model)."""
+    return jax.tree.map(jnp.zeros_like, init_lora_params(jax.random.key(0), cfg))
 
 
 def merge_lora(params: dict[str, Any], cfg: ModelConfig) -> dict[str, Any]:

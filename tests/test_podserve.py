@@ -107,3 +107,30 @@ def test_pod_close_fails_queued_and_new_work(tiny_setup):
     pod.close()
     with pytest.raises(RuntimeError, match="stopped"):
         pod.generate_tokens([tok.encode("late")], GenerateConfig(max_new_tokens=4))
+
+
+def test_server_plain_completion_via_pod(tiny_setup):
+    # The handler passes adapter_ids (None) positionally — the pod surface
+    # must accept it (regression: --pod serving broke when it did not).
+    import json
+    import urllib.request
+
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params = tiny_setup
+    pod = PodGenerator(Generator(params, cfg, ByteTokenizer()), poll_s=0.01)
+    server = make_server(pod, port=0, default_max_tokens=4)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": "ab", "max_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert json.loads(r.read())["object"] == "text_completion"
+    finally:
+        server.shutdown()
+        pod.close()
