@@ -564,6 +564,26 @@ class ContinuousEngine:
         results = self.run()
         return [self.tokenizer.decode(results[i]) for i in ids]
 
+    def cancel(self, req_id: int) -> bool:
+        """Abandon a queued or in-flight request: its slot frees immediately
+        (the next admission's prefill overwrites the stale cache rows, the
+        same invariant as normal slot reuse) instead of decoding dead work to
+        its full token budget. Streamed requests receive their terminal
+        ``None``. Returns True if the request was found."""
+        for req in self._queue:
+            if req.req_id == req_id:
+                self._queue.remove(req)
+                if req.stream is not None:
+                    req.stream.put(None)
+                return True
+        for slot, req in enumerate(self._slots):
+            if req is not None and req.req_id == req_id:
+                self._slots[slot] = None
+                if req.stream is not None:
+                    req.stream.put(None)
+                return True
+        return self._completed.pop(req_id, None) is not None
+
     def take_result(self, req_id: int) -> list[int] | None:
         """Pop a finished request's tokens, or None if still in flight."""
         req = self._completed.pop(req_id, None)
@@ -589,6 +609,7 @@ class ThreadedEngine:
         self._engine = engine
         self._cond = threading.Condition()
         self._results: dict[int, list[int]] = {}
+        self._cancels: set[int] = set()
         self._error: BaseException | None = None
         self._stop = False
         self._thread = threading.Thread(target=self._drive, daemon=True)
@@ -607,8 +628,13 @@ class ThreadedEngine:
                     return
             # Device work runs OUTSIDE the lock: submissions (queue appends,
             # thread-safe deque) land while a chunk decodes and are admitted
-            # on the next tick; only result handoff needs the lock.
+            # on the next tick; only result handoff needs the lock. Cancels
+            # are applied here because only this thread touches engine state.
+            with self._cond:
+                cancels, self._cancels = self._cancels, set()
             try:
+                for rid in cancels:
+                    self._engine.cancel(rid)
                 self._engine.step()
             except BaseException as e:  # device/compile errors must not
                 # wedge the server: fail every waiter loudly and stop.
@@ -684,18 +710,30 @@ class ThreadedEngine:
                 stream=stream,
             )
             self._cond.notify_all()
-        while True:
-            try:
-                chunk = stream.get(timeout=1.0)
-            except _queue.Empty:
-                if self._stop:
-                    raise RuntimeError(
-                        "continuous engine stopped mid-stream"
-                    ) from self._error
-                continue
-            if chunk is None:
-                return
-            yield chunk
+        try:
+            while True:
+                try:
+                    chunk = stream.get(timeout=1.0)
+                except _queue.Empty:
+                    if self._stop:
+                        raise RuntimeError(
+                            "continuous engine stopped mid-stream"
+                        ) from self._error
+                    continue
+                if chunk is None:
+                    return
+                yield chunk
+        finally:
+            # Consumer stopped early (stop sequence hit, client disconnect):
+            # cancel so the engine doesn't decode the abandoned budget.
+            self.cancel(rid)
+
+    def cancel(self, req_id: int) -> None:
+        """Request cancellation; applied by the driver thread on its next
+        tick (only it touches engine state)."""
+        with self._cond:
+            self._cancels.add(req_id)
+            self._cond.notify_all()
 
     def close(self) -> None:
         with self._cond:

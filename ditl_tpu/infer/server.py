@@ -41,6 +41,68 @@ logger = get_logger(__name__)
 __all__ = ["serve", "make_server"]
 
 
+def _stop_list(stop) -> list[str]:
+    """Normalize OpenAI's `stop` (str | list | None) to <= 4 sequences.
+    Raises ValueError on non-string entries (callers answer 400)."""
+    if not stop:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    if not isinstance(stop, list) or any(not isinstance(s, str) for s in stop):
+        raise ValueError("stop must be a string or an array of strings")
+    return [s for s in stop if s][:4]
+
+
+def _apply_stop(text: str, stops: list[str]) -> tuple[str, bool]:
+    """Truncate at the earliest stop sequence (excluded, per OpenAI)."""
+    cut = None
+    for s in stops:
+        i = text.find(s)
+        if i >= 0 and (cut is None or i < cut):
+            cut = i
+    return (text, False) if cut is None else (text[:cut], True)
+
+
+class _StopTracker:
+    """Streaming stop handling: emits increments, holding back any trailing
+    text that could be the start of a stop sequence spanning a chunk
+    boundary."""
+
+    def __init__(self, stops: list[str]):
+        self.stops = stops
+        self.acc = ""
+        self.sent = 0
+        self.hit = False
+
+    def push(self, piece: str) -> str:
+        """Add decoded text; return what is safe to emit now."""
+        if self.hit:
+            return ""
+        self.acc += piece
+        cut, self.hit = _apply_stop(self.acc, self.stops)
+        if self.hit:
+            out = cut[self.sent:]
+            self.sent = len(cut)
+            return out
+        hold = 0
+        for s in self.stops:
+            for k in range(1, len(s)):
+                if self.acc.endswith(s[:k]):
+                    hold = max(hold, k)
+        safe = len(self.acc) - hold
+        out = self.acc[self.sent: safe] if safe > self.sent else ""
+        self.sent = max(self.sent, safe)
+        return out
+
+    def flush(self) -> str:
+        """End of stream: release any held-back stop-prefix text."""
+        if self.hit:
+            return ""
+        out = self.acc[self.sent:]
+        self.sent = len(self.acc)
+        return out
+
+
 def _chat_prompt(messages: list[dict]) -> str:
     """Minimal chat template: the byte/debug tokenizer has no special chat
     tokens, so roles are rendered as plain text turns."""
@@ -107,7 +169,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.flush()
 
     def _stream_complete(
-        self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None
+        self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None,
+        stops=None,
     ) -> None:
         """OpenAI streaming: real incremental chunks from the continuous
         engine; the lockstep engine generates fully, then emits one chunk."""
@@ -133,6 +196,8 @@ class _Handler(BaseHTTPRequestHandler):
         def events():
             if chat:
                 yield event("", role="assistant")  # role-announcement chunk
+            tracker = _StopTracker(stops or [])
+            n_gen = 0
             if self.threaded_engine is not None and adapter_ids is None:
                 tok = self.threaded_engine.tokenizer
                 for chunk in self.threaded_engine.stream_one(
@@ -142,15 +207,31 @@ class _Handler(BaseHTTPRequestHandler):
                     top_p=gen.top_p,
                     seed=gen.seed,
                 ):
-                    text = tok.decode(chunk)
+                    n_gen += len(chunk)
+                    text = tracker.push(tok.decode(chunk))
                     if text:
                         yield event(text)
+                    if tracker.hit:
+                        break  # stream_one cancels the abandoned request
+                tail = tracker.flush()
+                if tail:
+                    yield event(tail)
             else:
+                tok = self.generator.tokenizer
                 with self.device_lock:
-                    text = self.generator.generate([prompt], gen, adapter_ids)[0]
+                    out = self.generator.generate_tokens(
+                        [[tok.bos_id] + tok.encode(prompt)], gen, adapter_ids
+                    )[0]
+                n_gen = len(out)
+                text, _ = _apply_stop(tok.decode(out), tracker.stops)
                 if text:
                     yield event(text)
-            yield event("", finish="stop")
+            finish = (
+                "stop"
+                if tracker.hit or n_gen < gen.max_new_tokens
+                else "length"
+            )
+            yield event("", finish=finish)
 
         self._send_sse(events())
 
@@ -178,6 +259,11 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=float(payload.get("top_p") or 1.0),
                 seed=int(seed),
             )
+            try:
+                stops = _stop_list(payload.get("stop"))
+            except ValueError as e:
+                self._send_json(400, {"error": {"message": str(e)}})
+                return
             # Multi-LoRA routing: the OpenAI "model" field selects an
             # adapter by name; unknown/absent names serve the base (slot 0).
             aid = self.adapter_names.get(str(payload.get("model") or ""))
@@ -195,7 +281,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 try:
                     self._stream_complete(
-                        payload, prompt, gen, chat=chat, adapter_ids=adapter_ids
+                        payload, prompt, gen, chat=chat,
+                        adapter_ids=adapter_ids, stops=stops,
                     )
                 except (BrokenPipeError, ConnectionError):
                     logger.info("client disconnected mid-stream")
@@ -235,9 +322,28 @@ class _Handler(BaseHTTPRequestHandler):
                     outs, lps = self.generator.generate_tokens_with_logprobs(
                         [prompt_ids], lp_gen, adapter_ids
                     )
-                text = tok.decode(outs[0])
+                gen_ids = outs[0]
                 lp = lps[0]
-                tok_strs = [tok.decode([t]) for t in outs[0]]
+                # Apply stop truncation at TOKEN granularity before building
+                # the logprobs JSON: the entries must stay aligned with the
+                # returned text (keep whole tokens up to the stop cut).
+                full_text = tok.decode(gen_ids)
+                cut_text, hit_stop = _apply_stop(full_text, stops)
+                n_gen_full = len(gen_ids)
+                if hit_stop:
+                    keep, acc = 0, ""
+                    for t in gen_ids:
+                        piece = tok.decode([t])
+                        if len(acc) + len(piece) > len(cut_text):
+                            break
+                        acc += piece
+                        keep += 1
+                    gen_ids = gen_ids[:keep]
+                    lp = {k: v[:keep] for k, v in lp.items()}
+                    text = acc
+                else:
+                    text = full_text
+                tok_strs = [tok.decode([t]) for t in gen_ids]
                 if chat:
                     logprobs_json = {
                         "content": [
@@ -274,6 +380,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "text_offset": offsets,
                     }
                 n_prompt = len(prompt_ids)
+                n_gen = n_gen_full
             elif self.threaded_engine is not None and adapter_ids is None:
                 tok = self.threaded_engine.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
@@ -284,20 +391,32 @@ class _Handler(BaseHTTPRequestHandler):
                     top_p=gen.top_p,
                     seed=gen.seed,
                 )
-                text = tok.decode(out)
+                n_gen = len(out)
+                text, hit_stop = _apply_stop(tok.decode(out), stops)
                 n_prompt = len(prompt_ids)
             else:
-                with self.device_lock:
-                    text = self.generator.generate([prompt], gen, adapter_ids)[0]
                 tok = self.generator.tokenizer
-                n_prompt = len(tok.encode(prompt)) + 1
+                prompt_ids = [tok.bos_id] + tok.encode(prompt)
+                with self.device_lock:
+                    out = self.generator.generate_tokens(
+                        [prompt_ids], gen, adapter_ids
+                    )[0]
+                n_gen = len(out)
+                text, hit_stop = _apply_stop(tok.decode(out), stops)
+                n_prompt = len(prompt_ids)
+            # "length" = the GENERATED token count hit the budget (decoded
+            # text round-trips are not token-count-preserving, so never
+            # re-encode to decide this).
+            finish = (
+                "stop" if hit_stop or n_gen < gen.max_new_tokens else "length"
+            )
             n_out = len(tok.encode(text))
             kind = "chat.completion" if chat else "text_completion"
             choice = (
                 {"index": 0, "message": {"role": "assistant", "content": text},
-                 "finish_reason": "stop"}
+                 "finish_reason": finish}
                 if chat
-                else {"index": 0, "text": text, "finish_reason": "stop"}
+                else {"index": 0, "text": text, "finish_reason": finish}
             )
             if logprobs_json is not None:
                 choice["logprobs"] = logprobs_json

@@ -1,0 +1,108 @@
+"""OpenAI `stop` sequences and `finish_reason` semantics.
+
+Unit contracts for the truncation helpers (including the chunk-boundary
+hold-back in streaming), plus server-level behavior: stop-truncated
+completions report finish_reason "stop", budget-exhausted ones "length".
+"""
+
+import json
+import threading
+import urllib.request
+
+import jax
+import pytest
+
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.infer.server import _StopTracker, _apply_stop, _stop_list, make_server
+from ditl_tpu.models import llama
+
+
+def test_stop_list_normalization():
+    assert _stop_list(None) == []
+    assert _stop_list("") == []
+    assert _stop_list("x") == ["x"]
+    assert _stop_list(["a", "", "b", "c", "d", "e"]) == ["a", "b", "c", "d"]
+
+
+def test_apply_stop_earliest_wins():
+    assert _apply_stop("abcdef", ["de", "bc"]) == ("a", True)
+    assert _apply_stop("abcdef", ["zz"]) == ("abcdef", False)
+    assert _apply_stop("abcdef", []) == ("abcdef", False)
+    assert _apply_stop("abc", ["abc"]) == ("", True)
+
+
+def test_stop_tracker_spanning_chunks():
+    t = _StopTracker(["END"])
+    assert t.push("hello E") == "hello "  # "E" held back (prefix of END)
+    assert t.push("N") == ""  # "EN" still a prefix
+    assert t.push("D tail") == ""  # stop completed: nothing more emitted
+    assert t.hit
+    assert t.flush() == ""
+
+
+def test_stop_tracker_false_alarm_released():
+    t = _StopTracker(["END"])
+    assert t.push("x E") == "x "
+    assert t.push("go") == "Ego"  # "E" was not a stop after all
+    assert not t.hit
+    assert t.flush() == ""
+
+
+def test_stop_tracker_flush_releases_held_suffix():
+    t = _StopTracker(["END"])
+    assert t.push("abc EN") == "abc "
+    assert t.flush() == "EN"  # stream ended before the stop completed
+
+
+@pytest.fixture(scope="module")
+def served():
+    from ditl_tpu.config import ModelConfig
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+        dtype="float32", param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    tok = ByteTokenizer()
+    gen = Generator(params, cfg, tok)
+    server = make_server(gen, port=0, default_max_tokens=8)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield cfg, params, tok, f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def _post(base, payload):
+    req = urllib.request.Request(
+        f"{base}/v1/completions", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())
+
+
+def test_server_stop_truncates_and_reports_stop(served):
+    cfg, params, tok, base = served
+    full = Generator(params, cfg, tok).generate(
+        ["hello"], GenerateConfig(max_new_tokens=8)
+    )[0]
+    if len(full) < 2:
+        pytest.skip("model generated too little text to truncate")
+    stop_char = full[1]
+    out = _post(base, {"prompt": "hello", "max_tokens": 8, "stop": stop_char})
+    choice = out["choices"][0]
+    assert stop_char not in choice["text"]
+    assert choice["text"] == full.split(stop_char)[0]
+    assert choice["finish_reason"] == "stop"
+
+
+def test_server_finish_reason_length(served):
+    cfg, params, tok, base = served
+    full = Generator(params, cfg, tok).generate(
+        ["hello"], GenerateConfig(max_new_tokens=4)
+    )[0]
+    out = _post(base, {"prompt": "hello", "max_tokens": 4})
+    expected = "length" if len(tok.encode(full)) >= 4 else "stop"
+    assert out["choices"][0]["finish_reason"] == expected
