@@ -373,7 +373,18 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
 
-    x = params["embed"]["embedding"].astype(cd)[input_ids]
+    # Embedding lookup. The stored table is (vocab->tensor, embed->fsdp)
+    # sharded; gathering straight from it leaves the output embed-sharded in a
+    # permuted device order that GSPMD cannot reshard to the batch-sharded
+    # activation layout without an "involuntary full rematerialization"
+    # (replicate-then-repartition) — in both the forward gather and the
+    # backward scatter-add. Constraining the table to vocab-sharded /
+    # embed-replicated for the lookup makes XLA use its sharded-vocab gather
+    # (mask out-of-shard ids + psum over the tensor axis), whose output is
+    # already batch-sharded; the embed-axis all-gather this implies is the
+    # same per-use weight all-gather FSDP performs everywhere else.
+    table = _constrain(params["embed"]["embedding"].astype(cd), ("vocab", None), mesh, rules)
+    x = table[input_ids]
     x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
 
     if cache is not None:
