@@ -151,6 +151,14 @@ class ModelConfig:
     # Attention implementation: "xla" | "flash" (Pallas) | "ring" (SP ring
     # attention) | "ulysses" (SP via all-to-all head/sequence transposition)
     attention_impl: str = "xla"
+    # Pallas flash-attention tile sizes. 0 = kernel default (512). The
+    # backward kernels take their own sizes (0 = same as forward): the dkv
+    # kernel's working set (two f32 accumulators + recomputed p) differs from
+    # the forward's, so its optimum can differ — sweepable per chip.
+    flash_block_q: int = 0
+    flash_block_kv: int = 0
+    flash_block_q_bwd: int = 0
+    flash_block_kv_bwd: int = 0
     # KV-cache storage for inference: "" / "model" (compute dtype, bf16 on
     # TPU) | "int8" (symmetric per-head absmax quantization, infer/cache.py)
     kv_cache_dtype: str = ""

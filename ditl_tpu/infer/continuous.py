@@ -46,7 +46,13 @@ from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-__all__ = ["ContinuousEngine", "Request", "ThreadedEngine"]
+__all__ = ["ContinuousEngine", "QueueFullError", "Request", "ThreadedEngine"]
+
+
+class QueueFullError(RuntimeError):
+    """Raised by ``submit`` when the engine's admission queue is at its
+    configured depth cap — callers (the HTTP server) turn this into a 429
+    instead of letting waiting requests accumulate without bound."""
 
 
 @dataclass
@@ -86,6 +92,10 @@ class ContinuousEngine:
         seed: int = 0,
         max_cache_len: int | None = None,
         prefill_chunk: int = 0,
+        cache_mode: str = "contiguous",
+        page_size: int = 256,
+        n_pages: int | None = None,
+        max_queue: int | None = None,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -97,7 +107,27 @@ class ContinuousEngine:
         with other slots' decode chunks — a 100k-token admission no longer
         stalls every in-flight generation for the whole prefill (and one
         chunk-sized program serves every prompt length, instead of one
-        compile per prompt-length bucket)."""
+        compile per prompt-length bucket).
+
+        ``cache_mode="paged"`` replaces the contiguous per-slot cache with a
+        shared page pool (``n_pages`` pages of ``page_size`` tokens;
+        default sized to the contiguous capacity ``n_slots x smax``).
+        ``page_size`` trades decode speed against sharing granularity: 256
+        decodes at parity with the contiguous cache on v5e (the Pallas
+        kernel is page-DMA-bound; 128 costs ~7%, 64 ~20%), while smaller
+        pages dedup shorter prefixes and waste less tail padding.
+        Capacity is then bounded by total resident tokens, not
+        ``n_slots x max_context``; every FULL prompt page is content-hashed
+        and automatically reused by later prompts sharing the prefix —
+        ``register_prefix`` becomes an optimization hint (pre-warm), not a
+        requirement (infer/paged_cache.py, ops/paged_attention.py).
+        Admission reserves a request's worst-case pages up front (prompt +
+        max_new); requests wait in queue when the pool can't cover that —
+        no mid-flight preemption. int8 KV quantization currently requires
+        the contiguous mode.
+
+        ``max_queue`` caps how many requests may wait for a slot; ``submit``
+        raises ``QueueFullError`` beyond it (HTTP layer: 429)."""
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer
@@ -110,10 +140,47 @@ class ContinuousEngine:
         if prefill_chunk < 0:
             raise ValueError(f"prefill_chunk must be >= 0, got {prefill_chunk}")
         self.prefill_chunk = prefill_chunk
+        self.max_queue = max_queue
         self.gen = gen or GenerateConfig()
         self.smax = min(model_cfg.max_seq_len, max_cache_len or model_cfg.max_seq_len)
 
-        self.cache = init_cache(model_cfg, n_slots, self.smax)
+        if cache_mode not in ("contiguous", "paged"):
+            raise ValueError(f"unknown cache_mode {cache_mode!r}")
+        self.cache_mode = cache_mode
+        self.page_size = page_size
+        if cache_mode == "paged":
+            if model_cfg.kv_cache_dtype == "int8":
+                raise NotImplementedError(
+                    "int8 KV quantization requires cache_mode='contiguous'"
+                )
+            if page_size < 16 or page_size & (page_size - 1):
+                raise ValueError(
+                    f"page_size must be a power of two >= 16, got {page_size}"
+                )
+            if prefill_chunk and prefill_chunk % page_size:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} must be a multiple of "
+                    f"page_size {page_size} (chunk starts must be page-aligned)"
+                )
+            from ditl_tpu.infer.paged_cache import PageAllocator
+
+            self.maxp = -(-self.smax // page_size)
+            # Default pool = the contiguous capacity; page 0 is the sentinel.
+            self.n_pages = n_pages or (n_slots * self.maxp + 1)
+            # (L, P, K, ps, D): kv-heads before page slots so the Pallas
+            # kernel's per-head blocks keep (ps, D) trailing dims.
+            shape = (
+                model_cfg.num_layers, self.n_pages, model_cfg.num_kv_heads,
+                page_size, model_cfg.head_dim,
+            )
+            dt = jnp.dtype(model_cfg.dtype)
+            self.cache = {"kp": jnp.zeros(shape, dt), "vp": jnp.zeros(shape, dt)}
+            self.allocator = PageAllocator(self.n_pages)
+            self._table = np.zeros((n_slots, self.maxp), np.int32)
+            self._slot_pages: list[list[int]] = [[] for _ in range(n_slots)]
+            self.limits = jnp.zeros((n_slots,), jnp.int32)
+        else:
+            self.cache = init_cache(model_cfg, n_slots, self.smax)
         self.cur = jnp.full((n_slots,), tokenizer.pad_id, jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.temps = jnp.zeros((n_slots,), jnp.float32)
@@ -140,6 +207,8 @@ class ContinuousEngine:
         self._seed_cache: dict[int, Any] = {}
         self._suffix_prefill: dict[int, Any] = {}  # keyed by suffix bucket
         self._first_sampler: Any = None
+        self._paged_prefill: dict[int, Any] = {}  # keyed by suffix bucket
+        self._paged_decode: dict[tuple[bool, bool], Any] = {}
 
     # -- compiled programs --------------------------------------------------
 
@@ -292,6 +361,122 @@ class ContinuousEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
+    # -- paged programs ------------------------------------------------------
+
+    def _build_paged_prefill(self, s_bucket: int):
+        """Prefill ``s_bucket`` prompt tokens of one slot in paged mode.
+
+        The slot's resident pages are gathered into a transient contiguous
+        row (prefill is compute-bound; one context-sized copy is noise), the
+        ordinary cached forward runs against it, and the chunk's K/V pages
+        are scattered back into the pool at ``write_pids``. Chunk starts are
+        page-aligned by construction (prefill_chunk and prefix matches are
+        multiples of page_size), so the chunk covers whole pages; bucket
+        tail beyond ``s_len`` writes garbage that stays masked until decode
+        overwrites it (the same write-then-unmask invariant as the
+        contiguous suffix prefill)."""
+        cfg, ps, maxp = self.cfg, self.page_size, self.maxp
+        n_wp = s_bucket // ps
+        buf = maxp * ps + s_bucket
+        buf_iota = jnp.arange(buf, dtype=jnp.int32)
+
+        def run(params, kp, vp, table_row, ids, offset, s_len, temp, top_p,
+                rng, write_pids):
+            L, _, K, _, D = kp.shape
+
+            def to_row(pool):  # (L, maxp, K, ps, D) -> (L, 1, maxp*ps, K, D)
+                g = jnp.swapaxes(pool[:, table_row], 2, 3)
+                return g.reshape(L, 1, maxp * ps, K, D)
+
+            ctx_k, ctx_v = to_row(kp), to_row(vp)
+            zeros = jnp.zeros((L, 1, s_bucket, K, D), kp.dtype)
+            row = {
+                "k": jnp.concatenate([ctx_k, zeros], axis=2),
+                "v": jnp.concatenate([ctx_v, zeros], axis=2),
+            }
+            q_pos = offset + jnp.arange(s_bucket, dtype=jnp.int32)
+            mask = buf_iota[None, None, :] <= q_pos[None, :, None]
+            logits, row = llama.forward(
+                params, ids, cfg, positions=q_pos[None],
+                cache=row, cache_index=offset, attn_mask=mask,
+            )
+            def to_pages(r):  # (L, 1, s_bucket, K, D) -> (L, n_wp, K, ps, D)
+                chunk = jax.lax.dynamic_slice_in_dim(r, offset, s_bucket, axis=2)
+                return jnp.swapaxes(chunk.reshape(L, n_wp, ps, K, D), 2, 3)
+
+            chunk_k, chunk_v = to_pages(row["k"]), to_pages(row["v"])
+            for j in range(n_wp):
+                kp = jax.lax.dynamic_update_slice(
+                    kp, chunk_k[:, j:j + 1], (0, write_pids[j], 0, 0, 0)
+                )
+                vp = jax.lax.dynamic_update_slice(
+                    vp, chunk_v[:, j:j + 1], (0, write_pids[j], 0, 0, 0)
+                )
+            last = logits[0, s_len - 1]
+            first = sample_logits(
+                last[None], rng, temperature=temp, top_k=self.gen.top_k,
+                top_p=top_p,
+            )[0]
+            return kp, vp, first
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
+    def _build_paged_decode(self, sampled: bool, topp: bool):
+        """Paged decode tick: same chunked scan as the contiguous program,
+        but K/V live in the page pool, reached through the page table
+        (ops/paged_attention.py). ``limits`` ends a row exactly at its token
+        budget, so writes never run past the pages reserved at admission."""
+        cfg, ps = self.cfg, self.page_size
+        pad, eos = self.tokenizer.pad_id, self.tokenizer.eos_id
+        chunk = self.decode_chunk
+
+        def run(params, kp, vp, cur, pos, alive, temps, top_ps, keys, table,
+                limits):
+            b_iota = jnp.arange(pos.shape[0], dtype=jnp.int32)
+
+            def body(carry, _):
+                kp, vp, cur, pos, done, keys = carry
+                split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+                keys, subs = split[:, 0], split[:, 1]
+                done = done | (pos >= limits)
+                step_alive = ~done
+                lengths = jnp.where(step_alive, pos + 1, 0)
+                pidx = jnp.take_along_axis(table, (pos // ps)[:, None], 1)[:, 0]
+                paged_meta = {
+                    "table": table,
+                    "pid": jnp.where(step_alive, pidx, 0),
+                    "off": jnp.where(step_alive, pos % ps, b_iota % ps),
+                    "live": step_alive,
+                    "lengths": lengths,
+                }
+                logits, cache = llama.forward(
+                    params,
+                    cur[:, None],
+                    cfg,
+                    positions=pos[:, None],
+                    cache={"kp": kp, "vp": vp},
+                    paged=paged_meta,
+                )
+                kp, vp = cache["kp"], cache["vp"]
+                nxt = sample_logits(
+                    logits[:, 0], subs,
+                    temperature=temps if sampled else 0.0,
+                    top_k=self.gen.top_k,
+                    top_p=top_ps if topp else 1.0,
+                )
+                emit = jnp.where(step_alive, cur, pad)
+                done = done | (cur == eos)
+                pos = jnp.where(step_alive, pos + 1, pos)
+                cur = jnp.where(done, pad, nxt)
+                return (kp, vp, cur, pos, done, keys), emit
+
+            (kp, vp, cur, pos, done, keys), toks = jax.lax.scan(
+                body, (kp, vp, cur, pos, ~alive, keys), None, length=chunk
+            )
+            return kp, vp, cur, pos, keys, toks.T
+
+        return jax.jit(run, donate_argnums=(1, 2))
+
     def register_prefix(self, prefix_tokens: list[int]) -> None:
         """Prefill ``prefix_tokens`` once and reuse the KV for every future
         request whose prompt starts with them (longest registered match wins).
@@ -304,6 +489,11 @@ class ContinuousEngine:
             raise ValueError(
                 f"prefix {len(prefix_tokens)} leaves no room in cache {self.smax}"
             )
+        if self.cache_mode == "paged":
+            # Paged mode: prefix reuse is automatic (content-hashed pages);
+            # registration is just a pre-warm of the page cache.
+            self._warm_pages(prefix_tokens)
+            return
         key = tuple(prefix_tokens)
         if key in self._prefixes:
             return
@@ -319,6 +509,62 @@ class ContinuousEngine:
         self._prefixes[key] = (row, last_logits, len(prefix_tokens))
         logger.info(
             "registered prefix of %d tokens (bucket %d)", len(prefix_tokens), p_bucket
+        )
+
+    def _warm_pages(self, tokens: list[int]) -> None:
+        """Prefill and publish the FULL pages of ``tokens`` into the page
+        cache so later prompts reuse them without prefilling (paged-mode
+        ``register_prefix``). No slot is occupied; the pages are held only
+        by the content cache (evictable under pool pressure)."""
+        from ditl_tpu.infer.paged_cache import block_hashes
+
+        ps = self.page_size
+        n_full = len(tokens) // ps
+        if n_full == 0:
+            return
+        hashes = block_hashes(tokens[: n_full * ps], ps)
+        matched: list[int] = []
+        for h in hashes:
+            pid = self.allocator.lookup(h)
+            if pid is None:
+                break
+            self.allocator.retain(pid)
+            matched.append(pid)
+        n_fresh = n_full - len(matched)
+        if n_fresh == 0:
+            for pid in matched:
+                self.allocator.release(pid)
+            return
+        fresh = self.allocator.alloc(n_fresh)
+        pages = matched + fresh
+        table_row = np.zeros((self.maxp,), np.int32)
+        table_row[: len(pages)] = pages
+        d = len(matched) * ps
+        s = n_full * ps - d
+        s_bucket = min(_next_pow2(s, floor=ps), self.maxp * ps)
+        if s_bucket not in self._paged_prefill:
+            logger.info("compiling paged prefill for bucket %d", s_bucket)
+            self._paged_prefill[s_bucket] = self._build_paged_prefill(s_bucket)
+        ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, :s] = tokens[d: d + s]
+        n_wp = s_bucket // ps
+        write_pids = np.zeros((n_wp,), np.int32)
+        usable = pages[len(matched):]
+        write_pids[: len(usable)] = usable
+        kp, vp, _ = self._paged_prefill[s_bucket](
+            self.params, self.cache["kp"], self.cache["vp"],
+            jnp.asarray(table_row), jnp.asarray(ids), jnp.int32(d),
+            jnp.int32(s), jnp.float32(0.0), jnp.float32(1.0),
+            jax.random.key(0), jnp.asarray(write_pids),
+        )
+        self.cache = {"kp": kp, "vp": vp}
+        for j in range(len(matched), n_full):
+            self.allocator.publish(hashes[j], pages[j])
+        for pid in pages:
+            self.allocator.release(pid)
+        logger.info(
+            "warmed %d pages (%d reused) for a %d-token prefix",
+            n_fresh, len(matched), len(tokens),
         )
 
     def clear_prefixes(self) -> None:
@@ -351,6 +597,10 @@ class ContinuousEngine:
         ``stream``: optional ``queue.Queue`` receiving per-chunk token lists
         and a final ``None``."""
         gen = self.gen
+        if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            raise QueueFullError(
+                f"admission queue full ({self.max_queue} waiting requests)"
+            )
         max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
         prompt = prompt_tokens or [self.tokenizer.bos_id]
         if len(prompt) + max_new > self.smax:
@@ -442,6 +692,21 @@ class ContinuousEngine:
         final chunk's sample becomes the request's first token, and the slot
         key is (re)derived from the request seed so sampling stays
         reproducible no matter how many decode ticks ran while parked."""
+        if self.cache_mode == "paged":
+            d = req.prefill_pos
+            s = min(self.prefill_chunk, len(req.prompt) - d)
+            slot_key, sub = jax.random.split(jax.random.key(req.seed))
+            first = self._paged_prefill_chunk(
+                req, req.slot, d, s, self.prefill_chunk, sub
+            )
+            req.prefill_pos += s
+            if req.prefill_pos >= len(req.prompt):
+                req.prefilling = False
+                self._publish_prompt_pages(req, req.slot)
+                self.cur = self.cur.at[req.slot].set(first)
+                self.pos = self.pos.at[req.slot].set(len(req.prompt))
+                self.keys = self.keys.at[req.slot].set(slot_key)
+            return
         d = req.prefill_pos
         s = min(self.prefill_chunk, len(req.prompt) - d)
         # The write window must fit: a clamped dynamic_update_slice would
@@ -470,9 +735,103 @@ class ContinuousEngine:
             self.pos = self.pos.at[req.slot].set(len(req.prompt))
             self.keys = self.keys.at[req.slot].set(slot_key)
 
+    # -- paged admission / prefill -------------------------------------------
+
+    def _free_slot_pages(self, slot: int) -> None:
+        for pid in self._slot_pages[slot]:
+            self.allocator.release(pid)
+        self._slot_pages[slot] = []
+        self._table[slot, :] = 0
+
+    def _publish_prompt_pages(self, req: Request, slot: int) -> None:
+        """Make the prompt's FULL pages content-addressable so later prompts
+        sharing the prefix reuse them without prefilling. Full prompt pages
+        are immutable (decode writes only past the prompt), so sharing is
+        read-only by construction."""
+        from ditl_tpu.infer.paged_cache import block_hashes
+
+        ps = self.page_size
+        n_full = len(req.prompt) // ps
+        for j, h in enumerate(block_hashes(req.prompt[: n_full * ps], ps)):
+            if self.allocator.lookup(h) is None:
+                self.allocator.publish(h, int(self._table[slot, j]))
+
+    def _paged_prefill_chunk(self, req: Request, slot: int, d: int, s: int,
+                             s_bucket: int, rng):
+        """Run one paged prefill program call over prompt[d:d+s]."""
+        ps, maxp = self.page_size, self.maxp
+        s_bucket = min(_next_pow2(max(s_bucket, ps), floor=ps), maxp * ps)
+        if s_bucket not in self._paged_prefill:
+            logger.info("compiling paged prefill for bucket %d", s_bucket)
+            self._paged_prefill[s_bucket] = self._build_paged_prefill(s_bucket)
+        ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, :s] = req.prompt[d: d + s]
+        n_wp = s_bucket // ps
+        write_pids = np.zeros((n_wp,), np.int32)
+        row = self._table[slot, d // ps: d // ps + n_wp]
+        write_pids[: len(row)] = row
+        kp, vp, first = self._paged_prefill[s_bucket](
+            self.params, self.cache["kp"], self.cache["vp"],
+            jnp.asarray(self._table[slot]), jnp.asarray(ids), jnp.int32(d),
+            jnp.int32(s), jnp.float32(req.temperature),
+            jnp.float32(req.top_p), rng, jnp.asarray(write_pids),
+        )
+        self.cache = {"kp": kp, "vp": vp}
+        return first
+
+    def _admit_paged_slot(self, slot: int) -> bool:
+        """Admit the queue head into ``slot`` (paged mode). Reserves the
+        request's worst-case pages (prompt + max_new) up front — admission
+        fails (request stays queued, False returned) when the pool cannot
+        cover it, so decode never faults mid-flight."""
+        req = self._queue[0]
+        ps = self.page_size
+        matched = self.allocator.match_prefix(req.prompt, ps)  # retained
+        n_total = -(-(len(req.prompt) + req.max_new_tokens) // ps)
+        n_fresh = n_total - len(matched)
+        try:
+            fresh = self.allocator.alloc(n_fresh)
+        except MemoryError:
+            for pid in matched:
+                self.allocator.release(pid)
+            return False
+        self._queue.popleft()
+        pages = matched + fresh
+        self._slot_pages[slot] = pages
+        self._table[slot, :] = 0
+        self._table[slot, : len(pages)] = pages
+        d0 = len(matched) * ps
+        slot_key, sub = jax.random.split(jax.random.key(req.seed))
+        req.slot = slot
+        self._slots[slot] = req
+        s = len(req.prompt) - d0
+        if self.prefill_chunk and s > self.prefill_chunk:
+            req.prefill_pos = d0
+            req.prefilling = True
+            self.cur = self.cur.at[slot].set(self.tokenizer.pad_id)
+            self.pos = self.pos.at[slot].set(0)
+        else:
+            first = self._paged_prefill_chunk(req, slot, d0, s, s, sub)
+            self._publish_prompt_pages(req, slot)
+            self.cur = self.cur.at[slot].set(first)
+            self.pos = self.pos.at[slot].set(len(req.prompt))
+        self.temps = self.temps.at[slot].set(req.temperature)
+        self.top_ps = self.top_ps.at[slot].set(req.top_p)
+        self.keys = self.keys.at[slot].set(slot_key)
+        self.limits = self.limits.at[slot].set(
+            len(req.prompt) + req.max_new_tokens
+        )
+        return True
+
     def _admit(self) -> None:
         for slot in range(self.n_slots):
             if self._slots[slot] is not None or not self._queue:
+                continue
+            if self.cache_mode == "paged":
+                if not self._admit_paged_slot(slot):
+                    # FIFO: the head request doesn't fit the pool right now;
+                    # don't let smaller requests starve it indefinitely.
+                    break
                 continue
             req = self._queue.popleft()
             slot_key = jax.random.key(req.seed)
@@ -517,6 +876,8 @@ class ContinuousEngine:
                     req.stream.put(None)
                 self._completed[req.req_id] = req
                 self._slots[slot] = None
+                if self.cache_mode == "paged":
+                    self._free_slot_pages(slot)
 
     def step(self) -> None:
         """One scheduler tick: admit queued requests, advance one chunk of
@@ -534,12 +895,22 @@ class ContinuousEngine:
         # top_p only matters when something actually samples — greedy rows
         # ignore it, so (False, True) would compile a redundant program.
         key = (sampled, sampled and any(r.top_p < 1.0 for r in active))
-        if key not in self._decode_cache:
-            self._decode_cache[key] = self._build_decode(*key)
-        self.cache, self.cur, self.pos, self.keys, toks = self._decode_cache[key](
-            self.params, self.cache, self.cur, self.pos, alive,
-            self.temps, self.top_ps, self.keys,
-        )
+        if self.cache_mode == "paged":
+            if key not in self._paged_decode:
+                self._paged_decode[key] = self._build_paged_decode(*key)
+            kp, vp, self.cur, self.pos, self.keys, toks = self._paged_decode[key](
+                self.params, self.cache["kp"], self.cache["vp"], self.cur,
+                self.pos, alive, self.temps, self.top_ps, self.keys,
+                jnp.asarray(self._table), self.limits,
+            )
+            self.cache = {"kp": kp, "vp": vp}
+        else:
+            if key not in self._decode_cache:
+                self._decode_cache[key] = self._build_decode(*key)
+            self.cache, self.cur, self.pos, self.keys, toks = self._decode_cache[key](
+                self.params, self.cache, self.cur, self.pos, alive,
+                self.temps, self.top_ps, self.keys,
+            )
         self._harvest(np.asarray(jax.device_get(toks)))
 
     @property
@@ -579,6 +950,8 @@ class ContinuousEngine:
         for slot, req in enumerate(self._slots):
             if req is not None and req.req_id == req_id:
                 self._slots[slot] = None
+                if self.cache_mode == "paged":
+                    self._free_slot_pages(slot)
                 if req.stream is not None:
                     req.stream.put(None)
                 return True
@@ -618,6 +991,14 @@ class ThreadedEngine:
     @property
     def tokenizer(self) -> Tokenizer:
         return self._engine.tokenizer
+
+    @property
+    def queue_full(self) -> bool:
+        """Best-effort admission-queue check (for pre-stream 429s: once SSE
+        headers are out, a QueueFullError can no longer become an HTTP
+        status)."""
+        eng = self._engine
+        return eng.max_queue is not None and len(eng._queue) >= eng.max_queue
 
     def _drive(self) -> None:
         while True:

@@ -1,0 +1,161 @@
+"""Host-side page allocator for the paged KV cache (infer/continuous.py
+``cache_mode="paged"``; device op: ops/paged_attention.py).
+
+The device holds one pool of KV pages per layer — ``(L, n_pages, page_size,
+K, D)`` — and per-slot page tables map logical block index -> physical page.
+This module is the host bookkeeping around that pool:
+
+- **Free-list allocation** with refcounts: a page may back several slots'
+  tables at once (shared prefix blocks).
+- **Content-addressed dedup**: every FULL page of a prompt is published
+  under a progressive hash ``h_i = hash((h_{i-1}, tokens_in_page_i))``; a
+  later prompt whose leading blocks hash to published pages reuses them
+  (refcount bump, no prefill) — vLLM-style automatic prefix caching, no
+  ``register_prefix`` call required. Only full, immutable pages are ever
+  shared: a slot's partial tail page and its decode pages are private, so
+  there is no copy-on-write fault path — sharing is read-only by
+  construction.
+- **LRU eviction**: published pages whose only reference is the hash cache
+  are reclaimable; allocation pressure evicts them oldest-first.
+
+Page 0 is a reserved sentinel: dead slots' table tails point at it and dead
+decode rows write their no-op writes into it, so live writes can never
+collide with a stale table entry (ops/paged_attention.write_page_tokens).
+
+The allocator is plain Python on the host — admission policy is not a TPU
+problem (same stance as the continuous engine's scheduler).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+__all__ = ["PageAllocator", "block_hashes"]
+
+
+def block_hashes(tokens: list[int], page_size: int) -> list[int]:
+    """Progressive content hashes of the FULL pages of ``tokens``. Page i's
+    hash covers every token up to and including page i (chained), so equal
+    hashes mean equal full prefixes — the property that makes reuse safe."""
+    out: list[int] = []
+    h = 0
+    for start in range(0, len(tokens) - page_size + 1, page_size):
+        h = hash((h, tuple(tokens[start:start + page_size])))
+        out.append(h)
+    return out
+
+
+class PageAllocator:
+    """Refcounted page pool bookkeeping with content-hash reuse."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(f"need >= 2 pages (page 0 is reserved), got {n_pages}")
+        self.n_pages = n_pages
+        self._free: deque[int] = deque(range(1, n_pages))
+        self._ref = [0] * n_pages
+        self._hash_to_page: dict[int, int] = {}
+        self._page_hash: dict[int, int] = {}
+        # Insertion-ordered: oldest published hash evicts first.
+        self._lru: OrderedDict[int, None] = OrderedDict()
+
+    # -- capacity ------------------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_evictable(self) -> int:
+        return sum(
+            1 for h, p in self._hash_to_page.items() if self._ref[p] == 1
+        )
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= self.n_free + self.n_evictable
+
+    # -- alloc / free --------------------------------------------------------
+
+    def alloc(self, n: int) -> list[int]:
+        """Allocate ``n`` private pages (ref 1 each), evicting LRU published
+        pages if the free list runs short. Raises when truly out."""
+        out: list[int] = []
+        while len(out) < n:
+            if self._free:
+                pid = self._free.popleft()
+            else:
+                pid = self._evict_one()
+                if pid is None:
+                    # Roll back so a failed multi-page request leaks nothing.
+                    for p in out:
+                        self.release(p)
+                    raise MemoryError(
+                        f"page pool exhausted ({self.n_pages} pages, 0 evictable)"
+                    )
+            self._ref[pid] = 1
+            out.append(pid)
+        return out
+
+    def _evict_one(self) -> int | None:
+        for h in self._lru:
+            pid = self._hash_to_page[h]
+            if self._ref[pid] == 1:  # only the hash cache holds it
+                self._unpublish(h, pid)
+                return pid
+        return None
+
+    def _unpublish(self, h: int, pid: int) -> None:
+        del self._hash_to_page[h]
+        del self._page_hash[pid]
+        self._lru.pop(h, None)
+        self._ref[pid] -= 1  # the cache's own reference
+
+    def retain(self, pid: int) -> None:
+        self._ref[pid] += 1
+
+    def release(self, pid: int) -> None:
+        if pid == 0:
+            return
+        self._ref[pid] -= 1
+        if self._ref[pid] < 0:
+            raise AssertionError(f"double release of page {pid}")
+        if self._ref[pid] == 0:
+            self._free.append(pid)
+
+    # -- content cache -------------------------------------------------------
+
+    def lookup(self, h: int) -> int | None:
+        """Published page for hash ``h`` (bumps its LRU recency), or None."""
+        pid = self._hash_to_page.get(h)
+        if pid is not None:
+            self._lru.move_to_end(h)
+        return pid
+
+    def publish(self, h: int, pid: int) -> None:
+        """Register ``pid`` as the page for content hash ``h``. The cache
+        takes its own reference, keeping the page reclaimable-but-resident
+        after the owning request finishes."""
+        if h in self._hash_to_page:
+            return  # first publisher wins; the duplicate stays private
+        self._hash_to_page[h] = pid
+        self._page_hash[pid] = h
+        self._lru[h] = None
+        self._ref[pid] += 1
+
+    def match_prefix(self, tokens: list[int], page_size: int) -> list[int]:
+        """Longest run of published pages covering ``tokens``' leading FULL
+        pages — each returned page is retained for the caller. At least one
+        token is always left unmatched so the caller's prefill produces the
+        next-token logits."""
+        usable = len(tokens) - 1
+        if usable < page_size:
+            return []
+        pages: list[int] = []
+        for h in block_hashes(tokens[: usable - usable % page_size], page_size):
+            pid = self.lookup(h)
+            if pid is None:
+                break
+            pages.append(pid)
+        for pid in pages:
+            self.retain(pid)
+        return pages

@@ -61,6 +61,23 @@ def _broadcast(arr: np.ndarray) -> np.ndarray:
     return np.asarray(multihost_utils.broadcast_one_to_all(arr))
 
 
+def _statuses_agree(ok: bool) -> bool:
+    """Post-tick status collective: all processes exchange an ok/fail byte.
+
+    A one-sided failure (transient device error on one host mid-generate)
+    would otherwise leave that process waiting at the next header broadcast
+    while the others are still inside the generate program's collectives —
+    a silent, permanent desync. Every process calls this after every generate
+    tick; the gathered vector is identical pod-wide, so all processes take
+    the same shutdown decision when statuses diverge."""
+    from jax.experimental import multihost_utils
+
+    statuses = np.asarray(
+        multihost_utils.process_allgather(np.asarray([1 if ok else 0], np.int32))
+    ).reshape(-1)
+    return bool(statuses.min() == statuses.max())
+
+
 class _Job:
     def __init__(self, token_lists, gen):
         self.token_lists = token_lists
@@ -156,11 +173,41 @@ class PodGenerator:
                 _broadcast(header)
                 ids = _broadcast(ids)
                 lengths = _broadcast(lengths)
-                job.result = _run_tick(self.generator, header, ids, lengths)
-                job.done.set()
             except BaseException as e:  # noqa: BLE001 — handed to the waiter
                 job.error = e
                 job.done.set()
+                continue
+            ok = True
+            try:
+                job.result = _run_tick(self.generator, header, ids, lengths)
+            except BaseException as e:  # noqa: BLE001 — handed to the waiter
+                job.error = e
+                ok = False
+            if not _statuses_agree(ok):
+                # One-sided failure: the pod can no longer be assumed in
+                # lockstep. Workers saw the same divergent vector and are
+                # exiting their loops, so do NOT broadcast further (a
+                # collective with absent participants hangs) — fail local
+                # waiters and stop serving.
+                job.error = job.error or RuntimeError(
+                    "pod tick status diverged across processes"
+                )
+                job.done.set()
+                logger.error(
+                    "pod tick status diverged across processes; stopping pod "
+                    "serving (workers have shut down)"
+                )
+                with self._submit_lock:
+                    self._stop = True
+                    while True:
+                        try:
+                            j = self._jobs.get_nowait()
+                        except queue.Empty:
+                            break
+                        j.error = RuntimeError("pod serving stopped (desync)")
+                        j.done.set()
+                return
+            job.done.set()
 
     # -- Generator surface ----------------------------------------------------
 
@@ -234,12 +281,21 @@ def worker_loop(generator: Generator) -> None:
         batch, plen = int(header[1]), int(header[2])
         ids = _broadcast(np.zeros((batch, plen), np.int32))
         lengths = _broadcast(np.zeros((batch,), np.int32))
+        ok = True
         try:
             _run_tick(generator, header, ids, lengths)
         except Exception:
-            # Mirror the coordinator: its pump catches per-request errors
-            # (deterministic ones — validation, OOM-at-shape — raise
-            # identically on every process) and serves the next request; a
-            # worker that died here instead would strand the whole pod at
-            # the next broadcast.
-            logger.exception("pod serve worker: tick failed; continuing")
+            # Deterministic per-request errors (validation, OOM-at-shape)
+            # raise identically on every process; the status collective below
+            # confirms that before continuing. A worker that died here
+            # instead would strand the whole pod at the next broadcast.
+            ok = False
+            logger.exception("pod serve worker: tick failed")
+        if not _statuses_agree(ok):
+            # One-sided failure — the pod is desynced; every process saw the
+            # same divergent status vector, so all exit together.
+            logger.error(
+                "pod serve worker: tick status diverged across processes; "
+                "shutting down"
+            )
+            return

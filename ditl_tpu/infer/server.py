@@ -223,7 +223,12 @@ class _Handler(BaseHTTPRequestHandler):
                         [[tok.bos_id] + tok.encode(prompt)], gen, adapter_ids
                     )[0]
                 n_gen = len(out)
-                text, _ = _apply_stop(tok.decode(out), tracker.stops)
+                text, hit = _apply_stop(tok.decode(out), tracker.stops)
+                if hit:
+                    # Fold into the tracker so the finish computation reports
+                    # "stop" even when the completion also used its full
+                    # token budget.
+                    tracker.hit = True
                 if text:
                     yield event(text)
             finish = (
@@ -268,9 +273,13 @@ class _Handler(BaseHTTPRequestHandler):
             # adapter by name; unknown/absent names serve the base (slot 0).
             aid = self.adapter_names.get(str(payload.get("model") or ""))
             adapter_ids = [aid] if aid is not None else None
+            # OpenAI semantics: completions' `logprobs: 0` is a real request
+            # (chosen-token logprob, zero alternatives) — 0 is falsy, so test
+            # presence, not truthiness. Chat's `logprobs: false` means off.
             lp_req = payload.get("logprobs")
+            has_lp = lp_req is not None and lp_req is not False
             if payload.get("stream"):
-                if lp_req:
+                if has_lp:
                     # Streaming logprobs are unsupported; failing loudly beats
                     # silently returning chunks without them.
                     self._send_json(
@@ -278,6 +287,21 @@ class _Handler(BaseHTTPRequestHandler):
                         {"error": {"message": "logprobs with stream=true is "
                                    "not supported by this server"}},
                     )
+                    return
+                if (self.threaded_engine is not None
+                        and getattr(self.threaded_engine, "queue_full", False)):
+                    # Pre-stream check: after the SSE headers go out there
+                    # is no way to signal 429.
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    body = json.dumps({"error": {
+                        "message": "admission queue full",
+                        "type": "rate_limit_error",
+                    }}).encode()
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 try:
                     self._stream_complete(
@@ -293,7 +317,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             t0 = time.time()
             logprobs_json = None
-            if lp_req:
+            if has_lp:
                 if not hasattr(self.generator, "generate_tokens_with_logprobs"):
                     # --pod wraps the generator in PodGenerator; its broadcast
                     # protocol doesn't carry logprobs (and device work must
@@ -314,10 +338,12 @@ class _Handler(BaseHTTPRequestHandler):
                 n_top = (
                     int(payload.get("top_logprobs") or 1) if chat else int(lp_req)
                 )
-                n_top = max(1, min(n_top, 20))
+                n_top = max(0, min(n_top, 20))
                 tok = self.generator.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
-                lp_gen = dataclasses.replace(gen, logprobs=n_top)
+                # The engine's top-k needs k >= 1; n_top == 0 is served by
+                # computing one alternative and emitting none.
+                lp_gen = dataclasses.replace(gen, logprobs=max(1, n_top))
                 with self.device_lock:
                     outs, lps = self.generator.generate_tokens_with_logprobs(
                         [prompt_ids], lp_gen, adapter_ids
@@ -353,7 +379,8 @@ class _Handler(BaseHTTPRequestHandler):
                                 "top_logprobs": [
                                     {"token": tok.decode([tid]), "logprob": tlp}
                                     for tid, tlp in zip(
-                                        lp["top_ids"][i], lp["top_logprobs"][i]
+                                        lp["top_ids"][i][:n_top],
+                                        lp["top_logprobs"][i][:n_top],
                                     )
                                 ],
                             }
@@ -372,7 +399,8 @@ class _Handler(BaseHTTPRequestHandler):
                             {
                                 tok.decode([tid]): tlp
                                 for tid, tlp in zip(
-                                    lp["top_ids"][i], lp["top_logprobs"][i]
+                                    lp["top_ids"][i][:n_top],
+                                    lp["top_logprobs"][i][:n_top],
                                 )
                             }
                             for i in range(len(tok_strs))
@@ -440,6 +468,20 @@ class _Handler(BaseHTTPRequestHandler):
                 kind, n_prompt, n_out, time.time() - t0,
             )
         except Exception as e:  # total-server: errors become JSON, not crashes
+            from ditl_tpu.infer.continuous import QueueFullError
+
+            if isinstance(e, QueueFullError):
+                # OpenAI rate-limit shape: clients back off and retry.
+                self.send_response(429)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Retry-After", "1")
+                body = json.dumps({"error": {
+                    "message": str(e), "type": "rate_limit_error",
+                }}).encode()
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             logger.exception("completion failed")
             self._send_json(500, {"error": {"message": str(e)}})
 
@@ -498,6 +540,27 @@ def serve(argv: list[str] | None = None) -> int:
         help="chunked prefill for --engine continuous: prompts longer than "
         "this prefill one chunk per tick, interleaved with in-flight "
         "decodes (0 = whole-prompt prefill)",
+    )
+    parser.add_argument(
+        "--max-queue", type=int, default=0,
+        help="admission-queue depth cap for --engine continuous; beyond it "
+        "requests get HTTP 429 (0 = unbounded)",
+    )
+    parser.add_argument(
+        "--cache-mode", choices=("contiguous", "paged"), default="contiguous",
+        help="KV cache layout for --engine continuous: 'paged' pools KV in "
+        "content-hashed pages with automatic prefix reuse "
+        "(infer/paged_cache.py, ops/paged_attention.py)",
+    )
+    parser.add_argument(
+        "--page-size", type=int, default=256,
+        help="tokens per KV page for --cache-mode paged (256 = decode "
+        "parity with contiguous on v5e; smaller = finer prefix sharing)",
+    )
+    parser.add_argument(
+        "--pages", type=int, default=0,
+        help="page-pool size for --cache-mode paged; 0 = the contiguous "
+        "equivalent (slots x max context)",
     )
     parser.add_argument(
         "--quantize", choices=("none", "int8"), default="none",
@@ -603,7 +666,22 @@ def serve(argv: list[str] | None = None) -> int:
         from ditl_tpu.models import lora as lora_mod
         from ditl_tpu.train.checkpoint import CheckpointManager
 
-        stacks = [lora_mod.zeros_adapter(cfg)]  # id 0 = base model
+        # Adapter id 0 serves the "base" model name. If the restored base
+        # checkpoint was itself LoRA-fine-tuned (its own lora tree is
+        # non-zero), that tree IS the base behavior — replacing it with a
+        # zeros adapter would silently serve un-adapted weights for the base
+        # model name.
+        base_lora = params["layers"].get("lora")
+        if base_lora is not None and any(
+            bool(jax.numpy.any(leaf != 0)) for leaf in jax.tree.leaves(base_lora)
+        ):
+            stacks = [base_lora]
+            logger.info(
+                "--adapter: base checkpoint carries a non-zero LoRA tree; "
+                "keeping it as adapter slot 0"
+            )
+        else:
+            stacks = [lora_mod.zeros_adapter(cfg)]  # id 0 = base model
         for item in args.adapter:
             if "=" not in item:
                 parser.error(f"--adapter wants NAME=ORBAX_DIR, got {item!r}")
@@ -654,6 +732,10 @@ def serve(argv: list[str] | None = None) -> int:
                 params, cfg, tokenizer, n_slots=args.slots,
                 max_cache_len=args.max_cache_len or None,
                 prefill_chunk=args.prefill_chunk,
+                cache_mode=args.cache_mode,
+                page_size=args.page_size,
+                n_pages=args.pages or None,
+                max_queue=args.max_queue or None,
             )
         )
     server = make_server(

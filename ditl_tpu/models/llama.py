@@ -252,12 +252,20 @@ def _decoder_layer(
     cache_index: jax.Array | None = None,
     attn_mask: jax.Array | None = None,
     adapter_ids: jax.Array | None = None,
+    paged: dict | None = None,
 ) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, dict]:
     """One decoder block. With ``layer_cache`` (this layer's slice of the KV
     cache pytree, values shaped (B, Smax, K, D) — plus scales when int8,
     infer/cache.py), the chunk's keys/values are written at slot
     ``cache_index`` and attention runs against the whole cache under
-    ``attn_mask`` — the KV-cache prefill/decode path (infer/engine.py)."""
+    ``attn_mask`` — the KV-cache prefill/decode path (infer/engine.py).
+
+    When ``layer_cache`` holds page pools (``{"kp", "vp"}``, each
+    (n_pages, page_size, K, D)), ``paged`` carries the tick metadata —
+    ``table`` (B, maxp), write ``pid``/``off`` (B,), ``live`` (B,) and
+    ``lengths`` (B,) — and this is the single-token paged decode step
+    (ops/paged_attention.py): the token's K/V rows are scattered into the
+    pools and attention runs through the page table."""
     b, s, d = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     cd = _dtype(cfg.dtype)
@@ -284,7 +292,24 @@ def _decoder_layer(
     q = _constrain(q, ("batch", "seq", "act_heads", "head_dim"), mesh, rules)
     k = _constrain(k, ("batch", "seq", "act_kv_heads", "head_dim"), mesh, rules)
     new_kv = None
-    if layer_cache is not None:
+    if layer_cache is not None and "kp" in layer_cache:
+        from ditl_tpu.ops.paged_attention import paged_attention, write_page_tokens
+
+        if s != 1:
+            raise ValueError(f"paged decode takes one token per slot, got S={s}")
+        new_kv = {
+            "kp": write_page_tokens(
+                layer_cache["kp"], k[:, 0], paged["pid"], paged["off"]
+            ),
+            "vp": write_page_tokens(
+                layer_cache["vp"], v[:, 0], paged["pid"], paged["off"]
+            ),
+        }
+        attn_out = paged_attention(
+            q[:, 0], new_kv["kp"], new_kv["vp"], paged["table"],
+            paged["lengths"],
+        )[:, None]
+    elif layer_cache is not None:
         from ditl_tpu.infer.cache import read_kv, write_kv
 
         new_kv = write_kv(layer_cache, k, v, cache_index)
@@ -306,6 +331,8 @@ def _decoder_layer(
         attn_out = dot_product_attention(
             q, k, v, causal=True, segment_ids=segment_ids, impl=cfg.attention_impl,
             mesh=mesh, rules=rules,
+            block_sizes=(cfg.flash_block_q, cfg.flash_block_kv,
+                         cfg.flash_block_q_bwd, cfg.flash_block_kv_bwd),
         )
     attn_out = attn_out.reshape(b, s, nh * hd)
     # Named for the remat="attn" policy: saving this one activation means the
@@ -352,6 +379,7 @@ def forward(
     attn_mask: jax.Array | None = None,
     return_hidden: bool = False,
     adapter_ids: jax.Array | None = None,
+    paged: dict | None = None,
 ) -> Any:
     """Token ids (B, S) -> logits (B, S, V) in float32.
 
@@ -402,6 +430,7 @@ def forward(
                 cache_index=cache_index,
                 attn_mask=attn_mask,
                 adapter_ids=adapter_ids,
+                paged=paged,
             )
             return y, (aux, new_kv)
 

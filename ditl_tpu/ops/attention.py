@@ -100,6 +100,7 @@ def dot_product_attention(
     rules=None,
     k_scale: jax.Array | None = None,
     v_scale: jax.Array | None = None,
+    block_sizes: tuple[int, int, int, int] | None = None,
 ) -> jax.Array:
     """Grouped-query attention. ``segment_ids`` (B, S) int32 restricts
     attention to tokens of the same segment (sequence packing / padding:
@@ -109,7 +110,10 @@ def dot_product_attention(
 
     ``rules`` is the logical-axis table (parallel/sharding.py) used to derive
     shard_map specs for the flash and ring paths — the same single source of
-    truth the rest of the model uses for its sharding constraints."""
+    truth the rest of the model uses for its sharding constraints.
+
+    ``block_sizes`` is ``(block_q, block_kv, block_q_bwd, block_kv_bwd)`` for
+    the flash kernels; zeros mean kernel defaults (ModelConfig.flash_block_*)."""
     if q.shape[2] % k.shape[2]:
         raise ValueError(f"q heads {q.shape[2]} not divisible by kv heads {k.shape[2]}")
     if k_scale is not None and mask is None:
@@ -150,12 +154,17 @@ def dot_product_attention(
                 q, k, v, causal=causal, segment_ids=segment_ids, mesh=mesh,
                 rules=rules,
             )
-        if not fa.supports(q.shape[1], k.shape[1], q.shape[3]):
+        bq, bkv, bqb, bkvb = block_sizes or (0, 0, 0, 0)
+        bq, bkv = bq or 512, bkv or 512
+        if not (fa.supports(q.shape[1], k.shape[1], q.shape[3], bq, bkv)
+                and fa.supports(q.shape[1], k.shape[1], q.shape[3],
+                                bqb or bq, bkvb or bkv)):
             # Shapes the kernel can't tile (tiny tests, odd seq lens): XLA.
             return _xla_attention(q, k, v, causal=causal, segment_ids=segment_ids)
         if mesh is None:
             return fa.flash_attention(
-                q, k, v, causal=causal, segment_ids=segment_ids
+                q, k, v, causal=causal, segment_ids=segment_ids,
+                block_q=bq, block_kv=bkv, block_q_bwd=bqb, block_kv_bwd=bkvb,
             )
         # Pallas calls carry no GSPMD partitioning rules — under pjit they
         # must be explicitly mapped over the mesh. Batch splits over the
@@ -175,7 +184,10 @@ def dot_product_attention(
             in_specs.append(logical_to_spec(("batch", None), rules))
 
         def local(q_, k_, v_, seg_=None):
-            return fa.flash_attention(q_, k_, v_, causal=causal, segment_ids=seg_)
+            return fa.flash_attention(
+                q_, k_, v_, causal=causal, segment_ids=seg_,
+                block_q=bq, block_kv=bkv, block_q_bwd=bqb, block_kv_bwd=bkvb,
+            )
 
         return jax.shard_map(
             local,

@@ -587,8 +587,9 @@ def _bwd_impl(
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_bhsd(q, k, v, q_seg, kv_seg, causal, scale, blocks, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, q_seg, kv_seg, causal, scale, blocks, blocks_bwd,
+                interpret):
     o, _ = _fwd(
         q, k, v, q_seg, kv_seg,
         causal=causal, scale=scale, blocks=blocks, interpret=interpret,
@@ -596,7 +597,8 @@ def _flash_bhsd(q, k, v, q_seg, kv_seg, causal, scale, blocks, interpret):
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, q_seg, kv_seg, causal, scale, blocks, interpret):
+def _flash_bhsd_fwd(q, k, v, q_seg, kv_seg, causal, scale, blocks, blocks_bwd,
+                    interpret):
     o, lse = _fwd(
         q, k, v, q_seg, kv_seg,
         causal=causal, scale=scale, blocks=blocks, interpret=interpret,
@@ -604,11 +606,11 @@ def _flash_bhsd_fwd(q, k, v, q_seg, kv_seg, causal, scale, blocks, interpret):
     return o, (q, k, v, q_seg, kv_seg, o, lse)
 
 
-def _flash_bhsd_bwd(causal, scale, blocks, interpret, residuals, do):
+def _flash_bhsd_bwd(causal, scale, blocks, blocks_bwd, interpret, residuals, do):
     q, k, v, q_seg, kv_seg, o, lse = residuals
     dq, dk, dv = _bwd_impl(
         q, k, v, q_seg, kv_seg, o, lse, do,
-        causal=causal, scale=scale, blocks=blocks, interpret=interpret,
+        causal=causal, scale=scale, blocks=blocks_bwd, interpret=interpret,
     )
     return dq, dk, dv, None, None
 
@@ -625,24 +627,32 @@ def flash_attention(
     segment_ids: jax.Array | None = None,  # (B, S) int32
     block_q: int = 512,
     block_kv: int = 512,
+    block_q_bwd: int = 0,
+    block_kv_bwd: int = 0,
     interpret: bool | None = None,
 ) -> jax.Array:
     """FlashAttention with GQA + sequence-packing segment masks.
 
     Takes/returns the model's (B, S, H, D) layout. Raises ``ValueError`` on
     shapes the kernel cannot tile — callers (``ops.attention``) fall back to
-    the XLA implementation.
+    the XLA implementation. ``block_*_bwd`` size the backward kernels' tiles
+    independently (0 = same as forward).
     """
     b, s_q, h, d = q.shape
     _, s_kv, kv_heads, _ = k.shape
+    block_q_bwd = block_q_bwd or block_q
+    block_kv_bwd = block_kv_bwd or block_kv
     if h % kv_heads:
         raise ValueError(f"q heads {h} not divisible by kv heads {kv_heads}")
-    if not supports(s_q, s_kv, d, block_q, block_kv):
+    if not (supports(s_q, s_kv, d, block_q, block_kv)
+            and supports(s_q, s_kv, d, block_q_bwd, block_kv_bwd)):
         raise ValueError(
             f"flash_attention cannot tile Sq={s_q} Skv={s_kv} D={d} "
-            f"(block_q={block_q}, block_kv={block_kv})"
+            f"(block_q={block_q}, block_kv={block_kv}, "
+            f"bwd {block_q_bwd}/{block_kv_bwd})"
         )
     blocks = _pick_blocks(s_q, s_kv, block_q, block_kv)
+    blocks_bwd = _pick_blocks(s_q, s_kv, block_q_bwd, block_kv_bwd)
     if interpret is None:
         interpret = _interpret_default()
 
@@ -651,6 +661,6 @@ def flash_attention(
     vt = jnp.transpose(v, (0, 2, 1, 3))
     o = _flash_bhsd(
         qt, kt, vt, segment_ids, segment_ids,
-        causal, d**-0.5, blocks, interpret,
+        causal, d**-0.5, blocks, blocks_bwd, interpret,
     )
     return jnp.transpose(o, (0, 2, 1, 3))

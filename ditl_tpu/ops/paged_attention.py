@@ -1,0 +1,249 @@
+"""Paged decode attention: KV lives in a shared page pool, per-slot page
+tables map logical block -> physical page (vLLM-style), TPU-first.
+
+The reference has no serving stack at all (its model is behind an HTTP API,
+ref ``src/distributed_inference.py:34-41``); this op underpins the paged
+mode of the continuous-batching engine (infer/continuous.py) that replaces
+it. Contiguous per-slot caches (infer/cache.py) bound capacity by
+``n_slots x max_context`` and make prefix sharing whole-prefix and explicit;
+a page pool bounds capacity by *total tokens resident* and shares any
+common full page between slots (automatic prefix reuse, infer/paged_cache.py).
+
+Two implementations, equal by construction (tested against each other):
+
+- ``paged_attention_xla``: gather pages -> contiguous (B, maxp*ps, K, D) ->
+  masked GQA attention. Materializes the gathered cache every step (double
+  HBM traffic); used as the correctness reference and the CPU path.
+- ``paged_attention`` (Pallas/Mosaic): grid (B, kv_heads, maxp); the page
+  table rides the scalar-prefetch channel so each grid step's *block index
+  map* fetches the right physical page from HBM — no gathered copy is ever
+  materialized. Online softmax over pages (same lane-replicated row-stat
+  scheme as ops/flash_attention.py). Pages past a slot's length are mapped
+  to page 0 by the host table; Mosaic's revisit optimization skips the
+  re-fetch of an identical block index, so dead tail pages cost ~nothing.
+
+Layouts: q is (B, H, D) — one query token per slot (the decode tick shape);
+pools are (P, K, ps, D) — kv-heads BEFORE page slots, so a Pallas block
+slicing one kv head keeps (ps, D) as its trailing dims (Mosaic requires the
+last two block dims divisible by (8, 128) or equal to the array's);
+page_table is (B, maxp) int32; lengths (B,) counts valid tokens per slot
+(0 = dead slot -> zero output).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ditl_tpu.ops.attention import NEG_INF
+
+__all__ = ["paged_attention", "paged_attention_xla", "write_page_tokens"]
+
+NUM_LANES = 128
+
+
+def paged_attention_xla(
+    q: jax.Array,  # (B, H, D)
+    k_pages: jax.Array,  # (P, K, ps, D)
+    v_pages: jax.Array,
+    page_table: jax.Array,  # (B, maxp) int32
+    lengths: jax.Array,  # (B,) int32
+) -> jax.Array:
+    """Gather-based reference: correctness oracle + CPU fallback."""
+    b, h, d = q.shape
+    _, kv_heads, ps, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    groups = h // kv_heads
+    k = jnp.swapaxes(k_pages[page_table], 2, 3).reshape(b, maxp * ps, kv_heads, d)
+    v = jnp.swapaxes(v_pages[page_table], 2, 3).reshape(b, maxp * ps, kv_heads, d)
+    qg = q.reshape(b, kv_heads, groups, d)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg, k, preferred_element_type=jnp.float32
+    ) * (d**-0.5)
+    valid = jnp.arange(maxp * ps, dtype=jnp.int32)[None, :] < lengths[:, None]
+    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # Dead slots (length 0) have an all-masked row; emit zeros, not NaN.
+    probs = jnp.where(lengths[:, None, None, None] > 0, probs, 0.0)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v.dtype), v)
+    return out.reshape(b, h, d)
+
+
+def _paged_kernel(
+    table_ref,  # scalar prefetch: (B, maxp) int32
+    lengths_ref,  # scalar prefetch: (B,) int32
+    q_ref,  # (1, K, G, D)
+    k_ref,  # (1, K, ps, D)
+    v_ref,
+    o_ref,  # (1, K, G, D)
+    m_scr,  # (K*G padded, NUM_LANES)
+    l_scr,
+    acc_scr,  # (K*G padded, D)
+    *,
+    scale: float,
+    page_size: int,
+    n_pages: int,
+):
+    """Grid (B, maxp): each step consumes one PAGE for ALL kv heads — the
+    kv-head loop is unrolled inside the kernel (static K small dots) so the
+    grid stays small; per-(b, h, page) grids are latency-bound at ~2k tiny
+    steps on v5e. Row r = k*G + g of the stats/acc scratch belongs to
+    (kv head k, group member g)."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+    base = p * page_size
+    kv_heads, groups = q_ref.shape[1], q_ref.shape[2]
+    d = acc_scr.shape[-1]
+
+    def tile(x, width):
+        if width == NUM_LANES:
+            return x
+        if width < NUM_LANES:
+            return x[:, :width]
+        return jnp.tile(x, (1, width // NUM_LANES))
+
+    @pl.when(base < length)
+    def _compute():
+        cols = base + jax.lax.broadcasted_iota(
+            jnp.int32, (groups, page_size), 1
+        )
+        col_mask = cols < length
+        for kh in range(kv_heads):
+            q = q_ref[0, kh].astype(jnp.float32) * scale  # (G, D)
+            k = k_ref[0, kh].astype(jnp.float32)  # (ps, D)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (G, ps)
+            s = jnp.where(col_mask, s, NEG_INF)
+            rows = slice(kh * groups, (kh + 1) * groups)
+            m_prev = m_scr[rows]  # (G, NUM_LANES) lane-replicated
+            l_prev = l_scr[rows]
+            m_cur = jnp.max(s, axis=1, keepdims=True)
+            m_next = jnp.maximum(m_prev, m_cur)
+            alpha = jnp.exp(m_prev - m_next)
+            ptab = jnp.exp(s - tile(m_next, page_size))
+            l_scr[rows] = alpha * l_prev + jnp.sum(ptab, axis=1, keepdims=True)
+            m_scr[rows] = m_next
+            v = v_ref[0, kh]  # (ps, D)
+            pv = jax.lax.dot_general(
+                ptab.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (G, D)
+            acc_scr[rows] = acc_scr[rows] * tile(alpha, d) + pv
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        for kh in range(kv_heads):
+            rows = slice(kh * groups, (kh + 1) * groups)
+            l = l_scr[rows]
+            l_safe = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, kh] = (acc_scr[rows] / tile(l_safe, d)).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,  # (B, H, D)
+    k_pages: jax.Array,  # (P, K, ps, D)
+    v_pages: jax.Array,
+    page_table: jax.Array,  # (B, maxp) int32
+    lengths: jax.Array,  # (B,) int32
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Pallas paged GQA decode attention (see module docstring)."""
+    b, h, d = q.shape
+    n_pool, kv_heads, ps, _ = k_pages.shape
+    maxp = page_table.shape[1]
+    groups = h // kv_heads
+    if h % kv_heads:
+        raise ValueError(f"q heads {h} not divisible by kv heads {kv_heads}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    # (B, K, G, D): one grid step's q block is ALL kv heads of one slot.
+    qg = q.reshape(b, kv_heads, groups, d)
+
+    grid = (b, maxp)
+    kernel = functools.partial(
+        _paged_kernel, scale=d**-0.5, page_size=ps, n_pages=maxp
+    )
+    g_rows = max(kv_heads * groups, 8)  # scratch sublane floor
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, kv_heads, groups, d), lambda ib, ip, tab, lens: (ib, 0, 0, 0)
+                ),
+                # Pages at or past the slot's length are redirected to the
+                # sentinel page 0 (their compute is pl.when-skipped anyway):
+                # consecutive identical block indices make Mosaic skip the
+                # re-fetch, so a slot whose admission reserved max_new pages
+                # only pays DMA for the pages actually written so far.
+                pl.BlockSpec(
+                    (1, kv_heads, ps, d),
+                    lambda ib, ip, tab, lens: (
+                        jnp.where(ip * ps < lens[ib], tab[ib, ip], 0), 0, 0, 0
+                    ),
+                ),
+                pl.BlockSpec(
+                    (1, kv_heads, ps, d),
+                    lambda ib, ip, tab, lens: (
+                        jnp.where(ip * ps < lens[ib], tab[ib, ip], 0), 0, 0, 0
+                    ),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, kv_heads, groups, d), lambda ib, ip, tab, lens: (ib, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((g_rows, NUM_LANES), jnp.float32),  # m
+                pltpu.VMEM((g_rows, NUM_LANES), jnp.float32),  # l
+                pltpu.VMEM((g_rows, d), jnp.float32),  # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kv_heads, groups, d), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(page_table, lengths, qg, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+def write_page_tokens(
+    pool: jax.Array,  # (P, K, ps, D)
+    new: jax.Array,  # (B, K, D) — one token per slot
+    page_ids: jax.Array,  # (B,) int32
+    offsets: jax.Array,  # (B,) int32
+) -> jax.Array:
+    """Write one decode step's K or V rows into the pool — EVERY row writes.
+
+    Callers redirect dead rows to the reserved sentinel page 0 (never
+    allocated, never read unmasked), so no old-value read or write masking
+    is needed. Implemented as an unrolled loop of single-row
+    ``dynamic_update_slice`` — an XLA batched scatter here costs ~2 ms/call
+    on v5e (serialized lowering) vs microseconds for B in-place row
+    updates on a donated buffer."""
+    b, kv_heads, d = new.shape
+    vals = new.astype(pool.dtype).reshape(b, 1, kv_heads, 1, d)
+    for i in range(b):
+        pool = jax.lax.dynamic_update_slice(
+            pool, vals[i], (page_ids[i], 0, offsets[i], 0)
+        )
+    return pool

