@@ -442,11 +442,13 @@ class ContinuousEngine:
                 step_alive = ~done
                 lengths = jnp.where(step_alive, pos + 1, 0)
                 pidx = jnp.take_along_axis(table, (pos // ps)[:, None], 1)[:, 0]
+                # Dead rows redirect their write to sentinel page 0 (per-row
+                # distinct offsets); liveness is fully encoded in pid/off/
+                # lengths — the layer needs no separate flag.
                 paged_meta = {
                     "table": table,
                     "pid": jnp.where(step_alive, pidx, 0),
                     "off": jnp.where(step_alive, pos % ps, b_iota % ps),
-                    "live": step_alive,
                     "lengths": lengths,
                 }
                 logits, cache = llama.forward(
@@ -516,26 +518,37 @@ class ContinuousEngine:
         cache so later prompts reuse them without prefilling (paged-mode
         ``register_prefix``). No slot is occupied; the pages are held only
         by the content cache (evictable under pool pressure)."""
-        from ditl_tpu.infer.paged_cache import block_hashes
-
         ps = self.page_size
         n_full = len(tokens) // ps
         if n_full == 0:
             return
-        hashes = block_hashes(tokens[: n_full * ps], ps)
         matched: list[int] = []
-        for h in hashes:
-            pid = self.allocator.lookup(h)
+        parent = 0
+        for i in range(n_full):
+            block = tuple(tokens[i * ps:(i + 1) * ps])
+            pid = self.allocator.lookup((parent, block))
             if pid is None:
                 break
             self.allocator.retain(pid)
             matched.append(pid)
+            parent = pid
         n_fresh = n_full - len(matched)
         if n_fresh == 0:
             for pid in matched:
                 self.allocator.release(pid)
             return
-        fresh = self.allocator.alloc(n_fresh)
+        try:
+            fresh = self.allocator.alloc(n_fresh)
+        except MemoryError:
+            # A warm hint must not raise or leak: drop the matched retains
+            # and leave the cache as-is.
+            for pid in matched:
+                self.allocator.release(pid)
+            logger.warning(
+                "register_prefix: pool cannot hold %d fresh pages; skipping "
+                "warm-up", n_fresh,
+            )
+            return
         pages = matched + fresh
         table_row = np.zeros((self.maxp,), np.int32)
         table_row[: len(pages)] = pages
@@ -558,8 +571,7 @@ class ContinuousEngine:
             jax.random.key(0), jnp.asarray(write_pids),
         )
         self.cache = {"kp": kp, "vp": vp}
-        for j in range(len(matched), n_full):
-            self.allocator.publish(hashes[j], pages[j])
+        self.allocator.publish_chain(tokens[: n_full * ps], ps, pages)
         for pid in pages:
             self.allocator.release(pid)
         logger.info(
@@ -608,6 +620,17 @@ class ContinuousEngine:
                 f"prompt {len(prompt)} + max_new {max_new} exceeds max_seq_len "
                 f"/ cache cap {self.smax}"
             )
+        if self.cache_mode == "paged":
+            need = -(-(len(prompt) + max_new) // self.page_size)
+            if need > self.n_pages - 1:  # page 0 is the reserved sentinel
+                # Reject now: admission could never reserve this many pages,
+                # and a forever-unadmittable request would spin run()/the
+                # server driver without progress.
+                raise ValueError(
+                    f"request needs {need} pages but the pool only has "
+                    f"{self.n_pages - 1} (n_pages={self.n_pages}, "
+                    f"page_size={self.page_size})"
+                )
         req = Request(
             req_id=self._next_id,
             prompt=list(prompt),
@@ -748,13 +771,12 @@ class ContinuousEngine:
         sharing the prefix reuse them without prefilling. Full prompt pages
         are immutable (decode writes only past the prompt), so sharing is
         read-only by construction."""
-        from ditl_tpu.infer.paged_cache import block_hashes
-
         ps = self.page_size
         n_full = len(req.prompt) // ps
-        for j, h in enumerate(block_hashes(req.prompt[: n_full * ps], ps)):
-            if self.allocator.lookup(h) is None:
-                self.allocator.publish(h, int(self._table[slot, j]))
+        self.allocator.publish_chain(
+            req.prompt[: n_full * ps], ps,
+            [int(p) for p in self._table[slot, :n_full]],
+        )
 
     def _paged_prefill_chunk(self, req: Request, slot: int, d: int, s: int,
                              s_bucket: int, rng):

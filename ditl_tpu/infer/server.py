@@ -129,6 +129,18 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_429(self, message: str) -> None:
+        """OpenAI rate-limit shape: clients back off and retry."""
+        body = json.dumps({"error": {
+            "message": message, "type": "rate_limit_error",
+        }}).encode()
+        self.send_response(429)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Retry-After", "1")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         if self.path in ("/health", "/v1/health"):
             self._send_json(200, {"status": "ok", "model": self.model_name})
@@ -292,16 +304,7 @@ class _Handler(BaseHTTPRequestHandler):
                         and getattr(self.threaded_engine, "queue_full", False)):
                     # Pre-stream check: after the SSE headers go out there
                     # is no way to signal 429.
-                    self.send_response(429)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Retry-After", "1")
-                    body = json.dumps({"error": {
-                        "message": "admission queue full",
-                        "type": "rate_limit_error",
-                    }}).encode()
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._send_429("admission queue full")
                     return
                 try:
                     self._stream_complete(
@@ -335,9 +338,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # (OpenAI caps at 5/20); the Generator's LRU program cache
                 # bounds what other client-controlled compile-key fields
                 # (temperature, top_p, max_tokens) can pin in memory.
-                n_top = (
-                    int(payload.get("top_logprobs") or 1) if chat else int(lp_req)
-                )
+                if chat:
+                    # top_logprobs: 0 is a valid explicit request (chosen
+                    # token only) — presence, not truthiness, again.
+                    tl = payload.get("top_logprobs")
+                    n_top = int(tl) if tl is not None else 1
+                else:
+                    n_top = int(lp_req)
                 n_top = max(0, min(n_top, 20))
                 tok = self.generator.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
@@ -471,16 +478,7 @@ class _Handler(BaseHTTPRequestHandler):
             from ditl_tpu.infer.continuous import QueueFullError
 
             if isinstance(e, QueueFullError):
-                # OpenAI rate-limit shape: clients back off and retry.
-                self.send_response(429)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Retry-After", "1")
-                body = json.dumps({"error": {
-                    "message": str(e), "type": "rate_limit_error",
-                }}).encode()
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_429(str(e))
                 return
             logger.exception("completion failed")
             self._send_json(500, {"error": {"message": str(e)}})
