@@ -1,20 +1,25 @@
 """Host-side page allocator for the paged KV cache (infer/continuous.py
 ``cache_mode="paged"``; device op: ops/paged_attention.py).
 
-The device holds one pool of KV pages per layer — ``(L, n_pages, page_size,
-K, D)`` — and per-slot page tables map logical block index -> physical page.
+The device holds one pool of KV pages per layer — ``(L, n_pages, K,
+page_size, D)``, kv-heads before page slots (ops/paged_attention.py's
+Mosaic trailing-dim requirement) — and per-slot page tables map logical
+block index -> physical page.
 This module is the host bookkeeping around that pool:
 
 - **Free-list allocation** with refcounts: a page may back several slots'
   tables at once (shared prefix blocks).
 - **Content-addressed dedup**: every FULL page of a prompt is published
-  under a progressive hash ``h_i = hash((h_{i-1}, tokens_in_page_i))``; a
-  later prompt whose leading blocks hash to published pages reuses them
+  under the key ``(parent_physical_page_id, exact_tokens_in_page)``; a
+  later prompt whose leading blocks walk to published pages reuses them
   (refcount bump, no prefill) — vLLM-style automatic prefix caching, no
-  ``register_prefix`` call required. Only full, immutable pages are ever
-  shared: a slot's partial tail page and its decode pages are private, so
-  there is no copy-on-write fault path — sharing is read-only by
-  construction.
+  ``register_prefix`` call required. The key chains through the *physical*
+  parent page id and compares the block's actual tokens, so equal keys
+  mean equal full prefixes by construction — no reliance on hash
+  collision resistance (a colliding ``hash()`` key would silently serve
+  another prompt's KV). Only full, immutable pages are ever shared: a
+  slot's partial tail page and its decode pages are private, so there is
+  no copy-on-write fault path — sharing is read-only by construction.
 - **LRU eviction**: published pages whose only reference is the hash cache
   are reclaimable; allocation pressure evicts them oldest-first.
 
@@ -30,18 +35,20 @@ from __future__ import annotations
 
 from collections import OrderedDict, deque
 
-__all__ = ["PageAllocator", "block_hashes"]
+__all__ = ["PageAllocator", "block_keys"]
+
+PageKey = tuple[int, tuple[int, ...]]
 
 
-def block_hashes(tokens: list[int], page_size: int) -> list[int]:
-    """Progressive content hashes of the FULL pages of ``tokens``. Page i's
-    hash covers every token up to and including page i (chained), so equal
-    hashes mean equal full prefixes — the property that makes reuse safe."""
-    out: list[int] = []
-    h = 0
-    for start in range(0, len(tokens) - page_size + 1, page_size):
-        h = hash((h, tuple(tokens[start:start + page_size])))
-        out.append(h)
+def block_keys(tokens: list[int], page_size: int, parents: list[int]) -> list[PageKey]:
+    """Content keys for the FULL pages of ``tokens``: page i's key is
+    ``(physical id of page i-1, page i's exact tokens)`` (parent 0 = the
+    sentinel for the first page). Equal keys mean equal full prefixes by
+    induction over verified parents — no hash-collision exposure."""
+    out: list[PageKey] = []
+    for i, start in enumerate(range(0, len(tokens) - page_size + 1, page_size)):
+        parent = parents[i - 1] if i > 0 else 0
+        out.append((parent, tuple(tokens[start:start + page_size])))
     return out
 
 
@@ -54,10 +61,10 @@ class PageAllocator:
         self.n_pages = n_pages
         self._free: deque[int] = deque(range(1, n_pages))
         self._ref = [0] * n_pages
-        self._hash_to_page: dict[int, int] = {}
-        self._page_hash: dict[int, int] = {}
-        # Insertion-ordered: oldest published hash evicts first.
-        self._lru: OrderedDict[int, None] = OrderedDict()
+        self._key_to_page: dict[PageKey, int] = {}
+        self._page_key: dict[int, PageKey] = {}
+        # Insertion-ordered: oldest published key evicts first.
+        self._lru: OrderedDict[PageKey, None] = OrderedDict()
 
     # -- capacity ------------------------------------------------------------
 
@@ -68,11 +75,8 @@ class PageAllocator:
     @property
     def n_evictable(self) -> int:
         return sum(
-            1 for h, p in self._hash_to_page.items() if self._ref[p] == 1
+            1 for k, p in self._key_to_page.items() if self._ref[p] == 1
         )
-
-    def can_alloc(self, n: int) -> bool:
-        return n <= self.n_free + self.n_evictable
 
     # -- alloc / free --------------------------------------------------------
 
@@ -97,17 +101,17 @@ class PageAllocator:
         return out
 
     def _evict_one(self) -> int | None:
-        for h in self._lru:
-            pid = self._hash_to_page[h]
-            if self._ref[pid] == 1:  # only the hash cache holds it
-                self._unpublish(h, pid)
+        for key in self._lru:
+            pid = self._key_to_page[key]
+            if self._ref[pid] == 1:  # only the content cache holds it
+                self._unpublish(key, pid)
                 return pid
         return None
 
-    def _unpublish(self, h: int, pid: int) -> None:
-        del self._hash_to_page[h]
-        del self._page_hash[pid]
-        self._lru.pop(h, None)
+    def _unpublish(self, key: PageKey, pid: int) -> None:
+        del self._key_to_page[key]
+        del self._page_key[pid]
+        self._lru.pop(key, None)
         self._ref[pid] -= 1  # the cache's own reference
 
     def retain(self, pid: int) -> None:
@@ -124,23 +128,43 @@ class PageAllocator:
 
     # -- content cache -------------------------------------------------------
 
-    def lookup(self, h: int) -> int | None:
-        """Published page for hash ``h`` (bumps its LRU recency), or None."""
-        pid = self._hash_to_page.get(h)
+    def lookup(self, key: PageKey) -> int | None:
+        """Published page for content key ``key`` (bumps LRU recency)."""
+        pid = self._key_to_page.get(key)
         if pid is not None:
-            self._lru.move_to_end(h)
+            self._lru.move_to_end(key)
         return pid
 
-    def publish(self, h: int, pid: int) -> None:
-        """Register ``pid`` as the page for content hash ``h``. The cache
+    def publish(self, key: PageKey, pid: int) -> None:
+        """Register ``pid`` as the page for content key ``key``. The cache
         takes its own reference, keeping the page reclaimable-but-resident
         after the owning request finishes."""
-        if h in self._hash_to_page:
+        if key in self._key_to_page:
             return  # first publisher wins; the duplicate stays private
-        self._hash_to_page[h] = pid
-        self._page_hash[pid] = h
-        self._lru[h] = None
+        self._key_to_page[key] = pid
+        self._page_key[pid] = key
+        self._lru[key] = None
         self._ref[pid] += 1
+
+    def publish_chain(
+        self, tokens: list[int], page_size: int, own_pages: list[int]
+    ) -> None:
+        """Publish the full pages of ``tokens`` backed by ``own_pages``
+        (the owner's physical page per block, shared or private). Walks the
+        CANONICAL chain: when a key is already published, the cached page —
+        not the owner's private duplicate — becomes the parent for the next
+        key, so all equal prefixes share one chain."""
+        parent = 0
+        for i, pid in enumerate(own_pages):
+            block = tuple(tokens[i * page_size:(i + 1) * page_size])
+            key = (parent, block)
+            existing = self._key_to_page.get(key)
+            if existing is None:
+                self.publish(key, pid)
+                parent = pid
+            else:
+                self._lru.move_to_end(key)
+                parent = existing
 
     def match_prefix(self, tokens: list[int], page_size: int) -> list[int]:
         """Longest run of published pages covering ``tokens``' leading FULL
@@ -151,11 +175,14 @@ class PageAllocator:
         if usable < page_size:
             return []
         pages: list[int] = []
-        for h in block_hashes(tokens[: usable - usable % page_size], page_size):
-            pid = self.lookup(h)
+        parent = 0
+        for i in range(usable // page_size):
+            block = tuple(tokens[i * page_size:(i + 1) * page_size])
+            pid = self.lookup((parent, block))
             if pid is None:
                 break
             pages.append(pid)
+            parent = pid
         for pid in pages:
             self.retain(pid)
         return pages

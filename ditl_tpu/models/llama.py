@@ -261,8 +261,9 @@ def _decoder_layer(
     ``attn_mask`` — the KV-cache prefill/decode path (infer/engine.py).
 
     When ``layer_cache`` holds page pools (``{"kp", "vp"}``, each
-    (n_pages, page_size, K, D)), ``paged`` carries the tick metadata —
-    ``table`` (B, maxp), write ``pid``/``off`` (B,), ``live`` (B,) and
+    (n_pages, K, page_size, D) — kv-heads before page slots, the Mosaic
+    trailing-dim layout of ops/paged_attention.py), ``paged`` carries the
+    tick metadata — ``table`` (B, maxp), write ``pid``/``off`` (B,) and
     ``lengths`` (B,) — and this is the single-token paged decode step
     (ops/paged_attention.py): the token's K/V rows are scattered into the
     pools and attention runs through the page table."""

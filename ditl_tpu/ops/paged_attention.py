@@ -40,10 +40,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ditl_tpu.ops.attention import NEG_INF
+from ditl_tpu.ops.flash_attention import NUM_LANES, _lane_tile
 
 __all__ = ["paged_attention", "paged_attention_xla", "write_page_tokens"]
-
-NUM_LANES = 128
 
 
 def paged_attention_xla(
@@ -106,13 +105,7 @@ def _paged_kernel(
     base = p * page_size
     kv_heads, groups = q_ref.shape[1], q_ref.shape[2]
     d = acc_scr.shape[-1]
-
-    def tile(x, width):
-        if width == NUM_LANES:
-            return x
-        if width < NUM_LANES:
-            return x[:, :width]
-        return jnp.tile(x, (1, width // NUM_LANES))
+    tile = _lane_tile  # shared lane-replication helper (ops/flash_attention)
 
     @pl.when(base < length)
     def _compute():

@@ -16,7 +16,7 @@ from ditl_tpu.config import ModelConfig
 from ditl_tpu.data.tokenizer import ByteTokenizer
 from ditl_tpu.infer.continuous import ContinuousEngine
 from ditl_tpu.infer.engine import GenerateConfig, Generator
-from ditl_tpu.infer.paged_cache import PageAllocator, block_hashes
+from ditl_tpu.infer.paged_cache import PageAllocator, block_keys
 from ditl_tpu.models import llama
 
 
@@ -101,10 +101,8 @@ def test_allocator_publish_match_and_evict():
     ps = 4
     a = PageAllocator(6)
     toks = list(range(12))  # 3 full pages
-    hashes = block_hashes(toks, ps)
     pages = a.alloc(3)
-    for h, p in zip(hashes, pages):
-        a.publish(h, p)
+    a.publish_chain(toks, ps, pages)
     for p in pages:
         a.release(p)  # owner done; cache still holds them
     # a prompt with the same first 2 pages + different tail matches 2 pages
@@ -126,14 +124,42 @@ def test_allocator_publish_match_and_evict():
     assert m == pages[:2]
 
 
-def test_block_hashes_are_prefix_chained():
+def test_block_keys_are_prefix_chained():
     ps = 4
-    h1 = block_hashes([1, 2, 3, 4, 5, 6, 7, 8], ps)
-    h2 = block_hashes([1, 2, 3, 4, 9, 9, 9, 9], ps)
-    assert h1[0] == h2[0] and h1[1] != h2[1]
-    # same second block under a different first block must NOT collide
-    h3 = block_hashes([9, 9, 9, 9, 5, 6, 7, 8], ps)
-    assert h3[1] != h1[1]
+    k1 = block_keys([1, 2, 3, 4, 5, 6, 7, 8], ps, parents=[7, 9])
+    k2 = block_keys([1, 2, 3, 4, 9, 9, 9, 9], ps, parents=[7, 9])
+    assert k1[0] == k2[0] and k1[1] != k2[1]
+    # same second block under a different parent page must NOT collide —
+    # identity is (physical parent page, exact tokens), collision-free
+    k3 = block_keys([1, 2, 3, 4, 5, 6, 7, 8], ps, parents=[8, 9])
+    assert k3[1] != k1[1]
+
+
+def test_allocator_keys_verify_content_not_hash():
+    """A published page is only served for the EXACT (parent, tokens) key —
+    content is compared, not a hash value, so collisions cannot leak
+    another prompt's KV."""
+    ps = 4
+    a = PageAllocator(6)
+    toks = [1, 2, 3, 4, 5, 6, 7, 8]
+    pages = a.alloc(2)
+    a.publish_chain(toks, ps, pages)
+    for p in pages:
+        a.release(p)
+    # same first block, different second block: only page 1 matches
+    m = a.match_prefix([1, 2, 3, 4, 9, 9, 9, 9, 0], ps)
+    assert m == pages[:1]
+    for p in m:
+        a.release(p)
+    # a second publisher of an equal prefix keeps ONE canonical chain
+    dup = a.alloc(2)
+    a.publish_chain(toks, ps, dup)
+    for p in dup:
+        a.release(p)
+    m = a.match_prefix(toks + [0], ps)
+    assert m == pages  # the first-published chain wins
+    for p in m:
+        a.release(p)
 
 
 # -- engine -------------------------------------------------------------------
@@ -287,3 +313,24 @@ def test_paged_rejects_int8_kv(tiny_setup):
     qcfg = dataclasses.replace(cfg, kv_cache_dtype="int8")
     with pytest.raises(NotImplementedError):
         _paged_engine(params, qcfg)
+
+
+def test_paged_oversize_request_rejected_at_submit(tiny_setup):
+    """A request that could never fit the pool must fail at submit, not spin
+    the scheduler forever waiting for pages that cannot exist."""
+    cfg, params = tiny_setup
+    eng = _paged_engine(params, cfg, n_pages=4,
+                        gen=GenerateConfig(max_new_tokens=64))
+    with pytest.raises(ValueError, match="pages"):
+        eng.submit([1] + list(range(5, 100)))  # needs ~10 pages, pool has 3
+
+
+def test_paged_register_prefix_survives_pool_pressure(tiny_setup):
+    """register_prefix on a nearly-full pool degrades to a no-op (with the
+    matched retains rolled back) instead of raising or leaking refcounts."""
+    cfg, params = tiny_setup
+    eng = _paged_engine(params, cfg, n_pages=4,
+                        gen=GenerateConfig(max_new_tokens=8))
+    free0 = eng.allocator.n_free + eng.allocator.n_evictable
+    eng.register_prefix([1] + list(range(5, 150)))  # needs more pages than 3
+    assert eng.allocator.n_free + eng.allocator.n_evictable == free0
