@@ -194,5 +194,5 @@ _SLOW_TESTS = {
 
 def pytest_collection_modifyitems(config, items):
     for item in items:
-        if f"{item.fspath.basename and 'tests/' + item.fspath.basename}::{item.name}" in _SLOW_TESTS:
+        if item.nodeid in _SLOW_TESTS:
             item.add_marker(pytest.mark.slow)
