@@ -96,6 +96,8 @@ class ContinuousEngine:
         page_size: int = 256,
         n_pages: int | None = None,
         max_queue: int | None = None,
+        mesh=None,
+        rules=None,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -127,7 +129,16 @@ class ContinuousEngine:
         the contiguous mode.
 
         ``max_queue`` caps how many requests may wait for a slot; ``submit``
-        raises ``QueueFullError`` beyond it (HTTP layer: 429)."""
+        raises ``QueueFullError`` beyond it (HTTP layer: 429).
+
+        ``mesh`` shards the engine's programs over a device mesh (same rule
+        table as training, parallel/sharding.py): the cache shards batch
+        over data/fsdp and kv-heads over tensor, and GSPMD emits the pod
+        collectives. Combined with the podserve tick broadcast
+        (infer/podserve.PodContinuousDriver) this is pod-wide continuous
+        batching: every process runs the identical tick program on its
+        shard. Paged mode is currently single-device (the Pallas kernel is
+        not yet shard_mapped)."""
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer
@@ -141,6 +152,13 @@ class ContinuousEngine:
             raise ValueError(f"prefill_chunk must be >= 0, got {prefill_chunk}")
         self.prefill_chunk = prefill_chunk
         self.max_queue = max_queue
+        if mesh is not None and cache_mode == "paged":
+            raise NotImplementedError(
+                "cache_mode='paged' does not yet compose with a mesh (the "
+                "paged Pallas kernel is not shard_mapped); use contiguous"
+            )
+        self.mesh = mesh
+        self.rules = rules
         self.gen = gen or GenerateConfig()
         self.smax = min(model_cfg.max_seq_len, max_cache_len or model_cfg.max_seq_len)
 
@@ -181,6 +199,14 @@ class ContinuousEngine:
             self.limits = jnp.zeros((n_slots,), jnp.int32)
         else:
             self.cache = init_cache(model_cfg, n_slots, self.smax)
+            if mesh is not None:
+                from ditl_tpu.infer.cache import cache_logical_axes
+                from ditl_tpu.parallel.sharding import named_sharding_tree
+
+                self.cache = jax.device_put(
+                    self.cache,
+                    named_sharding_tree(mesh, cache_logical_axes(model_cfg), rules),
+                )
         self.cur = jnp.full((n_slots,), tokenizer.pad_id, jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.temps = jnp.zeros((n_slots,), jnp.float32)
@@ -233,6 +259,8 @@ class ContinuousEngine:
                 cache=row,
                 cache_index=jnp.int32(0),
                 attn_mask=mask,
+                mesh=self.mesh,
+                rules=self.rules,
             )
             cache = jax.tree.map(
                 lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
@@ -270,6 +298,8 @@ class ContinuousEngine:
                     cache=cache,
                     cache_index=pos,
                     attn_mask=mask,
+                    mesh=self.mesh,
+                    rules=self.rules,
                 )
                 nxt = sample_logits(
                     logits[:, 0], subs,
@@ -309,6 +339,7 @@ class ContinuousEngine:
             logits, row = llama.forward(
                 params, ids, cfg, positions=q_pos[None],
                 cache=row, cache_index=jnp.int32(0), attn_mask=mask,
+                mesh=self.mesh, rules=self.rules,
             )
             return row, logits[0, length - 1]
 
@@ -346,6 +377,7 @@ class ContinuousEngine:
             logits, row = llama.forward(
                 params, ids, cfg, positions=q_pos[None],
                 cache=row, cache_index=offset, attn_mask=mask,
+                mesh=self.mesh, rules=self.rules,
             )
             cache = jax.tree.map(
                 lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
@@ -399,6 +431,7 @@ class ContinuousEngine:
             logits, row = llama.forward(
                 params, ids, cfg, positions=q_pos[None],
                 cache=row, cache_index=offset, attn_mask=mask,
+                mesh=self.mesh, rules=self.rules,
             )
             def to_pages(r):  # (L, 1, s_bucket, K, D) -> (L, n_wp, K, ps, D)
                 chunk = jax.lax.dynamic_slice_in_dim(r, offset, s_bucket, axis=2)

@@ -42,9 +42,12 @@ from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-__all__ = ["PodGenerator", "worker_loop"]
+__all__ = [
+    "PodGenerator", "worker_loop",
+    "PodContinuousDriver", "continuous_worker_loop",
+]
 
-_IDLE, _GENERATE, _SHUTDOWN = 0, 1, 2
+_IDLE, _GENERATE, _SHUTDOWN, _CTICK = 0, 1, 2, 3
 
 
 def _f2i(x: float) -> int:
@@ -297,5 +300,319 @@ def worker_loop(generator: Generator) -> None:
             logger.error(
                 "pod serve worker: tick status diverged across processes; "
                 "shutting down"
+            )
+            return
+
+
+# ---------------------------------------------------------------------------
+# Pod-wide continuous batching
+# ---------------------------------------------------------------------------
+#
+# The lock-step PodGenerator broadcasts whole generate calls; a continuous
+# engine instead needs every process to run the SAME scheduler ticks on the
+# same state. The protocol broadcasts scheduler INPUTS (submits + cancels)
+# once per tick; each process applies them to its own ContinuousEngine
+# replica (deterministic: same seeds, same FIFO order, same slot math) and
+# calls engine.step() — the tick's prefill/decode programs are then
+# pod-wide SPMD programs over the engine's mesh. Results are replicated;
+# process 0 answers HTTP.
+#
+# CTICK payload: header [_CTICK, n_submits, ids_total, n_cancels, 0...];
+# then meta (n_submits, 5) int32 = [prompt_len, max_new, temp_bits,
+# top_p_bits, seed]; ids (ids_total,) int32 (prompts concatenated);
+# cancels (n_cancels,) int32 (req ids). A post-tick status collective
+# (_statuses_agree) detects one-sided failures exactly as in lock-step
+# pod serving.
+
+
+def _apply_ctick(engine, meta: np.ndarray, ids: np.ndarray, cancels: np.ndarray,
+                 streams: list | None = None):
+    """Apply one broadcast tick's scheduler inputs, then run one tick.
+    Returns the submitted request ids (identical on every process).
+    ``streams`` (process 0 only) attaches per-request stream queues at
+    submit time — before the tick's step, so first-tick chunks are not
+    lost; worker replicas stream to nowhere."""
+    rids = []
+    off = 0
+    for i, row in enumerate(meta):
+        plen, max_new, temp_bits, top_p_bits, seed = (int(v) for v in row)
+        prompt = ids[off: off + plen].tolist()
+        off += plen
+        rids.append(engine.submit(
+            prompt, max_new_tokens=max_new, temperature=_i2f(temp_bits),
+            top_p=_i2f(top_p_bits), seed=seed,
+            stream=streams[i] if streams is not None else None,
+        ))
+    for rid in cancels:
+        engine.cancel(int(rid))
+    engine.step()
+    return rids
+
+
+class PodContinuousDriver:
+    """Process-0 driver for pod-wide continuous batching. Exposes the
+    ``ThreadedEngine`` surface the HTTP server uses (``generate_one``,
+    ``stream_one``, ``cancel``, ``queue_full``, ``close``) while pumping
+    scheduler inputs through the pod broadcast so every process ticks the
+    same engine state. At ``process_count == 1`` the broadcasts are
+    identity and this degenerates to a broadcast-framed ThreadedEngine —
+    how the protocol is unit-tested."""
+
+    def __init__(self, engine, *, poll_s: float = 0.02):
+        self._engine = engine
+        self.tokenizer = engine.tokenizer
+        self.poll_s = poll_s
+        self._lock = threading.Lock()
+        self._staged: list[tuple] = []  # (prompt, max_new, temp, top_p, seed, ticket)
+        self._cancels: set[int] = set()
+        self._tickets: dict[int, "_Ticket"] = {}
+        self._seq = 0  # monotonic default-seed counter (never reset)
+        self._stop = False
+        self._error: BaseException | None = None
+        self._cond = threading.Condition(self._lock)
+        self._pump = threading.Thread(target=self._pump_loop, daemon=True)
+        self._pump.start()
+
+    @property
+    def queue_full(self) -> bool:
+        # Lock-free on purpose: _stage calls this while holding _cond (the
+        # same non-reentrant lock), and the check is best-effort anyway —
+        # len() reads of a deque/list are atomic under the GIL.
+        eng = self._engine
+        if eng.max_queue is None:
+            return False
+        return len(eng._queue) + len(self._staged) >= eng.max_queue
+
+    def _pump_loop(self) -> None:
+        import time as _time
+
+        while True:
+            with self._cond:
+                while (not self._stop and not self._staged and not self._cancels
+                       and self._engine.pending == 0):
+                    self._cond.wait(timeout=self.poll_s)
+                if self._stop:
+                    staged, self._staged = self._staged, []
+                    break
+                staged, self._staged = self._staged, []
+                cancels, self._cancels = self._cancels, set()
+            try:
+                self._tick(staged, sorted(cancels))
+            except BaseException as e:  # noqa: BLE001
+                logger.exception("pod continuous driver died")
+                with self._cond:
+                    self._error = e
+                    self._stop = True
+                    # Fail EVERY outstanding waiter: registered tickets,
+                    # the in-flight batch (whose tickets may not have been
+                    # registered yet), and anything staged during the tick
+                    # — an unset ticket event is a permanently hung HTTP
+                    # connection.
+                    for t in self._tickets.values():
+                        t.fail(e)
+                    self._tickets.clear()
+                    for (*_, t) in staged:
+                        t.fail(e)
+                    for (*_, t) in self._staged:
+                        t.fail(e)
+                    self._staged.clear()
+                    self._cond.notify_all()
+                return
+        # shutdown: one final broadcast releases the workers
+        _broadcast(np.asarray([_SHUTDOWN, 0, 0, 0, 0, 0, 0, 0], np.int32))
+        with self._cond:
+            err = RuntimeError("pod serving stopped")
+            for t in self._tickets.values():
+                t.fail(err)
+            for (_, _, _, _, _, ticket) in staged:
+                ticket.fail(err)
+            self._tickets.clear()
+            self._cond.notify_all()
+
+    def _tick(self, staged, cancels) -> None:
+        metas, all_ids = [], []
+        for (prompt, max_new, temp, top_p, seed, _t) in staged:
+            metas.append([len(prompt), max_new, _f2i(temp), _f2i(top_p), seed])
+            all_ids.extend(prompt)
+        meta = np.asarray(metas, np.int32).reshape(len(staged), 5)
+        ids = np.asarray(all_ids, np.int32)
+        cc = np.asarray(cancels, np.int32)
+        header = np.asarray(
+            [_CTICK, len(staged), len(all_ids), len(cc), 0, 0, 0, 0], np.int32
+        )
+        _broadcast(header)
+        if len(staged):
+            _broadcast(meta)
+            _broadcast(ids)
+        if len(cc):
+            _broadcast(cc)
+        ok = True
+        rids = []
+        try:
+            rids = _apply_ctick(
+                self._engine, meta, ids, cc,
+                streams=[t.stream for (_, _, _, _, _, t) in staged],
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced via tickets
+            ok = False
+            err = e
+        if not _statuses_agree(ok):
+            raise RuntimeError(
+                "pod tick status diverged across processes (workers have "
+                "shut down)"
+            )
+        with self._cond:
+            if not ok:
+                for (*_, ticket) in staged:
+                    ticket.fail(err)
+                return
+            for (_, _, _, _, _, ticket), rid in zip(staged, rids):
+                ticket.req_id = rid
+                self._tickets[rid] = ticket
+            for req in self._engine.take_finished():
+                t = self._tickets.pop(req.req_id, None)
+                if t is not None:
+                    t.finish(req.tokens)
+            self._cond.notify_all()
+
+    # -- ThreadedEngine surface ----------------------------------------------
+
+    def _stage(self, prompt_tokens, max_new_tokens, temperature, top_p, seed,
+               stream=None) -> "_Ticket":
+        from ditl_tpu.infer.continuous import QueueFullError
+
+        gen = self._engine.gen
+        ticket = _Ticket(stream)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("pod serving stopped") from self._error
+            if self.queue_full:
+                raise QueueFullError("admission queue full (pod)")
+            self._staged.append((
+                list(prompt_tokens) or [self.tokenizer.bos_id],
+                max_new_tokens if max_new_tokens is not None else gen.max_new_tokens,
+                gen.temperature if temperature is None else float(temperature),
+                gen.top_p if top_p is None else float(top_p),
+                int(seed) if seed is not None else
+                # Driver-level monotonic counter: unlike engine._next_id +
+                # len(staged) (which races with an in-flight tick swapping
+                # the staged list), _seq only moves forward, so concurrent
+                # default-seeded requests never collide.
+                self._engine._base_seed + self._seq,
+                ticket,
+            ))
+            self._seq += 1
+            self._cond.notify_all()
+        return ticket
+
+    def generate_one(self, prompt_tokens, *, max_new_tokens=None,
+                     temperature=None, top_p=None, seed=None) -> list[int]:
+        ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
+                             top_p, seed)
+        return ticket.wait()
+
+    def stream_one(self, prompt_tokens, *, max_new_tokens=None,
+                   temperature=None, top_p=None, seed=None):
+        import queue as _queue
+
+        stream: _queue.Queue = _queue.Queue()
+        ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
+                             top_p, seed, stream=stream)
+        try:
+            while True:
+                try:
+                    chunk = stream.get(timeout=1.0)
+                except _queue.Empty:
+                    if self._stop:
+                        raise RuntimeError(
+                            "pod serving stopped mid-stream"
+                        ) from self._error
+                    continue
+                if chunk is None:
+                    if ticket.error is not None:
+                        # fail() uses the same end-of-stream sentinel; a
+                        # driver error must not present a truncated stream
+                        # as a clean completion.
+                        raise RuntimeError(
+                            "pod serving stopped mid-stream"
+                        ) from ticket.error
+                    return
+                yield chunk
+        finally:
+            if ticket.req_id is not None:
+                self.cancel(ticket.req_id)
+
+    def cancel(self, req_id: int) -> None:
+        with self._cond:
+            self._cancels.add(req_id)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._pump.join(timeout=600)
+        if self._pump.is_alive():
+            logger.error("pod continuous pump did not drain within 600s")
+
+
+class _Ticket:
+    """One staged request's handoff between an HTTP thread and the pump."""
+
+    def __init__(self, stream=None):
+        self.stream = stream
+        self.req_id: int | None = None
+        self.result: list[int] | None = None
+        self.error: BaseException | None = None
+        self.done = threading.Event()
+
+    def finish(self, tokens: list[int]) -> None:
+        self.result = tokens
+        self.done.set()
+
+    def fail(self, err: BaseException) -> None:
+        self.error = err
+        self.done.set()
+        if self.stream is not None:
+            self.stream.put(None)
+
+    def wait(self) -> list[int]:
+        self.done.wait()
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+def continuous_worker_loop(engine) -> None:
+    """Run on every ``jax.process_index() != 0`` process under
+    ``--pod --engine continuous``: mirror the coordinator's tick broadcasts
+    on an identical engine replica until shutdown."""
+    logger.info("pod continuous worker: entering broadcast loop")
+    while True:
+        header = _broadcast(np.zeros((8,), np.int32))
+        op = int(header[0])
+        if op == _SHUTDOWN:
+            logger.info("pod continuous worker: shutdown")
+            return
+        if op != _CTICK:
+            logger.error("pod continuous worker: unexpected opcode %d", op)
+            return
+        n_sub, ids_total, n_cancel = int(header[1]), int(header[2]), int(header[3])
+        meta = (_broadcast(np.zeros((n_sub, 5), np.int32))
+                if n_sub else np.zeros((0, 5), np.int32))
+        ids = (_broadcast(np.zeros((ids_total,), np.int32))
+               if n_sub else np.zeros((0,), np.int32))
+        cc = (_broadcast(np.zeros((n_cancel,), np.int32))
+              if n_cancel else np.zeros((0,), np.int32))
+        ok = True
+        try:
+            _apply_ctick(engine, meta, ids, cc)
+            engine.take_finished()  # drop replicated results
+        except Exception:
+            ok = False
+            logger.exception("pod continuous worker: tick failed")
+        if not _statuses_agree(ok):
+            logger.error(
+                "pod continuous worker: tick status diverged; shutting down"
             )
             return

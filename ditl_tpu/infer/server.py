@@ -597,9 +597,6 @@ def serve(argv: list[str] | None = None) -> int:
     )
     args = parser.parse_args(argv)
 
-    if args.pod and args.engine == "continuous":
-        parser.error("--pod composes with --engine lockstep only (the "
-                     "continuous scheduler is host-side per-process state)")
     if args.mesh and not args.pod and jax.process_count() > 1:
         parser.error("--mesh on a multi-host pod requires --pod: the mesh "
                      "spans all hosts' devices, so every process must join "
@@ -610,11 +607,10 @@ def serve(argv: list[str] | None = None) -> int:
     if args.adapter and args.pod:
         parser.error("--adapter does not compose with --pod (the broadcast "
                      "protocol does not carry adapter ids)")
-    if args.mesh and args.engine == "continuous":
-        parser.error("--mesh composes with --engine lockstep only (the "
-                     "continuous engine's cache/scheduler is single-device; "
-                     "shard it with --engine lockstep --mesh, or serve "
-                     "continuous unsharded)")
+    if args.cache_mode == "paged" and args.mesh:
+        parser.error("--cache-mode paged does not yet compose with --mesh "
+                     "(the paged kernel is not shard_mapped); use "
+                     "--cache-mode contiguous")
     if jax.process_index() != 0 and not args.pod:
         # Without --pod, one process binds the port and the others exit; with
         # --pod every process joins the collective decode loop below.
@@ -711,31 +707,58 @@ def serve(argv: list[str] | None = None) -> int:
         params = quantize_weights(params)
         logger.info("quantized weights to int8 (weight-only)")
     generator = Generator(params, cfg, tokenizer, mesh=mesh)
-    if args.pod and jax.process_index() != 0:
-        from ditl_tpu.infer.podserve import worker_loop
+    def build_engine():
+        from ditl_tpu.infer.continuous import ContinuousEngine
 
-        worker_loop(generator)  # returns on the coordinator's shutdown opcode
+        return ContinuousEngine(
+            params, cfg, tokenizer, n_slots=args.slots,
+            max_cache_len=args.max_cache_len or None,
+            prefill_chunk=args.prefill_chunk,
+            cache_mode=args.cache_mode,
+            page_size=args.page_size,
+            n_pages=args.pages or None,
+            max_queue=args.max_queue or None,
+            mesh=mesh,
+        )
+
+    if args.pod and jax.process_index() != 0:
+        if args.engine == "continuous":
+            # Pod-wide continuous batching: every process replays the
+            # coordinator's scheduler ticks on an identical engine replica.
+            from ditl_tpu.infer.podserve import continuous_worker_loop
+
+            continuous_worker_loop(build_engine())
+        else:
+            from ditl_tpu.infer.podserve import worker_loop
+
+            worker_loop(generator)  # returns on the shutdown opcode
         return 0
     pod = None
-    if args.pod:
+    threaded = None
+    if args.engine == "continuous":
+        if args.pod:
+            from ditl_tpu.infer.podserve import PodContinuousDriver
+
+            threaded = pod = PodContinuousDriver(build_engine())
+
+            class _TokenizerOnly:
+                """All device work must ride the tick broadcast: direct
+                Generator fallbacks (logprobs) would run a pod-wide SPMD
+                program on process 0 alone and hang the pod — absent
+                methods turn those requests into clean 400s."""
+
+                def __init__(self, tok):
+                    self.tokenizer = tok
+
+            generator = _TokenizerOnly(tokenizer)
+        else:
+            from ditl_tpu.infer.continuous import ThreadedEngine
+
+            threaded = ThreadedEngine(build_engine())
+    elif args.pod:
         from ditl_tpu.infer.podserve import PodGenerator
 
         generator = pod = PodGenerator(generator)
-    threaded = None
-    if args.engine == "continuous":
-        from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
-
-        threaded = ThreadedEngine(
-            ContinuousEngine(
-                params, cfg, tokenizer, n_slots=args.slots,
-                max_cache_len=args.max_cache_len or None,
-                prefill_chunk=args.prefill_chunk,
-                cache_mode=args.cache_mode,
-                page_size=args.page_size,
-                n_pages=args.pages or None,
-                max_queue=args.max_queue or None,
-            )
-        )
     server = make_server(
         generator, host=args.host, port=args.port, model_name=cfg.name,
         default_max_tokens=args.max_tokens, threaded_engine=threaded,

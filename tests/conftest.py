@@ -101,6 +101,7 @@ _SLOW_TESTS = {
     "tests/test_continuous.py::test_server_sse_streaming_lockstep_fallback",
     "tests/test_continuous.py::test_slot_reuse_more_requests_than_slots",
     "tests/test_continuous.py::test_stream_one_yields_incremental_chunks",
+    "tests/test_continuous.py::test_continuous_engine_on_mesh_matches_single_device",
     "tests/test_continuous.py::test_varied_max_new_and_temperature",
     "tests/test_convert.py::test_export_cli_from_orbax_checkpoint",
     "tests/test_convert.py::test_export_roundtrip",
@@ -152,7 +153,10 @@ _SLOW_TESTS = {
     "tests/test_pipeline.py::test_pipeline_microbatch_count",
     "tests/test_pipeline.py::test_pipeline_moe_aux_matches",
     "tests/test_pipeline.py::test_pipeline_train_step_matches_single_device",
+    "tests/test_podserve.py::test_pod_continuous_concurrent_and_streaming",
+    "tests/test_podserve.py::test_pod_continuous_matches_plain_engine",
     "tests/test_podserve.py::test_pod_generate_matches_direct",
+    "tests/test_podserve.py::test_server_continuous_via_pod",
     "tests/test_profiling.py::test_metrics_jsonl_stream",
     "tests/test_profiling.py::test_trainer_profile_config_end_to_end",
     "tests/test_quant.py::test_quantized_forward_close_to_float",

@@ -540,3 +540,18 @@ def test_short_request_admitted_during_long_prefill(setup):
     # never a prerequisite)
     results = eng.run()
     assert long_id in results
+
+
+def test_continuous_engine_on_mesh_matches_single_device(setup):
+    """A dp x tp-sharded ContinuousEngine produces the same tokens as the
+    unsharded one — the pod-wide continuous batching compute path."""
+    from ditl_tpu.config import MeshConfig
+    from ditl_tpu.runtime.mesh import build_mesh
+
+    params, cfg, tok = setup
+    prompts = ["hello world", "abc", "a slightly longer prompt here"]
+    gen = GenerateConfig(max_new_tokens=10)
+    ref = ContinuousEngine(params, cfg, tok, n_slots=4, gen=gen).generate(prompts)
+    mesh = build_mesh(MeshConfig(data=2, tensor=2, fsdp=2))
+    eng = ContinuousEngine(params, cfg, tok, n_slots=4, gen=gen, mesh=mesh)
+    assert eng.generate(prompts) == ref
