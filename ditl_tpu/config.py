@@ -244,6 +244,11 @@ class TrainConfig:
     # step on the FIRST run (never after a resume). 0 => off. Pick a step
     # past checkpoint_every so the restart has something to resume from.
     fault_inject_step: int = 0
+    # Harsher drill: SIGKILL our own process at this global step on the
+    # FIRST run — uncatchable, like a host crash/OOM-kill. Only an
+    # OUT-OF-PROCESS supervisor (launch --supervise, or k8s restartPolicy)
+    # can recover from it. 0 => off.
+    fault_kill_step: int = 0
     # Path to a local HF checkpoint directory (transformers format) to
     # initialize parameters from instead of random init (models/convert.py).
     init_from_hf: str = ""

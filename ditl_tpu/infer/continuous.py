@@ -648,6 +648,25 @@ class ContinuousEngine:
             )
         max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
         prompt = prompt_tokens or [self.tokenizer.bos_id]
+        self.validate_request(prompt, max_new)
+        req = Request(
+            req_id=self._next_id,
+            prompt=list(prompt),
+            max_new_tokens=max_new,
+            temperature=gen.temperature if temperature is None else temperature,
+            top_p=gen.top_p if top_p is None else top_p,
+            seed=(self._base_seed + self._next_id) if seed is None else seed,
+            stream=stream,
+        )
+        self._next_id += 1
+        self._queue.append(req)
+        return req.req_id
+
+    def validate_request(self, prompt: list[int], max_new: int) -> None:
+        """Per-request shape validation, raising ``ValueError`` on requests
+        that could never run. Exposed so pod staging (podserve) can reject
+        a bad request on its own HTTP thread instead of failing the whole
+        broadcast tick it would have shared with innocent requests."""
         if len(prompt) + max_new > self.smax:
             raise ValueError(
                 f"prompt {len(prompt)} + max_new {max_new} exceeds max_seq_len "
@@ -664,18 +683,6 @@ class ContinuousEngine:
                     f"{self.n_pages - 1} (n_pages={self.n_pages}, "
                     f"page_size={self.page_size})"
                 )
-        req = Request(
-            req_id=self._next_id,
-            prompt=list(prompt),
-            max_new_tokens=max_new,
-            temperature=gen.temperature if temperature is None else temperature,
-            top_p=gen.top_p if top_p is None else top_p,
-            seed=(self._base_seed + self._next_id) if seed is None else seed,
-            stream=stream,
-        )
-        self._next_id += 1
-        self._queue.append(req)
-        return req.req_id
 
     def _prefill_into_slot(self, req: Request, slot: int, rng) -> jax.Array | None:
         """Fill the slot's cache for ``req``'s prompt and return the first

@@ -332,17 +332,25 @@ def _apply_ctick(engine, meta: np.ndarray, ids: np.ndarray, cancels: np.ndarray,
     ``streams`` (process 0 only) attaches per-request stream queues at
     submit time — before the tick's step, so first-tick chunks are not
     lost; worker replicas stream to nowhere."""
+    from ditl_tpu.infer.continuous import QueueFullError
+
     rids = []
     off = 0
     for i, row in enumerate(meta):
         plen, max_new, temp_bits, top_p_bits, seed = (int(v) for v in row)
         prompt = ids[off: off + plen].tolist()
         off += plen
-        rids.append(engine.submit(
-            prompt, max_new_tokens=max_new, temperature=_i2f(temp_bits),
-            top_p=_i2f(top_p_bits), seed=seed,
-            stream=streams[i] if streams is not None else None,
-        ))
+        try:
+            rids.append(engine.submit(
+                prompt, max_new_tokens=max_new, temperature=_i2f(temp_bits),
+                top_p=_i2f(top_p_bits), seed=seed,
+                stream=streams[i] if streams is not None else None,
+            ))
+        except (ValueError, QueueFullError) as e:
+            # Deterministic per-request rejection: the same submit fails
+            # identically on every process (same engine state), so the pod
+            # stays in lockstep while only this request errors.
+            rids.append(e)
     for rid in cancels:
         engine.cancel(int(rid))
     engine.step()
@@ -366,6 +374,8 @@ class PodContinuousDriver:
         self._staged: list[tuple] = []  # (prompt, max_new, temp, top_p, seed, ticket)
         self._cancels: set[int] = set()
         self._tickets: dict[int, "_Ticket"] = {}
+        self._inflight = 0  # batch swapped out of _staged, not yet submitted
+        self._workers_down = False  # divergence detected: never broadcast again
         self._seq = 0  # monotonic default-seed counter (never reset)
         self._stop = False
         self._error: BaseException | None = None
@@ -381,7 +391,8 @@ class PodContinuousDriver:
         eng = self._engine
         if eng.max_queue is None:
             return False
-        return len(eng._queue) + len(self._staged) >= eng.max_queue
+        return (len(eng._queue) + len(self._staged) + self._inflight
+                >= eng.max_queue)
 
     def _pump_loop(self) -> None:
         import time as _time
@@ -396,10 +407,22 @@ class PodContinuousDriver:
                     break
                 staged, self._staged = self._staged, []
                 cancels, self._cancels = self._cancels, set()
+                self._inflight = len(staged)
             try:
                 self._tick(staged, sorted(cancels))
             except BaseException as e:  # noqa: BLE001
                 logger.exception("pod continuous driver died")
+                if not self._workers_down:
+                    # Wake workers parked in their header broadcast so they
+                    # exit instead of hanging forever. Skipped after a
+                    # status divergence (the workers already shut down — a
+                    # collective with absent participants would hang US).
+                    try:
+                        _broadcast(np.asarray(
+                            [_SHUTDOWN, 0, 0, 0, 0, 0, 0, 0], np.int32
+                        ))
+                    except Exception:
+                        logger.exception("shutdown broadcast failed")
                 with self._cond:
                     self._error = e
                     self._stop = True
@@ -430,13 +453,22 @@ class PodContinuousDriver:
             self._cond.notify_all()
 
     def _tick(self, staged, cancels) -> None:
-        metas, all_ids = [], []
-        for (prompt, max_new, temp, top_p, seed, _t) in staged:
-            metas.append([len(prompt), max_new, _f2i(temp), _f2i(top_p), seed])
-            all_ids.extend(prompt)
-        meta = np.asarray(metas, np.int32).reshape(len(staged), 5)
-        ids = np.asarray(all_ids, np.int32)
-        cc = np.asarray(cancels, np.int32)
+        try:
+            metas, all_ids = [], []
+            for (prompt, max_new, temp, top_p, seed, _t) in staged:
+                metas.append([len(prompt), max_new, _f2i(temp), _f2i(top_p), seed])
+                all_ids.extend(prompt)
+            meta = np.asarray(metas, np.int32).reshape(len(staged), 5)
+            ids = np.asarray(all_ids, np.int32)
+            cc = np.asarray(cancels, np.int32)
+        except Exception as e:
+            # Packing failed before anything was broadcast: fail this batch
+            # only — the pod never saw the tick, so serving continues.
+            with self._cond:
+                self._inflight = 0
+                for (*_, ticket) in staged:
+                    ticket.fail(e)
+            return
         header = np.asarray(
             [_CTICK, len(staged), len(all_ids), len(cc), 0, 0, 0, 0], np.int32
         )
@@ -457,16 +489,21 @@ class PodContinuousDriver:
             ok = False
             err = e
         if not _statuses_agree(ok):
+            self._workers_down = True
             raise RuntimeError(
                 "pod tick status diverged across processes (workers have "
                 "shut down)"
             )
         with self._cond:
+            self._inflight = 0
             if not ok:
                 for (*_, ticket) in staged:
                     ticket.fail(err)
                 return
             for (_, _, _, _, _, ticket), rid in zip(staged, rids):
+                if isinstance(rid, BaseException):
+                    ticket.fail(rid)  # deterministic per-request rejection
+                    continue
                 ticket.req_id = rid
                 self._tickets[rid] = ticket
             for req in self._engine.take_finished():
@@ -483,14 +520,24 @@ class PodContinuousDriver:
 
         gen = self._engine.gen
         ticket = _Ticket(stream)
+        prompt = list(prompt_tokens) or [self.tokenizer.bos_id]
+        max_new = (max_new_tokens if max_new_tokens is not None
+                   else gen.max_new_tokens)
+        # Validate on the HTTP thread: a bad request must fail HERE, not
+        # inside the broadcast tick it would share with innocent requests.
+        self._engine.validate_request(prompt, max_new)
+        if seed is not None and not (-2**31 <= int(seed) < 2**31):
+            raise ValueError("seed must fit in int32")
+        if not (0 < max_new < 2**31):
+            raise ValueError("max_tokens out of range")
         with self._cond:
             if self._stop:
                 raise RuntimeError("pod serving stopped") from self._error
             if self.queue_full:
                 raise QueueFullError("admission queue full (pod)")
             self._staged.append((
-                list(prompt_tokens) or [self.tokenizer.bos_id],
-                max_new_tokens if max_new_tokens is not None else gen.max_new_tokens,
+                prompt,
+                max_new,
                 gen.temperature if temperature is None else float(temperature),
                 gen.top_p if top_p is None else float(top_p),
                 int(seed) if seed is not None else
@@ -539,7 +586,10 @@ class PodContinuousDriver:
                     return
                 yield chunk
         finally:
-            if ticket.req_id is not None:
+            # Cancel only abandoned/failed streams: a cleanly finished
+            # request was already removed by take_finished, and a dead
+            # cancel would cost one pointless pod-wide broadcast tick.
+            if ticket.req_id is not None and not ticket.done.is_set():
                 self.cancel(ticket.req_id)
 
     def cancel(self, req_id: int) -> None:

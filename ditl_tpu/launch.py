@@ -42,6 +42,13 @@ def build_config(argv: list[str] | None = None) -> Config:
     parser.add_argument("--process-id", type=int, default=None)
     parser.add_argument("--print-config", action="store_true")
     parser.add_argument(
+        "--supervise", action="store_true",
+        help="run training in a child PROCESS and restart it (up to "
+        "train.max_restarts) on any death — including SIGKILL/host-crash "
+        "class failures the in-process supervisor cannot catch; each "
+        "restart resumes from the latest Orbax checkpoint",
+    )
+    parser.add_argument(
         "overrides", nargs="*", help="config overrides like train.total_steps=50"
     )
     args = parser.parse_args(argv)
@@ -111,7 +118,45 @@ def run_supervised(config: Config) -> dict:
             )
 
 
+def run_process_supervised(argv: list[str]) -> int:
+    """Process-level restart supervisor: spawn the launcher as a child
+    process and restart it when it dies abnormally — the recovery story for
+    SIGKILL/OOM/host-crash failures that never reach a Python except block
+    (``run_supervised`` handles only in-process exceptions). Resumption
+    correctness comes from the same Orbax checkpoint + data-iterator
+    position the in-process path uses."""
+    import logging
+    import subprocess
+
+    logger = logging.getLogger(__name__)
+    child_argv = [a for a in argv if a != "--supervise"]
+    config = build_config(child_argv)
+    can_resume = bool(config.train.checkpoint_dir and config.train.resume)
+    restarts = 0
+    while True:
+        rc = subprocess.call(
+            [sys.executable, "-m", "ditl_tpu.launch", *child_argv]
+        )
+        if rc == 0:
+            return 0
+        if restarts >= config.train.max_restarts or not can_resume:
+            logger.error(
+                "training process exited rc=%d; giving up (%d restarts used, "
+                "resume %s)", rc, restarts, "on" if can_resume else "off",
+            )
+            return rc
+        restarts += 1
+        logger.error(
+            "training process exited rc=%d; restart %d/%d from latest "
+            "checkpoint", rc, restarts, config.train.max_restarts,
+        )
+
+
 def main(argv: list[str] | None = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if "--supervise" in argv:
+        return run_process_supervised(argv)
     config = build_config(argv)
     try:
         summary = run_supervised(config)

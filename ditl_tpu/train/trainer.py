@@ -217,6 +217,11 @@ def train(config: Config) -> dict[str, Any]:
             if restored is not None:
                 state, data_iter = restored
                 resumed = True
+                logger.info(
+                    "restored checkpoint: resuming from step %d "
+                    "(epoch %d, batch offset %d)",
+                    int(state.step), data_iter.epoch, data_iter.step_in_epoch,
+                )
 
     if config.train.init_from_hf and not resumed:
         # Overwrite the random base weights with a converted HF checkpoint
@@ -351,6 +356,22 @@ def train(config: Config) -> dict[str, Any]:
                         [dataset[int(i)]["label"] for i in idx],
                         max_samples=config.train.eval_samples,
                     )
+                if (
+                    config.train.fault_kill_step > 0
+                    and not resumed
+                    and global_step >= config.train.fault_kill_step
+                ):
+                    # SIGKILL drill (host-crash simulation): bypasses every
+                    # Python-level handler, so only a process-level
+                    # supervisor (launch --supervise) can bring us back.
+                    import os as _os
+                    import signal as _signal
+
+                    logger.error(
+                        "fault_kill_step: SIGKILLing self at step %d",
+                        global_step,
+                    )
+                    _os.kill(_os.getpid(), _signal.SIGKILL)
                 if (
                     config.train.fault_inject_step > 0
                     and not resumed

@@ -166,6 +166,7 @@ _SLOW_TESTS = {
     "tests/test_recovery.py::test_no_restart_when_resume_disabled",
     "tests/test_recovery.py::test_no_restart_without_checkpointing",
     "tests/test_recovery.py::test_restart_budget_exhausted",
+    "tests/test_recovery.py::test_sigkill_drill_process_supervisor_resumes",
     "tests/test_recovery.py::test_supervisor_recovers_from_injected_fault",
     "tests/test_ring_attention.py::test_grads_flow_through_ring",
     "tests/test_ring_attention.py::test_matches_full_attention[True]",

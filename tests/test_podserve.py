@@ -283,3 +283,32 @@ def test_server_continuous_via_pod(tiny_setup):
     finally:
         driver.close()
         server.shutdown()
+
+
+def test_pod_continuous_bad_request_isolated(cont_engine):
+    """An invalid request (oversize, out-of-range seed) fails on its own
+    HTTP thread at stage time; a concurrent valid request is unaffected and
+    the driver keeps serving."""
+    import threading as _threading
+
+    from ditl_tpu.infer.podserve import PodContinuousDriver
+
+    driver = PodContinuousDriver(cont_engine())
+    try:
+        good: dict = {}
+        t = _threading.Thread(
+            target=lambda: good.setdefault(
+                "r", driver.generate_one([1] + list(range(5, 20)))
+            )
+        )
+        t.start()
+        with pytest.raises(ValueError, match="exceeds"):
+            driver.generate_one([1] * 200, max_new_tokens=50)
+        with pytest.raises(ValueError, match="seed"):
+            driver.generate_one([1, 2, 3], seed=2**31)
+        t.join(timeout=300)
+        assert not t.is_alive() and len(good["r"]) > 0
+        # driver still alive after the rejections
+        assert len(driver.generate_one([1, 2, 3])) > 0
+    finally:
+        driver.close()
