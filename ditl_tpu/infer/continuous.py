@@ -114,10 +114,11 @@ class ContinuousEngine:
         ``cache_mode="paged"`` replaces the contiguous per-slot cache with a
         shared page pool (``n_pages`` pages of ``page_size`` tokens;
         default sized to the contiguous capacity ``n_slots x smax``).
-        ``page_size`` trades decode speed against sharing granularity: 256
-        decodes at parity with the contiguous cache on v5e (the Pallas
-        kernel is page-DMA-bound; 128 costs ~7%, 64 ~20%), while smaller
-        pages dedup shorter prefixes and waste less tail padding.
+        ``page_size`` trades decode speed against sharing granularity: at
+        256 (default) paged decode is ~1.5x FASTER than the contiguous
+        cache on v5e (the kernel reads only live pages and defers page
+        writes to one per-tick flush); 128 costs ~16% over 256, 64 ~40% —
+        smaller pages dedup shorter prefixes and waste less tail padding.
         Capacity is then bounded by total resident tokens, not
         ``n_slots x max_context``; every FULL prompt page is content-hashed
         and automatically reused by later prompts sharing the prefix —
@@ -455,44 +456,52 @@ class ContinuousEngine:
         return jax.jit(run, donate_argnums=(1, 2))
 
     def _build_paged_decode(self, sampled: bool, topp: bool):
-        """Paged decode tick: same chunked scan as the contiguous program,
-        but K/V live in the page pool, reached through the page table
-        (ops/paged_attention.py). ``limits`` ends a row exactly at its token
-        budget, so writes never run past the pages reserved at admission."""
+        """Paged decode tick with DEFERRED page writes: the chunk's K/V
+        accumulate in small per-layer tail buffers carried through the scan
+        (the kernel reads pages + tail; per-token writes into the pooled
+        buffers inside the scan cost ~7 ms/step on v5e), then ONE scatter
+        per pool flushes the tail after the scan. ``limits`` ends a row
+        exactly at its token budget, so flushed positions never pass the
+        pages reserved at admission."""
         cfg, ps = self.cfg, self.page_size
         pad, eos = self.tokenizer.pad_id, self.tokenizer.eos_id
         chunk = self.decode_chunk
+        tail_len = max(chunk, 8)  # Mosaic sublane floor for the tail block
+        L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+        dt = jnp.dtype(cfg.dtype)
 
         def run(params, kp, vp, cur, pos, alive, temps, top_ps, keys, table,
                 limits):
-            b_iota = jnp.arange(pos.shape[0], dtype=jnp.int32)
+            n_b = pos.shape[0]
+            b_iota = jnp.arange(n_b, dtype=jnp.int32)
+            # starts = pos (not where(alive, pos, 0)): dead rows then have
+            # pos - starts == 0 live tail columns, so the flush writes
+            # nothing for them regardless of table-row state — no reliance
+            # on freed slots having zeroed rows.
+            starts = pos
+            tk0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
+            tv0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
 
-            def body(carry, _):
-                kp, vp, cur, pos, done, keys = carry
+            def body(carry, t):
+                tk, tv, cur, pos, done, keys = carry
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
                 done = done | (pos >= limits)
                 step_alive = ~done
                 lengths = jnp.where(step_alive, pos + 1, 0)
-                pidx = jnp.take_along_axis(table, (pos // ps)[:, None], 1)[:, 0]
-                # Dead rows redirect their write to sentinel page 0 (per-row
-                # distinct offsets); liveness is fully encoded in pid/off/
-                # lengths — the layer needs no separate flag.
                 paged_meta = {
-                    "table": table,
-                    "pid": jnp.where(step_alive, pidx, 0),
-                    "off": jnp.where(step_alive, pos % ps, b_iota % ps),
-                    "lengths": lengths,
+                    "table": table, "lengths": lengths, "starts": starts,
+                    "t": t,
                 }
-                logits, cache = llama.forward(
+                logits, tails = llama.forward(
                     params,
                     cur[:, None],
                     cfg,
                     positions=pos[:, None],
-                    cache={"kp": kp, "vp": vp},
+                    cache={"kp": kp, "vp": vp, "tk": tk, "tv": tv},
                     paged=paged_meta,
                 )
-                kp, vp = cache["kp"], cache["vp"]
+                tk, tv = tails["tk"], tails["tv"]
                 nxt = sample_logits(
                     logits[:, 0], subs,
                     temperature=temps if sampled else 0.0,
@@ -503,12 +512,38 @@ class ContinuousEngine:
                 done = done | (cur == eos)
                 pos = jnp.where(step_alive, pos + 1, pos)
                 cur = jnp.where(done, pad, nxt)
-                return (kp, vp, cur, pos, done, keys), emit
+                return (tk, tv, cur, pos, done, keys), emit
 
-            (kp, vp, cur, pos, done, keys), toks = jax.lax.scan(
-                body, (kp, vp, cur, pos, ~alive, keys), None, length=chunk
+            (tk, tv, cur, pos, done, keys), toks = jax.lax.scan(
+                body, (tk0, tv0, cur, pos, ~alive, keys),
+                jnp.arange(chunk, dtype=jnp.int32),
             )
-            return kp, vp, cur, pos, keys, toks.T
+
+            # Flush: scatter the tail's written columns into their pages —
+            # one scatter per pool per tick (amortized over the chunk).
+            # Invalid columns (beyond what the row decoded) and dead rows
+            # aim at sentinel page 0, whose content is never read unmasked.
+            j = jnp.arange(tail_len, dtype=jnp.int32)
+            gpos = starts[:, None] + j[None, :]  # (B, tail_len)
+            valid = j[None, :] < (pos - starts)[:, None]
+            pidx = jnp.take_along_axis(
+                table, jnp.clip(gpos // ps, 0, table.shape[1] - 1), axis=1
+            )
+            pid = jnp.where(valid, pidx, 0).reshape(-1)
+            off = jnp.where(
+                valid, gpos % ps,
+                (b_iota[:, None] * tail_len + j[None, :]) % ps,
+            ).reshape(-1)
+
+            def flush(pool, tail):
+                # tail (L, B, K, T, D) -> (B*T, L, K, D); advanced indices
+                # on pool dims 1 and 3 put the scatter dim first.
+                vals = jnp.transpose(tail, (1, 3, 0, 2, 4)).reshape(
+                    n_b * tail_len, L, K, D
+                )
+                return pool.at[:, pid, :, off].set(vals.astype(pool.dtype))
+
+            return flush(kp, tk), flush(vp, tv), cur, pos, keys, toks.T
 
         return jax.jit(run, donate_argnums=(1, 2))
 

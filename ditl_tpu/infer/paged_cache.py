@@ -23,9 +23,10 @@ This module is the host bookkeeping around that pool:
 - **LRU eviction**: published pages whose only reference is the hash cache
   are reclaimable; allocation pressure evicts them oldest-first.
 
-Page 0 is a reserved sentinel: dead slots' table tails point at it and dead
-decode rows write their no-op writes into it, so live writes can never
-collide with a stale table entry (ops/paged_attention.write_page_tokens).
+Page 0 is a reserved sentinel: dead slots' table tails point at it, the
+kernel's out-of-range page fetches clamp to it, and the per-tick tail
+flush aims its invalid rows at it — so live data can never collide with a
+stale table entry.
 
 The allocator is plain Python on the host — admission policy is not a TPU
 problem (same stance as the continuous engine's scheduler).

@@ -260,13 +260,14 @@ def _decoder_layer(
     ``cache_index`` and attention runs against the whole cache under
     ``attn_mask`` — the KV-cache prefill/decode path (infer/engine.py).
 
-    When ``layer_cache`` holds page pools (``{"kp", "vp"}``, each
-    (n_pages, K, page_size, D) — kv-heads before page slots, the Mosaic
-    trailing-dim layout of ops/paged_attention.py), ``paged`` carries the
-    tick metadata — ``table`` (B, maxp), write ``pid``/``off`` (B,) and
-    ``lengths`` (B,) — and this is the single-token paged decode step
-    (ops/paged_attention.py): the token's K/V rows are scattered into the
-    pools and attention runs through the page table."""
+    When ``layer_cache`` holds page pools + tail buffers (``{"kp", "vp",
+    "tk", "tv"}``; pools (n_pages, K, page_size, D) — kv-heads before page
+    slots, the Mosaic trailing-dim layout of ops/paged_attention.py; tails
+    (B, K, T, D)), ``paged`` carries the tick metadata — ``table``
+    (B, maxp), ``starts``/``lengths`` (B,) and the scan column ``t`` — and
+    this is the single-token paged decode step: the token's K/V land in
+    the tail buffer (returned as this layer's new_kv; the pools are NOT
+    re-emitted) and attention runs through the page table plus the tail."""
     b, s, d = x.shape
     nh, nkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     cd = _dtype(cfg.dtype)
@@ -294,21 +295,27 @@ def _decoder_layer(
     k = _constrain(k, ("batch", "seq", "act_kv_heads", "head_dim"), mesh, rules)
     new_kv = None
     if layer_cache is not None and "kp" in layer_cache:
-        from ditl_tpu.ops.paged_attention import paged_attention, write_page_tokens
+        from ditl_tpu.ops.paged_attention import paged_attention
 
         if s != 1:
             raise ValueError(f"paged decode takes one token per slot, got S={s}")
-        new_kv = {
-            "kp": write_page_tokens(
-                layer_cache["kp"], k[:, 0], paged["pid"], paged["off"]
-            ),
-            "vp": write_page_tokens(
-                layer_cache["vp"], v[:, 0], paged["pid"], paged["off"]
-            ),
-        }
+        # Deferred flush: the token's K/V go into the tick's small TAIL
+        # buffer (per-token writes into the big page pool inside the decode
+        # scan cost ~7 ms/step on v5e); the kernel reads pages + tail, and
+        # the engine flushes the tail into pages once per tick.
+        tdt = layer_cache["tk"].dtype
+        k_tok = jnp.swapaxes(k, 1, 2).astype(tdt)  # (B, K, 1, D)
+        v_tok = jnp.swapaxes(v, 1, 2).astype(tdt)
+        tk = jax.lax.dynamic_update_slice(
+            layer_cache["tk"], k_tok, (0, 0, paged["t"], 0)
+        )
+        tv = jax.lax.dynamic_update_slice(
+            layer_cache["tv"], v_tok, (0, 0, paged["t"], 0)
+        )
+        new_kv = {"tk": tk, "tv": tv}
         attn_out = paged_attention(
-            q[:, 0], new_kv["kp"], new_kv["vp"], paged["table"],
-            paged["lengths"],
+            q[:, 0], layer_cache["kp"], layer_cache["vp"], paged["table"],
+            paged["lengths"], tail_k=tk, tail_v=tv, starts=paged["starts"],
         )[:, None]
     elif layer_cache is not None:
         from ditl_tpu.infer.cache import read_kv, write_kv

@@ -42,7 +42,7 @@ from jax.experimental.pallas import tpu as pltpu
 from ditl_tpu.ops.attention import NEG_INF
 from ditl_tpu.ops.flash_attention import NUM_LANES, _lane_tile
 
-__all__ = ["paged_attention", "paged_attention_xla", "write_page_tokens"]
+__all__ = ["paged_attention", "paged_attention_xla"]
 
 
 def paged_attention_xla(
@@ -51,25 +51,88 @@ def paged_attention_xla(
     v_pages: jax.Array,
     page_table: jax.Array,  # (B, maxp) int32
     lengths: jax.Array,  # (B,) int32
+    tail_k: jax.Array | None = None,  # (B, K, T, D)
+    tail_v: jax.Array | None = None,
+    starts: jax.Array | None = None,  # (B,) — tokens resident in pages
 ) -> jax.Array:
-    """Gather-based reference: correctness oracle + CPU fallback."""
+    """Gather-based reference: correctness oracle + CPU fallback.
+
+    With a tail (the deferred-flush decode path), tokens [0, starts) live
+    in pages and [starts, lengths) in the tail buffer at columns
+    [0, lengths - starts)."""
     b, h, d = q.shape
     _, kv_heads, ps, _ = k_pages.shape
     maxp = page_table.shape[1]
     groups = h // kv_heads
     k = jnp.swapaxes(k_pages[page_table], 2, 3).reshape(b, maxp * ps, kv_heads, d)
     v = jnp.swapaxes(v_pages[page_table], 2, 3).reshape(b, maxp * ps, kv_heads, d)
+    page_limit = lengths if starts is None else jnp.minimum(starts, lengths)
+    valid = jnp.arange(maxp * ps, dtype=jnp.int32)[None, :] < page_limit[:, None]
+    if tail_k is not None:
+        t = tail_k.shape[2]
+        k = jnp.concatenate([k, jnp.swapaxes(tail_k, 1, 2)], axis=1)
+        v = jnp.concatenate([v, jnp.swapaxes(tail_v, 1, 2)], axis=1)
+        tail_valid = (
+            starts[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+            < lengths[:, None]
+        )
+        valid = jnp.concatenate([valid, tail_valid], axis=1)
     qg = q.reshape(b, kv_heads, groups, d)
     scores = jnp.einsum(
         "bkgd,bskd->bkgs", qg, k, preferred_element_type=jnp.float32
     ) * (d**-0.5)
-    valid = jnp.arange(maxp * ps, dtype=jnp.int32)[None, :] < lengths[:, None]
     scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     # Dead slots (length 0) have an all-masked row; emit zeros, not NaN.
     probs = jnp.where(lengths[:, None, None, None] > 0, probs, 0.0)
     out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v.dtype), v)
     return out.reshape(b, h, d)
+
+
+def _accumulate_block(
+    q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
+    scale, base, width, limit,
+):
+    """Online-softmax accumulation of one (all-kv-heads) KV block whose
+    columns are global positions [base, base+width), masked to < limit."""
+    kv_heads, groups = q_ref.shape[1], q_ref.shape[2]
+    d = acc_scr.shape[-1]
+    tile = _lane_tile  # shared lane-replication helper (ops/flash_attention)
+    cols = base + jax.lax.broadcasted_iota(jnp.int32, (groups, width), 1)
+    col_mask = cols < limit
+    for kh in range(kv_heads):
+        q = q_ref[0, kh].astype(jnp.float32) * scale  # (G, D)
+        k = k_ref[0, kh].astype(jnp.float32)  # (width, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (G, width)
+        s = jnp.where(col_mask, s, NEG_INF)
+        rows = slice(kh * groups, (kh + 1) * groups)
+        m_prev = m_scr[rows]  # (G, NUM_LANES) lane-replicated
+        l_prev = l_scr[rows]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        ptab = jnp.exp(s - tile(m_next, width))
+        l_scr[rows] = alpha * l_prev + jnp.sum(ptab, axis=1, keepdims=True)
+        m_scr[rows] = m_next
+        v = v_ref[0, kh]  # (width, D)
+        pv = jax.lax.dot_general(
+            ptab.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (G, D)
+        acc_scr[rows] = acc_scr[rows] * tile(alpha, d) + pv
+
+
+def _finalize_out(o_ref, m_scr, l_scr, acc_scr):
+    kv_heads, groups = o_ref.shape[1], o_ref.shape[2]
+    d = acc_scr.shape[-1]
+    for kh in range(kv_heads):
+        rows = slice(kh * groups, (kh + 1) * groups)
+        l = l_scr[rows]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, kh] = (acc_scr[rows] / _lane_tile(l_safe, d)).astype(o_ref.dtype)
 
 
 def _paged_kernel(
@@ -103,47 +166,72 @@ def _paged_kernel(
 
     length = lengths_ref[b]
     base = p * page_size
-    kv_heads, groups = q_ref.shape[1], q_ref.shape[2]
-    d = acc_scr.shape[-1]
-    tile = _lane_tile  # shared lane-replication helper (ops/flash_attention)
 
     @pl.when(base < length)
     def _compute():
-        cols = base + jax.lax.broadcasted_iota(
-            jnp.int32, (groups, page_size), 1
+        _accumulate_block(
+            q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+            scale=scale, base=base, width=page_size, limit=length,
         )
-        col_mask = cols < length
-        for kh in range(kv_heads):
-            q = q_ref[0, kh].astype(jnp.float32) * scale  # (G, D)
-            k = k_ref[0, kh].astype(jnp.float32)  # (ps, D)
-            s = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # (G, ps)
-            s = jnp.where(col_mask, s, NEG_INF)
-            rows = slice(kh * groups, (kh + 1) * groups)
-            m_prev = m_scr[rows]  # (G, NUM_LANES) lane-replicated
-            l_prev = l_scr[rows]
-            m_cur = jnp.max(s, axis=1, keepdims=True)
-            m_next = jnp.maximum(m_prev, m_cur)
-            alpha = jnp.exp(m_prev - m_next)
-            ptab = jnp.exp(s - tile(m_next, page_size))
-            l_scr[rows] = alpha * l_prev + jnp.sum(ptab, axis=1, keepdims=True)
-            m_scr[rows] = m_next
-            v = v_ref[0, kh]  # (ps, D)
-            pv = jax.lax.dot_general(
-                ptab.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )  # (G, D)
-            acc_scr[rows] = acc_scr[rows] * tile(alpha, d) + pv
 
     @pl.when(p == n_pages - 1)
     def _finalize():
-        for kh in range(kv_heads):
-            rows = slice(kh * groups, (kh + 1) * groups)
-            l = l_scr[rows]
-            l_safe = jnp.where(l == 0.0, 1.0, l)
-            o_ref[0, kh] = (acc_scr[rows] / tile(l_safe, d)).astype(o_ref.dtype)
+        _finalize_out(o_ref, m_scr, l_scr, acc_scr)
+
+
+def _paged_tail_kernel(
+    table_ref,  # scalar prefetch: (B, maxp) int32
+    lengths_ref,  # scalar prefetch: (B,) int32
+    starts_ref,  # scalar prefetch: (B,) int32 — tokens resident in pages
+    q_ref,  # (1, K, G, D)
+    k_ref,  # (1, K, ps, D)
+    v_ref,
+    tk_ref,  # (1, K, T, D) — this tick's unflushed tokens
+    tv_ref,
+    o_ref,  # (1, K, G, D)
+    m_scr,
+    l_scr,
+    acc_scr,
+    *,
+    scale: float,
+    page_size: int,
+    n_pages: int,
+):
+    """Deferred-flush variant: grid (B, maxp + 1). Steps p < maxp consume
+    flushed pages (positions < starts[b]); the final step consumes the hot
+    TAIL block — the current decode chunk\'s KV, held in a small contiguous
+    buffer until the per-tick flush (positions [starts, lengths))."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+    start = starts_ref[b]
+    page_limit = jnp.minimum(start, length)
+    base = p * page_size
+
+    @pl.when((p < n_pages) & (base < page_limit))
+    def _pages():
+        _accumulate_block(
+            q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+            scale=scale, base=base, width=page_size, limit=page_limit,
+        )
+
+    @pl.when((p == n_pages) & (length > start))
+    def _tail():
+        _accumulate_block(
+            q_ref, tk_ref, tv_ref, m_scr, l_scr, acc_scr,
+            scale=scale, base=start, width=tk_ref.shape[2], limit=length,
+        )
+
+    @pl.when(p == n_pages)
+    def _finalize():
+        _finalize_out(o_ref, m_scr, l_scr, acc_scr)
 
 
 def paged_attention(
@@ -153,9 +241,17 @@ def paged_attention(
     page_table: jax.Array,  # (B, maxp) int32
     lengths: jax.Array,  # (B,) int32
     *,
+    tail_k: jax.Array | None = None,  # (B, K, T, D) — unflushed chunk KV
+    tail_v: jax.Array | None = None,
+    starts: jax.Array | None = None,  # (B,) tokens resident in pages
     interpret: bool | None = None,
 ) -> jax.Array:
-    """Pallas paged GQA decode attention (see module docstring)."""
+    """Pallas paged GQA decode attention (see module docstring).
+
+    With ``tail_k/tail_v/starts`` (the deferred-flush decode path), the
+    grid gains one final step that accumulates the hot tail block —
+    positions [starts, lengths) held in a small contiguous buffer — so
+    per-token page writes never happen inside the decode scan."""
     b, h, d = q.shape
     n_pool, kv_heads, ps, _ = k_pages.shape
     maxp = page_table.shape[1]
@@ -167,18 +263,61 @@ def paged_attention(
 
     # (B, K, G, D): one grid step's q block is ALL kv heads of one slot.
     qg = q.reshape(b, kv_heads, groups, d)
-
-    grid = (b, maxp)
-    kernel = functools.partial(
-        _paged_kernel, scale=d**-0.5, page_size=ps, n_pages=maxp
-    )
     g_rows = max(kv_heads * groups, 8)  # scratch sublane floor
+    has_tail = tail_k is not None
+    scratch = [
+        pltpu.VMEM((g_rows, NUM_LANES), jnp.float32),  # m
+        pltpu.VMEM((g_rows, NUM_LANES), jnp.float32),  # l
+        pltpu.VMEM((g_rows, d), jnp.float32),  # acc
+    ]
+    out_shape = jax.ShapeDtypeStruct((b, kv_heads, groups, d), q.dtype)
+    compiler_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "arbitrary")
+    )
+
+    if has_tail:
+        # Page fetches clamp to pages holding FLUSHED tokens (< starts) and
+        # redirect everything else to sentinel page 0 (Mosaic's revisit
+        # optimization skips the duplicate fetch); the final grid step
+        # consumes the tail block instead of a page.
+        def page_map(ib, ip, tab, lens, st):
+            pi = jnp.minimum(ip, maxp - 1)
+            live = (ip < maxp) & (pi * ps < jnp.minimum(st[ib], lens[ib]))
+            return jnp.where(live, tab[ib, pi], 0), 0, 0, 0
+
+        def slot_map(ib, ip, tab, lens, st):
+            return (ib, 0, 0, 0)
+
+        out = pl.pallas_call(
+            functools.partial(
+                _paged_tail_kernel, scale=d**-0.5, page_size=ps, n_pages=maxp
+            ),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,
+                grid=(b, maxp + 1),
+                in_specs=[
+                    pl.BlockSpec((1, kv_heads, groups, d), slot_map),
+                    pl.BlockSpec((1, kv_heads, ps, d), page_map),
+                    pl.BlockSpec((1, kv_heads, ps, d), page_map),
+                    pl.BlockSpec((1, kv_heads, tail_k.shape[2], d), slot_map),
+                    pl.BlockSpec((1, kv_heads, tail_k.shape[2], d), slot_map),
+                ],
+                out_specs=pl.BlockSpec((1, kv_heads, groups, d), slot_map),
+                scratch_shapes=scratch,
+            ),
+            out_shape=out_shape,
+            compiler_params=compiler_params,
+            interpret=interpret,
+        )(page_table, lengths, starts, qg, k_pages, v_pages, tail_k, tail_v)
+        return out.reshape(b, h, d)
 
     out = pl.pallas_call(
-        kernel,
+        functools.partial(
+            _paged_kernel, scale=d**-0.5, page_size=ps, n_pages=maxp
+        ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=grid,
+            grid=(b, maxp),
             in_specs=[
                 pl.BlockSpec(
                     (1, kv_heads, groups, d), lambda ib, ip, tab, lens: (ib, 0, 0, 0)
@@ -204,39 +343,10 @@ def paged_attention(
             out_specs=pl.BlockSpec(
                 (1, kv_heads, groups, d), lambda ib, ip, tab, lens: (ib, 0, 0, 0)
             ),
-            scratch_shapes=[
-                pltpu.VMEM((g_rows, NUM_LANES), jnp.float32),  # m
-                pltpu.VMEM((g_rows, NUM_LANES), jnp.float32),  # l
-                pltpu.VMEM((g_rows, d), jnp.float32),  # acc
-            ],
+            scratch_shapes=scratch,
         ),
-        out_shape=jax.ShapeDtypeStruct((b, kv_heads, groups, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
-        ),
+        out_shape=out_shape,
+        compiler_params=compiler_params,
         interpret=interpret,
     )(page_table, lengths, qg, k_pages, v_pages)
     return out.reshape(b, h, d)
-
-
-def write_page_tokens(
-    pool: jax.Array,  # (P, K, ps, D)
-    new: jax.Array,  # (B, K, D) — one token per slot
-    page_ids: jax.Array,  # (B,) int32
-    offsets: jax.Array,  # (B,) int32
-) -> jax.Array:
-    """Write one decode step's K or V rows into the pool — EVERY row writes.
-
-    Callers redirect dead rows to the reserved sentinel page 0 (never
-    allocated, never read unmasked), so no old-value read or write masking
-    is needed. Implemented as an unrolled loop of single-row
-    ``dynamic_update_slice`` — an XLA batched scatter here costs ~2 ms/call
-    on v5e (serialized lowering) vs microseconds for B in-place row
-    updates on a donated buffer."""
-    b, kv_heads, d = new.shape
-    vals = new.astype(pool.dtype).reshape(b, 1, kv_heads, 1, d)
-    for i in range(b):
-        pool = jax.lax.dynamic_update_slice(
-            pool, vals[i], (page_ids[i], 0, offsets[i], 0)
-        )
-    return pool

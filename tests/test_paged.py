@@ -59,25 +59,6 @@ def test_paged_attention_matches_xla_reference(groups):
     assert np.all(np.asarray(out[0]) == 0), "dead slot must emit zeros"
 
 
-def test_write_page_tokens_rows_land_where_addressed():
-    from ditl_tpu.ops.paged_attention import write_page_tokens
-
-    rng = np.random.default_rng(1)
-    pool = jnp.asarray(rng.normal(size=(8, 2, 16, 8)), jnp.float32)  # (P,K,ps,D)
-    new = jnp.asarray(rng.normal(size=(3, 2, 8)), jnp.float32)
-    out = write_page_tokens(
-        pool, new,
-        jnp.asarray([0, 3, 5], jnp.int32), jnp.asarray([0, 2, 15], jnp.int32),
-    )
-    # every row writes — dead rows are redirected to sentinel page 0 by the
-    # caller, where garbage is fine (never read unmasked, never allocated)
-    assert np.allclose(np.asarray(out[0, :, 0]), np.asarray(new[0]))
-    assert np.allclose(np.asarray(out[3, :, 2]), np.asarray(new[1]))
-    assert np.allclose(np.asarray(out[5, :, 15]), np.asarray(new[2]))
-    # untouched rows keep their contents
-    assert np.allclose(np.asarray(out[3, :, 3]), np.asarray(pool[3, :, 3]))
-
-
 # -- allocator ----------------------------------------------------------------
 
 
@@ -334,3 +315,34 @@ def test_paged_register_prefix_survives_pool_pressure(tiny_setup):
     free0 = eng.allocator.n_free + eng.allocator.n_evictable
     eng.register_prefix([1] + list(range(5, 150)))  # needs more pages than 3
     assert eng.allocator.n_free + eng.allocator.n_evictable == free0
+
+
+def test_paged_attention_tail_variant_matches_reference():
+    """The deferred-flush kernel (pages + hot tail block) against the
+    extended XLA reference: dead slot, tail-only, page-aligned and
+    mid-page starts."""
+    from ditl_tpu.ops.paged_attention import paged_attention, paged_attention_xla
+
+    rng = np.random.default_rng(3)
+    kv_heads, d, ps, maxp, pool, tail = 4, 64, 16, 6, 32, 8
+    b, h = 4, 8
+    q = jnp.asarray(rng.normal(size=(b, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(pool, kv_heads, ps, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(pool, kv_heads, ps, d)), jnp.float32)
+    tk = jnp.asarray(rng.normal(size=(b, kv_heads, tail, d)), jnp.float32)
+    tv = jnp.asarray(rng.normal(size=(b, kv_heads, tail, d)), jnp.float32)
+    # dead; tail-only; page-aligned start + tail; mid-page start + tail
+    starts = np.asarray([0, 0, 32, 45], np.int32)
+    lengths = np.asarray([0, 5, 38, 50], np.int32)
+    table = np.zeros((b, maxp), np.int32)
+    pid = 1
+    for row in range(b):
+        for i in range(-(-int(starts[row]) // ps)):
+            table[row, i] = pid
+            pid += 1
+    args = (q, kp, vp, jnp.asarray(table), jnp.asarray(lengths))
+    kw = dict(tail_k=tk, tail_v=tv, starts=jnp.asarray(starts))
+    ref = paged_attention_xla(*args, **kw)
+    out = paged_attention(*args, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    assert np.all(np.asarray(out[0]) == 0)
