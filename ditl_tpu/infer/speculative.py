@@ -67,40 +67,47 @@ def _emit_rows(buf: jax.Array, chunk: jax.Array, idx: jax.Array, count: jax.Arra
     return jnp.where(in_chunk, gathered, buf)
 
 
-def lookup_draft(context: list[int], k: int, ngram: int) -> list[int]:
+def lookup_draft(context: list[int], k: int, ngram: int,
+                 min_ngram: int | None = None) -> list[int]:
     """Host reference implementation of prompt-lookup drafting (the device
     version below must match it — tests/test_speculative.py): find the most
     recent earlier occurrence of the trailing ``ngram`` of ``context`` and
     return the ``k`` tokens that followed it, 0-padded when no match or the
-    history runs out."""
+    history runs out. With ``min_ngram < ngram``, BACKS OFF to shorter
+    n-grams when the longer one has no earlier occurrence — a 1-gram floor
+    is a "most recent successor" bigram predictor, which keeps drafting on
+    merely statistically repetitive text where exact long n-grams are
+    rare."""
+    min_n = ngram if min_ngram is None else min_ngram
     n = len(context)
-    draft: list[int] = []
-    if n > ngram:
-        tail = context[n - ngram:]
-        fallback: list[int] | None = None
-        for start in range(n - ngram - 1, -1, -1):
-            if context[start:start + ngram] == tail:
-                follow = list(context[start + ngram: start + ngram + k])
-                if len(follow) == k:  # prefer a match with a full continuation
-                    draft = follow
-                    break
-                if fallback is None:
-                    fallback = follow
-        if not draft and fallback is not None:
-            draft = fallback
-    draft += [0] * (k - len(draft))
-    return draft[:k]
+    for level in range(ngram, min_n - 1, -1):
+        draft: list[int] = []
+        if n > level:
+            tail = context[n - level:]
+            fallback: list[int] | None = None
+            for start in range(n - level - 1, -1, -1):
+                if context[start:start + level] == tail:
+                    follow = list(context[start + level: start + level + k])
+                    if len(follow) == k:  # prefer a full continuation
+                        draft = follow
+                        break
+                    if fallback is None:
+                        fallback = follow
+            if not draft and fallback is not None:
+                draft = fallback
+        if draft:
+            return (draft + [0] * (k - len(draft)))[:k]
+    return [0] * k
 
 
-def device_lookup_draft(
+def _device_lookup_level(
     tokens: jax.Array,  # (B, T) token history buffer
     ctx_len: jax.Array,  # (B,) valid length per row
     *,
     k: int,
     ngram: int,
-) -> jax.Array:
-    """Vectorized on-device prompt-lookup: (B, k) drafts. O(T·ngram) compares
-    per row — VPU noise next to the verify forward."""
+) -> tuple[jax.Array, jax.Array]:
+    """One n-gram level of the device lookup: ((B, k) draft, (B,) found)."""
     b, t = tokens.shape
     # Trailing ngram per row: tokens[ctx_len-ngram : ctx_len].
     tail_idx = ctx_len[:, None] - ngram + jnp.arange(ngram)  # (B, ngram)
@@ -129,7 +136,30 @@ def device_lookup_draft(
     src = (best + ngram)[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
     draft = jnp.take_along_axis(tokens, jnp.clip(src, 0, t - 1), axis=1)
     in_ctx = src < ctx_len[:, None]
-    return jnp.where(found[:, None] & in_ctx, draft, 0).astype(jnp.int32)
+    return jnp.where(found[:, None] & in_ctx, draft, 0).astype(jnp.int32), found
+
+
+def device_lookup_draft(
+    tokens: jax.Array,  # (B, T) token history buffer
+    ctx_len: jax.Array,  # (B,) valid length per row
+    *,
+    k: int,
+    ngram: int,
+    min_ngram: int | None = None,
+) -> jax.Array:
+    """Vectorized on-device prompt-lookup with n-gram BACKOFF: per row, the
+    longest n-gram level (``ngram`` down to ``min_ngram``) with an earlier
+    occurrence supplies the draft. O(T·ngram·levels) compares per row — VPU
+    noise next to the verify forward. Matches ``lookup_draft``."""
+    min_n = ngram if min_ngram is None else min_ngram
+    draft = jnp.zeros((tokens.shape[0], k), jnp.int32)
+    taken = jnp.zeros((tokens.shape[0],), bool)
+    for level in range(ngram, min_n - 1, -1):
+        d, f = _device_lookup_level(tokens, ctx_len, k=k, ngram=level)
+        use = f & ~taken
+        draft = jnp.where(use[:, None], d, draft)
+        taken = taken | f
+    return draft
 
 
 class SpeculativeGenerator:
@@ -147,7 +177,8 @@ class SpeculativeGenerator:
         tokenizer: Tokenizer,
         *,
         k: int = 8,
-        ngram: int = 2,
+        ngram: int = 3,
+        min_ngram: int = 1,
         rounds_per_check: int = 8,
         mesh=None,
         rules=None,
@@ -156,6 +187,10 @@ class SpeculativeGenerator:
             raise ValueError(f"k must be >= 1, got {k}")
         if ngram < 1:
             raise ValueError(f"ngram must be >= 1, got {ngram}")
+        if not (1 <= min_ngram <= ngram):
+            raise ValueError(
+                f"min_ngram must be in [1, ngram], got {min_ngram}"
+            )
         if rounds_per_check < 1:
             raise ValueError(f"rounds_per_check must be >= 1, got {rounds_per_check}")
         self.rounds_per_check = rounds_per_check
@@ -164,6 +199,13 @@ class SpeculativeGenerator:
         self.tokenizer = tokenizer
         self.k = k
         self.ngram = ngram
+        self.min_ngram = min_ngram
+        # Per-ROW tokens per verify forward of the latest call (None before
+        # the first): the number that must clear the verify/decode step-cost
+        # ratio for speculation to win. Per-row, not batch-aggregate — plain
+        # decode also produces one token per row per forward, so the
+        # breakeven ratio is batch-size-independent.
+        self.last_acceptance: float | None = None
         self.mesh = mesh
         self.rules = rules
         self._compiled: dict = {}
@@ -172,6 +214,7 @@ class SpeculativeGenerator:
 
     def _build(self, batch: int, prompt_len: int, max_new: int):
         cfg, mesh, rules, k, ngram = self.cfg, self.mesh, self.rules, self.k, self.ngram
+        min_ngram = self.min_ngram
         rounds_per_check = max(1, min(self.rounds_per_check, max_new))
         max_len = prompt_len + max_new + k + 1  # KV slots incl. overshoot slack
         if max_len > cfg.max_seq_len:
@@ -243,7 +286,8 @@ class SpeculativeGenerator:
 
             def body(s):
                 draft = device_lookup_draft(
-                    s["tokens"], s["ctx_len"], k=k, ngram=ngram
+                    s["tokens"], s["ctx_len"], k=k, ngram=ngram,
+                    min_ngram=min_ngram,
                 )  # (B, k)
                 tokens_in = jnp.concatenate([s["cur"][:, None], draft], axis=1)
                 positions = s["pos"][:, None] + q_idx[None, :]
@@ -341,11 +385,14 @@ class SpeculativeGenerator:
         )
         out = np.asarray(jax.device_get(out))
         rounds = int(jax.device_get(rounds))
+        self.last_acceptance = None
         if rounds:
+            total = int(np.asarray(jax.device_get(n_out))[:n].sum())
+            self.last_acceptance = total / rounds / n
             logger.info(
-                "speculative decode: %d tokens in %d rounds (%.2f tokens/forward)",
-                int(np.asarray(jax.device_get(n_out))[:n].sum()), rounds,
-                float(np.asarray(jax.device_get(n_out))[:n].sum()) / rounds,
+                "speculative decode: %d tokens, %d rows, %d rounds "
+                "(%.2f tokens/forward/row)",
+                total, n, rounds, self.last_acceptance,
             )
         results = []
         for i in range(n):
@@ -358,10 +405,91 @@ class SpeculativeGenerator:
         return results
 
     def generate(self, prompts: list[str], max_new_tokens: int = 64) -> list[str]:
-        encoded = [
-            [self.tokenizer.bos_id] + self.tokenizer.encode(p) for p in prompts
-        ]
-        return [
-            self.tokenizer.decode(t)
-            for t in self.generate_tokens(encoded, max_new_tokens)
-        ]
+        return _generate_text(self, prompts, max_new_tokens)
+
+
+def _generate_text(gen, prompts: list[str], max_new_tokens: int) -> list[str]:
+    """Shared text round-trip (BOS + encode -> generate_tokens -> decode)."""
+    encoded = [
+        [gen.tokenizer.bos_id] + gen.tokenizer.encode(p) for p in prompts
+    ]
+    return [
+        gen.tokenizer.decode(t)
+        for t in gen.generate_tokens(encoded, max_new_tokens)
+    ]
+
+
+class AutoSpeculativeGenerator:
+    """Per-request speculation auto-enable driven by MEASURED acceptance.
+
+    Speculation pays only when accepted tokens per verify forward PER ROW
+    exceed the verify/decode step-cost ratio (~2-2.5x on v5e for the bench
+    model, BASELINE.md) — and acceptance is a property of the WORKLOAD (repetitive
+    continuations accept; high-entropy text does not). This wrapper serves
+    each request speculatively while the exponentially-averaged acceptance
+    clears ``threshold``, falls back to the plain lock-step ``Generator``
+    when it does not, and re-probes with a speculative request every
+    ``probe_every`` requests so a workload shift back to repetitive text is
+    re-detected. Greedy only (the speculative path's restriction)."""
+
+    def __init__(
+        self,
+        params: llama.Params,
+        model_cfg: ModelConfig,
+        tokenizer: Tokenizer,
+        *,
+        threshold: float = 2.5,
+        probe_every: int = 16,
+        ema: float = 0.7,
+        mesh=None,
+        rules=None,
+        **spec_kw,
+    ):
+        from ditl_tpu.infer.engine import Generator
+
+        if probe_every < 1:
+            raise ValueError(f"probe_every must be >= 1, got {probe_every}")
+        if not (0.0 <= ema < 1.0):
+            raise ValueError(f"ema must be in [0, 1), got {ema}")
+        self.spec = SpeculativeGenerator(
+            params, model_cfg, tokenizer, mesh=mesh, rules=rules, **spec_kw
+        )
+        self.plain = Generator(params, model_cfg, tokenizer, mesh=mesh, rules=rules)
+        self.tokenizer = tokenizer
+        self.threshold = threshold
+        self.probe_every = probe_every
+        self._ema_w = ema
+        self.acceptance_ema: float | None = None
+        self._n_requests = 0
+
+    @property
+    def speculating(self) -> bool:
+        """Would the next (non-probe) request use the speculative path?"""
+        return (
+            self.acceptance_ema is None
+            or self.acceptance_ema >= self.threshold
+        )
+
+    def generate_tokens(
+        self, token_lists: list[list[int]], max_new_tokens: int = 64
+    ) -> list[list[int]]:
+        probe = self._n_requests % self.probe_every == 0
+        self._n_requests += 1
+        if self.speculating or probe:
+            out = self.spec.generate_tokens(token_lists, max_new_tokens)
+            acc = self.spec.last_acceptance
+            if acc is not None:
+                self.acceptance_ema = (
+                    acc if self.acceptance_ema is None
+                    else self._ema_w * self.acceptance_ema
+                    + (1.0 - self._ema_w) * acc
+                )
+            return out
+        from ditl_tpu.infer.engine import GenerateConfig
+
+        return self.plain.generate_tokens(
+            token_lists, GenerateConfig(max_new_tokens=max_new_tokens)
+        )
+
+    def generate(self, prompts: list[str], max_new_tokens: int = 64) -> list[str]:
+        return _generate_text(self, prompts, max_new_tokens)

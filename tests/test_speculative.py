@@ -143,3 +143,81 @@ def test_int8_kv_cache_composes(tiny_setup_f32):
     gen = SpeculativeGenerator(params, qcfg, tok, k=4)
     out = gen.generate_tokens([[tok.bos_id] + tok.encode("abc abc abc")], 12)
     assert gen.generate_tokens([[tok.bos_id] + tok.encode("abc abc abc")], 12) == out
+
+
+def test_lookup_draft_backoff_to_shorter_ngram():
+    # no repeated 3-gram or 2-gram, but token 5 occurred before: the 1-gram
+    # backoff drafts its most recent successor run
+    context = [5, 9, 1, 2, 3, 4, 5]
+    assert lookup_draft(context, k=2, ngram=3, min_ngram=1) == [9, 1]
+    # without backoff: nothing
+    assert lookup_draft(context, k=2, ngram=3) == [0, 0]
+    # longer match wins over the 1-gram when both exist
+    context = [1, 2, 7, 8, 1, 2]
+    assert lookup_draft(context, k=1, ngram=2, min_ngram=1) == [7]
+
+
+def test_device_draft_backoff_matches_host():
+    import jax.numpy as jnp
+
+    from ditl_tpu.infer.speculative import device_lookup_draft
+
+    rng = np.random.default_rng(7)
+    b, t, k = 8, 48, 4
+    tokens = rng.integers(1, 6, size=(b, t)).astype(np.int32)  # tiny vocab
+    ctx_len = rng.integers(5, t, size=(b,)).astype(np.int32)
+    dev = np.asarray(device_lookup_draft(
+        jnp.asarray(tokens), jnp.asarray(ctx_len), k=k, ngram=3, min_ngram=1
+    ))
+    for i in range(b):
+        host = lookup_draft(tokens[i, : ctx_len[i]].tolist(), k, 3, min_ngram=1)
+        assert dev[i].tolist() == host, f"row {i}"
+
+
+def test_auto_speculative_switches_on_measured_acceptance(tiny_setup_f32):
+    from ditl_tpu.infer.speculative import AutoSpeculativeGenerator
+
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    auto = AutoSpeculativeGenerator(
+        params, cfg, tok, threshold=2.0, probe_every=4, ema=0.0, k=4,
+    )
+    calls = {"spec": 0, "plain": 0}
+    real_spec = auto.spec.generate_tokens
+    real_plain = auto.plain.generate_tokens
+
+    def spy_spec(*a, **kw):
+        calls["spec"] += 1
+        return real_spec(*a, **kw)
+
+    def spy_plain(*a, **kw):
+        calls["plain"] += 1
+        return real_plain(*a, **kw)
+
+    auto.spec.generate_tokens = spy_spec
+    auto.plain.generate_tokens = spy_plain
+
+    prompt = [tok.bos_id] + tok.encode("hello world")
+    out1 = auto.generate_tokens([prompt], max_new_tokens=8)
+    assert calls["spec"] == 1
+    assert auto.acceptance_ema is not None
+    # Force low measured acceptance deterministically (random-weight
+    # acceptance varies): the wrapper must fall back to the plain path.
+    auto.acceptance_ema = 0.5
+    auto.generate_tokens([prompt], max_new_tokens=8)  # request 1
+    auto.generate_tokens([prompt], max_new_tokens=8)  # request 2
+    auto.generate_tokens([prompt], max_new_tokens=8)  # request 3
+    assert calls["plain"] == 3
+    # request 4 probes speculatively (4 % probe_every == 0)
+    auto.generate_tokens([prompt], max_new_tokens=8)
+    assert calls["spec"] == 2
+    # outputs stay greedy-exact regardless of path
+    ref = Generator(params, cfg, tok).generate_tokens(
+        [prompt], GenerateConfig(max_new_tokens=8)
+    )
+    assert out1 == ref
+    # forced-high acceptance keeps speculation on
+    auto.acceptance_ema = 10.0
+    before = calls["spec"]
+    auto.generate_tokens([prompt], max_new_tokens=8)
+    assert calls["spec"] == before + 1
