@@ -50,7 +50,10 @@ from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-__all__ = ["SpeculativeGenerator", "lookup_draft", "device_lookup_draft"]
+__all__ = [
+    "AutoSpeculativeGenerator", "SpeculativeGenerator", "lookup_draft",
+    "device_lookup_draft",
+]
 
 
 def _emit_rows(buf: jax.Array, chunk: jax.Array, idx: jax.Array, count: jax.Array):
@@ -206,6 +209,7 @@ class SpeculativeGenerator:
         # decode also produces one token per row per forward, so the
         # breakeven ratio is batch-size-independent.
         self.last_acceptance: float | None = None
+        self.last_rounds: int = 0
         self.mesh = mesh
         self.rules = rules
         self._compiled: dict = {}
@@ -238,7 +242,7 @@ class SpeculativeGenerator:
                 cache, named_sharding_tree(mesh, cache_logical_axes(cfg), rules)
             )
 
-        def run(params, input_ids, lengths):
+        def run(params, input_ids, lengths, n_real):
             # ---- prefill ----
             cache = shard_cache(init_cache(cfg, batch, max_len))
             p_pos = jnp.arange(prompt_len, dtype=jnp.int32)
@@ -255,12 +259,16 @@ class SpeculativeGenerator:
                 jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0],
                 axis=-1,
             ).astype(jnp.int32)
+            # Pad rows (batch bucketing) start DONE: they would otherwise
+            # decode to the full budget, inflating the round count that the
+            # acceptance metric divides by.
+            is_pad_row = jnp.arange(batch, dtype=jnp.int32) >= n_real
 
             tokens_buf = jnp.zeros((batch, t_buf), jnp.int32)
             tokens_buf = jax.lax.dynamic_update_slice(
                 tokens_buf, input_ids, (0, 0)
             )
-            done0 = first == eos_id
+            done0 = (first == eos_id) | is_pad_row
             tokens_buf = tokens_buf.at[rows[:, 0], lengths].set(
                 jnp.where(done0, 0, first)
             )
@@ -333,7 +341,11 @@ class SpeculativeGenerator:
                 return dict(
                     cache=cache, tokens=tokens, out=out, cur=cur, pos=pos,
                     ctx_len=s["ctx_len"] + e, n_out=n_out, done=done,
-                    rounds=s["rounds"] + 1,
+                    # Count only rounds where some row was still live: the
+                    # chunked while-loop runs whole R-round chunks, and
+                    # phantom tail rounds would deflate measured acceptance.
+                    rounds=s["rounds"]
+                    + jnp.any(~s["done"]).astype(jnp.int32),
                 )
 
             # Chunked loop: R rounds per while iteration. A bare while_loop
@@ -381,10 +393,11 @@ class SpeculativeGenerator:
         if key not in self._compiled:
             self._compiled[key] = self._build(batch, prompt_len, max_new_tokens)
         out, rounds, n_out = self._compiled[key](
-            self.params, jnp.asarray(ids), jnp.asarray(lengths)
+            self.params, jnp.asarray(ids), jnp.asarray(lengths), jnp.int32(n)
         )
         out = np.asarray(jax.device_get(out))
         rounds = int(jax.device_get(rounds))
+        self.last_rounds = rounds
         self.last_acceptance = None
         if rounds:
             total = int(np.asarray(jax.device_get(n_out))[:n].sum())

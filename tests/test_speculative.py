@@ -221,3 +221,32 @@ def test_auto_speculative_switches_on_measured_acceptance(tiny_setup_f32):
     before = calls["spec"]
     auto.generate_tokens([prompt], max_new_tokens=8)
     assert calls["spec"] == before + 1
+
+
+def test_acceptance_accounting_is_honest(tiny_setup_f32):
+    """The acceptance metric's denominator counts only rounds where some row
+    was live: the chunked while-loop runs whole rounds_per_check chunks, and
+    uncounted phantom tail rounds would deflate measured acceptance (and
+    mislead the auto-enable wrapper). Padded batch rows start done, so they
+    never contribute rounds either — outputs stay exact throughout."""
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    spec = SpeculativeGenerator(params, cfg, tok, k=8, rounds_per_check=8)
+    prompt = [tok.bos_id] + tok.encode("hello there")
+    ref = Generator(params, cfg, tok).generate_tokens(
+        [prompt], GenerateConfig(max_new_tokens=2)
+    )
+    out = spec.generate_tokens([prompt], max_new_tokens=2)
+    assert out == ref
+    # 1 token comes from prefill, so at most 1 verify round is ever live;
+    # the chunk still executes 8 body iterations — 7 phantom, none counted.
+    assert spec.last_rounds <= 1, spec.last_rounds
+    # padded rows (3 real prompts -> batch 4): exactness holds and the pad
+    # row contributes neither tokens nor rounds
+    prompts = [prompt, prompt, [tok.bos_id] + tok.encode("xy")]
+    ref3 = Generator(params, cfg, tok).generate_tokens(
+        prompts, GenerateConfig(max_new_tokens=16)
+    )
+    out3 = spec.generate_tokens(prompts, max_new_tokens=16)
+    assert out3 == ref3
+    assert spec.last_acceptance is not None and spec.last_acceptance > 0
