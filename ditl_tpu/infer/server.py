@@ -113,6 +113,7 @@ def _chat_prompt(messages: list[dict]) -> str:
 class _Handler(BaseHTTPRequestHandler):
     generator: Generator = None  # injected by make_server
     threaded_engine = None  # ContinuousEngine driver; None => lockstep path
+    spec_generator = None  # speculative path for greedy lock-step requests
     model_name: str = "ditl-tpu"
     device_lock: threading.Lock = None
     default_max_tokens: int = 64
@@ -432,10 +433,32 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 tok = self.generator.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
-                with self.device_lock:
-                    out = self.generator.generate_tokens(
-                        [prompt_ids], gen, adapter_ids
-                    )[0]
+                if (
+                    self.spec_generator is not None
+                    and gen.temperature == 0.0
+                    and adapter_ids is None
+                ):
+                    # Greedy requests ride the speculative (or acceptance-
+                    # gated auto-speculative) path — token-identical to the
+                    # plain Generator by the speculation exactness contract.
+                    try:
+                        with self.device_lock:
+                            out = self.spec_generator.generate_tokens(
+                                [prompt_ids], gen.max_new_tokens
+                            )[0]
+                    except ValueError:
+                        # The spec program needs k+1 extra KV slots; near-
+                        # max-context requests that the plain path can still
+                        # serve fall back instead of erroring.
+                        with self.device_lock:
+                            out = self.generator.generate_tokens(
+                                [prompt_ids], gen, adapter_ids
+                            )[0]
+                else:
+                    with self.device_lock:
+                        out = self.generator.generate_tokens(
+                            [prompt_ids], gen, adapter_ids
+                        )[0]
                 n_gen = len(out)
                 text, hit_stop = _apply_stop(tok.decode(out), stops)
                 n_prompt = len(prompt_ids)
@@ -493,12 +516,15 @@ def make_server(
     default_max_tokens: int = 64,
     threaded_engine=None,
     adapter_names: dict | None = None,
+    spec_generator=None,
 ) -> ThreadingHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
     continuous batching instead of the lock-step Generator;
     ``adapter_names`` maps OpenAI "model" names to multi-LoRA adapter ids
-    (the generator's params must be a stacked-adapter tree)."""
+    (the generator's params must be a stacked-adapter tree);
+    ``spec_generator`` (Speculative/AutoSpeculativeGenerator) serves greedy
+    non-streaming lock-step requests speculatively."""
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -509,6 +535,7 @@ def make_server(
             "device_lock": threading.Lock(),
             "default_max_tokens": default_max_tokens,
             "adapter_names": adapter_names or {},
+            "spec_generator": spec_generator,
         },
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -538,6 +565,13 @@ def serve(argv: list[str] | None = None) -> int:
         help="chunked prefill for --engine continuous: prompts longer than "
         "this prefill one chunk per tick, interleaved with in-flight "
         "decodes (0 = whole-prompt prefill)",
+    )
+    parser.add_argument(
+        "--speculative", choices=("off", "on", "auto"), default="off",
+        help="prompt-lookup speculative decoding for greedy non-streaming "
+        "requests (--engine lockstep): 'on' always speculates, 'auto' "
+        "enables per request from measured acceptance "
+        "(infer/speculative.py; outputs stay token-identical)",
     )
     parser.add_argument(
         "--max-queue", type=int, default=0,
@@ -611,6 +645,16 @@ def serve(argv: list[str] | None = None) -> int:
         parser.error("--cache-mode paged does not yet compose with --mesh "
                      "(the paged kernel is not shard_mapped); use "
                      "--cache-mode contiguous")
+    if args.speculative != "off" and args.engine == "continuous":
+        parser.error("--speculative composes with --engine lockstep only "
+                     "(the continuous engine's slot scheduler has no "
+                     "verify-forward path yet)")
+    if args.speculative != "off" and args.pod:
+        parser.error("--speculative does not compose with --pod (device "
+                     "work must ride the broadcast protocol)")
+    if args.speculative != "off" and args.adapter:
+        parser.error("--speculative does not compose with --adapter "
+                     "(adapter requests take the plain path anyway)")
     if jax.process_index() != 0 and not args.pod:
         # Without --pod, one process binds the port and the others exit; with
         # --pod every process joins the collective decode loop below.
@@ -759,10 +803,22 @@ def serve(argv: list[str] | None = None) -> int:
         from ditl_tpu.infer.podserve import PodGenerator
 
         generator = pod = PodGenerator(generator)
+    spec = None
+    if args.speculative != "off":
+        from ditl_tpu.infer.speculative import (
+            AutoSpeculativeGenerator, SpeculativeGenerator,
+        )
+
+        if args.speculative == "auto":
+            spec = AutoSpeculativeGenerator(
+                params, cfg, tokenizer, mesh=mesh, plain=generator
+            )
+        else:
+            spec = SpeculativeGenerator(params, cfg, tokenizer, mesh=mesh)
     server = make_server(
         generator, host=args.host, port=args.port, model_name=cfg.name,
         default_max_tokens=args.max_tokens, threaded_engine=threaded,
-        adapter_names=adapter_names,
+        adapter_names=adapter_names, spec_generator=spec,
     )
     logger.info("serving %s (%s) on %s:%d", cfg.name, args.engine, args.host, args.port)
     try:

@@ -212,7 +212,13 @@ class SpeculativeGenerator:
         self.last_rounds: int = 0
         self.mesh = mesh
         self.rules = rules
-        self._compiled: dict = {}
+        # LRU-bounded: the compile key includes client-controlled max_new
+        # (same rationale as engine.Generator's cache — unbounded would be
+        # an unbounded memory leak on a public server).
+        import collections
+
+        self._compiled: collections.OrderedDict = collections.OrderedDict()
+        self._compile_cache_size = 32
 
     # -- the one compiled program --------------------------------------------
 
@@ -390,8 +396,12 @@ class SpeculativeGenerator:
             lengths[i] = len(toks)
 
         key = (batch, prompt_len, max_new_tokens)
-        if key not in self._compiled:
+        if key in self._compiled:
+            self._compiled.move_to_end(key)
+        else:
             self._compiled[key] = self._build(batch, prompt_len, max_new_tokens)
+            while len(self._compiled) > self._compile_cache_size:
+                self._compiled.popitem(last=False)
         out, rounds, n_out = self._compiled[key](
             self.params, jnp.asarray(ids), jnp.asarray(lengths), jnp.int32(n)
         )
@@ -456,6 +466,7 @@ class AutoSpeculativeGenerator:
         ema: float = 0.7,
         mesh=None,
         rules=None,
+        plain=None,
         **spec_kw,
     ):
         from ditl_tpu.infer.engine import Generator
@@ -467,7 +478,12 @@ class AutoSpeculativeGenerator:
         self.spec = SpeculativeGenerator(
             params, model_cfg, tokenizer, mesh=mesh, rules=rules, **spec_kw
         )
-        self.plain = Generator(params, model_cfg, tokenizer, mesh=mesh, rules=rules)
+        # Reuse the caller's Generator when given (the server already holds
+        # one): a second instance would keep a second 32-program compile
+        # cache for the same shapes.
+        self.plain = plain if plain is not None else Generator(
+            params, model_cfg, tokenizer, mesh=mesh, rules=rules
+        )
         self.tokenizer = tokenizer
         self.threshold = threshold
         self.probe_every = probe_every
