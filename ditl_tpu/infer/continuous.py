@@ -127,7 +127,8 @@ class ContinuousEngine:
         Admission reserves a request's worst-case pages up front (prompt +
         max_new); requests wait in queue when the pool can't cover that —
         no mid-flight preemption. int8 KV quantization currently requires
-        the contiguous mode.
+        the contiguous mode. With a mesh, the pools shard kv-heads over the
+        tensor axis (the kernel is shard_mapped; heads must divide tp).
 
         ``max_queue`` caps how many requests may wait for a slot; ``submit``
         raises ``QueueFullError`` beyond it (HTTP layer: 429).
@@ -138,8 +139,8 @@ class ContinuousEngine:
         collectives. Combined with the podserve tick broadcast
         (infer/podserve.PodContinuousDriver) this is pod-wide continuous
         batching: every process runs the identical tick program on its
-        shard. Paged mode is currently single-device (the Pallas kernel is
-        not yet shard_mapped)."""
+        shard. In paged mode the kernel is shard_mapped over the tensor
+        axis (kv-heads split; page table replicated)."""
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer
@@ -153,11 +154,6 @@ class ContinuousEngine:
             raise ValueError(f"prefill_chunk must be >= 0, got {prefill_chunk}")
         self.prefill_chunk = prefill_chunk
         self.max_queue = max_queue
-        if mesh is not None and cache_mode == "paged":
-            raise NotImplementedError(
-                "cache_mode='paged' does not yet compose with a mesh (the "
-                "paged Pallas kernel is not shard_mapped); use contiguous"
-            )
         self.mesh = mesh
         self.rules = rules
         self.gen = gen or GenerateConfig()
@@ -193,7 +189,34 @@ class ContinuousEngine:
                 page_size, model_cfg.head_dim,
             )
             dt = jnp.dtype(model_cfg.dtype)
-            self.cache = {"kp": jnp.zeros(shape, dt), "vp": jnp.zeros(shape, dt)}
+            if mesh is not None:
+                from ditl_tpu.ops.attention import _mesh_axes_size
+                from ditl_tpu.parallel.sharding import DEFAULT_RULES, named_sharding_tree
+
+                r = rules if rules is not None else DEFAULT_RULES
+                tp = _mesh_axes_size(mesh, r.get("act_kv_heads"))
+                if tp > 1 and (model_cfg.num_kv_heads % tp
+                               or model_cfg.num_heads % tp):
+                    raise ValueError(
+                        f"paged cache with a mesh shards kv-heads over the "
+                        f"tensor axis: heads {model_cfg.num_heads}/"
+                        f"{model_cfg.num_kv_heads} must divide tp={tp}"
+                    )
+                pool_axes = ("layers", None, "act_kv_heads", None, "head_dim")
+                shardings = named_sharding_tree(
+                    mesh, {"kp": pool_axes, "vp": pool_axes}, rules
+                )
+                # Allocate sharded-from-birth: materializing the full pool
+                # on one device first would OOM exactly the configurations
+                # sharding exists for.
+                self.cache = jax.jit(
+                    lambda: {"kp": jnp.zeros(shape, dt),
+                             "vp": jnp.zeros(shape, dt)},
+                    out_shardings=shardings,
+                )()
+            else:
+                self.cache = {"kp": jnp.zeros(shape, dt),
+                              "vp": jnp.zeros(shape, dt)}
             self.allocator = PageAllocator(self.n_pages)
             self._table = np.zeros((n_slots, self.maxp), np.int32)
             self._slot_pages: list[list[int]] = [[] for _ in range(n_slots)]
@@ -500,6 +523,8 @@ class ContinuousEngine:
                     positions=pos[:, None],
                     cache={"kp": kp, "vp": vp, "tk": tk, "tv": tv},
                     paged=paged_meta,
+                    mesh=self.mesh,
+                    rules=self.rules,
                 )
                 tk, tv = tails["tk"], tails["tv"]
                 nxt = sample_logits(

@@ -641,10 +641,6 @@ def serve(argv: list[str] | None = None) -> int:
     if args.adapter and args.pod:
         parser.error("--adapter does not compose with --pod (the broadcast "
                      "protocol does not carry adapter ids)")
-    if args.cache_mode == "paged" and args.mesh:
-        parser.error("--cache-mode paged does not yet compose with --mesh "
-                     "(the paged kernel is not shard_mapped); use "
-                     "--cache-mode contiguous")
     if args.speculative != "off" and args.engine == "continuous":
         parser.error("--speculative composes with --engine lockstep only "
                      "(the continuous engine's slot scheduler has no "

@@ -245,13 +245,73 @@ def paged_attention(
     tail_v: jax.Array | None = None,
     starts: jax.Array | None = None,  # (B,) tokens resident in pages
     interpret: bool | None = None,
+    mesh=None,
+    rules=None,
 ) -> jax.Array:
     """Pallas paged GQA decode attention (see module docstring).
 
     With ``tail_k/tail_v/starts`` (the deferred-flush decode path), the
     grid gains one final step that accumulates the hot tail block —
     positions [starts, lengths) held in a small contiguous buffer — so
-    per-token page writes never happen inside the decode scan."""
+    per-token page writes never happen inside the decode scan.
+
+    With a ``mesh``, the kernel is shard_mapped over the TENSOR axis:
+    pools, tails and q/output split on kv-heads (the rule table's
+    ``act_kv_heads``), page table / lengths / starts replicated — heads
+    are independent in attention, so no collectives are induced. The
+    batch axes stay unsharded here (a paged pool is one shared resource;
+    multi-host paged serving replicates the batch like the pod protocols
+    do)."""
+    if mesh is not None:
+        from ditl_tpu.ops.attention import _mesh_axes_size
+        from ditl_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
+
+        rules = rules if rules is not None else DEFAULT_RULES
+        tp = _mesh_axes_size(mesh, rules.get("act_kv_heads"))
+        tp_q = _mesh_axes_size(mesh, rules.get("act_heads"))
+        dp = _mesh_axes_size(mesh, rules.get("batch"))
+        kv_heads = k_pages.shape[1]
+        shardable = (
+            (tp > 1 or dp > 1)
+            # q and kv specs must resolve to the SAME head split — a rule
+            # table splitting them differently would silently mispair q
+            # heads with kv heads inside the map.
+            and rules.get("act_heads") == rules.get("act_kv_heads")
+            and tp == tp_q
+            and kv_heads % tp == 0
+            and q.shape[1] % tp == 0
+            and q.shape[0] % dp == 0
+        )
+        if shardable:
+            pool_spec = logical_to_spec((None, "act_kv_heads", None, None), rules)
+            tail_spec = logical_to_spec(("batch", "act_kv_heads", None, None), rules)
+            row_spec = logical_to_spec(("batch",), rules)
+            in_specs = [
+                logical_to_spec(("batch", "act_heads", None), rules),  # q
+                pool_spec, pool_spec,  # pools (P,K,ps,D): replicated over dp
+                logical_to_spec(("batch", None), rules),  # table
+                row_spec,  # lengths
+            ]
+            args = [q, k_pages, v_pages, page_table, lengths]
+            if tail_k is not None:
+                in_specs += [tail_spec, tail_spec, row_spec]
+                args += [tail_k, tail_v, starts]
+
+            def local(q_, kp_, vp_, tab_, lens_, tk_=None, tv_=None, st_=None):
+                return paged_attention(
+                    q_, kp_, vp_, tab_, lens_,
+                    tail_k=tk_, tail_v=tv_, starts=st_, interpret=interpret,
+                )
+
+            return jax.shard_map(
+                local,
+                mesh=mesh,
+                in_specs=tuple(in_specs),
+                out_specs=logical_to_spec(("batch", "act_heads", None), rules),
+                check_vma=False,
+            )(*args)
+        # Mesh doesn't divide heads/batch (or no such axes): single-program
+        # path under GSPMD — fall through unsharded.
     b, h, d = q.shape
     n_pool, kv_heads, ps, _ = k_pages.shape
     maxp = page_table.shape[1]

@@ -145,6 +145,7 @@ _SLOW_TESTS = {
     "tests/test_paged.py::test_paged_capacity_exceeds_contiguous_equivalent",
     "tests/test_paged.py::test_paged_chunked_prefill_matches_unchunked",
     "tests/test_paged.py::test_paged_matches_lockstep_generator_greedy",
+    "tests/test_paged.py::test_paged_on_mesh_matches_single_device",
     "tests/test_paged.py::test_paged_pool_exhaustion_queues_and_recovers",
     "tests/test_paged.py::test_paged_register_prefix_is_a_warm_hint",
     "tests/test_paged.py::test_paged_sampled_seed_reproducible",

@@ -346,3 +346,29 @@ def test_paged_attention_tail_variant_matches_reference():
     out = paged_attention(*args, **kw)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
     assert np.all(np.asarray(out[0]) == 0)
+
+
+def test_paged_on_mesh_matches_single_device(tiny_setup):
+    """A tensor-sharded paged engine (kernel shard_mapped over kv-heads)
+    produces the same tokens as the unsharded one."""
+    from ditl_tpu.config import MeshConfig
+    from ditl_tpu.runtime.mesh import build_mesh
+
+    cfg, params = tiny_setup  # 2 kv heads: tp=2 divides
+    tok = ByteTokenizer()
+    prompts = ["hello world", "abc", "a longer paged prompt here"]
+    gen = GenerateConfig(max_new_tokens=10)
+    ref = _paged_engine(params, cfg, gen=gen).generate(prompts)
+    mesh = build_mesh(MeshConfig(data=-1, tensor=2))
+    eng = _paged_engine(params, cfg, gen=gen, mesh=mesh)
+    assert eng.generate(prompts) == ref
+
+
+def test_paged_mesh_rejects_undividable_heads(tiny_setup):
+    from ditl_tpu.config import MeshConfig
+    from ditl_tpu.runtime.mesh import build_mesh
+
+    cfg, params = tiny_setup  # 2 kv heads, tp=8 does not divide
+    mesh = build_mesh(MeshConfig(tensor=8))
+    with pytest.raises(ValueError, match="heads"):
+        _paged_engine(params, cfg, mesh=mesh)
