@@ -49,6 +49,16 @@ logger = get_logger(__name__)
 __all__ = ["ContinuousEngine", "QueueFullError", "Request", "ThreadedEngine"]
 
 
+def _quantize_pages(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(L, n, K, ps, D) float -> int8 values + (L, n, K, 1, ps) f32 scales
+    — one quantization recipe for both cache modes (infer/cache._quantize,
+    symmetric per-position absmax over the last axis)."""
+    from ditl_tpu.infer.cache import _quantize
+
+    q, scale = _quantize(chunk)
+    return q, scale[:, :, :, None, :]
+
+
 class QueueFullError(RuntimeError):
     """Raised by ``submit`` when the engine's admission queue is at its
     configured depth cap — callers (the HTTP server) turn this into a 429
@@ -126,9 +136,12 @@ class ContinuousEngine:
         requirement (infer/paged_cache.py, ops/paged_attention.py).
         Admission reserves a request's worst-case pages up front (prompt +
         max_new); requests wait in queue when the pool can't cover that —
-        no mid-flight preemption. int8 KV quantization currently requires
-        the contiguous mode. With a mesh, the pools shard kv-heads over the
-        tensor axis (the kernel is shard_mapped; heads must divide tp).
+        no mid-flight preemption. ``kv_cache_dtype="int8"`` composes:
+        pools store int8 + per-position scales (halving page bytes =
+        doubling resident tokens), the kernel factors the scales out of
+        its dots, and the hot tail stays float until the per-tick flush.
+        With a mesh, the pools shard kv-heads over the tensor axis (the
+        kernel is shard_mapped; heads must divide tp).
 
         ``max_queue`` caps how many requests may wait for a slot; ``submit``
         raises ``QueueFullError`` beyond it (HTTP layer: 429).
@@ -164,9 +177,9 @@ class ContinuousEngine:
         self.cache_mode = cache_mode
         self.page_size = page_size
         if cache_mode == "paged":
-            if model_cfg.kv_cache_dtype == "int8":
-                raise NotImplementedError(
-                    "int8 KV quantization requires cache_mode='contiguous'"
+            if model_cfg.kv_cache_dtype not in ("", "model", "int8"):
+                raise ValueError(
+                    f"unknown kv_cache_dtype {model_cfg.kv_cache_dtype!r}"
                 )
             if page_size < 16 or page_size & (page_size - 1):
                 raise ValueError(
@@ -189,6 +202,22 @@ class ContinuousEngine:
                 page_size, model_cfg.head_dim,
             )
             dt = jnp.dtype(model_cfg.dtype)
+            quantized = model_cfg.kv_cache_dtype == "int8"
+            scale_shape = (
+                model_cfg.num_layers, self.n_pages, model_cfg.num_kv_heads,
+                1, page_size,
+            )
+
+            def fresh_pools():
+                if quantized:
+                    return {
+                        "kp": jnp.zeros(shape, jnp.int8),
+                        "vp": jnp.zeros(shape, jnp.int8),
+                        "ks": jnp.ones(scale_shape, jnp.float32),
+                        "vs": jnp.ones(scale_shape, jnp.float32),
+                    }
+                return {"kp": jnp.zeros(shape, dt), "vp": jnp.zeros(shape, dt)}
+
             if mesh is not None:
                 from ditl_tpu.ops.attention import _mesh_axes_size
                 from ditl_tpu.parallel.sharding import DEFAULT_RULES, named_sharding_tree
@@ -203,20 +232,17 @@ class ContinuousEngine:
                         f"{model_cfg.num_kv_heads} must divide tp={tp}"
                     )
                 pool_axes = ("layers", None, "act_kv_heads", None, "head_dim")
-                shardings = named_sharding_tree(
-                    mesh, {"kp": pool_axes, "vp": pool_axes}, rules
-                )
+                axes_tree = {"kp": pool_axes, "vp": pool_axes}
+                if quantized:
+                    scale_axes = ("layers", None, "act_kv_heads", None, None)
+                    axes_tree.update({"ks": scale_axes, "vs": scale_axes})
+                shardings = named_sharding_tree(mesh, axes_tree, rules)
                 # Allocate sharded-from-birth: materializing the full pool
                 # on one device first would OOM exactly the configurations
                 # sharding exists for.
-                self.cache = jax.jit(
-                    lambda: {"kp": jnp.zeros(shape, dt),
-                             "vp": jnp.zeros(shape, dt)},
-                    out_shardings=shardings,
-                )()
+                self.cache = jax.jit(fresh_pools, out_shardings=shardings)()
             else:
-                self.cache = {"kp": jnp.zeros(shape, dt),
-                              "vp": jnp.zeros(shape, dt)}
+                self.cache = fresh_pools()
             self.allocator = PageAllocator(self.n_pages)
             self._table = np.zeros((n_slots, self.maxp), np.int32)
             self._slot_pages: list[list[int]] = [[] for _ in range(n_slots)]
@@ -436,16 +462,26 @@ class ContinuousEngine:
         buf = maxp * ps + s_bucket
         buf_iota = jnp.arange(buf, dtype=jnp.int32)
 
-        def run(params, kp, vp, table_row, ids, offset, s_len, temp, top_p,
+        cd = jnp.dtype(cfg.dtype)
+        quantized = cfg.kv_cache_dtype == "int8"
+
+        def run(params, pools, table_row, ids, offset, s_len, temp, top_p,
                 rng, write_pids):
+            kp, vp = pools["kp"], pools["vp"]
             L, _, K, _, D = kp.shape
 
-            def to_row(pool):  # (L, maxp, K, ps, D) -> (L, 1, maxp*ps, K, D)
-                g = jnp.swapaxes(pool[:, table_row], 2, 3)
+            def to_row(pool, scales=None):
+                # (L, maxp, K, ps, D) [+ int8 scales] -> (L, 1, maxp*ps, K, D)
+                g = pool[:, table_row]
+                if scales is not None:
+                    sc = scales[:, table_row][:, :, :, 0, :]  # (L, maxp, K, ps)
+                    g = (g.astype(jnp.float32) * sc[..., None]).astype(cd)
+                g = jnp.swapaxes(g, 2, 3)
                 return g.reshape(L, 1, maxp * ps, K, D)
 
-            ctx_k, ctx_v = to_row(kp), to_row(vp)
-            zeros = jnp.zeros((L, 1, s_bucket, K, D), kp.dtype)
+            ctx_k = to_row(kp, pools.get("ks"))
+            ctx_v = to_row(vp, pools.get("vs"))
+            zeros = jnp.zeros((L, 1, s_bucket, K, D), ctx_k.dtype)
             row = {
                 "k": jnp.concatenate([ctx_k, zeros], axis=2),
                 "v": jnp.concatenate([ctx_v, zeros], axis=2),
@@ -462,21 +498,36 @@ class ContinuousEngine:
                 return jnp.swapaxes(chunk.reshape(L, n_wp, ps, K, D), 2, 3)
 
             chunk_k, chunk_v = to_pages(row["k"]), to_pages(row["v"])
-            for j in range(n_wp):
-                kp = jax.lax.dynamic_update_slice(
-                    kp, chunk_k[:, j:j + 1], (0, write_pids[j], 0, 0, 0)
-                )
-                vp = jax.lax.dynamic_update_slice(
-                    vp, chunk_v[:, j:j + 1], (0, write_pids[j], 0, 0, 0)
-                )
+            out = dict(pools)
+            if quantized:
+                for name, sname, chunk in (("kp", "ks", chunk_k),
+                                           ("vp", "vs", chunk_v)):
+                    vals, sc = _quantize_pages(chunk)
+                    pool, spool = out[name], out[sname]
+                    for j in range(n_wp):
+                        pool = jax.lax.dynamic_update_slice(
+                            pool, vals[:, j:j + 1], (0, write_pids[j], 0, 0, 0)
+                        )
+                        spool = jax.lax.dynamic_update_slice(
+                            spool, sc[:, j:j + 1], (0, write_pids[j], 0, 0, 0)
+                        )
+                    out[name], out[sname] = pool, spool
+            else:
+                for name, chunk in (("kp", chunk_k), ("vp", chunk_v)):
+                    pool = out[name]
+                    for j in range(n_wp):
+                        pool = jax.lax.dynamic_update_slice(
+                            pool, chunk[:, j:j + 1], (0, write_pids[j], 0, 0, 0)
+                        )
+                    out[name] = pool
             last = logits[0, s_len - 1]
             first = sample_logits(
                 last[None], rng, temperature=temp, top_k=self.gen.top_k,
                 top_p=top_p,
             )[0]
-            return kp, vp, first
+            return out, first
 
-        return jax.jit(run, donate_argnums=(1, 2))
+        return jax.jit(run, donate_argnums=(1,))
 
     def _build_paged_decode(self, sampled: bool, topp: bool):
         """Paged decode tick with DEFERRED page writes: the chunk's K/V
@@ -493,7 +544,9 @@ class ContinuousEngine:
         L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
         dt = jnp.dtype(cfg.dtype)
 
-        def run(params, kp, vp, cur, pos, alive, temps, top_ps, keys, table,
+        quantized = cfg.kv_cache_dtype == "int8"
+
+        def run(params, pools, cur, pos, alive, temps, top_ps, keys, table,
                 limits):
             n_b = pos.shape[0]
             b_iota = jnp.arange(n_b, dtype=jnp.int32)
@@ -504,6 +557,7 @@ class ContinuousEngine:
             starts = pos
             tk0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
             tv0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
+            cache_const = dict(pools)  # pools are read-only during the scan
 
             def body(carry, t):
                 tk, tv, cur, pos, done, keys = carry
@@ -521,7 +575,7 @@ class ContinuousEngine:
                     cur[:, None],
                     cfg,
                     positions=pos[:, None],
-                    cache={"kp": kp, "vp": vp, "tk": tk, "tv": tv},
+                    cache={**cache_const, "tk": tk, "tv": tv},
                     paged=paged_meta,
                     mesh=self.mesh,
                     rules=self.rules,
@@ -548,6 +602,8 @@ class ContinuousEngine:
             # one scatter per pool per tick (amortized over the chunk).
             # Invalid columns (beyond what the row decoded) and dead rows
             # aim at sentinel page 0, whose content is never read unmasked.
+            # int8 pools: the tail is quantized HERE (tokens attend at full
+            # precision within their own tick, then round once).
             j = jnp.arange(tail_len, dtype=jnp.int32)
             gpos = starts[:, None] + j[None, :]  # (B, tail_len)
             valid = j[None, :] < (pos - starts)[:, None]
@@ -568,9 +624,29 @@ class ContinuousEngine:
                 )
                 return pool.at[:, pid, :, off].set(vals.astype(pool.dtype))
 
-            return flush(kp, tk), flush(vp, tv), cur, pos, keys, toks.T
+            def flush_scale(spool, scales):
+                # scales (L, B, K, T) -> (B*T, L, K, 1); spool (L,P,K,1,ps)
+                vals = jnp.transpose(scales, (1, 3, 0, 2)).reshape(
+                    n_b * tail_len, L, K
+                )[..., None]
+                return spool.at[:, pid, :, :, off].set(vals)
 
-        return jax.jit(run, donate_argnums=(1, 2))
+            out = dict(pools)
+            if quantized:
+                from ditl_tpu.infer.cache import _quantize
+
+                qk, sk = _quantize(tk)
+                qv, sv = _quantize(tv)
+                out["kp"] = flush(pools["kp"], qk)
+                out["vp"] = flush(pools["vp"], qv)
+                out["ks"] = flush_scale(pools["ks"], sk)
+                out["vs"] = flush_scale(pools["vs"], sv)
+            else:
+                out["kp"] = flush(pools["kp"], tk)
+                out["vp"] = flush(pools["vp"], tv)
+            return out, cur, pos, keys, toks.T
+
+        return jax.jit(run, donate_argnums=(1,))
 
     def register_prefix(self, prefix_tokens: list[int]) -> None:
         """Prefill ``prefix_tokens`` once and reuse the KV for every future
@@ -657,13 +733,12 @@ class ContinuousEngine:
         write_pids = np.zeros((n_wp,), np.int32)
         usable = pages[len(matched):]
         write_pids[: len(usable)] = usable
-        kp, vp, _ = self._paged_prefill[s_bucket](
-            self.params, self.cache["kp"], self.cache["vp"],
+        self.cache, _ = self._paged_prefill[s_bucket](
+            self.params, self.cache,
             jnp.asarray(table_row), jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.float32(0.0), jnp.float32(1.0),
             jax.random.key(0), jnp.asarray(write_pids),
         )
-        self.cache = {"kp": kp, "vp": vp}
         self.allocator.publish_chain(tokens[: n_full * ps], ps, pages)
         for pid in pages:
             self.allocator.release(pid)
@@ -892,13 +967,12 @@ class ContinuousEngine:
         write_pids = np.zeros((n_wp,), np.int32)
         row = self._table[slot, d // ps: d // ps + n_wp]
         write_pids[: len(row)] = row
-        kp, vp, first = self._paged_prefill[s_bucket](
-            self.params, self.cache["kp"], self.cache["vp"],
+        self.cache, first = self._paged_prefill[s_bucket](
+            self.params, self.cache,
             jnp.asarray(self._table[slot]), jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.float32(req.temperature),
             jnp.float32(req.top_p), rng, jnp.asarray(write_pids),
         )
-        self.cache = {"kp": kp, "vp": vp}
         return first
 
     def _admit_paged_slot(self, slot: int) -> bool:
@@ -1020,12 +1094,12 @@ class ContinuousEngine:
         if self.cache_mode == "paged":
             if key not in self._paged_decode:
                 self._paged_decode[key] = self._build_paged_decode(*key)
-            kp, vp, self.cur, self.pos, self.keys, toks = self._paged_decode[key](
-                self.params, self.cache["kp"], self.cache["vp"], self.cur,
-                self.pos, alive, self.temps, self.top_ps, self.keys,
-                jnp.asarray(self._table), self.limits,
-            )
-            self.cache = {"kp": kp, "vp": vp}
+            self.cache, self.cur, self.pos, self.keys, toks = \
+                self._paged_decode[key](
+                    self.params, self.cache, self.cur,
+                    self.pos, alive, self.temps, self.top_ps, self.keys,
+                    jnp.asarray(self._table), self.limits,
+                )
         else:
             if key not in self._decode_cache:
                 self._decode_cache[key] = self._build_decode(*key)

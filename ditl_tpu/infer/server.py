@@ -600,7 +600,8 @@ def serve(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--kv-quant", choices=("none", "int8"), default="none",
-        help="int8 KV cache (halves cache reads/footprint; infer/cache.py)",
+        help="int8 KV cache (halves cache reads/footprint; composes with "
+        "both cache modes)",
     )
     parser.add_argument(
         "--override", action="append", default=[], metavar="FIELD=VALUE",

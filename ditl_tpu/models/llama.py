@@ -316,6 +316,7 @@ def _decoder_layer(
         attn_out = paged_attention(
             q[:, 0], layer_cache["kp"], layer_cache["vp"], paged["table"],
             paged["lengths"], tail_k=tk, tail_v=tv, starts=paged["starts"],
+            k_scale=layer_cache.get("ks"), v_scale=layer_cache.get("vs"),
             mesh=mesh, rules=rules,
         )[:, None]
     elif layer_cache is not None:

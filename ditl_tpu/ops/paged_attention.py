@@ -54,18 +54,30 @@ def paged_attention_xla(
     tail_k: jax.Array | None = None,  # (B, K, T, D)
     tail_v: jax.Array | None = None,
     starts: jax.Array | None = None,  # (B,) — tokens resident in pages
+    k_scale: jax.Array | None = None,  # (P, K, 1, ps) — int8 pool scales
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """Gather-based reference: correctness oracle + CPU fallback.
 
     With a tail (the deferred-flush decode path), tokens [0, starts) live
     in pages and [starts, lengths) in the tail buffer at columns
-    [0, lengths - starts)."""
+    [0, lengths - starts). With ``k_scale``/``v_scale`` the pools are int8
+    (symmetric per-row absmax; tails stay float)."""
     b, h, d = q.shape
     _, kv_heads, ps, _ = k_pages.shape
     maxp = page_table.shape[1]
     groups = h // kv_heads
-    k = jnp.swapaxes(k_pages[page_table], 2, 3).reshape(b, maxp * ps, kv_heads, d)
-    v = jnp.swapaxes(v_pages[page_table], 2, 3).reshape(b, maxp * ps, kv_heads, d)
+    kg = k_pages[page_table]  # (B, maxp, K, ps, D)
+    vg = v_pages[page_table]
+    dtype = k_pages.dtype
+    if k_scale is not None:
+        kg = (kg.astype(jnp.float32)
+              * jnp.swapaxes(k_scale[page_table], 3, 4))  # scales (B,maxp,K,ps,1)
+        vg = (vg.astype(jnp.float32)
+              * jnp.swapaxes(v_scale[page_table], 3, 4))
+        dtype = q.dtype
+    k = jnp.swapaxes(kg, 2, 3).reshape(b, maxp * ps, kv_heads, d).astype(dtype)
+    v = jnp.swapaxes(vg, 2, 3).reshape(b, maxp * ps, kv_heads, d).astype(dtype)
     page_limit = lengths if starts is None else jnp.minimum(starts, lengths)
     valid = jnp.arange(maxp * ps, dtype=jnp.int32)[None, :] < page_limit[:, None]
     if tail_k is not None:
@@ -91,10 +103,17 @@ def paged_attention_xla(
 
 def _accumulate_block(
     q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
-    scale, base, width, limit,
+    scale, base, width, limit, ks_ref=None, vs_ref=None,
 ):
     """Online-softmax accumulation of one (all-kv-heads) KV block whose
-    columns are global positions [base, base+width), masked to < limit."""
+    columns are global positions [base, base+width), masked to < limit.
+
+    ``ks_ref``/``vs_ref`` ((1, K, 1, width) f32) mark the block as int8:
+    the scales factor OUT of the dots — the score matmul consumes raw int8
+    K (HBM reads stay int8-sized) and the per-position scale multiplies the
+    (G, width) score row afterwards; V's scale folds into the
+    probabilities before the pv matmul. Lane-aligned broadcasts both
+    times (same scheme as the contiguous int8 cache, ops/attention.py)."""
     kv_heads, groups = q_ref.shape[1], q_ref.shape[2]
     d = acc_scr.shape[-1]
     tile = _lane_tile  # shared lane-replication helper (ops/flash_attention)
@@ -107,6 +126,8 @@ def _accumulate_block(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (G, width)
+        if ks_ref is not None:
+            s = s * ks_ref[0, kh]  # (1, width) broadcast over G sublanes
         s = jnp.where(col_mask, s, NEG_INF)
         rows = slice(kh * groups, (kh + 1) * groups)
         m_prev = m_scr[rows]  # (G, NUM_LANES) lane-replicated
@@ -118,10 +139,17 @@ def _accumulate_block(
         l_scr[rows] = alpha * l_prev + jnp.sum(ptab, axis=1, keepdims=True)
         m_scr[rows] = m_next
         v = v_ref[0, kh]  # (width, D)
-        pv = jax.lax.dot_general(
-            ptab.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (G, D)
+        if vs_ref is not None:
+            pv = jax.lax.dot_general(
+                ptab * vs_ref[0, kh], v.astype(jnp.float32),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (G, D)
+        else:
+            pv = jax.lax.dot_general(
+                ptab.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # (G, D)
         acc_scr[rows] = acc_scr[rows] * tile(alpha, d) + pv
 
 
@@ -184,23 +212,26 @@ def _paged_tail_kernel(
     lengths_ref,  # scalar prefetch: (B,) int32
     starts_ref,  # scalar prefetch: (B,) int32 — tokens resident in pages
     q_ref,  # (1, K, G, D)
-    k_ref,  # (1, K, ps, D)
+    k_ref,  # (1, K, ps, D) — int8 when quantized
     v_ref,
-    tk_ref,  # (1, K, T, D) — this tick's unflushed tokens
-    tv_ref,
-    o_ref,  # (1, K, G, D)
-    m_scr,
-    l_scr,
-    acc_scr,
-    *,
+    *rest,  # [ks_ref, vs_ref ((1, K, 1, ps) f32)], tk_ref, tv_ref, o_ref,
+            # m_scr, l_scr, acc_scr
     scale: float,
     page_size: int,
     n_pages: int,
+    quantized: bool,
 ):
     """Deferred-flush variant: grid (B, maxp + 1). Steps p < maxp consume
     flushed pages (positions < starts[b]); the final step consumes the hot
-    TAIL block — the current decode chunk\'s KV, held in a small contiguous
-    buffer until the per-tick flush (positions [starts, lengths))."""
+    TAIL block — the current decode chunk's KV, held in a small contiguous
+    buffer until the per-tick flush (positions [starts, lengths)). With
+    ``quantized``, the pools are int8 and their per-position scales factor
+    out of the dots; the tail stays float until the flush."""
+    if quantized:
+        ks_ref, vs_ref, tk_ref, tv_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        tk_ref, tv_ref, o_ref, m_scr, l_scr, acc_scr = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -220,6 +251,7 @@ def _paged_tail_kernel(
         _accumulate_block(
             q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
             scale=scale, base=base, width=page_size, limit=page_limit,
+            ks_ref=ks_ref, vs_ref=vs_ref,
         )
 
     @pl.when((p == n_pages) & (length > start))
@@ -244,6 +276,8 @@ def paged_attention(
     tail_k: jax.Array | None = None,  # (B, K, T, D) — unflushed chunk KV
     tail_v: jax.Array | None = None,
     starts: jax.Array | None = None,  # (B,) tokens resident in pages
+    k_scale: jax.Array | None = None,  # (P, K, 1, ps) — int8 pools
+    v_scale: jax.Array | None = None,
     interpret: bool | None = None,
     mesh=None,
     rules=None,
@@ -293,14 +327,28 @@ def paged_attention(
                 row_spec,  # lengths
             ]
             args = [q, k_pages, v_pages, page_table, lengths]
-            if tail_k is not None:
+            has_tail = tail_k is not None
+            has_scale = k_scale is not None
+            if has_tail:
                 in_specs += [tail_spec, tail_spec, row_spec]
                 args += [tail_k, tail_v, starts]
+            if has_scale:
+                scale_spec = logical_to_spec(
+                    (None, "act_kv_heads", None, None), rules
+                )
+                in_specs += [scale_spec, scale_spec]
+                args += [k_scale, v_scale]
 
-            def local(q_, kp_, vp_, tab_, lens_, tk_=None, tv_=None, st_=None):
+            def local(q_, kp_, vp_, tab_, lens_, *rest):
+                tk_ = tv_ = st_ = ks_ = vs_ = None
+                if has_tail:
+                    tk_, tv_, st_, *rest = rest
+                if has_scale:
+                    ks_, vs_ = rest
                 return paged_attention(
                     q_, kp_, vp_, tab_, lens_,
-                    tail_k=tk_, tail_v=tv_, starts=st_, interpret=interpret,
+                    tail_k=tk_, tail_v=tv_, starts=st_,
+                    k_scale=ks_, v_scale=vs_, interpret=interpret,
                 )
 
             return jax.shard_map(
@@ -348,29 +396,48 @@ def paged_attention(
         def slot_map(ib, ip, tab, lens, st):
             return (ib, 0, 0, 0)
 
+        quantized = k_scale is not None
+        in_specs = [
+            pl.BlockSpec((1, kv_heads, groups, d), slot_map),
+            pl.BlockSpec((1, kv_heads, ps, d), page_map),
+            pl.BlockSpec((1, kv_heads, ps, d), page_map),
+        ]
+        args = [page_table, lengths, starts, qg, k_pages, v_pages]
+        if quantized:
+            in_specs += [
+                pl.BlockSpec((1, kv_heads, 1, ps), page_map),
+                pl.BlockSpec((1, kv_heads, 1, ps), page_map),
+            ]
+            args += [k_scale, v_scale]
+        in_specs += [
+            pl.BlockSpec((1, kv_heads, tail_k.shape[2], d), slot_map),
+            pl.BlockSpec((1, kv_heads, tail_k.shape[2], d), slot_map),
+        ]
+        args += [tail_k, tail_v]
         out = pl.pallas_call(
             functools.partial(
-                _paged_tail_kernel, scale=d**-0.5, page_size=ps, n_pages=maxp
+                _paged_tail_kernel, scale=d**-0.5, page_size=ps,
+                n_pages=maxp, quantized=quantized,
             ),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=3,
                 grid=(b, maxp + 1),
-                in_specs=[
-                    pl.BlockSpec((1, kv_heads, groups, d), slot_map),
-                    pl.BlockSpec((1, kv_heads, ps, d), page_map),
-                    pl.BlockSpec((1, kv_heads, ps, d), page_map),
-                    pl.BlockSpec((1, kv_heads, tail_k.shape[2], d), slot_map),
-                    pl.BlockSpec((1, kv_heads, tail_k.shape[2], d), slot_map),
-                ],
+                in_specs=in_specs,
                 out_specs=pl.BlockSpec((1, kv_heads, groups, d), slot_map),
                 scratch_shapes=scratch,
             ),
             out_shape=out_shape,
             compiler_params=compiler_params,
             interpret=interpret,
-        )(page_table, lengths, starts, qg, k_pages, v_pages, tail_k, tail_v)
+        )(*args)
         return out.reshape(b, h, d)
 
+    if k_scale is not None:
+        raise ValueError(
+            "paged_attention with k_scale/v_scale requires the tail path "
+            "(tail_k/tail_v/starts) — the no-tail kernel would silently "
+            "attend over raw int8 values"
+        )
     out = pl.pallas_call(
         functools.partial(
             _paged_kernel, scale=d**-0.5, page_size=ps, n_pages=maxp
