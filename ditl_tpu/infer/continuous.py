@@ -283,7 +283,7 @@ class ContinuousEngine:
         self._seed_cache: dict[int, Any] = {}
         self._suffix_prefill: dict[int, Any] = {}  # keyed by suffix bucket
         self._first_sampler: Any = None
-        self._paged_prefill: dict[int, Any] = {}  # keyed by suffix bucket
+        self._paged_prefill: dict[tuple[int, int], Any] = {}  # (s_bucket, ctx_pages)
         self._paged_decode: dict[tuple[bool, bool], Any] = {}
 
     # -- compiled programs --------------------------------------------------
@@ -445,19 +445,23 @@ class ContinuousEngine:
 
     # -- paged programs ------------------------------------------------------
 
-    def _build_paged_prefill(self, s_bucket: int):
+    def _build_paged_prefill(self, s_bucket: int, ctx_pages: int):
         """Prefill ``s_bucket`` prompt tokens of one slot in paged mode.
 
         The slot's resident pages are gathered into a transient contiguous
         row (prefill is compute-bound; one context-sized copy is noise), the
         ordinary cached forward runs against it, and the chunk's K/V pages
-        are scattered back into the pool at ``write_pids``. Chunk starts are
-        page-aligned by construction (prefill_chunk and prefix matches are
-        multiples of page_size), so the chunk covers whole pages; bucket
-        tail beyond ``s_len`` writes garbage that stays masked until decode
-        overwrites it (the same write-then-unmask invariant as the
-        contiguous suffix prefill)."""
-        cfg, ps, maxp = self.cfg, self.page_size, self.maxp
+        are scattered back into the pool at ``write_pids``. ``ctx_pages``
+        bounds the gather to a bucket of the pages actually holding context
+        (gathering the full worst-case table made long chunked prefills
+        quadratic in max context). Chunk starts are page-aligned by
+        construction (prefill_chunk and prefix matches are multiples of
+        page_size), so the chunk covers whole pages; bucket tail beyond
+        ``s_len`` writes garbage that stays masked until decode overwrites
+        it (the same write-then-unmask invariant as the contiguous suffix
+        prefill)."""
+        cfg, ps = self.cfg, self.page_size
+        maxp = ctx_pages
         n_wp = s_bucket // ps
         buf = maxp * ps + s_bucket
         buf_iota = jnp.arange(buf, dtype=jnp.int32)
@@ -471,7 +475,9 @@ class ContinuousEngine:
             L, _, K, _, D = kp.shape
 
             def to_row(pool, scales=None):
-                # (L, maxp, K, ps, D) [+ int8 scales] -> (L, 1, maxp*ps, K, D)
+                # (L, ctx_pages, K, ps, D) [+ scales] -> (L, 1, ctx*ps, K, D)
+                if maxp == 0:
+                    return jnp.zeros((L, 1, 0, K, D), cd)
                 g = pool[:, table_row]
                 if scales is not None:
                     sc = scales[:, table_row][:, :, :, 0, :]  # (L, maxp, K, ps)
@@ -719,25 +725,13 @@ class ContinuousEngine:
             )
             return
         pages = matched + fresh
-        table_row = np.zeros((self.maxp,), np.int32)
-        table_row[: len(pages)] = pages
         d = len(matched) * ps
         s = n_full * ps - d
-        s_bucket = min(_next_pow2(s, floor=ps), self.maxp * ps)
-        if s_bucket not in self._paged_prefill:
-            logger.info("compiling paged prefill for bucket %d", s_bucket)
-            self._paged_prefill[s_bucket] = self._build_paged_prefill(s_bucket)
-        ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
-        ids[0, :s] = tokens[d: d + s]
-        n_wp = s_bucket // ps
-        write_pids = np.zeros((n_wp,), np.int32)
-        usable = pages[len(matched):]
-        write_pids[: len(usable)] = usable
-        self.cache, _ = self._paged_prefill[s_bucket](
-            self.params, self.cache,
-            jnp.asarray(table_row), jnp.asarray(ids), jnp.int32(d),
-            jnp.int32(s), jnp.float32(0.0), jnp.float32(1.0),
-            jax.random.key(0), jnp.asarray(write_pids),
+        self._run_paged_prefill(
+            tokens[d: d + s], d, s, s,
+            ctx_row=np.asarray(pages, np.int32),  # pages[:ctx] = the context
+            write_pids=np.asarray(pages[len(matched):], np.int32),
+            temp=0.0, top_p=1.0, rng=jax.random.key(0),
         )
         self.allocator.publish_chain(tokens[: n_full * ps], ps, pages)
         for pid in pages:
@@ -953,27 +947,53 @@ class ContinuousEngine:
             [int(p) for p in self._table[slot, :n_full]],
         )
 
+    def _ctx_pages_bucket(self, d: int) -> int:
+        """Gather-bucket (in pages) covering a context of ``d`` tokens."""
+        if d <= 0:
+            return 0
+        need = -(-d // self.page_size)
+        return min(_next_pow2(need, floor=1), self.maxp)
+
+    def _run_paged_prefill(self, tokens, d: int, s: int, s_bucket: int,
+                           ctx_row, write_pids, temp: float, top_p: float,
+                           rng):
+        """Compile-on-miss + call of the (s_bucket, ctx_pages) prefill
+        program — the one shared path for slot prefills and page warming."""
+        ps, maxp = self.page_size, self.maxp
+        s_bucket = min(_next_pow2(max(s_bucket, ps), floor=ps), maxp * ps)
+        ctx = self._ctx_pages_bucket(d)
+        key = (s_bucket, ctx)
+        if key not in self._paged_prefill:
+            logger.info(
+                "compiling paged prefill for bucket %d (ctx %d pages)",
+                s_bucket, ctx,
+            )
+            self._paged_prefill[key] = self._build_paged_prefill(s_bucket, ctx)
+        ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, :s] = tokens
+        n_wp = s_bucket // ps
+        pids = np.zeros((n_wp,), np.int32)
+        pids[: min(len(write_pids), n_wp)] = write_pids[:n_wp]
+        row = np.zeros((max(ctx, 1),), np.int32)
+        row[: min(len(ctx_row), ctx)] = ctx_row[:ctx]
+        self.cache, first = self._paged_prefill[key](
+            self.params, self.cache,
+            jnp.asarray(row), jnp.asarray(ids), jnp.int32(d),
+            jnp.int32(s), jnp.float32(temp), jnp.float32(top_p), rng,
+            jnp.asarray(pids),
+        )
+        return first
+
     def _paged_prefill_chunk(self, req: Request, slot: int, d: int, s: int,
                              s_bucket: int, rng):
         """Run one paged prefill program call over prompt[d:d+s]."""
-        ps, maxp = self.page_size, self.maxp
-        s_bucket = min(_next_pow2(max(s_bucket, ps), floor=ps), maxp * ps)
-        if s_bucket not in self._paged_prefill:
-            logger.info("compiling paged prefill for bucket %d", s_bucket)
-            self._paged_prefill[s_bucket] = self._build_paged_prefill(s_bucket)
-        ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
-        ids[0, :s] = req.prompt[d: d + s]
-        n_wp = s_bucket // ps
-        write_pids = np.zeros((n_wp,), np.int32)
-        row = self._table[slot, d // ps: d // ps + n_wp]
-        write_pids[: len(row)] = row
-        self.cache, first = self._paged_prefill[s_bucket](
-            self.params, self.cache,
-            jnp.asarray(self._table[slot]), jnp.asarray(ids), jnp.int32(d),
-            jnp.int32(s), jnp.float32(req.temperature),
-            jnp.float32(req.top_p), rng, jnp.asarray(write_pids),
+        ps = self.page_size
+        return self._run_paged_prefill(
+            req.prompt[d: d + s], d, s, s_bucket,
+            ctx_row=self._table[slot],
+            write_pids=self._table[slot, d // ps:],
+            temp=req.temperature, top_p=req.top_p, rng=rng,
         )
-        return first
 
     def _admit_paged_slot(self, slot: int) -> bool:
         """Admit the queue head into ``slot`` (paged mode). Reserves the
