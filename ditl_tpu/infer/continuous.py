@@ -283,7 +283,10 @@ class ContinuousEngine:
         self._seed_cache: dict[int, Any] = {}
         self._suffix_prefill: dict[int, Any] = {}  # keyed by suffix bucket
         self._first_sampler: Any = None
-        self._paged_prefill: dict[tuple[int, int], Any] = {}  # (s_bucket, ctx_pages)
+        import collections as _collections
+
+        # (s_bucket, ctx_pages) -> compiled prefill program, LRU-bounded
+        self._paged_prefill: _collections.OrderedDict = _collections.OrderedDict()
         self._paged_decode: dict[tuple[bool, bool], Any] = {}
 
     # -- compiled programs --------------------------------------------------
@@ -940,12 +943,24 @@ class ContinuousEngine:
         sharing the prefix reuse them without prefilling. Full prompt pages
         are immutable (decode writes only past the prompt), so sharing is
         read-only by construction."""
+        self._publish_tokens(req.prompt, slot)
+
+    def _publish_tokens(self, tokens: list[int], slot: int) -> None:
         ps = self.page_size
-        n_full = len(req.prompt) // ps
+        n_full = len(tokens) // ps
         self.allocator.publish_chain(
-            req.prompt[: n_full * ps], ps,
+            tokens[: n_full * ps], ps,
             [int(p) for p in self._table[slot, :n_full]],
         )
+
+    def _publish_generated_pages(self, req: Request, slot: int) -> None:
+        """On natural completion, publish the pages covering prompt AND
+        generated tokens: a multi-turn follow-up whose prompt embeds this
+        turn's output (chat history) then reuses the whole conversation's
+        KV and prefills only the new user turn. Generated pages become
+        immutable the moment the slot stops decoding, and their content key
+        — (parent page, exact tokens) — verifies exactly like prompt pages."""
+        self._publish_tokens(req.prompt + req.tokens, slot)
 
     def _ctx_pages_bucket(self, d: int) -> int:
         """Gather-bucket (in pages) covering a context of ``d`` tokens."""
@@ -963,12 +978,19 @@ class ContinuousEngine:
         s_bucket = min(_next_pow2(max(s_bucket, ps), floor=ps), maxp * ps)
         ctx = self._ctx_pages_bucket(d)
         key = (s_bucket, ctx)
-        if key not in self._paged_prefill:
+        if key in self._paged_prefill:
+            self._paged_prefill.move_to_end(key)
+        else:
             logger.info(
                 "compiling paged prefill for bucket %d (ctx %d pages)",
                 s_bucket, ctx,
             )
             self._paged_prefill[key] = self._build_paged_prefill(s_bucket, ctx)
+            # LRU bound (same rationale as Generator._compiled): the
+            # (chunk, ctx) keyspace is ~|s_buckets| x log2(maxp); a pruned
+            # program recompiles on next use.
+            while len(self._paged_prefill) > 32:
+                self._paged_prefill.popitem(last=False)
         ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
         ids[0, :s] = tokens
         n_wp = s_bucket // ps
@@ -1093,6 +1115,10 @@ class ContinuousEngine:
                 self._completed[req.req_id] = req
                 self._slots[slot] = None
                 if self.cache_mode == "paged":
+                    # Publish before releasing: the content cache's own
+                    # reference keeps the conversation's pages resident
+                    # (and LRU-evictable) for follow-up turns.
+                    self._publish_generated_pages(req, slot)
                     self._free_slot_pages(slot)
 
     def step(self) -> None:

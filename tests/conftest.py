@@ -140,6 +140,7 @@ _SLOW_TESTS = {
     "tests/test_multilora.py::test_adapter_selection_matches_single_adapter_models",
     "tests/test_multilora.py::test_server_routes_model_field_to_adapter",
     "tests/test_multilora.py::test_zero_adapter_equals_base_model",
+    "tests/test_paged.py::test_generated_pages_reused_across_turns",
     "tests/test_paged.py::test_paged_automatic_prefix_reuse",
     "tests/test_paged.py::test_paged_cancel_frees_pages",
     "tests/test_paged.py::test_paged_capacity_exceeds_contiguous_equivalent",

@@ -430,3 +430,38 @@ def test_paged_mesh_rejects_undividable_heads(tiny_setup):
     mesh = build_mesh(MeshConfig(tensor=8))
     with pytest.raises(ValueError, match="heads"):
         _paged_engine(params, cfg, mesh=mesh)
+
+
+def test_generated_pages_reused_across_turns(tiny_setup):
+    """Multi-turn chat pattern: turn 2's prompt embeds turn 1's prompt AND
+    its generated output; the whole previous conversation's pages are reused
+    and only the new user turn prefills."""
+    cfg, params = tiny_setup
+    tok = ByteTokenizer()
+    eng = _paged_engine(params, cfg, gen=GenerateConfig(max_new_tokens=32))
+    turn1_prompt = [1] + tok.encode("u" * 60)
+    rid = eng.submit(turn1_prompt)
+    out1 = eng.run()[rid]
+    assert len(out1) >= 4
+    history = turn1_prompt + out1
+    calls = []
+    orig = eng._paged_prefill_chunk
+
+    def spy(req, slot, d, s, s_bucket, rng):
+        calls.append((d, s))
+        return orig(req, slot, d, s, s_bucket, rng)
+
+    eng._paged_prefill_chunk = spy
+    turn2 = history + tok.encode(" next question")
+    rid2 = eng.submit(turn2)
+    out2 = eng.run()[rid2]
+    assert len(out2) >= 1
+    d, s = calls[0]
+    # reuse must extend past the prompt-only region into generated pages
+    ps = eng.page_size
+    assert d >= (len(history) - 1) // ps * ps - ps, (d, len(history))
+    assert d > (len(turn1_prompt) // ps) * ps - 1, (d, len(turn1_prompt))
+    # exactness: a cold engine gives the same turn-2 output
+    cold = _paged_engine(params, cfg, gen=GenerateConfig(max_new_tokens=32))
+    rid3 = cold.submit(turn2)
+    assert cold.run()[rid3] == out2
