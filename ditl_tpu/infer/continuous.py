@@ -977,20 +977,18 @@ class ContinuousEngine:
         ps, maxp = self.page_size, self.maxp
         s_bucket = min(_next_pow2(max(s_bucket, ps), floor=ps), maxp * ps)
         ctx = self._ctx_pages_bucket(d)
+        from ditl_tpu.infer.engine import lru_program
+
         key = (s_bucket, ctx)
-        if key in self._paged_prefill:
-            self._paged_prefill.move_to_end(key)
-        else:
+
+        def build():
             logger.info(
                 "compiling paged prefill for bucket %d (ctx %d pages)",
                 s_bucket, ctx,
             )
-            self._paged_prefill[key] = self._build_paged_prefill(s_bucket, ctx)
-            # LRU bound (same rationale as Generator._compiled): the
-            # (chunk, ctx) keyspace is ~|s_buckets| x log2(maxp); a pruned
-            # program recompiles on next use.
-            while len(self._paged_prefill) > 32:
-                self._paged_prefill.popitem(last=False)
+            return self._build_paged_prefill(s_bucket, ctx)
+
+        program = lru_program(self._paged_prefill, key, build)
         ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
         ids[0, :s] = tokens
         n_wp = s_bucket // ps
@@ -998,7 +996,7 @@ class ContinuousEngine:
         pids[: min(len(write_pids), n_wp)] = write_pids[:n_wp]
         row = np.zeros((max(ctx, 1),), np.int32)
         row[: min(len(ctx_row), ctx)] = ctx_row[:ctx]
-        self.cache, first = self._paged_prefill[key](
+        self.cache, first = program(
             self.params, self.cache,
             jnp.asarray(row), jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.float32(temp), jnp.float32(top_p), rng,
@@ -1159,6 +1157,32 @@ class ContinuousEngine:
     def pending(self) -> int:
         return len(self._queue) + sum(r is not None for r in self._slots)
 
+    def stats(self) -> dict:
+        """Operational snapshot (host state only — no device sync): slot
+        occupancy, queue depth, and page-pool accounting in paged mode.
+        Served at the HTTP layer as /v1/stats."""
+        out = {
+            "engine": "continuous",
+            "cache_mode": self.cache_mode,
+            "n_slots": self.n_slots,
+            "slots_busy": sum(r is not None for r in self._slots),
+            "slots_prefilling": sum(
+                r is not None and r.prefilling for r in self._slots
+            ),
+            "queue_depth": len(self._queue),
+            "max_queue": self.max_queue,
+            "decode_chunk": self.decode_chunk,
+            "max_context": self.smax,
+        }
+        if self.cache_mode == "paged":
+            out.update({
+                "page_size": self.page_size,
+                "pages_total": self.n_pages - 1,  # page 0 is the sentinel
+                "pages_free": self.allocator.n_free,
+                "pages_cached_evictable": self.allocator.n_evictable,
+            })
+        return out
+
     def run(self) -> dict[int, list[int]]:
         """Drive until all submitted requests complete; pops and returns the
         finished requests' token lists by id (no unbounded history kept)."""
@@ -1233,6 +1257,9 @@ class ThreadedEngine:
     @property
     def tokenizer(self) -> Tokenizer:
         return self._engine.tokenizer
+
+    def stats(self) -> dict:
+        return self._engine.stats()
 
     @property
     def queue_full(self) -> bool:

@@ -55,6 +55,21 @@ class GenerateConfig:
     logprobs: int = 0
 
 
+def lru_program(cache, key, build, bound: int = 32):
+    """Bounded compile-cache access: move-to-front on hit, build on miss,
+    evict oldest past ``bound``. Compile keys include client-controlled
+    fields (max_tokens, temperature...), so every program cache on a
+    serving path must be bounded or it is an unbounded memory leak."""
+    if key in cache:
+        cache.move_to_end(key)
+        return cache[key]
+    prog = build()
+    cache[key] = prog
+    while len(cache) > bound:
+        cache.popitem(last=False)
+    return prog
+
+
 def _next_pow2(n: int, floor: int = 16) -> int:
     p = floor
     while p < n:
@@ -211,13 +226,10 @@ class Generator:
         # seed is runtime data (the rng argument), not part of the program —
         # keep it out of the compile key or every new seed recompiles.
         key = (batch, prompt_len, dataclasses.replace(gen, seed=0))
-        if key not in self._compiled:
-            self._compiled[key] = self._build(batch, prompt_len, gen)
-            while len(self._compiled) > self._compile_cache_size:
-                self._compiled.popitem(last=False)
-        else:
-            self._compiled.move_to_end(key)
-        return self._compiled[key]
+        return lru_program(
+            self._compiled, key, lambda: self._build(batch, prompt_len, gen),
+            bound=self._compile_cache_size,
+        )
 
     # -- public surface -----------------------------------------------------
 

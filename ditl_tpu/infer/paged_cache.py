@@ -64,6 +64,12 @@ class PageAllocator:
         self._ref = [0] * n_pages
         self._key_to_page: dict[PageKey, int] = {}
         self._page_key: dict[int, PageKey] = {}
+        # parent physical page -> keys of published children chained to it.
+        # Needed so evicting a parent CASCADES: a child key (parent_pid,
+        # tokens) left behind after parent_pid is recycled and republished
+        # with different content would match a later prompt and serve KV
+        # computed under the OLD prefix — silent cross-request corruption.
+        self._children: dict[int, set[PageKey]] = {}
         # Insertion-ordered: oldest published key evicts first.
         self._lru: OrderedDict[PageKey, None] = OrderedDict()
 
@@ -75,8 +81,10 @@ class PageAllocator:
 
     @property
     def n_evictable(self) -> int:
+        # list() snapshots atomically under the GIL: /v1/stats reads this
+        # from HTTP threads while the driver thread publishes/evicts.
         return sum(
-            1 for k, p in self._key_to_page.items() if self._ref[p] == 1
+            1 for k, p in list(self._key_to_page.items()) if self._ref[p] == 1
         )
 
     # -- alloc / free --------------------------------------------------------
@@ -105,15 +113,37 @@ class PageAllocator:
         for key in self._lru:
             pid = self._key_to_page[key]
             if self._ref[pid] == 1:  # only the content cache holds it
-                self._unpublish(key, pid)
+                self._unpublish(key, pid, claimed=True)
                 return pid
         return None
 
-    def _unpublish(self, key: PageKey, pid: int) -> None:
+    def _unpublish(self, key: PageKey, pid: int, *, claimed: bool) -> None:
+        """Remove a published key (and cascade through descendants).
+
+        ``claimed=True`` means the caller (eviction inside ``alloc``) takes
+        ownership of ``pid`` directly — it must NOT also land on the free
+        list. Cascaded descendants are never claimed: dropping the cache's
+        reference frees them when nothing else holds them (in-flight users
+        keep their refcounts; only matchability and the cache ref go)."""
         del self._key_to_page[key]
         del self._page_key[pid]
         self._lru.pop(key, None)
-        self._ref[pid] -= 1  # the cache's own reference
+        parent_kids = self._children.get(key[0])
+        if parent_kids is not None:
+            parent_kids.discard(key)
+            if not parent_kids:
+                del self._children[key[0]]
+        # Cascade: children's keys chain through THIS physical id; once it
+        # can be recycled, those keys would verify against the wrong
+        # content.
+        for child_key in list(self._children.pop(pid, ())):
+            child_pid = self._key_to_page.get(child_key)
+            if child_pid is not None:
+                self._unpublish(child_key, child_pid, claimed=False)
+        if claimed:
+            self._ref[pid] -= 1  # the cache's reference passes to the caller
+        else:
+            self.release(pid)  # the cache's own reference
 
     def retain(self, pid: int) -> None:
         self._ref[pid] += 1
@@ -144,6 +174,7 @@ class PageAllocator:
             return  # first publisher wins; the duplicate stays private
         self._key_to_page[key] = pid
         self._page_key[pid] = key
+        self._children.setdefault(key[0], set()).add(key)
         self._lru[key] = None
         self._ref[pid] += 1
 

@@ -383,6 +383,12 @@ class PodContinuousDriver:
         self._pump = threading.Thread(target=self._pump_loop, daemon=True)
         self._pump.start()
 
+    def stats(self) -> dict:
+        eng_stats = self._engine.stats()
+        eng_stats["pod"] = True
+        eng_stats["staged"] = len(self._staged)
+        return eng_stats
+
     @property
     def queue_full(self) -> bool:
         # Lock-free on purpose: _stage calls this while holding _cond (the

@@ -145,6 +145,21 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path in ("/health", "/v1/health"):
             self._send_json(200, {"status": "ok", "model": self.model_name})
+        elif self.path in ("/v1/stats", "/stats"):
+            stats = {"model": self.model_name, "engine": "lockstep"}
+            eng = self._engine_for_stats()
+            if eng is not None:
+                stats.update(eng.stats())
+            spec = self.spec_generator
+            if spec is not None:
+                stats["speculative"] = True
+                acc = getattr(spec, "acceptance_ema", None)
+                inner = getattr(spec, "spec", spec)
+                if acc is None:
+                    acc = getattr(inner, "last_acceptance", None)
+                if acc is not None:
+                    stats["speculative_acceptance"] = round(acc, 3)
+            self._send_json(200, stats)
         elif self.path in ("/v1/models", "/models"):
             models = [{"id": self.model_name, "object": "model"}] + [
                 {"id": name, "object": "model", "parent": self.model_name}
@@ -153,6 +168,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"object": "list", "data": models})
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _engine_for_stats(self):
+        """The serving driver, if any (both drivers expose ``stats()``)."""
+        return self.threaded_engine
 
     def do_POST(self):
         try:

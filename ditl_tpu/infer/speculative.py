@@ -395,14 +395,15 @@ class SpeculativeGenerator:
             ids[i, : len(toks)] = toks
             lengths[i] = len(toks)
 
+        from ditl_tpu.infer.engine import lru_program
+
         key = (batch, prompt_len, max_new_tokens)
-        if key in self._compiled:
-            self._compiled.move_to_end(key)
-        else:
-            self._compiled[key] = self._build(batch, prompt_len, max_new_tokens)
-            while len(self._compiled) > self._compile_cache_size:
-                self._compiled.popitem(last=False)
-        out, rounds, n_out = self._compiled[key](
+        program = lru_program(
+            self._compiled, key,
+            lambda: self._build(batch, prompt_len, max_new_tokens),
+            bound=self._compile_cache_size,
+        )
+        out, rounds, n_out = program(
             self.params, jnp.asarray(ids), jnp.asarray(lengths), jnp.int32(n)
         )
         out = np.asarray(jax.device_get(out))

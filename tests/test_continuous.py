@@ -555,3 +555,40 @@ def test_continuous_engine_on_mesh_matches_single_device(setup):
     mesh = build_mesh(MeshConfig(data=2, tensor=2, fsdp=2))
     eng = ContinuousEngine(params, cfg, tok, n_slots=4, gen=gen, mesh=mesh)
     assert eng.generate(prompts) == ref
+
+
+def test_stats_endpoint(setup):
+    """/v1/stats reports slot occupancy, queue depth and (paged) pool state
+    without touching the device."""
+    import json
+    import threading
+    import urllib.request
+
+    from ditl_tpu.infer.continuous import ThreadedEngine
+    from ditl_tpu.infer.server import make_server
+
+    params, cfg, tok = setup
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=3, gen=GenerateConfig(max_new_tokens=4),
+        cache_mode="paged", page_size=16, max_queue=7,
+    )
+    threaded = ThreadedEngine(eng)
+    server = make_server(
+        Generator(params, cfg, tok), port=0, threaded_engine=threaded,
+    )
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.server_address[1]}/v1/stats", timeout=30
+        ) as r:
+            stats = json.loads(r.read())
+        assert stats["cache_mode"] == "paged"
+        assert stats["n_slots"] == 3
+        assert stats["slots_busy"] == 0
+        assert stats["max_queue"] == 7
+        assert stats["pages_total"] == eng.n_pages - 1
+        assert stats["pages_free"] <= stats["pages_total"]
+    finally:
+        server.shutdown()
+        threaded.close()

@@ -465,3 +465,29 @@ def test_generated_pages_reused_across_turns(tiny_setup):
     cold = _paged_engine(params, cfg, gen=GenerateConfig(max_new_tokens=32))
     rid3 = cold.submit(turn2)
     assert cold.run()[rid3] == out2
+
+
+def test_evicting_parent_cascades_to_children():
+    """Evicting a published parent page must also unpublish every descendant
+    chained through its physical id: after the id is recycled with new
+    content, a stale child key would match a later prompt and serve KV
+    computed under the OLD prefix — silent cross-request corruption."""
+    ps = 4
+    a = PageAllocator(6)  # pages 1..5
+    toks = list(range(12))  # 3 full pages: p1 -> p2 -> p3
+    pages = a.alloc(3)
+    a.publish_chain(toks, ps, pages)
+    for p in pages:
+        a.release(p)  # cache-only refs now
+    # exhaust the free list (2 pages) then force eviction of the oldest
+    # published page (the chain's parent)
+    got = a.alloc(3)
+    assert pages[0] in got  # the parent was evicted and claimed
+    # every descendant became unmatchable AND reclaimable (alloc got 3)
+    assert a.match_prefix(toks + [0], ps) == []
+    assert a.n_evictable == 0
+    # refcounts stayed consistent: the remaining chain pages were freed by
+    # the cascade, so the allocator can hand out the full pool again
+    for p in got:
+        a.release(p)
+    assert sorted(a.alloc(5)) == [1, 2, 3, 4, 5]
