@@ -188,6 +188,29 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
+    def _lockstep_generate(self, prompt_ids, gen, adapter_ids) -> list:
+        """One lock-step generation, speculatively when eligible: greedy,
+        no adapter, and the spec program's k+1 KV slack fits (ValueError
+        falls back to the plain Generator). Outputs are token-identical by
+        the speculation exactness contract. Used by both the streaming and
+        non-streaming paths."""
+        if (
+            self.spec_generator is not None
+            and gen.temperature == 0.0
+            and adapter_ids is None
+        ):
+            try:
+                with self.device_lock:
+                    return self.spec_generator.generate_tokens(
+                        [prompt_ids], gen.max_new_tokens
+                    )[0]
+            except ValueError:
+                pass
+        with self.device_lock:
+            return self.generator.generate_tokens(
+                [prompt_ids], gen, adapter_ids
+            )[0]
+
     def _send_sse(self, events) -> None:
         """Stream pre-serialized JSON events as Server-Sent Events."""
         self.send_response(200)
@@ -249,11 +272,12 @@ class _Handler(BaseHTTPRequestHandler):
                 if tail:
                     yield event(tail)
             else:
+                # The lock-step stream generates fully before emitting, so
+                # greedy streamed requests benefit from speculation the same
+                # way non-streaming ones do.
                 tok = self.generator.tokenizer
-                with self.device_lock:
-                    out = self.generator.generate_tokens(
-                        [[tok.bos_id] + tok.encode(prompt)], gen, adapter_ids
-                    )[0]
+                prompt_ids = [tok.bos_id] + tok.encode(prompt)
+                out = self._lockstep_generate(prompt_ids, gen, adapter_ids)
                 n_gen = len(out)
                 text, hit = _apply_stop(tok.decode(out), tracker.stops)
                 if hit:
@@ -452,32 +476,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 tok = self.generator.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
-                if (
-                    self.spec_generator is not None
-                    and gen.temperature == 0.0
-                    and adapter_ids is None
-                ):
-                    # Greedy requests ride the speculative (or acceptance-
-                    # gated auto-speculative) path — token-identical to the
-                    # plain Generator by the speculation exactness contract.
-                    try:
-                        with self.device_lock:
-                            out = self.spec_generator.generate_tokens(
-                                [prompt_ids], gen.max_new_tokens
-                            )[0]
-                    except ValueError:
-                        # The spec program needs k+1 extra KV slots; near-
-                        # max-context requests that the plain path can still
-                        # serve fall back instead of erroring.
-                        with self.device_lock:
-                            out = self.generator.generate_tokens(
-                                [prompt_ids], gen, adapter_ids
-                            )[0]
-                else:
-                    with self.device_lock:
-                        out = self.generator.generate_tokens(
-                            [prompt_ids], gen, adapter_ids
-                        )[0]
+                out = self._lockstep_generate(prompt_ids, gen, adapter_ids)
                 n_gen = len(out)
                 text, hit_stop = _apply_stop(tok.decode(out), stops)
                 n_prompt = len(prompt_ids)
@@ -543,7 +542,7 @@ def make_server(
     ``adapter_names`` maps OpenAI "model" names to multi-LoRA adapter ids
     (the generator's params must be a stacked-adapter tree);
     ``spec_generator`` (Speculative/AutoSpeculativeGenerator) serves greedy
-    non-streaming lock-step requests speculatively."""
+    lock-step requests — streaming and non-streaming — speculatively."""
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -587,8 +586,8 @@ def serve(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--speculative", choices=("off", "on", "auto"), default="off",
-        help="prompt-lookup speculative decoding for greedy non-streaming "
-        "requests (--engine lockstep): 'on' always speculates, 'auto' "
+        help="prompt-lookup speculative decoding for greedy requests "
+        "(--engine lockstep, streamed or not): 'on' always speculates, 'auto' "
         "enables per request from measured acceptance "
         "(infer/speculative.py; outputs stay token-identical)",
     )

@@ -337,3 +337,42 @@ def test_spec_compile_cache_is_bounded(tiny_setup_f32):
     for m in range(2, 8):  # 6 distinct client-controlled compile keys
         spec.generate_tokens([prompt], max_new_tokens=m)
     assert len(spec._compiled) <= 3
+
+
+def test_server_speculative_streaming_matches_plain(tiny_setup_f32):
+    """Greedy STREAMED lock-step requests also ride the speculative path;
+    assembled SSE text equals the plain server's completion."""
+    import json
+    import threading
+    import urllib.request
+
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params = tiny_setup_f32
+    tok = ByteTokenizer()
+    plain = Generator(params, cfg, tok)
+    spec = SpeculativeGenerator(params, cfg, tok, k=4)
+    server = make_server(plain, port=0, default_max_tokens=8,
+                         spec_generator=spec)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.server_address[1]}/v1/completions",
+            data=json.dumps({"prompt": "hello world", "max_tokens": 8,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        pieces = []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for line in r:
+                line = line.decode().strip()
+                if line.startswith("data:") and line != "data: [DONE]":
+                    chunk = json.loads(line[5:])
+                    pieces.append(chunk["choices"][0]["text"] or "")
+        streamed = "".join(pieces)
+        ref = plain.generate(["hello world"], GenerateConfig(max_new_tokens=8))[0]
+        assert streamed == ref
+        assert spec.last_rounds > 0  # the speculative path actually ran
+    finally:
+        server.shutdown()
