@@ -25,6 +25,27 @@ The scheduler (``submit``/``step``/``run``) is deliberately host-side and
 simple — admission policy is not a TPU problem. Per-request sampling params
 are supported for temperature 0/>0 mixtures by keeping sampling greedy when
 ``temperature == 0`` per-slot (a (B,) vector fed to the tick program).
+
+**Speculative ticks** (``speculative=True``): when every active slot is
+greedy, the decode tick can run as ``spec_rounds`` verify rounds instead of
+``decode_chunk`` single-token steps. Each round drafts ``spec_k`` tokens per
+slot by on-device prompt lookup over a per-slot token-history buffer
+(infer/speculative.device_lookup_draft — the history rides the tick carry,
+so drafting re-fires after every accepted span with zero host round-trips),
+verifies them with ONE (B, K+1)-token forward (per-row scatter cache writes
+at each slot's own depth — the ragged-depth machinery chunked prefill
+already uses), and emits the accepted prefix plus the verify forward's bonus
+token. Rejected draft positions leave stale KV that stays masked and is
+overwritten by the next round's write window (same invariant as
+infer/speculative.py). Greedy speculative output is token-identical to the
+plain tick (exact arithmetic; pinned in f32 by tests). Because acceptance is
+a workload property, the engine auto-decides per tick from per-REQUEST
+measured acceptance (tokens per verify forward per row, EMA-smoothed, probed
+periodically) against the verify/decode cost-ratio threshold — slots whose
+requests historically accept keep speculation on; a batch of low-acceptance
+requests falls back to plain ticks. Composes with ``cache_mode="paged"``
+(accepted tokens land in the deferred-flush tail; the verify runs through a
+multi-query paged-attention kernel) and int8 KV.
 """
 
 from __future__ import annotations
@@ -85,6 +106,11 @@ class Request:
     # Streaming: when set, every harvest pushes this chunk's new token ids
     # (list[int]); a final ``None`` marks completion.
     stream: Any = None
+    # Measured speculative acceptance for THIS request: tokens emitted
+    # across its speculative rounds / verify forwards it participated in.
+    # Drives the per-tick speculate-or-not decision (see step()).
+    spec_tokens: int = 0
+    spec_forwards: int = 0
 
 
 class ContinuousEngine:
@@ -108,6 +134,14 @@ class ContinuousEngine:
         max_queue: int | None = None,
         mesh=None,
         rules=None,
+        speculative: bool = False,
+        spec_k: int = 8,
+        spec_ngram: int = 3,
+        spec_min_ngram: int = 1,
+        spec_rounds: int | None = None,
+        spec_threshold: float | None = None,
+        spec_probe_every: int = 32,
+        spec_ema: float = 0.7,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -145,6 +179,18 @@ class ContinuousEngine:
 
         ``max_queue`` caps how many requests may wait for a slot; ``submit``
         raises ``QueueFullError`` beyond it (HTTP layer: 429).
+
+        ``speculative=True`` arms speculative decode ticks (module
+        docstring): ``spec_k`` drafted tokens per round via prompt lookup
+        with n-gram backoff ``spec_ngram`` → ``spec_min_ngram``,
+        ``spec_rounds`` verify rounds per tick (default: enough rounds to
+        match ``decode_chunk`` tokens at full acceptance). A tick runs
+        speculatively only when every active slot is greedy AND the
+        acceptance the engine predicts for the current slots (per-request
+        measured tokens/forward, EMA ``spec_ema``, re-probed every
+        ``spec_probe_every`` ticks) clears ``spec_threshold`` — the
+        verify/decode cost ratio (default from
+        ``calibrate_spec_threshold``'s conservative prior, ~2.5 on v5e).
 
         ``mesh`` shards the engine's programs over a device mesh (same rule
         table as training, parallel/sharding.py): the cache shards batch
@@ -289,6 +335,42 @@ class ContinuousEngine:
         self._paged_prefill: _collections.OrderedDict = _collections.OrderedDict()
         self._paged_decode: dict[tuple[bool, bool], Any] = {}
 
+        # -- speculative decode ticks -----------------------------------
+        self.speculative = speculative
+        if speculative:
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            if not (1 <= spec_min_ngram <= spec_ngram):
+                raise ValueError(
+                    f"spec_min_ngram must be in [1, spec_ngram], got "
+                    f"{spec_min_ngram}"
+                )
+            self.spec_k = spec_k
+            self.spec_ngram = spec_ngram
+            self.spec_min_ngram = spec_min_ngram
+            self.spec_rounds = spec_rounds or max(
+                1, -(-decode_chunk // (spec_k + 1))
+            )
+            if self.spec_rounds < 1:
+                raise ValueError(f"spec_rounds must be >= 1, got {spec_rounds}")
+            self.spec_threshold = (
+                spec_threshold if spec_threshold is not None else 2.5
+            )
+            self.spec_probe_every = spec_probe_every
+            self._spec_ema_w = spec_ema
+            self.spec_acceptance_ema: float | None = None
+            self.spec_ticks = 0
+            self._tick_no = 0
+            self._spec_decode: dict[bool, Any] = {}  # key: paged?
+        # Per-slot token history (prompt + generated incl. the pending
+        # ``cur``) — the draft source for speculative ticks. Rides the tick
+        # carry; host writes it only at admission. 1-wide dummy when
+        # speculation is off (the programs take it either way; XLA drops the
+        # dead argument).
+        self.hist = jnp.zeros(
+            (n_slots, self.smax if speculative else 1), jnp.int32
+        )
+
     # -- compiled programs --------------------------------------------------
 
     def _build_prefill(self, p_bucket: int):
@@ -332,14 +414,17 @@ class ContinuousEngine:
     def _build_decode(self, sampled: bool, topp: bool):
         """One decode program per (any-slot-sampled, any-top-p) combination:
         all-greedy ticks compile to pure argmax — no per-step vocab sort,
-        softmax, or categorical that a ``where`` would discard."""
+        softmax, or categorical that a ``where`` would discard. With
+        ``speculative`` armed, the per-slot token history rides the carry so
+        a later speculative tick drafts from fresh context."""
         cfg, smax, pad, eos = self.cfg, self.smax, self.tokenizer.pad_id, self.tokenizer.eos_id
         slots_iota = jnp.arange(smax, dtype=jnp.int32)
         chunk = self.decode_chunk
+        track = self.speculative
 
-        def run(params, cache, cur, pos, alive, temps, top_ps, keys):
+        def run(params, cache, cur, pos, alive, temps, top_ps, keys, hist):
             def body(carry, _):
-                cache, cur, pos, done, keys = carry
+                cache, cur, pos, done, keys, hist = carry
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
                 mask = (slots_iota[None, :] <= pos[:, None])[:, None, :]  # (B,1,Smax)
@@ -365,12 +450,103 @@ class ContinuousEngine:
                 done = done | (cur == eos)
                 pos = jnp.where(step_alive, jnp.minimum(pos + 1, smax - 1), pos)
                 cur = jnp.where(done, pad, nxt)
-                return (cache, cur, pos, done, keys), emit
+                if track:
+                    from ditl_tpu.infer.speculative import _emit_rows
 
-            (cache, cur, pos, done, keys), toks = jax.lax.scan(
-                body, (cache, cur, pos, ~alive, keys), None, length=chunk
+                    grow = (~done).astype(jnp.int32)
+                    hist = _emit_rows(hist, cur[:, None], pos, grow)
+                return (cache, cur, pos, done, keys, hist), emit
+
+            (cache, cur, pos, done, keys, hist), toks = jax.lax.scan(
+                body, (cache, cur, pos, ~alive, keys, hist), None, length=chunk
             )
-            return cache, cur, pos, keys, toks.T  # toks: (B, chunk)
+            return cache, cur, pos, keys, hist, toks.T  # toks: (B, chunk)
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _build_spec_decode(self):
+        """Speculative decode tick, contiguous cache (module docstring):
+        ``spec_rounds`` rounds of draft → (B, K+1) verify forward → accept.
+        Greedy-only (rejection-sampling for temperature > 0 changes the
+        acceptance rule; sampled slots force plain ticks). Emissions are
+        compacted per row (prefix of the output buffer) with a per-row
+        count, because a round emits 1..K+1 tokens — harvest consumes
+        ``toks[b, :counts[b]]`` instead of pad-scanning."""
+        cfg, smax = self.cfg, self.smax
+        pad, eos = self.tokenizer.pad_id, self.tokenizer.eos_id
+        k, rounds = self.spec_k, self.spec_rounds
+        ngram, min_ngram = self.spec_ngram, self.spec_min_ngram
+        out_len = rounds * (k + 1)
+        slots_iota = jnp.arange(smax, dtype=jnp.int32)
+        q_idx = jnp.arange(k + 1, dtype=jnp.int32)
+
+        from ditl_tpu.infer.speculative import _emit_rows, device_lookup_draft
+
+        def run(params, cache, cur, pos, alive, hist):
+            n_b = pos.shape[0]
+            out0 = jnp.full((n_b, out_len), pad, jnp.int32)
+            zeros = jnp.zeros((n_b,), jnp.int32)
+
+            def body(carry, _):
+                cache, cur, pos, done, hist, out, n_out, rr = carry
+                live = ~done
+                # ctx_len = pos + 1: hist[pos] holds the pending ``cur``.
+                draft = device_lookup_draft(
+                    hist, jnp.minimum(pos + 1, smax), k=k, ngram=ngram,
+                    min_ngram=min_ngram,
+                )  # (B, k)
+                tokens_in = jnp.concatenate([cur[:, None], draft], axis=1)
+                positions = pos[:, None] + q_idx[None, :]  # (B, K+1)
+                mask = slots_iota[None, None, :] <= positions[:, :, None]
+                logits, cache = llama.forward(
+                    params, tokens_in, cfg, positions=positions,
+                    cache=cache, cache_index=pos, attn_mask=mask,
+                    mesh=self.mesh, rules=self.rules,
+                )
+                cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, K+1)
+                eq = tokens_in[:, 1:] == cand[:, :k]
+                n_acc = jnp.sum(
+                    jnp.cumprod(eq.astype(jnp.int32), axis=-1), axis=-1
+                )  # (B,) accepted draft tokens
+                # Emission sequence: [cur, accepted drafts...] — index j
+                # emits the token at global position pos + j. The bonus
+                # (cand[n_acc]) becomes the next round's ``cur`` and is NOT
+                # emitted (same pending-token convention as the plain tick).
+                emit_seq = jnp.concatenate([cur[:, None], cand[:, :k]], axis=1)
+                in_span = q_idx[None, :] <= n_acc[:, None]
+                is_term = (emit_seq == eos) | (emit_seq == pad)
+                term_before = (
+                    jnp.cumsum(is_term.astype(jnp.int32), axis=1)
+                    - is_term.astype(jnp.int32)
+                ) > 0
+                emit = in_span & ~term_before & live[:, None]
+                e = jnp.sum(emit.astype(jnp.int32), axis=1)  # (B,)
+                hit_term = jnp.any(emit & is_term, axis=1)
+                out = _emit_rows(out, emit_seq, n_out, e)
+                n_out = n_out + e
+                # History gains positions pos+1 .. pos+e: accepted drafts
+                # plus the bonus (cand[:e] exactly — the bonus IS cand[e-1]
+                # when nothing truncated).
+                grow = jnp.where(hit_term, 0, e)
+                hist = _emit_rows(
+                    hist, cand, jnp.minimum(pos + 1, smax), grow
+                )
+                pos = jnp.where(
+                    live, jnp.minimum(pos + e, smax - 1), pos
+                )
+                done = done | hit_term
+                cur = jnp.where(
+                    done, pad,
+                    jnp.take_along_axis(cand, n_acc[:, None], axis=1)[:, 0],
+                )
+                rr = rr + live.astype(jnp.int32)
+                return (cache, cur, pos, done, hist, out, n_out, rr), None
+
+            (cache, cur, pos, done, hist, out, n_out, rr), _ = jax.lax.scan(
+                body, (cache, cur, pos, ~alive, hist, out0, zeros, zeros),
+                None, length=rounds,
+            )
+            return cache, cur, pos, hist, out, n_out, rr
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -554,9 +730,10 @@ class ContinuousEngine:
         dt = jnp.dtype(cfg.dtype)
 
         quantized = cfg.kv_cache_dtype == "int8"
+        track = self.speculative
 
         def run(params, pools, cur, pos, alive, temps, top_ps, keys, table,
-                limits):
+                limits, hist):
             n_b = pos.shape[0]
             b_iota = jnp.arange(n_b, dtype=jnp.int32)
             # starts = pos (not where(alive, pos, 0)): dead rows then have
@@ -569,7 +746,7 @@ class ContinuousEngine:
             cache_const = dict(pools)  # pools are read-only during the scan
 
             def body(carry, t):
-                tk, tv, cur, pos, done, keys = carry
+                tk, tv, cur, pos, done, keys, hist = carry
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
                 done = done | (pos >= limits)
@@ -600,10 +777,15 @@ class ContinuousEngine:
                 done = done | (cur == eos)
                 pos = jnp.where(step_alive, pos + 1, pos)
                 cur = jnp.where(done, pad, nxt)
-                return (tk, tv, cur, pos, done, keys), emit
+                if track:
+                    from ditl_tpu.infer.speculative import _emit_rows
 
-            (tk, tv, cur, pos, done, keys), toks = jax.lax.scan(
-                body, (tk0, tv0, cur, pos, ~alive, keys),
+                    grow = (~done).astype(jnp.int32)
+                    hist = _emit_rows(hist, cur[:, None], pos, grow)
+                return (tk, tv, cur, pos, done, keys, hist), emit
+
+            (tk, tv, cur, pos, done, keys, hist), toks = jax.lax.scan(
+                body, (tk0, tv0, cur, pos, ~alive, keys, hist),
                 jnp.arange(chunk, dtype=jnp.int32),
             )
 
@@ -653,7 +835,7 @@ class ContinuousEngine:
             else:
                 out["kp"] = flush(pools["kp"], tk)
                 out["vp"] = flush(pools["vp"], tv)
-            return out, cur, pos, keys, toks.T
+            return out, cur, pos, keys, hist, toks.T
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -901,6 +1083,7 @@ class ContinuousEngine:
                 self.cur = self.cur.at[req.slot].set(first)
                 self.pos = self.pos.at[req.slot].set(len(req.prompt))
                 self.keys = self.keys.at[req.slot].set(slot_key)
+                self._set_hist(req.slot, req.prompt, first)
             return
         d = req.prefill_pos
         s = min(self.prefill_chunk, len(req.prompt) - d)
@@ -929,6 +1112,20 @@ class ContinuousEngine:
             self.cur = self.cur.at[req.slot].set(first)
             self.pos = self.pos.at[req.slot].set(len(req.prompt))
             self.keys = self.keys.at[req.slot].set(slot_key)
+            self._set_hist(req.slot, req.prompt, first)
+
+    def _set_hist(self, slot: int, prompt: list[int], first) -> None:
+        """Seed the slot's draft history: prompt tokens plus the pending
+        first sampled token (``hist[pos] == cur`` is the tick invariant).
+        ``first`` stays a device scalar — no host sync on admission."""
+        if not self.speculative:
+            return
+        row = np.zeros((self.smax,), np.int32)
+        n = min(len(prompt), self.smax - 1)
+        row[:n] = prompt[:n]
+        self.hist = (
+            self.hist.at[slot].set(jnp.asarray(row)).at[slot, n].set(first)
+        )
 
     # -- paged admission / prefill -------------------------------------------
 
@@ -1051,6 +1248,7 @@ class ContinuousEngine:
             self._publish_prompt_pages(req, slot)
             self.cur = self.cur.at[slot].set(first)
             self.pos = self.pos.at[slot].set(len(req.prompt))
+            self._set_hist(slot, req.prompt, first)
         self.temps = self.temps.at[slot].set(req.temperature)
         self.top_ps = self.top_ps.at[slot].set(req.top_p)
         self.keys = self.keys.at[slot].set(slot_key)
@@ -1084,11 +1282,16 @@ class ContinuousEngine:
             else:
                 self.cur = self.cur.at[slot].set(first)
                 self.pos = self.pos.at[slot].set(len(req.prompt))
+                self._set_hist(slot, req.prompt, first)
             self.temps = self.temps.at[slot].set(req.temperature)
             self.top_ps = self.top_ps.at[slot].set(req.top_p)
             self.keys = self.keys.at[slot].set(slot_key)
 
-    def _harvest(self, emitted: np.ndarray) -> None:
+    def _harvest(self, emitted: np.ndarray, counts: np.ndarray | None = None) -> None:
+        """``counts`` (speculative ticks): per-row valid-emission counts —
+        spec rounds emit 1..K+1 tokens, so the row is count-delimited
+        instead of pad-delimited (a live row's tick can end without the pad
+        filler that marks death in the plain tick's fixed-width output)."""
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
         for slot, req in enumerate(self._slots):
             if req is None or req.prefilling:
@@ -1096,7 +1299,8 @@ class ContinuousEngine:
                 # pad filler, not a finished (empty) generation.
                 continue
             fresh: list[int] = []
-            for tok in emitted[slot]:
+            row = emitted[slot] if counts is None else emitted[slot][: counts[slot]]
+            for tok in row:
                 tok = int(tok)
                 if tok in (eos, pad) or len(req.tokens) >= req.max_new_tokens:
                     req.finished = True
@@ -1119,9 +1323,77 @@ class ContinuousEngine:
                     self._publish_generated_pages(req, slot)
                     self._free_slot_pages(slot)
 
+    def _use_spec_tick(self, active: list[Request]) -> bool:
+        """Speculate this tick? Requires every active slot greedy (the
+        exact-match acceptance rule), then compares the acceptance predicted
+        for the CURRENT slots — each request's measured tokens-per-forward,
+        falling back to the engine's workload EMA for unmeasured requests —
+        against the verify/decode cost-ratio threshold. Probes (runs one
+        speculative tick to re-measure) when nothing is measured yet and
+        every ``spec_probe_every`` ticks, so a workload shift back to
+        repetitive text is re-detected."""
+        if not self.speculative:
+            return False
+        if any(r.temperature > 0.0 for r in active):
+            return False
+        if any(getattr(r, "logprobs", 0) for r in active):
+            return False
+        self._tick_no += 1
+        preds = []
+        for r in active:
+            if r.spec_forwards > 0:
+                preds.append(r.spec_tokens / r.spec_forwards)
+            elif self.spec_acceptance_ema is not None:
+                preds.append(self.spec_acceptance_ema)
+            else:
+                return True  # nothing measured anywhere yet: probe
+        if self._tick_no % self.spec_probe_every == 0:
+            return True
+        return sum(preds) / len(preds) >= self.spec_threshold
+
+    def _spec_step(self, alive: jax.Array) -> None:
+        """One speculative tick + acceptance accounting."""
+        paged = self.cache_mode == "paged"
+        if paged not in self._spec_decode:
+            self._spec_decode[paged] = (
+                self._build_spec_paged_decode() if paged
+                else self._build_spec_decode()
+            )
+        if paged:
+            (self.cache, self.cur, self.pos, self.hist, toks, counts,
+             rr) = self._spec_decode[True](
+                self.params, self.cache, self.cur, self.pos, alive,
+                jnp.asarray(self._table), self.limits, self.hist,
+            )
+        else:
+            (self.cache, self.cur, self.pos, self.hist, toks, counts,
+             rr) = self._spec_decode[False](
+                self.params, self.cache, self.cur, self.pos, alive, self.hist,
+            )
+        counts = np.asarray(jax.device_get(counts))
+        rr = np.asarray(jax.device_get(rr))
+        self.spec_ticks += 1
+        accs = []
+        for slot, req in enumerate(self._slots):
+            if req is None or req.prefilling:
+                continue
+            req.spec_tokens += int(counts[slot])
+            req.spec_forwards += int(rr[slot])
+            if rr[slot] > 0:
+                accs.append(counts[slot] / rr[slot])
+        if accs:
+            mean = float(np.mean(accs))
+            self.spec_acceptance_ema = (
+                mean if self.spec_acceptance_ema is None
+                else self._spec_ema_w * self.spec_acceptance_ema
+                + (1.0 - self._spec_ema_w) * mean
+            )
+        self._harvest(np.asarray(jax.device_get(toks)), counts)
+
     def step(self) -> None:
         """One scheduler tick: admit queued requests, advance one chunk of
-        every in-progress chunked prefill, decode one chunk."""
+        every in-progress chunked prefill, decode one chunk (speculatively
+        when armed and predicted to win — see ``_use_spec_tick``)."""
         self._admit()
         for req in self._slots:
             if req is not None and req.prefilling:
@@ -1131,6 +1403,9 @@ class ContinuousEngine:
             return
         alive = jnp.asarray(occupied, bool)
         active = [r for r in self._slots if r is not None and not r.prefilling]
+        if self._use_spec_tick(active):
+            self._spec_step(alive)
+            return
         sampled = any(r.temperature > 0.0 for r in active)
         # top_p only matters when something actually samples — greedy rows
         # ignore it, so (False, True) would compile a redundant program.
@@ -1138,18 +1413,19 @@ class ContinuousEngine:
         if self.cache_mode == "paged":
             if key not in self._paged_decode:
                 self._paged_decode[key] = self._build_paged_decode(*key)
-            self.cache, self.cur, self.pos, self.keys, toks = \
+            self.cache, self.cur, self.pos, self.keys, self.hist, toks = \
                 self._paged_decode[key](
                     self.params, self.cache, self.cur,
                     self.pos, alive, self.temps, self.top_ps, self.keys,
-                    jnp.asarray(self._table), self.limits,
+                    jnp.asarray(self._table), self.limits, self.hist,
                 )
         else:
             if key not in self._decode_cache:
                 self._decode_cache[key] = self._build_decode(*key)
-            self.cache, self.cur, self.pos, self.keys, toks = self._decode_cache[key](
+            (self.cache, self.cur, self.pos, self.keys, self.hist,
+             toks) = self._decode_cache[key](
                 self.params, self.cache, self.cur, self.pos, alive,
-                self.temps, self.top_ps, self.keys,
+                self.temps, self.top_ps, self.keys, self.hist,
             )
         self._harvest(np.asarray(jax.device_get(toks)))
 
@@ -1181,6 +1457,15 @@ class ContinuousEngine:
                 "pages_free": self.allocator.n_free,
                 "pages_cached_evictable": self.allocator.n_evictable,
             })
+        if self.speculative:
+            out["speculative"] = {
+                "k": self.spec_k,
+                "rounds_per_tick": self.spec_rounds,
+                "threshold": self.spec_threshold,
+                "acceptance_ema": self.spec_acceptance_ema,
+                "spec_ticks": self.spec_ticks,
+                "ticks": self._tick_no,
+            }
         return out
 
     def run(self) -> dict[int, list[int]]:
