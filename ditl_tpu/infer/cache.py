@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from ditl_tpu.config import ModelConfig
 
-__all__ = ["init_cache", "cache_logical_axes", "write_kv", "read_kv"]
+__all__ = ["init_cache", "cache_logical_axes", "write_kv", "read_kv", "scatter_tail"]
 
 
 def init_cache(cfg: ModelConfig, batch_size: int, max_len: int) -> dict:
@@ -79,6 +79,17 @@ def _scatter_rows(cache: jax.Array, chunk: jax.Array, idx: jax.Array) -> jax.Arr
         axis=1,
     )
     return jnp.where(in_chunk.reshape(in_chunk.shape + tail), gathered, cache)
+
+
+def scatter_tail(tail: jax.Array, chunk: jax.Array, off: jax.Array) -> jax.Array:
+    """Write ``chunk`` (B, K, S, D) into the decode tail buffer ``tail``
+    (B, K, T, D) at per-row column offsets ``off`` (B,) — the speculative
+    verify's K+1-token write, where each slot sits at its own tail depth.
+    Same dense gather+select formulation as ``_scatter_rows`` (axis moved
+    to position 1; XLA fuses the transposes into the select)."""
+    t = jnp.swapaxes(tail, 1, 2)  # (B, T, K, D)
+    c = jnp.swapaxes(chunk, 1, 2)
+    return jnp.swapaxes(_scatter_rows(t, c, off), 1, 2)
 
 
 def _quantize(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:

@@ -80,6 +80,62 @@ def _quantize_pages(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, scale[:, :, :, None, :]
 
 
+def _flush_tail_into_pools(pools, tk, tv, starts, pos, table, ps, tail_len):
+    """Scatter the tick's tail columns into their pages — ONE scatter per
+    pool per tick (amortized over the chunk; per-token in-scan page writes
+    cost ~7 ms/step on v5e). Valid columns are j < pos - starts (exactly
+    the tokens the tick committed; rejected speculative positions and dead
+    rows fall outside). Invalid columns aim at sentinel page 0 with
+    row-distinct offsets, whose content is never read unmasked. int8 pools:
+    the tail is quantized HERE (tokens attend at full precision within
+    their own tick, then round once). Shared by the plain and speculative
+    paged decode programs."""
+    n_b = pos.shape[0]
+    b_iota = jnp.arange(n_b, dtype=jnp.int32)
+    L, _, K, _, D = pools["kp"].shape
+    j = jnp.arange(tail_len, dtype=jnp.int32)
+    gpos = starts[:, None] + j[None, :]  # (B, tail_len)
+    valid = j[None, :] < (pos - starts)[:, None]
+    pidx = jnp.take_along_axis(
+        table, jnp.clip(gpos // ps, 0, table.shape[1] - 1), axis=1
+    )
+    pid = jnp.where(valid, pidx, 0).reshape(-1)
+    off = jnp.where(
+        valid, gpos % ps,
+        (b_iota[:, None] * tail_len + j[None, :]) % ps,
+    ).reshape(-1)
+
+    def flush(pool, tail):
+        # tail (L, B, K, T, D) -> (B*T, L, K, D); advanced indices
+        # on pool dims 1 and 3 put the scatter dim first.
+        vals = jnp.transpose(tail, (1, 3, 0, 2, 4)).reshape(
+            n_b * tail_len, L, K, D
+        )
+        return pool.at[:, pid, :, off].set(vals.astype(pool.dtype))
+
+    def flush_scale(spool, scales):
+        # scales (L, B, K, T) -> (B*T, L, K, 1); spool (L,P,K,1,ps)
+        vals = jnp.transpose(scales, (1, 3, 0, 2)).reshape(
+            n_b * tail_len, L, K
+        )[..., None]
+        return spool.at[:, pid, :, :, off].set(vals)
+
+    out = dict(pools)
+    if "ks" in pools:
+        from ditl_tpu.infer.cache import _quantize
+
+        qk, sk = _quantize(tk)
+        qv, sv = _quantize(tv)
+        out["kp"] = flush(pools["kp"], qk)
+        out["vp"] = flush(pools["vp"], qv)
+        out["ks"] = flush_scale(pools["ks"], sk)
+        out["vs"] = flush_scale(pools["vs"], sv)
+    else:
+        out["kp"] = flush(pools["kp"], tk)
+        out["vp"] = flush(pools["vp"], tv)
+    return out
+
+
 class QueueFullError(RuntimeError):
     """Raised by ``submit`` when the engine's admission queue is at its
     configured depth cap — callers (the HTTP server) turn this into a 429
@@ -729,13 +785,11 @@ class ContinuousEngine:
         L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
         dt = jnp.dtype(cfg.dtype)
 
-        quantized = cfg.kv_cache_dtype == "int8"
         track = self.speculative
 
         def run(params, pools, cur, pos, alive, temps, top_ps, keys, table,
                 limits, hist):
             n_b = pos.shape[0]
-            b_iota = jnp.arange(n_b, dtype=jnp.int32)
             # starts = pos (not where(alive, pos, 0)): dead rows then have
             # pos - starts == 0 live tail columns, so the flush writes
             # nothing for them regardless of table-row state — no reliance
@@ -789,53 +843,103 @@ class ContinuousEngine:
                 jnp.arange(chunk, dtype=jnp.int32),
             )
 
-            # Flush: scatter the tail's written columns into their pages —
-            # one scatter per pool per tick (amortized over the chunk).
-            # Invalid columns (beyond what the row decoded) and dead rows
-            # aim at sentinel page 0, whose content is never read unmasked.
-            # int8 pools: the tail is quantized HERE (tokens attend at full
-            # precision within their own tick, then round once).
-            j = jnp.arange(tail_len, dtype=jnp.int32)
-            gpos = starts[:, None] + j[None, :]  # (B, tail_len)
-            valid = j[None, :] < (pos - starts)[:, None]
-            pidx = jnp.take_along_axis(
-                table, jnp.clip(gpos // ps, 0, table.shape[1] - 1), axis=1
+            out = _flush_tail_into_pools(
+                pools, tk, tv, starts, pos, table, ps, tail_len
             )
-            pid = jnp.where(valid, pidx, 0).reshape(-1)
-            off = jnp.where(
-                valid, gpos % ps,
-                (b_iota[:, None] * tail_len + j[None, :]) % ps,
-            ).reshape(-1)
-
-            def flush(pool, tail):
-                # tail (L, B, K, T, D) -> (B*T, L, K, D); advanced indices
-                # on pool dims 1 and 3 put the scatter dim first.
-                vals = jnp.transpose(tail, (1, 3, 0, 2, 4)).reshape(
-                    n_b * tail_len, L, K, D
-                )
-                return pool.at[:, pid, :, off].set(vals.astype(pool.dtype))
-
-            def flush_scale(spool, scales):
-                # scales (L, B, K, T) -> (B*T, L, K, 1); spool (L,P,K,1,ps)
-                vals = jnp.transpose(scales, (1, 3, 0, 2)).reshape(
-                    n_b * tail_len, L, K
-                )[..., None]
-                return spool.at[:, pid, :, :, off].set(vals)
-
-            out = dict(pools)
-            if quantized:
-                from ditl_tpu.infer.cache import _quantize
-
-                qk, sk = _quantize(tk)
-                qv, sv = _quantize(tv)
-                out["kp"] = flush(pools["kp"], qk)
-                out["vp"] = flush(pools["vp"], qv)
-                out["ks"] = flush_scale(pools["ks"], sk)
-                out["vs"] = flush_scale(pools["vs"], sv)
-            else:
-                out["kp"] = flush(pools["kp"], tk)
-                out["vp"] = flush(pools["vp"], tv)
             return out, cur, pos, keys, hist, toks.T
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _build_spec_paged_decode(self):
+        """Speculative decode tick, paged cache: same round structure as the
+        contiguous spec tick, but the verify chunk's K/V land in the
+        deferred-flush TAIL buffer at per-row offsets (cache.scatter_tail)
+        and the verify attention runs through the multi-query paged kernel
+        (Q queries share every page fetch; per-query causal limits apply to
+        the tail block only). Accepted columns are contiguous from each
+        round's offset, so the per-tick flush is IDENTICAL to the plain
+        tick's (valid = j < pos - starts). ``limits`` caps emission on
+        device so flushed positions never pass the pages reserved at
+        admission."""
+        cfg, ps, smax = self.cfg, self.page_size, self.smax
+        pad, eos = self.tokenizer.pad_id, self.tokenizer.eos_id
+        k, rounds = self.spec_k, self.spec_rounds
+        ngram, min_ngram = self.spec_ngram, self.spec_min_ngram
+        out_len = rounds * (k + 1)
+        tail_len = max(rounds * (k + 1), 8)
+        L, K, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+        dt = jnp.dtype(cfg.dtype)
+        q_idx = jnp.arange(k + 1, dtype=jnp.int32)
+
+        from ditl_tpu.infer.speculative import _emit_rows, device_lookup_draft
+
+        def run(params, pools, cur, pos, alive, table, limits, hist):
+            n_b = pos.shape[0]
+            starts = pos
+            tk0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
+            tv0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
+            cache_const = dict(pools)  # pools are read-only during the scan
+            out0 = jnp.full((n_b, out_len), pad, jnp.int32)
+            zeros = jnp.zeros((n_b,), jnp.int32)
+
+            def body(carry, _):
+                tk, tv, cur, pos, done, hist, out, n_out, rr = carry
+                done = done | (pos >= limits)
+                live = ~done
+                draft = device_lookup_draft(
+                    hist, jnp.minimum(pos + 1, smax), k=k, ngram=ngram,
+                    min_ngram=min_ngram,
+                )
+                tokens_in = jnp.concatenate([cur[:, None], draft], axis=1)
+                positions = pos[:, None] + q_idx[None, :]
+                lengths = jnp.where(live, pos + 1, 0)
+                paged_meta = {
+                    "table": table, "lengths": lengths, "starts": starts,
+                    "off": pos - starts,
+                }
+                logits, tails = llama.forward(
+                    params, tokens_in, cfg, positions=positions,
+                    cache={**cache_const, "tk": tk, "tv": tv},
+                    paged=paged_meta, mesh=self.mesh, rules=self.rules,
+                )
+                tk, tv = tails["tk"], tails["tv"]
+                cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                eq = tokens_in[:, 1:] == cand[:, :k]
+                n_acc = jnp.sum(
+                    jnp.cumprod(eq.astype(jnp.int32), axis=-1), axis=-1
+                )
+                emit_seq = jnp.concatenate([cur[:, None], cand[:, :k]], axis=1)
+                in_span = q_idx[None, :] <= n_acc[:, None]
+                is_term = (emit_seq == eos) | (emit_seq == pad)
+                term_before = (
+                    jnp.cumsum(is_term.astype(jnp.int32), axis=1)
+                    - is_term.astype(jnp.int32)
+                ) > 0
+                budget_ok = (pos[:, None] + q_idx[None, :]) < limits[:, None]
+                emit = in_span & ~term_before & budget_ok & live[:, None]
+                e = jnp.sum(emit.astype(jnp.int32), axis=1)
+                hit_term = jnp.any(emit & is_term, axis=1)
+                out = _emit_rows(out, emit_seq, n_out, e)
+                n_out = n_out + e
+                grow = jnp.where(hit_term, 0, e)
+                hist = _emit_rows(hist, cand, jnp.minimum(pos + 1, smax), grow)
+                pos = jnp.where(live, pos + e, pos)
+                done = done | hit_term
+                cur = jnp.where(
+                    done, pad,
+                    jnp.take_along_axis(cand, n_acc[:, None], axis=1)[:, 0],
+                )
+                rr = rr + live.astype(jnp.int32)
+                return (tk, tv, cur, pos, done, hist, out, n_out, rr), None
+
+            (tk, tv, cur, pos, done, hist, out, n_out, rr), _ = jax.lax.scan(
+                body, (tk0, tv0, cur, pos, ~alive, hist, out0, zeros, zeros),
+                None, length=rounds,
+            )
+            pools_out = _flush_tail_into_pools(
+                pools, tk, tv, starts, pos, table, ps, tail_len
+            )
+            return pools_out, cur, pos, hist, out, n_out, rr
 
         return jax.jit(run, donate_argnums=(1,))
 

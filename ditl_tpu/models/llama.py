@@ -297,28 +297,41 @@ def _decoder_layer(
     if layer_cache is not None and "kp" in layer_cache:
         from ditl_tpu.ops.paged_attention import paged_attention
 
-        if s != 1:
-            raise ValueError(f"paged decode takes one token per slot, got S={s}")
-        # Deferred flush: the token's K/V go into the tick's small TAIL
+        # Deferred flush: the chunk's K/V go into the tick's small TAIL
         # buffer (per-token writes into the big page pool inside the decode
         # scan cost ~7 ms/step on v5e); the kernel reads pages + tail, and
         # the engine flushes the tail into pages once per tick.
         tdt = layer_cache["tk"].dtype
-        k_tok = jnp.swapaxes(k, 1, 2).astype(tdt)  # (B, K, 1, D)
+        k_tok = jnp.swapaxes(k, 1, 2).astype(tdt)  # (B, K, S, D)
         v_tok = jnp.swapaxes(v, 1, 2).astype(tdt)
-        tk = jax.lax.dynamic_update_slice(
-            layer_cache["tk"], k_tok, (0, 0, paged["t"], 0)
-        )
-        tv = jax.lax.dynamic_update_slice(
-            layer_cache["tv"], v_tok, (0, 0, paged["t"], 0)
-        )
+        if s == 1:
+            # Plain decode tick: every live slot writes tail column
+            # ``paged["t"]`` (the scan step — slots advance in lock-step
+            # within a tick, each at its own global position).
+            tk = jax.lax.dynamic_update_slice(
+                layer_cache["tk"], k_tok, (0, 0, paged["t"], 0)
+            )
+            tv = jax.lax.dynamic_update_slice(
+                layer_cache["tv"], v_tok, (0, 0, paged["t"], 0)
+            )
+        else:
+            # Speculative verify: K+1 tokens land at per-row tail offsets
+            # ``paged["off"]`` (= pos - starts; slots advance by their own
+            # acceptance, so depths diverge within the tick).
+            from ditl_tpu.infer.cache import scatter_tail
+
+            tk = scatter_tail(layer_cache["tk"], k_tok, paged["off"])
+            tv = scatter_tail(layer_cache["tv"], v_tok, paged["off"])
         new_kv = {"tk": tk, "tv": tv}
         attn_out = paged_attention(
-            q[:, 0], layer_cache["kp"], layer_cache["vp"], paged["table"],
+            q[:, 0] if s == 1 else q,
+            layer_cache["kp"], layer_cache["vp"], paged["table"],
             paged["lengths"], tail_k=tk, tail_v=tv, starts=paged["starts"],
             k_scale=layer_cache.get("ks"), v_scale=layer_cache.get("vs"),
             mesh=mesh, rules=rules,
-        )[:, None]
+        )
+        if s == 1:
+            attn_out = attn_out[:, None]
     elif layer_cache is not None:
         from ditl_tpu.infer.cache import read_kv, write_kv
 

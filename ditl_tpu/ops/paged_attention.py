@@ -46,7 +46,7 @@ __all__ = ["paged_attention", "paged_attention_xla"]
 
 
 def paged_attention_xla(
-    q: jax.Array,  # (B, H, D)
+    q: jax.Array,  # (B, H, D) or (B, Q, H, D) — multi-query verify
     k_pages: jax.Array,  # (P, K, ps, D)
     v_pages: jax.Array,
     page_table: jax.Array,  # (B, maxp) int32
@@ -62,8 +62,17 @@ def paged_attention_xla(
     With a tail (the deferred-flush decode path), tokens [0, starts) live
     in pages and [starts, lengths) in the tail buffer at columns
     [0, lengths - starts). With ``k_scale``/``v_scale`` the pools are int8
-    (symmetric per-row absmax; tails stay float)."""
-    b, h, d = q.shape
+    (symmetric per-row absmax; tails stay float).
+
+    4-D ``q`` is the speculative-verify shape: Q consecutive query tokens
+    per slot at positions lengths-1 .. lengths-2+Q; query qi additionally
+    sees tail columns up to ``lengths + qi`` (causal within the chunk).
+    Page columns need no per-query limit — they all precede ``starts``,
+    which every query's limit covers."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q = q[:, None]
+    b, nq, h, d = q.shape
     _, kv_heads, ps, _ = k_pages.shape
     maxp = page_table.shape[1]
     groups = h // kv_heads
@@ -79,31 +88,37 @@ def paged_attention_xla(
     k = jnp.swapaxes(kg, 2, 3).reshape(b, maxp * ps, kv_heads, d).astype(dtype)
     v = jnp.swapaxes(vg, 2, 3).reshape(b, maxp * ps, kv_heads, d).astype(dtype)
     page_limit = lengths if starts is None else jnp.minimum(starts, lengths)
-    valid = jnp.arange(maxp * ps, dtype=jnp.int32)[None, :] < page_limit[:, None]
+    qi = jnp.arange(nq, dtype=jnp.int32)
+    valid = (
+        jnp.arange(maxp * ps, dtype=jnp.int32)[None, None, :]
+        < page_limit[:, None, None]
+    )  # (B, 1, S) -> broadcast over queries
+    valid = jnp.broadcast_to(valid, (b, nq, maxp * ps))
     if tail_k is not None:
         t = tail_k.shape[2]
         k = jnp.concatenate([k, jnp.swapaxes(tail_k, 1, 2)], axis=1)
         v = jnp.concatenate([v, jnp.swapaxes(tail_v, 1, 2)], axis=1)
         tail_valid = (
-            starts[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
-            < lengths[:, None]
-        )
-        valid = jnp.concatenate([valid, tail_valid], axis=1)
-    qg = q.reshape(b, kv_heads, groups, d)
+            starts[:, None, None] + jnp.arange(t, dtype=jnp.int32)[None, None, :]
+            < (lengths[:, None] + qi[None, :])[:, :, None]
+        )  # (B, Q, T)
+        valid = jnp.concatenate([valid, tail_valid], axis=2)
+    qg = q.reshape(b, nq, kv_heads, groups, d)
     scores = jnp.einsum(
-        "bkgd,bskd->bkgs", qg, k, preferred_element_type=jnp.float32
+        "bqkgd,bskd->bqkgs", qg, k, preferred_element_type=jnp.float32
     ) * (d**-0.5)
-    scores = jnp.where(valid[:, None, None, :], scores, NEG_INF)
+    scores = jnp.where(valid[:, :, None, None, :], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     # Dead slots (length 0) have an all-masked row; emit zeros, not NaN.
-    probs = jnp.where(lengths[:, None, None, None] > 0, probs, 0.0)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs.astype(v.dtype), v)
-    return out.reshape(b, h, d)
+    probs = jnp.where(lengths[:, None, None, None, None] > 0, probs, 0.0)
+    out = jnp.einsum("bqkgs,bskd->bqkgd", probs.astype(v.dtype), v)
+    out = out.reshape(b, nq, h, d)
+    return out[:, 0] if squeeze else out
 
 
 def _accumulate_block(
     q_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *,
-    scale, base, width, limit, ks_ref=None, vs_ref=None,
+    scale, base, width, limit, ks_ref=None, vs_ref=None, q_groups=None,
 ):
     """Online-softmax accumulation of one (all-kv-heads) KV block whose
     columns are global positions [base, base+width), masked to < limit.
@@ -113,12 +128,21 @@ def _accumulate_block(
     K (HBM reads stay int8-sized) and the per-position scale multiplies the
     (G, width) score row afterwards; V's scale folds into the
     probabilities before the pv matmul. Lane-aligned broadcasts both
-    times (same scheme as the contiguous int8 cache, ops/attention.py)."""
+    times (same scheme as the contiguous int8 cache, ops/attention.py).
+
+    ``q_groups`` (multi-query / speculative verify): the q block's rows are
+    Q consecutive query tokens x ``q_groups`` GQA group members (row
+    r = qi * q_groups + g), and row r's column limit is ``limit + qi`` —
+    causal masking WITHIN the verify chunk at zero extra block traffic."""
     kv_heads, groups = q_ref.shape[1], q_ref.shape[2]
     d = acc_scr.shape[-1]
     tile = _lane_tile  # shared lane-replication helper (ops/flash_attention)
     cols = base + jax.lax.broadcasted_iota(jnp.int32, (groups, width), 1)
-    col_mask = cols < limit
+    if q_groups is None:
+        col_mask = cols < limit
+    else:
+        qi = jax.lax.broadcasted_iota(jnp.int32, (groups, width), 0) // q_groups
+        col_mask = cols < (limit + qi)
     for kh in range(kv_heads):
         q = q_ref[0, kh].astype(jnp.float32) * scale  # (G, D)
         k = k_ref[0, kh].astype(jnp.float32)  # (width, D)
@@ -220,13 +244,17 @@ def _paged_tail_kernel(
     page_size: int,
     n_pages: int,
     quantized: bool,
+    q_groups: int | None = None,
 ):
     """Deferred-flush variant: grid (B, maxp + 1). Steps p < maxp consume
     flushed pages (positions < starts[b]); the final step consumes the hot
     TAIL block — the current decode chunk's KV, held in a small contiguous
     buffer until the per-tick flush (positions [starts, lengths)). With
     ``quantized``, the pools are int8 and their per-position scales factor
-    out of the dots; the tail stays float until the flush."""
+    out of the dots; the tail stays float until the flush. ``q_groups``
+    (speculative verify): the q block packs Q query tokens; per-query
+    causal limits apply to the TAIL only — every page column precedes
+    ``starts``, which every query's limit already covers."""
     if quantized:
         ks_ref, vs_ref, tk_ref, tv_ref, o_ref, m_scr, l_scr, acc_scr = rest
     else:
@@ -259,6 +287,7 @@ def _paged_tail_kernel(
         _accumulate_block(
             q_ref, tk_ref, tv_ref, m_scr, l_scr, acc_scr,
             scale=scale, base=start, width=tk_ref.shape[2], limit=length,
+            q_groups=q_groups,
         )
 
     @pl.when(p == n_pages)
@@ -267,7 +296,7 @@ def _paged_tail_kernel(
 
 
 def paged_attention(
-    q: jax.Array,  # (B, H, D)
+    q: jax.Array,  # (B, H, D); (B, Q, H, D) = multi-query speculative verify
     k_pages: jax.Array,  # (P, K, ps, D)
     v_pages: jax.Array,
     page_table: jax.Array,  # (B, maxp) int32
@@ -289,6 +318,12 @@ def paged_attention(
     positions [starts, lengths) held in a small contiguous buffer — so
     per-token page writes never happen inside the decode scan.
 
+    4-D ``q`` (requires the tail path) is the speculative K+1-token verify:
+    Q queries per slot share every page fetch — the whole point of
+    speculation on a bandwidth-bound decoder — and get per-query causal
+    limits on the tail block only (query qi sees tail positions
+    < lengths + qi; page columns all precede ``starts``).
+
     With a ``mesh``, the kernel is shard_mapped over the TENSOR axis:
     pools, tails and q/output split on kv-heads (the rule table's
     ``act_kv_heads``), page table / lengths / starts replicated — heads
@@ -296,6 +331,7 @@ def paged_attention(
     batch axes stay unsharded here (a paged pool is one shared resource;
     multi-host paged serving replicates the batch like the pod protocols
     do)."""
+    multi_q = q.ndim == 4
     if mesh is not None:
         from ditl_tpu.ops.attention import _mesh_axes_size
         from ditl_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
@@ -313,15 +349,19 @@ def paged_attention(
             and rules.get("act_heads") == rules.get("act_kv_heads")
             and tp == tp_q
             and kv_heads % tp == 0
-            and q.shape[1] % tp == 0
+            and q.shape[-2] % tp == 0
             and q.shape[0] % dp == 0
         )
         if shardable:
+            q_axes = (
+                ("batch", None, "act_heads", None) if multi_q
+                else ("batch", "act_heads", None)
+            )
             pool_spec = logical_to_spec((None, "act_kv_heads", None, None), rules)
             tail_spec = logical_to_spec(("batch", "act_kv_heads", None, None), rules)
             row_spec = logical_to_spec(("batch",), rules)
             in_specs = [
-                logical_to_spec(("batch", "act_heads", None), rules),  # q
+                logical_to_spec(q_axes, rules),  # q
                 pool_spec, pool_spec,  # pools (P,K,ps,D): replicated over dp
                 logical_to_spec(("batch", None), rules),  # table
                 row_spec,  # lengths
@@ -355,33 +395,53 @@ def paged_attention(
                 local,
                 mesh=mesh,
                 in_specs=tuple(in_specs),
-                out_specs=logical_to_spec(("batch", "act_heads", None), rules),
+                out_specs=logical_to_spec(q_axes, rules),
                 check_vma=False,
             )(*args)
         # Mesh doesn't divide heads/batch (or no such axes): single-program
         # path under GSPMD — fall through unsharded.
-    b, h, d = q.shape
+    if multi_q:
+        b, nq, h, d = q.shape
+    else:
+        b, h, d = q.shape
+        nq = 1
     n_pool, kv_heads, ps, _ = k_pages.shape
     maxp = page_table.shape[1]
     groups = h // kv_heads
     if h % kv_heads:
         raise ValueError(f"q heads {h} not divisible by kv heads {kv_heads}")
+    if multi_q and tail_k is None:
+        raise ValueError(
+            "multi-query paged_attention requires the tail path (the verify "
+            "chunk's own KV lives in the tail buffer)"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    # (B, K, G, D): one grid step's q block is ALL kv heads of one slot.
-    qg = q.reshape(b, kv_heads, groups, d)
-    g_rows = max(kv_heads * groups, 8)  # scratch sublane floor
+    # (B, K, Q*G, D): one grid step's q block is ALL kv heads of one slot —
+    # rows ordered query-major within a kv head (row = qi * G + g).
+    qg = (
+        q.reshape(b, nq, kv_heads, groups, d)
+        .transpose(0, 2, 1, 3, 4)
+        .reshape(b, kv_heads, nq * groups, d)
+    )
+    qg_rows = nq * groups
+    g_rows = max(kv_heads * qg_rows, 8)  # scratch sublane floor
     has_tail = tail_k is not None
     scratch = [
         pltpu.VMEM((g_rows, NUM_LANES), jnp.float32),  # m
         pltpu.VMEM((g_rows, NUM_LANES), jnp.float32),  # l
         pltpu.VMEM((g_rows, d), jnp.float32),  # acc
     ]
-    out_shape = jax.ShapeDtypeStruct((b, kv_heads, groups, d), q.dtype)
+    out_shape = jax.ShapeDtypeStruct((b, kv_heads, qg_rows, d), q.dtype)
     compiler_params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "arbitrary")
     )
+
+    def out_4d(o):
+        o = o.reshape(b, kv_heads, nq, groups, d).transpose(0, 2, 1, 3, 4)
+        o = o.reshape(b, nq, h, d)
+        return o if multi_q else o[:, 0]
 
     if has_tail:
         # Page fetches clamp to pages holding FLUSHED tokens (< starts) and
@@ -398,7 +458,7 @@ def paged_attention(
 
         quantized = k_scale is not None
         in_specs = [
-            pl.BlockSpec((1, kv_heads, groups, d), slot_map),
+            pl.BlockSpec((1, kv_heads, qg_rows, d), slot_map),
             pl.BlockSpec((1, kv_heads, ps, d), page_map),
             pl.BlockSpec((1, kv_heads, ps, d), page_map),
         ]
@@ -418,19 +478,20 @@ def paged_attention(
             functools.partial(
                 _paged_tail_kernel, scale=d**-0.5, page_size=ps,
                 n_pages=maxp, quantized=quantized,
+                q_groups=groups if nq > 1 else None,
             ),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=3,
                 grid=(b, maxp + 1),
                 in_specs=in_specs,
-                out_specs=pl.BlockSpec((1, kv_heads, groups, d), slot_map),
+                out_specs=pl.BlockSpec((1, kv_heads, qg_rows, d), slot_map),
                 scratch_shapes=scratch,
             ),
             out_shape=out_shape,
             compiler_params=compiler_params,
             interpret=interpret,
         )(*args)
-        return out.reshape(b, h, d)
+        return out_4d(out)
 
     if k_scale is not None:
         raise ValueError(

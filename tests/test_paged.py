@@ -491,3 +491,58 @@ def test_evicting_parent_cascades_to_children():
     for p in got:
         a.release(p)
     assert sorted(a.alloc(5)) == [1, 2, 3, 4, 5]
+
+
+def test_paged_attention_multi_query_matches_reference():
+    """The speculative-verify shape: Q query tokens per slot through the
+    tail kernel with per-query causal limits on the tail block (query qi
+    sees tail positions < lengths + qi). int8 pools compose. Single-query
+    calls must be bit-compatible with the 4-D Q=1 form."""
+    from ditl_tpu.infer.cache import _quantize
+    from ditl_tpu.ops.paged_attention import paged_attention, paged_attention_xla
+
+    rng = np.random.default_rng(7)
+    kv_heads, d, ps, maxp, pool, tail, nq = 4, 32, 16, 4, 16, 24, 5
+    b, h = 4, 8
+    q = jnp.asarray(rng.normal(size=(b, nq, h, d)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(pool, kv_heads, ps, d)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(pool, kv_heads, ps, d)), jnp.float32)
+    tk = jnp.asarray(rng.normal(size=(b, kv_heads, tail, d)), jnp.float32)
+    tv = jnp.asarray(rng.normal(size=(b, kv_heads, tail, d)), jnp.float32)
+    # dead; page-aligned start; mid-page start; tail-straddling lengths
+    starts = np.asarray([0, 16, 33, 20], np.int32)
+    lengths = np.asarray([0, 20, 40, 21], np.int32)
+    table = jnp.asarray(rng.integers(1, pool, size=(b, maxp)).astype(np.int32))
+    args = (q, kp, vp, table, jnp.asarray(lengths))
+    kw = dict(tail_k=tk, tail_v=tv, starts=jnp.asarray(starts))
+    ref = paged_attention_xla(*args, **kw)
+    out = paged_attention(*args, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    assert np.all(np.asarray(out[0]) == 0)  # dead slot: zeros for every query
+
+    # Q=1 4-D form == 3-D form
+    out3 = paged_attention(q[:, 0], kp, vp, table, jnp.asarray(lengths), **kw)
+    out41 = paged_attention(q[:, :1], kp, vp, table, jnp.asarray(lengths), **kw)
+    np.testing.assert_array_equal(np.asarray(out41[:, 0]), np.asarray(out3))
+
+    # int8 pools: scales factor out of the dots for every query
+    kq, ks = _quantize(jnp.swapaxes(kp, 1, 2))
+    vq, vs = _quantize(jnp.swapaxes(vp, 1, 2))
+    kq, vq = jnp.swapaxes(kq, 1, 2), jnp.swapaxes(vq, 1, 2)
+    ks = jnp.swapaxes(ks, 1, 2)[:, :, None, :]
+    vs = jnp.swapaxes(vs, 1, 2)[:, :, None, :]
+    refq = paged_attention_xla(q, kq, vq, table, jnp.asarray(lengths),
+                               k_scale=ks, v_scale=vs, **kw)
+    outq = paged_attention(q, kq, vq, table, jnp.asarray(lengths),
+                           k_scale=ks, v_scale=vs, **kw)
+    np.testing.assert_allclose(np.asarray(outq), np.asarray(refq), atol=1e-4)
+
+
+def test_paged_attention_multi_query_requires_tail():
+    from ditl_tpu.ops.paged_attention import paged_attention
+
+    q = jnp.zeros((2, 3, 4, 32), jnp.float32)
+    kp = jnp.zeros((4, 2, 16, 32), jnp.float32)
+    with pytest.raises(ValueError, match="multi-query"):
+        paged_attention(q, kp, kp, jnp.zeros((2, 2), jnp.int32),
+                        jnp.zeros((2,), jnp.int32))

@@ -1,0 +1,193 @@
+"""Speculative decode ticks inside the continuous-batching engine
+(infer/continuous.py, VERDICT r2 item 1): greedy continuous+speculative must
+be token-identical to plain continuous greedy (f32 — exact arithmetic), in
+BOTH cache modes, composing with int8 KV, chunked prefill, slot reuse, and
+the per-tick auto-decision.
+
+The reference's serving story is one blocking HTTP call per example (ref
+``src/distributed_inference.py:34-41,69``); this is the production shape that
+replaces it — continuous batching + paged KV + speculation simultaneously.
+"""
+
+import dataclasses
+
+import jax
+import pytest
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer.continuous import ContinuousEngine
+from ditl_tpu.models import llama
+
+PROMPTS = [
+    "abcabcabcabcabcabc",
+    "the cat sat on the mat the cat sat",
+    "x",
+    "hello hello hello hello",
+]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        dtype="float32",
+        param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    tok = ByteTokenizer()
+    return params, cfg, tok
+
+
+def _spec_engine(params, cfg, tok, **kw):
+    kw.setdefault("n_slots", 4)
+    kw.setdefault("decode_chunk", 4)
+    # threshold 0: every tick speculates — the exactness tests must exercise
+    # the speculative program, not fall back after one probe.
+    kw.setdefault("speculative", True)
+    kw.setdefault("spec_threshold", 0.0)
+    kw.setdefault("spec_rounds", 2)
+    return ContinuousEngine(params, cfg, tok, **kw)
+
+
+def test_spec_contiguous_matches_plain_greedy(setup):
+    params, cfg, tok = setup
+    ref = ContinuousEngine(params, cfg, tok, n_slots=4, decode_chunk=4).generate(
+        PROMPTS, max_new_tokens=37, temperature=0.0
+    )
+    eng = _spec_engine(params, cfg, tok)
+    out = eng.generate(PROMPTS, max_new_tokens=37, temperature=0.0)
+    st = eng.stats()["speculative"]
+    assert st["spec_ticks"] == st["ticks"] > 0  # really ran speculatively
+    assert out == ref
+
+
+def test_spec_paged_matches_plain_greedy(setup):
+    params, cfg, tok = setup
+    ref = ContinuousEngine(
+        params, cfg, tok, n_slots=4, decode_chunk=4,
+        cache_mode="paged", page_size=16,
+    ).generate(PROMPTS, max_new_tokens=37, temperature=0.0)
+    eng = _spec_engine(params, cfg, tok, cache_mode="paged", page_size=16)
+    out = eng.generate(PROMPTS, max_new_tokens=37, temperature=0.0)
+    st = eng.stats()["speculative"]
+    assert st["spec_ticks"] == st["ticks"] > 0
+    assert out == ref
+
+
+def test_spec_paged_int8_deterministic(setup):
+    """int8 KV quantizes at tick-flush boundaries, which differ between the
+    speculative and plain schedules — exactness is pinned in f32 above; the
+    int8 composition is pinned for determinism and non-degeneracy."""
+    params, cfg, tok = setup
+    cfg8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    eng = _spec_engine(params, cfg8, tok, cache_mode="paged", page_size=16)
+    out1 = eng.generate(PROMPTS, max_new_tokens=25, temperature=0.0)
+    assert eng.stats()["speculative"]["spec_ticks"] > 0
+    eng2 = _spec_engine(params, cfg8, tok, cache_mode="paged", page_size=16)
+    out2 = eng2.generate(PROMPTS, max_new_tokens=25, temperature=0.0)
+    assert out1 == out2
+    assert all(len(o) > 0 for o in out1)
+
+
+def test_spec_slot_reuse_more_requests_than_slots(setup):
+    params, cfg, tok = setup
+    prompts = PROMPTS + ["abab", "qrsqrsqrs"]
+    ref = ContinuousEngine(params, cfg, tok, n_slots=2, decode_chunk=4).generate(
+        prompts, max_new_tokens=19, temperature=0.0
+    )
+    out = _spec_engine(params, cfg, tok, n_slots=2).generate(
+        prompts, max_new_tokens=19, temperature=0.0
+    )
+    assert out == ref
+
+
+def test_spec_with_chunked_prefill(setup):
+    """History seeding happens at chunked-prefill COMPLETION — the parked
+    slot must join speculative ticks with a correct draft history."""
+    params, cfg, tok = setup
+    long = "0123456789" * 6  # 60 chars: > prefill_chunk
+    prompts = [long, "abcabc"]
+    ref = ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4, prefill_chunk=16,
+    ).generate(prompts, max_new_tokens=21, temperature=0.0)
+    out = _spec_engine(
+        params, cfg, tok, n_slots=2, prefill_chunk=16,
+    ).generate(prompts, max_new_tokens=21, temperature=0.0)
+    assert out == ref
+
+
+def test_spec_sampled_slots_force_plain_ticks(setup):
+    params, cfg, tok = setup
+    eng = _spec_engine(params, cfg, tok)
+    out = eng.generate(PROMPTS, max_new_tokens=12, temperature=0.7, seed=5)
+    assert eng.stats()["speculative"]["spec_ticks"] == 0
+    ref = ContinuousEngine(params, cfg, tok, n_slots=4, decode_chunk=4).generate(
+        PROMPTS, max_new_tokens=12, temperature=0.7, seed=5
+    )
+    assert out == ref  # fallback is the plain tick, bit-for-bit
+
+
+def test_spec_auto_disables_on_low_acceptance(setup):
+    """Random weights yield ~1 token/forward; with the default-style
+    threshold the engine must probe once, measure, and fall back to plain
+    ticks — per-request measured acceptance drives the decision."""
+    params, cfg, tok = setup
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=4, decode_chunk=4,
+        speculative=True, spec_threshold=2.5, spec_probe_every=1000,
+    )
+    out = eng.generate(PROMPTS, max_new_tokens=24, temperature=0.0)
+    st = eng.stats()["speculative"]
+    assert st["spec_ticks"] >= 1  # the probe
+    assert st["spec_ticks"] < st["ticks"]  # ...then fell back
+    assert st["acceptance_ema"] is not None and st["acceptance_ema"] < 2.5
+    ref = ContinuousEngine(params, cfg, tok, n_slots=4, decode_chunk=4).generate(
+        PROMPTS, max_new_tokens=24, temperature=0.0
+    )
+    assert out == ref
+
+
+def test_spec_acceptance_accounted_per_request(setup):
+    params, cfg, tok = setup
+    eng = _spec_engine(params, cfg, tok)
+    rids = [
+        eng.submit([tok.bos_id] + tok.encode(p), max_new_tokens=16,
+                   temperature=0.0)
+        for p in PROMPTS
+    ]
+    eng.run()
+    # completed requests were popped; per-request counters lived on them —
+    # verify through the engine aggregate instead.
+    st = eng.stats()["speculative"]
+    assert st["acceptance_ema"] is not None and st["acceptance_ema"] >= 1.0
+    assert len(rids) == 4
+
+
+def test_spec_streaming_chunks_concatenate_to_plain(setup):
+    """stream_one through a speculative engine delivers count-delimited
+    chunks that concatenate to exactly the plain greedy output."""
+    from ditl_tpu.infer.continuous import ThreadedEngine
+
+    params, cfg, tok = setup
+    ref = ContinuousEngine(params, cfg, tok, n_slots=2, decode_chunk=4).generate(
+        ["abcabcabcabc"], max_new_tokens=20, temperature=0.0
+    )[0]
+    te = ThreadedEngine(_spec_engine(params, cfg, tok, n_slots=2))
+    try:
+        got: list[int] = []
+        for chunk in te.stream_one(
+            [tok.bos_id] + tok.encode("abcabcabcabc"), max_new_tokens=20,
+            temperature=0.0,
+        ):
+            got.extend(chunk)
+        assert tok.decode(got) == ref
+    finally:
+        te.close()
