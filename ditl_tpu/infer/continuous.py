@@ -80,6 +80,16 @@ def _quantize_pages(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:
     return q, scale[:, :, :, None, :]
 
 
+def _lp_stats(step_logits: jax.Array, tok: jax.Array, k: int):
+    """Chosen-token logprob + top-k alternatives from the RAW (B, V)
+    distribution — before temperature/top-k/top-p shaping; the same OpenAI
+    semantics as engine.Generator's lock-step logprobs."""
+    lp = jax.nn.log_softmax(step_logits.astype(jnp.float32), -1)
+    chosen = jnp.take_along_axis(lp, tok[:, None], 1)[:, 0]
+    top_lp, top_id = jax.lax.top_k(lp, k)
+    return chosen, top_id.astype(jnp.int32), top_lp
+
+
 def _flush_tail_into_pools(pools, tk, tv, starts, pos, table, ps, tail_len):
     """Scatter the tick's tail columns into their pages — ONE scatter per
     pool per tick (amortized over the chunk; per-token in-scan page writes
@@ -167,6 +177,13 @@ class Request:
     # Drives the per-tick speculate-or-not decision (see step()).
     spec_tokens: int = 0
     spec_forwards: int = 0
+    # OpenAI-style logprobs: None = not requested; N >= 0 = return the
+    # chosen token's logprob plus top-N alternatives per generated token
+    # (engine computes ``logprobs_k`` alternatives; N only slices).
+    logprobs: int | None = None
+    lp_token: list[float] = field(default_factory=list)
+    lp_top_ids: list[list[int]] = field(default_factory=list)
+    lp_top: list[list[float]] = field(default_factory=list)
 
 
 class ContinuousEngine:
@@ -198,6 +215,7 @@ class ContinuousEngine:
         spec_threshold: float | None = None,
         spec_probe_every: int = 32,
         spec_ema: float = 0.7,
+        logprobs_k: int = 0,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -427,6 +445,25 @@ class ContinuousEngine:
             (n_slots, self.smax if speculative else 1), jnp.int32
         )
 
+        # -- per-token logprobs (OpenAI semantics) -----------------------
+        # ``logprobs_k > 0`` arms per-token logprob tracking: every prefill
+        # and decode program additionally computes the chosen token's
+        # logprob and the top-k alternatives FROM THE RAW distribution
+        # (before temperature/top-k/top-p shaping — the same semantics as
+        # engine.Generator's lock-step logprobs). The stats of the pending
+        # ``cur`` ride engine state between ticks, exactly like ``cur``
+        # itself. Costs one (B, V) log-softmax + top-k per decode step when
+        # armed; requests that don't ask for logprobs simply don't consume
+        # the outputs. Speculative ticks don't carry logprob state, so a
+        # request with ``logprobs`` set forces plain ticks while active.
+        if logprobs_k < 0:
+            raise ValueError(f"logprobs_k must be >= 0, got {logprobs_k}")
+        self.logprobs_k = logprobs_k
+        if logprobs_k > 0:
+            self.lp_chosen = jnp.zeros((n_slots,), jnp.float32)
+            self.lp_ids = jnp.zeros((n_slots, logprobs_k), jnp.int32)
+            self.lp_top = jnp.zeros((n_slots, logprobs_k), jnp.float32)
+
     # -- compiled programs --------------------------------------------------
 
     def _build_prefill(self, p_bucket: int):
@@ -463,6 +500,9 @@ class ContinuousEngine:
                 last[None], rng, temperature=temp,
                 top_k=self.gen.top_k, top_p=top_p,
             )[0]
+            if self.logprobs_k:
+                c, i, t = _lp_stats(last[None], first[None], self.logprobs_k)
+                return cache, first, c[0], i[0], t[0]
             return cache, first
 
         return jax.jit(run, donate_argnums=(1,))
@@ -477,10 +517,12 @@ class ContinuousEngine:
         slots_iota = jnp.arange(smax, dtype=jnp.int32)
         chunk = self.decode_chunk
         track = self.speculative
+        n_lp = self.logprobs_k
 
-        def run(params, cache, cur, pos, alive, temps, top_ps, keys, hist):
+        def run(params, cache, cur, pos, alive, temps, top_ps, keys, hist,
+                *lp0):
             def body(carry, _):
-                cache, cur, pos, done, keys, hist = carry
+                cache, cur, pos, done, keys, hist, lp = carry
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
                 mask = (slots_iota[None, :] <= pos[:, None])[:, None, :]  # (B,1,Smax)
@@ -503,6 +545,12 @@ class ContinuousEngine:
                 )
                 step_alive = ~done
                 emit = jnp.where(step_alive, cur, pad)
+                # The emitted stats are the PENDING ones — computed when
+                # ``cur`` was sampled (previous step / prefill) — then the
+                # pending slot is refilled with ``nxt``'s stats.
+                ys = (emit, *lp) if n_lp else emit
+                if n_lp:
+                    lp = _lp_stats(logits[:, 0], nxt, n_lp)
                 done = done | (cur == eos)
                 pos = jnp.where(step_alive, jnp.minimum(pos + 1, smax - 1), pos)
                 cur = jnp.where(done, pad, nxt)
@@ -511,12 +559,17 @@ class ContinuousEngine:
 
                     grow = (~done).astype(jnp.int32)
                     hist = _emit_rows(hist, cur[:, None], pos, grow)
-                return (cache, cur, pos, done, keys, hist), emit
+                return (cache, cur, pos, done, keys, hist, lp), ys
 
-            (cache, cur, pos, done, keys, hist), toks = jax.lax.scan(
-                body, (cache, cur, pos, ~alive, keys, hist), None, length=chunk
+            (cache, cur, pos, done, keys, hist, lp), ys = jax.lax.scan(
+                body, (cache, cur, pos, ~alive, keys, hist, tuple(lp0)),
+                None, length=chunk,
             )
-            return cache, cur, pos, keys, hist, toks.T  # toks: (B, chunk)
+            if n_lp:
+                toks, c, i, t = ys
+                return (cache, cur, pos, keys, hist, lp, toks.T,
+                        c.T, jnp.swapaxes(i, 0, 1), jnp.swapaxes(t, 0, 1))
+            return cache, cur, pos, keys, hist, ys.T  # ys: (chunk, B)
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -674,6 +727,9 @@ class ContinuousEngine:
                 last[None], rng, temperature=temp, top_k=self.gen.top_k,
                 top_p=top_p,
             )[0]
+            if self.logprobs_k:
+                c, i, t = _lp_stats(last[None], first[None], self.logprobs_k)
+                return cache, first, c[0], i[0], t[0]
             return cache, first
 
         return jax.jit(run, donate_argnums=(1,))
@@ -766,6 +822,9 @@ class ContinuousEngine:
                 last[None], rng, temperature=temp, top_k=self.gen.top_k,
                 top_p=top_p,
             )[0]
+            if self.logprobs_k:
+                c, i, t = _lp_stats(last[None], first[None], self.logprobs_k)
+                return out, first, c[0], i[0], t[0]
             return out, first
 
         return jax.jit(run, donate_argnums=(1,))
@@ -786,9 +845,10 @@ class ContinuousEngine:
         dt = jnp.dtype(cfg.dtype)
 
         track = self.speculative
+        n_lp = self.logprobs_k
 
         def run(params, pools, cur, pos, alive, temps, top_ps, keys, table,
-                limits, hist):
+                limits, hist, *lp0):
             n_b = pos.shape[0]
             # starts = pos (not where(alive, pos, 0)): dead rows then have
             # pos - starts == 0 live tail columns, so the flush writes
@@ -800,7 +860,7 @@ class ContinuousEngine:
             cache_const = dict(pools)  # pools are read-only during the scan
 
             def body(carry, t):
-                tk, tv, cur, pos, done, keys, hist = carry
+                tk, tv, cur, pos, done, keys, hist, lp = carry
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
                 done = done | (pos >= limits)
@@ -828,6 +888,11 @@ class ContinuousEngine:
                     top_p=top_ps if topp else 1.0,
                 )
                 emit = jnp.where(step_alive, cur, pad)
+                # Emitted stats are the pending ones (aligned with ``cur``);
+                # the pending slot then refills with ``nxt``'s stats.
+                ys = (emit, *lp) if n_lp else emit
+                if n_lp:
+                    lp = _lp_stats(logits[:, 0], nxt, n_lp)
                 done = done | (cur == eos)
                 pos = jnp.where(step_alive, pos + 1, pos)
                 cur = jnp.where(done, pad, nxt)
@@ -836,17 +901,21 @@ class ContinuousEngine:
 
                     grow = (~done).astype(jnp.int32)
                     hist = _emit_rows(hist, cur[:, None], pos, grow)
-                return (tk, tv, cur, pos, done, keys, hist), emit
+                return (tk, tv, cur, pos, done, keys, hist, lp), ys
 
-            (tk, tv, cur, pos, done, keys, hist), toks = jax.lax.scan(
-                body, (tk0, tv0, cur, pos, ~alive, keys, hist),
+            (tk, tv, cur, pos, done, keys, hist, lp), ys = jax.lax.scan(
+                body, (tk0, tv0, cur, pos, ~alive, keys, hist, tuple(lp0)),
                 jnp.arange(chunk, dtype=jnp.int32),
             )
 
             out = _flush_tail_into_pools(
                 pools, tk, tv, starts, pos, table, ps, tail_len
             )
-            return out, cur, pos, keys, hist, toks.T
+            if n_lp:
+                toks, c, i, t = ys
+                return (out, cur, pos, keys, hist, lp, toks.T,
+                        c.T, jnp.swapaxes(i, 0, 1), jnp.swapaxes(t, 0, 1))
+            return out, cur, pos, keys, hist, ys.T
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -1055,15 +1124,28 @@ class ContinuousEngine:
         top_p: float | None = None,
         seed: int | None = None,
         stream: Any = None,
+        logprobs: int | None = None,
     ) -> int:
         """Queue a request; returns its id (see ``results``/``run``).
         ``stream``: optional ``queue.Queue`` receiving per-chunk token lists
-        and a final ``None``."""
+        and a final ``None``. ``logprobs``: top-N alternatives per generated
+        token (None = off; 0 = chosen-token logprob only); requires the
+        engine constructed with ``logprobs_k >= N``."""
         gen = self.gen
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             raise QueueFullError(
                 f"admission queue full ({self.max_queue} waiting requests)"
             )
+        if logprobs is not None:
+            if self.logprobs_k == 0:
+                raise ValueError(
+                    "logprobs requested but the engine was built with "
+                    "logprobs_k=0"
+                )
+            if not 0 <= logprobs <= self.logprobs_k:
+                raise ValueError(
+                    f"logprobs={logprobs} out of range [0, {self.logprobs_k}]"
+                )
         max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
         prompt = prompt_tokens or [self.tokenizer.bos_id]
         self.validate_request(prompt, max_new)
@@ -1075,6 +1157,7 @@ class ContinuousEngine:
             top_p=gen.top_p if top_p is None else top_p,
             seed=(self._base_seed + self._next_id) if seed is None else seed,
             stream=stream,
+            logprobs=logprobs,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -1129,12 +1212,11 @@ class ContinuousEngine:
                 self._prefill_cache[p_bucket] = self._build_prefill(p_bucket)
             ids = np.full((1, p_bucket), self.tokenizer.pad_id, np.int32)
             ids[0, : len(req.prompt)] = req.prompt
-            self.cache, first = self._prefill_cache[p_bucket](
+            return self._take_prefill(self._prefill_cache[p_bucket](
                 self.params, self.cache, jnp.asarray(ids),
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
                 jnp.float32(req.temperature), jnp.float32(req.top_p), rng,
-            )
-            return first
+            ), slot)
         row, last_logits, d = prefix
         p_bucket = row["k"].shape[2]
         if p_bucket not in self._seed_cache:
@@ -1144,28 +1226,39 @@ class ContinuousEngine:
         if s == 0:
             # Prompt == prefix: first token comes from the stored logits.
             if self._first_sampler is None:
-                self._first_sampler = jax.jit(
-                    lambda lg, key, t, p: sample_logits(
+                n_lp = self.logprobs_k
+
+                def first_sample(lg, key, t, p):
+                    first = sample_logits(
                         lg[None], key, temperature=t,
                         top_k=self.gen.top_k, top_p=p,
                     )[0]
-                )
-            return self._first_sampler(
+                    if n_lp:
+                        c, i, tt = _lp_stats(lg[None], first[None], n_lp)
+                        return first, c[0], i[0], tt[0]
+                    return first
+
+                self._first_sampler = jax.jit(first_sample)
+            out = self._first_sampler(
                 last_logits, rng, jnp.float32(req.temperature),
                 jnp.float32(req.top_p),
             )
+            if self.logprobs_k:
+                first, c, i, t = out
+                self._store_lp(slot, c, i, t)
+                return first
+            return out
         s_bucket = min(_next_pow2(s, floor=16), self.smax - d)
         if s_bucket not in self._suffix_prefill:
             logger.info("compiling suffix prefill for bucket %d", s_bucket)
             self._suffix_prefill[s_bucket] = self._build_suffix_prefill(s_bucket)
         ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
         ids[0, :s] = req.prompt[d:]
-        self.cache, first = self._suffix_prefill[s_bucket](
+        return self._take_prefill(self._suffix_prefill[s_bucket](
             self.params, self.cache, jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.int32(slot), jnp.float32(req.temperature),
             jnp.float32(req.top_p), rng,
-        )
-        return first
+        ), slot)
 
     def _advance_prefill(self, req: Request) -> None:
         """One chunk of a chunked prefill (reuses the suffix-prefill program —
@@ -1205,11 +1298,11 @@ class ContinuousEngine:
         ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
         ids[0, :s] = req.prompt[d: d + s]
         slot_key, sub = jax.random.split(jax.random.key(req.seed))
-        self.cache, first = self._suffix_prefill[s_bucket](
+        first = self._take_prefill(self._suffix_prefill[s_bucket](
             self.params, self.cache, jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.int32(req.slot), jnp.float32(req.temperature),
             jnp.float32(req.top_p), sub,
-        )
+        ), req.slot)
         req.prefill_pos += s
         if req.prefill_pos >= len(req.prompt):
             req.prefilling = False
@@ -1217,6 +1310,23 @@ class ContinuousEngine:
             self.pos = self.pos.at[req.slot].set(len(req.prompt))
             self.keys = self.keys.at[req.slot].set(slot_key)
             self._set_hist(req.slot, req.prompt, first)
+
+    def _take_prefill(self, out, slot: int | None):
+        """Unpack a prefill program's outputs: store the new cache and —
+        when logprobs are armed — the first token's pending stats for
+        ``slot`` (``None``: discard, e.g. page warming); return ``first``."""
+        if self.logprobs_k:
+            self.cache, first, c, i, t = out
+            if slot is not None:
+                self._store_lp(slot, c, i, t)
+        else:
+            self.cache, first = out
+        return first
+
+    def _store_lp(self, slot: int, c, i, t) -> None:
+        self.lp_chosen = self.lp_chosen.at[slot].set(c)
+        self.lp_ids = self.lp_ids.at[slot].set(i)
+        self.lp_top = self.lp_top.at[slot].set(t)
 
     def _set_hist(self, slot: int, prompt: list[int], first) -> None:
         """Seed the slot's draft history: prompt tokens plus the pending
@@ -1272,7 +1382,7 @@ class ContinuousEngine:
 
     def _run_paged_prefill(self, tokens, d: int, s: int, s_bucket: int,
                            ctx_row, write_pids, temp: float, top_p: float,
-                           rng):
+                           rng, slot: int | None = None):
         """Compile-on-miss + call of the (s_bucket, ctx_pages) prefill
         program — the one shared path for slot prefills and page warming."""
         ps, maxp = self.page_size, self.maxp
@@ -1297,13 +1407,12 @@ class ContinuousEngine:
         pids[: min(len(write_pids), n_wp)] = write_pids[:n_wp]
         row = np.zeros((max(ctx, 1),), np.int32)
         row[: min(len(ctx_row), ctx)] = ctx_row[:ctx]
-        self.cache, first = program(
+        return self._take_prefill(program(
             self.params, self.cache,
             jnp.asarray(row), jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.float32(temp), jnp.float32(top_p), rng,
             jnp.asarray(pids),
-        )
-        return first
+        ), slot)
 
     def _paged_prefill_chunk(self, req: Request, slot: int, d: int, s: int,
                              s_bucket: int, rng):
@@ -1313,7 +1422,7 @@ class ContinuousEngine:
             req.prompt[d: d + s], d, s, s_bucket,
             ctx_row=self._table[slot],
             write_pids=self._table[slot, d // ps:],
-            temp=req.temperature, top_p=req.top_p, rng=rng,
+            temp=req.temperature, top_p=req.top_p, rng=rng, slot=slot,
         )
 
     def _admit_paged_slot(self, slot: int) -> bool:
@@ -1391,11 +1500,15 @@ class ContinuousEngine:
             self.top_ps = self.top_ps.at[slot].set(req.top_p)
             self.keys = self.keys.at[slot].set(slot_key)
 
-    def _harvest(self, emitted: np.ndarray, counts: np.ndarray | None = None) -> None:
+    def _harvest(self, emitted: np.ndarray, counts: np.ndarray | None = None,
+                 lp=None) -> None:
         """``counts`` (speculative ticks): per-row valid-emission counts —
         spec rounds emit 1..K+1 tokens, so the row is count-delimited
         instead of pad-delimited (a live row's tick can end without the pad
-        filler that marks death in the plain tick's fixed-width output)."""
+        filler that marks death in the plain tick's fixed-width output).
+        ``lp`` (chosen, top_ids, top_lp arrays, column-aligned with
+        ``emitted``): per-token logprob stats, attached to requests that
+        asked for them."""
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
         for slot, req in enumerate(self._slots):
             if req is None or req.prefilling:
@@ -1404,13 +1517,18 @@ class ContinuousEngine:
                 continue
             fresh: list[int] = []
             row = emitted[slot] if counts is None else emitted[slot][: counts[slot]]
-            for tok in row:
+            for j, tok in enumerate(row):
                 tok = int(tok)
                 if tok in (eos, pad) or len(req.tokens) >= req.max_new_tokens:
                     req.finished = True
                     break
                 req.tokens.append(tok)
                 fresh.append(tok)
+                if lp is not None and req.logprobs is not None:
+                    c, ids, top = lp
+                    req.lp_token.append(float(c[slot, j]))
+                    req.lp_top_ids.append([int(x) for x in ids[slot, j]])
+                    req.lp_top.append([float(x) for x in top[slot, j]])
             if len(req.tokens) >= req.max_new_tokens:
                 req.finished = True
             if req.stream is not None and fresh:
@@ -1440,7 +1558,9 @@ class ContinuousEngine:
             return False
         if any(r.temperature > 0.0 for r in active):
             return False
-        if any(getattr(r, "logprobs", 0) for r in active):
+        # Spec ticks don't carry logprob state — a logprobs request (even
+        # logprobs=0: chosen-token-only) forces plain ticks while active.
+        if any(r.logprobs is not None for r in active):
             return False
         self._tick_no += 1
         preds = []
@@ -1514,24 +1634,33 @@ class ContinuousEngine:
         # top_p only matters when something actually samples — greedy rows
         # ignore it, so (False, True) would compile a redundant program.
         key = (sampled, sampled and any(r.top_p < 1.0 for r in active))
+        lp_args = (
+            (self.lp_chosen, self.lp_ids, self.lp_top)
+            if self.logprobs_k else ()
+        )
         if self.cache_mode == "paged":
             if key not in self._paged_decode:
                 self._paged_decode[key] = self._build_paged_decode(*key)
-            self.cache, self.cur, self.pos, self.keys, self.hist, toks = \
-                self._paged_decode[key](
-                    self.params, self.cache, self.cur,
-                    self.pos, alive, self.temps, self.top_ps, self.keys,
-                    jnp.asarray(self._table), self.limits, self.hist,
-                )
+            res = self._paged_decode[key](
+                self.params, self.cache, self.cur,
+                self.pos, alive, self.temps, self.top_ps, self.keys,
+                jnp.asarray(self._table), self.limits, self.hist, *lp_args,
+            )
         else:
             if key not in self._decode_cache:
                 self._decode_cache[key] = self._build_decode(*key)
-            (self.cache, self.cur, self.pos, self.keys, self.hist,
-             toks) = self._decode_cache[key](
+            res = self._decode_cache[key](
                 self.params, self.cache, self.cur, self.pos, alive,
-                self.temps, self.top_ps, self.keys, self.hist,
+                self.temps, self.top_ps, self.keys, self.hist, *lp_args,
             )
-        self._harvest(np.asarray(jax.device_get(toks)))
+        if self.logprobs_k:
+            (self.cache, self.cur, self.pos, self.keys, self.hist,
+             (self.lp_chosen, self.lp_ids, self.lp_top), toks, c, i, t) = res
+            lp = tuple(np.asarray(x) for x in jax.device_get((c, i, t)))
+        else:
+            self.cache, self.cur, self.pos, self.keys, self.hist, toks = res
+            lp = None
+        self._harvest(np.asarray(jax.device_get(toks)), lp=lp)
 
     @property
     def pending(self) -> int:
@@ -1689,8 +1818,22 @@ class ThreadedEngine:
                     # None already went out in _harvest); recording them here
                     # would leak entries nobody pops.
                     if req.stream is None:
-                        self._results[req.req_id] = req.tokens
+                        self._results[req.req_id] = req
                 self._cond.notify_all()
+
+    @property
+    def logprobs_k(self) -> int:
+        """Max top-N logprob alternatives the engine can serve (0 = off)."""
+        return self._engine.logprobs_k
+
+    def _wait_one(self, rid: int) -> Request:
+        while rid not in self._results:
+            if self._stop:
+                raise RuntimeError(
+                    "continuous engine stopped mid-request"
+                ) from self._error
+            self._cond.wait()
+        return self._results.pop(rid)
 
     def generate_one(
         self,
@@ -1715,13 +1858,41 @@ class ThreadedEngine:
                 seed=seed,
             )
             self._cond.notify_all()
-            while rid not in self._results:
-                if self._stop:
-                    raise RuntimeError(
-                        "continuous engine stopped mid-request"
-                    ) from self._error
-                self._cond.wait()
-            return self._results.pop(rid)
+            return self._wait_one(rid).tokens
+
+    def generate_one_with_logprobs(
+        self,
+        prompt_tokens: list[int],
+        n_top: int,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+    ) -> tuple[list[int], dict]:
+        """``generate_one`` + per-token logprob stats (same dict layout as
+        engine.Generator.generate_tokens_with_logprobs: ``token_logprobs``,
+        ``top_ids``, ``top_logprobs``). The request rides ordinary decode
+        ticks — logprobs no longer force the lock-step path that stalled
+        the continuous engine's throughput."""
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("continuous engine is stopped") from self._error
+            rid = self._engine.submit(
+                prompt_tokens,
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                seed=seed,
+                logprobs=n_top,
+            )
+            self._cond.notify_all()
+            req = self._wait_one(rid)
+            return req.tokens, {
+                "token_logprobs": req.lp_token,
+                "top_ids": [row[:n_top] for row in req.lp_top_ids],
+                "top_logprobs": [row[:n_top] for row in req.lp_top],
+            }
 
     def stream_one(
         self,

@@ -365,7 +365,37 @@ class _Handler(BaseHTTPRequestHandler):
             t0 = time.time()
             logprobs_json = None
             if has_lp:
-                if not hasattr(self.generator, "generate_tokens_with_logprobs"):
+                # OpenAI logprobs: completions' `logprobs: N` = top-N; chat's
+                # `logprobs: true` + `top_logprobs: N`. N is clamped (OpenAI
+                # caps at 5/20).
+                if chat:
+                    # top_logprobs: 0 is a valid explicit request (chosen
+                    # token only) — presence, not truthiness, again.
+                    tl = payload.get("top_logprobs")
+                    n_top = int(tl) if tl is not None else 1
+                else:
+                    n_top = int(lp_req)
+                n_top = max(0, min(n_top, 20))
+                engine_k = getattr(self.threaded_engine, "logprobs_k", 0)
+                if (
+                    self.threaded_engine is not None
+                    and adapter_ids is None
+                    and engine_k > 0
+                    and n_top <= engine_k
+                ):
+                    # Continuous engine with logprobs armed: the request
+                    # rides ordinary decode ticks (sharing the batch with
+                    # everyone else) — no lock-step fallback stalling the
+                    # engine's throughput for a standard capability.
+                    tok = self.threaded_engine.tokenizer
+                    prompt_ids = [tok.bos_id] + tok.encode(prompt)
+                    gen_ids, lp = self.threaded_engine.generate_one_with_logprobs(
+                        prompt_ids, n_top,
+                        max_new_tokens=gen.max_new_tokens,
+                        temperature=gen.temperature, top_p=gen.top_p,
+                        seed=gen.seed,
+                    )
+                elif not hasattr(self.generator, "generate_tokens_with_logprobs"):
                     # --pod wraps the generator in PodGenerator; its broadcast
                     # protocol doesn't carry logprobs (and device work must
                     # not bypass it).
@@ -375,32 +405,24 @@ class _Handler(BaseHTTPRequestHandler):
                                    "with --pod serving"}},
                     )
                     return
-                # OpenAI logprobs: completions' `logprobs: N` = top-N; chat's
-                # `logprobs: true` + `top_logprobs: N`. Served by the
-                # lock-step generator (exact per-step logits) even when the
-                # continuous engine handles plain requests. N is clamped
-                # (OpenAI caps at 5/20); the Generator's LRU program cache
-                # bounds what other client-controlled compile-key fields
-                # (temperature, top_p, max_tokens) can pin in memory.
-                if chat:
-                    # top_logprobs: 0 is a valid explicit request (chosen
-                    # token only) — presence, not truthiness, again.
-                    tl = payload.get("top_logprobs")
-                    n_top = int(tl) if tl is not None else 1
                 else:
-                    n_top = int(lp_req)
-                n_top = max(0, min(n_top, 20))
-                tok = self.generator.tokenizer
-                prompt_ids = [tok.bos_id] + tok.encode(prompt)
-                # The engine's top-k needs k >= 1; n_top == 0 is served by
-                # computing one alternative and emitting none.
-                lp_gen = dataclasses.replace(gen, logprobs=max(1, n_top))
-                with self.device_lock:
-                    outs, lps = self.generator.generate_tokens_with_logprobs(
-                        [prompt_ids], lp_gen, adapter_ids
-                    )
-                gen_ids = outs[0]
-                lp = lps[0]
+                    # Lock-step generator (exact per-step logits): the
+                    # no-continuous-engine server, adapter requests, and
+                    # n_top beyond the engine's compiled logprobs_k. The
+                    # Generator's LRU program cache bounds what other
+                    # client-controlled compile-key fields (temperature,
+                    # top_p, max_tokens) can pin in memory.
+                    tok = self.generator.tokenizer
+                    prompt_ids = [tok.bos_id] + tok.encode(prompt)
+                    # The engine's top-k needs k >= 1; n_top == 0 is served
+                    # by computing one alternative and emitting none.
+                    lp_gen = dataclasses.replace(gen, logprobs=max(1, n_top))
+                    with self.device_lock:
+                        outs, lps = self.generator.generate_tokens_with_logprobs(
+                            [prompt_ids], lp_gen, adapter_ids
+                        )
+                    gen_ids = outs[0]
+                    lp = lps[0]
                 # Apply stop truncation at TOKEN granularity before building
                 # the logprobs JSON: the entries must stay aligned with the
                 # returned text (keep whole tokens up to the stop cut).
@@ -586,10 +608,17 @@ def serve(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--speculative", choices=("off", "on", "auto"), default="off",
-        help="prompt-lookup speculative decoding for greedy requests "
-        "(--engine lockstep, streamed or not): 'on' always speculates, 'auto' "
-        "enables per request from measured acceptance "
-        "(infer/speculative.py; outputs stay token-identical)",
+        help="prompt-lookup speculative decoding for greedy requests, both "
+        "engines: 'on' always speculates, 'auto' decides from measured "
+        "acceptance — per request on the lock-step engine "
+        "(infer/speculative.py), per decode tick on the continuous engine "
+        "(speculative ticks; outputs stay token-identical)",
+    )
+    parser.add_argument(
+        "--logprobs-k", type=int, default=0,
+        help="arm the continuous engine to serve per-token logprobs with up "
+        "to K alternatives natively (requests ride ordinary decode ticks); "
+        "0 = logprob requests fall back to the lock-step generator",
     )
     parser.add_argument(
         "--max-queue", type=int, default=0,
@@ -778,6 +807,11 @@ def serve(argv: list[str] | None = None) -> int:
             n_pages=args.pages or None,
             max_queue=args.max_queue or None,
             mesh=mesh,
+            speculative=args.speculative != "off",
+            # 'on' forces every greedy tick speculative; 'auto' keeps the
+            # measured-acceptance decision (engine default threshold).
+            spec_threshold=0.0 if args.speculative == "on" else None,
+            logprobs_k=args.logprobs_k,
         )
 
     if args.pod and jax.process_index() != 0:
@@ -819,7 +853,10 @@ def serve(argv: list[str] | None = None) -> int:
 
         generator = pod = PodGenerator(generator)
     spec = None
-    if args.speculative != "off":
+    if args.speculative != "off" and args.engine == "lockstep":
+        # The continuous engine speculates inside its own decode ticks
+        # (build_engine above); the lock-step path uses the dedicated
+        # speculative generator.
         from ditl_tpu.infer.speculative import (
             AutoSpeculativeGenerator, SpeculativeGenerator,
         )
