@@ -1666,6 +1666,31 @@ class ContinuousEngine:
     def pending(self) -> int:
         return len(self._queue) + sum(r is not None for r in self._slots)
 
+    def scheduler_fingerprint(self) -> int:
+        """31-bit digest of the host-side scheduler state that must agree
+        across pod processes after every tick: slot occupancy, queue depth,
+        and — in paged mode — the page tables plus allocator occupancy.
+        Pod replicas run the scheduler deterministically on broadcast
+        inputs, so tables SHOULD be identical; a single divergent
+        allocation or eviction would desync the SPMD tick programs
+        silently (each process would gather different pages), which on TPU
+        manifests as wrong tokens or a collective hang. The pod tick's
+        status collective exchanges this digest so divergence stops the
+        pod loudly instead (infer/podserve.py)."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(len(self._queue).to_bytes(4, "big"))
+        h.update(bytes(
+            0 if r is None else (2 if r.prefilling else 1)
+            for r in self._slots
+        ))
+        if self.cache_mode == "paged":
+            h.update(self._table.tobytes())
+            h.update(self.allocator.n_free.to_bytes(4, "big"))
+            h.update(self.allocator.n_evictable.to_bytes(4, "big"))
+        return int.from_bytes(h.digest()[:4], "big") >> 1
+
     def stats(self) -> dict:
         """Operational snapshot (host state only — no device sync): slot
         occupancy, queue depth, and page-pool accounting in paged mode.

@@ -81,6 +81,21 @@ def _statuses_agree(ok: bool) -> bool:
     return bool(statuses.min() == statuses.max())
 
 
+def _status_fingerprints_agree(ok: bool, fingerprint: int) -> bool:
+    """Continuous-tick status collective carrying the engine's scheduler
+    FINGERPRINT (ContinuousEngine.scheduler_fingerprint) alongside the
+    ok/fail byte: replicas whose page allocators or slot schedules diverge
+    — even while every tick 'succeeds' locally — produce different
+    digests, and the whole pod shuts down loudly instead of silently
+    gathering different pages inside the same SPMD program."""
+    from jax.experimental import multihost_utils
+
+    statuses = np.asarray(multihost_utils.process_allgather(
+        np.asarray([1 if ok else 0, fingerprint], np.int64)
+    )).reshape(-1, 2)
+    return bool((statuses.min(axis=0) == statuses.max(axis=0)).all())
+
+
 class _Job:
     def __init__(self, token_lists, gen):
         self.token_lists = token_lists
@@ -494,11 +509,13 @@ class PodContinuousDriver:
         except Exception as e:  # noqa: BLE001 — surfaced via tickets
             ok = False
             err = e
-        if not _statuses_agree(ok):
+        if not _status_fingerprints_agree(
+            ok, self._engine.scheduler_fingerprint() if ok else 0
+        ):
             self._workers_down = True
             raise RuntimeError(
-                "pod tick status diverged across processes (workers have "
-                "shut down)"
+                "pod tick status/scheduler-state diverged across processes "
+                "(workers have shut down)"
             )
         with self._cond:
             self._inflight = 0
@@ -667,8 +684,11 @@ def continuous_worker_loop(engine) -> None:
         except Exception:
             ok = False
             logger.exception("pod continuous worker: tick failed")
-        if not _statuses_agree(ok):
+        if not _status_fingerprints_agree(
+            ok, engine.scheduler_fingerprint() if ok else 0
+        ):
             logger.error(
-                "pod continuous worker: tick status diverged; shutting down"
+                "pod continuous worker: tick status/scheduler-state "
+                "diverged; shutting down"
             )
             return

@@ -1,0 +1,115 @@
+"""MoE inference (VERDICT r2 item 3): the Mixtral-style expert path must
+serve, not just train — lock-step Generator, ContinuousEngine (both cache
+modes), speculative ticks, and expert-sharded decode on a mesh.
+
+The reference's only model is remote (ref
+``src/distributed_inference.py:37``); the MoE serving scope comes from
+BASELINE.json's Mixtral-8x7B north star.
+"""
+
+import dataclasses
+
+import jax
+import pytest
+
+from ditl_tpu.config import MeshConfig, ModelConfig
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer.continuous import ContinuousEngine
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.models import llama
+from ditl_tpu.runtime.mesh import build_mesh
+
+PROMPTS = ["abcabcabc", "the cat sat on the mat", "x"]
+
+
+@pytest.fixture(scope="module")
+def moe_setup():
+    cfg = ModelConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        dtype="float32",
+        param_dtype="float32",
+        num_experts=4,
+        num_experts_per_tok=2,
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    return cfg, params
+
+
+def test_generator_moe_decode(moe_setup):
+    cfg, params = moe_setup
+    tok = ByteTokenizer()
+    g = Generator(params, cfg, tok)
+    gen = GenerateConfig(max_new_tokens=12)
+    out1 = g.generate(PROMPTS, gen)
+    out2 = g.generate(PROMPTS, gen)
+    assert out1 == out2  # deterministic greedy routing through experts
+    assert all(isinstance(o, str) for o in out1)
+
+
+def test_continuous_moe_matches_generator(moe_setup):
+    cfg, params = moe_setup
+    tok = ByteTokenizer()
+    gen = GenerateConfig(max_new_tokens=14)
+    ref = Generator(params, cfg, tok).generate(PROMPTS, gen)
+    for kw in ({}, dict(cache_mode="paged", page_size=16)):
+        eng = ContinuousEngine(params, cfg, tok, n_slots=4, decode_chunk=4, **kw)
+        out = eng.generate(PROMPTS, max_new_tokens=14, temperature=0.0)
+        assert out == ref, kw
+
+
+def test_spec_moe_matches_plain(moe_setup):
+    """Speculative verify forwards route (B, K+1) chunks through the
+    experts; outputs must stay token-identical to plain ticks."""
+    cfg, params = moe_setup
+    tok = ByteTokenizer()
+    ref = ContinuousEngine(params, cfg, tok, n_slots=4, decode_chunk=4).generate(
+        PROMPTS, max_new_tokens=14, temperature=0.0
+    )
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=4, decode_chunk=4,
+        speculative=True, spec_threshold=0.0, spec_rounds=2,
+    )
+    out = eng.generate(PROMPTS, max_new_tokens=14, temperature=0.0)
+    assert eng.stats()["speculative"]["spec_ticks"] > 0
+    assert out == ref
+
+
+def test_moe_decode_expert_sharded_matches_single_device(moe_setup):
+    """Expert-parallel decode: the same greedy tokens through an
+    ep x dp mesh as unsharded (GSPMD collectives in the decode program)."""
+    cfg, params = moe_setup
+    tok = ByteTokenizer()
+    gen = GenerateConfig(max_new_tokens=10)
+    ref = Generator(params, cfg, tok).generate(PROMPTS, gen)
+    mesh = build_mesh(MeshConfig(data=2, expert=4))
+    sharded = Generator(params, cfg, tok, mesh=mesh).generate(PROMPTS, gen)
+    assert sharded == ref
+
+
+def test_moe_continuous_expert_sharded(moe_setup):
+    cfg, params = moe_setup
+    tok = ByteTokenizer()
+    mesh = build_mesh(MeshConfig(data=2, expert=4))
+    ref = ContinuousEngine(params, cfg, tok, n_slots=4, decode_chunk=4).generate(
+        PROMPTS, max_new_tokens=10, temperature=0.0
+    )
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=4, decode_chunk=4, mesh=mesh
+    )
+    out = eng.generate(PROMPTS, max_new_tokens=10, temperature=0.0)
+    assert out == ref
+
+
+def test_moe_sampled_decode_respects_seed(moe_setup):
+    cfg, params = moe_setup
+    tok = ByteTokenizer()
+    g = Generator(params, cfg, tok)
+    gen = GenerateConfig(max_new_tokens=10, temperature=0.8, seed=3)
+    assert g.generate(PROMPTS, gen) == g.generate(PROMPTS, gen)
