@@ -188,13 +188,40 @@ class PodGenerator:
                     ],
                     np.int32,
                 )
-                _broadcast(header)
-                ids = _broadcast(ids)
-                lengths = _broadcast(lengths)
             except BaseException as e:  # noqa: BLE001 — handed to the waiter
+                # Packing failed BEFORE anything was broadcast: the pod never
+                # saw this tick, so fail the one job and keep serving.
                 job.error = e
                 job.done.set()
                 continue
+            try:
+                _broadcast(header)
+                ids = _broadcast(ids)
+                lengths = _broadcast(lengths)
+            except BaseException as e:  # noqa: BLE001
+                # A failure mid-broadcast is FATAL: workers that received the
+                # header are already inside the ids broadcast / post-tick
+                # allgather, so continuing to the next job would misalign the
+                # pod's collective sequence and hang everyone (ADVICE r2) —
+                # same shutdown path as a status divergence.
+                job.error = e
+                job.done.set()
+                logger.exception(
+                    "pod broadcast failed mid-tick; stopping pod serving "
+                    "(collective sequence can no longer be trusted)"
+                )
+                with self._submit_lock:
+                    self._stop = True
+                    while True:
+                        try:
+                            j = self._jobs.get_nowait()
+                        except queue.Empty:
+                            break
+                        j.error = RuntimeError(
+                            "pod serving stopped (broadcast failure)"
+                        )
+                        j.done.set()
+                return
             ok = True
             try:
                 job.result = _run_tick(self.generator, header, ids, lengths)
@@ -586,34 +613,48 @@ class PodContinuousDriver:
         import queue as _queue
 
         stream: _queue.Queue = _queue.Queue()
+        # Staged EAGERLY (not on first next()): QueueFullError must raise
+        # while the HTTP layer can still answer 429 — after the SSE headers
+        # there is no status left to send (ADVICE r2).
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
                              top_p, seed, stream=stream)
-        try:
-            while True:
-                try:
-                    chunk = stream.get(timeout=1.0)
-                except _queue.Empty:
-                    if self._stop:
-                        raise RuntimeError(
-                            "pod serving stopped mid-stream"
-                        ) from self._error
-                    continue
-                if chunk is None:
-                    if ticket.error is not None:
-                        # fail() uses the same end-of-stream sentinel; a
-                        # driver error must not present a truncated stream
-                        # as a clean completion.
-                        raise RuntimeError(
-                            "pod serving stopped mid-stream"
-                        ) from ticket.error
-                    return
-                yield chunk
-        finally:
-            # Cancel only abandoned/failed streams: a cleanly finished
-            # request was already removed by take_finished, and a dead
-            # cancel would cost one pointless pod-wide broadcast tick.
-            if ticket.req_id is not None and not ticket.done.is_set():
-                self.cancel(ticket.req_id)
+
+        def chunks():
+            try:
+                while True:
+                    try:
+                        chunk = stream.get(timeout=1.0)
+                    except _queue.Empty:
+                        if self._stop:
+                            raise RuntimeError(
+                                "pod serving stopped mid-stream"
+                            ) from self._error
+                        continue
+                    if chunk is None:
+                        if ticket.error is not None:
+                            # fail() uses the same end-of-stream sentinel; a
+                            # driver error must not present a truncated
+                            # stream as a clean completion.
+                            raise RuntimeError(
+                                "pod serving stopped mid-stream"
+                            ) from ticket.error
+                        # The engine enqueues the sentinel inside the tick;
+                        # the pump marks the ticket finished moments later
+                        # (take_finished). Wait for that so the finally
+                        # clause below doesn't broadcast a spurious pod-wide
+                        # cancel tick for a cleanly finished request
+                        # (ADVICE r2).
+                        ticket.done.wait(timeout=2.0)
+                        return
+                    yield chunk
+            finally:
+                # Cancel only abandoned/failed streams: a cleanly finished
+                # request was already removed by take_finished, and a dead
+                # cancel would cost one pointless pod-wide broadcast tick.
+                if ticket.req_id is not None and not ticket.done.is_set():
+                    self.cancel(ticket.req_id)
+
+        return chunks()
 
     def cancel(self, req_id: int) -> None:
         with self._cond:

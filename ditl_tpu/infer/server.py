@@ -33,6 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ditl_tpu.config import ModelConfig
 from ditl_tpu.data.tokenizer import Tokenizer
+from ditl_tpu.infer.continuous import QueueFullError
 from ditl_tpu.infer.engine import GenerateConfig, Generator
 from ditl_tpu.utils.logging import get_logger
 
@@ -248,20 +249,28 @@ class _Handler(BaseHTTPRequestHandler):
             return {"id": cmpl_id, "object": kind, "created": created,
                     "model": model, "choices": [choice]}
 
+        # Submit eagerly, BEFORE the SSE headers go out: stream_one reserves
+        # the queue slot here, so QueueFullError still becomes an HTTP 429
+        # instead of a silently truncated stream (ADVICE r2).
+        stream_iter = None
+        if self.threaded_engine is not None and adapter_ids is None:
+            etok = self.threaded_engine.tokenizer
+            stream_iter = self.threaded_engine.stream_one(
+                [etok.bos_id] + etok.encode(prompt),
+                max_new_tokens=gen.max_new_tokens,
+                temperature=gen.temperature,
+                top_p=gen.top_p,
+                seed=gen.seed,
+            )
+
         def events():
             if chat:
                 yield event("", role="assistant")  # role-announcement chunk
             tracker = _StopTracker(stops or [])
             n_gen = 0
-            if self.threaded_engine is not None and adapter_ids is None:
+            if stream_iter is not None:
                 tok = self.threaded_engine.tokenizer
-                for chunk in self.threaded_engine.stream_one(
-                    [tok.bos_id] + tok.encode(prompt),
-                    max_new_tokens=gen.max_new_tokens,
-                    temperature=gen.temperature,
-                    top_p=gen.top_p,
-                    seed=gen.seed,
-                ):
+                for chunk in stream_iter:
                     n_gen += len(chunk)
                     text = tracker.push(tok.decode(chunk))
                     if text:
@@ -344,17 +353,15 @@ class _Handler(BaseHTTPRequestHandler):
                                    "not supported by this server"}},
                     )
                     return
-                if (self.threaded_engine is not None
-                        and getattr(self.threaded_engine, "queue_full", False)):
-                    # Pre-stream check: after the SSE headers go out there
-                    # is no way to signal 429.
-                    self._send_429("admission queue full")
-                    return
                 try:
                     self._stream_complete(
                         payload, prompt, gen, chat=chat,
                         adapter_ids=adapter_ids, stops=stops,
                     )
+                except QueueFullError as e:
+                    # The stream's submit is eager (before SSE headers), so
+                    # a full queue still becomes a real 429 (ADVICE r2).
+                    self._send_429(str(e))
                 except (BrokenPipeError, ConnectionError):
                     logger.info("client disconnected mid-stream")
                 except Exception:

@@ -399,7 +399,20 @@ def paged_attention(
                 check_vma=False,
             )(*args)
         # Mesh doesn't divide heads/batch (or no such axes): single-program
-        # path under GSPMD — fall through unsharded.
+        # path under GSPMD — fall through unsharded. Warn: under GSPMD the
+        # unsharded pallas_call forces the whole page pool to be
+        # replicated/resharded every decode step — a large silent perf/HBM
+        # cliff on exactly the configs sharding exists for (ADVICE r2).
+        if tp > 1 or dp > 1:
+            import warnings
+
+            warnings.warn(
+                f"paged_attention: mesh given but not shardable (kv_heads="
+                f"{kv_heads} vs tp={tp}/{tp_q}, batch={q.shape[0]} vs "
+                f"dp={dp}); falling back to the unsharded kernel under "
+                f"GSPMD — expect per-step pool resharding",
+                stacklevel=2,
+            )
     if multi_q:
         b, nq, h, d = q.shape
     else:

@@ -191,3 +191,34 @@ def test_spec_streaming_chunks_concatenate_to_plain(setup):
         assert tok.decode(got) == ref
     finally:
         te.close()
+
+
+def test_spec_threshold_self_calibrates(setup):
+    """With no configured threshold, the engine measures the verify-round /
+    decode-step cost ratio from its own tick timings: 'prior' until both
+    paths have run twice, then 'measured'; explicit values always win."""
+    params, cfg, tok = setup
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4,
+        speculative=True, spec_probe_every=1000,
+    )
+    assert eng.stats()["speculative"]["threshold_source"] == "prior"
+    assert eng.spec_threshold == 2.5
+    # Random weights: the probe measures ~1 token/forward, the engine falls
+    # back to plain ticks, and BOTH program kinds get timed (the first call
+    # of each — the compile — is excluded, so run enough ticks).
+    for _ in range(3):
+        eng.generate(PROMPTS, max_new_tokens=24, temperature=0.0)
+    st = eng.stats()["speculative"]
+    assert st["plain_step_ms"] is not None
+    if st["spec_round_ms"] is not None:  # >= 2 spec ticks ran
+        assert st["threshold_source"] == "measured"
+        assert eng.spec_threshold == pytest.approx(
+            st["spec_round_ms"] / st["plain_step_ms"]
+        )
+        assert eng.spec_threshold > 0
+    fixed = ContinuousEngine(
+        params, cfg, tok, n_slots=2, speculative=True, spec_threshold=3.3,
+    )
+    assert fixed.spec_threshold == 3.3
+    assert fixed.stats()["speculative"]["threshold_source"] == "configured"
