@@ -431,8 +431,15 @@ class ContinuousEngine:
             self.spec_k = spec_k
             self.spec_ngram = spec_ngram
             self.spec_min_ngram = spec_min_ngram
+            # Default rounds-per-tick matches the PLAIN tick's device cost,
+            # not its token count: a verify round costs ~2.5 decode steps
+            # (the threshold prior), so decode_chunk/2.5 rounds keep tick
+            # latency comparable while emitting up to (k+1)x more tokens
+            # per tick — which is also what amortizes the per-tick host
+            # dispatch on remote-transport setups. Rows that finish
+            # mid-tick wait for the tick end, same as the plain chunk.
             self.spec_rounds = spec_rounds or max(
-                1, -(-decode_chunk // (spec_k + 1))
+                1, round(decode_chunk / 2.5)
             )
             if self.spec_rounds < 1:
                 raise ValueError(f"spec_rounds must be >= 1, got {spec_rounds}")
