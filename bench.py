@@ -278,10 +278,24 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             out = eng.run()
             return sum(len(v) for v in out.values())
 
-        run_once(make_engine())  # compile path (fresh engine: cold caches)
+        def reset_prefix_state(eng):
+            # Every timed iteration measures a COLD-prefix run: drop the
+            # content cache so paged iterations don't silently become
+            # prefix-cache benchmarks (programs stay compiled — only the
+            # host-side allocator resets; pages are fully rewritten before
+            # any read).
+            if cache == "paged":
+                from ditl_tpu.infer.paged_cache import PageAllocator
+
+                eng.allocator = PageAllocator(eng.n_pages)
+                eng._table[:] = 0
+                eng._slot_pages = [[] for _ in range(eng.n_slots)]
+
         eng = make_engine()
+        run_once(eng)  # compile every program in the path
         times, tokens = [], 0
-        for _ in range(3):
+        for _ in range(5):
+            reset_prefix_state(eng)
             t = time.perf_counter()
             tokens = run_once(eng)
             times.append(time.perf_counter() - t)
@@ -307,7 +321,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         g = Generator(params, cfg, tok)
         g.generate_tokens(prompts, gen)  # compile
         times, tokens = [], 0
-        for _ in range(3):
+        for _ in range(5):
             t = time.perf_counter()
             out = g.generate_tokens(prompts, gen)
             tokens = sum(len(v) for v in out)

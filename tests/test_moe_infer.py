@@ -53,6 +53,7 @@ def test_generator_moe_decode(moe_setup):
     assert all(isinstance(o, str) for o in out1)
 
 
+@pytest.mark.slow
 def test_continuous_moe_matches_generator(moe_setup):
     cfg, params = moe_setup
     tok = ByteTokenizer()
@@ -64,6 +65,7 @@ def test_continuous_moe_matches_generator(moe_setup):
         assert out == ref, kw
 
 
+@pytest.mark.slow
 def test_spec_moe_matches_plain(moe_setup):
     """Speculative verify forwards route (B, K+1) chunks through the
     experts; outputs must stay token-identical to plain ticks."""
@@ -93,6 +95,7 @@ def test_moe_decode_expert_sharded_matches_single_device(moe_setup):
     assert sharded == ref
 
 
+@pytest.mark.slow
 def test_moe_continuous_expert_sharded(moe_setup):
     cfg, params = moe_setup
     tok = ByteTokenizer()

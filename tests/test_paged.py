@@ -287,6 +287,7 @@ def test_paged_cancel_frees_pages(tiny_setup):
     assert eng.pending == 0
 
 
+@pytest.mark.slow
 def test_paged_int8_kv_deterministic_and_reuses_prefix(tiny_setup):
     """int8 KV + paged: generation is deterministic, automatic prefix reuse
     still fires (quantized pages are shared), and outputs stay close to the

@@ -317,6 +317,7 @@ def test_pod_continuous_bad_request_isolated(cont_engine):
 # -- pod x paged composition + allocator-divergence guard (r3) ---------------
 
 
+@pytest.mark.slow
 def test_pod_continuous_paged_matches_plain_engine(cont_engine):
     """A PAGED engine driven through the pod tick-broadcast protocol
     (VERDICT r2 item 4): same tokens as ticking the engine directly."""
@@ -358,6 +359,7 @@ def test_pod_paged_allocator_divergence_stops_pod(cont_engine, monkeypatch):
     driver.close()
 
 
+@pytest.mark.slow
 def test_scheduler_fingerprint_tracks_allocator_state(cont_engine):
     """The fingerprint must move when page-table/allocator state moves, and
     agree between two replicas fed identical inputs."""

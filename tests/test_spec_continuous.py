@@ -69,6 +69,7 @@ def test_spec_contiguous_matches_plain_greedy(setup):
     assert out == ref
 
 
+@pytest.mark.slow
 def test_spec_paged_matches_plain_greedy(setup):
     params, cfg, tok = setup
     ref = ContinuousEngine(
@@ -82,6 +83,7 @@ def test_spec_paged_matches_plain_greedy(setup):
     assert out == ref
 
 
+@pytest.mark.slow
 def test_spec_paged_int8_deterministic(setup):
     """int8 KV quantizes at tick-flush boundaries, which differ between the
     speculative and plain schedules — exactness is pinned in f32 above; the
@@ -97,6 +99,7 @@ def test_spec_paged_int8_deterministic(setup):
     assert all(len(o) > 0 for o in out1)
 
 
+@pytest.mark.slow
 def test_spec_slot_reuse_more_requests_than_slots(setup):
     params, cfg, tok = setup
     prompts = PROMPTS + ["abab", "qrsqrsqrs"]
@@ -109,6 +112,7 @@ def test_spec_slot_reuse_more_requests_than_slots(setup):
     assert out == ref
 
 
+@pytest.mark.slow
 def test_spec_with_chunked_prefill(setup):
     """History seeding happens at chunked-prefill COMPLETION — the parked
     slot must join speculative ticks with a correct draft history."""
@@ -124,6 +128,7 @@ def test_spec_with_chunked_prefill(setup):
     assert out == ref
 
 
+@pytest.mark.slow
 def test_spec_sampled_slots_force_plain_ticks(setup):
     params, cfg, tok = setup
     eng = _spec_engine(params, cfg, tok)
@@ -135,6 +140,7 @@ def test_spec_sampled_slots_force_plain_ticks(setup):
     assert out == ref  # fallback is the plain tick, bit-for-bit
 
 
+@pytest.mark.slow
 def test_spec_auto_disables_on_low_acceptance(setup):
     """Random weights yield ~1 token/forward; with the default-style
     threshold the engine must probe once, measure, and fall back to plain

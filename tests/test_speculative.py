@@ -174,6 +174,7 @@ def test_device_draft_backoff_matches_host():
         assert dev[i].tolist() == host, f"row {i}"
 
 
+@pytest.mark.slow
 def test_auto_speculative_switches_on_measured_acceptance(tiny_setup_f32):
     from ditl_tpu.infer.speculative import AutoSpeculativeGenerator
 
@@ -223,6 +224,7 @@ def test_auto_speculative_switches_on_measured_acceptance(tiny_setup_f32):
     assert calls["spec"] == before + 1
 
 
+@pytest.mark.slow
 def test_acceptance_accounting_is_honest(tiny_setup_f32):
     """The acceptance metric's denominator counts only rounds where some row
     was live: the chunked while-loop runs whole rounds_per_check chunks, and
@@ -252,6 +254,7 @@ def test_acceptance_accounting_is_honest(tiny_setup_f32):
     assert spec.last_acceptance is not None and spec.last_acceptance > 0
 
 
+@pytest.mark.slow
 def test_server_speculative_path_matches_plain(tiny_setup_f32):
     """--speculative serving: greedy non-streaming requests ride the
     speculative generator and return the same text as a plain server;
@@ -328,6 +331,7 @@ def test_server_speculative_near_max_context_falls_back(tiny_setup_f32):
         server.shutdown()
 
 
+@pytest.mark.slow
 def test_spec_compile_cache_is_bounded(tiny_setup_f32):
     cfg, params = tiny_setup_f32
     tok = ByteTokenizer()
@@ -339,6 +343,7 @@ def test_spec_compile_cache_is_bounded(tiny_setup_f32):
     assert len(spec._compiled) <= 3
 
 
+@pytest.mark.slow
 def test_server_speculative_streaming_matches_plain(tiny_setup_f32):
     """Greedy STREAMED lock-step requests also ride the speculative path;
     assembled SSE text equals the plain server's completion."""
