@@ -374,6 +374,11 @@ class ContinuousEngine:
                 self.cache = fresh_pools()
             self.allocator = PageAllocator(self.n_pages)
             self._table = np.zeros((n_slots, self.maxp), np.int32)
+            # Device-resident mirror, re-uploaded only when the host table
+            # changes (admission / slot free): a per-tick jnp.asarray would
+            # add one host->device transfer to EVERY tick's dispatch stream.
+            self._table_dirty = True
+            self._table_dev: Any = None
             self._slot_pages: list[list[int]] = [[] for _ in range(n_slots)]
             self.limits = jnp.zeros((n_slots,), jnp.int32)
         else:
@@ -1371,6 +1376,7 @@ class ContinuousEngine:
             self.allocator.release(pid)
         self._slot_pages[slot] = []
         self._table[slot, :] = 0
+        self._table_dirty = True
 
     def _publish_prompt_pages(self, req: Request, slot: int) -> None:
         """Make the prompt's FULL pages content-addressable so later prompts
@@ -1469,6 +1475,7 @@ class ContinuousEngine:
         self._slot_pages[slot] = pages
         self._table[slot, :] = 0
         self._table[slot, : len(pages)] = pages
+        self._table_dirty = True
         d0 = len(matched) * ps
         slot_key, sub = jax.random.split(jax.random.key(req.seed))
         req.slot = slot
@@ -1568,6 +1575,12 @@ class ContinuousEngine:
                     self._publish_generated_pages(req, slot)
                     self._free_slot_pages(slot)
 
+    def _table_device(self):
+        if self._table_dirty:
+            self._table_dev = jnp.asarray(self._table)
+            self._table_dirty = False
+        return self._table_dev
+
     @property
     def spec_threshold(self) -> float:
         """Breakeven tokens-per-verify-forward for a spec tick to win.
@@ -1648,16 +1661,19 @@ class ContinuousEngine:
             (self.cache, self.cur, self.pos, self.hist, toks, counts,
              rr) = self._spec_decode[True](
                 self.params, self.cache, self.cur, self.pos, alive,
-                jnp.asarray(self._table), self.limits, self.hist,
+                self._table_device(), self.limits, self.hist,
             )
         else:
             (self.cache, self.cur, self.pos, self.hist, toks, counts,
              rr) = self._spec_decode[False](
                 self.params, self.cache, self.cur, self.pos, alive, self.hist,
             )
-        counts = np.asarray(jax.device_get(counts))
-        rr = np.asarray(jax.device_get(rr))
-        toks = np.asarray(jax.device_get(toks))
+        # ONE device_get for all three outputs: each separate fetch is a
+        # full round trip on remote-device transports (~100 ms here) — three
+        # sequential fetches per tick erased the speculative win entirely.
+        counts, rr, toks = (
+            np.asarray(x) for x in jax.device_get((counts, rr, toks))
+        )
         self._record_tick_time("spec", (_time.perf_counter() - t0) * 1e3)
         self.spec_ticks += 1
         accs = []
@@ -1710,7 +1726,7 @@ class ContinuousEngine:
             res = self._paged_decode[key](
                 self.params, self.cache, self.cur,
                 self.pos, alive, self.temps, self.top_ps, self.keys,
-                jnp.asarray(self._table), self.limits, self.hist, *lp_args,
+                self._table_device(), self.limits, self.hist, *lp_args,
             )
         else:
             if key not in self._decode_cache:
@@ -1722,11 +1738,14 @@ class ContinuousEngine:
         if self.logprobs_k:
             (self.cache, self.cur, self.pos, self.keys, self.hist,
              (self.lp_chosen, self.lp_ids, self.lp_top), toks, c, i, t) = res
-            lp = tuple(np.asarray(x) for x in jax.device_get((c, i, t)))
+            # One fetch for everything (see _spec_step).
+            toks, *lp_np = jax.device_get((toks, c, i, t))
+            lp = tuple(np.asarray(x) for x in lp_np)
+            toks = np.asarray(toks)
         else:
             self.cache, self.cur, self.pos, self.keys, self.hist, toks = res
             lp = None
-        toks = np.asarray(jax.device_get(toks))
+            toks = np.asarray(jax.device_get(toks))
         if self.speculative:
             self._record_tick_time(key, (_time.perf_counter() - t0) * 1e3)
         self._harvest(toks, lp=lp)
@@ -1867,7 +1886,7 @@ class ThreadedEngine:
 
         self._engine = engine
         self._cond = threading.Condition()
-        self._results: dict[int, list[int]] = {}
+        self._results: dict[int, Request] = {}
         self._cancels: set[int] = set()
         self._error: BaseException | None = None
         self._stop = False
