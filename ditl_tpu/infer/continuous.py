@@ -463,7 +463,7 @@ class ContinuousEngine:
             self.spec_acceptance_ema: float | None = None
             self.spec_ticks = 0
             self._tick_no = 0
-            self._spec_decode: dict[bool, Any] = {}  # key: paged?
+            self._spec_decode: dict[tuple, Any] = {}  # key: (paged?, sampled?)
         # Per-slot token history (prompt + generated incl. the pending
         # ``cur``) — the draft source for speculative ticks. Rides the tick
         # carry; host writes it only at admission. 1-wide dummy when
@@ -601,11 +601,34 @@ class ContinuousEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
-    def _build_spec_decode(self):
+    def _spec_accept(self, logits, tokens_in, subs, temps, top_ps,
+                     sampled: bool):
+        """Shared acceptance step for spec ticks: returns ``(n_acc,
+        nxt_tok)`` — accepted-draft count and the pending token. Greedy
+        programs compile the pure exact-match/argmax rule; sampled programs
+        use point-mass rejection sampling (speculative.spec_sample_tokens),
+        whose greedy-row limit is bit-identical to the exact-match rule."""
+        k = self.spec_k
+        if sampled:
+            from ditl_tpu.infer.speculative import spec_sample_tokens
+
+            return spec_sample_tokens(
+                logits, tokens_in[:, 1:], subs, temps, top_ps,
+                top_k=self.gen.top_k,
+            )
+        cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, K+1)
+        eq = tokens_in[:, 1:] == cand[:, :k]
+        n_acc = jnp.sum(jnp.cumprod(eq.astype(jnp.int32), axis=-1), axis=-1)
+        nxt = jnp.take_along_axis(cand, n_acc[:, None], axis=1)[:, 0]
+        return n_acc, nxt
+
+    def _build_spec_decode(self, sampled: bool = False):
         """Speculative decode tick, contiguous cache (module docstring):
         ``spec_rounds`` rounds of draft → (B, K+1) verify forward → accept.
-        Greedy-only (rejection-sampling for temperature > 0 changes the
-        acceptance rule; sampled slots force plain ticks). Emissions are
+        ``sampled=False`` compiles the pure greedy exact-match program;
+        ``sampled=True`` accepts by point-mass rejection sampling (exact in
+        distribution under each row's temperature/top-k/top-p; greedy rows
+        in the batch still take the argmax rule bit-exactly). Emissions are
         compacted per row (prefix of the output buffer) with a per-row
         count, because a round emits 1..K+1 tokens — harvest consumes
         ``toks[b, :counts[b]]`` instead of pad-scanning."""
@@ -619,14 +642,16 @@ class ContinuousEngine:
 
         from ditl_tpu.infer.speculative import _emit_rows, device_lookup_draft
 
-        def run(params, cache, cur, pos, alive, hist):
+        def run(params, cache, cur, pos, alive, hist, temps, top_ps, keys):
             n_b = pos.shape[0]
             out0 = jnp.full((n_b, out_len), pad, jnp.int32)
             zeros = jnp.zeros((n_b,), jnp.int32)
 
             def body(carry, _):
-                cache, cur, pos, done, hist, out, n_out, rr = carry
+                cache, cur, pos, done, hist, out, n_out, rr, keys = carry
                 live = ~done
+                split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
+                keys, subs = split[:, 0], split[:, 1]
                 # ctx_len = pos + 1: hist[pos] holds the pending ``cur``.
                 draft = device_lookup_draft(
                     hist, jnp.minimum(pos + 1, smax), k=k, ngram=ngram,
@@ -640,18 +665,15 @@ class ContinuousEngine:
                     cache=cache, cache_index=pos, attn_mask=mask,
                     mesh=self.mesh, rules=self.rules,
                 )
-                cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, K+1)
-                eq = tokens_in[:, 1:] == cand[:, :k]
-                n_acc = jnp.sum(
-                    jnp.cumprod(eq.astype(jnp.int32), axis=-1), axis=-1
-                )  # (B,) accepted draft tokens
+                n_acc, nxt_tok = self._spec_accept(
+                    logits, tokens_in, subs, temps, top_ps, sampled
+                )
                 # Emission sequence: [cur, accepted drafts...] — index j
-                # emits the token at global position pos + j. The bonus
-                # (cand[n_acc]) becomes the next round's ``cur`` and is NOT
-                # emitted (same pending-token convention as the plain tick).
-                emit_seq = jnp.concatenate([cur[:, None], cand[:, :k]], axis=1)
+                # emits the token at global position pos + j. The pending
+                # token (``nxt_tok``) becomes the next round's ``cur`` and
+                # is NOT emitted (same convention as the plain tick).
                 in_span = q_idx[None, :] <= n_acc[:, None]
-                is_term = (emit_seq == eos) | (emit_seq == pad)
+                is_term = (tokens_in == eos) | (tokens_in == pad)
                 term_before = (
                     jnp.cumsum(is_term.astype(jnp.int32), axis=1)
                     - is_term.astype(jnp.int32)
@@ -659,31 +681,32 @@ class ContinuousEngine:
                 emit = in_span & ~term_before & live[:, None]
                 e = jnp.sum(emit.astype(jnp.int32), axis=1)  # (B,)
                 hit_term = jnp.any(emit & is_term, axis=1)
-                out = _emit_rows(out, emit_seq, n_out, e)
+                out = _emit_rows(out, tokens_in, n_out, e)
                 n_out = n_out + e
-                # History gains positions pos+1 .. pos+e: accepted drafts
-                # plus the bonus (cand[:e] exactly — the bonus IS cand[e-1]
-                # when nothing truncated).
+                # History gains positions pos+1 .. pos+e: the accepted
+                # drafts, with the pending token at index n_acc.
+                append_seq = jnp.where(
+                    q_idx[None, :] == n_acc[:, None],
+                    nxt_tok[:, None],
+                    jnp.concatenate([draft, zeros[:, None]], axis=1),
+                )
                 grow = jnp.where(hit_term, 0, e)
                 hist = _emit_rows(
-                    hist, cand, jnp.minimum(pos + 1, smax), grow
+                    hist, append_seq, jnp.minimum(pos + 1, smax), grow
                 )
                 pos = jnp.where(
                     live, jnp.minimum(pos + e, smax - 1), pos
                 )
                 done = done | hit_term
-                cur = jnp.where(
-                    done, pad,
-                    jnp.take_along_axis(cand, n_acc[:, None], axis=1)[:, 0],
-                )
+                cur = jnp.where(done, pad, nxt_tok)
                 rr = rr + live.astype(jnp.int32)
-                return (cache, cur, pos, done, hist, out, n_out, rr), None
+                return (cache, cur, pos, done, hist, out, n_out, rr, keys), None
 
-            (cache, cur, pos, done, hist, out, n_out, rr), _ = jax.lax.scan(
-                body, (cache, cur, pos, ~alive, hist, out0, zeros, zeros),
+            (cache, cur, pos, done, hist, out, n_out, rr, keys), _ = jax.lax.scan(
+                body, (cache, cur, pos, ~alive, hist, out0, zeros, zeros, keys),
                 None, length=rounds,
             )
-            return cache, cur, pos, hist, out, n_out, rr
+            return cache, cur, pos, hist, keys, out, n_out, rr
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -947,7 +970,7 @@ class ContinuousEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
-    def _build_spec_paged_decode(self):
+    def _build_spec_paged_decode(self, sampled: bool = False):
         """Speculative decode tick, paged cache: same round structure as the
         contiguous spec tick, but the verify chunk's K/V land in the
         deferred-flush TAIL buffer at per-row offsets (cache.scatter_tail)
@@ -957,7 +980,7 @@ class ContinuousEngine:
         round's offset, so the per-tick flush is IDENTICAL to the plain
         tick's (valid = j < pos - starts). ``limits`` caps emission on
         device so flushed positions never pass the pages reserved at
-        admission."""
+        admission. ``sampled``: see ``_build_spec_decode``."""
         cfg, ps, smax = self.cfg, self.page_size, self.smax
         pad, eos = self.tokenizer.pad_id, self.tokenizer.eos_id
         k, rounds = self.spec_k, self.spec_rounds
@@ -970,7 +993,8 @@ class ContinuousEngine:
 
         from ditl_tpu.infer.speculative import _emit_rows, device_lookup_draft
 
-        def run(params, pools, cur, pos, alive, table, limits, hist):
+        def run(params, pools, cur, pos, alive, table, limits, hist, temps,
+                top_ps, keys):
             n_b = pos.shape[0]
             starts = pos
             tk0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
@@ -980,9 +1004,11 @@ class ContinuousEngine:
             zeros = jnp.zeros((n_b,), jnp.int32)
 
             def body(carry, _):
-                tk, tv, cur, pos, done, hist, out, n_out, rr = carry
+                tk, tv, cur, pos, done, hist, out, n_out, rr, keys = carry
                 done = done | (pos >= limits)
                 live = ~done
+                split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
+                keys, subs = split[:, 0], split[:, 1]
                 draft = device_lookup_draft(
                     hist, jnp.minimum(pos + 1, smax), k=k, ngram=ngram,
                     min_ngram=min_ngram,
@@ -1000,14 +1026,11 @@ class ContinuousEngine:
                     paged=paged_meta, mesh=self.mesh, rules=self.rules,
                 )
                 tk, tv = tails["tk"], tails["tv"]
-                cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                eq = tokens_in[:, 1:] == cand[:, :k]
-                n_acc = jnp.sum(
-                    jnp.cumprod(eq.astype(jnp.int32), axis=-1), axis=-1
+                n_acc, nxt_tok = self._spec_accept(
+                    logits, tokens_in, subs, temps, top_ps, sampled
                 )
-                emit_seq = jnp.concatenate([cur[:, None], cand[:, :k]], axis=1)
                 in_span = q_idx[None, :] <= n_acc[:, None]
-                is_term = (emit_seq == eos) | (emit_seq == pad)
+                is_term = (tokens_in == eos) | (tokens_in == pad)
                 term_before = (
                     jnp.cumsum(is_term.astype(jnp.int32), axis=1)
                     - is_term.astype(jnp.int32)
@@ -1016,27 +1039,35 @@ class ContinuousEngine:
                 emit = in_span & ~term_before & budget_ok & live[:, None]
                 e = jnp.sum(emit.astype(jnp.int32), axis=1)
                 hit_term = jnp.any(emit & is_term, axis=1)
-                out = _emit_rows(out, emit_seq, n_out, e)
+                out = _emit_rows(out, tokens_in, n_out, e)
                 n_out = n_out + e
+                append_seq = jnp.where(
+                    q_idx[None, :] == n_acc[:, None],
+                    nxt_tok[:, None],
+                    jnp.concatenate([draft, zeros[:, None]], axis=1),
+                )
                 grow = jnp.where(hit_term, 0, e)
-                hist = _emit_rows(hist, cand, jnp.minimum(pos + 1, smax), grow)
+                hist = _emit_rows(
+                    hist, append_seq, jnp.minimum(pos + 1, smax), grow
+                )
                 pos = jnp.where(live, pos + e, pos)
                 done = done | hit_term
-                cur = jnp.where(
-                    done, pad,
-                    jnp.take_along_axis(cand, n_acc[:, None], axis=1)[:, 0],
-                )
+                cur = jnp.where(done, pad, nxt_tok)
                 rr = rr + live.astype(jnp.int32)
-                return (tk, tv, cur, pos, done, hist, out, n_out, rr), None
+                return (tk, tv, cur, pos, done, hist, out, n_out, rr,
+                        keys), None
 
-            (tk, tv, cur, pos, done, hist, out, n_out, rr), _ = jax.lax.scan(
-                body, (tk0, tv0, cur, pos, ~alive, hist, out0, zeros, zeros),
-                None, length=rounds,
-            )
+            (tk, tv, cur, pos, done, hist, out, n_out, rr, keys), _ = \
+                jax.lax.scan(
+                    body,
+                    (tk0, tv0, cur, pos, ~alive, hist, out0, zeros, zeros,
+                     keys),
+                    None, length=rounds,
+                )
             pools_out = _flush_tail_into_pools(
                 pools, tk, tv, starts, pos, table, ps, tail_len
             )
-            return pools_out, cur, pos, hist, out, n_out, rr
+            return pools_out, cur, pos, hist, keys, out, n_out, rr
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -1617,17 +1648,17 @@ class ContinuousEngine:
             )
 
     def _use_spec_tick(self, active: list[Request]) -> bool:
-        """Speculate this tick? Requires every active slot greedy (the
-        exact-match acceptance rule), then compares the acceptance predicted
-        for the CURRENT slots — each request's measured tokens-per-forward,
-        falling back to the engine's workload EMA for unmeasured requests —
-        against the verify/decode cost-ratio threshold. Probes (runs one
+        """Speculate this tick? Compares the acceptance predicted for the
+        CURRENT slots — each request's measured tokens-per-forward, falling
+        back to the engine's workload EMA for unmeasured requests — against
+        the verify/decode cost-ratio threshold. Probes (runs one
         speculative tick to re-measure) when nothing is measured yet and
         every ``spec_probe_every`` ticks, so a workload shift back to
-        repetitive text is re-detected."""
+        repetitive text is re-detected. Greedy batches take the pure
+        argmax-acceptance program; batches with sampled slots take the
+        rejection-sampling program (exact in distribution; greedy rows in
+        the mix still accept by argmax, bit-exactly)."""
         if not self.speculative:
-            return False
-        if any(r.temperature > 0.0 for r in active):
             return False
         # Spec ticks don't carry logprob state — a logprobs request (even
         # logprobs=0: chosen-token-only) forces plain ticks while active.
@@ -1646,27 +1677,30 @@ class ContinuousEngine:
             return True
         return sum(preds) / len(preds) >= self.spec_threshold
 
-    def _spec_step(self, alive: jax.Array) -> None:
+    def _spec_step(self, alive: jax.Array, sampled: bool) -> None:
         """One speculative tick + acceptance accounting."""
         import time as _time
 
         paged = self.cache_mode == "paged"
-        if paged not in self._spec_decode:
-            self._spec_decode[paged] = (
-                self._build_spec_paged_decode() if paged
-                else self._build_spec_decode()
+        key = (paged, sampled)
+        if key not in self._spec_decode:
+            self._spec_decode[key] = (
+                self._build_spec_paged_decode(sampled) if paged
+                else self._build_spec_decode(sampled)
             )
         t0 = _time.perf_counter()
         if paged:
-            (self.cache, self.cur, self.pos, self.hist, toks, counts,
-             rr) = self._spec_decode[True](
+            (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
+             counts, rr) = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self._table_device(), self.limits, self.hist,
+                self.temps, self.top_ps, self.keys,
             )
         else:
-            (self.cache, self.cur, self.pos, self.hist, toks, counts,
-             rr) = self._spec_decode[False](
-                self.params, self.cache, self.cur, self.pos, alive, self.hist,
+            (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
+             counts, rr) = self._spec_decode[key](
+                self.params, self.cache, self.cur, self.pos, alive,
+                self.hist, self.temps, self.top_ps, self.keys,
             )
         # ONE device_get for all three outputs: each separate fetch is a
         # full round trip on remote-device transports (~100 ms here) — three
@@ -1706,10 +1740,10 @@ class ContinuousEngine:
             return
         alive = jnp.asarray(occupied, bool)
         active = [r for r in self._slots if r is not None and not r.prefilling]
-        if self._use_spec_tick(active):
-            self._spec_step(alive)
-            return
         sampled = any(r.temperature > 0.0 for r in active)
+        if self._use_spec_tick(active):
+            self._spec_step(alive, sampled)
+            return
         # top_p only matters when something actually samples — greedy rows
         # ignore it, so (False, True) would compile a redundant program.
         key = (sampled, sampled and any(r.top_p < 1.0 for r in active))

@@ -11,7 +11,31 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["sample_logits"]
+__all__ = ["sample_logits", "shaped_logits"]
+
+
+def shaped_logits(
+    logits: jax.Array,
+    temperature,
+    *,
+    top_k: int = 0,
+    top_p=1.0,
+) -> jax.Array:
+    """(B, V) raw logits -> shaped logits under per-row temperature / top-k /
+    top-p — exactly the distribution ``sample_logits``' traced-temperature
+    path draws from. Exposed for speculative rejection sampling, which needs
+    the PROBABILITIES (acceptance = p[draft]) rather than one draw. Rows
+    with ``temperature <= 0`` get the clamped 1e-6 scale (callers handle
+    the greedy limit explicitly)."""
+    logits = logits.astype(jnp.float32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[..., None]
+    if top_k > 0:
+        scaled = _apply_top_k(scaled, min(top_k, logits.shape[-1]))
+    per_row_p = not isinstance(top_p, (int, float))
+    if per_row_p or top_p < 1.0:
+        scaled = _apply_top_p(scaled, top_p)
+    return scaled
 
 
 def _apply_top_k(logits: jax.Array, k: int) -> jax.Array:
@@ -68,14 +92,9 @@ def sample_logits(
             logits = _apply_top_p(logits, top_p)
         return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
-    temperature = jnp.asarray(temperature, jnp.float32)
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / jnp.maximum(temperature, 1e-6)[..., None]
-    if top_k > 0:
-        scaled = _apply_top_k(scaled, min(top_k, logits.shape[-1]))
-    per_row_p = not isinstance(top_p, (int, float))
-    if per_row_p or top_p < 1.0:
-        scaled = _apply_top_p(scaled, top_p)
+    scaled = shaped_logits(logits, temperature, top_k=top_k, top_p=top_p)
+    temperature = jnp.asarray(temperature, jnp.float32)
     if rng.ndim >= 1:  # per-row keys (continuous batching: per-request seeds)
         sampled = jax.vmap(
             lambda k, row: jax.random.categorical(k, row).astype(jnp.int32)

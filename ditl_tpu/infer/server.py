@@ -615,11 +615,12 @@ def serve(argv: list[str] | None = None) -> int:
     )
     parser.add_argument(
         "--speculative", choices=("off", "on", "auto"), default="off",
-        help="prompt-lookup speculative decoding for greedy requests, both "
-        "engines: 'on' always speculates, 'auto' decides from measured "
-        "acceptance — per request on the lock-step engine "
-        "(infer/speculative.py), per decode tick on the continuous engine "
-        "(speculative ticks; outputs stay token-identical)",
+        help="prompt-lookup speculative decoding: 'on' always speculates, "
+        "'auto' decides from measured acceptance. Continuous engine: "
+        "speculative decode ticks for greedy AND sampled requests (greedy "
+        "outputs token-identical; sampled exact in distribution via "
+        "rejection sampling). Lock-step engine: greedy requests via "
+        "infer/speculative.py",
     )
     parser.add_argument(
         "--logprobs-k", type=int, default=0,

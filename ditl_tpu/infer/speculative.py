@@ -52,8 +52,83 @@ logger = get_logger(__name__)
 
 __all__ = [
     "AutoSpeculativeGenerator", "SpeculativeGenerator", "lookup_draft",
-    "device_lookup_draft",
+    "device_lookup_draft", "spec_sample_tokens",
 ]
+
+
+def spec_sample_tokens(
+    logits: jax.Array,  # (B, K+1, V) raw verify logits, positions pos..pos+K
+    draft: jax.Array,  # (B, K) drafted tokens for positions pos+1..pos+K
+    keys: jax.Array,  # (B,) PRNG keys (consumed whole; split outside)
+    temps: jax.Array,  # (B,) temperature; <= 0 rows take the greedy rule
+    top_ps,  # (B,) or float nucleus parameter
+    *,
+    top_k: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Rejection-sampling acceptance for POINT-MASS (prompt-lookup) drafts —
+    speculative decoding at temperature > 0 (Leviathan et al.; q is a delta
+    at the drafted token, so the acceptance probability is simply
+    ``p[draft]`` and the residual on rejection is ``p`` with the draft
+    entry removed, renormalized). The emitted sequence is distributed
+    EXACTLY as ancestral sampling from the target model under the same
+    temperature/top-k/top-p shaping (pinned by a distributional test).
+
+    Returns ``(n_acc, next_tok)``: per-row accepted-draft count and the
+    pending token for position ``pos + n_acc + 1`` — the residual sample at
+    the first rejected position, or the bonus sample from position K's
+    distribution when every draft is accepted. Greedy rows (``temps <= 0``)
+    reduce to the exact-match rule: accept while ``draft == argmax``,
+    pending token = the argmax at the first mismatch — bit-identical to the
+    greedy speculative program."""
+    b, k1, v = logits.shape
+    k = k1 - 1
+    greedy_row = temps <= 0.0
+    cand = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, K+1)
+
+    # Shaped probabilities per position (flatten positions into rows so the
+    # per-row temperature/top-p helpers broadcast correctly).
+    from ditl_tpu.infer.sampling import shaped_logits
+
+    flat = shaped_logits(
+        logits.reshape(b * k1, v),
+        jnp.repeat(temps, k1),
+        top_k=top_k,
+        top_p=(jnp.repeat(jnp.asarray(top_ps, jnp.float32), k1)
+               if not isinstance(top_ps, (int, float)) else top_ps),
+    )
+    probs = jax.nn.softmax(flat, axis=-1).reshape(b, k1, v)
+
+    split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
+    u_key, cat_key = split[:, 0], split[:, 1]
+    u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(u_key)  # (B, K)
+    p_draft = jnp.take_along_axis(
+        probs[:, :k], draft[..., None], axis=2
+    )[..., 0]  # (B, K)
+    acc_sampled = u < p_draft
+    acc_greedy = draft == cand[:, :k]
+    acc = jnp.where(greedy_row[:, None], acc_greedy, acc_sampled)
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=-1), axis=-1)
+
+    # Pending-token distribution: position n_acc's shaped probs, with the
+    # rejected draft's entry removed (residual) when a rejection happened.
+    p_sel = jnp.take_along_axis(probs, n_acc[:, None, None], axis=1)[:, 0]
+    rejected = n_acc < k
+    d_sel = jnp.take_along_axis(
+        draft, jnp.clip(n_acc, 0, k - 1)[:, None], axis=1
+    )[:, 0]
+    vocab = jnp.arange(v, dtype=jnp.int32)
+    residual = jnp.where(
+        rejected[:, None] & (vocab[None, :] == d_sel[:, None]), 0.0, p_sel
+    )
+    # Degenerate guard (float-only; p[draft] == 1 implies acceptance a.s.):
+    # fall back to the unadjusted distribution rather than sampling NaNs.
+    z = jnp.sum(residual, axis=-1, keepdims=True)
+    residual = jnp.where(z > 0.0, residual / jnp.maximum(z, 1e-30), p_sel)
+    next_sampled = jax.vmap(
+        lambda kk, row: jax.random.categorical(kk, jnp.log(row + 1e-38))
+    )(cat_key, residual).astype(jnp.int32)
+    next_greedy = jnp.take_along_axis(cand, n_acc[:, None], axis=1)[:, 0]
+    return n_acc, jnp.where(greedy_row, next_greedy, next_sampled)
 
 
 def _emit_rows(buf: jax.Array, chunk: jax.Array, idx: jax.Array, count: jax.Array):

@@ -12,6 +12,7 @@ replaces it — continuous batching + paged KV + speculation simultaneously.
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 import pytest
 
 from ditl_tpu.config import ModelConfig
@@ -129,15 +130,22 @@ def test_spec_with_chunked_prefill(setup):
 
 
 @pytest.mark.slow
-def test_spec_sampled_slots_force_plain_ticks(setup):
+def test_spec_logprobs_slots_force_plain_ticks(setup):
+    """Spec ticks don't carry logprob state: a logprobs request forces
+    plain ticks (bit-for-bit the plain engine), while plain sampled
+    requests now ride speculative ticks (tested below)."""
     params, cfg, tok = setup
-    eng = _spec_engine(params, cfg, tok)
-    out = eng.generate(PROMPTS, max_new_tokens=12, temperature=0.7, seed=5)
+    eng = _spec_engine(params, cfg, tok, logprobs_k=2)
+    rid = eng.submit([tok.bos_id] + tok.encode(PROMPTS[0]),
+                     max_new_tokens=12, temperature=0.0, logprobs=1)
+    out = eng.run()[rid]
     assert eng.stats()["speculative"]["spec_ticks"] == 0
-    ref = ContinuousEngine(params, cfg, tok, n_slots=4, decode_chunk=4).generate(
-        PROMPTS, max_new_tokens=12, temperature=0.7, seed=5
+    ref_eng = ContinuousEngine(
+        params, cfg, tok, n_slots=4, decode_chunk=4, logprobs_k=2
     )
-    assert out == ref  # fallback is the plain tick, bit-for-bit
+    ref_rid = ref_eng.submit([tok.bos_id] + tok.encode(PROMPTS[0]),
+                             max_new_tokens=12, temperature=0.0, logprobs=1)
+    assert ref_eng.run()[ref_rid] == out
 
 
 @pytest.mark.slow
@@ -228,3 +236,70 @@ def test_spec_threshold_self_calibrates(setup):
     )
     assert fixed.spec_threshold == 3.3
     assert fixed.stats()["speculative"]["threshold_source"] == "configured"
+
+
+def test_spec_sample_tokens_matches_target_distribution(setup):
+    """Rejection-sampling acceptance with point-mass drafts: the emitted
+    token at each position is distributed exactly as ancestral sampling
+    from the shaped target distribution (Leviathan et al.) — checked
+    empirically over 20k keys on a tiny vocab, plus the greedy-row limit."""
+    import numpy as np
+
+    from ditl_tpu.infer.speculative import spec_sample_tokens
+
+    V, K = 8, 2
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(1, K + 1, V)) * 1.5, jnp.float32)
+    draft = jnp.asarray([[3, 5]], jnp.int32)
+    temps = jnp.asarray([0.9], jnp.float32)
+    top_ps = jnp.asarray([1.0], jnp.float32)
+
+    def one(key):
+        n_acc, nxt = spec_sample_tokens(logits, draft, key[None], temps, top_ps)
+        return n_acc[0], nxt[0]
+
+    N = 20000
+    keys = jax.vmap(jax.random.key)(jnp.arange(N, dtype=jnp.uint32))
+    n_accs, nxts = jax.jit(jax.vmap(one))(keys)
+    n_accs, nxts = np.asarray(n_accs), np.asarray(nxts)
+    probs = np.asarray(jax.nn.softmax(logits[0].astype(jnp.float32) / 0.9, -1))
+    tok1 = np.where(n_accs >= 1, 3, nxts)
+    emp = np.bincount(tok1, minlength=V) / N
+    assert np.abs(emp - probs[0]).max() < 0.02
+    m = n_accs >= 1
+    tok2 = np.where(n_accs[m] == 2, 5, nxts[m])
+    emp2 = np.bincount(tok2, minlength=V) / m.sum()
+    assert np.abs(emp2 - probs[1]).max() < 0.03
+    # Greedy limit == exact-match rule
+    n0, nx0 = spec_sample_tokens(
+        logits, draft, keys[:1], jnp.asarray([0.0]), top_ps
+    )
+    cand = np.argmax(np.asarray(logits[0]), -1)
+    exp_n = 0 if cand[0] != 3 else 1 + int(cand[1] == 5)
+    assert int(n0[0]) == exp_n and int(nx0[0]) == cand[int(n0[0])]
+
+
+def test_spec_sampled_ticks_reproducible_and_mixed_greedy_exact(setup):
+    """Sampled requests now ride speculative ticks: same seeds → same
+    outputs, and a greedy request sharing the batch with sampled ones
+    still decodes token-identically to a plain greedy engine (the
+    rejection rule's temperature→0 limit is the argmax rule)."""
+    params, cfg, tok = setup
+    mk = lambda: _spec_engine(params, cfg, tok, n_slots=2)
+    a, b = mk(), mk()
+    o1 = a.generate(PROMPTS[:2], max_new_tokens=20, temperature=0.8, seed=7)
+    o2 = b.generate(PROMPTS[:2], max_new_tokens=20, temperature=0.8, seed=7)
+    assert a.stats()["speculative"]["spec_ticks"] > 0
+    assert o1 == o2
+
+    ref = ContinuousEngine(params, cfg, tok, n_slots=2, decode_chunk=4).generate(
+        [PROMPTS[0]], max_new_tokens=22, temperature=0.0
+    )[0]
+    eng = mk()
+    r_g = eng.submit([tok.bos_id] + tok.encode(PROMPTS[0]),
+                     max_new_tokens=22, temperature=0.0)
+    eng.submit([tok.bos_id] + tok.encode(PROMPTS[1]),
+               max_new_tokens=22, temperature=0.9, seed=3)
+    out = eng.run()
+    assert eng.stats()["speculative"]["spec_ticks"] > 0
+    assert tok.decode(out[r_g]) == ref
