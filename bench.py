@@ -197,7 +197,8 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 quantize: bool = False, kv_quant: bool = False,
                 speculative: bool = False, workload: str = "random",
                 slots: int = 8, decode_chunk: int = 16,
-                page_size: int = 256) -> int:
+                page_size: int = 256, moe: bool = False,
+                prompt_len: int = 0, max_new: int = 0) -> int:
     """Decode/serving benchmark — one JSON line. Every serving claim in
     BASELINE.md is reproducible from here: ``--engine continuous`` ticks the
     production slot engine (``--cache paged`` for the page pool + Pallas
@@ -216,16 +217,27 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
 
     platform = jax.devices()[0].platform
     cfg = ModelConfig(
-        name="bench-350m", vocab_size=32768, hidden_size=1024,
-        intermediate_size=2816, num_layers=24, num_heads=16, num_kv_heads=8,
-        head_dim=64, max_seq_len=1024, dtype="bfloat16", param_dtype="float32",
+        name="bench-moe" if moe else "bench-350m", vocab_size=32768,
+        hidden_size=1024,
+        # MoE variant: 8 experts, top-2 — per-token FLOPs comparable to the
+        # dense config, ~2.3B total params (the Mixtral shape at bench
+        # scale; BASELINE.json north star Mixtral-8x7B).
+        intermediate_size=1408 if moe else 2816,
+        num_experts=8 if moe else 0,
+        num_experts_per_tok=2 if moe else 0,
+        num_layers=24, num_heads=16, num_kv_heads=8,
+        head_dim=64,
+        max_seq_len=max(1024, prompt_len + (max_new or 128) + 1),
+        dtype="bfloat16", param_dtype="float32",
         attention_impl="xla", kv_cache_dtype="int8" if kv_quant else "",
     )
-    batch, max_new = (slots, 128) if platform == "tpu" else (2, 16)
+    batch = slots if platform == "tpu" else 2
+    max_new = max_new or (128 if platform == "tpu" else 16)
     if platform != "tpu":
         cfg = dataclasses.replace(cfg, num_layers=2, hidden_size=256,
                                   intermediate_size=688, vocab_size=4096)
         page_size = min(page_size, 64)
+        max_new = min(max_new, 16)
     params = llama.init_params(jax.random.key(0), cfg)
     params_m = llama.num_params(params) / 1e6
     import numpy as np
@@ -241,14 +253,20 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         n_steps, seq = (40, 512) if platform == "tpu" else (4, 64)
         params = _repetitive_finetune(params, cfg, pattern, n_steps,
                                       batch, seq)
-        plen = 256 if platform == "tpu" else 32
-        max_new = 192 if platform == "tpu" else 16
+        plen = prompt_len or (256 if platform == "tpu" else 32)
+        if not max_new or max_new == 128:
+            max_new = 192 if platform == "tpu" else 16
         prompts = []
         for i in range(batch):
             roll = pattern[i % len(pattern):] + pattern[: i % len(pattern)]
             prompts.append((roll * (plen // len(roll) + 1))[:plen])
     elif workload == "random":
-        prompts = [[1] + list(range(10, 70))] * batch
+        plen = prompt_len or 61
+        prompts = [
+            [1] + rng.integers(4, min(4096, cfg.vocab_size),
+                               size=plen - 1).tolist()
+            for _ in range(batch)
+        ]
     else:
         raise SystemExit(f"unknown --infer-workload {workload!r}")
     if quantize:
@@ -335,9 +353,11 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         ", int8-kv" if kv_quant else "",
         ", speculative" if speculative else "",
     )
+    arch = "MoE 8x top-2" if moe else "Llama-style"
     print(json.dumps({
-        "metric": "decode tokens/sec (Llama-style %dM, batch %d, %s, %s)" % (
-            round(params_m), batch, label, workload),
+        "metric": "decode tokens/sec (%s %dM, batch %d, ctx %d+%d, %s, %s)"
+                  % (arch, round(params_m), batch, len(prompts[0]), max_new,
+                     label, workload),
         "value": round(tokens / dt, 1),
         "unit": "tokens/sec",
         "vs_baseline": 1.0,
@@ -487,10 +507,21 @@ if __name__ == "__main__":
                         help="decode steps per tick (--infer continuous)")
     parser.add_argument("--page-size", type=int, default=256,
                         help="tokens per KV page (--infer --cache paged)")
+    parser.add_argument("--moe", action="store_true",
+                        help="MoE bench model (8 experts, top-2) for --infer "
+                        "— the Mixtral-style serving path")
+    parser.add_argument("--prompt-len", type=int, default=0,
+                        help="prompt tokens per request (--infer; 0 = "
+                        "workload default — raise for long-context rows, "
+                        "e.g. 2048 to reproduce the int8-KV context sweep)")
+    parser.add_argument("--max-new", type=int, default=0,
+                        help="generated tokens per request (--infer; 0 = "
+                        "workload default)")
     args = parser.parse_args()
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"
-                  or args.infer_workload != "random")
+                  or args.infer_workload != "random" or args.moe
+                  or args.prompt_len or args.max_new)
     if infer_only and not args.infer:
         parser.error("serving flags require --infer")
     if args.infer:
@@ -500,6 +531,7 @@ if __name__ == "__main__":
             kv_quant=args.kv_quant == "int8",
             speculative=args.speculative, workload=args.infer_workload,
             slots=args.slots, decode_chunk=args.decode_chunk,
-            page_size=args.page_size,
+            page_size=args.page_size, moe=args.moe,
+            prompt_len=args.prompt_len, max_new=args.max_new,
         ))
     sys.exit(main(args.model))

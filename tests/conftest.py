@@ -82,6 +82,30 @@ def example_batch():
 # ---------------------------------------------------------------------------
 
 _SLOW_TESTS = {
+    "tests/test_spec_continuous.py::test_spec_sampled_ticks_reproducible_and_mixed_greedy_exact",
+    "tests/test_spec_continuous.py::test_spec_contiguous_matches_plain_greedy",
+    "tests/test_paged.py::test_paged_attention_multi_query_matches_reference",
+    "tests/test_logprobs.py::test_continuous_engine_logprobs_match_lockstep",
+    "tests/test_convert.py::test_llama_logits_parity[True]",
+    "tests/test_spec_continuous.py::test_spec_threshold_self_calibrates",
+    "tests/test_flash_attention.py::test_grads_match_xla[True]",
+    "tests/test_spec_continuous.py::test_spec_acceptance_accounted_per_request",
+    "tests/test_spec_continuous.py::test_spec_streaming_chunks_concatenate_to_plain",
+    "tests/test_moe_infer.py::test_moe_decode_expert_sharded_matches_single_device",
+    "tests/test_podserve.py::test_pod_paged_allocator_divergence_stops_pod",
+    "tests/test_continuous.py::test_short_request_admitted_during_long_prefill",
+    "tests/test_ulysses.py::test_matches_full_attention[False]",
+    "tests/test_stop_sequences.py::test_streaming_stop_at_full_budget_reports_stop",
+    "tests/test_flash_attention.py::test_forward_matches_xla[blocks0-False]",
+    "tests/test_ring_attention.py::test_matches_full_attention[False]",
+    "tests/test_paged.py::test_paged_int8_kernel_matches_reference",
+    "tests/test_continuous.py::test_queue_depth_cap_raises",
+    "tests/test_continuous.py::test_server_returns_429_when_queue_full",
+    "tests/test_podserve.py::test_pod_concurrent_requests",
+    "tests/test_podserve.py::test_pod_continuous_close_fails_waiters",
+    "tests/test_podserve.py::test_pod_continuous_bad_request_isolated",
+    "tests/test_spec_continuous.py::test_spec_sample_tokens_matches_target_distribution",
+    "tests/test_moe_infer.py::test_spec_moe_matches_plain",
     "tests/test_checkpoint.py::test_checkpoint_cadence_with_step_windows",
     "tests/test_checkpoint.py::test_trainer_resume_continues_from_checkpoint",
     "tests/test_continuous.py::test_chunked_prefill_exact_outputs",

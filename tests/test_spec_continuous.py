@@ -303,3 +303,18 @@ def test_spec_sampled_ticks_reproducible_and_mixed_greedy_exact(setup):
     out = eng.run()
     assert eng.stats()["speculative"]["spec_ticks"] > 0
     assert tok.decode(out[r_g]) == ref
+
+
+def test_spec_smoke_fast(setup):
+    """Fast-tier representative: one speculative engine produces non-empty
+    deterministic output with spec ticks actually running and acceptance
+    accounted (the exactness/distribution variants live in the slow tier)."""
+    params, cfg, tok = setup
+    eng = _spec_engine(params, cfg, tok, n_slots=2, spec_rounds=1)
+    out = eng.generate([PROMPTS[0]], max_new_tokens=10, temperature=0.0)
+    st = eng.stats()["speculative"]
+    assert st["spec_ticks"] == st["ticks"] > 0
+    assert st["acceptance_ema"] is not None and st["acceptance_ema"] >= 1.0
+    assert len(out[0]) > 0
+    eng2 = _spec_engine(params, cfg, tok, n_slots=2, spec_rounds=1)
+    assert eng2.generate([PROMPTS[0]], max_new_tokens=10, temperature=0.0) == out
