@@ -1606,6 +1606,21 @@ class ContinuousEngine:
                     self._publish_generated_pages(req, slot)
                     self._free_slot_pages(slot)
 
+    def freeze_spec_threshold(self) -> None:
+        """Pin the speculation threshold to its current value. REQUIRED for
+        pod serving: the self-calibrating threshold derives from per-host
+        WALL-CLOCK tick timings, so replicas could disagree on whether a
+        tick speculates — different programs, divergent results, and a
+        (loud but spurious) fingerprint shutdown. The pod driver and worker
+        loop call this so every process decides from identical,
+        broadcast-derived state only."""
+        if self.speculative and self._spec_threshold_cfg is None:
+            self._spec_threshold_cfg = self.spec_threshold
+            logger.info(
+                "speculation threshold frozen at %.2f for deterministic "
+                "pod-wide tick decisions", self._spec_threshold_cfg,
+            )
+
     def _table_device(self):
         if self._table_dirty:
             self._table_dev = jnp.asarray(self._table)

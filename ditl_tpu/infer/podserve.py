@@ -410,6 +410,8 @@ class PodContinuousDriver:
 
     def __init__(self, engine, *, poll_s: float = 0.02):
         self._engine = engine
+        # Per-host wall-clock calibration would desync pod tick decisions.
+        engine.freeze_spec_threshold()
         self.tokenizer = engine.tokenizer
         self.poll_s = poll_s
         self._lock = threading.Lock()
@@ -701,6 +703,7 @@ def continuous_worker_loop(engine) -> None:
     """Run on every ``jax.process_index() != 0`` process under
     ``--pod --engine continuous``: mirror the coordinator's tick broadcasts
     on an identical engine replica until shutdown."""
+    engine.freeze_spec_threshold()  # same reason as PodContinuousDriver
     logger.info("pod continuous worker: entering broadcast loop")
     while True:
         header = _broadcast(np.zeros((8,), np.int32))

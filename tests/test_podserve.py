@@ -384,3 +384,22 @@ def test_status_fingerprint_collective_single_process():
 
     assert _status_fingerprints_agree(True, 12345)
     assert _status_fingerprints_agree(False, 0)
+
+
+def test_pod_freezes_self_calibrating_spec_threshold(cont_engine):
+    """Pod serving must pin the speculation threshold: the self-calibrating
+    value derives from per-host wall-clock timings, which would let
+    replicas disagree on whether a tick speculates (divergent programs →
+    spurious fingerprint shutdown)."""
+    from ditl_tpu.infer.podserve import PodContinuousDriver
+
+    eng = cont_engine(speculative=True)  # no explicit threshold: auto mode
+    assert eng._spec_threshold_cfg is None
+    driver = PodContinuousDriver(eng, poll_s=0.01)
+    try:
+        assert eng._spec_threshold_cfg is not None  # frozen at the prior
+        assert eng.stats()["speculative"]["threshold_source"] == "configured"
+        out = driver.generate_one([1] + list(range(5, 15)))
+        assert isinstance(out, list)
+    finally:
+        driver.close()
