@@ -496,7 +496,6 @@ class ContinuousEngine:
 
     def _build_prefill(self, p_bucket: int):
         cfg, smax = self.cfg, self.smax
-        slots_iota = jnp.arange(smax, dtype=jnp.int32)
 
         def run(params, cache, ids, length, slot, temp, top_p, rng):
             # 1-row view of the shared cache: prefill never touches other slots.
@@ -504,19 +503,20 @@ class ContinuousEngine:
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), cache
             )
             q_pos = jnp.arange(p_bucket, dtype=jnp.int32)
-            mask = (slots_iota[None, None, :] <= q_pos[None, :, None]) & (
-                slots_iota[None, None, :] < length
-            )
+            # Empty-cache full prefill == causal self-attention over the
+            # chunk: flash-kernel path (validity via segment ids).
+            seg = (q_pos[None, :] < length).astype(jnp.int32)
             logits, row = llama.forward(
                 params,
                 ids,
                 cfg,
                 positions=q_pos[None],
+                segment_ids=seg,
                 cache=row,
                 cache_index=jnp.int32(0),
-                attn_mask=mask,
                 mesh=self.mesh,
                 rules=self.rules,
+                prefill_causal=True,
             )
             cache = jax.tree.map(
                 lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
@@ -835,12 +835,23 @@ class ContinuousEngine:
                 "v": jnp.concatenate([ctx_v, zeros], axis=2),
             }
             q_pos = offset + jnp.arange(s_bucket, dtype=jnp.int32)
-            mask = buf_iota[None, None, :] <= q_pos[None, :, None]
-            logits, row = llama.forward(
-                params, ids, cfg, positions=q_pos[None],
-                cache=row, cache_index=offset, attn_mask=mask,
-                mesh=self.mesh, rules=self.rules,
-            )
+            if maxp == 0:
+                # No context pages (offset 0): pure causal self-attention
+                # over the chunk — flash-kernel path.
+                seg = (jnp.arange(s_bucket, dtype=jnp.int32)[None, :]
+                       < s_len).astype(jnp.int32)
+                logits, row = llama.forward(
+                    params, ids, cfg, positions=q_pos[None], segment_ids=seg,
+                    cache=row, cache_index=offset,
+                    mesh=self.mesh, rules=self.rules, prefill_causal=True,
+                )
+            else:
+                mask = buf_iota[None, None, :] <= q_pos[None, :, None]
+                logits, row = llama.forward(
+                    params, ids, cfg, positions=q_pos[None],
+                    cache=row, cache_index=offset, attn_mask=mask,
+                    mesh=self.mesh, rules=self.rules,
+                )
             def to_pages(r):  # (L, 1, s_bucket, K, D) -> (L, n_wp, K, ps, D)
                 chunk = jax.lax.dynamic_slice_in_dim(r, offset, s_bucket, axis=2)
                 return jnp.swapaxes(chunk.reshape(L, n_wp, ps, K, D), 2, 3)

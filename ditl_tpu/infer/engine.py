@@ -129,23 +129,24 @@ class Generator:
                 cache = jax.lax.with_sharding_constraint(
                     cache, named_sharding_tree(mesh, cache_logical_axes(cfg), rules)
                 )
-            # Prefill: causal over real (non-pad) prompt slots.
+            # Prefill: causal over real (non-pad) prompt slots — pure causal
+            # self-attention from an empty cache, so the flash kernel
+            # applies (prefill_causal; pad validity rides segment ids).
             q_pos = jnp.arange(prompt_len, dtype=jnp.int32)
-            prefill_mask = (slots[None, None, :] <= q_pos[None, :, None]) & (
-                slots[None, None, :] < lengths[:, None, None]
-            )
+            seg = (q_pos[None, :] < lengths[:, None]).astype(jnp.int32)
             positions = jnp.broadcast_to(q_pos, (batch, prompt_len))
             logits, cache = llama.forward(
                 params,
                 input_ids,
                 cfg,
                 positions=positions,
+                segment_ids=seg,
                 mesh=mesh,
                 rules=rules,
                 cache=cache,
                 cache_index=jnp.int32(0),
-                attn_mask=prefill_mask,
                 adapter_ids=adapter_ids,
+                prefill_causal=True,
             )
             last = jnp.take_along_axis(
                 logits, (lengths - 1)[:, None, None], axis=1

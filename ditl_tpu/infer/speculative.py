@@ -327,14 +327,14 @@ class SpeculativeGenerator:
             # ---- prefill ----
             cache = shard_cache(init_cache(cfg, batch, max_len))
             p_pos = jnp.arange(prompt_len, dtype=jnp.int32)
-            p_mask = (slots[None, None, :] <= p_pos[None, :, None]) & (
-                slots[None, None, :] < lengths[:, None, None]
-            )
+            # Empty-cache prefill = causal self-attention: flash-kernel path
+            # (validity via segment ids), same as the lock-step engine.
+            seg = (p_pos[None, :] < lengths[:, None]).astype(jnp.int32)
             logits, cache = llama.forward(
                 params, input_ids, cfg,
                 positions=jnp.broadcast_to(p_pos, (batch, prompt_len)),
-                mesh=mesh, rules=rules,
-                cache=cache, cache_index=jnp.int32(0), attn_mask=p_mask,
+                segment_ids=seg, mesh=mesh, rules=rules,
+                cache=cache, cache_index=jnp.int32(0), prefill_causal=True,
             )
             first = jnp.argmax(
                 jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0],

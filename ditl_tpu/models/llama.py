@@ -253,6 +253,7 @@ def _decoder_layer(
     attn_mask: jax.Array | None = None,
     adapter_ids: jax.Array | None = None,
     paged: dict | None = None,
+    prefill_causal: bool = False,
 ) -> tuple[jax.Array, jax.Array] | tuple[jax.Array, jax.Array, dict]:
     """One decoder block. With ``layer_cache`` (this layer's slice of the KV
     cache pytree, values shaped (B, Smax, K, D) — plus scales when int8,
@@ -332,6 +333,22 @@ def _decoder_layer(
         )
         if s == 1:
             attn_out = attn_out[:, None]
+    elif layer_cache is not None and prefill_causal:
+        from ditl_tpu.infer.cache import write_kv
+
+        # Full prefill from an EMPTY cache (offset 0): every query attends
+        # only chunk positions — pure causal self-attention, so the Pallas
+        # flash kernel applies (the O(S²) score tensor never hits HBM;
+        # 3.4× faster at 8k context than the masked cache read, BASELINE).
+        # Validity (right-padding) rides segment_ids; the cache write is
+        # unchanged.
+        new_kv = write_kv(layer_cache, k, v, cache_index)
+        attn_out = dot_product_attention(
+            q, k, v, causal=True, segment_ids=segment_ids,
+            impl=cfg.attention_impl, mesh=mesh, rules=rules,
+            block_sizes=(cfg.flash_block_q, cfg.flash_block_kv,
+                         cfg.flash_block_q_bwd, cfg.flash_block_kv_bwd),
+        )
     elif layer_cache is not None:
         from ditl_tpu.infer.cache import read_kv, write_kv
 
@@ -403,6 +420,7 @@ def forward(
     return_hidden: bool = False,
     adapter_ids: jax.Array | None = None,
     paged: dict | None = None,
+    prefill_causal: bool = False,
 ) -> Any:
     """Token ids (B, S) -> logits (B, S, V) in float32.
 
@@ -418,7 +436,13 @@ def forward(
     this into the incremental-decode forward: the chunk's K/V are written into
     the cache at ``cache_index`` and attention uses ``attn_mask`` (B, S, Smax)
     instead of the causal mask. Returns ``(logits, new_cache)`` (plus aux when
-    requested). No remat in this mode — there is no backward pass."""
+    requested). No remat in this mode — there is no backward pass.
+
+    ``prefill_causal=True`` (with ``cache``): the chunk prefills an EMPTY
+    cache from offset 0, so attention is pure causal self-attention over
+    the chunk (validity via ``segment_ids``) and routes through the flash
+    kernel instead of a masked full-cache read — the long-prompt serving
+    prefill path."""
     cd = _dtype(cfg.dtype)
     b, s = input_ids.shape
     if positions is None:
@@ -454,6 +478,7 @@ def forward(
                 attn_mask=attn_mask,
                 adapter_ids=adapter_ids,
                 paged=paged,
+                prefill_causal=prefill_causal,
             )
             return y, (aux, new_kv)
 
