@@ -181,6 +181,8 @@ class Request:
     # chosen token's logprob plus top-N alternatives per generated token
     # (engine computes ``logprobs_k`` alternatives; N only slices).
     logprobs: int | None = None
+    # Multi-LoRA: adapter slot in the stacked params tree (0 = base).
+    adapter_id: int = 0
     lp_token: list[float] = field(default_factory=list)
     lp_top_ids: list[list[int]] = field(default_factory=list)
     lp_top: list[list[float]] = field(default_factory=list)
@@ -395,6 +397,17 @@ class ContinuousEngine:
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.temps = jnp.zeros((n_slots,), jnp.float32)
         self.top_ps = jnp.ones((n_slots,), jnp.float32)
+        # Multi-LoRA serving: when the params tree is an adapter STACK
+        # (models/lora.stack_adapters; leaves (L, n_adapters, d, r)), each
+        # slot carries its adapter id — a per-row gather inside every
+        # program, so requests with different adapters share decode ticks
+        # (slot 0 convention: the base model).
+        lora = params.get("layers", {}).get("lora") or {}
+        self.multi_lora = bool(lora) and next(iter(lora.values()))["a"].ndim == 4
+        self.n_adapters = (
+            next(iter(lora.values()))["a"].shape[1] if self.multi_lora else 0
+        )
+        self.adapters = jnp.zeros((n_slots,), jnp.int32)
         # One PRNG stream per slot: per-request seeds stay reproducible no
         # matter which other requests share the batch.
         self.keys = jax.vmap(jax.random.key)(jnp.arange(n_slots, dtype=jnp.uint32))
@@ -497,7 +510,7 @@ class ContinuousEngine:
     def _build_prefill(self, p_bucket: int):
         cfg, smax = self.cfg, self.smax
 
-        def run(params, cache, ids, length, slot, temp, top_p, rng):
+        def run(params, cache, ids, length, slot, temp, top_p, rng, aid):
             # 1-row view of the shared cache: prefill never touches other slots.
             row = jax.tree.map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), cache
@@ -517,6 +530,7 @@ class ContinuousEngine:
                 mesh=self.mesh,
                 rules=self.rules,
                 prefill_causal=True,
+                adapter_ids=aid if self.multi_lora else None,
             )
             cache = jax.tree.map(
                 lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
@@ -548,7 +562,7 @@ class ContinuousEngine:
         n_lp = self.logprobs_k
 
         def run(params, cache, cur, pos, alive, temps, top_ps, keys, hist,
-                *lp0):
+                adapters, *lp0):
             def body(carry, _):
                 cache, cur, pos, done, keys, hist, lp = carry
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
@@ -564,6 +578,7 @@ class ContinuousEngine:
                     attn_mask=mask,
                     mesh=self.mesh,
                     rules=self.rules,
+                    adapter_ids=adapters if self.multi_lora else None,
                 )
                 nxt = sample_logits(
                     logits[:, 0], subs,
@@ -642,7 +657,8 @@ class ContinuousEngine:
 
         from ditl_tpu.infer.speculative import _emit_rows, device_lookup_draft
 
-        def run(params, cache, cur, pos, alive, hist, temps, top_ps, keys):
+        def run(params, cache, cur, pos, alive, hist, temps, top_ps, keys,
+                adapters):
             n_b = pos.shape[0]
             out0 = jnp.full((n_b, out_len), pad, jnp.int32)
             zeros = jnp.zeros((n_b,), jnp.int32)
@@ -664,6 +680,7 @@ class ContinuousEngine:
                     params, tokens_in, cfg, positions=positions,
                     cache=cache, cache_index=pos, attn_mask=mask,
                     mesh=self.mesh, rules=self.rules,
+                    adapter_ids=adapters if self.multi_lora else None,
                 )
                 n_acc, nxt_tok = self._spec_accept(
                     logits, tokens_in, subs, temps, top_ps, sampled
@@ -757,7 +774,8 @@ class ContinuousEngine:
         cfg, smax = self.cfg, self.smax
         slots_iota = jnp.arange(smax, dtype=jnp.int32)
 
-        def run(params, cache, ids, offset, s_len, slot, temp, top_p, rng):
+        def run(params, cache, ids, offset, s_len, slot, temp, top_p, rng,
+                aid):
             row = jax.tree.map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), cache
             )
@@ -767,6 +785,7 @@ class ContinuousEngine:
                 params, ids, cfg, positions=q_pos[None],
                 cache=row, cache_index=offset, attn_mask=mask,
                 mesh=self.mesh, rules=self.rules,
+                adapter_ids=aid if self.multi_lora else None,
             )
             cache = jax.tree.map(
                 lambda c, r: jax.lax.dynamic_update_slice_in_dim(c, r, slot, axis=1),
@@ -812,7 +831,7 @@ class ContinuousEngine:
         quantized = cfg.kv_cache_dtype == "int8"
 
         def run(params, pools, table_row, ids, offset, s_len, temp, top_p,
-                rng, write_pids):
+                rng, write_pids, aid):
             kp, vp = pools["kp"], pools["vp"]
             L, _, K, _, D = kp.shape
 
@@ -844,6 +863,7 @@ class ContinuousEngine:
                     params, ids, cfg, positions=q_pos[None], segment_ids=seg,
                     cache=row, cache_index=offset,
                     mesh=self.mesh, rules=self.rules, prefill_causal=True,
+                    adapter_ids=aid if self.multi_lora else None,
                 )
             else:
                 mask = buf_iota[None, None, :] <= q_pos[None, :, None]
@@ -851,6 +871,7 @@ class ContinuousEngine:
                     params, ids, cfg, positions=q_pos[None],
                     cache=row, cache_index=offset, attn_mask=mask,
                     mesh=self.mesh, rules=self.rules,
+                    adapter_ids=aid if self.multi_lora else None,
                 )
             def to_pages(r):  # (L, 1, s_bucket, K, D) -> (L, n_wp, K, ps, D)
                 chunk = jax.lax.dynamic_slice_in_dim(r, offset, s_bucket, axis=2)
@@ -910,7 +931,7 @@ class ContinuousEngine:
         n_lp = self.logprobs_k
 
         def run(params, pools, cur, pos, alive, temps, top_ps, keys, table,
-                limits, hist, *lp0):
+                limits, hist, adapters, *lp0):
             n_b = pos.shape[0]
             # starts = pos (not where(alive, pos, 0)): dead rows then have
             # pos - starts == 0 live tail columns, so the flush writes
@@ -941,6 +962,7 @@ class ContinuousEngine:
                     paged=paged_meta,
                     mesh=self.mesh,
                     rules=self.rules,
+                    adapter_ids=adapters if self.multi_lora else None,
                 )
                 tk, tv = tails["tk"], tails["tv"]
                 nxt = sample_logits(
@@ -1005,7 +1027,7 @@ class ContinuousEngine:
         from ditl_tpu.infer.speculative import _emit_rows, device_lookup_draft
 
         def run(params, pools, cur, pos, alive, table, limits, hist, temps,
-                top_ps, keys):
+                top_ps, keys, adapters):
             n_b = pos.shape[0]
             starts = pos
             tk0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
@@ -1035,6 +1057,7 @@ class ContinuousEngine:
                     params, tokens_in, cfg, positions=positions,
                     cache={**cache_const, "tk": tk, "tv": tv},
                     paged=paged_meta, mesh=self.mesh, rules=self.rules,
+                    adapter_ids=adapters if self.multi_lora else None,
                 )
                 tk, tv = tails["tk"], tails["tv"]
                 n_acc, nxt_tok = self._spec_accept(
@@ -1090,6 +1113,12 @@ class ContinuousEngine:
         device memory until ``clear_prefixes``."""
         if not prefix_tokens:
             raise ValueError("prefix must be non-empty")
+        if self.multi_lora:
+            raise ValueError(
+                "register_prefix with a multi-adapter stack is unsupported "
+                "(the prefix KV is adapter-specific); paged-mode automatic "
+                "prefix reuse is adapter-isolated instead"
+            )
         if len(prefix_tokens) + 1 > self.smax:
             raise ValueError(
                 f"prefix {len(prefix_tokens)} leaves no room in cache {self.smax}"
@@ -1195,17 +1224,33 @@ class ContinuousEngine:
         seed: int | None = None,
         stream: Any = None,
         logprobs: int | None = None,
+        adapter_id: int | None = None,
     ) -> int:
         """Queue a request; returns its id (see ``results``/``run``).
         ``stream``: optional ``queue.Queue`` receiving per-chunk token lists
         and a final ``None``. ``logprobs``: top-N alternatives per generated
         token (None = off; 0 = chosen-token logprob only); requires the
-        engine constructed with ``logprobs_k >= N``."""
+        engine constructed with ``logprobs_k >= N``. ``adapter_id`` selects
+        the request's LoRA adapter when params are a multi-adapter stack
+        (0 = base)."""
         gen = self.gen
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             raise QueueFullError(
                 f"admission queue full ({self.max_queue} waiting requests)"
             )
+        if adapter_id:
+            if not self.multi_lora:
+                raise ValueError(
+                    "adapter_id given but params are not a multi-adapter "
+                    "stack (models/lora.stack_adapters)"
+                )
+            if not 0 <= adapter_id < self.n_adapters:
+                # JAX gathers clamp out-of-range indices under jit, which
+                # would silently serve the wrong adapter.
+                raise ValueError(
+                    f"adapter_id {adapter_id} out of range "
+                    f"[0, {self.n_adapters})"
+                )
         if logprobs is not None:
             if self.logprobs_k == 0:
                 raise ValueError(
@@ -1228,6 +1273,7 @@ class ContinuousEngine:
             seed=(self._base_seed + self._next_id) if seed is None else seed,
             stream=stream,
             logprobs=logprobs,
+            adapter_id=adapter_id or 0,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -1261,7 +1307,9 @@ class ContinuousEngine:
         copy + suffix-only prefill), else the full prefill program. Returns
         ``None`` when chunked prefill takes over (the request finishes
         prefilling across subsequent ticks, see ``_advance_prefill``)."""
-        prefix = self._match_prefix(req.prompt)
+        prefix = (
+            self._match_prefix(req.prompt) if req.adapter_id == 0 else None
+        )
         d0 = 0 if prefix is None else prefix[2]
         if self.prefill_chunk and len(req.prompt) - d0 > self.prefill_chunk:
             if prefix is not None:
@@ -1286,6 +1334,7 @@ class ContinuousEngine:
                 self.params, self.cache, jnp.asarray(ids),
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
                 jnp.float32(req.temperature), jnp.float32(req.top_p), rng,
+                jnp.asarray([req.adapter_id], jnp.int32),
             ), slot)
         row, last_logits, d = prefix
         p_bucket = row["k"].shape[2]
@@ -1328,6 +1377,7 @@ class ContinuousEngine:
             self.params, self.cache, jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.int32(slot), jnp.float32(req.temperature),
             jnp.float32(req.top_p), rng,
+            jnp.asarray([req.adapter_id], jnp.int32),
         ), slot)
 
     def _advance_prefill(self, req: Request) -> None:
@@ -1372,6 +1422,7 @@ class ContinuousEngine:
             self.params, self.cache, jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.int32(req.slot), jnp.float32(req.temperature),
             jnp.float32(req.top_p), sub,
+            jnp.asarray([req.adapter_id], jnp.int32),
         ), req.slot)
         req.prefill_pos += s
         if req.prefill_pos >= len(req.prompt):
@@ -1425,14 +1476,16 @@ class ContinuousEngine:
         sharing the prefix reuse them without prefilling. Full prompt pages
         are immutable (decode writes only past the prompt), so sharing is
         read-only by construction."""
-        self._publish_tokens(req.prompt, slot)
+        self._publish_tokens(req.prompt, slot, req.adapter_id)
 
-    def _publish_tokens(self, tokens: list[int], slot: int) -> None:
+    def _publish_tokens(self, tokens: list[int], slot: int,
+                        adapter_id: int = 0) -> None:
         ps = self.page_size
         n_full = len(tokens) // ps
         self.allocator.publish_chain(
             tokens[: n_full * ps], ps,
             [int(p) for p in self._table[slot, :n_full]],
+            root=-adapter_id,
         )
 
     def _publish_generated_pages(self, req: Request, slot: int) -> None:
@@ -1442,7 +1495,7 @@ class ContinuousEngine:
         KV and prefills only the new user turn. Generated pages become
         immutable the moment the slot stops decoding, and their content key
         — (parent page, exact tokens) — verifies exactly like prompt pages."""
-        self._publish_tokens(req.prompt + req.tokens, slot)
+        self._publish_tokens(req.prompt + req.tokens, slot, req.adapter_id)
 
     def _ctx_pages_bucket(self, d: int) -> int:
         """Gather-bucket (in pages) covering a context of ``d`` tokens."""
@@ -1453,7 +1506,7 @@ class ContinuousEngine:
 
     def _run_paged_prefill(self, tokens, d: int, s: int, s_bucket: int,
                            ctx_row, write_pids, temp: float, top_p: float,
-                           rng, slot: int | None = None):
+                           rng, slot: int | None = None, adapter: int = 0):
         """Compile-on-miss + call of the (s_bucket, ctx_pages) prefill
         program — the one shared path for slot prefills and page warming."""
         ps, maxp = self.page_size, self.maxp
@@ -1482,7 +1535,7 @@ class ContinuousEngine:
             self.params, self.cache,
             jnp.asarray(row), jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.float32(temp), jnp.float32(top_p), rng,
-            jnp.asarray(pids),
+            jnp.asarray(pids), jnp.asarray([adapter], jnp.int32),
         ), slot)
 
     def _paged_prefill_chunk(self, req: Request, slot: int, d: int, s: int,
@@ -1494,6 +1547,7 @@ class ContinuousEngine:
             ctx_row=self._table[slot],
             write_pids=self._table[slot, d // ps:],
             temp=req.temperature, top_p=req.top_p, rng=rng, slot=slot,
+            adapter=req.adapter_id,
         )
 
     def _admit_paged_slot(self, slot: int) -> bool:
@@ -1503,7 +1557,9 @@ class ContinuousEngine:
         cover it, so decode never faults mid-flight."""
         req = self._queue[0]
         ps = self.page_size
-        matched = self.allocator.match_prefix(req.prompt, ps)  # retained
+        matched = self.allocator.match_prefix(
+            req.prompt, ps, root=-req.adapter_id
+        )  # retained
         n_total = -(-(len(req.prompt) + req.max_new_tokens) // ps)
         n_fresh = n_total - len(matched)
         try:
@@ -1537,6 +1593,7 @@ class ContinuousEngine:
         self.temps = self.temps.at[slot].set(req.temperature)
         self.top_ps = self.top_ps.at[slot].set(req.top_p)
         self.keys = self.keys.at[slot].set(slot_key)
+        self.adapters = self.adapters.at[slot].set(req.adapter_id)
         self.limits = self.limits.at[slot].set(
             len(req.prompt) + req.max_new_tokens
         )
@@ -1571,6 +1628,7 @@ class ContinuousEngine:
             self.temps = self.temps.at[slot].set(req.temperature)
             self.top_ps = self.top_ps.at[slot].set(req.top_p)
             self.keys = self.keys.at[slot].set(slot_key)
+            self.adapters = self.adapters.at[slot].set(req.adapter_id)
 
     def _harvest(self, emitted: np.ndarray, counts: np.ndarray | None = None,
                  lp=None) -> None:
@@ -1720,13 +1778,13 @@ class ContinuousEngine:
              counts, rr) = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self._table_device(), self.limits, self.hist,
-                self.temps, self.top_ps, self.keys,
+                self.temps, self.top_ps, self.keys, self.adapters,
             )
         else:
             (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
              counts, rr) = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
-                self.hist, self.temps, self.top_ps, self.keys,
+                self.hist, self.temps, self.top_ps, self.keys, self.adapters,
             )
         # ONE device_get for all three outputs: each separate fetch is a
         # full round trip on remote-device transports (~100 ms here) — three
@@ -1786,14 +1844,16 @@ class ContinuousEngine:
             res = self._paged_decode[key](
                 self.params, self.cache, self.cur,
                 self.pos, alive, self.temps, self.top_ps, self.keys,
-                self._table_device(), self.limits, self.hist, *lp_args,
+                self._table_device(), self.limits, self.hist, self.adapters,
+                *lp_args,
             )
         else:
             if key not in self._decode_cache:
                 self._decode_cache[key] = self._build_decode(*key)
             res = self._decode_cache[key](
                 self.params, self.cache, self.cur, self.pos, alive,
-                self.temps, self.top_ps, self.keys, self.hist, *lp_args,
+                self.temps, self.top_ps, self.keys, self.hist, self.adapters,
+                *lp_args,
             )
         if self.logprobs_k:
             (self.cache, self.cur, self.pos, self.keys, self.hist,
@@ -2007,6 +2067,11 @@ class ThreadedEngine:
         """Max top-N logprob alternatives the engine can serve (0 = off)."""
         return self._engine.logprobs_k
 
+    @property
+    def multi_lora(self) -> bool:
+        """True when the engine serves a multi-adapter LoRA stack."""
+        return self._engine.multi_lora
+
     def _wait_one(self, rid: int) -> Request:
         while rid not in self._results:
             if self._stop:
@@ -2024,6 +2089,7 @@ class ThreadedEngine:
         temperature: float | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        adapter_id: int | None = None,
     ) -> list[int]:
         """Submit one request and block until it completes. Raises if the
         driver has stopped (shutdown or device error) — callers turn that
@@ -2037,6 +2103,7 @@ class ThreadedEngine:
                 temperature=temperature,
                 top_p=top_p,
                 seed=seed,
+                adapter_id=adapter_id,
             )
             self._cond.notify_all()
             return self._wait_one(rid).tokens
@@ -2083,6 +2150,7 @@ class ThreadedEngine:
         temperature: float | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        adapter_id: int | None = None,
     ):
         """Submit one request and return an iterator of per-chunk token-id
         lists as they are decoded (SSE streaming). The submit happens
@@ -2102,6 +2170,7 @@ class ThreadedEngine:
                 top_p=top_p,
                 seed=seed,
                 stream=stream,
+                adapter_id=adapter_id,
             )
             self._cond.notify_all()
 

@@ -179,14 +179,18 @@ class PageAllocator:
         self._ref[pid] += 1
 
     def publish_chain(
-        self, tokens: list[int], page_size: int, own_pages: list[int]
+        self, tokens: list[int], page_size: int, own_pages: list[int],
+        root: int = 0,
     ) -> None:
         """Publish the full pages of ``tokens`` backed by ``own_pages``
         (the owner's physical page per block, shared or private). Walks the
         CANONICAL chain: when a key is already published, the cached page —
         not the owner's private duplicate — becomes the parent for the next
-        key, so all equal prefixes share one chain."""
-        parent = 0
+        key, so all equal prefixes share one chain. ``root`` namespaces the
+        chain's first parent (multi-LoRA: identical tokens under different
+        adapters produce different KV, so each adapter id gets its own
+        non-positive root, disjoint from physical page ids)."""
+        parent = root
         for i, pid in enumerate(own_pages):
             block = tuple(tokens[i * page_size:(i + 1) * page_size])
             key = (parent, block)
@@ -198,16 +202,17 @@ class PageAllocator:
                 self._lru.move_to_end(key)
                 parent = existing
 
-    def match_prefix(self, tokens: list[int], page_size: int) -> list[int]:
+    def match_prefix(self, tokens: list[int], page_size: int,
+                     root: int = 0) -> list[int]:
         """Longest run of published pages covering ``tokens``' leading FULL
         pages — each returned page is retained for the caller. At least one
         token is always left unmatched so the caller's prefill produces the
-        next-token logits."""
+        next-token logits. ``root``: see ``publish_chain``."""
         usable = len(tokens) - 1
         if usable < page_size:
             return []
         pages: list[int] = []
-        parent = 0
+        parent = root
         for i in range(usable // page_size):
             block = tuple(tokens[i * page_size:(i + 1) * page_size])
             pid = self.lookup((parent, block))

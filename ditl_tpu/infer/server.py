@@ -253,7 +253,10 @@ class _Handler(BaseHTTPRequestHandler):
         # the queue slot here, so QueueFullError still becomes an HTTP 429
         # instead of a silently truncated stream (ADVICE r2).
         stream_iter = None
-        if self.threaded_engine is not None and adapter_ids is None:
+        if self.threaded_engine is not None and (
+            adapter_ids is None
+            or getattr(self.threaded_engine, "multi_lora", False)
+        ):
             etok = self.threaded_engine.tokenizer
             stream_iter = self.threaded_engine.stream_one(
                 [etok.bos_id] + etok.encode(prompt),
@@ -261,6 +264,7 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=gen.temperature,
                 top_p=gen.top_p,
                 seed=gen.seed,
+                adapter_id=adapter_ids[0] if adapter_ids else None,
             )
 
         def events():
@@ -489,7 +493,10 @@ class _Handler(BaseHTTPRequestHandler):
                     }
                 n_prompt = len(prompt_ids)
                 n_gen = n_gen_full
-            elif self.threaded_engine is not None and adapter_ids is None:
+            elif self.threaded_engine is not None and (
+                adapter_ids is None
+                or getattr(self.threaded_engine, "multi_lora", False)
+            ):
                 tok = self.threaded_engine.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
                 out = self.threaded_engine.generate_one(
@@ -498,6 +505,7 @@ class _Handler(BaseHTTPRequestHandler):
                     temperature=gen.temperature,
                     top_p=gen.top_p,
                     seed=gen.seed,
+                    adapter_id=adapter_ids[0] if adapter_ids else None,
                 )
                 n_gen = len(out)
                 text, hit_stop = _apply_stop(tok.decode(out), stops)
@@ -691,9 +699,8 @@ def serve(argv: list[str] | None = None) -> int:
         parser.error("--mesh on a multi-host pod requires --pod: the mesh "
                      "spans all hosts' devices, so every process must join "
                      "the collective decode loop")
-    if args.adapter and args.engine == "continuous":
-        parser.error("--adapter composes with --engine lockstep only (the "
-                     "continuous engine has no per-slot adapter selection)")
+    # --adapter composes with BOTH engines: the continuous engine carries
+    # a per-slot adapter id (requests with different adapters share ticks).
     if args.adapter and args.pod:
         parser.error("--adapter does not compose with --pod (the broadcast "
                      "protocol does not carry adapter ids)")

@@ -157,3 +157,107 @@ def test_server_routes_model_field_to_adapter(lora_setup):
         assert via_base == ref_base
     finally:
         server.shutdown()
+
+
+# -- multi-LoRA on the continuous engine (r3) --------------------------------
+
+
+@pytest.mark.slow
+def test_continuous_engine_mixed_adapters_match_single(lora_setup):
+    """Slots with different adapters share decode ticks; each request's
+    output equals the single-adapter lock-step reference (f32), both cache
+    modes."""
+    from ditl_tpu.infer.continuous import ContinuousEngine
+
+    cfg, params, adapters, stacked = lora_setup
+    tok = ByteTokenizer()
+    gen = GenerateConfig(max_new_tokens=8)
+    prompts = [
+        [tok.bos_id] + tok.encode("hello there"),
+        [tok.bos_id] + tok.encode("quick brown"),
+        [tok.bos_id] + tok.encode("hello there"),
+    ]
+    refs = [
+        Generator(_single(params, cfg, adapters[0]), cfg, tok).generate_tokens(
+            [prompts[0]], gen)[0],
+        Generator(_single(params, cfg, adapters[1]), cfg, tok).generate_tokens(
+            [prompts[1]], gen)[0],
+        Generator(params, cfg, tok).generate_tokens([prompts[2]], gen)[0],
+    ]
+    for kw in ({}, dict(cache_mode="paged", page_size=16)):
+        eng = ContinuousEngine(stacked, cfg, tok, n_slots=4, decode_chunk=4, **kw)
+        assert eng.multi_lora and eng.n_adapters == 3
+        rids = [
+            eng.submit(p, max_new_tokens=8, temperature=0.0, adapter_id=aid)
+            for p, aid in zip(prompts, [1, 2, 0])
+        ]
+        out = eng.run()
+        assert [out[r] for r in rids] == refs, kw
+
+
+@pytest.mark.slow
+def test_continuous_paged_prefix_reuse_is_adapter_isolated(lora_setup):
+    """Identical prompts under different adapters must NOT share KV pages
+    (each adapter id namespaces its own content-chain root): the
+    second-adapter request's output still matches its single-adapter
+    reference even after the first adapter's pages were published."""
+    from ditl_tpu.infer.continuous import ContinuousEngine
+
+    cfg, params, adapters, stacked = lora_setup
+    tok = ByteTokenizer()
+    gen = GenerateConfig(max_new_tokens=6)
+    # Prompt long enough to cover full pages (page_size 16).
+    prompt = [tok.bos_id] + tok.encode("abcdefghijklmnopqrstuvwxyz0123456789")
+    refs = [
+        Generator(_single(params, cfg, adapters[0]), cfg, tok).generate_tokens(
+            [prompt], gen)[0],
+        Generator(_single(params, cfg, adapters[1]), cfg, tok).generate_tokens(
+            [prompt], gen)[0],
+    ]
+    eng = ContinuousEngine(stacked, cfg, tok, n_slots=2, decode_chunk=4,
+                           cache_mode="paged", page_size=16)
+    r1 = eng.submit(list(prompt), max_new_tokens=6, temperature=0.0, adapter_id=1)
+    out1 = eng.run()[r1]
+    assert out1 == refs[0]
+    # Adapter 2 afterwards: pages from adapter 1's run are published but
+    # must not match (different chain root).
+    r2 = eng.submit(list(prompt), max_new_tokens=6, temperature=0.0, adapter_id=2)
+    out2 = eng.run()[r2]
+    assert out2 == refs[1]
+
+
+def test_continuous_adapter_validation(lora_setup):
+    from ditl_tpu.infer.continuous import ContinuousEngine
+
+    cfg, params, adapters, stacked = lora_setup
+    tok = ByteTokenizer()
+    eng = ContinuousEngine(stacked, cfg, tok, n_slots=2)
+    with pytest.raises(ValueError, match="out of range"):
+        eng.submit([1, 2, 3], adapter_id=7)
+    base = ContinuousEngine(params, cfg, tok, n_slots=2)
+    with pytest.raises(ValueError, match="not a multi-adapter"):
+        base.submit([1, 2, 3], adapter_id=1)
+    with pytest.raises(ValueError, match="multi-adapter"):
+        ContinuousEngine(stacked, cfg, tok, n_slots=2).register_prefix([1, 2, 3])
+
+
+@pytest.mark.slow
+def test_spec_ticks_with_adapters_match_plain(lora_setup):
+    """Speculative ticks route the verify through per-slot adapters too."""
+    from ditl_tpu.infer.continuous import ContinuousEngine
+
+    cfg, params, adapters, stacked = lora_setup
+    tok = ByteTokenizer()
+    prompts = [[tok.bos_id] + tok.encode("abcabcabcabc"),
+               [tok.bos_id] + tok.encode("hello hello")]
+    plain = ContinuousEngine(stacked, cfg, tok, n_slots=2, decode_chunk=4)
+    rids = [plain.submit(p, max_new_tokens=14, temperature=0.0, adapter_id=a)
+            for p, a in zip(prompts, [1, 2])]
+    ref = plain.run()
+    spec = ContinuousEngine(stacked, cfg, tok, n_slots=2, decode_chunk=4,
+                            speculative=True, spec_threshold=0.0, spec_rounds=2)
+    rids2 = [spec.submit(p, max_new_tokens=14, temperature=0.0, adapter_id=a)
+             for p, a in zip(prompts, [1, 2])]
+    out = spec.run()
+    assert spec.stats()["speculative"]["spec_ticks"] > 0
+    assert [out[r] for r in rids2] == [ref[r] for r in rids]
