@@ -261,3 +261,54 @@ def test_spec_ticks_with_adapters_match_plain(lora_setup):
     out = spec.run()
     assert spec.stats()["speculative"]["spec_ticks"] > 0
     assert [out[r] for r in rids2] == [ref[r] for r in rids]
+
+
+@pytest.mark.slow
+def test_server_routes_adapter_through_continuous_engine(lora_setup):
+    """The OpenAI model field reaches the continuous engine's per-slot
+    adapter id (no lock-step fallback): responses match the
+    single-adapter references."""
+    from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params, adapters, stacked = lora_setup
+    tok = ByteTokenizer()
+
+    class _NoLockstep(Generator):
+        def generate_tokens(self, *a, **k):  # pragma: no cover
+            raise AssertionError("adapter request took the lock-step path")
+
+    te = ThreadedEngine(ContinuousEngine(stacked, cfg, tok, n_slots=2,
+                                         decode_chunk=4))
+    server = make_server(
+        _NoLockstep(stacked, cfg, tok), port=0, default_max_tokens=6,
+        model_name="base", adapter_names={"ad1": 1, "ad2": 2},
+        threaded_engine=te,
+    )
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+
+        def ask(model):
+            req = urllib.request.Request(
+                f"{base}/v1/completions",
+                data=json.dumps(
+                    {"prompt": "route me", "max_tokens": 6, "model": model}
+                ).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return json.loads(r.read())["choices"][0]["text"]
+
+        ref_ad2 = Generator(
+            _single(params, cfg, adapters[1]), cfg, tok
+        ).generate(["route me"], GenerateConfig(max_new_tokens=6))[0]
+        ref_base = Generator(params, cfg, tok).generate(
+            ["route me"], GenerateConfig(max_new_tokens=6)
+        )[0]
+        assert ask("ad2") == ref_ad2
+        assert ask("unknown-model") == ref_base  # base weights via slot 0
+    finally:
+        server.shutdown()
+        te.close()
