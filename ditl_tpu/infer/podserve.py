@@ -379,7 +379,9 @@ def _apply_ctick(engine, meta: np.ndarray, ids: np.ndarray, cancels: np.ndarray,
     rids = []
     off = 0
     for i, row in enumerate(meta):
-        plen, max_new, temp_bits, top_p_bits, seed = (int(v) for v in row)
+        plen, max_new, temp_bits, top_p_bits, seed, adapter = (
+            int(v) for v in row
+        )
         prompt = ids[off: off + plen].tolist()
         off += plen
         try:
@@ -387,6 +389,7 @@ def _apply_ctick(engine, meta: np.ndarray, ids: np.ndarray, cancels: np.ndarray,
                 prompt, max_new_tokens=max_new, temperature=_i2f(temp_bits),
                 top_p=_i2f(top_p_bits), seed=seed,
                 stream=streams[i] if streams is not None else None,
+                adapter_id=adapter or None,
             ))
         except (ValueError, QueueFullError) as e:
             # Deterministic per-request rejection: the same submit fails
@@ -415,7 +418,7 @@ class PodContinuousDriver:
         self.tokenizer = engine.tokenizer
         self.poll_s = poll_s
         self._lock = threading.Lock()
-        self._staged: list[tuple] = []  # (prompt, max_new, temp, top_p, seed, ticket)
+        self._staged: list[tuple] = []  # (prompt, max_new, temp, top_p, seed, adapter, ticket)
         self._cancels: set[int] = set()
         self._tickets: dict[int, "_Ticket"] = {}
         self._inflight = 0  # batch swapped out of _staged, not yet submitted
@@ -497,7 +500,7 @@ class PodContinuousDriver:
             err = RuntimeError("pod serving stopped")
             for t in self._tickets.values():
                 t.fail(err)
-            for (_, _, _, _, _, ticket) in staged:
+            for (*_, ticket) in staged:
                 ticket.fail(err)
             self._tickets.clear()
             self._cond.notify_all()
@@ -505,10 +508,13 @@ class PodContinuousDriver:
     def _tick(self, staged, cancels) -> None:
         try:
             metas, all_ids = [], []
-            for (prompt, max_new, temp, top_p, seed, _t) in staged:
-                metas.append([len(prompt), max_new, _f2i(temp), _f2i(top_p), seed])
+            for (prompt, max_new, temp, top_p, seed, adapter, _t) in staged:
+                metas.append([
+                    len(prompt), max_new, _f2i(temp), _f2i(top_p), seed,
+                    adapter,
+                ])
                 all_ids.extend(prompt)
-            meta = np.asarray(metas, np.int32).reshape(len(staged), 5)
+            meta = np.asarray(metas, np.int32).reshape(len(staged), 6)
             ids = np.asarray(all_ids, np.int32)
             cc = np.asarray(cancels, np.int32)
         except Exception as e:
@@ -533,7 +539,7 @@ class PodContinuousDriver:
         try:
             rids = _apply_ctick(
                 self._engine, meta, ids, cc,
-                streams=[t.stream for (_, _, _, _, _, t) in staged],
+                streams=[t.stream for (*_, t) in staged],
             )
         except Exception as e:  # noqa: BLE001 — surfaced via tickets
             ok = False
@@ -552,7 +558,7 @@ class PodContinuousDriver:
                 for (*_, ticket) in staged:
                     ticket.fail(err)
                 return
-            for (_, _, _, _, _, ticket), rid in zip(staged, rids):
+            for (*_, ticket), rid in zip(staged, rids):
                 if isinstance(rid, BaseException):
                     ticket.fail(rid)  # deterministic per-request rejection
                     continue
@@ -567,7 +573,7 @@ class PodContinuousDriver:
     # -- ThreadedEngine surface ----------------------------------------------
 
     def _stage(self, prompt_tokens, max_new_tokens, temperature, top_p, seed,
-               stream=None) -> "_Ticket":
+               stream=None, adapter_id=None) -> "_Ticket":
         from ditl_tpu.infer.continuous import QueueFullError
 
         gen = self._engine.gen
@@ -582,6 +588,14 @@ class PodContinuousDriver:
             raise ValueError("seed must fit in int32")
         if not (0 < max_new < 2**31):
             raise ValueError("max_tokens out of range")
+        adapter = int(adapter_id or 0)
+        if adapter and not (
+            self._engine.multi_lora
+            and 0 <= adapter < self._engine.n_adapters
+        ):
+            raise ValueError(
+                f"adapter_id {adapter} invalid for this engine"
+            )
         with self._cond:
             if self._stop:
                 raise RuntimeError("pod serving stopped") from self._error
@@ -598,20 +612,26 @@ class PodContinuousDriver:
                 # the staged list), _seq only moves forward, so concurrent
                 # default-seeded requests never collide.
                 self._engine._base_seed + self._seq,
+                adapter,
                 ticket,
             ))
             self._seq += 1
             self._cond.notify_all()
         return ticket
 
+    @property
+    def multi_lora(self) -> bool:
+        return self._engine.multi_lora
+
     def generate_one(self, prompt_tokens, *, max_new_tokens=None,
-                     temperature=None, top_p=None, seed=None) -> list[int]:
+                     temperature=None, top_p=None, seed=None,
+                     adapter_id=None) -> list[int]:
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
-                             top_p, seed)
+                             top_p, seed, adapter_id=adapter_id)
         return ticket.wait()
 
     def stream_one(self, prompt_tokens, *, max_new_tokens=None,
-                   temperature=None, top_p=None, seed=None):
+                   temperature=None, top_p=None, seed=None, adapter_id=None):
         import queue as _queue
 
         stream: _queue.Queue = _queue.Queue()
@@ -619,7 +639,8 @@ class PodContinuousDriver:
         # while the HTTP layer can still answer 429 — after the SSE headers
         # there is no status left to send (ADVICE r2).
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
-                             top_p, seed, stream=stream)
+                             top_p, seed, stream=stream,
+                             adapter_id=adapter_id)
 
         def chunks():
             try:
@@ -715,8 +736,8 @@ def continuous_worker_loop(engine) -> None:
             logger.error("pod continuous worker: unexpected opcode %d", op)
             return
         n_sub, ids_total, n_cancel = int(header[1]), int(header[2]), int(header[3])
-        meta = (_broadcast(np.zeros((n_sub, 5), np.int32))
-                if n_sub else np.zeros((0, 5), np.int32))
+        meta = (_broadcast(np.zeros((n_sub, 6), np.int32))
+                if n_sub else np.zeros((0, 6), np.int32))
         ids = (_broadcast(np.zeros((ids_total,), np.int32))
                if n_sub else np.zeros((0,), np.int32))
         cc = (_broadcast(np.zeros((n_cancel,), np.int32))

@@ -701,9 +701,10 @@ def serve(argv: list[str] | None = None) -> int:
                      "the collective decode loop")
     # --adapter composes with BOTH engines: the continuous engine carries
     # a per-slot adapter id (requests with different adapters share ticks).
-    if args.adapter and args.pod:
-        parser.error("--adapter does not compose with --pod (the broadcast "
-                     "protocol does not carry adapter ids)")
+    if args.adapter and args.pod and args.engine != "continuous":
+        parser.error("--adapter with --pod requires --engine continuous "
+                     "(only the continuous tick broadcast carries adapter "
+                     "ids)")
     if args.speculative != "off" and args.engine == "continuous":
         parser.error("--speculative composes with --engine lockstep only "
                      "(the continuous engine's slot scheduler has no "

@@ -312,3 +312,31 @@ def test_server_routes_adapter_through_continuous_engine(lora_setup):
     finally:
         server.shutdown()
         te.close()
+
+
+@pytest.mark.slow
+def test_pod_continuous_carries_adapter_ids(lora_setup):
+    """The pod tick broadcast carries per-request adapter ids: outputs
+    through PodContinuousDriver match the single-adapter references."""
+    from ditl_tpu.infer.continuous import ContinuousEngine
+    from ditl_tpu.infer.podserve import PodContinuousDriver
+
+    cfg, params, adapters, stacked = lora_setup
+    tok = ByteTokenizer()
+    gen = GenerateConfig(max_new_tokens=6)
+    prompt = [tok.bos_id] + tok.encode("pod route")
+    ref = Generator(_single(params, cfg, adapters[1]), cfg, tok).generate_tokens(
+        [prompt], gen)[0]
+    driver = PodContinuousDriver(
+        ContinuousEngine(stacked, cfg, tok, n_slots=2, decode_chunk=4,
+                         gen=GenerateConfig(max_new_tokens=6)),
+        poll_s=0.01,
+    )
+    try:
+        assert driver.multi_lora
+        out = driver.generate_one(prompt, max_new_tokens=6, adapter_id=2)
+        assert out == ref
+        with pytest.raises(ValueError, match="adapter_id"):
+            driver.generate_one(prompt, adapter_id=9)
+    finally:
+        driver.close()
