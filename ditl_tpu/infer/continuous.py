@@ -1923,6 +1923,8 @@ class ContinuousEngine:
                 "pages_free": self.allocator.n_free,
                 "pages_cached_evictable": self.allocator.n_evictable,
             })
+        if self.multi_lora:
+            out["adapters"] = self.n_adapters
         if self.speculative:
             out["speculative"] = {
                 "k": self.spec_k,
