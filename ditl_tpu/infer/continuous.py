@@ -495,8 +495,9 @@ class ContinuousEngine:
         # ``cur`` ride engine state between ticks, exactly like ``cur``
         # itself. Costs one (B, V) log-softmax + top-k per decode step when
         # armed; requests that don't ask for logprobs simply don't consume
-        # the outputs. Speculative ticks don't carry logprob state, so a
-        # request with ``logprobs`` set forces plain ticks while active.
+        # the outputs. Speculative ticks carry the stats too (the verify
+        # logits already score every emitted token — _spec_lp_round), so
+        # logprobs and speculation compose.
         if logprobs_k < 0:
             raise ValueError(f"logprobs_k must be >= 0, got {logprobs_k}")
         self.logprobs_k = logprobs_k
@@ -637,6 +638,34 @@ class ContinuousEngine:
         nxt = jnp.take_along_axis(cand, n_acc[:, None], axis=1)[:, 0]
         return n_acc, nxt
 
+    def _spec_lp_round(self, logits, draft, n_acc, nxt_tok, lp, bufs, n_out,
+                       e):
+        """Per-round logprob bookkeeping for spec ticks (``logprobs_k > 0``):
+        emit-index j's stats are the PENDING ones for j=0 (``cur``, scored
+        when it was chosen) and, for j >= 1, ``draft[j-1]`` scored by the
+        verify logits at position j-1 — the raw distribution, identical
+        semantics to the plain tick. The new pending stats score
+        ``nxt_tok`` under the distribution that chose it
+        (``logits[:, n_acc]``)."""
+        from ditl_tpu.infer.speculative import _emit_rows
+
+        n_lp = self.logprobs_k
+        pc, pi, pt = lp
+        bc, bi, bt = bufs
+        k = logits.shape[1] - 1
+        lp_all = jax.nn.log_softmax(logits[:, :k].astype(jnp.float32), -1)
+        chosen_d = jnp.take_along_axis(lp_all, draft[..., None], 2)[..., 0]
+        top_t, top_i = jax.lax.top_k(lp_all, n_lp)  # (B, k, N)
+        seq_c = jnp.concatenate([pc[:, None], chosen_d], axis=1)
+        seq_i = jnp.concatenate([pi[:, None, :], top_i.astype(jnp.int32)],
+                                axis=1)
+        seq_t = jnp.concatenate([pt[:, None, :], top_t], axis=1)
+        bc = _emit_rows(bc, seq_c, n_out, e)
+        bi = _emit_rows(bi, seq_i, n_out, e)
+        bt = _emit_rows(bt, seq_t, n_out, e)
+        sel = jnp.take_along_axis(logits, n_acc[:, None, None], axis=1)[:, 0]
+        return _lp_stats(sel, nxt_tok, n_lp), (bc, bi, bt)
+
     def _build_spec_decode(self, sampled: bool = False):
         """Speculative decode tick, contiguous cache (module docstring):
         ``spec_rounds`` rounds of draft → (B, K+1) verify forward → accept.
@@ -657,14 +686,23 @@ class ContinuousEngine:
 
         from ditl_tpu.infer.speculative import _emit_rows, device_lookup_draft
 
+        n_lp = self.logprobs_k
+
         def run(params, cache, cur, pos, alive, hist, temps, top_ps, keys,
-                adapters):
+                adapters, *lp0):
             n_b = pos.shape[0]
             out0 = jnp.full((n_b, out_len), pad, jnp.int32)
             zeros = jnp.zeros((n_b,), jnp.int32)
+            bufs0 = (
+                (jnp.zeros((n_b, out_len), jnp.float32),
+                 jnp.zeros((n_b, out_len, n_lp), jnp.int32),
+                 jnp.zeros((n_b, out_len, n_lp), jnp.float32))
+                if n_lp else ()
+            )
 
             def body(carry, _):
-                cache, cur, pos, done, hist, out, n_out, rr, keys = carry
+                (cache, cur, pos, done, hist, out, n_out, rr, keys, lp,
+                 bufs) = carry
                 live = ~done
                 split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
@@ -699,6 +737,12 @@ class ContinuousEngine:
                 e = jnp.sum(emit.astype(jnp.int32), axis=1)  # (B,)
                 hit_term = jnp.any(emit & is_term, axis=1)
                 out = _emit_rows(out, tokens_in, n_out, e)
+                if n_lp:
+                    # Buffers share ``out``'s PRE-advance offsets (column-
+                    # aligned with the emitted tokens).
+                    lp, bufs = self._spec_lp_round(
+                        logits, draft, n_acc, nxt_tok, lp, bufs, n_out, e
+                    )
                 n_out = n_out + e
                 # History gains positions pos+1 .. pos+e: the accepted
                 # drafts, with the pending token at index n_acc.
@@ -717,13 +761,17 @@ class ContinuousEngine:
                 done = done | hit_term
                 cur = jnp.where(done, pad, nxt_tok)
                 rr = rr + live.astype(jnp.int32)
-                return (cache, cur, pos, done, hist, out, n_out, rr, keys), None
+                return (cache, cur, pos, done, hist, out, n_out, rr, keys,
+                        lp, bufs), None
 
-            (cache, cur, pos, done, hist, out, n_out, rr, keys), _ = jax.lax.scan(
-                body, (cache, cur, pos, ~alive, hist, out0, zeros, zeros, keys),
+            (cache, cur, pos, done, hist, out, n_out, rr, keys, lp,
+             bufs), _ = jax.lax.scan(
+                body,
+                (cache, cur, pos, ~alive, hist, out0, zeros, zeros, keys,
+                 tuple(lp0), bufs0),
                 None, length=rounds,
             )
-            return cache, cur, pos, hist, keys, out, n_out, rr
+            return cache, cur, pos, hist, keys, out, n_out, rr, lp, bufs
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -1026,8 +1074,10 @@ class ContinuousEngine:
 
         from ditl_tpu.infer.speculative import _emit_rows, device_lookup_draft
 
+        n_lp = self.logprobs_k
+
         def run(params, pools, cur, pos, alive, table, limits, hist, temps,
-                top_ps, keys, adapters):
+                top_ps, keys, adapters, *lp0):
             n_b = pos.shape[0]
             starts = pos
             tk0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
@@ -1035,9 +1085,16 @@ class ContinuousEngine:
             cache_const = dict(pools)  # pools are read-only during the scan
             out0 = jnp.full((n_b, out_len), pad, jnp.int32)
             zeros = jnp.zeros((n_b,), jnp.int32)
+            bufs0 = (
+                (jnp.zeros((n_b, out_len), jnp.float32),
+                 jnp.zeros((n_b, out_len, n_lp), jnp.int32),
+                 jnp.zeros((n_b, out_len, n_lp), jnp.float32))
+                if n_lp else ()
+            )
 
             def body(carry, _):
-                tk, tv, cur, pos, done, hist, out, n_out, rr, keys = carry
+                (tk, tv, cur, pos, done, hist, out, n_out, rr, keys, lp,
+                 bufs) = carry
                 done = done | (pos >= limits)
                 live = ~done
                 split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
@@ -1074,6 +1131,10 @@ class ContinuousEngine:
                 e = jnp.sum(emit.astype(jnp.int32), axis=1)
                 hit_term = jnp.any(emit & is_term, axis=1)
                 out = _emit_rows(out, tokens_in, n_out, e)
+                if n_lp:
+                    lp, bufs = self._spec_lp_round(
+                        logits, draft, n_acc, nxt_tok, lp, bufs, n_out, e
+                    )
                 n_out = n_out + e
                 append_seq = jnp.where(
                     q_idx[None, :] == n_acc[:, None],
@@ -1089,19 +1150,19 @@ class ContinuousEngine:
                 cur = jnp.where(done, pad, nxt_tok)
                 rr = rr + live.astype(jnp.int32)
                 return (tk, tv, cur, pos, done, hist, out, n_out, rr,
-                        keys), None
+                        keys, lp, bufs), None
 
-            (tk, tv, cur, pos, done, hist, out, n_out, rr, keys), _ = \
-                jax.lax.scan(
-                    body,
-                    (tk0, tv0, cur, pos, ~alive, hist, out0, zeros, zeros,
-                     keys),
-                    None, length=rounds,
-                )
+            (tk, tv, cur, pos, done, hist, out, n_out, rr, keys, lp,
+             bufs), _ = jax.lax.scan(
+                body,
+                (tk0, tv0, cur, pos, ~alive, hist, out0, zeros, zeros,
+                 keys, tuple(lp0), bufs0),
+                None, length=rounds,
+            )
             pools_out = _flush_tail_into_pools(
                 pools, tk, tv, starts, pos, table, ps, tail_len
             )
-            return pools_out, cur, pos, hist, keys, out, n_out, rr
+            return pools_out, cur, pos, hist, keys, out, n_out, rr, lp, bufs
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -1744,10 +1805,6 @@ class ContinuousEngine:
         the mix still accept by argmax, bit-exactly)."""
         if not self.speculative:
             return False
-        # Spec ticks don't carry logprob state — a logprobs request (even
-        # logprobs=0: chosen-token-only) forces plain ticks while active.
-        if any(r.logprobs is not None for r in active):
-            return False
         self._tick_no += 1
         preds = []
         for r in active:
@@ -1772,26 +1829,40 @@ class ContinuousEngine:
                 self._build_spec_paged_decode(sampled) if paged
                 else self._build_spec_decode(sampled)
             )
+        lp_args = (
+            (self.lp_chosen, self.lp_ids, self.lp_top)
+            if self.logprobs_k else ()
+        )
         t0 = _time.perf_counter()
         if paged:
             (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
-             counts, rr) = self._spec_decode[key](
+             counts, rr, lp_state, lp_bufs) = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self._table_device(), self.limits, self.hist,
-                self.temps, self.top_ps, self.keys, self.adapters,
+                self.temps, self.top_ps, self.keys, self.adapters, *lp_args,
             )
         else:
             (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
-             counts, rr) = self._spec_decode[key](
+             counts, rr, lp_state, lp_bufs) = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self.hist, self.temps, self.top_ps, self.keys, self.adapters,
+                *lp_args,
             )
-        # ONE device_get for all three outputs: each separate fetch is a
-        # full round trip on remote-device transports (~100 ms here) — three
-        # sequential fetches per tick erased the speculative win entirely.
-        counts, rr, toks = (
-            np.asarray(x) for x in jax.device_get((counts, rr, toks))
-        )
+        # ONE device_get for every host-consumed output: each separate fetch
+        # is a full round trip on remote-device transports (~100 ms here) —
+        # three sequential fetches per tick erased the speculative win.
+        if self.logprobs_k:
+            (self.lp_chosen, self.lp_ids, self.lp_top) = lp_state
+            counts, rr, toks, lp = jax.device_get(
+                (counts, rr, toks, lp_bufs)
+            )
+            counts, rr, toks = (np.asarray(x) for x in (counts, rr, toks))
+            lp = tuple(np.asarray(x) for x in lp)
+        else:
+            counts, rr, toks = (
+                np.asarray(x) for x in jax.device_get((counts, rr, toks))
+            )
+            lp = None
         self._record_tick_time("spec", (_time.perf_counter() - t0) * 1e3)
         self.spec_ticks += 1
         accs = []
@@ -1809,7 +1880,7 @@ class ContinuousEngine:
                 else self._spec_ema_w * self.spec_acceptance_ema
                 + (1.0 - self._spec_ema_w) * mean
             )
-        self._harvest(toks, counts)
+        self._harvest(toks, counts, lp=lp)
 
     def step(self) -> None:
         """One scheduler tick: admit queued requests, advance one chunk of

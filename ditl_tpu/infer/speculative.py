@@ -132,17 +132,22 @@ def spec_sample_tokens(
 
 
 def _emit_rows(buf: jax.Array, chunk: jax.Array, idx: jax.Array, count: jax.Array):
-    """Write the first ``count[b]`` entries of ``chunk`` (B, S) into ``buf``
-    (B, T) at per-row offsets ``idx`` (B,). Same gather+select formulation as
-    infer/cache._scatter_rows (TPU scatters serialize; dense selects don't),
-    with the per-row prefix length bound."""
+    """Write the first ``count[b]`` entries of ``chunk`` (B, S, ...) into
+    ``buf`` (B, T, ...) at per-row offsets ``idx`` (B,) — trailing feature
+    dims broadcast (the speculative logprob buffers are (B, T, N)). Same
+    gather+select formulation as infer/cache._scatter_rows (TPU scatters
+    serialize; dense selects don't), with the per-row prefix length
+    bound."""
     s = chunk.shape[1]
+    tail = (1,) * (buf.ndim - 2)
     rel = jnp.arange(buf.shape[1], dtype=jnp.int32)[None, :] - idx[:, None]
     in_chunk = (rel >= 0) & (rel < jnp.minimum(count, s)[:, None])
     gathered = jnp.take_along_axis(
-        chunk.astype(buf.dtype), jnp.clip(rel, 0, s - 1), axis=1
+        chunk.astype(buf.dtype),
+        jnp.clip(rel, 0, s - 1).reshape(rel.shape + tail),
+        axis=1,
     )
-    return jnp.where(in_chunk, gathered, buf)
+    return jnp.where(in_chunk.reshape(in_chunk.shape + tail), gathered, buf)
 
 
 def lookup_draft(context: list[int], k: int, ngram: int,

@@ -130,22 +130,44 @@ def test_spec_with_chunked_prefill(setup):
 
 
 @pytest.mark.slow
-def test_spec_logprobs_slots_force_plain_ticks(setup):
-    """Spec ticks don't carry logprob state: a logprobs request forces
-    plain ticks (bit-for-bit the plain engine), while plain sampled
-    requests now ride speculative ticks (tested below)."""
+def test_spec_logprobs_match_plain_ticks(setup):
+    """Logprobs COMPOSE with speculative ticks: tokens, chosen logprobs,
+    and top-k alternatives through a speculative engine are identical to
+    the plain continuous engine's (the verify logits score every emitted
+    token from the same raw distributions, f32)."""
+    from ditl_tpu.infer.continuous import ThreadedEngine
+
     params, cfg, tok = setup
-    eng = _spec_engine(params, cfg, tok, logprobs_k=2)
-    rid = eng.submit([tok.bos_id] + tok.encode(PROMPTS[0]),
-                     max_new_tokens=12, temperature=0.0, logprobs=1)
-    out = eng.run()[rid]
-    assert eng.stats()["speculative"]["spec_ticks"] == 0
-    ref_eng = ContinuousEngine(
-        params, cfg, tok, n_slots=4, decode_chunk=4, logprobs_k=2
+    prompt = [tok.bos_id] + tok.encode(PROMPTS[0])
+    ref_te = ThreadedEngine(ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4, logprobs_k=3
+    ))
+    try:
+        ref_toks, ref_lp = ref_te.generate_one_with_logprobs(
+            prompt, 3, max_new_tokens=14, temperature=0.0
+        )
+    finally:
+        ref_te.close()
+    eng = _spec_engine(params, cfg, tok, n_slots=2, logprobs_k=3)
+    te = ThreadedEngine(eng)
+    try:
+        toks, lp = te.generate_one_with_logprobs(
+            prompt, 3, max_new_tokens=14, temperature=0.0
+        )
+    finally:
+        te.close()
+    assert eng.stats()["speculative"]["spec_ticks"] > 0
+    assert toks == ref_toks
+    import numpy as np
+
+    np.testing.assert_allclose(
+        lp["token_logprobs"], ref_lp["token_logprobs"], atol=1e-5
     )
-    ref_rid = ref_eng.submit([tok.bos_id] + tok.encode(PROMPTS[0]),
-                             max_new_tokens=12, temperature=0.0, logprobs=1)
-    assert ref_eng.run()[ref_rid] == out
+    assert lp["top_ids"] == ref_lp["top_ids"]
+    np.testing.assert_allclose(
+        np.array(lp["top_logprobs"]), np.array(ref_lp["top_logprobs"]),
+        atol=1e-5,
+    )
 
 
 @pytest.mark.slow
