@@ -1723,7 +1723,19 @@ class ContinuousEngine:
             if len(req.tokens) >= req.max_new_tokens:
                 req.finished = True
             if req.stream is not None and fresh:
-                req.stream.put(fresh)
+                if req.logprobs is not None and lp is not None:
+                    # Streamed logprobs ride the chunk: the entries for the
+                    # tokens just appended (same OpenAI dict layout as the
+                    # non-streaming path, sliced to the request's N).
+                    n = req.logprobs
+                    k = len(fresh)
+                    req.stream.put((fresh, {
+                        "token_logprobs": req.lp_token[-k:],
+                        "top_ids": [r[:n] for r in req.lp_top_ids[-k:]],
+                        "top_logprobs": [r[:n] for r in req.lp_top[-k:]],
+                    }))
+                else:
+                    req.stream.put(fresh)
             if req.finished:
                 if req.stream is not None:
                     req.stream.put(None)
@@ -2265,6 +2277,56 @@ class ThreadedEngine:
                 # Consumer stopped early (stop sequence hit, client
                 # disconnect): cancel so the engine doesn't decode the
                 # abandoned budget.
+                self.cancel(rid)
+
+        return chunks()
+
+    def stream_one_with_logprobs(
+        self,
+        prompt_tokens: list[int],
+        n_top: int,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+    ):
+        """``stream_one`` + per-chunk logprob stats: yields
+        ``(token_ids, lp_dict)`` pairs where ``lp_dict`` carries the chunk's
+        ``token_logprobs``/``top_ids``/``top_logprobs`` (OpenAI semantics,
+        sliced to ``n_top``)."""
+        import queue as _queue
+
+        stream: _queue.Queue = _queue.Queue()
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("continuous engine is stopped") from self._error
+            rid = self._engine.submit(
+                prompt_tokens,
+                max_new_tokens=max_new_tokens,
+                temperature=temperature,
+                top_p=top_p,
+                seed=seed,
+                stream=stream,
+                logprobs=n_top,
+            )
+            self._cond.notify_all()
+
+        def chunks():
+            try:
+                while True:
+                    try:
+                        item = stream.get(timeout=1.0)
+                    except _queue.Empty:
+                        if self._stop:
+                            raise RuntimeError(
+                                "continuous engine stopped mid-stream"
+                            ) from self._error
+                        continue
+                    if item is None:
+                        return
+                    yield item
+            finally:
                 self.cancel(rid)
 
         return chunks()

@@ -226,16 +226,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None,
-        stops=None,
+        stops=None, lp_n=None,
     ) -> None:
         """OpenAI streaming: real incremental chunks from the continuous
-        engine; the lockstep engine generates fully, then emits one chunk."""
+        engine; the lockstep engine generates fully, then emits one chunk.
+        ``lp_n`` (continuous engine only, validated by the caller): attach
+        per-chunk logprobs with ``lp_n`` alternatives."""
         cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
         model = payload.get("model") or self.model_name
         kind = "chat.completion.chunk" if chat else "text_completion"
 
-        def event(text, finish=None, role=None):
+        def event(text, finish=None, role=None, logprobs=None):
             if chat:
                 delta = {}
                 if role is not None:
@@ -246,6 +248,8 @@ class _Handler(BaseHTTPRequestHandler):
                 choice = {"index": 0, "delta": delta, "finish_reason": finish}
             else:
                 choice = {"index": 0, "text": text, "finish_reason": finish}
+            if logprobs is not None:
+                choice["logprobs"] = logprobs
             return {"id": cmpl_id, "object": kind, "created": created,
                     "model": model, "choices": [choice]}
 
@@ -258,21 +262,67 @@ class _Handler(BaseHTTPRequestHandler):
             or getattr(self.threaded_engine, "multi_lora", False)
         ):
             etok = self.threaded_engine.tokenizer
-            stream_iter = self.threaded_engine.stream_one(
-                [etok.bos_id] + etok.encode(prompt),
-                max_new_tokens=gen.max_new_tokens,
-                temperature=gen.temperature,
-                top_p=gen.top_p,
-                seed=gen.seed,
-                adapter_id=adapter_ids[0] if adapter_ids else None,
-            )
+            if lp_n is not None:
+                stream_iter = self.threaded_engine.stream_one_with_logprobs(
+                    [etok.bos_id] + etok.encode(prompt), lp_n,
+                    max_new_tokens=gen.max_new_tokens,
+                    temperature=gen.temperature,
+                    top_p=gen.top_p,
+                    seed=gen.seed,
+                )
+            else:
+                stream_iter = self.threaded_engine.stream_one(
+                    [etok.bos_id] + etok.encode(prompt),
+                    max_new_tokens=gen.max_new_tokens,
+                    temperature=gen.temperature,
+                    top_p=gen.top_p,
+                    seed=gen.seed,
+                    adapter_id=adapter_ids[0] if adapter_ids else None,
+                )
 
         def events():
             if chat:
                 yield event("", role="assistant")  # role-announcement chunk
             tracker = _StopTracker(stops or [])
             n_gen = 0
-            if stream_iter is not None:
+            if stream_iter is not None and lp_n is not None:
+                # Streaming logprobs (stops excluded by the caller): each
+                # chunk carries its tokens' stats; text offsets advance
+                # through the decoded stream.
+                tok = self.threaded_engine.tokenizer
+                pos = len(prompt)
+                for toks, lp in stream_iter:
+                    n_gen += len(toks)
+                    tok_strs = [tok.decode([t]) for t in toks]
+                    if chat:
+                        lpj = {"content": [
+                            {"token": s,
+                             "logprob": lp["token_logprobs"][i],
+                             "top_logprobs": [
+                                 {"token": tok.decode([tid]), "logprob": tlp}
+                                 for tid, tlp in zip(lp["top_ids"][i],
+                                                     lp["top_logprobs"][i])
+                             ]}
+                            for i, s in enumerate(tok_strs)
+                        ]}
+                    else:
+                        offsets = []
+                        for s in tok_strs:
+                            offsets.append(pos)
+                            pos += len(s)
+                        lpj = {
+                            "tokens": tok_strs,
+                            "token_logprobs": lp["token_logprobs"],
+                            "top_logprobs": [
+                                {tok.decode([tid]): tlp
+                                 for tid, tlp in zip(lp["top_ids"][i],
+                                                     lp["top_logprobs"][i])}
+                                for i in range(len(tok_strs))
+                            ],
+                            "text_offset": offsets,
+                        }
+                    yield event("".join(tok_strs), logprobs=lpj)
+            elif stream_iter is not None:
                 tok = self.threaded_engine.tokenizer
                 for chunk in stream_iter:
                     n_gen += len(chunk)
@@ -348,19 +398,32 @@ class _Handler(BaseHTTPRequestHandler):
             lp_req = payload.get("logprobs")
             has_lp = lp_req is not None and lp_req is not False
             if payload.get("stream"):
+                lp_n = None
                 if has_lp:
-                    # Streaming logprobs are unsupported; failing loudly beats
-                    # silently returning chunks without them.
-                    self._send_json(
-                        400,
-                        {"error": {"message": "logprobs with stream=true is "
-                                   "not supported by this server"}},
-                    )
-                    return
+                    # Streaming logprobs: served through the continuous
+                    # engine's per-chunk stats; anything it can't carry
+                    # (lock-step-only serving, stop sequences, adapter
+                    # routing, N beyond the compiled logprobs_k) fails
+                    # loudly instead of silently dropping the field.
+                    if chat:
+                        tl = payload.get("top_logprobs")
+                        lp_n = int(tl) if tl is not None else 1
+                    else:
+                        lp_n = int(lp_req)
+                    lp_n = max(0, min(lp_n, 20))
+                    engine_k = getattr(self.threaded_engine, "logprobs_k", 0)
+                    if not (self.threaded_engine is not None and engine_k > 0
+                            and lp_n <= engine_k and not stops
+                            and adapter_ids is None):
+                        self._send_json(400, {"error": {"message":
+                            "streaming logprobs requires --engine continuous "
+                            "with --logprobs-k >= N, no stop sequences, and "
+                            "no adapter routing"}})
+                        return
                 try:
                     self._stream_complete(
                         payload, prompt, gen, chat=chat,
-                        adapter_ids=adapter_ids, stops=stops,
+                        adapter_ids=adapter_ids, stops=stops, lp_n=lp_n,
                     )
                 except QueueFullError as e:
                     # The stream's submit is eager (before SSE headers), so

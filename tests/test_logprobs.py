@@ -263,3 +263,65 @@ def test_server_logprobs_via_continuous_engine(tiny_setup):
     finally:
         server.shutdown()
         te.close()
+
+
+@pytest.mark.slow
+def test_server_streaming_logprobs_via_continuous_engine(tiny_setup):
+    """SSE streaming with logprobs: chunks carry per-token stats that
+    concatenate to exactly the non-streaming response's logprobs."""
+    from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
+    from ditl_tpu.infer.server import make_server
+
+    cfg, params = tiny_setup
+    tok = ByteTokenizer()
+    te = ThreadedEngine(ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4, logprobs_k=3
+    ))
+    server = make_server(Generator(params, cfg, tok), port=0,
+                         default_max_tokens=10, threaded_engine=te)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        body = {"prompt": "abc", "max_tokens": 10, "logprobs": 2,
+                "stream": True}
+        req = urllib.request.Request(
+            f"{base}/v1/completions", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        toks, lps = [], []
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for line in r:
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                ev = json.loads(line[6:])
+                ch = ev["choices"][0]
+                if ch.get("logprobs"):
+                    toks += ch["logprobs"]["tokens"]
+                    lps += ch["logprobs"]["token_logprobs"]
+        # Non-streaming reference through the same engine
+        ref = json.loads(urllib.request.urlopen(urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": "abc", "max_tokens": 10,
+                             "logprobs": 2}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        ), timeout=120).read())["choices"][0]["logprobs"]
+        assert toks == ref["tokens"]
+        assert lps == pytest.approx(ref["token_logprobs"], abs=1e-5)
+
+        # stop sequences + streaming logprobs: loud 400, not silence
+        bad = urllib.request.Request(
+            f"{base}/v1/completions",
+            data=json.dumps({"prompt": "x", "stream": True, "logprobs": 1,
+                             "stop": ["q"]}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            urllib.request.urlopen(bad, timeout=60)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        server.shutdown()
+        te.close()
