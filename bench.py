@@ -198,7 +198,8 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 speculative: bool = False, workload: str = "random",
                 slots: int = 8, decode_chunk: int = 16,
                 page_size: int = 256, moe: bool = False,
-                prompt_len: int = 0, max_new: int = 0) -> int:
+                prompt_len: int = 0, max_new: int = 0,
+                temperature: float = 0.0) -> int:
     """Decode/serving benchmark — one JSON line. Every serving claim in
     BASELINE.md is reproducible from here: ``--engine continuous`` ticks the
     production slot engine (``--cache paged`` for the page pool + Pallas
@@ -291,8 +292,9 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             )
 
         def run_once(eng):
-            for p in prompts:
-                eng.submit(list(p), max_new_tokens=max_new, temperature=0.0)
+            for i, p in enumerate(prompts):
+                eng.submit(list(p), max_new_tokens=max_new,
+                           temperature=temperature, seed=i)
             out = eng.run()
             return sum(len(v) for v in out.values())
 
@@ -346,12 +348,13 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             times.append(time.perf_counter() - t)
         dt = statistics.median(times)
         extra = {}
-    label = "%s%s%s%s%s" % (
+    label = "%s%s%s%s%s%s" % (
         engine,
         "/paged" if cache == "paged" else "",
         ", int8" if quantize else "",
         ", int8-kv" if kv_quant else "",
         ", speculative" if speculative else "",
+        (", T=%.2g" % temperature) if temperature else "",
     )
     arch = "MoE 8x top-2" if moe else "Llama-style"
     print(json.dumps({
@@ -517,6 +520,10 @@ if __name__ == "__main__":
     parser.add_argument("--max-new", type=int, default=0,
                         help="generated tokens per request (--infer; 0 = "
                         "workload default)")
+    parser.add_argument("--temperature", type=float, default=0.0,
+                        help="sampling temperature for --infer continuous "
+                        "(0 = greedy; >0 with --speculative measures the "
+                        "rejection-sampling path)")
     args = parser.parse_args()
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"
@@ -533,5 +540,6 @@ if __name__ == "__main__":
             slots=args.slots, decode_chunk=args.decode_chunk,
             page_size=args.page_size, moe=args.moe,
             prompt_len=args.prompt_len, max_new=args.max_new,
+            temperature=args.temperature,
         ))
     sys.exit(main(args.model))
