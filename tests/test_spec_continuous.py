@@ -340,3 +340,39 @@ def test_spec_smoke_fast(setup):
     assert len(out[0]) > 0
     eng2 = _spec_engine(params, cfg, tok, n_slots=2, spec_rounds=1)
     assert eng2.generate([PROMPTS[0]], max_new_tokens=10, temperature=0.0) == out
+
+
+@pytest.mark.slow
+def test_streaming_logprobs_through_spec_ticks_exact(setup):
+    """The deepest composition: SSE-style streamed chunks with logprobs,
+    decoded by SPECULATIVE ticks — tokens and chosen logprobs identical to
+    the plain engine's non-streaming response (f32)."""
+    import numpy as np
+
+    from ditl_tpu.infer.continuous import ThreadedEngine
+
+    params, cfg, tok = setup
+    prompt = [tok.bos_id] + tok.encode(PROMPTS[0])
+    ref_te = ThreadedEngine(ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4, logprobs_k=2
+    ))
+    try:
+        ref_toks, ref_lp = ref_te.generate_one_with_logprobs(
+            prompt, 2, max_new_tokens=16, temperature=0.0
+        )
+    finally:
+        ref_te.close()
+    eng = _spec_engine(params, cfg, tok, n_slots=2, logprobs_k=2)
+    te = ThreadedEngine(eng)
+    toks, lps = [], []
+    try:
+        for chunk, lp in te.stream_one_with_logprobs(
+            prompt, 2, max_new_tokens=16, temperature=0.0
+        ):
+            toks += chunk
+            lps += lp["token_logprobs"]
+    finally:
+        te.close()
+    assert eng.stats()["speculative"]["spec_ticks"] > 0
+    assert toks == ref_toks
+    np.testing.assert_allclose(lps, ref_lp["token_logprobs"], atol=1e-5)
