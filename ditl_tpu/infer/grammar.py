@@ -1,0 +1,970 @@
+"""Grammar-constrained decoding (L1/L5): regex / JSON grammars compiled to
+token-level DFA transition tables that run ON DEVICE as one gather per step.
+
+The reference has no serving stack at all (its only "model" is a remote API,
+ref ``src/distributed_inference.py:34-41``); guided decoding is part of this
+framework's production serving surface (vLLM/outlines-class capability),
+designed TPU-first:
+
+- **All constraint work happens at compile time, on the host.** A grammar is
+  compiled once into a dense ``(n_states, vocab)`` int32 transition table:
+  ``table[s, t] = next state`` if token ``t`` is allowed in state ``s``, else
+  ``-1``. The decode program then needs exactly one row gather per step
+  (``table[state]``), a ``where`` mask into the logits, and one scalar gather
+  for the state transition — static shapes, no host round-trips, no
+  data-dependent control flow (SURVEY.md §7 design stance).
+- **Byte-level automata.** The char-level machine operates on UTF-8 bytes
+  (alphabet 256), so multi-byte characters need no special-casing in the
+  token walk and the in-repo ``ByteTokenizer`` (1 byte = 1 token) is exact by
+  construction. For subword tokenizers the token table is built from each
+  token's decoded string (the standard outlines-style construction, exact for
+  byte-level BPEs whose per-token decode concatenates).
+- **Bounded-depth JSON is built directly as a DFA**, not via a regex: the
+  pushdown stack is expanded into the state id (mode × container-stack
+  tuple), which stays small (a few hundred states at depth 5) where the
+  equivalent regex would blow up exponentially.
+
+Pipeline: pattern -> AST -> Thompson NFA (byte-set edges) -> subset-construction
+DFA over an alphabet partition (distinct byte-class equivalence, so the hot
+loop is ~n_classes wide, not 256) -> numpy-vectorized token-table walk.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = [
+    "CompiledGrammar",
+    "compile_regex",
+    "compile_json",
+    "compile_json_schema",
+    "token_strings",
+]
+
+# ---------------------------------------------------------------------------
+# Regex AST. Byte sets are 256-bit int masks (bit b set = byte b matches).
+# Sharing AST nodes is safe: the NFA builder allocates fresh states per visit.
+# ---------------------------------------------------------------------------
+
+_ASCII_ALL = (1 << 128) - 1  # bytes 0..127
+
+
+def _mask_of(*bs: int) -> int:
+    m = 0
+    for b in bs:
+        m |= 1 << b
+    return m
+
+
+def _range_mask(lo: int, hi: int) -> int:
+    return ((1 << (hi + 1)) - 1) & ~((1 << lo) - 1)
+
+
+@dataclass(frozen=True)
+class ByteSet:
+    """One transition consuming a single byte from ``mask``."""
+
+    mask: int
+
+
+@dataclass(frozen=True)
+class AnyMultibyte:
+    """Any non-ASCII UTF-8 character (2-4 byte sequence).
+
+    Slightly permissive at the byte level (overlong/surrogate encodings are
+    not rejected) — it constrains structure, and every real tokenizer only
+    carries valid UTF-8 anyway."""
+
+
+@dataclass(frozen=True)
+class Seq:
+    parts: tuple
+
+
+@dataclass(frozen=True)
+class Alt:
+    options: tuple
+
+
+@dataclass(frozen=True)
+class Repeat:
+    """min..max repetitions of ``node``; max=None means unbounded."""
+
+    node: object
+    min: int
+    max: int | None
+
+
+_CLASS_ESCAPES = {
+    "d": _range_mask(0x30, 0x39),
+    "w": _range_mask(0x30, 0x39) | _range_mask(0x41, 0x5A) | _range_mask(0x61, 0x7A) | _mask_of(0x5F),
+    "s": _mask_of(0x20, 0x09, 0x0A, 0x0D, 0x0C, 0x0B),
+}
+_CHAR_ESCAPES = {"n": 0x0A, "t": 0x09, "r": 0x0D, "f": 0x0C, "v": 0x0B, "0": 0x00, "a": 0x07, "b": 0x08}
+
+
+class RegexError(ValueError):
+    pass
+
+
+class _Parser:
+    """Recursive-descent parser for the supported regex subset:
+    literals, escapes (incl. ``\\xHH``, ``\\d\\w\\s`` and negations), ``.``,
+    classes ``[...]`` with ranges/negation, ``|``, groups ``(...)`` (and
+    non-capturing ``(?:...)``), quantifiers ``* + ? {m} {m,} {m,n}``.
+    Anchored fullmatch semantics (``^``/``$`` are implicit and rejected)."""
+
+    def __init__(self, pattern: str):
+        self.p = pattern
+        self.i = 0
+
+    def error(self, msg: str):
+        raise RegexError(f"{msg} at position {self.i} in regex {self.p!r}")
+
+    def peek(self) -> str | None:
+        return self.p[self.i] if self.i < len(self.p) else None
+
+    def next(self) -> str:
+        c = self.p[self.i]
+        self.i += 1
+        return c
+
+    def parse(self):
+        node = self._alt()
+        if self.i != len(self.p):
+            self.error("unexpected character")
+        return node
+
+    def _alt(self):
+        options = [self._seq()]
+        while self.peek() == "|":
+            self.next()
+            options.append(self._seq())
+        return options[0] if len(options) == 1 else Alt(tuple(options))
+
+    def _seq(self):
+        parts = []
+        while (c := self.peek()) is not None and c not in "|)":
+            parts.append(self._quantified())
+        if len(parts) == 1:
+            return parts[0]
+        return Seq(tuple(parts))
+
+    def _quantified(self):
+        node = self._atom()
+        c = self.peek()
+        if c == "*":
+            self.next()
+            node = Repeat(node, 0, None)
+        elif c == "+":
+            self.next()
+            node = Repeat(node, 1, None)
+        elif c == "?":
+            self.next()
+            node = Repeat(node, 0, 1)
+        elif c == "{":
+            node = self._braces(node)
+        if self.peek() == "?":
+            self.error("non-greedy quantifiers are meaningless for a DFA")
+        return node
+
+    def _braces(self, node):
+        self.next()  # {
+        start = self.i
+        while self.peek() not in ("}", None):
+            self.next()
+        if self.peek() is None:
+            self.error("unterminated {")
+        body = self.p[start : self.i]
+        self.next()  # }
+        try:
+            if "," in body:
+                lo_s, hi_s = body.split(",", 1)
+                lo = int(lo_s)
+                hi = int(hi_s) if hi_s.strip() else None
+            else:
+                lo = hi = int(body)
+        except ValueError:
+            self.error(f"bad repetition {{{body}}}")
+        if lo < 0 or (hi is not None and hi < lo):
+            self.error(f"bad repetition {{{body}}}")
+        return Repeat(node, lo, hi)
+
+    def _atom(self):
+        c = self.next()
+        if c == "(":
+            if self.peek() == "?":
+                self.next()
+                if self.peek() != ":":
+                    self.error("only (?:...) groups are supported")
+                self.next()
+            node = self._alt()
+            if self.peek() != ")":
+                self.error("unterminated group")
+            self.next()
+            return node
+        if c == "[":
+            return self._char_class()
+        if c == ".":
+            # Python-re semantics: any character except newline.
+            return Alt((ByteSet(_ASCII_ALL & ~_mask_of(0x0A)), AnyMultibyte()))
+        if c == "\\":
+            return self._escape(in_class=False)
+        if c in "*+?{":
+            self.error(f"quantifier {c!r} with nothing to repeat")
+        if c in ")]^$":
+            self.error(f"unsupported metacharacter {c!r}")
+        return self._literal_char(c)
+
+    def _literal_char(self, c: str):
+        data = c.encode("utf-8")
+        if len(data) == 1:
+            return ByteSet(_mask_of(data[0]))
+        return Seq(tuple(ByteSet(_mask_of(b)) for b in data))
+
+    def _escape(self, in_class: bool):
+        if self.peek() is None:
+            self.error("dangling backslash")
+        c = self.next()
+        if c in _CLASS_ESCAPES:
+            return ByteSet(_CLASS_ESCAPES[c])
+        if c.lower() in _CLASS_ESCAPES and c.isupper():
+            # Negated: ASCII complement plus any non-ASCII character.
+            return Alt((ByteSet(_ASCII_ALL & ~_CLASS_ESCAPES[c.lower()]), AnyMultibyte()))
+        if c == "x":
+            hexs = self.p[self.i : self.i + 2]
+            if len(hexs) != 2 or any(h not in "0123456789abcdefABCDEF" for h in hexs):
+                self.error("\\x needs two hex digits")
+            self.i += 2
+            b = int(hexs, 16)
+            if b > 0x7F and not in_class:
+                self.error("\\x beyond ASCII outside a class is ambiguous; use the literal character")
+            return ByteSet(_mask_of(b))
+        if c in _CHAR_ESCAPES and c != "b":
+            return ByteSet(_mask_of(_CHAR_ESCAPES[c]))
+        if c == "b" and in_class:
+            return ByteSet(_mask_of(0x08))
+        if c == "b":
+            self.error("word-boundary \\b is not a DFA-expressible single-byte constraint")
+        if c.isalnum():
+            self.error(f"unsupported escape \\{c}")
+        return self._literal_char(c)
+
+    def _char_class(self):
+        negate = False
+        if self.peek() == "^":
+            self.next()
+            negate = True
+        mask = 0
+        first = True
+        while True:
+            c = self.peek()
+            if c is None:
+                self.error("unterminated character class")
+            if c == "]" and not first:
+                self.next()
+                break
+            first = False
+            lo_node = self._class_single()
+            if isinstance(lo_node, int):
+                lo = lo_node
+                if self.peek() == "-" and self.p[self.i + 1 : self.i + 2] not in ("]", ""):
+                    self.next()
+                    hi_node = self._class_single()
+                    if not isinstance(hi_node, int) or hi_node < lo:
+                        self.error("bad class range")
+                    mask |= _range_mask(lo, hi_node)
+                else:
+                    mask |= _mask_of(lo)
+            else:  # a \d/\w/\s mask inside the class
+                mask |= lo_node.mask
+        if negate:
+            # Complement within ASCII, plus all non-ASCII characters.
+            return Alt((ByteSet(_ASCII_ALL & ~mask), AnyMultibyte()))
+        return ByteSet(mask)
+
+    def _class_single(self):
+        c = self.next()
+        if c == "\\":
+            node = self._escape(in_class=True)
+            if isinstance(node, ByteSet):
+                m = node.mask
+                # single byte -> return the code; multi-bit -> return the set
+                if m & (m - 1) == 0:
+                    return m.bit_length() - 1
+                return node
+            self.error("unsupported escape in class")
+        b = c.encode("utf-8")
+        if len(b) != 1:
+            self.error("non-ASCII characters in classes are not supported")
+        return b[0]
+
+
+# ---------------------------------------------------------------------------
+# Thompson NFA -> subset-construction DFA over an alphabet partition.
+# ---------------------------------------------------------------------------
+
+_MB_LEAD2 = _range_mask(0xC2, 0xDF)
+_MB_LEAD3 = _range_mask(0xE0, 0xEF)
+_MB_LEAD4 = _range_mask(0xF0, 0xF4)
+_MB_CONT = _range_mask(0x80, 0xBF)
+
+
+class _NFA:
+    def __init__(self):
+        self.n = 0
+        self.edges: list[tuple[int, int, int]] = []  # (src, mask, dst)
+        self.eps: list[tuple[int, int]] = []
+
+    def state(self) -> int:
+        self.n += 1
+        return self.n - 1
+
+    def add(self, src: int, mask: int, dst: int):
+        self.edges.append((src, mask, dst))
+
+    def frag(self, node) -> tuple[int, int]:
+        """Build the fragment for ``node``; returns (start, accept)."""
+        if isinstance(node, ByteSet):
+            s, a = self.state(), self.state()
+            if node.mask:
+                self.add(s, node.mask, a)
+            # empty mask = matches nothing (e.g. [^\x00-\x7f] ASCII part)
+            return s, a
+        if isinstance(node, AnyMultibyte):
+            s, a = self.state(), self.state()
+            c1, c2, c3 = self.state(), self.state(), self.state()
+            self.add(s, _MB_LEAD2, c1)
+            self.add(s, _MB_LEAD3, c2)
+            self.add(s, _MB_LEAD4, c3)
+            self.add(c3, _MB_CONT, c2)
+            self.add(c2, _MB_CONT, c1)
+            self.add(c1, _MB_CONT, a)
+            return s, a
+        if isinstance(node, Seq):
+            if not node.parts:
+                s = self.state()
+                return s, s
+            s, a = self.frag(node.parts[0])
+            for part in node.parts[1:]:
+                s2, a2 = self.frag(part)
+                self.eps.append((a, s2))
+                a = a2
+            return s, a
+        if isinstance(node, Alt):
+            s, a = self.state(), self.state()
+            for opt in node.options:
+                os, oa = self.frag(opt)
+                self.eps.append((s, os))
+                self.eps.append((oa, a))
+            return s, a
+        if isinstance(node, Repeat):
+            s = self.state()
+            cur = s
+            for _ in range(node.min):
+                fs, fa = self.frag(node.node)
+                self.eps.append((cur, fs))
+                cur = fa
+            if node.max is None:
+                fs, fa = self.frag(node.node)
+                self.eps.append((cur, fs))
+                self.eps.append((fa, fs))
+                a = self.state()
+                self.eps.append((cur, a))
+                self.eps.append((fa, a))
+                return s, a
+            a = self.state()
+            self.eps.append((cur, a))
+            for _ in range(node.max - node.min):
+                fs, fa = self.frag(node.node)
+                self.eps.append((cur, fs))
+                self.eps.append((fa, a))
+                cur = fa
+            return s, a
+        raise TypeError(f"unknown AST node {node!r}")
+
+
+def _nfa_to_dfa(nfa: _NFA, start: int, accept: int, max_states: int):
+    """Subset construction. Returns (next (S, 256) int32 with -1 = dead,
+    accept (S,) bool). The alphabet is partitioned into byte-equivalence
+    classes (bytes indistinguishable by every edge mask) so the per-state
+    work is O(n_classes), not O(256)."""
+    # Alphabet partition: class signature = which distinct masks contain b.
+    masks = sorted({m for (_, m, _) in nfa.edges})
+    sig = np.zeros(256, np.int64)
+    for idx, m in enumerate(masks):
+        arr = np.array([(m >> b) & 1 for b in range(256)], np.int64)
+        sig = sig * 2 + arr  # cheap running signature
+        # Re-compress before int64 can overflow: after a compression the
+        # values are < 256 distinct indices, and 48 doublings keeps
+        # 2^8 * 2^48 well inside int64.
+        if idx and idx % 48 == 0:
+            _, sig = np.unique(sig, return_inverse=True)
+    _, class_of = np.unique(sig, return_inverse=True)
+    n_classes = int(class_of.max()) + 1
+    rep_byte = np.zeros(n_classes, np.int64)
+    for c in range(n_classes):
+        rep_byte[c] = int(np.argmax(class_of == c))
+
+    # Per NFA state: epsilon targets and byte edges.
+    eps_out: list[list[int]] = [[] for _ in range(nfa.n)]
+    for s, d in nfa.eps:
+        eps_out[s].append(d)
+    edges_out: list[list[tuple[int, int]]] = [[] for _ in range(nfa.n)]
+    for s, m, d in nfa.edges:
+        edges_out[s].append((m, d))
+
+    def closure(states: frozenset[int]) -> frozenset[int]:
+        stack = list(states)
+        seen = set(states)
+        while stack:
+            s = stack.pop()
+            for d in eps_out[s]:
+                if d not in seen:
+                    seen.add(d)
+                    stack.append(d)
+        return frozenset(seen)
+
+    start_set = closure(frozenset([start]))
+    ids: dict[frozenset[int], int] = {start_set: 0}
+    order = [start_set]
+    next_cls: list[list[int]] = []
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        row = [-1] * n_classes
+        for c in range(n_classes):
+            b = int(rep_byte[c])
+            dst = set()
+            for s in cur:
+                for m, d in edges_out[s]:
+                    if (m >> b) & 1:
+                        dst.add(d)
+            if dst:
+                dset = closure(frozenset(dst))
+                if dset not in ids:
+                    if len(ids) >= max_states:
+                        raise RegexError(
+                            f"grammar DFA exceeds {max_states} states; simplify "
+                            "the pattern or raise max_states"
+                        )
+                    ids[dset] = len(order)
+                    order.append(dset)
+                row[c] = ids[dset]
+        next_cls.append(row)
+    n = len(order)
+    nxt = np.asarray(next_cls, np.int32)[:, class_of]  # (S, 256)
+    acc = np.array([accept in st for st in order], bool)
+    return nxt, acc
+
+
+# ---------------------------------------------------------------------------
+# Direct bounded-depth JSON DFA (no regex intermediate — the pushdown stack
+# is expanded into the state id, so depth 5 stays a few hundred states).
+# ---------------------------------------------------------------------------
+
+_WS = b" \t\n\r"
+_DIGITS = b"0123456789"
+_HEX = b"0123456789abcdefABCDEF"
+
+
+def _json_dfa(max_depth: int, top: str):
+    """Byte-level DFA for JSON with container nesting bounded by
+    ``max_depth``. ``top`` is "object" (the OpenAI ``json_object`` contract)
+    or "value". States are (mode, stack) pairs, stack a str of 'o'/'a'."""
+    if top not in ("object", "value"):
+        raise ValueError("top must be 'object' or 'value'")
+
+    def step(state, byte: int):
+        """(mode, stack) × byte -> (mode, stack) | None. Modes:
+        V value-start; D done (top value complete, ws loop);
+        P post-value (ws, then , or close per stack top);
+        OO just-opened object (key or }); OC after comma in object (key);
+        K in-key; KE key-escape; KU1-4 key-unicode; KC1-2 key utf8 cont;
+        PK post-key (ws then :); S/SE/SU1-4/SC1-2 value string;
+        N- N0 NI ND NF NE NS NX number; Lt/Lf/Ln literal progress ints."""
+        mode, stack = state
+        c = byte
+
+        def complete(stk):  # a value just finished under stack stk
+            return ("D", "") if not stk else ("P", stk)
+
+        if mode == "D":
+            return ("D", "") if c in _WS else None
+        if mode == "P":
+            if c in _WS:
+                return state
+            topc = stack[-1]
+            if topc == "o":
+                if c == ord(","):
+                    return ("OC", stack)
+                if c == ord("}"):
+                    return complete(stack[:-1])
+            else:
+                if c == ord(","):
+                    return ("V", stack)
+                if c == ord("]"):
+                    return complete(stack[:-1])
+            return None
+        if mode in ("V", "OO", "OC", "AO"):
+            if c in _WS:
+                return state
+            if mode in ("OO", "OC"):
+                if c == ord('"'):
+                    return ("K", stack)
+                if c == ord("}") and mode == "OO":
+                    return complete(stack[:-1])
+                return None
+            # value start (V), or just-opened array (AO: value or ])
+            if mode == "AO" and c == ord("]"):
+                return complete(stack[:-1])
+            if c == ord('"'):
+                return ("S", stack)
+            if c == ord("{"):
+                if len(stack) >= max_depth:
+                    return None
+                return ("OO", stack + "o")
+            if c == ord("["):
+                if len(stack) >= max_depth:
+                    return None
+                return ("AO", stack + "a")
+            if c == ord("-"):
+                return ("N-", stack)
+            if c == ord("0"):
+                return ("N0", stack)
+            if c in _DIGITS:
+                return ("NI", stack)
+            if c == ord("t"):
+                return (("L", "true", 1), stack)
+            if c == ord("f"):
+                return (("L", "false", 1), stack)
+            if c == ord("n"):
+                return (("L", "null", 1), stack)
+            return None
+        if isinstance(mode, tuple) and mode[0] == "L":
+            _, word, pos = mode
+            if c == ord(word[pos]):
+                if pos + 1 == len(word):
+                    return complete(stack)
+                return (("L", word, pos + 1), stack)
+            return None
+        # Strings (value S* / key K*) share structure.
+        if mode in ("S", "K"):
+            esc, u1, c1, c2, end = (
+                ("SE", "SU1", "SC1", "SC2", None) if mode == "S" else ("KE", "KU1", "KC1", "KC2", None)
+            )
+            if c == ord('"'):
+                return complete(stack) if mode == "S" else ("PK", stack)
+            if c == ord("\\"):
+                return (esc, stack)
+            if 0x20 <= c <= 0x7F:
+                return state
+            if 0xC2 <= c <= 0xDF:
+                return (c1, stack)
+            if 0xE0 <= c <= 0xEF:
+                return (c2, stack)
+            if 0xF0 <= c <= 0xF4:
+                return ((("MC3", mode), stack))
+            return None
+        if isinstance(mode, tuple) and mode[0] == "MC3":
+            if 0x80 <= c <= 0xBF:
+                return ("SC2" if mode[1] == "S" else "KC2", stack)
+            return None
+        if mode in ("SC2", "KC2"):
+            if 0x80 <= c <= 0xBF:
+                return ("SC1" if mode == "SC2" else "KC1", stack)
+            return None
+        if mode in ("SC1", "KC1"):
+            if 0x80 <= c <= 0xBF:
+                return ("S" if mode == "SC1" else "K", stack)
+            return None
+        if mode in ("SE", "KE"):
+            base = "S" if mode == "SE" else "K"
+            if c in b'"\\/bfnrt':
+                return (base, stack)
+            if c == ord("u"):
+                return (base + "U1", stack)
+            return None
+        if mode in ("SU1", "SU2", "SU3", "SU4", "KU1", "KU2", "KU3", "KU4"):
+            if c in _HEX:
+                base, n = mode[0], int(mode[2])
+                if n == 4:
+                    return ("S" if base == "S" else "K", stack)
+                return (f"{base}U{n + 1}", stack)
+            return None
+        if mode == "PK":
+            if c in _WS:
+                return state
+            if c == ord(":"):
+                return ("V", stack)
+            return None
+        # Numbers. Completion is implicit: delimiter bytes route through P.
+        if mode == "N-":
+            if c == ord("0"):
+                return ("N0", stack)
+            if c in _DIGITS:
+                return ("NI", stack)
+            return None
+        if mode in ("N0", "NI", "NF", "NX"):
+            if mode == "NI" and c in _DIGITS:
+                return state
+            if mode in ("N0", "NI") and c == ord("."):
+                return ("ND", stack)
+            if mode in ("N0", "NI", "NF") and c in b"eE":
+                return ("NE", stack)
+            if mode in ("NF", "NX") and c in _DIGITS:
+                return state
+            # number complete; the byte must belong to the follow set
+            nxt = complete(stack)
+            return step(nxt, c)
+        if mode == "ND":
+            if c in _DIGITS:
+                return ("NF", stack)
+            return None
+        if mode == "NE":
+            if c in b"+-":
+                return ("NS", stack)
+            if c in _DIGITS:
+                return ("NX", stack)
+            return None
+        if mode == "NS":
+            if c in _DIGITS:
+                return ("NX", stack)
+            return None
+        raise AssertionError(f"unhandled mode {mode!r}")
+
+    start = ("OO", "o") if top == "object" else ("V", "")
+    if top == "object":
+        # top-level object: consume the opening '{' implicitly? No — the
+        # model must emit it. Start expects ws then '{'.
+        start = ("TOP", "")
+
+    def step_top(state, byte):
+        if state[0] == "TOP":
+            if byte in _WS:
+                return state
+            if byte == ord("{"):
+                return ("OO", "o")
+            return None
+        return step(state, byte)
+
+    f = step_top if top == "object" else step
+
+    def is_accept(state):
+        mode, stack = state
+        if mode == "D":
+            return True
+        # top-level numbers complete implicitly at end of input
+        return not stack and mode in ("N0", "NI", "NF", "NX")
+
+    ids = {start: 0}
+    order = [start]
+    rows = []
+    i = 0
+    while i < len(order):
+        cur = order[i]
+        i += 1
+        row = np.full(256, -1, np.int32)
+        for b in range(256):
+            nxt = f(cur, b)
+            if nxt is not None:
+                if nxt not in ids:
+                    ids[nxt] = len(order)
+                    order.append(nxt)
+                row[b] = ids[nxt]
+        rows.append(row)
+    nxt = np.stack(rows)
+    acc = np.array([is_accept(s) for s in order], bool)
+    return nxt, acc
+
+
+# ---------------------------------------------------------------------------
+# JSON-schema subset -> regex string (closed schemas; nesting comes from the
+# schema itself, so the regex stays linear in schema size).
+# ---------------------------------------------------------------------------
+
+_JSON_STRING_RE = r'"([^"\\\x00-\x1f]|\\(["\\/bfnrt]|u[0-9a-fA-F]{4}))*"'
+_JSON_NUMBER_RE = r"\-?(0|[1-9][0-9]*)(\.[0-9]+)?([eE][\+\-]?[0-9]+)?"
+_JSON_INT_RE = r"\-?(0|[1-9][0-9]*)"
+_WS_RE = r"[ \t\n\r]*"
+
+
+def _re_escape(s: str) -> str:
+    out = []
+    for ch in s:
+        if ch in r"\.^$*+?{}[]()|":
+            out.append("\\" + ch)
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _schema_regex(schema: dict) -> str:
+    if not isinstance(schema, dict):
+        raise ValueError(f"schema must be a dict, got {type(schema).__name__}")
+    if "enum" in schema:
+        return "(" + "|".join(_re_escape(json.dumps(v)) for v in schema["enum"]) + ")"
+    if "const" in schema:
+        return _re_escape(json.dumps(schema["const"]))
+    t = schema.get("type")
+    if isinstance(t, list):
+        return "(" + "|".join(_schema_regex({**schema, "type": x}) for x in t) + ")"
+    if t == "string":
+        return _JSON_STRING_RE
+    if t == "integer":
+        return _JSON_INT_RE
+    if t == "number":
+        return _JSON_NUMBER_RE
+    if t == "boolean":
+        return "(true|false)"
+    if t == "null":
+        return "null"
+    if t == "array":
+        items = schema.get("items")
+        if items is None:
+            raise ValueError("array schemas need 'items' (closed schemas only)")
+        item = _schema_regex(items)
+        mn = int(schema.get("minItems", 0))
+        mx = schema.get("maxItems")
+        sep = _WS_RE + "," + _WS_RE
+        if mx is not None:
+            mx = int(mx)
+            if mx < mn:
+                raise ValueError(
+                    f"unsatisfiable array bounds minItems={mn} > maxItems={mx}"
+                )
+            if mx == 0:
+                return r"\[" + _WS_RE + r"\]"
+            opts = []
+            for k in range(max(mn, 0), mx + 1):
+                if k == 0:
+                    opts.append("")
+                else:
+                    opts.append(item + (sep + item) * (k - 1))
+            body = "(" + "|".join(opts) + ")"
+        elif mn > 0:
+            body = item + (sep + item) * (mn - 1) + "(" + sep + item + ")*"
+        else:
+            body = "(" + item + "(" + sep + item + ")*" + ")?"
+        return r"\[" + _WS_RE + body + _WS_RE + r"\]"
+    if t == "object":
+        props = schema.get("properties")
+        if not props:
+            raise ValueError("object schemas need 'properties' (closed schemas only)")
+        required = set(schema.get("required", props.keys()))
+        parts = []
+        first = True
+        for name, sub in props.items():
+            pair = (
+                _re_escape(json.dumps(name)) + _WS_RE + ":" + _WS_RE + _schema_regex(sub)
+            )
+            if first:
+                frag = pair
+            else:
+                frag = _WS_RE + "," + _WS_RE + pair
+            if name not in required:
+                frag = "(" + frag + ")?"
+                if first:
+                    raise ValueError(
+                        "an optional FIRST property is ambiguous with the "
+                        "comma grammar; make the first property required"
+                    )
+            parts.append(frag)
+            first = False
+        body = "".join(parts)
+        return r"\{" + _WS_RE + body + _WS_RE + r"\}"
+    raise ValueError(f"unsupported schema: {schema!r}")
+
+
+# ---------------------------------------------------------------------------
+# Token-level table.
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CompiledGrammar:
+    """A grammar lowered to a token-level transition table.
+
+    ``token_next[s, t]``: local next state if token ``t`` is allowed in local
+    state ``s``, else ``-1``. ``accept[s]``: EOS is allowed in ``s``. State 0
+    is the start. States are local (0-based); an engine embedding several
+    grammars into one device table relocates them by row offset."""
+
+    token_next: np.ndarray  # (S, V) int32
+    accept: np.ndarray  # (S,) bool
+    source: str  # printable description for stats/debugging
+    byte_next: np.ndarray | None = None  # (S, 256) char-level DFA (debug/tests)
+
+    @property
+    def n_states(self) -> int:
+        return int(self.token_next.shape[0])
+
+    def matches(self, data: bytes) -> bool:
+        """Char-level fullmatch — the oracle used by tests."""
+        if self.byte_next is None:
+            raise ValueError("char-level DFA not retained")
+        s = 0
+        for b in data:
+            s = int(self.byte_next[s, b])
+            if s < 0:
+                return False
+        return bool(self.accept[s])
+
+
+def _gpt2_unicode_to_byte() -> dict[str, int]:
+    """Inverse of GPT-2's public bytes_to_unicode table: byte-level BPEs
+    store each raw byte as a printable unicode char; mapping token strings
+    back through this table recovers EXACT bytes, including tokens that are
+    partial UTF-8 sequences (which ``decode()`` would mangle to U+FFFD)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {chr(c): b for b, c in zip(bs, cs)}
+
+
+def token_strings(tokenizer) -> list[bytes]:
+    """Per-token byte strings. Exact for ByteTokenizer (1 byte/token). For
+    HF tokenizers, token vocab strings are mapped back through the GPT-2
+    byte alphabet when the vocab uses it (exact for byte-level BPEs, partial
+    UTF-8 tokens included) or through SentencePiece's ``▁``-prefix
+    convention; otherwise falls back to each token's decoded string. Every
+    special token maps to b"" and is handled by column rules (EOS allowed
+    via accept states, all other specials disallowed)."""
+    off = getattr(tokenizer, "byte_offset", None)
+    v = tokenizer.vocab_size
+    if off is not None:  # ByteTokenizer fast path
+        out = [b""] * v
+        for i in range(off, min(off + 256, v)):
+            out[i] = bytes([i - off])
+        return out
+    specials = {tokenizer.pad_id, tokenizer.bos_id, tokenizer.eos_id}
+    inner = getattr(tokenizer, "_tok", None)
+    if inner is not None:
+        specials |= set(getattr(inner, "all_special_ids", ()) or ())
+    to_tokens = getattr(inner, "convert_ids_to_tokens", None)
+    u2b = _gpt2_unicode_to_byte()
+    out = []
+    for i in range(v):
+        if i in specials:
+            out.append(b"")
+            continue
+        if to_tokens is not None:
+            s = to_tokens(i)
+            if s is None:
+                out.append(b"")
+                continue
+            if all(ch in u2b for ch in s):  # byte-level BPE alphabet
+                out.append(bytes(u2b[ch] for ch in s))
+                continue
+            if s.startswith("▁"):  # SentencePiece word-start marker
+                out.append((" " + s[1:]).encode("utf-8"))
+                continue
+            if "▁" not in s and "�" not in s:
+                out.append(s.encode("utf-8"))
+                continue
+        out.append(tokenizer.decode([i]).encode("utf-8"))
+    return out
+
+
+def _token_table(
+    byte_next: np.ndarray,
+    accept: np.ndarray,
+    toks: list[bytes],
+    *,
+    eos_id: int,
+    source: str,
+    keep_byte_dfa: bool = True,
+) -> CompiledGrammar:
+    """Vectorized walk: advance every (state, token) pair through the byte
+    DFA in lock-step over byte positions — O(S x V x max_len) numpy ops."""
+    from ditl_tpu.native.fsm import token_table_native
+
+    native = token_table_native(byte_next, toks)
+    if native is not None:
+        tt = native
+    else:
+        S = byte_next.shape[0]
+        V = len(toks)
+        lmax = max((len(t) for t in toks), default=1) or 1
+        padded = np.zeros((V, lmax), np.uint8)
+        lens = np.zeros(V, np.int64)
+        for i, t in enumerate(toks):
+            padded[i, : len(t)] = np.frombuffer(t, np.uint8)
+            lens[i] = len(t)
+        tt = np.broadcast_to(np.arange(S, dtype=np.int32)[:, None], (S, V)).copy()
+        for l in range(lmax):
+            active = (l < lens)[None, :]  # (1, V)
+            cur = np.maximum(tt, 0)
+            stepped = byte_next[cur, padded[None, :, l]]  # (S, V)
+            tt = np.where(active, np.where(tt >= 0, stepped, -1), tt)
+        # zero-byte tokens (specials / empty decodes) must not be free
+        # no-ops — disallow them everywhere.
+        tt[:, lens == 0] = -1
+    # EOS: allowed exactly in accepting states; consuming it parks the row
+    # in its current state (the engine freezes finished rows anyway).
+    tt[:, eos_id] = np.where(accept, np.arange(byte_next.shape[0], dtype=np.int32), -1)
+    return CompiledGrammar(
+        token_next=tt.astype(np.int32),
+        accept=accept.copy(),
+        source=source,
+        byte_next=byte_next if keep_byte_dfa else None,
+    )
+
+
+def compile_regex(
+    pattern: str,
+    tokenizer,
+    *,
+    max_states: int = 20_000,
+) -> CompiledGrammar:
+    """Compile an anchored (fullmatch) regex into a token-level DFA table."""
+    ast = _Parser(pattern).parse()
+    nfa = _NFA()
+    s, a = nfa.frag(ast)
+    byte_next, accept = _nfa_to_dfa(nfa, s, a, max_states)
+    return _token_table(
+        byte_next, accept, token_strings(tokenizer),
+        eos_id=tokenizer.eos_id, source=f"regex:{pattern}",
+    )
+
+
+def compile_json(
+    tokenizer,
+    *,
+    max_depth: int = 5,
+    top: str = "object",
+) -> CompiledGrammar:
+    """Any syntactically valid JSON (``top="object"`` = the OpenAI
+    ``json_object`` contract) with container nesting up to ``max_depth``."""
+    byte_next, accept = _json_dfa(max_depth, top)
+    return _token_table(
+        byte_next, accept, token_strings(tokenizer),
+        eos_id=tokenizer.eos_id, source=f"json:{top}:d{max_depth}",
+    )
+
+
+def compile_json_schema(
+    schema: dict,
+    tokenizer,
+    *,
+    max_states: int = 20_000,
+) -> CompiledGrammar:
+    """Closed JSON-schema subset (type/enum/const/properties/items/required,
+    fixed property order) -> regex -> token DFA."""
+    pattern = _schema_regex(schema)
+    g = compile_regex(pattern, tokenizer, max_states=max_states)
+    return dataclasses.replace(g, source=f"schema:{json.dumps(schema)[:80]}")

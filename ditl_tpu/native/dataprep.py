@@ -1,7 +1,6 @@
 """ctypes bridge to the C++ data-prep library (csrc/dataprep.cpp).
 
-Build-on-first-use: compiles with g++ into ``ditl_tpu/native/_build/`` when
-the .so is missing or older than the source (no pip/pybind11 involved —
+Build-on-first-use via native/build.NativeLib (no pip/pybind11 involved —
 plain ``ctypes`` per the zero-new-dependency rule). Every entry point has a
 pure-Python/numpy fallback, so a machine without a toolchain still runs —
 just slower on the host data path.
@@ -14,25 +13,12 @@ their own native code and bypass this.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-import threading
 
 import numpy as np
 
-from ditl_tpu.utils.logging import get_logger
-
-logger = get_logger(__name__)
+from ditl_tpu.native.build import NativeLib
 
 __all__ = ["available", "pack_stream", "segments_positions", "tokenize_padded"]
-
-_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "dataprep.cpp")
-_BUILD_DIR = os.path.join(os.path.dirname(__file__), "_build")
-_SO = os.path.join(_BUILD_DIR, "libdataprep.so")
-
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_tried = False
 
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
@@ -40,27 +26,7 @@ _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 _u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
-def _build_and_load() -> ctypes.CDLL | None:
-    src = os.path.abspath(_SRC)
-    if not os.path.exists(src):
-        logger.warning("native dataprep source missing at %s", src)
-        return None
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(src):
-        tmp = _SO + f".tmp.{os.getpid()}"
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src, "-o", tmp]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(tmp, _SO)  # atomic: concurrent builders don't corrupt
-            logger.info("built native dataprep: %s", _SO)
-        except (subprocess.SubprocessError, OSError) as e:
-            logger.warning("native dataprep build failed (%s); using Python path", e)
-            return None
-    try:
-        lib = ctypes.CDLL(_SO)
-    except OSError as e:
-        logger.warning("native dataprep load failed (%s); using Python path", e)
-        return None
+def _register(lib: ctypes.CDLL) -> None:
     lib.dp_stream_size.restype = ctypes.c_int64
     lib.dp_stream_size.argtypes = [_i64p, ctypes.c_int64]
     lib.dp_pack_stream.restype = ctypes.c_int64
@@ -77,21 +43,17 @@ def _build_and_load() -> ctypes.CDLL | None:
         _u8p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_int32, ctypes.c_int32, _i32p, _f32p,
     ]
-    return lib
+
+
+_LIB = NativeLib("dataprep", _register)
 
 
 def _get() -> ctypes.CDLL | None:
-    global _lib, _tried
-    if _lib is None and not _tried:
-        with _lock:
-            if _lib is None and not _tried:
-                _lib = _build_and_load()
-                _tried = True
-    return _lib
+    return _LIB.get()
 
 
 def available() -> bool:
-    return _get() is not None
+    return _LIB.available()
 
 
 def _concat_docs(texts: list[str]) -> tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,361 @@
+"""Grammar compilation (infer/grammar.py): regex->DFA semantics vs Python
+``re``, the direct bounded-depth JSON DFA vs ``json.loads``, schema->regex,
+and the token-table walk (numpy fallback vs the C++ native path)."""
+
+from __future__ import annotations
+
+import json
+import re
+
+import numpy as np
+import pytest
+
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer import grammar as G
+
+
+def _byte_match(byte_next, accept, data: bytes) -> bool:
+    s = 0
+    for b in data:
+        s = int(byte_next[s, b])
+        if s < 0:
+            return False
+    return bool(accept[s])
+
+
+# ---------------------------------------------------------------------------
+# Regex -> byte DFA semantics (oracle: re.fullmatch).
+# ---------------------------------------------------------------------------
+
+_PATTERNS = [
+    r"abc",
+    r"a*b+c?",
+    r"(ab|cd)*ef",
+    r"[0-9]{2,4}",
+    r"[a-f]+\d*",
+    r"yes|no|maybe",
+    r"a{3}",
+    r"a{2,}",
+    r"(a|b){1,3}c",
+    r"[^x]y",
+    r"\w+@\w+\.(com|org)",
+    r"\s*-?[0-9]+\s*",
+    r"a.c",
+    r'"[^"]*"',
+]
+
+_PROBES = [
+    "", "a", "b", "c", "ab", "abc", "abcc", "aabbcc", "ef", "abef", "cdabef",
+    "12", "123", "12345", "abc123", "deadbeef", "yes", "no", "maybe", "maybes",
+    "aaa", "aa", "aaaa", "ac", "bc", "abc", "xy", "zy", "yy", "xx",
+    "a@b.com", "foo@bar.org", "foo@bar.net", " -42 ", "42", "a c", "axc", "a\nc",
+    '"hello"', '""', '"a"b', "héllo", "añc", "über",
+]
+
+
+@pytest.mark.parametrize("pattern", _PATTERNS)
+def test_regex_matches_python_re(pattern):
+    tok = ByteTokenizer()
+    g = G.compile_regex(pattern, tok)
+    rx = re.compile(pattern)
+    for probe in _PROBES:
+        want = rx.fullmatch(probe) is not None
+        got = g.matches(probe.encode("utf-8"))
+        assert got == want, f"{pattern!r} vs {probe!r}: dfa={got} re={want}"
+
+
+def test_regex_unicode_dot_and_negated_class():
+    tok = ByteTokenizer()
+    g = G.compile_regex(r"a.c", tok)
+    assert g.matches("aéc".encode())  # multibyte char matches .
+    assert not g.matches(b"a\nc")
+    g2 = G.compile_regex(r"[^x]+", tok)
+    assert g2.matches("ünïcödé".encode())
+    assert not g2.matches(b"ax")
+
+
+def test_regex_rejects_unsupported():
+    tok = ByteTokenizer()
+    for bad in [
+        r"a(", r"a)", r"*a", r"a**", r"(?P<x>a)", r"a\b", r"[z-a]",
+        r"a{-1}", r"a{2,1}", r"\xzz", r"\x5",
+    ]:
+        with pytest.raises(G.RegexError):
+            G.compile_regex(bad, tok)
+
+
+def test_regex_state_budget():
+    tok = ByteTokenizer()
+    with pytest.raises(G.RegexError):
+        G.compile_regex(r"a{500}b{500}", tok, max_states=100)
+
+
+# ---------------------------------------------------------------------------
+# Direct JSON DFA.
+# ---------------------------------------------------------------------------
+
+_GOOD_JSON_VALUES = [
+    "0", "-1", "42", "3.14", "-0.5e10", "1e-3", "true", "false", "null",
+    '"hi"', '""', '"a\\nb"', '"\\u00e9"', "[]", "[1]", "[1, 2, 3]",
+    '{"a": 1}', '{ "a" : [1, {"b": "c"}], "d": null }', "[[1], [2, [3]]]",
+    '"héllo wörld"',
+]
+
+_BAD_JSON = [
+    "", "{", "}", "[1,]", "{a: 1}", "01", "+1", "1.", ".5", "tru", "nul",
+    '"unterminated', "[1 2]", '{"a" 1}', '{"a": }', "--1", "1e", '{"a":1,}',
+    "nan", "infinity", '"bad \\x escape"',
+]
+
+
+@pytest.mark.parametrize("text", _GOOD_JSON_VALUES)
+def test_json_dfa_accepts_valid(text):
+    byte_next, accept = G._json_dfa(max_depth=5, top="value")
+    assert _byte_match(byte_next, accept, text.encode()), text
+    json.loads(text)  # sanity: the oracle agrees it is valid
+
+
+@pytest.mark.parametrize("text", _BAD_JSON)
+def test_json_dfa_rejects_invalid(text):
+    byte_next, accept = G._json_dfa(max_depth=5, top="value")
+    assert not _byte_match(byte_next, accept, text.encode()), text
+
+
+def test_json_dfa_depth_bound():
+    byte_next, accept = G._json_dfa(max_depth=2, top="value")
+    assert _byte_match(byte_next, accept, b'[[1]]')
+    assert not _byte_match(byte_next, accept, b'[[[1]]]')
+
+
+def test_json_object_top_requires_object():
+    byte_next, accept = G._json_dfa(max_depth=4, top="object")
+    assert _byte_match(byte_next, accept, b'{"a": 1}')
+    assert _byte_match(byte_next, accept, b'  {"a": [1, 2]} ')
+    assert not _byte_match(byte_next, accept, b"[1]")
+    assert not _byte_match(byte_next, accept, b'"str"')
+
+
+def test_json_dfa_state_count_is_small():
+    byte_next, _ = G._json_dfa(max_depth=5, top="value")
+    # the pushdown expansion must stay linear-ish, not exponential-regex
+    assert byte_next.shape[0] < 3000, byte_next.shape
+
+
+# ---------------------------------------------------------------------------
+# Schema -> regex.
+# ---------------------------------------------------------------------------
+
+def test_schema_object_roundtrip():
+    tok = ByteTokenizer()
+    schema = {
+        "type": "object",
+        "properties": {
+            "name": {"type": "string"},
+            "age": {"type": "integer"},
+            "tags": {"type": "array", "items": {"type": "string"}, "maxItems": 2},
+            "ok": {"type": "boolean"},
+        },
+    }
+    g = G.compile_json_schema(schema, tok)
+    good = '{"name": "bo", "age": 3, "tags": ["x"], "ok": true}'
+    json.loads(good)
+    assert g.matches(good.encode())
+    assert g.matches(b'{"name":"", "age":-1, "tags":[], "ok":false}')
+    # wrong type, wrong order, missing key
+    assert not g.matches(b'{"name": 3, "age": 3, "tags": [], "ok": true}')
+    assert not g.matches(b'{"age": 3, "name": "bo", "tags": [], "ok": true}')
+    assert not g.matches(b'{"name": "bo"}')
+
+
+def test_schema_enum_and_const():
+    tok = ByteTokenizer()
+    g = G.compile_json_schema(
+        {"enum": ["red", "green", 3, True, None]}, tok
+    )
+    for ok in [b'"red"', b'"green"', b"3", b"true", b"null"]:
+        assert g.matches(ok), ok
+    for bad in [b'"blue"', b"4", b"false"]:
+        assert not g.matches(bad), bad
+
+
+def test_schema_optional_properties():
+    tok = ByteTokenizer()
+    schema = {
+        "type": "object",
+        "properties": {"a": {"type": "integer"}, "b": {"type": "boolean"}},
+        "required": ["a"],
+    }
+    g = G.compile_json_schema(schema, tok)
+    assert g.matches(b'{"a": 1, "b": true}')
+    assert g.matches(b'{"a": 1}')
+    assert not g.matches(b'{"b": true}')
+
+
+def test_schema_rejects_open_schemas():
+    tok = ByteTokenizer()
+    with pytest.raises(ValueError):
+        G.compile_json_schema({"type": "object"}, tok)
+    with pytest.raises(ValueError):
+        G.compile_json_schema({"type": "array"}, tok)
+    with pytest.raises(ValueError):  # unsatisfiable bounds
+        G.compile_json_schema(
+            {"type": "array", "items": {"type": "integer"},
+             "minItems": 3, "maxItems": 2}, tok,
+        )
+
+
+def test_token_strings_byte_level_bpe_partial_utf8():
+    """GPT-2-style byte-level BPE vocab strings map back to EXACT bytes,
+    including tokens that are partial UTF-8 sequences."""
+    b2u = {b: u for u, b in G._gpt2_unicode_to_byte().items()}
+
+    class FakeInner:
+        all_special_ids = [0, 1, 2, 9]
+
+        def convert_ids_to_tokens(self, i):
+            # token 3: the lone byte 0xC3 (first half of 'é') — decode()
+            # would mangle this to U+FFFD
+            return {3: b2u[0xC3], 4: b2u[0xA9], 5: "".join(b2u[b] for b in b"hi"),
+                    9: "<unk>"}.get(i)
+
+    class FakeTok:
+        vocab_size = 10
+        pad_id, bos_id, eos_id = 0, 1, 2
+        _tok = FakeInner()
+
+        def decode(self, ids):
+            return "�"
+
+    toks = G.token_strings(FakeTok())
+    assert toks[3] == b"\xc3"
+    assert toks[4] == b"\xa9"
+    assert toks[5] == b"hi"
+    assert toks[9] == b""  # special beyond pad/bos/eos excluded too
+    # and the partial pair composes: walking both halves matches 'é'
+    g_next, g_acc = None, None
+    ast = G._Parser("é").parse()
+    nfa = G._NFA()
+    s, a = nfa.frag(ast)
+    g_next, g_acc = G._nfa_to_dfa(nfa, s, a, 100)
+    st = int(g_next[0, 0xC3])
+    assert st >= 0
+    st = int(g_next[st, 0xA9])
+    assert st >= 0 and g_acc[st]
+
+
+def test_token_strings_sentencepiece_marker():
+    class FakeInner:
+        all_special_ids = [0]
+
+        def convert_ids_to_tokens(self, i):
+            return {3: "▁hello", 4: "world"}.get(i)
+
+    class FakeTok:
+        vocab_size = 5
+        pad_id, bos_id, eos_id = 0, 1, 2
+        _tok = FakeInner()
+
+        def decode(self, ids):
+            raise AssertionError("should not fall back")
+
+    toks = G.token_strings(FakeTok())
+    assert toks[3] == b" hello"
+    assert toks[4] == b"world"
+
+
+# ---------------------------------------------------------------------------
+# Token tables.
+# ---------------------------------------------------------------------------
+
+def test_token_table_byte_tokenizer_exact():
+    """With 1-byte tokens, the token table IS the byte DFA (shifted)."""
+    tok = ByteTokenizer()
+    g = G.compile_regex(r"ab+", tok)
+    a, b = tok.encode("a")[0], tok.encode("b")[0]
+    s0 = 0
+    s1 = int(g.token_next[s0, a])
+    assert s1 >= 0
+    assert g.token_next[s0, b] == -1  # can't start with b
+    s2 = int(g.token_next[s1, b])
+    assert s2 >= 0 and g.accept[s2]
+    assert g.token_next[s1, a] == -1
+    # EOS allowed exactly in accepting states
+    assert g.token_next[s2, tok.eos_id] >= 0
+    assert g.token_next[s0, tok.eos_id] == -1
+    assert g.token_next[s1, tok.eos_id] == -1
+    # specials (pad/bos) never allowed
+    assert (g.token_next[:, tok.pad_id] == -1).all()
+    assert (g.token_next[:, tok.bos_id] == -1).all()
+
+
+def test_token_table_multibyte_tokens():
+    """A fake tokenizer with multi-byte tokens walks whole strings."""
+
+    class WordTok:
+        vocab_size = 6
+        pad_id, bos_id, eos_id = 0, 1, 2
+
+        def encode(self, text):
+            raise NotImplementedError
+
+        def decode(self, ids):
+            return "".join({3: "ab", 4: "cd", 5: "x"}.get(i, "") for i in ids)
+
+    tok = WordTok()
+    g = G.compile_regex(r"(ab)*cd", tok)
+    s = 0
+    s = int(g.token_next[s, 3])  # "ab"
+    assert s >= 0
+    assert g.token_next[s, 5] == -1  # "x" never fits
+    s = int(g.token_next[s, 4])  # "cd" -> accept
+    assert s >= 0 and g.accept[s] and g.token_next[s, tok.eos_id] >= 0
+
+
+def test_token_table_native_matches_numpy():
+    from ditl_tpu.native import fsm as native_fsm
+
+    if not native_fsm.available():
+        pytest.skip("no C++ toolchain")
+    tok = ByteTokenizer()
+    for pattern in [r"[a-z]+[0-9]{2}", r"(foo|bar)+", r'"[^"]*"']:
+        ast = G._Parser(pattern).parse()
+        nfa = G._NFA()
+        s, a = nfa.frag(ast)
+        byte_next, accept = G._nfa_to_dfa(nfa, s, a, 20_000)
+        toks = G.token_strings(tok)
+        native = native_fsm.token_table_native(byte_next, toks)
+        assert native is not None
+        # numpy reference walk
+        S, V = byte_next.shape[0], len(toks)
+        ref = np.empty((S, V), np.int32)
+        for st in range(S):
+            for v, tb in enumerate(toks):
+                cur = st
+                for byte in tb:
+                    cur = int(byte_next[cur, byte])
+                    if cur < 0:
+                        break
+                ref[st, v] = cur if tb else -1
+        np.testing.assert_array_equal(native, ref)
+
+
+def test_numpy_fallback_walk(monkeypatch):
+    """Force the numpy path and check it against the native/simple walk."""
+    import ditl_tpu.native.fsm as native_fsm
+
+    monkeypatch.setattr(native_fsm, "token_table_native", lambda *a: None)
+    tok = ByteTokenizer()
+    g = G.compile_regex(r"ab|ba", tok)
+    a, b = tok.encode("a")[0], tok.encode("b")[0]
+    assert g.token_next[0, a] >= 0 and g.token_next[0, b] >= 0
+    s_ab = int(g.token_next[int(g.token_next[0, a]), b])
+    assert s_ab >= 0 and g.accept[s_ab]
+
+
+def test_compiled_grammar_json_mode():
+    tok = ByteTokenizer()
+    g = G.compile_json(tok, max_depth=3)
+    assert g.matches(b'{"k": [1, 2]}')
+    assert not g.matches(b"[1]")  # top=object
+    gv = G.compile_json(tok, top="value", max_depth=3)
+    assert gv.matches(b"[1]")
