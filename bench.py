@@ -199,7 +199,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 slots: int = 8, decode_chunk: int = 16,
                 page_size: int = 256, moe: bool = False,
                 prompt_len: int = 0, max_new: int = 0,
-                temperature: float = 0.0) -> int:
+                temperature: float = 0.0, guided: str = "") -> int:
     """Decode/serving benchmark — one JSON line. Every serving claim in
     BASELINE.md is reproducible from here: ``--engine continuous`` ticks the
     production slot engine (``--cache paged`` for the page pool + Pallas
@@ -280,6 +280,18 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         from ditl_tpu.infer.continuous import ContinuousEngine
         from ditl_tpu.infer.engine import GenerateConfig
 
+        grammar = None
+        if guided:
+            # "--guided json" = the json_object grammar; anything else is a
+            # regex. "--guided '(.|\n)*'" is the all-permissive grammar —
+            # its mask is a no-op on every token, so the A/B against the
+            # same command without --guided isolates the FSM machinery's
+            # own cost (one table-row gather + where per step).
+            from ditl_tpu.infer import grammar as gmod
+
+            grammar = (gmod.compile_json(tok) if guided == "json"
+                       else gmod.compile_regex(guided, tok))
+
         def make_engine():
             return ContinuousEngine(
                 params, cfg, tok, n_slots=slots, decode_chunk=decode_chunk,
@@ -289,12 +301,14 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 # The bench measures the speculative path itself; the
                 # auto-decision's own probing is pinned by tests.
                 spec_threshold=0.0 if speculative else None,
+                fsm_capacity=(grammar.n_states + 2) if grammar else 0,
             )
 
         def run_once(eng):
             for i, p in enumerate(prompts):
                 eng.submit(list(p), max_new_tokens=max_new,
-                           temperature=temperature, seed=i)
+                           temperature=temperature, seed=i,
+                           grammar=grammar)
             out = eng.run()
             return sum(len(v) for v in out.values())
 
@@ -321,6 +335,8 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             times.append(time.perf_counter() - t)
         dt = statistics.median(times)
         extra = {}
+        if guided:
+            extra["guided"] = guided
         if speculative:
             st = eng.stats()["speculative"]
             extra["spec_acceptance"] = (
@@ -334,6 +350,11 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             raise SystemExit(
                 "--speculative with --engine lockstep: use the continuous "
                 "engine (or infer/speculative.SpeculativeGenerator directly)"
+            )
+        if guided:
+            raise SystemExit(
+                "--guided requires --engine continuous (the FSM mask rides "
+                "the slot scheduler's decode ticks)"
             )
         gen = GenerateConfig(max_new_tokens=max_new,
                              temperature=0.0 if workload == "repetitive" else 1.0,
@@ -524,11 +545,17 @@ if __name__ == "__main__":
                         help="sampling temperature for --infer continuous "
                         "(0 = greedy; >0 with --speculative measures the "
                         "rejection-sampling path)")
+    parser.add_argument("--guided", default="",
+                        help="grammar-constrained decoding (--infer --engine "
+                        "continuous): 'json' = the json_object grammar, "
+                        "anything else = a regex; \"(.|\\n)*\" measures the "
+                        "FSM machinery's overhead against the same command "
+                        "without --guided")
     args = parser.parse_args()
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"
                   or args.infer_workload != "random" or args.moe
-                  or args.prompt_len or args.max_new)
+                  or args.prompt_len or args.max_new or args.guided)
     if infer_only and not args.infer:
         parser.error("serving flags require --infer")
     if args.infer:
@@ -540,6 +567,6 @@ if __name__ == "__main__":
             slots=args.slots, decode_chunk=args.decode_chunk,
             page_size=args.page_size, moe=args.moe,
             prompt_len=args.prompt_len, max_new=args.max_new,
-            temperature=args.temperature,
+            temperature=args.temperature, guided=args.guided,
         ))
     sys.exit(main(args.model))

@@ -90,6 +90,21 @@ def _lp_stats(step_logits: jax.Array, tok: jax.Array, k: int):
     return chosen, top_id.astype(jnp.int32), top_lp
 
 
+def _fsm_mask(ftab: jax.Array, fstate: jax.Array, logits: jax.Array) -> jax.Array:
+    """Grammar mask: disallowed tokens (table entry < 0) to -inf. One row
+    gather per call; FREE/DEAD rows are all-allowed, so unconstrained rows
+    pass through bit-identically."""
+    return jnp.where(ftab[fstate] >= 0, logits, -jnp.inf)
+
+
+def _fsm_next(ftab: jax.Array, fstate: jax.Array, tok: jax.Array) -> jax.Array:
+    """Advance FSM state(s) on sampled token(s); a disallowed transition
+    (only reachable via discarded speculative positions or finished rows)
+    clamps to the DEAD trap row 1."""
+    nxt = ftab[fstate, tok]
+    return jnp.where(nxt >= 0, nxt, 1)
+
+
 def _flush_tail_into_pools(pools, tk, tv, starts, pos, table, ps, tail_len):
     """Scatter the tick's tail columns into their pages — ONE scatter per
     pool per tick (amortized over the chunk; per-token in-scan page writes
@@ -183,6 +198,9 @@ class Request:
     logprobs: int | None = None
     # Multi-LoRA: adapter slot in the stacked params tree (0 = base).
     adapter_id: int = 0
+    # Guided decoding: absolute start state in the engine's FSM table
+    # (0 = FREE row = unconstrained).
+    fsm_start: int = 0
     lp_token: list[float] = field(default_factory=list)
     lp_top_ids: list[list[int]] = field(default_factory=list)
     lp_top: list[list[float]] = field(default_factory=list)
@@ -218,6 +236,7 @@ class ContinuousEngine:
         spec_probe_every: int = 32,
         spec_ema: float = 0.7,
         logprobs_k: int = 0,
+        fsm_capacity: int = 0,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -506,12 +525,51 @@ class ContinuousEngine:
             self.lp_ids = jnp.zeros((n_slots, logprobs_k), jnp.int32)
             self.lp_top = jnp.zeros((n_slots, logprobs_k), jnp.float32)
 
+        # -- grammar-constrained decoding (infer/grammar.py) -------------
+        # ``fsm_capacity > 0`` arms guided decoding: a device-resident
+        # (capacity, vocab) transition table holds every registered
+        # grammar's token-level DFA; each slot carries one int32 FSM state.
+        # Every sample site then costs ONE row gather + a ``where`` mask,
+        # and the transition is one scalar gather — no host round-trips,
+        # and unconstrained rows ride the FREE row (all-allowed identity,
+        # so their sampled tokens are bit-identical to a guided-off
+        # engine). Row conventions: table[s, t] >= 0 = allowed, value =
+        # next state; -1 = masked (transition clamps to DEAD). Row 0 =
+        # FREE (everything allowed, parks), row 1 = DEAD (permissive
+        # trap — reached only by finished rows and discarded speculative
+        # positions, and deliberately all-allowed so a masked row can
+        # never be all -inf, which would NaN the sampling softmax).
+        if fsm_capacity < 0:
+            raise ValueError(f"fsm_capacity must be >= 0, got {fsm_capacity}")
+        self.fsm_capacity = fsm_capacity
+        self.guided = fsm_capacity > 0
+        if self.guided:
+            if fsm_capacity < 2:
+                raise ValueError("fsm_capacity must be >= 2 (FREE + DEAD rows)")
+            import threading as _threading
+
+            v = model_cfg.vocab_size
+            self._fsm_host = np.full((fsm_capacity, v), -1, np.int32)
+            self._fsm_host[0, :] = 0  # FREE
+            self._fsm_host[1, :] = 1  # DEAD
+            self._fsm_used = 2
+            self._fsm_dirty = True
+            self._fsm_dev: Any = None
+            self._grammars: dict[str, int] = {}
+            # Registration may come from HTTP handler threads while the
+            # driver thread is mid-tick (ThreadedEngine): the lock pairs
+            # every host-table mutation with the dirty-check-and-upload so
+            # a tick can never capture a half-installed grammar.
+            self._fsm_lock = _threading.Lock()
+            self.fstates = jnp.zeros((n_slots,), jnp.int32)
+
     # -- compiled programs --------------------------------------------------
 
     def _build_prefill(self, p_bucket: int):
         cfg, smax = self.cfg, self.smax
 
-        def run(params, cache, ids, length, slot, temp, top_p, rng, aid):
+        def run(params, cache, ids, length, slot, temp, top_p, rng, aid,
+                *fsm):
             # 1-row view of the shared cache: prefill never touches other slots.
             row = jax.tree.map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), cache
@@ -539,14 +597,16 @@ class ContinuousEngine:
                 row,
             )
             last = logits[0, length - 1]
+            masked = _fsm_mask(fsm[0], fsm[1], last) if self.guided else last
             first = sample_logits(
-                last[None], rng, temperature=temp,
+                masked[None], rng, temperature=temp,
                 top_k=self.gen.top_k, top_p=top_p,
             )[0]
+            fs = (_fsm_next(fsm[0], fsm[1], first),) if self.guided else ()
             if self.logprobs_k:
                 c, i, t = _lp_stats(last[None], first[None], self.logprobs_k)
-                return cache, first, c[0], i[0], t[0]
-            return cache, first
+                return (cache, first, c[0], i[0], t[0], *fs)
+            return (cache, first, *fs)
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -562,10 +622,15 @@ class ContinuousEngine:
         track = self.speculative
         n_lp = self.logprobs_k
 
+        guided = self.guided
+
         def run(params, cache, cur, pos, alive, temps, top_ps, keys, hist,
-                adapters, *lp0):
+                adapters, *extra):
+            ftab, fstates = (extra[0], extra[1]) if guided else (None, None)
+            lp0 = extra[2:] if guided else extra
+
             def body(carry, _):
-                cache, cur, pos, done, keys, hist, lp = carry
+                cache, cur, pos, done, keys, hist, fst, lp = carry
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
                 mask = (slots_iota[None, :] <= pos[:, None])[:, None, :]  # (B,1,Smax)
@@ -581,8 +646,10 @@ class ContinuousEngine:
                     rules=self.rules,
                     adapter_ids=adapters if self.multi_lora else None,
                 )
+                step_logits = logits[:, 0]
                 nxt = sample_logits(
-                    logits[:, 0], subs,
+                    _fsm_mask(ftab, fst, step_logits) if guided else step_logits,
+                    subs,
                     temperature=temps if sampled else 0.0,
                     top_k=self.gen.top_k,
                     top_p=top_ps if topp else 1.0,
@@ -594,8 +661,12 @@ class ContinuousEngine:
                 # pending slot is refilled with ``nxt``'s stats.
                 ys = (emit, *lp) if n_lp else emit
                 if n_lp:
-                    lp = _lp_stats(logits[:, 0], nxt, n_lp)
+                    lp = _lp_stats(step_logits, nxt, n_lp)
                 done = done | (cur == eos)
+                if guided:
+                    # ``nxt`` is real only for rows still live after the
+                    # EOS check — mirror the ``cur`` update exactly.
+                    fst = jnp.where(done, fst, _fsm_next(ftab, fst, nxt))
                 pos = jnp.where(step_alive, jnp.minimum(pos + 1, smax - 1), pos)
                 cur = jnp.where(done, pad, nxt)
                 if track:
@@ -603,19 +674,34 @@ class ContinuousEngine:
 
                     grow = (~done).astype(jnp.int32)
                     hist = _emit_rows(hist, cur[:, None], pos, grow)
-                return (cache, cur, pos, done, keys, hist, lp), ys
+                return (cache, cur, pos, done, keys, hist, fst, lp), ys
 
-            (cache, cur, pos, done, keys, hist, lp), ys = jax.lax.scan(
-                body, (cache, cur, pos, ~alive, keys, hist, tuple(lp0)),
+            fst0 = fstates if guided else jnp.zeros((), jnp.int32)
+            (cache, cur, pos, done, keys, hist, fst, lp), ys = jax.lax.scan(
+                body, (cache, cur, pos, ~alive, keys, hist, fst0, tuple(lp0)),
                 None, length=chunk,
             )
+            fs = (fst,) if guided else ()
             if n_lp:
                 toks, c, i, t = ys
-                return (cache, cur, pos, keys, hist, lp, toks.T,
+                return (cache, cur, pos, keys, hist, *fs, lp, toks.T,
                         c.T, jnp.swapaxes(i, 0, 1), jnp.swapaxes(t, 0, 1))
-            return cache, cur, pos, keys, hist, ys.T  # ys: (chunk, B)
+            return (cache, cur, pos, keys, hist, *fs, ys.T)  # ys: (chunk, B)
 
         return jax.jit(run, donate_argnums=(1,))
+
+    def _fsm_spec_path(self, ftab, fstates, draft):
+        """Grammar states along the speculative draft path: ``path[:, 0]``
+        is the row's current state, ``path[:, j+1]`` the state after
+        consuming ``draft[:, j]``. A disallowed draft token clamps to the
+        DEAD trap — its own position was already masked -inf under the
+        PRE-transition state, so acceptance rejects there and every
+        DEAD-masked later position is discarded; k is small (static), so
+        the walk unrolls into k scalar-gather steps."""
+        states = [fstates]
+        for j in range(draft.shape[1]):
+            states.append(_fsm_next(ftab, states[-1], draft[:, j]))
+        return jnp.stack(states, axis=1)  # (B, k+1)
 
     def _spec_accept(self, logits, tokens_in, subs, temps, top_ps,
                      sampled: bool):
@@ -688,8 +774,12 @@ class ContinuousEngine:
 
         n_lp = self.logprobs_k
 
+        guided = self.guided
+
         def run(params, cache, cur, pos, alive, hist, temps, top_ps, keys,
-                adapters, *lp0):
+                adapters, *extra):
+            ftab, fstates = (extra[0], extra[1]) if guided else (None, None)
+            lp0 = extra[2:] if guided else extra
             n_b = pos.shape[0]
             out0 = jnp.full((n_b, out_len), pad, jnp.int32)
             zeros = jnp.zeros((n_b,), jnp.int32)
@@ -701,7 +791,7 @@ class ContinuousEngine:
             )
 
             def body(carry, _):
-                (cache, cur, pos, done, hist, out, n_out, rr, keys, lp,
+                (cache, cur, pos, done, hist, out, n_out, rr, keys, fst, lp,
                  bufs) = carry
                 live = ~done
                 split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
@@ -720,8 +810,18 @@ class ContinuousEngine:
                     mesh=self.mesh, rules=self.rules,
                     adapter_ids=adapters if self.multi_lora else None,
                 )
+                if guided:
+                    # Mask every verify position under its path state: a
+                    # disallowed draft token rejects at its own position
+                    # (p=0 / argmax mismatch), so constrained rows accept
+                    # only grammar-legal prefixes — and the bonus token is
+                    # sampled under the post-acceptance state's mask.
+                    path = self._fsm_spec_path(ftab, fst, draft)
+                    ver_logits = _fsm_mask(ftab, path, logits)
+                else:
+                    ver_logits = logits
                 n_acc, nxt_tok = self._spec_accept(
-                    logits, tokens_in, subs, temps, top_ps, sampled
+                    ver_logits, tokens_in, subs, temps, top_ps, sampled
                 )
                 # Emission sequence: [cur, accepted drafts...] — index j
                 # emits the token at global position pos + j. The pending
@@ -759,19 +859,25 @@ class ContinuousEngine:
                     live, jnp.minimum(pos + e, smax - 1), pos
                 )
                 done = done | hit_term
+                if guided:
+                    s_at = jnp.take_along_axis(path, n_acc[:, None], 1)[:, 0]
+                    fst = jnp.where(done, fst, _fsm_next(ftab, s_at, nxt_tok))
                 cur = jnp.where(done, pad, nxt_tok)
                 rr = rr + live.astype(jnp.int32)
                 return (cache, cur, pos, done, hist, out, n_out, rr, keys,
-                        lp, bufs), None
+                        fst, lp, bufs), None
 
-            (cache, cur, pos, done, hist, out, n_out, rr, keys, lp,
+            fst0 = fstates if guided else jnp.zeros((), jnp.int32)
+            (cache, cur, pos, done, hist, out, n_out, rr, keys, fst, lp,
              bufs), _ = jax.lax.scan(
                 body,
                 (cache, cur, pos, ~alive, hist, out0, zeros, zeros, keys,
-                 tuple(lp0), bufs0),
+                 fst0, tuple(lp0), bufs0),
                 None, length=rounds,
             )
-            return cache, cur, pos, hist, keys, out, n_out, rr, lp, bufs
+            fs = (fst,) if guided else ()
+            return (cache, cur, pos, hist, keys, *fs, out, n_out, rr, lp,
+                    bufs)
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -823,7 +929,7 @@ class ContinuousEngine:
         slots_iota = jnp.arange(smax, dtype=jnp.int32)
 
         def run(params, cache, ids, offset, s_len, slot, temp, top_p, rng,
-                aid):
+                aid, *fsm):
             row = jax.tree.map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1), cache
             )
@@ -841,14 +947,16 @@ class ContinuousEngine:
                 row,
             )
             last = logits[0, s_len - 1]
+            masked = _fsm_mask(fsm[0], fsm[1], last) if self.guided else last
             first = sample_logits(
-                last[None], rng, temperature=temp, top_k=self.gen.top_k,
+                masked[None], rng, temperature=temp, top_k=self.gen.top_k,
                 top_p=top_p,
             )[0]
+            fs = (_fsm_next(fsm[0], fsm[1], first),) if self.guided else ()
             if self.logprobs_k:
                 c, i, t = _lp_stats(last[None], first[None], self.logprobs_k)
-                return cache, first, c[0], i[0], t[0]
-            return cache, first
+                return (cache, first, c[0], i[0], t[0], *fs)
+            return (cache, first, *fs)
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -879,7 +987,7 @@ class ContinuousEngine:
         quantized = cfg.kv_cache_dtype == "int8"
 
         def run(params, pools, table_row, ids, offset, s_len, temp, top_p,
-                rng, write_pids, aid):
+                rng, write_pids, aid, *fsm):
             kp, vp = pools["kp"], pools["vp"]
             L, _, K, _, D = kp.shape
 
@@ -949,14 +1057,16 @@ class ContinuousEngine:
                         )
                     out[name] = pool
             last = logits[0, s_len - 1]
+            masked = _fsm_mask(fsm[0], fsm[1], last) if self.guided else last
             first = sample_logits(
-                last[None], rng, temperature=temp, top_k=self.gen.top_k,
+                masked[None], rng, temperature=temp, top_k=self.gen.top_k,
                 top_p=top_p,
             )[0]
+            fs = (_fsm_next(fsm[0], fsm[1], first),) if self.guided else ()
             if self.logprobs_k:
                 c, i, t = _lp_stats(last[None], first[None], self.logprobs_k)
-                return out, first, c[0], i[0], t[0]
-            return out, first
+                return (out, first, c[0], i[0], t[0], *fs)
+            return (out, first, *fs)
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -978,8 +1088,12 @@ class ContinuousEngine:
         track = self.speculative
         n_lp = self.logprobs_k
 
+        guided = self.guided
+
         def run(params, pools, cur, pos, alive, temps, top_ps, keys, table,
-                limits, hist, adapters, *lp0):
+                limits, hist, adapters, *extra):
+            ftab, fstates = (extra[0], extra[1]) if guided else (None, None)
+            lp0 = extra[2:] if guided else extra
             n_b = pos.shape[0]
             # starts = pos (not where(alive, pos, 0)): dead rows then have
             # pos - starts == 0 live tail columns, so the flush writes
@@ -991,7 +1105,7 @@ class ContinuousEngine:
             cache_const = dict(pools)  # pools are read-only during the scan
 
             def body(carry, t):
-                tk, tv, cur, pos, done, keys, hist, lp = carry
+                tk, tv, cur, pos, done, keys, hist, fst, lp = carry
                 split = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
                 done = done | (pos >= limits)
@@ -1013,8 +1127,10 @@ class ContinuousEngine:
                     adapter_ids=adapters if self.multi_lora else None,
                 )
                 tk, tv = tails["tk"], tails["tv"]
+                step_logits = logits[:, 0]
                 nxt = sample_logits(
-                    logits[:, 0], subs,
+                    _fsm_mask(ftab, fst, step_logits) if guided else step_logits,
+                    subs,
                     temperature=temps if sampled else 0.0,
                     top_k=self.gen.top_k,
                     top_p=top_ps if topp else 1.0,
@@ -1024,8 +1140,10 @@ class ContinuousEngine:
                 # the pending slot then refills with ``nxt``'s stats.
                 ys = (emit, *lp) if n_lp else emit
                 if n_lp:
-                    lp = _lp_stats(logits[:, 0], nxt, n_lp)
+                    lp = _lp_stats(step_logits, nxt, n_lp)
                 done = done | (cur == eos)
+                if guided:
+                    fst = jnp.where(done, fst, _fsm_next(ftab, fst, nxt))
                 pos = jnp.where(step_alive, pos + 1, pos)
                 cur = jnp.where(done, pad, nxt)
                 if track:
@@ -1033,21 +1151,24 @@ class ContinuousEngine:
 
                     grow = (~done).astype(jnp.int32)
                     hist = _emit_rows(hist, cur[:, None], pos, grow)
-                return (tk, tv, cur, pos, done, keys, hist, lp), ys
+                return (tk, tv, cur, pos, done, keys, hist, fst, lp), ys
 
-            (tk, tv, cur, pos, done, keys, hist, lp), ys = jax.lax.scan(
-                body, (tk0, tv0, cur, pos, ~alive, keys, hist, tuple(lp0)),
+            fst0 = fstates if guided else jnp.zeros((), jnp.int32)
+            (tk, tv, cur, pos, done, keys, hist, fst, lp), ys = jax.lax.scan(
+                body, (tk0, tv0, cur, pos, ~alive, keys, hist, fst0,
+                       tuple(lp0)),
                 jnp.arange(chunk, dtype=jnp.int32),
             )
 
             out = _flush_tail_into_pools(
                 pools, tk, tv, starts, pos, table, ps, tail_len
             )
+            fs = (fst,) if guided else ()
             if n_lp:
                 toks, c, i, t = ys
-                return (out, cur, pos, keys, hist, lp, toks.T,
+                return (out, cur, pos, keys, hist, *fs, lp, toks.T,
                         c.T, jnp.swapaxes(i, 0, 1), jnp.swapaxes(t, 0, 1))
-            return out, cur, pos, keys, hist, ys.T
+            return (out, cur, pos, keys, hist, *fs, ys.T)
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -1076,8 +1197,12 @@ class ContinuousEngine:
 
         n_lp = self.logprobs_k
 
+        guided = self.guided
+
         def run(params, pools, cur, pos, alive, table, limits, hist, temps,
-                top_ps, keys, adapters, *lp0):
+                top_ps, keys, adapters, *extra):
+            ftab, fstates = (extra[0], extra[1]) if guided else (None, None)
+            lp0 = extra[2:] if guided else extra
             n_b = pos.shape[0]
             starts = pos
             tk0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
@@ -1093,8 +1218,8 @@ class ContinuousEngine:
             )
 
             def body(carry, _):
-                (tk, tv, cur, pos, done, hist, out, n_out, rr, keys, lp,
-                 bufs) = carry
+                (tk, tv, cur, pos, done, hist, out, n_out, rr, keys, fst,
+                 lp, bufs) = carry
                 done = done | (pos >= limits)
                 live = ~done
                 split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
@@ -1117,8 +1242,14 @@ class ContinuousEngine:
                     adapter_ids=adapters if self.multi_lora else None,
                 )
                 tk, tv = tails["tk"], tails["tv"]
+                if guided:
+                    # See _build_spec_decode: per-position path-state masks.
+                    path = self._fsm_spec_path(ftab, fst, draft)
+                    ver_logits = _fsm_mask(ftab, path, logits)
+                else:
+                    ver_logits = logits
                 n_acc, nxt_tok = self._spec_accept(
-                    logits, tokens_in, subs, temps, top_ps, sampled
+                    ver_logits, tokens_in, subs, temps, top_ps, sampled
                 )
                 in_span = q_idx[None, :] <= n_acc[:, None]
                 is_term = (tokens_in == eos) | (tokens_in == pad)
@@ -1147,22 +1278,28 @@ class ContinuousEngine:
                 )
                 pos = jnp.where(live, pos + e, pos)
                 done = done | hit_term
+                if guided:
+                    s_at = jnp.take_along_axis(path, n_acc[:, None], 1)[:, 0]
+                    fst = jnp.where(done, fst, _fsm_next(ftab, s_at, nxt_tok))
                 cur = jnp.where(done, pad, nxt_tok)
                 rr = rr + live.astype(jnp.int32)
                 return (tk, tv, cur, pos, done, hist, out, n_out, rr,
-                        keys, lp, bufs), None
+                        keys, fst, lp, bufs), None
 
-            (tk, tv, cur, pos, done, hist, out, n_out, rr, keys, lp,
+            fst0 = fstates if guided else jnp.zeros((), jnp.int32)
+            (tk, tv, cur, pos, done, hist, out, n_out, rr, keys, fst, lp,
              bufs), _ = jax.lax.scan(
                 body,
                 (tk0, tv0, cur, pos, ~alive, hist, out0, zeros, zeros,
-                 keys, tuple(lp0), bufs0),
+                 keys, fst0, tuple(lp0), bufs0),
                 None, length=rounds,
             )
             pools_out = _flush_tail_into_pools(
                 pools, tk, tv, starts, pos, table, ps, tail_len
             )
-            return pools_out, cur, pos, hist, keys, out, n_out, rr, lp, bufs
+            fs = (fst,) if guided else ()
+            return (pools_out, cur, pos, hist, keys, *fs, out, n_out, rr,
+                    lp, bufs)
 
         return jax.jit(run, donate_argnums=(1,))
 
@@ -1286,6 +1423,7 @@ class ContinuousEngine:
         stream: Any = None,
         logprobs: int | None = None,
         adapter_id: int | None = None,
+        grammar: Any = None,
     ) -> int:
         """Queue a request; returns its id (see ``results``/``run``).
         ``stream``: optional ``queue.Queue`` receiving per-chunk token lists
@@ -1293,7 +1431,10 @@ class ContinuousEngine:
         token (None = off; 0 = chosen-token logprob only); requires the
         engine constructed with ``logprobs_k >= N``. ``adapter_id`` selects
         the request's LoRA adapter when params are a multi-adapter stack
-        (0 = base)."""
+        (0 = base). ``grammar`` constrains the COMPLETION (not the prompt)
+        to a compiled grammar — an ``infer.grammar.CompiledGrammar`` (auto-
+        registered) or an int start state from ``register_grammar``;
+        requires the engine constructed with ``fsm_capacity > 0``."""
         gen = self.gen
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             raise QueueFullError(
@@ -1322,6 +1463,22 @@ class ContinuousEngine:
                 raise ValueError(
                     f"logprobs={logprobs} out of range [0, {self.logprobs_k}]"
                 )
+        fsm_start = 0
+        if grammar is not None:
+            if not self.guided:
+                raise ValueError(
+                    "grammar requested but the engine was built with "
+                    "fsm_capacity=0"
+                )
+            if isinstance(grammar, int):
+                if not 0 <= grammar < self._fsm_used:
+                    raise ValueError(
+                        f"grammar start state {grammar} not in the installed "
+                        f"table (rows [0, {self._fsm_used}))"
+                    )
+                fsm_start = grammar
+            else:
+                fsm_start = self.register_grammar(grammar)
         max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
         prompt = prompt_tokens or [self.tokenizer.bos_id]
         self.validate_request(prompt, max_new)
@@ -1335,6 +1492,7 @@ class ContinuousEngine:
             stream=stream,
             logprobs=logprobs,
             adapter_id=adapter_id or 0,
+            fsm_start=fsm_start,
         )
         self._next_id += 1
         self._queue.append(req)
@@ -1396,6 +1554,7 @@ class ContinuousEngine:
                 jnp.int32(len(req.prompt)), jnp.int32(slot),
                 jnp.float32(req.temperature), jnp.float32(req.top_p), rng,
                 jnp.asarray([req.adapter_id], jnp.int32),
+                *self._fsm_args(req.fsm_start),
             ), slot)
         row, last_logits, d = prefix
         p_bucket = row["k"].shape[2]
@@ -1407,27 +1566,35 @@ class ContinuousEngine:
             # Prompt == prefix: first token comes from the stored logits.
             if self._first_sampler is None:
                 n_lp = self.logprobs_k
+                guided = self.guided
 
-                def first_sample(lg, key, t, p):
+                def first_sample(lg, key, t, p, *fsm):
+                    masked = _fsm_mask(fsm[0], fsm[1], lg) if guided else lg
                     first = sample_logits(
-                        lg[None], key, temperature=t,
+                        masked[None], key, temperature=t,
                         top_k=self.gen.top_k, top_p=p,
                     )[0]
+                    fs = (
+                        (_fsm_next(fsm[0], fsm[1], first),) if guided else ()
+                    )
                     if n_lp:
                         c, i, tt = _lp_stats(lg[None], first[None], n_lp)
-                        return first, c[0], i[0], tt[0]
-                    return first
+                        return (first, c[0], i[0], tt[0], *fs)
+                    return (first, *fs) if guided else first
 
                 self._first_sampler = jax.jit(first_sample)
             out = self._first_sampler(
                 last_logits, rng, jnp.float32(req.temperature),
-                jnp.float32(req.top_p),
+                jnp.float32(req.top_p), *self._fsm_args(req.fsm_start),
             )
+            if self.guided:
+                *out, fst = out
+                self.fstates = self.fstates.at[slot].set(fst)
             if self.logprobs_k:
                 first, c, i, t = out
                 self._store_lp(slot, c, i, t)
                 return first
-            return out
+            return out[0] if self.guided else out
         s_bucket = min(_next_pow2(s, floor=16), self.smax - d)
         if s_bucket not in self._suffix_prefill:
             logger.info("compiling suffix prefill for bucket %d", s_bucket)
@@ -1439,6 +1606,7 @@ class ContinuousEngine:
             jnp.int32(s), jnp.int32(slot), jnp.float32(req.temperature),
             jnp.float32(req.top_p), rng,
             jnp.asarray([req.adapter_id], jnp.int32),
+            *self._fsm_args(req.fsm_start),
         ), slot)
 
     def _advance_prefill(self, req: Request) -> None:
@@ -1484,6 +1652,7 @@ class ContinuousEngine:
             jnp.int32(s), jnp.int32(req.slot), jnp.float32(req.temperature),
             jnp.float32(req.top_p), sub,
             jnp.asarray([req.adapter_id], jnp.int32),
+            *self._fsm_args(req.fsm_start),
         ), req.slot)
         req.prefill_pos += s
         if req.prefill_pos >= len(req.prompt):
@@ -1496,7 +1665,14 @@ class ContinuousEngine:
     def _take_prefill(self, out, slot: int | None):
         """Unpack a prefill program's outputs: store the new cache and —
         when logprobs are armed — the first token's pending stats for
-        ``slot`` (``None``: discard, e.g. page warming); return ``first``."""
+        ``slot`` (``None``: discard, e.g. page warming); return ``first``.
+        Guided engines also carry the post-first-token FSM state; like the
+        pending logprob stats, a chunked prefill's intermediate stores are
+        junk that the final chunk overwrites before the slot goes live."""
+        if self.guided:
+            *out, fst = out
+            if slot is not None:
+                self.fstates = self.fstates.at[slot].set(fst)
         if self.logprobs_k:
             self.cache, first, c, i, t = out
             if slot is not None:
@@ -1504,6 +1680,13 @@ class ContinuousEngine:
         else:
             self.cache, first = out
         return first
+
+    def _fsm_args(self, fsm_start: int) -> tuple:
+        """Per-call FSM program arguments (device table + start state), or
+        () on unguided engines — splatted after the fixed prefill args."""
+        if not self.guided:
+            return ()
+        return (self._fsm_device(), jnp.int32(fsm_start))
 
     def _store_lp(self, slot: int, c, i, t) -> None:
         self.lp_chosen = self.lp_chosen.at[slot].set(c)
@@ -1567,7 +1750,8 @@ class ContinuousEngine:
 
     def _run_paged_prefill(self, tokens, d: int, s: int, s_bucket: int,
                            ctx_row, write_pids, temp: float, top_p: float,
-                           rng, slot: int | None = None, adapter: int = 0):
+                           rng, slot: int | None = None, adapter: int = 0,
+                           fsm_start: int = 0):
         """Compile-on-miss + call of the (s_bucket, ctx_pages) prefill
         program — the one shared path for slot prefills and page warming."""
         ps, maxp = self.page_size, self.maxp
@@ -1597,6 +1781,7 @@ class ContinuousEngine:
             jnp.asarray(row), jnp.asarray(ids), jnp.int32(d),
             jnp.int32(s), jnp.float32(temp), jnp.float32(top_p), rng,
             jnp.asarray(pids), jnp.asarray([adapter], jnp.int32),
+            *self._fsm_args(fsm_start),
         ), slot)
 
     def _paged_prefill_chunk(self, req: Request, slot: int, d: int, s: int,
@@ -1608,7 +1793,7 @@ class ContinuousEngine:
             ctx_row=self._table[slot],
             write_pids=self._table[slot, d // ps:],
             temp=req.temperature, top_p=req.top_p, rng=rng, slot=slot,
-            adapter=req.adapter_id,
+            adapter=req.adapter_id, fsm_start=req.fsm_start,
         )
 
     def _admit_paged_slot(self, slot: int) -> bool:
@@ -1769,6 +1954,59 @@ class ContinuousEngine:
             self._table_dirty = False
         return self._table_dev
 
+    # -- guided decoding -----------------------------------------------------
+
+    def register_grammar(self, g) -> int:
+        """Install a compiled grammar (infer/grammar.CompiledGrammar) into
+        the engine's device transition table; returns the grammar's START
+        state — pass it (or the CompiledGrammar itself) as ``submit``'s
+        ``grammar=``. Registration is content-deduplicated, so serving
+        layers can call this per-request; the table row budget
+        (``fsm_capacity``) is a hard cap — registration raises when a new
+        grammar would not fit."""
+        import hashlib
+
+        if not self.guided:
+            raise ValueError(
+                "engine built with fsm_capacity=0; construct with "
+                "fsm_capacity >= grammar states + 2 to serve guided requests"
+            )
+        tn = np.ascontiguousarray(g.token_next, np.int32)
+        digest = hashlib.sha1(tn.tobytes()).hexdigest()
+        with self._fsm_lock:
+            if digest in self._grammars:
+                return self._grammars[digest]
+            s, vt = tn.shape
+            v = self._fsm_host.shape[1]
+            if vt > v:
+                raise ValueError(
+                    f"grammar table vocab {vt} exceeds the model head width {v}"
+                )
+            if self._fsm_used + s > self.fsm_capacity:
+                raise ValueError(
+                    f"fsm_capacity exhausted: {self._fsm_used} rows used + "
+                    f"{s} needed > {self.fsm_capacity}"
+                )
+            base = self._fsm_used
+            block = np.full((s, v), -1, np.int32)
+            block[:, :vt] = np.where(tn >= 0, tn + base, -1)
+            self._fsm_host[base : base + s] = block
+            self._fsm_used += s
+            self._fsm_dirty = True
+            self._grammars[digest] = base
+        logger.info(
+            "registered grammar %s: %d states at rows [%d, %d)",
+            getattr(g, "source", "?"), s, base, base + s,
+        )
+        return base
+
+    def _fsm_device(self):
+        with self._fsm_lock:
+            if self._fsm_dirty:
+                self._fsm_dev = jnp.asarray(self._fsm_host)
+                self._fsm_dirty = False
+            return self._fsm_dev
+
     @property
     def spec_threshold(self) -> float:
         """Breakeven tokens-per-verify-forward for a spec tick to win.
@@ -1845,21 +2083,29 @@ class ContinuousEngine:
             (self.lp_chosen, self.lp_ids, self.lp_top)
             if self.logprobs_k else ()
         )
+        fsm_args = (
+            (self._fsm_device(), self.fstates) if self.guided else ()
+        )
         t0 = _time.perf_counter()
         if paged:
-            (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
-             counts, rr, lp_state, lp_bufs) = self._spec_decode[key](
+            res = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self._table_device(), self.limits, self.hist,
-                self.temps, self.top_ps, self.keys, self.adapters, *lp_args,
+                self.temps, self.top_ps, self.keys, self.adapters,
+                *fsm_args, *lp_args,
             )
         else:
-            (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
-             counts, rr, lp_state, lp_bufs) = self._spec_decode[key](
+            res = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self.hist, self.temps, self.top_ps, self.keys, self.adapters,
-                *lp_args,
+                *fsm_args, *lp_args,
             )
+        if self.guided:
+            (self.cache, self.cur, self.pos, self.hist, self.keys,
+             self.fstates, toks, counts, rr, lp_state, lp_bufs) = res
+        else:
+            (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
+             counts, rr, lp_state, lp_bufs) = res
         # ONE device_get for every host-consumed output: each separate fetch
         # is a full round trip on remote-device transports (~100 ms here) —
         # three sequential fetches per tick erased the speculative win.
@@ -1918,6 +2164,9 @@ class ContinuousEngine:
             (self.lp_chosen, self.lp_ids, self.lp_top)
             if self.logprobs_k else ()
         )
+        fsm_args = (
+            (self._fsm_device(), self.fstates) if self.guided else ()
+        )
         import time as _time
 
         t0 = _time.perf_counter()
@@ -1928,7 +2177,7 @@ class ContinuousEngine:
                 self.params, self.cache, self.cur,
                 self.pos, alive, self.temps, self.top_ps, self.keys,
                 self._table_device(), self.limits, self.hist, self.adapters,
-                *lp_args,
+                *fsm_args, *lp_args,
             )
         else:
             if key not in self._decode_cache:
@@ -1936,17 +2185,24 @@ class ContinuousEngine:
             res = self._decode_cache[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self.temps, self.top_ps, self.keys, self.hist, self.adapters,
-                *lp_args,
+                *fsm_args, *lp_args,
             )
-        if self.logprobs_k:
+        if self.guided:
             (self.cache, self.cur, self.pos, self.keys, self.hist,
-             (self.lp_chosen, self.lp_ids, self.lp_top), toks, c, i, t) = res
+             self.fstates, *res_rest) = res
+        else:
+            (self.cache, self.cur, self.pos, self.keys, self.hist,
+             *res_rest) = res
+        if self.logprobs_k:
+            ((self.lp_chosen, self.lp_ids, self.lp_top), toks, c, i, t) = (
+                res_rest
+            )
             # One fetch for everything (see _spec_step).
             toks, *lp_np = jax.device_get((toks, c, i, t))
             lp = tuple(np.asarray(x) for x in lp_np)
             toks = np.asarray(toks)
         else:
-            self.cache, self.cur, self.pos, self.keys, self.hist, toks = res
+            (toks,) = res_rest
             lp = None
             toks = np.asarray(jax.device_get(toks))
         if self.speculative:
@@ -2008,6 +2264,12 @@ class ContinuousEngine:
             })
         if self.multi_lora:
             out["adapters"] = self.n_adapters
+        if self.guided:
+            out["guided"] = {
+                "fsm_capacity": self.fsm_capacity,
+                "fsm_rows_used": self._fsm_used,
+                "grammars_registered": len(self._grammars),
+            }
         if self.speculative:
             out["speculative"] = {
                 "k": self.spec_k,
@@ -2153,6 +2415,11 @@ class ThreadedEngine:
         return self._engine.logprobs_k
 
     @property
+    def guided(self) -> bool:
+        """True when the engine can serve grammar-constrained requests."""
+        return self._engine.guided
+
+    @property
     def multi_lora(self) -> bool:
         """True when the engine serves a multi-adapter LoRA stack."""
         return self._engine.multi_lora
@@ -2175,6 +2442,7 @@ class ThreadedEngine:
         top_p: float | None = None,
         seed: int | None = None,
         adapter_id: int | None = None,
+        grammar: Any = None,
     ) -> list[int]:
         """Submit one request and block until it completes. Raises if the
         driver has stopped (shutdown or device error) — callers turn that
@@ -2189,6 +2457,7 @@ class ThreadedEngine:
                 top_p=top_p,
                 seed=seed,
                 adapter_id=adapter_id,
+                grammar=grammar,
             )
             self._cond.notify_all()
             return self._wait_one(rid).tokens
@@ -2202,6 +2471,7 @@ class ThreadedEngine:
         temperature: float | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        grammar: Any = None,
     ) -> tuple[list[int], dict]:
         """``generate_one`` + per-token logprob stats (same dict layout as
         engine.Generator.generate_tokens_with_logprobs: ``token_logprobs``,
@@ -2218,6 +2488,7 @@ class ThreadedEngine:
                 top_p=top_p,
                 seed=seed,
                 logprobs=n_top,
+                grammar=grammar,
             )
             self._cond.notify_all()
             req = self._wait_one(rid)
@@ -2226,6 +2497,59 @@ class ThreadedEngine:
                 "top_ids": [row[:n_top] for row in req.lp_top_ids],
                 "top_logprobs": [row[:n_top] for row in req.lp_top],
             }
+
+    def generate_many(
+        self,
+        prompt_tokens: list[int],
+        n: int,
+        *,
+        max_new_tokens: int | None = None,
+        temperature: float | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+        adapter_id: int | None = None,
+        grammar: Any = None,
+        logprobs: int | None = None,
+    ) -> list[Request]:
+        """Submit ``n`` copies of one prompt (distinct derived seeds) and
+        block until all complete; returns the finished Request objects in
+        submission order. The copies share decode ticks with each other and
+        with everything else in flight — OpenAI ``n``/``best_of`` serving
+        costs one batched decode, not n sequential generations."""
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("continuous engine is stopped") from self._error
+            if seed is None:
+                # Fresh randomness per CALL when unseeded (OpenAI sampling
+                # semantics) — a constant base would replay the same n-set
+                # for every identical prompt.
+                import random as _random
+
+                seed = _random.getrandbits(31)
+            rids: list[int] = []
+            try:
+                for i in range(n):
+                    rids.append(self._engine.submit(
+                        prompt_tokens,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature,
+                        top_p=top_p,
+                        seed=seed + 7919 * i,  # distinct per copy, reproducible
+                        adapter_id=adapter_id,
+                        grammar=grammar,
+                        logprobs=logprobs,
+                    ))
+            except BaseException:
+                # A mid-loop failure (e.g. QueueFullError on copy k) must
+                # not orphan copies 0..k-1: cancel them so their decode
+                # work stops and no unconsumed Request parks in _results.
+                for rid in rids:
+                    self._cancels.add(rid)
+                    self._results.pop(rid, None)
+                self._cond.notify_all()
+                raise
+            self._cond.notify_all()
+            return [self._wait_one(rid) for rid in rids]
 
     def stream_one(
         self,
@@ -2236,6 +2560,7 @@ class ThreadedEngine:
         top_p: float | None = None,
         seed: int | None = None,
         adapter_id: int | None = None,
+        grammar: Any = None,
     ):
         """Submit one request and return an iterator of per-chunk token-id
         lists as they are decoded (SSE streaming). The submit happens
@@ -2256,6 +2581,7 @@ class ThreadedEngine:
                 seed=seed,
                 stream=stream,
                 adapter_id=adapter_id,
+                grammar=grammar,
             )
             self._cond.notify_all()
 
@@ -2290,6 +2616,7 @@ class ThreadedEngine:
         temperature: float | None = None,
         top_p: float | None = None,
         seed: int | None = None,
+        grammar: Any = None,
     ):
         """``stream_one`` + per-chunk logprob stats: yields
         ``(token_ids, lp_dict)`` pairs where ``lp_dict`` carries the chunk's
@@ -2309,6 +2636,7 @@ class ThreadedEngine:
                 seed=seed,
                 stream=stream,
                 logprobs=n_top,
+                grammar=grammar,
             )
             self._cond.notify_all()
 

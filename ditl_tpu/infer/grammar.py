@@ -916,9 +916,29 @@ def _token_table(
         tt[:, lens == 0] = -1
     # EOS: allowed exactly in accepting states; consuming it parks the row
     # in its current state (the engine freezes finished rows anyway).
+    tt = tt.astype(np.int32)
     tt[:, eos_id] = np.where(accept, np.arange(byte_next.shape[0], dtype=np.int32), -1)
+    # Liveness trim: disallow transitions into states from which no
+    # accepting state is TOKEN-reachable. Without this, a constrained row
+    # could enter a strandable state (e.g. the grammar needs a byte
+    # sequence no token provides) and the decode mask would have no
+    # allowed token — generation must instead be steered around such
+    # states so every emitted prefix extends to a full match.
+    live = accept.copy()
+    while True:
+        reach = (tt >= 0) & live[np.clip(tt, 0, None)]
+        new_live = live | reach.any(axis=1)
+        if (new_live == live).all():
+            break
+        live = new_live
+    if not live[0]:
+        raise ValueError(
+            f"grammar {source!r} admits no completion under this tokenizer "
+            "(no token path from the start state reaches an accepting state)"
+        )
+    tt = np.where((tt >= 0) & live[np.clip(tt, 0, None)], tt, -1).astype(np.int32)
     return CompiledGrammar(
-        token_next=tt.astype(np.int32),
+        token_next=tt,
         accept=accept.copy(),
         source=source,
         byte_next=byte_next if keep_byte_dfa else None,

@@ -119,6 +119,9 @@ class _Handler(BaseHTTPRequestHandler):
     device_lock: threading.Lock = None
     default_max_tokens: int = 64
     adapter_names: dict = {}  # multi-LoRA: request "model" name -> adapter id
+    grammar_cache = None  # guided decoding: spec-key -> CompiledGrammar LRU
+    grammar_lock: threading.Lock = None
+    embed_cache = None  # /v1/embeddings: (batch, plen) -> jitted program LRU
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
@@ -186,6 +189,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._complete(payload, chat=True)
         elif path.endswith("/completions"):
             self._complete(payload, chat=False)
+        elif path.endswith("/embeddings"):
+            try:
+                self._embeddings(payload)
+            except Exception as e:
+                logger.exception("embeddings failed")
+                self._send_json(500, {"error": {"message": str(e)}})
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -224,9 +233,281 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(b"data: [DONE]\n\n")
         self.wfile.flush()
 
+    def _multi_complete(
+        self, payload: dict, prompt: str, gen, *, chat: bool, n: int,
+        best_of: int, adapter_ids=None, stops=None, grammar=None,
+    ) -> None:
+        """OpenAI ``n``/``best_of``: generate ``best_of`` candidates (the
+        continuous engine batches them into shared decode ticks; the
+        lock-step path replicates the prompt into one batch) and return the
+        top ``n`` ranked by mean token logprob (OpenAI's best_of rule).
+        Ranking needs per-token logprobs: the continuous engine must be
+        armed (``--logprobs-k``) when ``best_of > n``; the lock-step
+        generator computes them natively."""
+        t0 = time.time()
+        rank = best_of > n
+        eng = self.threaded_engine
+        use_cont = eng is not None and (
+            adapter_ids is None or getattr(eng, "multi_lora", False)
+        ) and (not rank or getattr(eng, "logprobs_k", 0) > 0)
+        if use_cont:
+            tok = eng.tokenizer
+            prompt_ids = [tok.bos_id] + tok.encode(prompt)
+            reqs = eng.generate_many(
+                prompt_ids, best_of,
+                max_new_tokens=gen.max_new_tokens,
+                temperature=gen.temperature, top_p=gen.top_p,
+                seed=gen.seed,
+                adapter_id=adapter_ids[0] if adapter_ids else None,
+                grammar=grammar,
+                logprobs=0 if rank else None,
+            )
+            cands = [(r.tokens, r.lp_token) for r in reqs]
+        else:
+            if grammar is not None:
+                # Name the ACTUAL missing piece: a guided request can land
+                # here despite a guided-armed continuous engine when
+                # best_of ranking needs logprobs the engine wasn't built
+                # with.
+                msg = (
+                    "best_of ranking with guided decoding requires the "
+                    "continuous engine armed with --logprobs-k >= 1"
+                    if eng is not None and rank
+                    and getattr(eng, "logprobs_k", 0) == 0
+                    else "guided decoding requires the continuous engine"
+                )
+                self._send_json(400, {"error": {"message": msg}})
+                return
+            if rank and not hasattr(
+                self.generator, "generate_tokens_with_logprobs"
+            ):
+                self._send_json(400, {"error": {"message":
+                    "best_of ranking is not supported with --pod serving"}})
+                return
+            tok = self.generator.tokenizer
+            prompt_ids = [tok.bos_id] + tok.encode(prompt)
+            batch = [list(prompt_ids) for _ in range(best_of)]
+            if rank:
+                lp_gen = dataclasses.replace(gen, logprobs=1)
+                with self.device_lock:
+                    outs, lps = self.generator.generate_tokens_with_logprobs(
+                        batch, lp_gen, adapter_ids * best_of if adapter_ids else None
+                    )
+                cands = [
+                    (outs[i], lps[i]["token_logprobs"]) for i in range(best_of)
+                ]
+            else:
+                with self.device_lock:
+                    outs = self.generator.generate_tokens(
+                        batch, gen, adapter_ids * best_of if adapter_ids else None
+                    )
+                cands = [(o, None) for o in outs]
+        if rank:
+            def score(c):
+                toks, lp = c
+                return (sum(lp[: len(toks)]) / max(1, len(toks))) if lp else 0.0
+
+            cands.sort(key=score, reverse=True)
+        cands = cands[:n]
+        choices = []
+        total_out = 0
+        for i, (out, _) in enumerate(cands):
+            text, hit_stop = _apply_stop(tok.decode(out), stops or [])
+            finish = (
+                "stop" if hit_stop or len(out) < gen.max_new_tokens
+                else "length"
+            )
+            total_out += len(tok.encode(text))
+            choices.append(
+                {"index": i, "message": {"role": "assistant", "content": text},
+                 "finish_reason": finish}
+                if chat
+                else {"index": i, "text": text, "finish_reason": finish}
+            )
+        n_prompt = len(prompt_ids)
+        self._send_json(200, {
+            "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion" if chat else "text_completion",
+            "created": int(t0),
+            "model": payload.get("model") or self.model_name,
+            "choices": choices,
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": total_out,
+                "total_tokens": n_prompt + total_out,
+            },
+        })
+
+    def _embeddings(self, payload: dict) -> None:
+        """OpenAI ``/v1/embeddings``: mean-pooled, L2-normalized final
+        hidden states (the standard causal-LM embedding recipe). One jitted
+        program per (batch, length) bucket, LRU-bounded like every other
+        client-shaped compile cache; runs under the device lock (embedding
+        batches are one forward — lock-step is the right shape)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ditl_tpu.infer.engine import _next_pow2, lru_program
+        from ditl_tpu.models import llama
+
+        if not hasattr(self.generator, "cfg"):
+            # --pod wraps the generator in PodGenerator (tokenizer-only
+            # surface): a direct forward here would run device work outside
+            # the pod broadcast protocol and hang the other processes.
+            self._send_json(400, {"error": {"message":
+                "embeddings are not supported with --pod serving"}})
+            return
+        raw = payload.get("input")
+        if isinstance(raw, str):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw and all(
+            isinstance(x, int) for x in raw
+        ):
+            inputs = [raw]  # one pre-tokenized prompt
+        elif isinstance(raw, list):
+            inputs = raw
+        else:
+            self._send_json(400, {"error": {"message":
+                "input must be a string, array of strings, or token array"}})
+            return
+        if not inputs or len(inputs) > 64:
+            self._send_json(400, {"error": {"message":
+                "input must contain 1..64 entries"}})
+            return
+        gen = self.generator
+        tok = gen.tokenizer
+        token_lists = []
+        for item in inputs:
+            if isinstance(item, str):
+                ids = [tok.bos_id] + tok.encode(item)
+            elif isinstance(item, list) and all(isinstance(x, int) for x in item):
+                ids = item or [tok.bos_id]
+            else:
+                self._send_json(400, {"error": {"message":
+                    "each input must be a string or a token-id array"}})
+                return
+            if len(ids) > gen.cfg.max_seq_len:
+                ids = ids[: gen.cfg.max_seq_len]
+            token_lists.append(ids)
+        batch = _next_pow2(len(token_lists), floor=1)
+        plen = _next_pow2(max(len(t) for t in token_lists))
+        ids = np.full((batch, plen), tok.pad_id, np.int32)
+        lengths = np.ones((batch,), np.int32)
+        for i, t in enumerate(token_lists):
+            ids[i, : len(t)] = t
+            lengths[i] = len(t)
+        cfg, mesh, rules = gen.cfg, gen.mesh, gen.rules
+
+        def build():
+            def run(params, ids, lengths):
+                q_pos = jnp.arange(plen, dtype=jnp.int32)
+                seg = (q_pos[None, :] < lengths[:, None]).astype(jnp.int32)
+                hidden = llama.forward(
+                    params, ids, cfg,
+                    positions=jnp.broadcast_to(q_pos, (batch, plen)),
+                    segment_ids=seg, mesh=mesh, rules=rules,
+                    return_hidden=True,
+                )
+                mask = seg.astype(jnp.float32)[..., None]
+                pooled = (hidden.astype(jnp.float32) * mask).sum(1) / mask.sum(1)
+                norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+                return pooled / jnp.maximum(norm, 1e-9)
+
+            return jax.jit(run)
+
+        with self.device_lock:
+            program = lru_program(
+                self.embed_cache, (batch, plen), build, bound=16
+            )
+            vecs = np.asarray(
+                jax.device_get(program(gen.params, ids, lengths))
+            )
+        self._send_json(200, {
+            "object": "list",
+            "model": payload.get("model") or self.model_name,
+            "data": [
+                {"object": "embedding", "index": i,
+                 "embedding": vecs[i].tolist()}
+                for i in range(len(token_lists))
+            ],
+            "usage": {
+                "prompt_tokens": int(sum(len(t) for t in token_lists)),
+                "total_tokens": int(sum(len(t) for t in token_lists)),
+            },
+        })
+
+    def _resolve_grammar(self, payload: dict):
+        """Parse the request's guided-decoding spec (``guided_regex``,
+        ``guided_json``, or OpenAI ``response_format`` json_object /
+        json_schema) into a CompiledGrammar, LRU-cached by spec — grammar
+        compilation is host work (regex -> DFA -> token table) that repeat
+        clients shouldn't pay twice; the engine additionally dedups
+        registration by table content. Returns None when the request is
+        unconstrained; raises ValueError (caller answers 400) on a bad spec
+        or a server not armed for guided decoding."""
+        rf = payload.get("response_format")
+        rf = rf if isinstance(rf, dict) else {}
+        specs = [
+            payload.get("guided_regex") is not None,
+            payload.get("guided_json") is not None,
+            rf.get("type") in ("json_object", "json_schema"),
+        ]
+        if not any(specs):
+            return None
+        if sum(specs) > 1:
+            raise ValueError(
+                "at most one of guided_regex, guided_json, response_format "
+                "may constrain a request"
+            )
+        eng = self.threaded_engine
+        if eng is None or not getattr(eng, "guided", False):
+            raise ValueError(
+                "guided decoding requires --engine continuous with "
+                "--fsm-capacity > 0"
+            )
+        tok = eng.tokenizer
+        from ditl_tpu.infer import grammar as G
+
+        if payload.get("guided_regex") is not None:
+            pattern = payload["guided_regex"]
+            if not isinstance(pattern, str):
+                raise ValueError("guided_regex must be a string")
+            key, build = ("regex", pattern), (
+                lambda: G.compile_regex(pattern, tok)
+            )
+        elif payload.get("guided_json") is not None:
+            schema = payload["guided_json"]
+            if isinstance(schema, str):
+                schema = json.loads(schema)
+            if not isinstance(schema, dict):
+                raise ValueError("guided_json must be a JSON-schema object")
+            key = ("schema", json.dumps(schema, sort_keys=True))
+            build = lambda: G.compile_json_schema(schema, tok)  # noqa: E731
+        elif rf.get("type") == "json_schema":
+            schema = (rf.get("json_schema") or {}).get("schema")
+            if not isinstance(schema, dict):
+                raise ValueError(
+                    "response_format.json_schema.schema must be an object"
+                )
+            key = ("schema", json.dumps(schema, sort_keys=True))
+            build = lambda: G.compile_json_schema(schema, tok)  # noqa: E731
+        else:  # json_object
+            key, build = ("json_object",), (lambda: G.compile_json(tok))
+        with self.grammar_lock:
+            if key in self.grammar_cache:
+                self.grammar_cache.move_to_end(key)
+                return self.grammar_cache[key]
+        g = build()  # compile OUTSIDE the lock: can cost ~seconds
+        with self.grammar_lock:
+            self.grammar_cache[key] = g
+            while len(self.grammar_cache) > 64:
+                self.grammar_cache.popitem(last=False)
+        return g
+
     def _stream_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None,
-        stops=None, lp_n=None,
+        stops=None, lp_n=None, grammar=None,
     ) -> None:
         """OpenAI streaming: real incremental chunks from the continuous
         engine; the lockstep engine generates fully, then emits one chunk.
@@ -269,6 +550,7 @@ class _Handler(BaseHTTPRequestHandler):
                     temperature=gen.temperature,
                     top_p=gen.top_p,
                     seed=gen.seed,
+                    grammar=grammar,
                 )
             else:
                 stream_iter = self.threaded_engine.stream_one(
@@ -278,6 +560,7 @@ class _Handler(BaseHTTPRequestHandler):
                     top_p=gen.top_p,
                     seed=gen.seed,
                     adapter_id=adapter_ids[0] if adapter_ids else None,
+                    grammar=grammar,
                 )
 
         def events():
@@ -392,6 +675,43 @@ class _Handler(BaseHTTPRequestHandler):
             # adapter by name; unknown/absent names serve the base (slot 0).
             aid = self.adapter_names.get(str(payload.get("model") or ""))
             adapter_ids = [aid] if aid is not None else None
+            try:
+                grammar = self._resolve_grammar(payload)
+            except ValueError as e:
+                self._send_json(400, {"error": {"message": str(e)}})
+                return
+            if (grammar is not None and adapter_ids is not None
+                    and not getattr(self.threaded_engine, "multi_lora", False)):
+                self._send_json(400, {"error": {"message":
+                    "guided decoding with adapter routing requires a "
+                    "multi-LoRA continuous engine"}})
+                return
+            try:
+                n_choices = int(payload.get("n") or 1)
+                best_of = int(payload.get("best_of") or n_choices)
+            except (TypeError, ValueError):
+                self._send_json(400, {"error": {"message":
+                    "n and best_of must be integers"}})
+                return
+            if n_choices > 1 or best_of > 1:
+                if not (1 <= n_choices <= best_of <= 8):
+                    self._send_json(400, {"error": {"message":
+                        "need 1 <= n <= best_of <= 8"}})
+                    return
+                if payload.get("stream"):
+                    self._send_json(400, {"error": {"message":
+                        "n/best_of do not compose with stream"}})
+                    return
+                if payload.get("logprobs") not in (None, False):
+                    self._send_json(400, {"error": {"message":
+                        "logprobs with n > 1 is not supported"}})
+                    return
+                self._multi_complete(
+                    payload, prompt, gen, chat=chat, n=n_choices,
+                    best_of=best_of, adapter_ids=adapter_ids, stops=stops,
+                    grammar=grammar,
+                )
+                return
             # OpenAI semantics: completions' `logprobs: 0` is a real request
             # (chosen-token logprob, zero alternatives) — 0 is falsy, so test
             # presence, not truthiness. Chat's `logprobs: false` means off.
@@ -424,11 +744,17 @@ class _Handler(BaseHTTPRequestHandler):
                     self._stream_complete(
                         payload, prompt, gen, chat=chat,
                         adapter_ids=adapter_ids, stops=stops, lp_n=lp_n,
+                        grammar=grammar,
                     )
                 except QueueFullError as e:
                     # The stream's submit is eager (before SSE headers), so
                     # a full queue still becomes a real 429 (ADVICE r2).
                     self._send_429(str(e))
+                except ValueError as e:
+                    # Eager-submit validation (e.g. fsm_capacity exhausted)
+                    # also precedes the SSE headers.
+                    status = 503 if "fsm_capacity" in str(e) else 400
+                    self._send_json(status, {"error": {"message": str(e)}})
                 except (BrokenPipeError, ConnectionError):
                     logger.info("client disconnected mid-stream")
                 except Exception:
@@ -468,7 +794,16 @@ class _Handler(BaseHTTPRequestHandler):
                         max_new_tokens=gen.max_new_tokens,
                         temperature=gen.temperature, top_p=gen.top_p,
                         seed=gen.seed,
+                        grammar=grammar,
                     )
+                elif grammar is not None:
+                    # Guided requests never fall back to the lock-step
+                    # generator (no FSM path there) — the conditions above
+                    # (logprobs_k >= N) must hold for guided + logprobs.
+                    self._send_json(400, {"error": {"message":
+                        "guided decoding with logprobs requires the "
+                        "continuous engine armed with --logprobs-k >= N"}})
+                    return
                 elif not hasattr(self.generator, "generate_tokens_with_logprobs"):
                     # --pod wraps the generator in PodGenerator; its broadcast
                     # protocol doesn't carry logprobs (and device work must
@@ -569,11 +904,16 @@ class _Handler(BaseHTTPRequestHandler):
                     top_p=gen.top_p,
                     seed=gen.seed,
                     adapter_id=adapter_ids[0] if adapter_ids else None,
+                    grammar=grammar,
                 )
                 n_gen = len(out)
                 text, hit_stop = _apply_stop(tok.decode(out), stops)
                 n_prompt = len(prompt_ids)
             else:
+                if grammar is not None:  # unreachable guard: no FSM path
+                    self._send_json(400, {"error": {"message":
+                        "guided decoding requires the continuous engine"}})
+                    return
                 tok = self.generator.tokenizer
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
                 out = self._lockstep_generate(prompt_ids, gen, adapter_ids)
@@ -621,6 +961,17 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(e, QueueFullError):
                 self._send_429(str(e))
                 return
+            if isinstance(e, ValueError) and "fsm_capacity exhausted" in str(e):
+                # Guided table full: a server-capacity condition, not a
+                # client error. Rows are never evicted (active slots may
+                # point anywhere in the table), so NEW grammars keep
+                # failing until the operator restarts with a larger
+                # --fsm-capacity; already-registered grammars still serve.
+                self._send_json(503, {"error": {"message":
+                    str(e) + " (new grammars need a restart with a larger "
+                    "--fsm-capacity; already-registered grammars still "
+                    "serve)"}})
+                return
             logger.exception("completion failed")
             self._send_json(500, {"error": {"message": str(e)}})
 
@@ -643,6 +994,8 @@ def make_server(
     (the generator's params must be a stacked-adapter tree);
     ``spec_generator`` (Speculative/AutoSpeculativeGenerator) serves greedy
     lock-step requests — streaming and non-streaming — speculatively."""
+    import collections
+
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -654,6 +1007,9 @@ def make_server(
             "default_max_tokens": default_max_tokens,
             "adapter_names": adapter_names or {},
             "spec_generator": spec_generator,
+            "grammar_cache": collections.OrderedDict(),
+            "grammar_lock": threading.Lock(),
+            "embed_cache": collections.OrderedDict(),
         },
     )
     return ThreadingHTTPServer((host, port), handler)
@@ -703,6 +1059,14 @@ def serve(argv: list[str] | None = None) -> int:
         "--max-queue", type=int, default=0,
         help="admission-queue depth cap for --engine continuous; beyond it "
         "requests get HTTP 429 (0 = unbounded)",
+    )
+    parser.add_argument(
+        "--fsm-capacity", type=int, default=0,
+        help="arm guided (grammar-constrained) decoding on --engine "
+        "continuous: total DFA states servable at once (device table rows; "
+        "a JSON grammar is ~1.1k states at depth 5, a typical regex tens). "
+        "Requests then accept guided_regex / guided_json / response_format "
+        "json_object. 0 = off",
     )
     parser.add_argument(
         "--cache-mode", choices=("contiguous", "paged"), default="contiguous",
@@ -768,16 +1132,24 @@ def serve(argv: list[str] | None = None) -> int:
         parser.error("--adapter with --pod requires --engine continuous "
                      "(only the continuous tick broadcast carries adapter "
                      "ids)")
-    if args.speculative != "off" and args.engine == "continuous":
-        parser.error("--speculative composes with --engine lockstep only "
-                     "(the continuous engine's slot scheduler has no "
-                     "verify-forward path yet)")
-    if args.speculative != "off" and args.pod:
-        parser.error("--speculative does not compose with --pod (device "
-                     "work must ride the broadcast protocol)")
-    if args.speculative != "off" and args.adapter:
-        parser.error("--speculative does not compose with --adapter "
-                     "(adapter requests take the plain path anyway)")
+    if args.speculative != "off" and args.engine != "continuous":
+        # Lock-step speculation rides its own generator (below); the extra
+        # compositions (pod, adapters) exist on the continuous engine only.
+        if args.pod:
+            parser.error("--speculative with --pod requires --engine "
+                         "continuous (spec ticks ride the tick broadcast; "
+                         "the lock-step pod protocol has no verify path)")
+        if args.adapter:
+            parser.error("--speculative with --adapter requires --engine "
+                         "continuous (spec ticks carry per-slot adapter "
+                         "ids; the lock-step spec generator does not)")
+    if args.fsm_capacity and args.engine != "continuous":
+        parser.error("--fsm-capacity (guided decoding) requires --engine "
+                     "continuous: grammar masks ride the slot scheduler's "
+                     "decode ticks")
+    if args.fsm_capacity and args.pod:
+        parser.error("--fsm-capacity does not compose with --pod yet (the "
+                     "tick broadcast does not carry grammar registrations)")
     if jax.process_index() != 0 and not args.pod:
         # Without --pod, one process binds the port and the others exit; with
         # --pod every process joins the collective decode loop below.
@@ -891,6 +1263,7 @@ def serve(argv: list[str] | None = None) -> int:
             # measured-acceptance decision (engine default threshold).
             spec_threshold=0.0 if args.speculative == "on" else None,
             logprobs_k=args.logprobs_k,
+            fsm_capacity=args.fsm_capacity,
         )
 
     if args.pod and jax.process_index() != 0:

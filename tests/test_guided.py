@@ -1,0 +1,380 @@
+"""Guided (grammar-constrained) decoding on the continuous engine: FSM
+masking exactness, pattern conformance across cache modes, speculative
+composition, and registration bookkeeping."""
+
+from __future__ import annotations
+
+import json
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer import grammar as G
+from ditl_tpu.infer.continuous import ContinuousEngine
+from ditl_tpu.infer.engine import GenerateConfig
+from ditl_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        dtype="float32",
+        param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    tok = ByteTokenizer()
+    return params, cfg, tok
+
+
+def _engine(params, cfg, tok, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("decode_chunk", 4)
+    kw.setdefault("gen", GenerateConfig(max_new_tokens=16, temperature=0.0))
+    kw.setdefault("fsm_capacity", 1024)
+    return ContinuousEngine(params, cfg, tok, **kw)
+
+
+def test_unconstrained_rows_bit_exact_vs_unguided(setup):
+    """A guided-capacity engine serving NO grammar must produce tokens
+    bit-identical to a guided-off engine (the FREE row is an identity
+    mask)."""
+    params, cfg, tok = setup
+    prompts = ["hello world", "abc def"]
+    gen = GenerateConfig(max_new_tokens=12)
+    plain = ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4, gen=gen,
+    ).generate(prompts)
+    guided = _engine(params, cfg, tok, gen=gen).generate(prompts)
+    assert guided == plain
+
+
+def test_regex_constrained_output_matches(setup):
+    params, cfg, tok = setup
+    g = G.compile_regex(r"[0-9]{3}-[0-9]{4}", tok)
+    eng = _engine(params, cfg, tok)
+    out = eng.generate(["call me at", "the number is"], grammar=g)
+    for text in out:
+        assert re.fullmatch(r"[0-9]{3}-[0-9]{4}", text), out
+    # bounded grammar: single accepting sink => generation stopped at EOS,
+    # not the token budget
+    assert all(len(t) == 8 for t in out)
+
+
+def test_mixed_batch_free_rows_unaffected(setup):
+    """One constrained + one free request sharing decode ticks: the free
+    row's output is identical to an all-free engine run."""
+    params, cfg, tok = setup
+    g = G.compile_regex(r"(yes|no)", tok)
+    free_alone = _engine(params, cfg, tok).generate(["tell me"])[0]
+    eng = _engine(params, cfg, tok)
+    rid_c = eng.submit([tok.bos_id] + tok.encode("answer:"), grammar=g)
+    rid_f = eng.submit([tok.bos_id] + tok.encode("tell me"))
+    res = eng.run()
+    assert tok.decode(res[rid_f]) == free_alone
+    assert tok.decode(res[rid_c]) in ("yes", "no")
+
+
+def test_schema_constrained_json(setup):
+    params, cfg, tok = setup
+    schema = {"enum": ["red", "green", "blue"]}
+    g = G.compile_json_schema(schema, tok)
+    eng = _engine(params, cfg, tok)
+    out = eng.generate(["pick a color"], grammar=g)[0]
+    assert json.loads(out) in ("red", "green", "blue")
+
+
+def test_json_mode_output_is_valid_prefix(setup):
+    """json_object mode on a random-weight model: every emitted byte walks
+    the JSON DFA live (the guarantee is valid-prefix always, full validity
+    when EOS lands inside the budget)."""
+    params, cfg, tok = setup
+    g = G.compile_json(tok, max_depth=3)
+    eng = _engine(
+        params, cfg, tok,
+        gen=GenerateConfig(max_new_tokens=24, temperature=0.0),
+    )
+    out = eng.generate(["emit json"], grammar=g)[0]
+    data = out.encode()
+    s = 0
+    for b in data:
+        s = int(g.byte_next[s, b])
+        assert s >= 0, f"dead byte in {out!r}"
+    try:
+        json.loads(out)
+    except ValueError:
+        assert len(eng.tokenizer.encode(out)) >= 24  # budget-truncated
+
+
+def test_sampled_constrained(setup):
+    params, cfg, tok = setup
+    g = G.compile_regex(r"[ab]{2,6}", tok)
+    eng = _engine(params, cfg, tok)
+    rid = eng.submit(
+        [tok.bos_id] + tok.encode("x"), grammar=g, temperature=0.9, seed=7,
+    )
+    out = tok.decode(eng.run()[rid])
+    assert re.fullmatch(r"[ab]{2,6}", out), out
+
+
+@pytest.mark.slow
+def test_paged_constrained(setup):
+    params, cfg, tok = setup
+    g = G.compile_regex(r"[0-9]{2}(px|em)", tok)
+    eng = _engine(
+        params, cfg, tok, cache_mode="paged", page_size=16, max_cache_len=64,
+    )
+    out = eng.generate(["width:", "height:"], grammar=g)
+    for text in out:
+        assert re.fullmatch(r"[0-9]{2}(px|em)", text), out
+
+
+@pytest.mark.slow
+def test_spec_guided_greedy_exact(setup):
+    """Speculative ticks under a grammar emit token-identical output to
+    plain guided ticks (f32, greedy)."""
+    params, cfg, tok = setup
+    g = G.compile_regex(r"[a-z ]{1,30}", tok)
+    prompts = ["the cat sat on the", "a b a b a b"]
+    plain = _engine(params, cfg, tok).generate(prompts, grammar=g)
+    spec = _engine(
+        params, cfg, tok, speculative=True, spec_k=4, spec_threshold=0.0,
+    ).generate(prompts, grammar=g)
+    assert spec == plain
+    for t in spec:
+        assert re.fullmatch(r"[a-z ]{1,30}", t), spec
+
+
+@pytest.mark.slow
+def test_spec_paged_guided(setup):
+    params, cfg, tok = setup
+    g = G.compile_regex(r"-?[0-9]{1,6}", tok)
+    plain = _engine(
+        params, cfg, tok, cache_mode="paged", page_size=16, max_cache_len=64,
+    ).generate(["n ="], grammar=g)
+    spec = _engine(
+        params, cfg, tok, cache_mode="paged", page_size=16, max_cache_len=64,
+        speculative=True, spec_k=4, spec_threshold=0.0,
+    ).generate(["n ="], grammar=g)
+    assert spec == plain
+    assert re.fullmatch(r"-?[0-9]{1,6}", spec[0])
+
+
+@pytest.mark.slow
+def test_chunked_prefill_constrained(setup):
+    params, cfg, tok = setup
+    g = G.compile_regex(r"(foo|bar){1,4}", tok)
+    long_prompt = "word " * 12
+    ref = _engine(params, cfg, tok).generate([long_prompt], grammar=g)[0]
+    chunked = _engine(params, cfg, tok, prefill_chunk=16).generate(
+        [long_prompt], grammar=g
+    )[0]
+    assert chunked == ref
+    assert re.fullmatch(r"(foo|bar){1,4}", ref)
+
+
+@pytest.mark.slow
+def test_logprobs_compose_with_grammar(setup):
+    params, cfg, tok = setup
+    g = G.compile_regex(r"[0-9]{4}", tok)
+    eng = _engine(params, cfg, tok, logprobs_k=3)
+    rid = eng.submit(
+        [tok.bos_id] + tok.encode("year:"), grammar=g, logprobs=2,
+    )
+    while eng.pending:
+        eng.step()
+    req = eng._completed[rid]
+    assert re.fullmatch(r"[0-9]{4}", tok.decode(req.tokens))
+    assert len(req.lp_token) >= len(req.tokens)
+    # engine stores logprobs_k-wide rows; the serving layer slices to N
+    assert all(len(r) == 3 for r in req.lp_top_ids[: len(req.tokens)])
+
+
+def test_registration_bookkeeping(setup):
+    params, cfg, tok = setup
+    eng = _engine(params, cfg, tok, fsm_capacity=64)
+    g1 = G.compile_regex(r"[ab]+", tok)
+    b1 = eng.register_grammar(g1)
+    assert b1 == 2  # after FREE + DEAD
+    assert eng.register_grammar(g1) == b1  # dedup by content
+    g2 = G.compile_regex(r"[cd]+", tok)
+    b2 = eng.register_grammar(g2)
+    assert b2 > b1
+    stats = eng.stats()["guided"]
+    assert stats["grammars_registered"] == 2
+    big = G.compile_json(tok, max_depth=3)  # hundreds of states
+    with pytest.raises(ValueError, match="fsm_capacity exhausted"):
+        eng.register_grammar(big)
+    # int start-state submission round-trips
+    rid = eng.submit([tok.bos_id] + tok.encode("q"), grammar=b1)
+    out = tok.decode(eng.run()[rid])
+    assert re.fullmatch(r"[ab]+", out) or out == ""
+
+
+def test_guided_off_engine_rejects_grammar(setup):
+    params, cfg, tok = setup
+    eng = ContinuousEngine(params, cfg, tok, n_slots=1)
+    g = G.compile_regex(r"a+", ByteTokenizer())
+    with pytest.raises(ValueError, match="fsm_capacity"):
+        eng.submit([3], grammar=g)
+
+
+@pytest.mark.slow
+def test_server_guided_routes(setup):
+    """HTTP layer: guided_regex, response_format json_object, guided_json
+    schema, streaming with a grammar, and the 400 for unarmed servers."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from ditl_tpu.infer.continuous import ThreadedEngine
+    from ditl_tpu.infer.engine import Generator
+    from ditl_tpu.infer.server import make_server
+
+    params, cfg, tok = setup
+    threaded = ThreadedEngine(
+        _engine(params, cfg, tok, n_slots=4, fsm_capacity=4096)
+    )
+    server = make_server(
+        Generator(params, cfg, tok), host="127.0.0.1", port=0,
+        threaded_engine=threaded, default_max_tokens=16,
+    )
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+
+    def post(path, body, expect_error=False):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            assert expect_error
+            return e.code, json.loads(e.read())
+
+    try:
+        # guided_regex on completions
+        status, out = post("/v1/completions", {
+            "prompt": "pin:", "guided_regex": "[0-9]{4}", "max_tokens": 12,
+        })
+        assert status == 200
+        assert re.fullmatch(r"[0-9]{4}", out["choices"][0]["text"])
+        # response_format json_object on chat completions
+        status, out = post("/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "emit json"}],
+            "response_format": {"type": "json_object"}, "max_tokens": 20,
+        })
+        assert status == 200
+        text = out["choices"][0]["message"]["content"]
+        g = G.compile_json(tok)
+        s = 0
+        for b in text.encode():
+            s = int(g.byte_next[s, b])
+            assert s >= 0, text
+        # guided_json schema
+        status, out = post("/v1/completions", {
+            "prompt": "color:", "max_tokens": 12,
+            "guided_json": {"enum": ["on", "off"]},
+        })
+        assert status == 200
+        assert json.loads(out["choices"][0]["text"]) in ("on", "off")
+        # streaming + grammar: SSE chunks concatenate to a full match
+        import http.client
+
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        conn.request(
+            "POST", "/v1/completions",
+            json.dumps({"prompt": "id:", "guided_regex": "[a-f]{6}",
+                        "max_tokens": 10, "stream": True}),
+            {"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        acc = ""
+        for raw in resp.read().decode().splitlines():
+            if raw.startswith("data: ") and raw != "data: [DONE]":
+                acc += json.loads(raw[6:])["choices"][0]["text"]
+        conn.close()
+        assert re.fullmatch(r"[a-f]{6}", acc), acc
+        # bad spec -> 400
+        status, out = post("/v1/completions", {
+            "prompt": "x", "guided_regex": "([unclosed",
+        }, expect_error=True)
+        assert status == 400
+        # two specs at once -> 400
+        status, _ = post("/v1/completions", {
+            "prompt": "x", "guided_regex": "a+",
+            "response_format": {"type": "json_object"},
+        }, expect_error=True)
+        assert status == 400
+    finally:
+        server.shutdown()
+        threaded.close()
+
+
+@pytest.mark.slow
+def test_server_unarmed_guided_400(setup):
+    """A server whose engine lacks fsm_capacity answers 400, not 500."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from ditl_tpu.infer.continuous import ThreadedEngine
+    from ditl_tpu.infer.engine import Generator
+    from ditl_tpu.infer.server import make_server
+
+    params, cfg, tok = setup
+    threaded = ThreadedEngine(
+        ContinuousEngine(params, cfg, tok, n_slots=2,
+                         gen=GenerateConfig(max_new_tokens=8))
+    )
+    server = make_server(
+        Generator(params, cfg, tok), host="127.0.0.1", port=0,
+        threaded_engine=threaded,
+    )
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": "x", "guided_regex": "a+"}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 400
+        assert "fsm-capacity" in json.loads(ei.value.read())["error"]["message"]
+    finally:
+        server.shutdown()
+        threaded.close()
+
+
+def test_unreachable_grammar_raises():
+    """A grammar no token path can complete fails at COMPILE time (liveness
+    trim), not by stranding a slot at serve time."""
+
+    class TwoTok:  # vocab: only "ab" exists as a real token
+        vocab_size = 4
+        pad_id, bos_id, eos_id = 0, 1, 2
+
+        def decode(self, ids):
+            return "ab" if ids == [3] else ""
+
+        def encode(self, text):
+            raise NotImplementedError
+
+    with pytest.raises(ValueError, match="admits no completion"):
+        G.compile_regex(r"abc", TwoTok())

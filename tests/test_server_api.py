@@ -1,0 +1,224 @@
+"""Server API surface beyond basic completions: /v1/embeddings and OpenAI
+n / best_of multi-choice serving."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.infer.server import make_server
+from ditl_tpu.models import llama
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = ModelConfig(
+        vocab_size=512,
+        hidden_size=64,
+        intermediate_size=128,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=16,
+        max_seq_len=128,
+        dtype="float32",
+        param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    tok = ByteTokenizer()
+    return params, cfg, tok
+
+
+def _serve(params, cfg, tok, **engine_kw):
+    threaded = None
+    if engine_kw.pop("continuous", False):
+        threaded = ThreadedEngine(ContinuousEngine(
+            params, cfg, tok, n_slots=8, decode_chunk=4,
+            gen=GenerateConfig(max_new_tokens=10), **engine_kw,
+        ))
+    server = make_server(
+        Generator(params, cfg, tok), host="127.0.0.1", port=0,
+        threaded_engine=threaded, default_max_tokens=10,
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, threaded, server.server_address[1]
+
+
+def _post(port, path, body, expect_error=False):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        assert expect_error, e.read()
+        return e.code, json.loads(e.read())
+
+
+def test_embeddings_endpoint(setup):
+    params, cfg, tok = setup
+    server, threaded, port = _serve(params, cfg, tok)
+    try:
+        status, out = _post(port, "/v1/embeddings", {
+            "input": ["hello world", "completely different text", "hello world"],
+        })
+        assert status == 200
+        assert out["object"] == "list"
+        vecs = [np.asarray(d["embedding"]) for d in out["data"]]
+        assert [d["index"] for d in out["data"]] == [0, 1, 2]
+        assert all(v.shape == (cfg.hidden_size,) for v in vecs)
+        # unit-normalized; identical inputs identical, different differ
+        for v in vecs:
+            assert abs(np.linalg.norm(v) - 1.0) < 1e-5
+        np.testing.assert_allclose(vecs[0], vecs[2], atol=1e-6)
+        assert np.linalg.norm(vecs[0] - vecs[1]) > 1e-3
+        assert out["usage"]["prompt_tokens"] > 0
+        # single string input
+        status, out = _post(port, "/v1/embeddings", {"input": "hello world"})
+        assert status == 200 and len(out["data"]) == 1
+        np.testing.assert_allclose(
+            np.asarray(out["data"][0]["embedding"]), vecs[0], atol=1e-6
+        )
+        # bad input
+        status, _ = _post(port, "/v1/embeddings", {"input": 42},
+                          expect_error=True)
+        assert status == 400
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.slow
+def test_n_choices_continuous(setup):
+    """n sampled completions ride shared decode ticks and come back as
+    distinct, seed-reproducible choices."""
+    params, cfg, tok = setup
+    server, threaded, port = _serve(params, cfg, tok, continuous=True)
+    try:
+        body = {"prompt": "story:", "n": 3, "temperature": 0.9,
+                "max_tokens": 8, "seed": 11}
+        status, out = _post(port, "/v1/completions", body)
+        assert status == 200
+        texts = [c["text"] for c in out["choices"]]
+        assert len(texts) == 3
+        assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+        assert len(set(texts)) > 1  # sampled copies differ
+        status, out2 = _post(port, "/v1/completions", body)
+        assert [c["text"] for c in out2["choices"]] == texts  # seed-pinned
+    finally:
+        server.shutdown()
+        threaded.close()
+
+
+@pytest.mark.slow
+def test_best_of_ranks_by_logprob(setup):
+    params, cfg, tok = setup
+    server, threaded, port = _serve(
+        params, cfg, tok, continuous=True, logprobs_k=1,
+    )
+    try:
+        status, out = _post(port, "/v1/completions", {
+            "prompt": "story:", "n": 2, "best_of": 4, "temperature": 0.9,
+            "max_tokens": 8, "seed": 3,
+        })
+        assert status == 200
+        assert len(out["choices"]) == 2
+        # chat spelling works too
+        status, out = _post(port, "/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "hi"}],
+            "n": 2, "temperature": 0.8, "max_tokens": 8,
+        })
+        assert status == 200
+        assert len(out["choices"]) == 2
+        assert all("message" in c for c in out["choices"])
+    finally:
+        server.shutdown()
+        threaded.close()
+
+
+def test_best_of_validation(setup):
+    params, cfg, tok = setup
+    server, threaded, port = _serve(params, cfg, tok, continuous=True)
+    try:
+        status, _ = _post(port, "/v1/completions", {
+            "prompt": "x", "n": 3, "best_of": 2,
+        }, expect_error=True)
+        assert status == 400
+        status, _ = _post(port, "/v1/completions", {
+            "prompt": "x", "n": 2, "stream": True,
+        }, expect_error=True)
+        assert status == 400
+        # best_of > n without logprobs-armed engine: lock-step fallback
+        # computes its own logprobs, so this still succeeds
+        status, out = _post(port, "/v1/completions", {
+            "prompt": "x", "n": 1, "best_of": 2, "temperature": 0.7,
+            "max_tokens": 6,
+        })
+        assert status == 200 and len(out["choices"]) == 1
+    finally:
+        server.shutdown()
+        threaded.close()
+
+
+def test_generate_many_cancels_orphans_on_midloop_failure(setup):
+    """A QueueFullError on copy k must cancel copies 0..k-1: no unconsumed
+    Request may park in ThreadedEngine._results, and the engine drains."""
+    import time
+
+    from ditl_tpu.infer.continuous import QueueFullError
+
+    params, cfg, tok = setup
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4,
+        gen=GenerateConfig(max_new_tokens=8),
+    )
+    te = ThreadedEngine(eng)
+    orig = eng.submit
+    calls = []
+
+    def failing_submit(prompt, **kw):
+        if len(calls) >= 2:
+            raise QueueFullError("full")
+        calls.append(1)
+        return orig(prompt, **kw)
+
+    eng.submit = failing_submit
+    try:
+        with pytest.raises(QueueFullError):
+            te.generate_many([tok.bos_id, 5, 6], 4, temperature=0.5)
+        deadline = time.time() + 30
+        while eng.pending and time.time() < deadline:
+            time.sleep(0.05)
+        assert eng.pending == 0
+        assert te._results == {}
+    finally:
+        eng.submit = orig
+        te.close()
+
+
+@pytest.mark.slow
+def test_n_lockstep_fallback(setup):
+    """No continuous engine at all: n/best_of serve through one replicated
+    lock-step batch."""
+    params, cfg, tok = setup
+    server, _, port = _serve(params, cfg, tok)
+    try:
+        status, out = _post(port, "/v1/completions", {
+            "prompt": "story:", "n": 2, "best_of": 3, "temperature": 0.9,
+            "max_tokens": 6, "seed": 5,
+        })
+        assert status == 200
+        assert len(out["choices"]) == 2
+    finally:
+        server.shutdown()
