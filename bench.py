@@ -135,7 +135,13 @@ def _model_cfg(name: str, platform: str):
             head_dim=128, max_seq_len=2048, dtype="bfloat16",
             param_dtype="bfloat16", remat="dots", attention_impl="flash",
             flash_block_q=1024, flash_block_kv=1024,
-            loss_impl="fused", loss_block_tokens=2048,
+            # r3 sweep: CE block 4096 is +0.5% over 2048 (8192 matches
+            # 4096); 2048-token flash tiles exceed v5e's 16M scoped VMEM,
+            # remat=attn loses 6%, batch 6/8 at s2048 exceed HBM. The
+            # b8 x s1024 SHAPE reaches 60.2% MFU (BASELINE.md) but changes
+            # the workload, so the pinned config keeps s2048 for an honest
+            # round-over-round vs_baseline.
+            loss_impl="fused", loss_block_tokens=4096,
         )
         batch, seq, optimizer = 4, 2048, "adafactor"
     else:
@@ -199,7 +205,8 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 slots: int = 8, decode_chunk: int = 16,
                 page_size: int = 256, moe: bool = False,
                 prompt_len: int = 0, max_new: int = 0,
-                temperature: float = 0.0, guided: str = "") -> int:
+                temperature: float = 0.0, guided: str = "",
+                spec_draft: bool = False) -> int:
     """Decode/serving benchmark — one JSON line. Every serving claim in
     BASELINE.md is reproducible from here: ``--engine continuous`` ticks the
     production slot engine (``--cache paged`` for the page pool + Pallas
@@ -270,6 +277,32 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         ]
     else:
         raise SystemExit(f"unknown --infer-workload {workload!r}")
+    if spec_draft and (not speculative or engine != "continuous"):
+        raise SystemExit(
+            "--spec-draft needs --speculative --engine continuous"
+        )
+    draft_params = draft_cfg = None
+    if spec_draft:
+        # A ~10x-smaller DRAFT model for model-based speculation. On the
+        # repetitive workload it is fine-tuned on the same pattern as the
+        # target, so its greedy predictions track the target's — the
+        # acceptance lever that works off workload PREDICTABILITY rather
+        # than verbatim self-similarity (prompt-lookup's requirement).
+        draft_cfg = dataclasses.replace(
+            cfg, name="bench-draft", hidden_size=512, intermediate_size=1408,
+            num_layers=6, num_heads=8, num_kv_heads=4,
+            num_experts=0, num_experts_per_tok=0,
+        )
+        if platform != "tpu":
+            draft_cfg = dataclasses.replace(
+                draft_cfg, num_layers=1, hidden_size=128,
+                intermediate_size=344,
+            )
+        draft_params = llama.init_params(jax.random.key(11), draft_cfg)
+        if workload == "repetitive":
+            draft_params = _repetitive_finetune(
+                draft_params, draft_cfg, pattern, n_steps, batch, seq
+            )
     if quantize:
         from ditl_tpu.ops.quant import quantize_weights
 
@@ -302,6 +335,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 # auto-decision's own probing is pinned by tests.
                 spec_threshold=0.0 if speculative else None,
                 fsm_capacity=(grammar.n_states + 2) if grammar else 0,
+                draft_params=draft_params, draft_cfg=draft_cfg,
             )
 
         def run_once(eng):
@@ -343,6 +377,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 round(st["acceptance_ema"], 2)
                 if st["acceptance_ema"] is not None else None
             )
+            extra["drafter"] = st["drafter"]
     else:
         from ditl_tpu.infer.engine import GenerateConfig, Generator
 
@@ -393,7 +428,10 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
     return 0
 
 
-def main(model_name: str = "350m") -> int:
+def main(model_name: str = "350m", overrides: list[str] | None = None,
+         batch_override: int = 0, seq_override: int = 0) -> int:
+    import dataclasses
+
     import jax
     import numpy as np
 
@@ -409,6 +447,20 @@ def main(model_name: str = "350m") -> int:
     print(f"bench: {n_chips} {platform} device(s)", file=sys.stderr)
 
     cfg, batch, seq, optimizer = _model_cfg(model_name, platform)
+    if overrides:
+        # Same dotted-override machinery as the launcher/server: sweep a
+        # config knob without editing the pinned bench config.
+        from ditl_tpu.config import Config, parse_overrides
+
+        cfg = parse_overrides(
+            Config(model=cfg), [f"model.{o}" for o in overrides]
+        ).model
+        print(f"bench: overrides {overrides}", file=sys.stderr)
+    if batch_override:
+        batch = batch_override
+    if seq_override:
+        seq = seq_override
+        cfg = dataclasses.replace(cfg, max_seq_len=max(cfg.max_seq_len, seq))
     tcfg = TrainConfig(total_steps=1000, warmup_steps=10, optimizer=optimizer)
     mesh = build_mesh(MeshConfig())
 
@@ -473,13 +525,20 @@ def main(model_name: str = "350m") -> int:
               file=sys.stderr)
 
     anchors = {"1b3": R02_1B3_BASELINE_TPS, "350m": R01_350M_BASELINE_TPS}
+    swept = bool(overrides or batch_override or seq_override)
     result = {
         "metric": "fine-tune tokens/sec/chip (Llama-style %dM, bf16, seq %d)"
                   % (round(params_m), seq),
         "value": round(tps_chip, 1),
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tps_chip / anchors[model_name], 4)
-                       if platform == "tpu" else 1.0,
+        # A swept run measures a DIFFERENT config/workload than the pinned
+        # anchor — comparing would misattribute progress, so swept runs
+        # carry their knobs in the JSON and no vs_baseline.
+        "vs_baseline": (
+            None if swept
+            else round(tps_chip / anchors[model_name], 4)
+            if platform == "tpu" else 1.0
+        ),
         "step_time_p50_ms": round(p50 * 1e3, 2),
         "n_chips": n_chips,
         "platform": platform,
@@ -487,6 +546,11 @@ def main(model_name: str = "350m") -> int:
         "loss_start": round(loss_start, 4),
         "final_loss": round(final_loss, 4),
     }
+    if swept:
+        result["swept"] = {
+            "overrides": list(overrides or []),
+            "batch": batch, "seq": seq,
+        }
     peak = _peak_flops(jax.devices()[0])
     if peak:
         train_flops_per_token = 3 * _model_flops_per_token(cfg, seq)
@@ -551,13 +615,32 @@ if __name__ == "__main__":
                         "anything else = a regex; \"(.|\\n)*\" measures the "
                         "FSM machinery's overhead against the same command "
                         "without --guided")
+    parser.add_argument("--spec-draft", action="store_true",
+                        help="model-based speculation (--infer --engine "
+                        "continuous --speculative): a ~10x-smaller draft "
+                        "model drafts (fine-tuned alongside the target on "
+                        "the repetitive workload) instead of prompt lookup")
+    parser.add_argument("--override", action="append", default=[],
+                        metavar="FIELD=VALUE",
+                        help="ModelConfig override for the TRAIN bench "
+                        "(repeatable), e.g. flash_block_q=2048 — sweep a "
+                        "knob without editing the pinned config")
+    parser.add_argument("--batch", type=int, default=0,
+                        help="train-bench batch override (0 = config default)")
+    parser.add_argument("--seq", type=int, default=0,
+                        help="train-bench seq-len override (0 = config default)")
     args = parser.parse_args()
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"
                   or args.infer_workload != "random" or args.moe
-                  or args.prompt_len or args.max_new or args.guided)
+                  or args.prompt_len or args.max_new or args.guided
+                  or args.spec_draft)
     if infer_only and not args.infer:
         parser.error("serving flags require --infer")
+    if args.infer and (args.override or args.batch or args.seq):
+        parser.error("--override/--batch/--seq sweep the TRAIN bench only; "
+                     "the serving bench has its own knobs (--slots, "
+                     "--decode-chunk, --prompt-len, --max-new, ...)")
     if args.infer:
         sys.exit(bench_infer(
             engine=args.engine, cache=args.cache,
@@ -568,5 +651,7 @@ if __name__ == "__main__":
             page_size=args.page_size, moe=args.moe,
             prompt_len=args.prompt_len, max_new=args.max_new,
             temperature=args.temperature, guided=args.guided,
+            spec_draft=args.spec_draft,
         ))
-    sys.exit(main(args.model))
+    sys.exit(main(args.model, overrides=args.override,
+                  batch_override=args.batch, seq_override=args.seq))

@@ -237,6 +237,8 @@ class ContinuousEngine:
         spec_ema: float = 0.7,
         logprobs_k: int = 0,
         fsm_capacity: int = 0,
+        draft_params: llama.Params | None = None,
+        draft_cfg: ModelConfig | None = None,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -496,13 +498,65 @@ class ContinuousEngine:
             self.spec_ticks = 0
             self._tick_no = 0
             self._spec_decode: dict[tuple, Any] = {}  # key: (paged?, sampled?)
+        # -- model-based drafting (draft_params + draft_cfg) -------------
+        # A small DRAFT model supplies speculative tokens instead of prompt
+        # lookup: k sequential draft-model decode steps inside the spec
+        # tick (the drafter is small, so k tiny forwards cost less than the
+        # big model's k+1-wide verify), verified by the target exactly as
+        # lookup drafts are — exactness never depends on the drafter. The
+        # draft model keeps its own contiguous per-slot KV cache: feeding
+        # the pending ``cur`` at ``pos`` each round writes the KV the
+        # previous round's bonus token never got (self-healing), and
+        # rejected positions' stale KV stays masked by position, so
+        # rollback is free. Acceptance on natural text comes from the
+        # drafter's quality (train one on your data), not the workload's
+        # self-similarity — the lever prompt-lookup cannot reach.
+        self.spec_draft = "lookup"
+        if draft_params is not None or draft_cfg is not None:
+            if draft_params is None or draft_cfg is None:
+                raise ValueError(
+                    "draft_params and draft_cfg must be given together"
+                )
+            if not speculative:
+                raise ValueError(
+                    "a draft model needs speculative=True (it drafts for "
+                    "speculative ticks)"
+                )
+            if draft_cfg.vocab_size != model_cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} must match the "
+                    f"target's {model_cfg.vocab_size} (same token space)"
+                )
+            if draft_cfg.max_seq_len < self.smax:
+                raise ValueError(
+                    f"draft max_seq_len {draft_cfg.max_seq_len} is below "
+                    f"the serving context cap {self.smax}"
+                )
+            self.spec_draft = "model"
+            self.draft_params = draft_params
+            self.draft_cfg = draft_cfg
+            self.draft_cache = init_cache(draft_cfg, n_slots, self.smax)
+            if mesh is not None:
+                from ditl_tpu.infer.cache import cache_logical_axes
+                from ditl_tpu.parallel.sharding import named_sharding_tree
+
+                self.draft_cache = jax.device_put(
+                    self.draft_cache,
+                    named_sharding_tree(
+                        mesh, cache_logical_axes(draft_cfg), rules
+                    ),
+                )
+            self._draft_prefill_cache: dict[int, Any] = {}
+
         # Per-slot token history (prompt + generated incl. the pending
         # ``cur``) — the draft source for speculative ticks. Rides the tick
         # carry; host writes it only at admission. 1-wide dummy when
-        # speculation is off (the programs take it either way; XLA drops the
-        # dead argument).
+        # speculation is off or drafting is model-based (the programs take
+        # it either way; XLA drops the dead argument).
         self.hist = jnp.zeros(
-            (n_slots, self.smax if speculative else 1), jnp.int32
+            (n_slots,
+             self.smax if speculative and self.spec_draft == "lookup" else 1),
+            jnp.int32,
         )
 
         # -- per-token logprobs (OpenAI semantics) -----------------------
@@ -690,6 +744,85 @@ class ContinuousEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
+    def _build_draft_prefill(self, p_bucket: int):
+        """Prefill one slot of the DRAFT model's cache with the prompt.
+        No sampling: the drafter's first prediction happens inside the spec
+        tick (feeding the pending ``cur`` at ``pos``). Always a full-prompt
+        prefill — the drafter is small, and prefix seams (main-cache prefix
+        reuse, chunked main prefill) don't apply to its private cache."""
+        dcfg = self.draft_cfg
+
+        def run(dparams, dcache, ids, length, slot):
+            row = jax.tree.map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
+                dcache,
+            )
+            q_pos = jnp.arange(p_bucket, dtype=jnp.int32)
+            seg = (q_pos[None, :] < length).astype(jnp.int32)
+            _, row = llama.forward(
+                dparams, ids, dcfg, positions=q_pos[None], segment_ids=seg,
+                cache=row, cache_index=jnp.int32(0),
+                mesh=self.mesh, rules=self.rules, prefill_causal=True,
+            )
+            return jax.tree.map(
+                lambda c, r: jax.lax.dynamic_update_slice_in_dim(
+                    c, r, slot, axis=1
+                ),
+                dcache,
+                row,
+            )
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _draft_prefill(self, req: Request, slot: int) -> None:
+        """Admission hook (model drafting only): load the prompt into the
+        draft model's cache for ``slot``."""
+        if self.spec_draft != "model":
+            return
+        p_bucket = min(_next_pow2(len(req.prompt), floor=16), self.smax)
+        if p_bucket not in self._draft_prefill_cache:
+            logger.info("compiling draft prefill for bucket %d", p_bucket)
+            self._draft_prefill_cache[p_bucket] = self._build_draft_prefill(
+                p_bucket
+            )
+        ids = np.full((1, p_bucket), self.tokenizer.pad_id, np.int32)
+        ids[0, : len(req.prompt)] = req.prompt
+        self.draft_cache = self._draft_prefill_cache[p_bucket](
+            self.draft_params, self.draft_cache, jnp.asarray(ids),
+            jnp.int32(len(req.prompt)), jnp.int32(slot),
+        )
+
+    def _draft_scan(self, dparams, dcache, cur, pos, smax):
+        """k greedy draft-model decode steps from the pending ``cur``:
+        returns (new dcache, (B, k) drafts). The scan runs k+1 feeds —
+        ``cur`` then ALL k drafts — so every drafted token's KV is written
+        (feeding only k would leave the last draft's position unwritten
+        forever on a full-accept round, and the next scan's mask would
+        attend the hole); the final output token is discarded. Feeding
+        ``cur`` at ``pos`` also writes the KV the previous round's bonus
+        token never got, and stale KV beyond a row's position stays masked
+        until the position is re-fed — so rejected drafts need no
+        rollback. ``dparams`` is a program ARGUMENT (a closure constant
+        would bake the draft weights into the executable)."""
+        dcfg, k = self.draft_cfg, self.spec_k
+        slots_iota = jnp.arange(smax, dtype=jnp.int32)
+
+        def step(carry, _):
+            dcache, tok, p = carry
+            mask = (slots_iota[None, :] <= p[:, None])[:, None, :]
+            lg, dcache = llama.forward(
+                dparams, tok[:, None], dcfg, positions=p[:, None],
+                cache=dcache, cache_index=p, attn_mask=mask,
+                mesh=self.mesh, rules=self.rules,
+            )
+            nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+            return (dcache, nxt, jnp.minimum(p + 1, smax - 1)), nxt
+
+        (dcache, _, _), drafts = jax.lax.scan(
+            step, (dcache, cur, pos), None, length=k + 1
+        )
+        return dcache, drafts.T[:, :k]  # (B, k); the k+1-th is discarded
+
     def _fsm_spec_path(self, ftab, fstates, draft):
         """Grammar states along the speculative draft path: ``path[:, 0]``
         is the row's current state, ``path[:, j+1]`` the state after
@@ -775,11 +908,19 @@ class ContinuousEngine:
         n_lp = self.logprobs_k
 
         guided = self.guided
+        model_draft = self.spec_draft == "model"
 
         def run(params, cache, cur, pos, alive, hist, temps, top_ps, keys,
                 adapters, *extra):
-            ftab, fstates = (extra[0], extra[1]) if guided else (None, None)
-            lp0 = extra[2:] if guided else extra
+            i = 0
+            dparams = dcache0 = None
+            if model_draft:
+                dparams, dcache0 = extra[0], extra[1]
+                i = 2
+            ftab, fstates = (
+                (extra[i], extra[i + 1]) if guided else (None, None)
+            )
+            lp0 = extra[i + 2 :] if guided else extra[i:]
             n_b = pos.shape[0]
             out0 = jnp.full((n_b, out_len), pad, jnp.int32)
             zeros = jnp.zeros((n_b,), jnp.int32)
@@ -791,16 +932,21 @@ class ContinuousEngine:
             )
 
             def body(carry, _):
-                (cache, cur, pos, done, hist, out, n_out, rr, keys, fst, lp,
-                 bufs) = carry
+                (cache, dcache, cur, pos, done, hist, out, n_out, rr, keys,
+                 fst, lp, bufs) = carry
                 live = ~done
                 split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
-                # ctx_len = pos + 1: hist[pos] holds the pending ``cur``.
-                draft = device_lookup_draft(
-                    hist, jnp.minimum(pos + 1, smax), k=k, ngram=ngram,
-                    min_ngram=min_ngram,
-                )  # (B, k)
+                if model_draft:
+                    dcache, draft = self._draft_scan(
+                        dparams, dcache, cur, pos, smax
+                    )
+                else:
+                    # ctx_len = pos + 1: hist[pos] holds the pending ``cur``.
+                    draft = device_lookup_draft(
+                        hist, jnp.minimum(pos + 1, smax), k=k, ngram=ngram,
+                        min_ngram=min_ngram,
+                    )  # (B, k)
                 tokens_in = jnp.concatenate([cur[:, None], draft], axis=1)
                 positions = pos[:, None] + q_idx[None, :]  # (B, K+1)
                 mask = slots_iota[None, None, :] <= positions[:, :, None]
@@ -852,9 +998,10 @@ class ContinuousEngine:
                     jnp.concatenate([draft, zeros[:, None]], axis=1),
                 )
                 grow = jnp.where(hit_term, 0, e)
-                hist = _emit_rows(
-                    hist, append_seq, jnp.minimum(pos + 1, smax), grow
-                )
+                if not model_draft:
+                    hist = _emit_rows(
+                        hist, append_seq, jnp.minimum(pos + 1, smax), grow
+                    )
                 pos = jnp.where(
                     live, jnp.minimum(pos + e, smax - 1), pos
                 )
@@ -864,22 +1011,25 @@ class ContinuousEngine:
                     fst = jnp.where(done, fst, _fsm_next(ftab, s_at, nxt_tok))
                 cur = jnp.where(done, pad, nxt_tok)
                 rr = rr + live.astype(jnp.int32)
-                return (cache, cur, pos, done, hist, out, n_out, rr, keys,
-                        fst, lp, bufs), None
+                return (cache, dcache, cur, pos, done, hist, out, n_out, rr,
+                        keys, fst, lp, bufs), None
 
             fst0 = fstates if guided else jnp.zeros((), jnp.int32)
-            (cache, cur, pos, done, hist, out, n_out, rr, keys, fst, lp,
-             bufs), _ = jax.lax.scan(
+            dc0 = dcache0 if model_draft else jnp.zeros((), jnp.int32)
+            (cache, dcache, cur, pos, done, hist, out, n_out, rr, keys, fst,
+             lp, bufs), _ = jax.lax.scan(
                 body,
-                (cache, cur, pos, ~alive, hist, out0, zeros, zeros, keys,
-                 fst0, tuple(lp0), bufs0),
+                (cache, dc0, cur, pos, ~alive, hist, out0, zeros, zeros,
+                 keys, fst0, tuple(lp0), bufs0),
                 None, length=rounds,
             )
             fs = (fst,) if guided else ()
-            return (cache, cur, pos, hist, keys, *fs, out, n_out, rr, lp,
-                    bufs)
+            dc = (dcache,) if model_draft else ()
+            return (cache, *dc, cur, pos, hist, keys, *fs, out, n_out, rr,
+                    lp, bufs)
 
-        return jax.jit(run, donate_argnums=(1,))
+        donate = (1, 11) if model_draft else (1,)
+        return jax.jit(run, donate_argnums=donate)
 
     # -- prefix caching ------------------------------------------------------
 
@@ -1198,11 +1348,19 @@ class ContinuousEngine:
         n_lp = self.logprobs_k
 
         guided = self.guided
+        model_draft = self.spec_draft == "model"
 
         def run(params, pools, cur, pos, alive, table, limits, hist, temps,
                 top_ps, keys, adapters, *extra):
-            ftab, fstates = (extra[0], extra[1]) if guided else (None, None)
-            lp0 = extra[2:] if guided else extra
+            i = 0
+            dparams = dcache0 = None
+            if model_draft:
+                dparams, dcache0 = extra[0], extra[1]
+                i = 2
+            ftab, fstates = (
+                (extra[i], extra[i + 1]) if guided else (None, None)
+            )
+            lp0 = extra[i + 2 :] if guided else extra[i:]
             n_b = pos.shape[0]
             starts = pos
             tk0 = jnp.zeros((L, n_b, K, tail_len, D), dt)
@@ -1218,16 +1376,24 @@ class ContinuousEngine:
             )
 
             def body(carry, _):
-                (tk, tv, cur, pos, done, hist, out, n_out, rr, keys, fst,
-                 lp, bufs) = carry
+                (tk, tv, dcache, cur, pos, done, hist, out, n_out, rr, keys,
+                 fst, lp, bufs) = carry
                 done = done | (pos >= limits)
                 live = ~done
                 split = jax.vmap(lambda kk: jax.random.split(kk, 2))(keys)
                 keys, subs = split[:, 0], split[:, 1]
-                draft = device_lookup_draft(
-                    hist, jnp.minimum(pos + 1, smax), k=k, ngram=ngram,
-                    min_ngram=min_ngram,
-                )
+                if model_draft:
+                    # The DRAFT cache stays contiguous even under a paged
+                    # target: it is per-slot small, and page-granular
+                    # sharing buys nothing for a private scratch model.
+                    dcache, draft = self._draft_scan(
+                        dparams, dcache, cur, pos, smax
+                    )
+                else:
+                    draft = device_lookup_draft(
+                        hist, jnp.minimum(pos + 1, smax), k=k, ngram=ngram,
+                        min_ngram=min_ngram,
+                    )
                 tokens_in = jnp.concatenate([cur[:, None], draft], axis=1)
                 positions = pos[:, None] + q_idx[None, :]
                 lengths = jnp.where(live, pos + 1, 0)
@@ -1273,9 +1439,10 @@ class ContinuousEngine:
                     jnp.concatenate([draft, zeros[:, None]], axis=1),
                 )
                 grow = jnp.where(hit_term, 0, e)
-                hist = _emit_rows(
-                    hist, append_seq, jnp.minimum(pos + 1, smax), grow
-                )
+                if not model_draft:
+                    hist = _emit_rows(
+                        hist, append_seq, jnp.minimum(pos + 1, smax), grow
+                    )
                 pos = jnp.where(live, pos + e, pos)
                 done = done | hit_term
                 if guided:
@@ -1283,14 +1450,15 @@ class ContinuousEngine:
                     fst = jnp.where(done, fst, _fsm_next(ftab, s_at, nxt_tok))
                 cur = jnp.where(done, pad, nxt_tok)
                 rr = rr + live.astype(jnp.int32)
-                return (tk, tv, cur, pos, done, hist, out, n_out, rr,
-                        keys, fst, lp, bufs), None
+                return (tk, tv, dcache, cur, pos, done, hist, out, n_out,
+                        rr, keys, fst, lp, bufs), None
 
             fst0 = fstates if guided else jnp.zeros((), jnp.int32)
-            (tk, tv, cur, pos, done, hist, out, n_out, rr, keys, fst, lp,
-             bufs), _ = jax.lax.scan(
+            dc0 = dcache0 if model_draft else jnp.zeros((), jnp.int32)
+            (tk, tv, dcache, cur, pos, done, hist, out, n_out, rr, keys,
+             fst, lp, bufs), _ = jax.lax.scan(
                 body,
-                (tk0, tv0, cur, pos, ~alive, hist, out0, zeros, zeros,
+                (tk0, tv0, dc0, cur, pos, ~alive, hist, out0, zeros, zeros,
                  keys, fst0, tuple(lp0), bufs0),
                 None, length=rounds,
             )
@@ -1298,10 +1466,12 @@ class ContinuousEngine:
                 pools, tk, tv, starts, pos, table, ps, tail_len
             )
             fs = (fst,) if guided else ()
-            return (pools_out, cur, pos, hist, keys, *fs, out, n_out, rr,
-                    lp, bufs)
+            dc = (dcache,) if model_draft else ()
+            return (pools_out, *dc, cur, pos, hist, keys, *fs, out, n_out,
+                    rr, lp, bufs)
 
-        return jax.jit(run, donate_argnums=(1,))
+        donate = (1, 13) if model_draft else (1,)
+        return jax.jit(run, donate_argnums=donate)
 
     def register_prefix(self, prefix_tokens: list[int]) -> None:
         """Prefill ``prefix_tokens`` once and reuse the KV for every future
@@ -1630,6 +1800,7 @@ class ContinuousEngine:
                 self.pos = self.pos.at[req.slot].set(len(req.prompt))
                 self.keys = self.keys.at[req.slot].set(slot_key)
                 self._set_hist(req.slot, req.prompt, first)
+                self._draft_prefill(req, req.slot)
             return
         d = req.prefill_pos
         s = min(self.prefill_chunk, len(req.prompt) - d)
@@ -1661,6 +1832,7 @@ class ContinuousEngine:
             self.pos = self.pos.at[req.slot].set(len(req.prompt))
             self.keys = self.keys.at[req.slot].set(slot_key)
             self._set_hist(req.slot, req.prompt, first)
+            self._draft_prefill(req, req.slot)
 
     def _take_prefill(self, out, slot: int | None):
         """Unpack a prefill program's outputs: store the new cache and —
@@ -1697,7 +1869,7 @@ class ContinuousEngine:
         """Seed the slot's draft history: prompt tokens plus the pending
         first sampled token (``hist[pos] == cur`` is the tick invariant).
         ``first`` stays a device scalar — no host sync on admission."""
-        if not self.speculative:
+        if not self.speculative or self.spec_draft != "lookup":
             return
         row = np.zeros((self.smax,), np.int32)
         n = min(len(prompt), self.smax - 1)
@@ -1836,6 +2008,7 @@ class ContinuousEngine:
             self.cur = self.cur.at[slot].set(first)
             self.pos = self.pos.at[slot].set(len(req.prompt))
             self._set_hist(slot, req.prompt, first)
+            self._draft_prefill(req, slot)
         self.temps = self.temps.at[slot].set(req.temperature)
         self.top_ps = self.top_ps.at[slot].set(req.top_p)
         self.keys = self.keys.at[slot].set(slot_key)
@@ -1871,6 +2044,7 @@ class ContinuousEngine:
                 self.cur = self.cur.at[slot].set(first)
                 self.pos = self.pos.at[slot].set(len(req.prompt))
                 self._set_hist(slot, req.prompt, first)
+                self._draft_prefill(req, slot)
             self.temps = self.temps.at[slot].set(req.temperature)
             self.top_ps = self.top_ps.at[slot].set(req.top_p)
             self.keys = self.keys.at[slot].set(slot_key)
@@ -2055,6 +2229,14 @@ class ContinuousEngine:
         the mix still accept by argmax, bit-exactly)."""
         if not self.speculative:
             return False
+        if self.spec_draft == "model":
+            # Model-based drafting speculates EVERY tick: the draft cache
+            # stays position-synchronized only while spec ticks run (plain
+            # ticks would advance the target without the drafter), and a
+            # drafter is configured precisely because it pays on the
+            # workload. The acceptance EMA still reports quality.
+            self._tick_no += 1
+            return True
         self._tick_no += 1
         preds = []
         for r in active:
@@ -2086,26 +2268,32 @@ class ContinuousEngine:
         fsm_args = (
             (self._fsm_device(), self.fstates) if self.guided else ()
         )
+        draft_args = (
+            (self.draft_params, self.draft_cache)
+            if self.spec_draft == "model" else ()
+        )
         t0 = _time.perf_counter()
         if paged:
             res = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self._table_device(), self.limits, self.hist,
                 self.temps, self.top_ps, self.keys, self.adapters,
-                *fsm_args, *lp_args,
+                *draft_args, *fsm_args, *lp_args,
             )
         else:
             res = self._spec_decode[key](
                 self.params, self.cache, self.cur, self.pos, alive,
                 self.hist, self.temps, self.top_ps, self.keys, self.adapters,
-                *fsm_args, *lp_args,
+                *draft_args, *fsm_args, *lp_args,
             )
+        res = list(res)
+        self.cache = res.pop(0)
+        if self.spec_draft == "model":
+            self.draft_cache = res.pop(0)
+        (self.cur, self.pos, self.hist, self.keys, *res) = res
         if self.guided:
-            (self.cache, self.cur, self.pos, self.hist, self.keys,
-             self.fstates, toks, counts, rr, lp_state, lp_bufs) = res
-        else:
-            (self.cache, self.cur, self.pos, self.hist, self.keys, toks,
-             counts, rr, lp_state, lp_bufs) = res
+            self.fstates = res.pop(0)
+        (toks, counts, rr, lp_state, lp_bufs) = res
         # ONE device_get for every host-consumed output: each separate fetch
         # is a full round trip on remote-device transports (~100 ms here) —
         # three sequential fetches per tick erased the speculative win.
@@ -2272,6 +2460,7 @@ class ContinuousEngine:
             }
         if self.speculative:
             out["speculative"] = {
+                "drafter": self.spec_draft,
                 "k": self.spec_k,
                 "rounds_per_tick": self.spec_rounds,
                 "threshold": self.spec_threshold,

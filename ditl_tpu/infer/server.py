@@ -170,8 +170,57 @@ class _Handler(BaseHTTPRequestHandler):
                 for name in self.adapter_names
             ]
             self._send_json(200, {"object": "list", "data": models})
+        elif self.path == "/metrics":
+            self._metrics()
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _metrics(self) -> None:
+        """Prometheus text exposition of the same host-only state /v1/stats
+        serves (no device sync): numeric leaves become
+        ``ditl_serving_<path>`` gauges, nested dicts flatten with ``_``.
+        Lets a standard scrape-based stack watch slot occupancy, queue
+        depth, page pool, speculation acceptance, and guided-table usage
+        without custom glue."""
+        stats: dict = {}
+        eng = self._engine_for_stats()
+        if eng is not None:
+            stats.update(eng.stats())
+        spec = self.spec_generator
+        if spec is not None:
+            # Lock-step speculative serving (no continuous engine): surface
+            # the same acceptance /v1/stats reports.
+            stats["lockstep_speculative"] = True
+            acc = getattr(spec, "acceptance_ema", None)
+            if acc is None:
+                acc = getattr(getattr(spec, "spec", spec),
+                              "last_acceptance", None)
+            if acc is not None:
+                stats["lockstep_speculative_acceptance"] = round(acc, 3)
+
+        lines: list[str] = []
+
+        def emit(prefix: str, obj) -> None:
+            if isinstance(obj, dict):
+                for k, v in obj.items():
+                    emit(f"{prefix}_{k}" if prefix else str(k), v)
+            elif isinstance(obj, bool):
+                lines.append(f"# TYPE ditl_serving_{prefix} gauge")
+                lines.append(f"ditl_serving_{prefix} {int(obj)}")
+            elif isinstance(obj, (int, float)) and obj == obj:  # drop NaN
+                lines.append(f"# TYPE ditl_serving_{prefix} gauge")
+                lines.append(f"ditl_serving_{prefix} {obj}")
+            # strings (engine/cache_mode names) have no gauge form; skip
+
+        emit("", stats)
+        lines.append("# TYPE ditl_serving_up gauge")
+        lines.append("ditl_serving_up 1")
+        body = ("\n".join(lines) + "\n").encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _engine_for_stats(self):
         """The serving driver, if any (both drivers expose ``stats()``)."""
@@ -1069,6 +1118,18 @@ def serve(argv: list[str] | None = None) -> int:
         "json_object. 0 = off",
     )
     parser.add_argument(
+        "--draft-preset", default="",
+        help="model-based speculation (--speculative --engine continuous): "
+        "preset name of a small DRAFT model whose greedy predictions draft "
+        "for the target's verify forwards (same tokenizer/vocab); every "
+        "tick speculates. Pair with --draft-checkpoint for trained weights",
+    )
+    parser.add_argument(
+        "--draft-checkpoint", default="",
+        help="Orbax checkpoint dir for --draft-preset's weights "
+        "(random-init without it — only useful for smoke tests)",
+    )
+    parser.add_argument(
         "--cache-mode", choices=("contiguous", "paged"), default="contiguous",
         help="KV cache layout for --engine continuous: 'paged' pools KV in "
         "content-hashed pages with automatic prefix reuse "
@@ -1147,6 +1208,14 @@ def serve(argv: list[str] | None = None) -> int:
         parser.error("--fsm-capacity (guided decoding) requires --engine "
                      "continuous: grammar masks ride the slot scheduler's "
                      "decode ticks")
+    if args.draft_preset and (
+        args.engine != "continuous" or args.speculative == "off"
+    ):
+        parser.error("--draft-preset requires --engine continuous with "
+                     "--speculative on|auto (the draft model drafts for "
+                     "speculative ticks)")
+    if args.draft_checkpoint and not args.draft_preset:
+        parser.error("--draft-checkpoint needs --draft-preset")
     if args.fsm_capacity and args.pod:
         parser.error("--fsm-capacity does not compose with --pod yet (the "
                      "tick broadcast does not carry grammar registrations)")
@@ -1246,6 +1315,31 @@ def serve(argv: list[str] | None = None) -> int:
         params = quantize_weights(params)
         logger.info("quantized weights to int8 (weight-only)")
     generator = Generator(params, cfg, tokenizer, mesh=mesh)
+    draft_params = draft_cfg = None
+    if args.draft_preset:
+        draft_cfg = get_preset(args.draft_preset)
+        if draft_cfg.vocab_size != cfg.vocab_size:
+            parser.error(
+                f"--draft-preset vocab {draft_cfg.vocab_size} must match "
+                f"the target's {cfg.vocab_size} (same token space)"
+            )
+        draft_params = llama.init_params(jax.random.key(1), draft_cfg)
+        if args.draft_checkpoint:
+            from ditl_tpu.train.checkpoint import CheckpointManager
+
+            ckpt = CheckpointManager(args.draft_checkpoint)
+            restored = ckpt.restore_latest_params(
+                jax.eval_shape(lambda: draft_params)
+            )
+            ckpt.close()
+            if restored is None:
+                parser.error(
+                    f"--draft-checkpoint: no checkpoint in "
+                    f"{args.draft_checkpoint}"
+                )
+            draft_params = restored
+            logger.info("restored draft params from %s", args.draft_checkpoint)
+
     def build_engine():
         from ditl_tpu.infer.continuous import ContinuousEngine
 
@@ -1264,6 +1358,7 @@ def serve(argv: list[str] | None = None) -> int:
             spec_threshold=0.0 if args.speculative == "on" else None,
             logprobs_k=args.logprobs_k,
             fsm_capacity=args.fsm_capacity,
+            draft_params=draft_params, draft_cfg=draft_cfg,
         )
 
     if args.pod and jax.process_index() != 0:

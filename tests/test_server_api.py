@@ -171,6 +171,31 @@ def test_best_of_validation(setup):
         threaded.close()
 
 
+def test_prometheus_metrics_endpoint(setup):
+    params, cfg, tok = setup
+    server, threaded, port = _serve(params, cfg, tok, continuous=True)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        assert "ditl_serving_up 1" in body
+        assert "ditl_serving_n_slots 8" in body
+        assert "# TYPE ditl_serving_queue_depth gauge" in body
+        # every non-comment line parses as "name value"
+        for line in body.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name, value = line.split(" ", 1)
+            float(value)
+            assert name.startswith("ditl_serving_")
+    finally:
+        server.shutdown()
+        threaded.close()
+
+
 def test_generate_many_cancels_orphans_on_midloop_failure(setup):
     """A QueueFullError on copy k must cancel copies 0..k-1: no unconsumed
     Request may park in ThreadedEngine._results, and the engine drains."""
