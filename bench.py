@@ -641,6 +641,11 @@ if __name__ == "__main__":
         parser.error("--override/--batch/--seq sweep the TRAIN bench only; "
                      "the serving bench has its own knobs (--slots, "
                      "--decode-chunk, --prompt-len, --max-new, ...)")
+    if args.spec_draft and (not args.speculative
+                            or args.engine != "continuous"):
+        # Validate HERE, not after bench_infer's expensive fine-tune has
+        # already burned minutes of chip time.
+        parser.error("--spec-draft needs --speculative --engine continuous")
     if args.infer:
         sys.exit(bench_infer(
             engine=args.engine, cache=args.cache,

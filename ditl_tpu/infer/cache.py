@@ -49,9 +49,15 @@ def init_cache(cfg: ModelConfig, batch_size: int, max_len: int) -> dict:
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
-def cache_logical_axes(cfg: ModelConfig) -> dict:
-    """Logical axes for the cache pytree (same table as params/activations)."""
-    axes = ("layers", "batch", None, "act_kv_heads", "head_dim")
+def cache_logical_axes(cfg: ModelConfig, *, seq_sharded: bool = False) -> dict:
+    """Logical axes for the cache pytree (same table as params/activations).
+    ``seq_sharded`` splits the CONTEXT dim over the ``cache_seq`` rule
+    (sequence mesh axis): per-device cache memory and attention reads drop
+    by the shard factor, and decode merges per-shard partial softmax over
+    ICI (ops/attention._seq_sharded_decode) — long-context serving beyond
+    one chip's HBM."""
+    seq = "cache_seq" if seq_sharded else None
+    axes = ("layers", "batch", seq, "act_kv_heads", "head_dim")
     out = {"k": axes, "v": axes}
     if cfg.kv_cache_dtype == "int8":
         out["k_scale"] = axes[:-1]

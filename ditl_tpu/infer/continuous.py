@@ -408,11 +408,24 @@ class ContinuousEngine:
             self.cache = init_cache(model_cfg, n_slots, self.smax)
             if mesh is not None:
                 from ditl_tpu.infer.cache import cache_logical_axes
-                from ditl_tpu.parallel.sharding import named_sharding_tree
+                from ditl_tpu.parallel.sharding import (
+                    named_sharding_tree,
+                    seq_shards,
+                )
 
+                seq_n = seq_shards(mesh, rules)
+                if seq_n > 1 and self.smax % seq_n:
+                    raise ValueError(
+                        f"sequence-sharded serving needs max context "
+                        f"{self.smax} divisible by the sequence axis {seq_n}"
+                    )
                 self.cache = jax.device_put(
                     self.cache,
-                    named_sharding_tree(mesh, cache_logical_axes(model_cfg), rules),
+                    named_sharding_tree(
+                        mesh,
+                        cache_logical_axes(model_cfg, seq_sharded=seq_n > 1),
+                        rules,
+                    ),
                 )
         self.cur = jnp.full((n_slots,), tokenizer.pad_id, jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)

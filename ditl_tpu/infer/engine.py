@@ -118,6 +118,16 @@ class Generator:
                 f"prompt {prompt_len} + max_new {gen.max_new_tokens} exceeds "
                 f"model max_seq_len {cfg.max_seq_len}"
             )
+        from ditl_tpu.parallel.sharding import seq_shards
+
+        seq_n = seq_shards(mesh, rules)
+        if seq_n > 1:
+            # Round the cache up so the context dim always divides the
+            # sequence axis — sequence-sharded serving must never silently
+            # fall back to a replicated cache (the continuous engine raises
+            # for the same condition; here the bucket is internal, so
+            # padding it is the kinder fix).
+            max_len = -(-max_len // seq_n) * seq_n
         pad_id = jnp.int32(self.tokenizer.pad_id)
         eos_id = jnp.int32(self.tokenizer.eos_id)
         slots = jnp.arange(max_len, dtype=jnp.int32)
@@ -126,8 +136,14 @@ class Generator:
             cache = init_cache(cfg, batch, max_len)
             if mesh is not None:
                 from ditl_tpu.parallel.sharding import named_sharding_tree
+
                 cache = jax.lax.with_sharding_constraint(
-                    cache, named_sharding_tree(mesh, cache_logical_axes(cfg), rules)
+                    cache,
+                    named_sharding_tree(
+                        mesh,
+                        cache_logical_axes(cfg, seq_sharded=seq_n > 1),
+                        rules,
+                    ),
                 )
             # Prefill: causal over real (non-pad) prompt slots — pure causal
             # self-attention from an empty cache, so the flash kernel

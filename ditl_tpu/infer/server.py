@@ -104,9 +104,19 @@ class _StopTracker:
         return out
 
 
-def _chat_prompt(messages: list[dict]) -> str:
-    """Minimal chat template: the byte/debug tokenizer has no special chat
-    tokens, so roles are rendered as plain text turns."""
+def _chat_prompt(messages: list[dict], tokenizer=None) -> str:
+    """Render chat messages to a prompt string. HF tokenizers that carry a
+    chat template (Llama-3.1 etc.) use it — real special-token turns, the
+    same rendering the model was trained with; the byte/debug tokenizer
+    falls back to plain-text role turns."""
+    inner = getattr(tokenizer, "_tok", None)
+    if inner is not None and getattr(inner, "chat_template", None):
+        try:
+            return inner.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        except Exception:
+            logger.exception("chat template failed; using plain-text turns")
     parts = [f"{m.get('role', 'user')}: {m.get('content', '')}" for m in messages]
     return "\n".join(parts) + "\nassistant:"
 
@@ -244,6 +254,29 @@ class _Handler(BaseHTTPRequestHandler):
             except Exception as e:
                 logger.exception("embeddings failed")
                 self._send_json(500, {"error": {"message": str(e)}})
+        elif path.endswith("/tokenize"):
+            tok = self.generator.tokenizer
+            text = payload.get("prompt")
+            if not isinstance(text, str):
+                self._send_json(400, {"error": {"message":
+                    "tokenize wants a string 'prompt'"}})
+                return
+            ids = tok.encode(text)
+            if payload.get("add_special_tokens", True):
+                ids = [tok.bos_id] + ids
+            self._send_json(200, {"tokens": ids, "count": len(ids),
+                                  "max_model_len": self.generator.cfg.max_seq_len
+                                  if hasattr(self.generator, "cfg") else None})
+        elif path.endswith("/detokenize"):
+            tok = self.generator.tokenizer
+            ids = payload.get("tokens")
+            if not isinstance(ids, list) or any(
+                not isinstance(i, int) for i in ids
+            ):
+                self._send_json(400, {"error": {"message":
+                    "detokenize wants an integer array 'tokens'"}})
+                return
+            self._send_json(200, {"prompt": tok.decode(ids)})
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -695,7 +728,7 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if chat:
                 messages = payload.get("messages") or []
-                prompt = _chat_prompt(messages)
+                prompt = _chat_prompt(messages, self.generator.tokenizer)
             else:
                 prompt = payload.get("prompt") or ""
                 if isinstance(prompt, list):

@@ -76,15 +76,95 @@ def _xla_attention(
 
 
 def _mesh_axes_size(mesh, axes) -> int:
-    """Product of mesh-axis sizes for a rules value (str, tuple, or None)."""
-    if axes is None:
-        return 1
-    if isinstance(axes, str):
-        axes = (axes,)
-    size = 1
-    for a in axes:
-        size *= mesh.shape[a]
-    return size
+    """Product of mesh-axis sizes for a rules value (str, tuple, or None).
+    Canonical definition lives in parallel/sharding.mesh_axes_size; this
+    alias keeps the op module's historical import surface."""
+    from ditl_tpu.parallel.sharding import mesh_axes_size
+
+    return mesh_axes_size(mesh, axes)
+
+
+def _seq_sharded_decode(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: jax.Array,
+    *,
+    mesh,
+    rules,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
+) -> jax.Array:
+    """Flash-decoding over ICI: the KV cache's CONTEXT dim is sharded over
+    the ``sequence`` mesh axis ("cache_seq" rule), each device computes
+    partial attention over its context shard with online-softmax stats
+    (m, l, unnormalized o), and the shards merge with one pmax + two psums
+    — the standard log-sum-exp combine, so the result equals the unsharded
+    softmax up to float addition order. Context capacity then scales with
+    the mesh instead of one chip's HBM, and per-device attention reads
+    drop by the shard factor. int8 KV composes: scales are per-position
+    and shard with their positions."""
+    from ditl_tpu.parallel.sharding import logical_to_spec
+
+    seq_axes = rules.get("cache_seq")
+    seq_axes = (seq_axes,) if isinstance(seq_axes, str) else tuple(seq_axes)
+    scale = q.shape[-1] ** -0.5
+
+    def local(q_, k_, v_, mask_, ks_, vs_):
+        b, s_q, h, d = q_.shape
+        kh = k_.shape[2]
+        g = h // kh
+        qg = q_.reshape(b, s_q, kh, g, d)
+        kk, vv = k_, v_
+        if ks_ is not None:
+            kk = kk.astype(q_.dtype)
+            vv = vv.astype(q_.dtype)
+        scores = jnp.einsum(
+            "bqkgd,bskd->bkgqs", qg, kk, preferred_element_type=jnp.float32
+        ) * scale
+        if ks_ is not None:
+            scores = scores * jnp.moveaxis(ks_, 1, 2)[:, :, None, None, :]
+        scores = jnp.where(mask_[:, None, None], scores, NEG_INF)
+        m = jnp.max(scores, axis=-1)  # (B, K, G, Sq)
+        p = jnp.exp(scores - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        if vs_ is not None:
+            p = p * jnp.moveaxis(vs_, 1, 2)[:, :, None, None, :]
+        o = jnp.einsum("bkgqs,bskd->bqkgd", p.astype(vv.dtype), vv)
+        # log-sum-exp merge across context shards
+        m_g = m
+        for ax in seq_axes:
+            m_g = jax.lax.pmax(m_g, ax)
+        corr = jnp.exp(m - m_g)  # (B, K, G, Sq)
+        l_g = jax.lax.psum(l * corr, seq_axes)
+        o_g = jax.lax.psum(
+            o.astype(jnp.float32)
+            * jnp.transpose(corr, (0, 3, 1, 2))[..., None],
+            seq_axes,
+        )
+        l_t = jnp.transpose(jnp.maximum(l_g, 1e-30), (0, 3, 1, 2))[..., None]
+        out = o_g / l_t  # (B, Sq, K, G, D)
+        return out.reshape(b, s_q, h, d).astype(q_.dtype)
+
+    q_spec = logical_to_spec(("batch", None, "act_heads", None), rules)
+    kv_spec = logical_to_spec(("batch", "cache_seq", "act_kv_heads", None), rules)
+    mask_spec = logical_to_spec(("batch", None, "cache_seq"), rules)
+    scale_spec = logical_to_spec(("batch", "cache_seq", "act_kv_heads"), rules)
+
+    if k_scale is None:
+        def local4(q_, k_, v_, mask_):
+            return local(q_, k_, v_, mask_, None, None)
+
+        return jax.shard_map(
+            local4, mesh=mesh,
+            in_specs=(q_spec, kv_spec, kv_spec, mask_spec),
+            out_specs=q_spec, check_vma=False,
+        )(q, k, v, mask)
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, mask_spec, scale_spec, scale_spec),
+        out_specs=q_spec, check_vma=False,
+    )(q, k, v, mask, k_scale, v_scale)
 
 
 def dot_product_attention(
@@ -121,6 +201,26 @@ def dot_product_attention(
     if mask is not None:
         # Explicit-mask (decode) path: bandwidth-bound, XLA fuses it fine; the
         # flash/ring kernels are for long training chunks, not 1-token queries.
+        if mesh is not None:
+            from ditl_tpu.parallel.sharding import (
+                DEFAULT_RULES,
+                mesh_axes_size,
+                seq_shards,
+            )
+
+            r = rules if rules is not None else DEFAULT_RULES
+            seq_n = seq_shards(mesh, r)
+            dp = mesh_axes_size(mesh, r.get("batch"))
+            tp = mesh_axes_size(mesh, r.get("act_kv_heads"))
+            if (seq_n > 1 and k.shape[1] % seq_n == 0
+                    and q.shape[0] % dp == 0 and k.shape[2] % tp == 0
+                    and q.shape[2] % max(tp, 1) == 0):
+                # Context (KV sequence) sharded over the mesh:
+                # flash-decoding-style partial-softmax merge over ICI.
+                return _seq_sharded_decode(
+                    q, k, v, mask, mesh=mesh, rules=r,
+                    k_scale=k_scale, v_scale=v_scale,
+                )
         return _xla_attention(
             q, k, v, causal=causal, segment_ids=segment_ids, mask=mask,
             k_scale=k_scale, v_scale=v_scale,

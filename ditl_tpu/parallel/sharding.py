@@ -30,7 +30,32 @@ from typing import Any, Sequence
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["DEFAULT_RULES", "logical_to_spec", "spec_tree", "named_sharding_tree"]
+__all__ = [
+    "DEFAULT_RULES", "logical_to_spec", "spec_tree", "named_sharding_tree",
+    "mesh_axes_size", "seq_shards",
+]
+
+
+def mesh_axes_size(mesh, axes) -> int:
+    """Product of mesh-axis sizes for a rules value (str, tuple, or None)."""
+    if axes is None:
+        return 1
+    if isinstance(axes, str):
+        axes = (axes,)
+    size = 1
+    for a in axes:
+        size *= mesh.shape[a]
+    return size
+
+
+def seq_shards(mesh, rules=None) -> int:
+    """Shard count of the KV-cache context dim ("cache_seq" rule) on this
+    mesh — 1 means context is unsharded. One definition for the engines
+    and the attention routing, so they can never disagree."""
+    if mesh is None:
+        return 1
+    r = rules if rules is not None else DEFAULT_RULES
+    return mesh_axes_size(mesh, r.get("cache_seq"))
 
 # logical axis -> mesh axis (or tuple of mesh axes, or None = replicated)
 DEFAULT_RULES: dict[str, Any] = {
@@ -54,6 +79,12 @@ DEFAULT_RULES: dict[str, Any] = {
     "act_kv_heads": "tensor",
     "act_mlp": "tensor",
     "act_vocab": "tensor",
+    # KV-cache CONTEXT dim for sequence-sharded serving: the contiguous
+    # cache's token axis splits over the sequence mesh axis and decode
+    # attention merges per-shard partial softmax over ICI
+    # (ops/attention._seq_sharded_decode) — context capacity scales with
+    # the mesh instead of one chip's HBM.
+    "cache_seq": "sequence",
 }
 
 

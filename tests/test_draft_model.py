@@ -53,6 +53,7 @@ def _plain(params, cfg, tok, prompts, **kw):
     ).generate(prompts)
 
 
+@pytest.mark.slow
 def test_bad_drafter_still_exact(setup):
     """A random, unrelated draft model must not change greedy output —
     acceptance may be ~0, the TARGET's verify still decides every token."""
@@ -70,6 +71,7 @@ def test_bad_drafter_still_exact(setup):
     assert eng.spec_ticks > 0  # model drafting speculates every tick
 
 
+@pytest.mark.slow
 def test_perfect_drafter_accepts_everything(setup):
     """Draft == target: drafted tokens match the verify argmax wherever the
     argmax is numerically stable. On RANDOM weights the logits are near

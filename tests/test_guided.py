@@ -46,6 +46,7 @@ def _engine(params, cfg, tok, **kw):
     return ContinuousEngine(params, cfg, tok, **kw)
 
 
+@pytest.mark.slow
 def test_unconstrained_rows_bit_exact_vs_unguided(setup):
     """A guided-capacity engine serving NO grammar must produce tokens
     bit-identical to a guided-off engine (the FREE row is an identity
@@ -72,6 +73,7 @@ def test_regex_constrained_output_matches(setup):
     assert all(len(t) == 8 for t in out)
 
 
+@pytest.mark.slow
 def test_mixed_batch_free_rows_unaffected(setup):
     """One constrained + one free request sharing decode ticks: the free
     row's output is identical to an all-free engine run."""
@@ -86,6 +88,7 @@ def test_mixed_batch_free_rows_unaffected(setup):
     assert tok.decode(res[rid_c]) in ("yes", "no")
 
 
+@pytest.mark.slow
 def test_schema_constrained_json(setup):
     params, cfg, tok = setup
     schema = {"enum": ["red", "green", "blue"]}
@@ -95,6 +98,7 @@ def test_schema_constrained_json(setup):
     assert json.loads(out) in ("red", "green", "blue")
 
 
+@pytest.mark.slow
 def test_json_mode_output_is_valid_prefix(setup):
     """json_object mode on a random-weight model: every emitted byte walks
     the JSON DFA live (the guarantee is valid-prefix always, full validity
@@ -117,6 +121,7 @@ def test_json_mode_output_is_valid_prefix(setup):
         assert len(eng.tokenizer.encode(out)) >= 24  # budget-truncated
 
 
+@pytest.mark.slow
 def test_sampled_constrained(setup):
     params, cfg, tok = setup
     g = G.compile_regex(r"[ab]{2,6}", tok)

@@ -110,6 +110,7 @@ def test_moe_continuous_expert_sharded(moe_setup):
     assert out == ref
 
 
+@pytest.mark.slow
 def test_moe_sampled_decode_respects_seed(moe_setup):
     cfg, params = moe_setup
     tok = ByteTokenizer()

@@ -386,6 +386,7 @@ def test_status_fingerprint_collective_single_process():
     assert _status_fingerprints_agree(False, 0)
 
 
+@pytest.mark.slow
 def test_pod_freezes_self_calibrating_spec_threshold(cont_engine):
     """Pod serving must pin the speculation threshold: the self-calibrating
     value derives from per-host wall-clock timings, which would let

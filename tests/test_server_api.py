@@ -67,6 +67,7 @@ def _post(port, path, body, expect_error=False):
         return e.code, json.loads(e.read())
 
 
+@pytest.mark.slow
 def test_embeddings_endpoint(setup):
     params, cfg, tok = setup
     server, threaded, port = _serve(params, cfg, tok)
@@ -147,6 +148,7 @@ def test_best_of_ranks_by_logprob(setup):
         threaded.close()
 
 
+@pytest.mark.slow
 def test_best_of_validation(setup):
     params, cfg, tok = setup
     server, threaded, port = _serve(params, cfg, tok, continuous=True)
@@ -196,6 +198,50 @@ def test_prometheus_metrics_endpoint(setup):
         threaded.close()
 
 
+def test_tokenize_detokenize_endpoints(setup):
+    params, cfg, tok = setup
+    server, _, port = _serve(params, cfg, tok)
+    try:
+        status, out = _post(port, "/tokenize", {"prompt": "hello"})
+        assert status == 200
+        assert out["tokens"][0] == tok.bos_id
+        assert out["tokens"][1:] == tok.encode("hello")
+        assert out["count"] == len(out["tokens"])
+        status, out2 = _post(port, "/detokenize", {"tokens": out["tokens"]})
+        assert status == 200 and out2["prompt"] == "hello"
+        status, out3 = _post(
+            port, "/tokenize", {"prompt": "hi", "add_special_tokens": False}
+        )
+        assert status == 200 and out3["tokens"] == tok.encode("hi")
+        status, _ = _post(port, "/tokenize", {"prompt": 5}, expect_error=True)
+        assert status == 400
+        status, _ = _post(port, "/detokenize", {"tokens": "x"},
+                          expect_error=True)
+        assert status == 400
+    finally:
+        server.shutdown()
+
+
+def test_chat_template_used_when_tokenizer_has_one(setup):
+    from ditl_tpu.infer.server import _chat_prompt
+
+    class FakeInner:
+        chat_template = "{{messages}}"
+
+        def apply_chat_template(self, messages, tokenize, add_generation_prompt):
+            assert not tokenize and add_generation_prompt
+            return "<|templated|>" + messages[0]["content"]
+
+    class FakeTok:
+        _tok = FakeInner()
+
+    msgs = [{"role": "user", "content": "hi"}]
+    assert _chat_prompt(msgs, FakeTok()) == "<|templated|>hi"
+    # no template -> plain-text turns
+    assert _chat_prompt(msgs, None) == "user: hi\nassistant:"
+
+
+@pytest.mark.slow
 def test_generate_many_cancels_orphans_on_midloop_failure(setup):
     """A QueueFullError on copy k must cancel copies 0..k-1: no unconsumed
     Request may park in ThreadedEngine._results, and the engine drains."""

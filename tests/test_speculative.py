@@ -119,6 +119,7 @@ def test_single_and_empty_prompts(tiny_setup_f32):
     assert gen.generate_tokens([[]], 8) == ref
 
 
+@pytest.mark.slow
 def test_max_new_tokens_respected(tiny_setup_f32):
     cfg, params = tiny_setup_f32
     tok = ByteTokenizer()

@@ -71,6 +71,7 @@ def test_grads_flow_through_all_to_all(seq_mesh):
         )
 
 
+@pytest.mark.slow
 def test_gqa_fallback_to_ring(seq_mesh):
     # 2 KV heads over a 4-way sequence axis: head slice would be fractional,
     # so dispatch falls back to ring attention — still exact.
