@@ -67,9 +67,9 @@ class LLMClient:
 
     # -- low level ----------------------------------------------------------
 
-    def _request_once(self, payload: dict) -> dict:
+    def _request_once(self, payload: dict, endpoint: str = "/chat/completions") -> dict:
         cfg = self.config
-        url = cfg.api_base.rstrip("/") + "/chat/completions"
+        url = cfg.api_base.rstrip("/") + endpoint
         headers = {
             "Content-Type": "application/json",
             "Authorization": f"Bearer {cfg.api_key()}",
@@ -80,12 +80,12 @@ class LLMClient:
             raise HTTPStatusError(status, resp_headers, resp_body)
         return json.loads(resp_body)
 
-    def _request_with_retries(self, payload: dict) -> dict:
+    def _request_with_retries(self, payload: dict, endpoint: str = "/chat/completions") -> dict:
         cfg = self.config
         last_exc: Exception | None = None
         for attempt in range(cfg.max_retries + 1):
             try:
-                return self._request_once(payload)
+                return self._request_once(payload, endpoint)
             except HTTPStatusError as e:
                 last_exc = e
                 retryable = e.status == 429 or e.status >= 500
@@ -133,6 +133,23 @@ class LLMClient:
         except Exception as e:
             logger.error("Error getting model response: %s", e)
             return ERROR_SENTINEL
+
+    def embed(self, texts: str | Sequence[str]) -> list[list[float]] | None:
+        """Embeddings from the endpoint's ``/embeddings`` route (this
+        framework's own server serves it; any OpenAI-compatible endpoint
+        works). Total function like ``complete``: ``None`` on any failure,
+        never raises."""
+        payload = {
+            "model": self.config.model_name,
+            "input": texts if isinstance(texts, str) else list(texts),
+        }
+        try:
+            resp = self._request_with_retries(payload, endpoint="/embeddings")
+            data = sorted(resp["data"], key=lambda d: d["index"])
+            return [d["embedding"] for d in data]
+        except Exception as e:
+            logger.error("Error getting embeddings: %s", e)
+            return None
 
     def complete_many(self, prompts: Sequence[str], system: str | None = None) -> list[str]:
         """Bounded-concurrency fan-out; order-preserving; each element total."""

@@ -138,6 +138,9 @@ class ModelConfig:
     rope_scaling_original_max_len: int = 8192
     rms_norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Qwen2-style attention: bias on the q/k/v projections (o stays
+    # bias-free, matching the family).
+    attention_bias: bool = False
     dtype: str = "bfloat16"  # activation/compute dtype
     param_dtype: str = "float32"  # master parameter dtype
     # MoE (Mixtral-style); num_experts == 0 disables.

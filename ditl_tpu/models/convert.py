@@ -56,6 +56,12 @@ def config_from_hf(hf_config: Any, **overrides) -> ModelConfig:
         rope_theta=getattr(hf_config, "rope_theta", 10000.0),
         rms_norm_eps=hf_config.rms_norm_eps,
         tie_embeddings=getattr(hf_config, "tie_word_embeddings", False),
+        # Qwen2-family: q/k/v bias. HF Llama configs carry an explicit
+        # attention_bias flag; Qwen2Config implies it by architecture.
+        attention_bias=bool(
+            getattr(hf_config, "attention_bias", False)
+            or getattr(hf_config, "model_type", "") == "qwen2"
+        ),
     )
     if getattr(hf_config, "num_local_experts", 0):  # Mixtral
         kwargs["num_experts"] = hf_config.num_local_experts
@@ -132,6 +138,12 @@ def params_from_state_dict(
         },
         "final_norm": {"scale": cast(_np(sd["model.norm.weight"]))},
     }
+    if cfg.attention_bias:  # Qwen2-family q/k/v bias (1-D: no transpose)
+        params["layers"]["attn"].update({
+            "bq": cast(_stack(sd, "model.layers.{i}.self_attn.q_proj.bias", L, False)),
+            "bk": cast(_stack(sd, "model.layers.{i}.self_attn.k_proj.bias", L, False)),
+            "bv": cast(_stack(sd, "model.layers.{i}.self_attn.v_proj.bias", L, False)),
+        })
     if cfg.num_experts > 0:  # Mixtral-style sparse MLP
         e = cfg.num_experts
         router = _stack(sd, "model.layers.{i}.block_sparse_moe.gate.weight", L, True)
@@ -202,6 +214,9 @@ def state_dict_from_params(params: Mapping[str, Any], cfg: ModelConfig) -> dict[
         sd[f"{p}.post_attention_layernorm.weight"] = host(layers["mlp_norm"]["scale"][i])
         for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
             sd[f"{p}.self_attn.{theirs}.weight"] = host(layers["attn"][ours][i]).T
+        if cfg.attention_bias:
+            for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
+                sd[f"{p}.self_attn.{theirs}.bias"] = host(layers["attn"][ours][i])
         if cfg.num_experts > 0:
             moe = layers["moe"]
             sd[f"{p}.block_sparse_moe.gate.weight"] = host(moe["router"][i]).T
@@ -257,6 +272,18 @@ def export_hf_model(params: Mapping[str, Any], cfg: ModelConfig, path: str) -> N
             **common,
         )
         model = MixtralForCausalLM(hf_cfg)
+    elif cfg.attention_bias:
+        # Qwen2-family (q/k/v bias): export as a native Qwen2 checkpoint.
+        from transformers import Qwen2Config, Qwen2ForCausalLM
+
+        common.pop("head_dim", None)  # Qwen2Config derives it
+        if cfg.head_dim * cfg.num_heads != cfg.hidden_size:
+            raise ValueError(
+                "Qwen2 export needs head_dim * num_heads == hidden_size "
+                f"({cfg.head_dim} * {cfg.num_heads} != {cfg.hidden_size})"
+            )
+        hf_cfg = Qwen2Config(**common)
+        model = Qwen2ForCausalLM(hf_cfg)
     else:
         hf_cfg = LlamaConfig(attention_bias=False, mlp_bias=False, **common)
         model = LlamaForCausalLM(hf_cfg)

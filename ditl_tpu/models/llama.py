@@ -78,6 +78,12 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         },
         "final_norm": {"scale": jnp.ones((d,), pd)},
     }
+    if cfg.attention_bias:  # Qwen2-family: bias on q/k/v only
+        params["layers"]["attn"].update({
+            "bq": jnp.zeros((L, nh * hd), pd),
+            "bk": jnp.zeros((L, nkv * hd), pd),
+            "bv": jnp.zeros((L, nkv * hd), pd),
+        })
     if cfg.num_experts > 0:
         from ditl_tpu.models.moe import init_moe_params
 
@@ -108,6 +114,10 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
                 "wk": ("layers", "embed", "kv_heads"),
                 "wv": ("layers", "embed", "kv_heads"),
                 "wo": ("layers", "heads", "embed"),
+                **({"bq": ("layers", "heads"),
+                    "bk": ("layers", "kv_heads"),
+                    "bv": ("layers", "kv_heads")}
+                   if cfg.attention_bias else {}),
             },
             "mlp_norm": {"scale": ("layers", "norm")},
         },
@@ -287,9 +297,14 @@ def _decoder_layer(
 
     # Attention block
     h = rms_norm(x, layer_params["attn_norm"]["scale"], cfg.rms_norm_eps)
-    q = proj(h, attn["wq"], "wq").reshape(b, s, nh, hd)
-    k = proj(h, attn["wk"], "wk").reshape(b, s, nkv, hd)
-    v = proj(h, attn["wv"], "wv").reshape(b, s, nkv, hd)
+
+    def _bias(t, name):
+        # Qwen2-family q/k/v bias (o stays bias-free).
+        return t + attn[name].astype(t.dtype) if name in attn else t
+
+    q = _bias(proj(h, attn["wq"], "wq"), "bq").reshape(b, s, nh, hd)
+    k = _bias(proj(h, attn["wk"], "wk"), "bk").reshape(b, s, nkv, hd)
+    v = _bias(proj(h, attn["wv"], "wv"), "bv").reshape(b, s, nkv, hd)
     q = apply_rope(q, positions, cfg=cfg)
     k = apply_rope(k, positions, cfg=cfg)
     q = _constrain(q, ("batch", "seq", "act_heads", "head_dim"), mesh, rules)

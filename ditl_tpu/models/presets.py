@@ -71,6 +71,37 @@ PRESETS: dict[str, ModelConfig] = {
         rope_theta=500000.0,
         rope_scaling_factor=8.0,
     ),
+    # Qwen2/2.5-family (q/k/v attention bias, rope 1e6; the 7B unties
+    # embeddings, the 0.5B ties them).
+    "qwen2-7b": ModelConfig(
+        name="qwen2-7b",
+        vocab_size=152064,
+        hidden_size=3584,
+        intermediate_size=18944,
+        num_layers=28,
+        num_heads=28,
+        num_kv_heads=4,
+        head_dim=128,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=True,
+    ),
+    "qwen2-0.5b": ModelConfig(
+        name="qwen2-0.5b",
+        vocab_size=151936,
+        hidden_size=896,
+        intermediate_size=4864,
+        num_layers=24,
+        num_heads=14,
+        num_kv_heads=2,
+        head_dim=64,
+        max_seq_len=32768,
+        rope_theta=1000000.0,
+        rms_norm_eps=1e-6,
+        attention_bias=True,
+        tie_embeddings=True,
+    ),
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b",
         vocab_size=32000,

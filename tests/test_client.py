@@ -140,3 +140,59 @@ def test_against_real_local_http_server():
         assert client.complete_many(["a", "b"]) == ["echo:a", "echo:b"]
     finally:
         server.shutdown()
+
+
+def test_embed_total_function():
+    """client.embed: returns vectors sorted by index; None on failure."""
+
+    def transport(url, headers, body, timeout):
+        assert url.endswith("/embeddings")
+        payload = json.loads(body)
+        n = 1 if isinstance(payload["input"], str) else len(payload["input"])
+        data = [
+            {"object": "embedding", "index": i, "embedding": [float(i), 0.5]}
+            for i in reversed(range(n))  # out of order: client must sort
+        ]
+        return 200, {}, json.dumps({"object": "list", "data": data}).encode()
+
+    client = LLMClient(_fast_cfg(), transport=transport)
+    vecs = client.embed(["a", "b", "c"])
+    assert vecs == [[0.0, 0.5], [1.0, 0.5], [2.0, 0.5]]
+    assert client.embed("solo") == [[0.0, 0.5]]
+
+    def failing(url, headers, body, timeout):
+        return 500, {}, b"boom"
+
+    assert LLMClient(_fast_cfg(), transport=failing).embed("x") is None
+
+
+def test_embed_against_own_server():
+    """The framework's client reads embeddings from the framework's server."""
+    import jax
+
+    from ditl_tpu.config import ModelConfig
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.infer.engine import Generator
+    from ditl_tpu.infer.server import make_server
+    from ditl_tpu.models import llama
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+        dtype="float32", param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    server = make_server(
+        Generator(params, cfg, ByteTokenizer()), host="127.0.0.1", port=0,
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        port = server.server_address[1]
+        client = LLMClient(
+            APIConfig(api_base=f"http://127.0.0.1:{port}/v1", timeout_s=60.0)
+        )
+        vecs = client.embed(["hello", "world"])
+        assert vecs is not None and len(vecs) == 2
+        assert len(vecs[0]) == cfg.hidden_size
+    finally:
+        server.shutdown()

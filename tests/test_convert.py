@@ -50,6 +50,80 @@ def test_llama_logits_parity(tie):
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("tie", [False, True])
+def test_qwen2_logits_parity(tie):
+    """Qwen2-family: q/k/v attention bias (+ tied embeddings on the small
+    variants) — torch Qwen2ForCausalLM logits == ours."""
+    cfg_hf = transformers.Qwen2Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=128,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        tie_word_embeddings=tie,
+    )
+    torch.manual_seed(2)
+    model = transformers.Qwen2ForCausalLM(cfg_hf).eval()
+    # Qwen2 initializes biases to zero; give them real values so the test
+    # actually exercises the bias path.
+    with torch.no_grad():
+        for layer in model.model.layers:
+            for p in (layer.self_attn.q_proj.bias,
+                      layer.self_attn.k_proj.bias,
+                      layer.self_attn.v_proj.bias):
+                p.copy_(torch.randn_like(p) * 0.1)
+    cfg = config_from_hf(model.config, dtype="float32")
+    assert cfg.attention_bias and cfg.tie_embeddings == tie
+    params = params_from_state_dict(model.state_dict(), cfg)
+    assert "bq" in params["layers"]["attn"]
+
+    rng = np.random.default_rng(2)
+    ids = rng.integers(0, 256, size=(2, 16)).astype(np.int64)
+    with torch.no_grad():
+        hf_logits = model(torch.from_numpy(ids)).logits.numpy()
+
+    ours = np.asarray(llama.forward(params, jnp.asarray(ids, jnp.int32), cfg))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_export_roundtrip(tmp_path):
+    """Export a bias-carrying model as a native Qwen2 checkpoint and read
+    it back bit-for-bit."""
+    import jax
+
+    from ditl_tpu.models.convert import export_hf_model, load_hf_model
+
+    from ditl_tpu.config import ModelConfig
+
+    cfg = ModelConfig(
+        name="tiny-qwen", vocab_size=256, hidden_size=64,
+        intermediate_size=128, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=16, max_seq_len=128, attention_bias=True,
+        param_dtype="float32", dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(3), cfg)
+    # non-zero biases so the round-trip carries information
+    params["layers"]["attn"]["bq"] = params["layers"]["attn"]["bq"] + 0.25
+    export_hf_model(params, cfg, str(tmp_path / "hf"))
+    back_params, back_cfg = load_hf_model(str(tmp_path / "hf"), dtype="float32")
+    assert back_cfg.attention_bias
+    np.testing.assert_array_equal(
+        np.asarray(back_params["layers"]["attn"]["bq"]),
+        np.asarray(params["layers"]["attn"]["bq"]),
+    )
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(0, 256, size=(1, 12)), jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(llama.forward(back_params, ids, back_cfg)),
+        np.asarray(llama.forward(params, ids, cfg)),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
 def test_mixtral_logits_parity():
     # One layer: the router softmax amplifies float noise across layers (a
     # ~4e-5 block-output difference can flip near-tie routing downstream), so

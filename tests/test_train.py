@@ -270,3 +270,19 @@ def test_unknown_optimizer_raises(tiny_model_cfg):
         create_train_state(
             jax.random.key(0), tiny_model_cfg, TrainConfig(optimizer="frobnicate")
         )
+
+
+def test_train_step_attention_bias(tiny_model_cfg, example_batch):
+    """Qwen2-family q/k/v bias: params exist, gradients flow, loss falls."""
+    import dataclasses
+
+    cfg = dataclasses.replace(tiny_model_cfg, attention_bias=True)
+    _, state, gb, step = _setup(cfg, example_batch)
+    assert "bq" in state.params["layers"]["attn"]
+    b0 = np.asarray(state.params["layers"]["attn"]["bq"])
+    state, m0 = step(state, gb)
+    for _ in range(6):
+        state, m = step(state, gb)
+    assert float(m["loss"]) < float(m0["loss"])
+    b1 = np.asarray(state.params["layers"]["attn"]["bq"])
+    assert np.abs(b1 - b0).max() > 0  # the bias actually trains
