@@ -161,6 +161,17 @@ def _flush_tail_into_pools(pools, tk, tv, starts, pos, table, ps, tail_len):
     return out
 
 
+def derive_copy_seed(base: int, i: int) -> int:
+    """Seed for copy ``i`` of an OpenAI ``n``/``best_of`` fan-out. Copy 0
+    keeps the caller's seed untouched (an ``n=2, seed=s`` request reproduces
+    the ``n=1, seed=s`` completion as its first candidate); later copies
+    stride by a prime and wrap into int31 so no derived seed ever trips the
+    pod driver's int32 stage bound. The single source of truth for BOTH
+    ThreadedEngine.generate_many and PodContinuousDriver.generate_many —
+    pod and solo serving must replay identically for a given seed."""
+    return base if i == 0 else (base + 7919 * i) & 0x7FFFFFFF
+
+
 class QueueFullError(RuntimeError):
     """Raised by ``submit`` when the engine's admission queue is at its
     configured depth cap — callers (the HTTP server) turn this into a 429
@@ -1662,6 +1673,11 @@ class ContinuousEngine:
                 fsm_start = grammar
             else:
                 fsm_start = self.register_grammar(grammar)
+        if seed is not None and not (-2**31 <= int(seed) < 2**31):
+            # Same bound the pod stage enforces: the per-slot PRNG key is
+            # folded from an int32 lane; numpy would raise OverflowError at
+            # dispatch time otherwise — surface it as request validation.
+            raise ValueError("seed must fit in int32")
         max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
         prompt = prompt_tokens or [self.tokenizer.bos_id]
         self.validate_request(prompt, max_new)
@@ -2736,7 +2752,7 @@ class ThreadedEngine:
                         max_new_tokens=max_new_tokens,
                         temperature=temperature,
                         top_p=top_p,
-                        seed=seed + 7919 * i,  # distinct per copy, reproducible
+                        seed=derive_copy_seed(seed, i),
                         adapter_id=adapter_id,
                         grammar=grammar,
                         logprobs=logprobs,

@@ -563,6 +563,11 @@ class PodContinuousDriver:
                     ticket.fail(rid)  # deterministic per-request rejection
                     continue
                 ticket.req_id = rid
+                if ticket.abandoned:
+                    # generate_many failed mid-stage after this copy was
+                    # staged: cancel on the next tick, never register.
+                    self._cancels.add(rid)
+                    continue
                 self._tickets[rid] = ticket
             for req in self._engine.take_finished():
                 t = self._tickets.pop(req.req_id, None)
@@ -573,9 +578,18 @@ class PodContinuousDriver:
     # -- ThreadedEngine surface ----------------------------------------------
 
     def _stage(self, prompt_tokens, max_new_tokens, temperature, top_p, seed,
-               stream=None, adapter_id=None) -> "_Ticket":
+               stream=None, adapter_id=None, grammar=None) -> "_Ticket":
         from ditl_tpu.infer.continuous import QueueFullError
 
+        if grammar is not None:
+            # The server CLI already refuses --fsm-capacity with --pod, so a
+            # guided request can only reach here via a direct driver call;
+            # ValueError (not TypeError) means request validation — the
+            # server's completion handlers map it to HTTP 400.
+            raise ValueError(
+                "guided decoding does not compose with --pod serving (the "
+                "tick broadcast does not carry grammar registrations)"
+            )
         gen = self._engine.gen
         ticket = _Ticket(stream)
         prompt = list(prompt_tokens) or [self.tokenizer.bos_id]
@@ -625,13 +639,67 @@ class PodContinuousDriver:
 
     def generate_one(self, prompt_tokens, *, max_new_tokens=None,
                      temperature=None, top_p=None, seed=None,
-                     adapter_id=None) -> list[int]:
+                     adapter_id=None, grammar=None) -> list[int]:
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
-                             top_p, seed, adapter_id=adapter_id)
+                             top_p, seed, adapter_id=adapter_id,
+                             grammar=grammar)
         return ticket.wait()
 
+    def generate_many(self, prompt_tokens, n, *, max_new_tokens=None,
+                      temperature=None, top_p=None, seed=None,
+                      adapter_id=None, grammar=None, logprobs=None):
+        """OpenAI ``n``/``best_of`` over the pod: stage ``n`` copies with
+        derived seeds (same 7919-stride rule as ThreadedEngine.generate_many
+        so pod and solo serving replay identically for a given seed), then
+        block until all finish. Returns objects with ``.tokens`` and
+        ``.lp_token`` — the server's candidate surface."""
+        if logprobs is not None:
+            raise ValueError(
+                "logprobs do not compose with --pod serving (the tick "
+                "broadcast carries token ids only)"
+            )
+        if seed is None:
+            import random as _random
+
+            seed = _random.getrandbits(31)
+        tickets: list[_Ticket] = []
+
+        def _abandon_siblings():
+            # A failure on copy k must not leave siblings decoding dead
+            # budget pod-wide. Still-staged copies are pulled out of
+            # self._staged entirely (never broadcast); in-flight ones are
+            # flagged so the pump cancels instead of registering them;
+            # admitted ones get a real cancel tick.
+            with self._cond:
+                live = set(id(t) for t in tickets)
+                self._staged = [
+                    entry for entry in self._staged
+                    if id(entry[-1]) not in live
+                ]
+                for t in tickets:
+                    t.abandoned = True
+                    if t.req_id is not None and not t.done.is_set():
+                        self._cancels.add(t.req_id)
+                        self._tickets.pop(t.req_id, None)
+                self._cond.notify_all()
+
+        try:
+            from ditl_tpu.infer.continuous import derive_copy_seed
+
+            for i in range(n):
+                tickets.append(self._stage(
+                    prompt_tokens, max_new_tokens, temperature, top_p,
+                    derive_copy_seed(seed, i),
+                    adapter_id=adapter_id, grammar=grammar,
+                ))
+            return [_PodResult(t.wait()) for t in tickets]
+        except BaseException:
+            _abandon_siblings()
+            raise
+
     def stream_one(self, prompt_tokens, *, max_new_tokens=None,
-                   temperature=None, top_p=None, seed=None, adapter_id=None):
+                   temperature=None, top_p=None, seed=None, adapter_id=None,
+                   grammar=None):
         import queue as _queue
 
         stream: _queue.Queue = _queue.Queue()
@@ -640,7 +708,7 @@ class PodContinuousDriver:
         # there is no status left to send (ADVICE r2).
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
                              top_p, seed, stream=stream,
-                             adapter_id=adapter_id)
+                             adapter_id=adapter_id, grammar=grammar)
 
         def chunks():
             try:
@@ -693,6 +761,18 @@ class PodContinuousDriver:
             logger.error("pod continuous pump did not drain within 600s")
 
 
+class _PodResult:
+    """Finished-candidate surface for ``generate_many`` (the server reads
+    ``.tokens`` and ``.lp_token``; the tick broadcast carries no logprobs,
+    so ``lp_token`` is always None in pod mode)."""
+
+    __slots__ = ("tokens", "lp_token")
+
+    def __init__(self, tokens: list[int]):
+        self.tokens = tokens
+        self.lp_token = None
+
+
 class _Ticket:
     """One staged request's handoff between an HTTP thread and the pump."""
 
@@ -701,6 +781,7 @@ class _Ticket:
         self.req_id: int | None = None
         self.result: list[int] | None = None
         self.error: BaseException | None = None
+        self.abandoned = False  # generate_many sibling failed mid-stage
         self.done = threading.Event()
 
     def finish(self, tokens: list[int]) -> None:

@@ -1043,16 +1043,23 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(e, QueueFullError):
                 self._send_429(str(e))
                 return
-            if isinstance(e, ValueError) and "fsm_capacity exhausted" in str(e):
-                # Guided table full: a server-capacity condition, not a
-                # client error. Rows are never evicted (active slots may
-                # point anywhere in the table), so NEW grammars keep
-                # failing until the operator restarts with a larger
-                # --fsm-capacity; already-registered grammars still serve.
-                self._send_json(503, {"error": {"message":
-                    str(e) + " (new grammars need a restart with a larger "
-                    "--fsm-capacity; already-registered grammars still "
-                    "serve)"}})
+            if isinstance(e, ValueError):
+                if "fsm_capacity exhausted" in str(e):
+                    # Guided table full: a server-capacity condition, not a
+                    # client error. Rows are never evicted (active slots may
+                    # point anywhere in the table), so NEW grammars keep
+                    # failing until the operator restarts with a larger
+                    # --fsm-capacity; already-registered grammars still serve.
+                    self._send_json(503, {"error": {"message":
+                        str(e) + " (new grammars need a restart with a larger "
+                        "--fsm-capacity; already-registered grammars still "
+                        "serve)"}})
+                    return
+                # Every other engine ValueError is request validation
+                # (seed/max_tokens bounds, prompt too long, bad adapter,
+                # guided-in-pod): the client's fault — 400, not 500. The
+                # streaming path maps identically above.
+                self._send_json(400, {"error": {"message": str(e)}})
                 return
             logger.exception("completion failed")
             self._send_json(500, {"error": {"message": str(e)}})

@@ -237,6 +237,77 @@ def test_pod_continuous_queue_full(cont_engine):
         driver.close()
 
 
+def test_pod_continuous_generate_many_and_guided_rejection(cont_engine):
+    """The server's threaded-engine surface (r3 regression class): every
+    kwarg it passes must be accepted here. ``grammar=None`` flows through
+    unguided requests; a real grammar is a clean ValueError (HTTP 400), and
+    ``generate_many`` seeds copies with the same 7919 stride as the solo
+    ThreadedEngine so pod and solo n/best_of replay identically."""
+    from ditl_tpu.infer.continuous import ThreadedEngine
+    from ditl_tpu.infer.podserve import PodContinuousDriver
+
+    prompt = [1] + list(range(5, 20))
+    solo = ThreadedEngine(cont_engine())
+    try:
+        expect = [r.tokens for r in solo.generate_many(
+            prompt, 2, temperature=0.8, seed=7,
+        )]
+    finally:
+        solo.close()
+
+    driver = PodContinuousDriver(cont_engine())
+    try:
+        assert driver.generate_one(prompt, grammar=None)  # server kwarg
+        reqs = driver.generate_many(prompt, 2, temperature=0.8, seed=7)
+        assert [r.tokens for r in reqs] == expect
+        assert all(r.lp_token is None for r in reqs)
+        with pytest.raises(ValueError, match="pod"):
+            driver.generate_one(prompt, grammar=object())
+        with pytest.raises(ValueError, match="pod"):
+            next(iter(driver.stream_one(prompt, grammar=object())))
+        with pytest.raises(ValueError, match="logprobs"):
+            driver.generate_many(prompt, 2, logprobs=1)
+        # Still serving after the rejections:
+        assert driver.generate_one(prompt)
+    finally:
+        driver.close()
+
+
+def test_pod_continuous_generate_many_overflow_abandons_siblings(cont_engine):
+    """generate_many(n > capacity): the overflow copy raises QueueFullError
+    and the already-staged siblings must be abandoned — never broadcast (or
+    cancelled if already admitted) — leaving no registered tickets behind
+    and the driver still serving."""
+    from ditl_tpu.infer.continuous import QueueFullError
+    from ditl_tpu.infer.podserve import PodContinuousDriver
+
+    # max_queue=1: queue_full counts engine queue + staged + inflight, so
+    # copy 0 stages and a later copy overflows at stage time (which copy
+    # depends on pump timing; the invariant below does not).
+    driver = PodContinuousDriver(cont_engine(n_slots=1, max_queue=1),
+                                 poll_s=0.01)
+    try:
+        with pytest.raises(QueueFullError):
+            driver.generate_many([1, 2, 3], 8, seed=3)
+        # Siblings were abandoned: once in-flight work drains, nothing may
+        # remain registered or staged (a leak here = dead decode budget
+        # pod-wide on every process).
+        import time as _time
+
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline:
+            with driver._cond:
+                if not driver._tickets and not driver._staged:
+                    break
+            _time.sleep(0.02)
+        with driver._cond:
+            assert not driver._tickets and not driver._staged
+        # Still serving after the failed fan-out:
+        assert driver.generate_one([1, 2, 3])
+    finally:
+        driver.close()
+
+
 def test_pod_continuous_close_fails_waiters(cont_engine):
     from ditl_tpu.infer.podserve import PodContinuousDriver
 

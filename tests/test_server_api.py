@@ -157,6 +157,12 @@ def test_best_of_validation(setup):
             "prompt": "x", "n": 3, "best_of": 2,
         }, expect_error=True)
         assert status == 400
+        # Engine-level request validation (seed out of int32) is the
+        # CLIENT's fault: 400, never a 500 from the catch-all.
+        status, _ = _post(port, "/v1/completions", {
+            "prompt": "x", "seed": 2**40, "max_tokens": 4,
+        }, expect_error=True)
+        assert status == 400
         status, _ = _post(port, "/v1/completions", {
             "prompt": "x", "n": 2, "stream": True,
         }, expect_error=True)
