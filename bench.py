@@ -206,7 +206,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 page_size: int = 256, moe: bool = False,
                 prompt_len: int = 0, max_new: int = 0,
                 temperature: float = 0.0, guided: str = "",
-                spec_draft: bool = False) -> int:
+                spec_draft: bool = False, pipeline: bool = False) -> int:
     """Decode/serving benchmark — one JSON line. Every serving claim in
     BASELINE.md is reproducible from here: ``--engine continuous`` ticks the
     production slot engine (``--cache paged`` for the page pool + Pallas
@@ -336,6 +336,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 spec_threshold=0.0 if speculative else None,
                 fsm_capacity=(grammar.n_states + 2) if grammar else 0,
                 draft_params=draft_params, draft_cfg=draft_cfg,
+                pipeline_ticks=pipeline,
             )
 
         def run_once(eng):
@@ -391,6 +392,11 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 "--guided requires --engine continuous (the FSM mask rides "
                 "the slot scheduler's decode ticks)"
             )
+        if pipeline:
+            raise SystemExit(
+                "--pipeline requires --engine continuous (lockstep has no "
+                "tick loop to double-buffer)"
+            )
         gen = GenerateConfig(max_new_tokens=max_new,
                              temperature=0.0 if workload == "repetitive" else 1.0,
                              seed=1)
@@ -404,13 +410,14 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             times.append(time.perf_counter() - t)
         dt = statistics.median(times)
         extra = {}
-    label = "%s%s%s%s%s%s" % (
+    label = "%s%s%s%s%s%s%s" % (
         engine,
         "/paged" if cache == "paged" else "",
         ", int8" if quantize else "",
         ", int8-kv" if kv_quant else "",
         ", speculative" if speculative else "",
         (", T=%.2g" % temperature) if temperature else "",
+        ", pipelined" if pipeline else "",
     )
     arch = "MoE 8x top-2" if moe else "Llama-style"
     print(json.dumps({
@@ -615,6 +622,9 @@ if __name__ == "__main__":
                         "anything else = a regex; \"(.|\\n)*\" measures the "
                         "FSM machinery's overhead against the same command "
                         "without --guided")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="double-buffered decode ticks on the continuous "
+                        "engine (dispatch tick N+1 before fetching tick N)")
     parser.add_argument("--spec-draft", action="store_true",
                         help="model-based speculation (--infer --engine "
                         "continuous --speculative): a ~10x-smaller draft "
@@ -656,7 +666,7 @@ if __name__ == "__main__":
             page_size=args.page_size, moe=args.moe,
             prompt_len=args.prompt_len, max_new=args.max_new,
             temperature=args.temperature, guided=args.guided,
-            spec_draft=args.spec_draft,
+            spec_draft=args.spec_draft, pipeline=args.pipeline,
         ))
     sys.exit(main(args.model, overrides=args.override,
                   batch_override=args.batch, seq_override=args.seq))

@@ -200,6 +200,10 @@ class Request:
     tokens: list[int] = field(default_factory=list)
     slot: int | None = None
     finished: bool = False
+    # Set by cancel(): pipelined (double-buffered) ticks may still hold this
+    # request in a pending harvest snapshot — the flag keeps that lagged
+    # harvest from appending tokens to (or re-completing) a dead request.
+    cancelled: bool = False
     # Chunked prefill progress: next prompt offset to prefill; the request
     # joins decode ticks only once the whole prompt is in the cache.
     prefill_pos: int = 0
@@ -259,6 +263,7 @@ class ContinuousEngine:
         fsm_capacity: int = 0,
         draft_params: llama.Params | None = None,
         draft_cfg: ModelConfig | None = None,
+        pipeline_ticks: bool = False,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -472,6 +477,15 @@ class ContinuousEngine:
         self._slots: list[Request | None] = [None] * n_slots
         self._queue: collections.deque[Request] = collections.deque()
         self._completed: dict[int, Request] = {}
+        # Double-buffered (pipelined) ticks: dispatch tick N+1 before
+        # fetching tick N's outputs, so the host→device dispatch and
+        # device→host fetch round trips (the dominant per-tick cost on
+        # remote-transport devices, and real on local TPU-VMs too) overlap
+        # with device compute instead of serializing with it. Harvest and
+        # admission lag one tick; outputs are token-identical (per-slot RNG
+        # derives from the request seed, never from tick alignment).
+        self.pipeline_ticks = bool(pipeline_ticks)
+        self._pending_fetch: tuple | None = None
         self._next_id = 0
         self._prefill_cache: dict[int, Any] = {}
         self._decode_cache: dict[tuple[bool, bool], Any] = {}
@@ -2090,20 +2104,37 @@ class ContinuousEngine:
             self.keys = self.keys.at[slot].set(slot_key)
             self.adapters = self.adapters.at[slot].set(req.adapter_id)
 
+    def _snapshot_slots(self) -> list[tuple[Request | None, bool]]:
+        """(request, was_prefilling) per slot AT DISPATCH TIME — pipelined
+        ticks harvest one tick late, by which point admission may have
+        refilled a freed slot; the snapshot keeps the lagged harvest bound
+        to the requests whose tokens the tick actually computed."""
+        return [
+            (r, r.prefilling if r is not None else False) for r in self._slots
+        ]
+
     def _harvest(self, emitted: np.ndarray, counts: np.ndarray | None = None,
-                 lp=None) -> None:
+                 lp=None, snapshot=None) -> None:
         """``counts`` (speculative ticks): per-row valid-emission counts —
         spec rounds emit 1..K+1 tokens, so the row is count-delimited
         instead of pad-delimited (a live row's tick can end without the pad
         filler that marks death in the plain tick's fixed-width output).
         ``lp`` (chosen, top_ids, top_lp arrays, column-aligned with
         ``emitted``): per-token logprob stats, attached to requests that
-        asked for them."""
+        asked for them. ``snapshot`` (pipelined ticks): the slot states at
+        dispatch time (see ``_snapshot_slots``)."""
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
-        for slot, req in enumerate(self._slots):
-            if req is None or req.prefilling:
+        if snapshot is None:
+            snapshot = self._snapshot_slots()
+        for slot, (req, was_prefilling) in enumerate(snapshot):
+            if req is None or was_prefilling:
                 # A still-prefilling slot is parked: its decode-row output is
                 # pad filler, not a finished (empty) generation.
+                continue
+            if req.finished or req.cancelled:
+                # Pipelined ticks: the slot decoded one extra (dead) chunk
+                # after the request finished or was cancelled — its row is
+                # garbage and the request already completed/streamed.
                 continue
             fresh: list[int] = []
             row = emitted[slot] if counts is None else emitted[slot][: counts[slot]]
@@ -2139,13 +2170,14 @@ class ContinuousEngine:
                 if req.stream is not None:
                     req.stream.put(None)
                 self._completed[req.req_id] = req
-                self._slots[slot] = None
-                if self.cache_mode == "paged":
-                    # Publish before releasing: the content cache's own
-                    # reference keeps the conversation's pages resident
-                    # (and LRU-evictable) for follow-up turns.
-                    self._publish_generated_pages(req, slot)
-                    self._free_slot_pages(slot)
+                if self._slots[slot] is req:  # not cancel-freed meanwhile
+                    self._slots[slot] = None
+                    if self.cache_mode == "paged":
+                        # Publish before releasing: the content cache's own
+                        # reference keeps the conversation's pages resident
+                        # (and LRU-evictable) for follow-up turns.
+                        self._publish_generated_pages(req, slot)
+                        self._free_slot_pages(slot)
 
     def freeze_spec_threshold(self) -> None:
         """Pin the speculation threshold to its current value. REQUIRED for
@@ -2227,7 +2259,11 @@ class ContinuousEngine:
         Explicit construction value wins; otherwise the MEASURED ratio of
         per-round verify cost to per-step decode cost (updated live from
         tick timings), with a conservative 2.5 prior until both paths have
-        been timed on this chip."""
+        been timed on this chip. Under ``pipeline_ticks`` no timings are
+        recorded (lagged fetches measure the pipeline period, not device
+        cost), so the adaptive threshold stays at the prior — pass an
+        explicit ``spec_threshold`` (e.g. from ``calibrate_spec_threshold``
+        run serially) when tuning speculative+pipelined serving."""
         if self._spec_threshold_cfg is not None:
             return self._spec_threshold_cfg
         if self._plain_step_ms and self._spec_round_ms:
@@ -2290,8 +2326,9 @@ class ContinuousEngine:
             return True
         return sum(preds) / len(preds) >= self.spec_threshold
 
-    def _spec_step(self, alive: jax.Array, sampled: bool) -> None:
-        """One speculative tick + acceptance accounting."""
+    def _spec_dispatch(self, alive: jax.Array, sampled: bool) -> tuple:
+        """Dispatch one speculative tick (async — nothing blocks); returns
+        the pending-fetch record ``_spec_finish`` consumes."""
         import time as _time
 
         paged = self.cache_mode == "paged"
@@ -2334,11 +2371,21 @@ class ContinuousEngine:
         if self.guided:
             self.fstates = res.pop(0)
         (toks, counts, rr, lp_state, lp_bufs) = res
+        if self.logprobs_k:
+            (self.lp_chosen, self.lp_ids, self.lp_top) = lp_state
+        return ("spec", t0, toks, counts, rr,
+                lp_bufs if self.logprobs_k else None, self._snapshot_slots())
+
+    def _spec_finish(self, rec: tuple) -> None:
+        """Fetch a dispatched speculative tick's outputs + acceptance
+        accounting + harvest."""
+        import time as _time
+
+        (_, t0, toks, counts, rr, lp_bufs, snapshot) = rec
         # ONE device_get for every host-consumed output: each separate fetch
         # is a full round trip on remote-device transports (~100 ms here) —
         # three sequential fetches per tick erased the speculative win.
-        if self.logprobs_k:
-            (self.lp_chosen, self.lp_ids, self.lp_top) = lp_state
+        if lp_bufs is not None:
             counts, rr, toks, lp = jax.device_get(
                 (counts, rr, toks, lp_bufs)
             )
@@ -2349,11 +2396,19 @@ class ContinuousEngine:
                 np.asarray(x) for x in jax.device_get((counts, rr, toks))
             )
             lp = None
-        self._record_tick_time("spec", (_time.perf_counter() - t0) * 1e3)
+        if not self.pipeline_ticks:
+            # Pipelined intervals measure the pipeline period (dispatch to
+            # NEXT-step fetch, including foreign host work), not device
+            # cost — feeding them into the threshold EMA would collapse
+            # spec/plain ratios toward 1. The adaptive threshold then rests
+            # on its conservative prior (see spec_threshold).
+            self._record_tick_time("spec", (_time.perf_counter() - t0) * 1e3)
         self.spec_ticks += 1
         accs = []
-        for slot, req in enumerate(self._slots):
-            if req is None or req.prefilling:
+        for slot, (req, was_prefilling) in enumerate(snapshot):
+            if req is None or was_prefilling or req.finished or req.cancelled:
+                # finished/cancelled: the pipelined dead chunk's counts are
+                # a past-EOS continuation — garbage for acceptance stats.
                 continue
             req.spec_tokens += int(counts[slot])
             req.spec_forwards += int(rr[slot])
@@ -2366,25 +2421,14 @@ class ContinuousEngine:
                 else self._spec_ema_w * self.spec_acceptance_ema
                 + (1.0 - self._spec_ema_w) * mean
             )
-        self._harvest(toks, counts, lp=lp)
+        self._harvest(toks, counts, lp=lp, snapshot=snapshot)
 
-    def step(self) -> None:
-        """One scheduler tick: admit queued requests, advance one chunk of
-        every in-progress chunked prefill, decode one chunk (speculatively
-        when armed and predicted to win — see ``_use_spec_tick``)."""
-        self._admit()
-        for req in self._slots:
-            if req is not None and req.prefilling:
-                self._advance_prefill(req)
-        occupied = [r is not None and not r.prefilling for r in self._slots]
-        if not any(occupied):  # host-side check: no device sync on idle ticks
-            return
-        alive = jnp.asarray(occupied, bool)
-        active = [r for r in self._slots if r is not None and not r.prefilling]
-        sampled = any(r.temperature > 0.0 for r in active)
-        if self._use_spec_tick(active):
-            self._spec_step(alive, sampled)
-            return
+    def _plain_dispatch(self, active: list, alive: jax.Array,
+                        sampled: bool) -> tuple:
+        """Dispatch one plain decode tick (async); returns the
+        pending-fetch record ``_plain_finish`` consumes."""
+        import time as _time
+
         # top_p only matters when something actually samples — greedy rows
         # ignore it, so (False, True) would compile a redundant program.
         key = (sampled, sampled and any(r.top_p < 1.0 for r in active))
@@ -2395,8 +2439,6 @@ class ContinuousEngine:
         fsm_args = (
             (self._fsm_device(), self.fstates) if self.guided else ()
         )
-        import time as _time
-
         t0 = _time.perf_counter()
         if self.cache_mode == "paged":
             if key not in self._paged_decode:
@@ -2425,17 +2467,69 @@ class ContinuousEngine:
             ((self.lp_chosen, self.lp_ids, self.lp_top), toks, c, i, t) = (
                 res_rest
             )
-            # One fetch for everything (see _spec_step).
-            toks, *lp_np = jax.device_get((toks, c, i, t))
+            lp_dev = (c, i, t)
+        else:
+            (toks,) = res_rest
+            lp_dev = None
+        return ("plain", key, t0, toks, lp_dev, self._snapshot_slots())
+
+    def _plain_finish(self, rec: tuple) -> None:
+        """Fetch a dispatched plain tick's outputs + harvest."""
+        import time as _time
+
+        (_, key, t0, toks, lp_dev, snapshot) = rec
+        if lp_dev is not None:
+            # One fetch for everything (see _spec_finish).
+            toks, *lp_np = jax.device_get((toks, *lp_dev))
             lp = tuple(np.asarray(x) for x in lp_np)
             toks = np.asarray(toks)
         else:
-            (toks,) = res_rest
             lp = None
             toks = np.asarray(jax.device_get(toks))
-        if self.speculative:
+        if self.speculative and not self.pipeline_ticks:
+            # See _spec_finish: pipelined intervals are not device cost.
             self._record_tick_time(key, (_time.perf_counter() - t0) * 1e3)
-        self._harvest(toks, lp=lp)
+        self._harvest(toks, lp=lp, snapshot=snapshot)
+
+    def _finish_tick(self, rec: tuple) -> None:
+        (self._spec_finish if rec[0] == "spec" else self._plain_finish)(rec)
+
+    def step(self) -> None:
+        """One scheduler tick: admit queued requests, advance one chunk of
+        every in-progress chunked prefill, decode one chunk (speculatively
+        when armed and predicted to win — see ``_use_spec_tick``).
+
+        ``pipeline_ticks``: the tick dispatched here is NOT fetched here —
+        it is fetched (and harvested) on the NEXT step, after that step has
+        already dispatched its own tick. The host's dispatch+fetch round
+        trips overlap with device compute; admission and harvest lag one
+        tick; a finished request's slot decodes one dead chunk before being
+        freed (masked out by the harvest snapshot). Token streams are
+        identical to serial ticks — per-slot RNG derives from the request
+        seed, never from tick alignment."""
+        prev, self._pending_fetch = self._pending_fetch, None
+        self._admit()
+        for req in self._slots:
+            if req is not None and req.prefilling:
+                self._advance_prefill(req)
+        occupied = [r is not None and not r.prefilling for r in self._slots]
+        rec = None
+        if any(occupied):  # host-side check: no device sync on idle ticks
+            alive = jnp.asarray(occupied, bool)
+            active = [
+                r for r in self._slots if r is not None and not r.prefilling
+            ]
+            sampled = any(r.temperature > 0.0 for r in active)
+            if self._use_spec_tick(active):
+                rec = self._spec_dispatch(alive, sampled)
+            else:
+                rec = self._plain_dispatch(active, alive, sampled)
+        if self.pipeline_ticks:
+            self._pending_fetch = rec
+            if prev is not None:
+                self._finish_tick(prev)
+        elif rec is not None:
+            self._finish_tick(rec)
 
     @property
     def pending(self) -> int:
@@ -2545,12 +2639,14 @@ class ContinuousEngine:
         for req in self._queue:
             if req.req_id == req_id:
                 self._queue.remove(req)
+                req.cancelled = True
                 if req.stream is not None:
                     req.stream.put(None)
                 return True
         for slot, req in enumerate(self._slots):
             if req is not None and req.req_id == req_id:
                 self._slots[slot] = None
+                req.cancelled = True
                 if self.cache_mode == "paged":
                     self._free_slot_pages(slot)
                 if req.stream is not None:

@@ -1151,6 +1151,13 @@ def serve(argv: list[str] | None = None) -> int:
         "requests get HTTP 429 (0 = unbounded)",
     )
     parser.add_argument(
+        "--pipeline-ticks", action="store_true",
+        help="double-buffered decode ticks for --engine continuous: "
+        "dispatch tick N+1 before fetching tick N, overlapping host "
+        "dispatch/fetch round trips with device compute (harvest and "
+        "admission lag one tick; outputs are token-identical)",
+    )
+    parser.add_argument(
         "--fsm-capacity", type=int, default=0,
         help="arm guided (grammar-constrained) decoding on --engine "
         "continuous: total DFA states servable at once (device table rows; "
@@ -1260,6 +1267,12 @@ def serve(argv: list[str] | None = None) -> int:
     if args.fsm_capacity and args.pod:
         parser.error("--fsm-capacity does not compose with --pod yet (the "
                      "tick broadcast does not carry grammar registrations)")
+    if args.pipeline_ticks and args.pod:
+        parser.error("--pipeline-ticks does not compose with --pod yet "
+                     "(the pod tick protocol broadcasts and harvests in "
+                     "lockstep; double-buffering it is untested)")
+    if args.pipeline_ticks and args.engine != "continuous":
+        parser.error("--pipeline-ticks requires --engine continuous")
     if jax.process_index() != 0 and not args.pod:
         # Without --pod, one process binds the port and the others exit; with
         # --pod every process joins the collective decode loop below.
@@ -1400,6 +1413,7 @@ def serve(argv: list[str] | None = None) -> int:
             logprobs_k=args.logprobs_k,
             fsm_capacity=args.fsm_capacity,
             draft_params=draft_params, draft_cfg=draft_cfg,
+            pipeline_ticks=args.pipeline_ticks,
         )
 
     if args.pod and jax.process_index() != 0:
