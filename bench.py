@@ -206,7 +206,8 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 page_size: int = 256, moe: bool = False,
                 prompt_len: int = 0, max_new: int = 0,
                 temperature: float = 0.0, guided: str = "",
-                spec_draft: bool = False, pipeline: bool = False) -> int:
+                spec_draft: bool = False, pipeline: bool = False,
+                admission: str = "reserve", pages: int = 0) -> int:
     """Decode/serving benchmark — one JSON line. Every serving claim in
     BASELINE.md is reproducible from here: ``--engine continuous`` ticks the
     production slot engine (``--cache paged`` for the page pool + Pallas
@@ -337,6 +338,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 fsm_capacity=(grammar.n_states + 2) if grammar else 0,
                 draft_params=draft_params, draft_cfg=draft_cfg,
                 pipeline_ticks=pipeline,
+                admission=admission, n_pages=pages or None,
             )
 
         def run_once(eng):
@@ -397,6 +399,11 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 "--pipeline requires --engine continuous (lockstep has no "
                 "tick loop to double-buffer)"
             )
+        if admission != "reserve" or pages:
+            raise SystemExit(
+                "--admission/--pages require --engine continuous --cache "
+                "paged (lockstep has no page pool)"
+            )
         gen = GenerateConfig(max_new_tokens=max_new,
                              temperature=0.0 if workload == "repetitive" else 1.0,
                              seed=1)
@@ -410,7 +417,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             times.append(time.perf_counter() - t)
         dt = statistics.median(times)
         extra = {}
-    label = "%s%s%s%s%s%s%s" % (
+    label = "%s%s%s%s%s%s%s%s" % (
         engine,
         "/paged" if cache == "paged" else "",
         ", int8" if quantize else "",
@@ -418,6 +425,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         ", speculative" if speculative else "",
         (", T=%.2g" % temperature) if temperature else "",
         ", pipelined" if pipeline else "",
+        ", optimistic" if admission == "optimistic" else "",
     )
     arch = "MoE 8x top-2" if moe else "Llama-style"
     print(json.dumps({
@@ -622,6 +630,14 @@ if __name__ == "__main__":
                         "anything else = a regex; \"(.|\\n)*\" measures the "
                         "FSM machinery's overhead against the same command "
                         "without --guided")
+    parser.add_argument("--admission", choices=("reserve", "optimistic"),
+                        default="reserve",
+                        help="paged admission policy (optimistic: admit past "
+                        "worst-case reservation, preempt on exhaustion)")
+    parser.add_argument("--pages", type=int, default=0,
+                        help="paged pool size override (0 = contiguous-"
+                        "equivalent capacity) — shrink to exercise "
+                        "optimistic admission under pressure")
     parser.add_argument("--pipeline", action="store_true",
                         help="double-buffered decode ticks on the continuous "
                         "engine (dispatch tick N+1 before fetching tick N)")
@@ -667,6 +683,7 @@ if __name__ == "__main__":
             prompt_len=args.prompt_len, max_new=args.max_new,
             temperature=args.temperature, guided=args.guided,
             spec_draft=args.spec_draft, pipeline=args.pipeline,
+            admission=args.admission, pages=args.pages,
         ))
     sys.exit(main(args.model, overrides=args.override,
                   batch_override=args.batch, seq_override=args.seq))

@@ -222,6 +222,17 @@ class Request:
     logprobs: int | None = None
     # Multi-LoRA: adapter slot in the stacked params tree (0 = base).
     adapter_id: int = 0
+    # Optimistic paged admission: True after this request was preempted
+    # (pages reclaimed mid-flight); the preempt_* fields carry the device
+    # scalars needed for an exact resume — the PENDING sampled token (cur),
+    # the per-slot PRNG key (a split chain, not reconstructible from
+    # emitted-token count alone), the FSM state, and the pending logprob
+    # stats. All stay lazy device values: capture costs no transfer.
+    preempted: bool = False
+    preempt_cur: Any = None
+    preempt_key: Any = None
+    preempt_fst: Any = None
+    preempt_lp: Any = None
     # Guided decoding: absolute start state in the engine's FSM table
     # (0 = FREE row = unconstrained).
     fsm_start: int = 0
@@ -264,6 +275,7 @@ class ContinuousEngine:
         draft_params: llama.Params | None = None,
         draft_cfg: ModelConfig | None = None,
         pipeline_ticks: bool = False,
+        admission: str = "reserve",
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -290,9 +302,15 @@ class ContinuousEngine:
         and automatically reused by later prompts sharing the prefix —
         ``register_prefix`` becomes an optimization hint (pre-warm), not a
         requirement (infer/paged_cache.py, ops/paged_attention.py).
-        Admission reserves a request's worst-case pages up front (prompt +
-        max_new); requests wait in queue when the pool can't cover that —
-        no mid-flight preemption. ``kv_cache_dtype="int8"`` composes:
+        ``admission`` picks the paged admission policy: ``"reserve"``
+        (default) reserves a request's worst-case pages up front (prompt +
+        max_new) and queues requests the pool can't cover — no mid-flight
+        preemption; ``"optimistic"`` reserves only prompt + one tick of
+        headroom, feeds pages per tick, and on pool exhaustion preempts the
+        youngest request (exact resume: pages published for cheap
+        re-prefill, sampling frontier captured device-side) — strictly more
+        concurrency at equal pool bytes when requests finish before their
+        pessimistic ``max_tokens``. ``kv_cache_dtype="int8"`` composes:
         pools store int8 + per-position scales (halving page bytes =
         doubling resident tokens), the kernel factors the scales out of
         its dots, and the hot tail stays float until the per-tick flush.
@@ -429,7 +447,21 @@ class ContinuousEngine:
             self._table_dev: Any = None
             self._slot_pages: list[list[int]] = [[] for _ in range(n_slots)]
             self.limits = jnp.zeros((n_slots,), jnp.int32)
+            if admission not in ("reserve", "optimistic"):
+                raise ValueError(
+                    f"admission must be 'reserve' or 'optimistic', "
+                    f"got {admission!r}"
+                )
+            self.admission = admission
+            self.preemptions = 0
         else:
+            if admission != "reserve":
+                raise ValueError(
+                    "admission='optimistic' requires cache_mode='paged' "
+                    "(the contiguous cache has no pages to reclaim)"
+                )
+            self.admission = admission
+            self.preemptions = 0
             self.cache = init_cache(model_cfg, n_slots, self.smax)
             if mesh is not None:
                 from ditl_tpu.infer.cache import cache_logical_axes
@@ -2022,17 +2054,51 @@ class ContinuousEngine:
             adapter=req.adapter_id, fsm_start=req.fsm_start,
         )
 
+    def _tick_advance_bound(self) -> int:
+        """Worst-case KV-write-position advance of one decode tick — how far
+        ahead optimistic page top-up must cover. Speculative ticks write the
+        whole (k+1)-token verify window every round even when little is
+        accepted, hence the extra ``spec_k + 1`` over the emission bound."""
+        if self.speculative:
+            return self.spec_rounds * (self.spec_k + 1) + self.spec_k + 1
+        return self.decode_chunk
+
     def _admit_paged_slot(self, slot: int) -> bool:
-        """Admit the queue head into ``slot`` (paged mode). Reserves the
-        request's worst-case pages (prompt + max_new) up front — admission
-        fails (request stays queued, False returned) when the pool cannot
-        cover it, so decode never faults mid-flight."""
-        req = self._queue[0]
+        """Admit the queue head into ``slot`` (paged mode).
+
+        ``admission="reserve"`` (default): reserve the request's worst-case
+        pages (prompt + max_new) up front — admission fails (request stays
+        queued, False returned) when the pool cannot cover it, so decode
+        never faults mid-flight.
+
+        ``admission="optimistic"``: reserve only prompt + one tick of
+        headroom; further pages are allocated per tick (``_topup_pages``),
+        and pool exhaustion preempts the youngest request instead of
+        blocking admission — strictly more concurrency at equal pool bytes
+        when requests finish before their pessimistic ``max_tokens``."""
+        while True:
+            req = self._queue[0]
+            if not (req.finished or req.cancelled):
+                break
+            # A preempted request can complete (or be cancelled) while
+            # queued — its pending tick's lagged harvest delivered the
+            # final chunk and already recorded it in _completed. Nothing
+            # to admit; drop it and try the next head.
+            self._queue.popleft()
+            if not self._queue:
+                return False
+        if req.preempted:
+            return self._resume_paged_slot(slot, req)
         ps = self.page_size
         matched = self.allocator.match_prefix(
             req.prompt, ps, root=-req.adapter_id
         )  # retained
-        n_total = -(-(len(req.prompt) + req.max_new_tokens) // ps)
+        worst = -(-(len(req.prompt) + req.max_new_tokens) // ps)
+        if self.admission == "optimistic":
+            want = -(-(len(req.prompt) + self._tick_advance_bound()) // ps)
+            n_total = min(max(want, len(matched)), worst)
+        else:
+            n_total = worst
         n_fresh = n_total - len(matched)
         try:
             fresh = self.allocator.alloc(n_fresh)
@@ -2071,6 +2137,167 @@ class ContinuousEngine:
             len(req.prompt) + req.max_new_tokens
         )
         return True
+
+    def _resume_paged_slot(self, slot: int, req: Request) -> bool:
+        """Re-admit a preempted request with its exact mid-flight state.
+
+        The KV for ``prompt + tokens`` is re-prefilled (one shot — resume
+        skips chunked prefill; the preemption publish below usually makes
+        this a near-full prefix match), then the captured device scalars
+        restore the sampling frontier: ``cur`` = the PENDING sampled token
+        (one ahead of ``tokens[-1]``), ``pos`` = its write position, the
+        per-slot PRNG key (a split chain — not derivable from token count),
+        the FSM state, and the pending logprob stats. Decode then continues
+        bit-exactly where it left off."""
+        ps = self.page_size
+        ctx = req.prompt + req.tokens
+        pos = len(ctx)  # cur's write position
+        cap = len(req.prompt) + req.max_new_tokens
+        matched = self.allocator.match_prefix(ctx, ps, root=-req.adapter_id)
+        worst = -(-cap // ps)
+        if self.admission == "optimistic":
+            n_total = min(-(-(pos + self._tick_advance_bound()) // ps), worst)
+        else:
+            n_total = worst
+        n_total = max(n_total, len(matched))
+        try:
+            fresh = self.allocator.alloc(n_total - len(matched))
+        except MemoryError:
+            for pid in matched:
+                self.allocator.release(pid)
+            return False
+        self._queue.popleft()
+        pages = matched + fresh
+        self._slot_pages[slot] = pages
+        self._table[slot, :] = 0
+        self._table[slot, : len(pages)] = pages
+        self._table_dirty = True
+        d0 = len(matched) * ps
+        s = pos - d0
+        req.slot = slot
+        self._slots[slot] = req
+        # The prefill programs' sampled tokens are discarded — the real
+        # pending token was captured at preemption; rng is irrelevant for
+        # the same reason (keys restored below). When the engine is
+        # configured for chunked prefill, the resume honors the bound: a
+        # published-pages eviction under pressure can make the unmatched
+        # remainder the FULL context, and a one-shot next_pow2(s) program
+        # would be exactly the compile/memory blowup prefill_chunk exists
+        # to prevent. (The chunks run back-to-back within this admission —
+        # resume does not interleave them across ticks.)
+        step = self.prefill_chunk or s
+        d = d0
+        while d < pos:
+            n = min(step, pos - d)
+            self._run_paged_prefill(
+                ctx[d: d + n], d, n, n,
+                ctx_row=self._table[slot],
+                write_pids=self._table[slot, d // ps:],
+                temp=req.temperature, top_p=req.top_p,
+                rng=jax.random.key(req.seed), slot=slot,
+                adapter=req.adapter_id, fsm_start=req.fsm_start,
+            )
+            d += n
+        self.cur = self.cur.at[slot].set(req.preempt_cur)
+        self.pos = self.pos.at[slot].set(pos)
+        self.keys = self.keys.at[slot].set(req.preempt_key)
+        if self.guided and req.preempt_fst is not None:
+            self.fstates = self.fstates.at[slot].set(req.preempt_fst)
+        if self.logprobs_k and req.preempt_lp is not None:
+            self._store_lp(slot, *req.preempt_lp)
+        self._set_hist(slot, ctx, req.preempt_cur)
+        self._draft_prefill(req, slot)
+        self.temps = self.temps.at[slot].set(req.temperature)
+        self.top_ps = self.top_ps.at[slot].set(req.top_p)
+        self.adapters = self.adapters.at[slot].set(req.adapter_id)
+        self.limits = self.limits.at[slot].set(cap)
+        req.preempted = False
+        req.preempt_cur = req.preempt_key = None
+        req.preempt_fst = req.preempt_lp = None
+        return True
+
+    def _pick_victim(self, needy: Request) -> int | None:
+        """Youngest active request STRICTLY younger than ``needy`` (so the
+        oldest in-flight request is never preempted and always progresses —
+        the no-deadlock invariant). None when ``needy`` is itself the
+        youngest."""
+        best: int | None = None
+        for slot, req in enumerate(self._slots):
+            if (req is None or req.prefilling or req.finished
+                    or req.cancelled or req.req_id <= needy.req_id):
+                continue
+            if best is None or req.req_id > self._slots[best].req_id:
+                best = slot
+        return best
+
+    def _preempt_slot(self, slot: int) -> None:
+        """Reclaim a slot's pages mid-flight and requeue its request at the
+        queue head. The full pages of ``prompt + tokens`` are PUBLISHED
+        before release, so they stay resident (LRU-evictable under real
+        pressure) and the resume prefill is a near-full prefix match —
+        re-admission costs roughly one partial-page prefill. Capture of the
+        sampling frontier stays device-lazy (no transfer)."""
+        req = self._slots[slot]
+        req.preempted = True
+        req.preempt_cur = self.cur[slot]
+        req.preempt_key = self.keys[slot]
+        if self.guided:
+            req.preempt_fst = self.fstates[slot]
+        if self.logprobs_k:
+            req.preempt_lp = (
+                self.lp_chosen[slot], self.lp_ids[slot], self.lp_top[slot]
+            )
+        self._publish_tokens(req.prompt + req.tokens, slot, req.adapter_id)
+        self._slots[slot] = None
+        self._free_slot_pages(slot)
+        self._queue.appendleft(req)
+        self.preemptions += 1
+        logger.info(
+            "preempted request %d (%d tokens in); pages reclaimed",
+            req.req_id, len(req.tokens),
+        )
+
+    def _topup_pages(self) -> None:
+        """Optimistic admission's per-tick page feed: before dispatch, every
+        decoding slot's table must cover this tick's worst-case writes
+        (``_tick_advance_bound``). On pool exhaustion, preempt the youngest
+        younger-than-needy request and retry; when the needy request IS the
+        youngest, preempt it instead — older requests keep their pages and
+        the oldest always progresses (no deadlock, no preemption ping-pong)."""
+        if self.cache_mode != "paged" or self.admission != "optimistic":
+            return
+        ps, adv = self.page_size, self._tick_advance_bound()
+        # One pending (unharvested) tick in pipelined mode can have advanced
+        # the device frontier past the harvested token count.
+        lag = 2 if self.pipeline_ticks else 1
+        for slot in range(self.n_slots):
+            req = self._slots[slot]
+            if req is None or req.prefilling or req.finished or req.cancelled:
+                continue
+            cap = len(req.prompt) + req.max_new_tokens
+            # Resync to the ACTUAL frontier (prompt + harvested tokens) each
+            # tick rather than accumulating the worst-case bound — under
+            # speculative ticks the bound is pessimistic (the verify window
+            # is written every round but only accepted tokens advance), and
+            # accumulation would degenerate to reserve-mode footprint.
+            target = min(len(req.prompt) + len(req.tokens) + lag * adv, cap)
+            need = -(-target // ps)
+            while True:
+                have = len(self._slot_pages[slot])
+                if need <= have:
+                    break
+                try:
+                    fresh = self.allocator.alloc(need - have)
+                except MemoryError:
+                    victim = self._pick_victim(req)
+                    if victim is None:
+                        self._preempt_slot(slot)
+                        break
+                    self._preempt_slot(victim)
+                    continue
+                self._table[slot, have: have + len(fresh)] = fresh
+                self._slot_pages[slot].extend(fresh)
+                self._table_dirty = True
 
     def _admit(self) -> None:
         for slot in range(self.n_slots):
@@ -2196,7 +2423,13 @@ class ContinuousEngine:
 
     def _table_device(self):
         if self._table_dirty:
-            self._table_dev = jnp.asarray(self._table)
+            # .copy() is load-bearing: on the CPU backend jnp.asarray may
+            # alias the numpy buffer ZERO-COPY, so a later host mutation
+            # (preemption zeroing a row, optimistic top-up appending pages)
+            # would race with a still-pending pipelined tick's device read
+            # of this table — nondeterministic garbage gathers. The copy is
+            # private to the device array; the host never touches it again.
+            self._table_dev = jnp.asarray(self._table.copy())
             self._table_dirty = False
         return self._table_dev
 
@@ -2249,7 +2482,10 @@ class ContinuousEngine:
     def _fsm_device(self):
         with self._fsm_lock:
             if self._fsm_dirty:
-                self._fsm_dev = jnp.asarray(self._fsm_host)
+                # .copy() for the same reason as _table_device: the host
+                # table is appended by register_grammar while ticks may be
+                # in flight; a zero-copy alias would race with device reads.
+                self._fsm_dev = jnp.asarray(self._fsm_host.copy())
                 self._fsm_dirty = False
             return self._fsm_dev
 
@@ -2512,6 +2748,7 @@ class ContinuousEngine:
         for req in self._slots:
             if req is not None and req.prefilling:
                 self._advance_prefill(req)
+        self._topup_pages()  # optimistic paged admission; may preempt
         occupied = [r is not None and not r.prefilling for r in self._slots]
         rec = None
         if any(occupied):  # host-side check: no device sync on idle ticks
@@ -2583,6 +2820,8 @@ class ContinuousEngine:
                 "pages_total": self.n_pages - 1,  # page 0 is the sentinel
                 "pages_free": self.allocator.n_free,
                 "pages_cached_evictable": self.allocator.n_evictable,
+                "admission": self.admission,
+                "preemptions": self.preemptions,
             })
         if self.multi_lora:
             out["adapters"] = self.n_adapters
@@ -2639,6 +2878,14 @@ class ContinuousEngine:
         for req in self._queue:
             if req.req_id == req_id:
                 self._queue.remove(req)
+                if req.finished:
+                    # Preempted request that COMPLETED via its pending
+                    # tick's lagged harvest while queued: the stream
+                    # already got its terminal None and the result sits in
+                    # _completed — cancelling now just discards it (no
+                    # second sentinel).
+                    self._completed.pop(req_id, None)
+                    return True
                 req.cancelled = True
                 if req.stream is not None:
                     req.stream.put(None)

@@ -1151,6 +1151,14 @@ def serve(argv: list[str] | None = None) -> int:
         "requests get HTTP 429 (0 = unbounded)",
     )
     parser.add_argument(
+        "--admission", choices=("reserve", "optimistic"), default="reserve",
+        help="paged admission policy: 'reserve' books worst-case pages "
+        "(prompt+max_tokens) up front; 'optimistic' books prompt + one "
+        "tick, feeds pages per tick, and preempts the youngest request on "
+        "pool exhaustion (exact resume) — more concurrency when clients "
+        "set pessimistic max_tokens",
+    )
+    parser.add_argument(
         "--pipeline-ticks", action="store_true",
         help="double-buffered decode ticks for --engine continuous: "
         "dispatch tick N+1 before fetching tick N, overlapping host "
@@ -1273,6 +1281,15 @@ def serve(argv: list[str] | None = None) -> int:
                      "lockstep; double-buffering it is untested)")
     if args.pipeline_ticks and args.engine != "continuous":
         parser.error("--pipeline-ticks requires --engine continuous")
+    if args.admission == "optimistic":
+        if args.engine != "continuous" or args.cache_mode != "paged":
+            parser.error("--admission optimistic requires --engine "
+                         "continuous --cache-mode paged (only the page pool "
+                         "can be reclaimed mid-flight)")
+        if args.pod:
+            parser.error("--admission optimistic does not compose with "
+                         "--pod yet (preemption decisions are host-local; "
+                         "the tick broadcast does not carry them)")
     if jax.process_index() != 0 and not args.pod:
         # Without --pod, one process binds the port and the others exit; with
         # --pod every process joins the collective decode loop below.
@@ -1414,6 +1431,7 @@ def serve(argv: list[str] | None = None) -> int:
             fsm_capacity=args.fsm_capacity,
             draft_params=draft_params, draft_cfg=draft_cfg,
             pipeline_ticks=args.pipeline_ticks,
+            admission=args.admission,
         )
 
     if args.pod and jax.process_index() != 0:
