@@ -1730,10 +1730,14 @@ class ContinuousEngine:
                     "fsm_capacity=0"
                 )
             if isinstance(grammar, int):
-                if not 0 <= grammar < self._fsm_used:
+                with self._fsm_lock:  # register_grammar appends from HTTP
+                    # threads; an unlocked read could reject a state that
+                    # was just registered (ADVICE r3)
+                    used = self._fsm_used
+                if not 0 <= grammar < used:
                     raise BadRequestError(
                         f"grammar start state {grammar} not in the installed "
-                        f"table (rows [0, {self._fsm_used}))"
+                        f"table (rows [0, {used}))"
                     )
                 fsm_start = grammar
             else:

@@ -704,6 +704,150 @@ def _re_escape(s: str) -> str:
     return "".join(out)
 
 
+def _suffix_cmp(s: str, ge: bool) -> str:
+    """Digit strings of ``len(s)`` digits (leading zeros fine) that are
+    >= s (``ge``) or <= s (not ``ge``)."""
+    if not s:
+        return ""
+    lead = s[0]
+    rest = _suffix_cmp(s[1:], ge)
+    tail_any = ("[0-9]{%d}" % (len(s) - 1)) if len(s) > 1 else ""
+    parts = []
+    if ge and lead < "9":
+        parts.append(("[%c-9]" % chr(ord(lead) + 1)) + tail_any)
+    if not ge and lead > "0":
+        parts.append(("[0-%c]" % chr(ord(lead) - 1)) + tail_any)
+    parts.append(lead + rest)
+    return "(" + "|".join(parts) + ")" if len(parts) > 1 else parts[0]
+
+
+def _same_len_range(a: str, b: str) -> str:
+    """Digit strings of len(a)==len(b) digits in [a, b] (zeros allowed)."""
+    if a == b:
+        return a
+    i = 0
+    while a[i] == b[i]:
+        i += 1
+    if i:
+        return a[:i] + _same_len_range(a[i:], b[i:])
+    tail_any = ("[0-9]{%d}" % (len(a) - 1)) if len(a) > 1 else ""
+    parts = [a[0] + _suffix_cmp(a[1:], True) if len(a) > 1 else a[0]]
+    lo_d, hi_d = ord(a[0]) + 1, ord(b[0]) - 1
+    if lo_d <= hi_d:
+        mid = ("[%c-%c]" % (chr(lo_d), chr(hi_d))) if lo_d != hi_d else chr(lo_d)
+        parts.append(mid + tail_any)
+    parts.append(b[0] + _suffix_cmp(b[1:], False) if len(b) > 1 else b[0])
+    return "(" + "|".join(parts) + ")"
+
+
+def _nonneg_range_regex(lo: int, hi: int) -> str:
+    """Canonical JSON integers (no leading zeros) in [lo, hi], 0 <= lo <= hi."""
+    parts = []
+    if lo == 0:
+        parts.append("0")
+        lo = 1
+        if hi == 0:
+            return "0"
+    for nd in range(len(str(lo)), len(str(hi)) + 1):
+        lo_d = max(lo, 10 ** (nd - 1))
+        hi_d = min(hi, 10**nd - 1)
+        if lo_d > hi_d:
+            continue
+        parts.append(_same_len_range(str(lo_d), str(hi_d)))
+    return "(" + "|".join(parts) + ")" if len(parts) > 1 else parts[0]
+
+
+def _int_range_regex(lo: int, hi: int) -> str:
+    """Canonical JSON integers in [lo, hi] (both bounds required)."""
+    if lo > hi:
+        raise ValueError(f"unsatisfiable integer bounds [{lo}, {hi}]")
+    parts = []
+    if lo < 0:
+        neg_hi = min(hi, -1)
+        parts.append("\\-" + _nonneg_range_regex(-neg_hi, -lo))
+    if hi >= 0:
+        parts.append(_nonneg_range_regex(max(lo, 0), hi))
+    return "(" + "|".join(parts) + ")" if len(parts) > 1 else parts[0]
+
+
+def _integer_regex(schema: dict) -> str:
+    lo, hi = schema.get("minimum"), schema.get("maximum")
+    # Exclusive bounds (pydantic's gt/lt spelling) fold to inclusive
+    # integer bounds; silently ignoring them would emit out-of-bound
+    # values from a CONSTRAINT engine.
+    if schema.get("exclusiveMinimum") is not None:
+        xlo = int(schema["exclusiveMinimum"]) + 1
+        lo = xlo if lo is None else max(int(lo), xlo)
+    if schema.get("exclusiveMaximum") is not None:
+        xhi = int(schema["exclusiveMaximum"]) - 1
+        hi = xhi if hi is None else min(int(hi), xhi)
+    if lo is None and hi is None:
+        return _JSON_INT_RE
+    if lo is None or hi is None:
+        raise ValueError(
+            "integer bounds need BOTH a lower and an upper bound (a "
+            "one-sided bound has unbounded digit count; give the other "
+            "side)"
+        )
+    return _int_range_regex(int(lo), int(hi))
+
+
+def _string_regex(schema: dict) -> str:
+    mn = schema.get("minLength")
+    mx = schema.get("maxLength")
+    if mn is None and mx is None:
+        return _JSON_STRING_RE
+    mn = int(mn or 0)
+    char = r'([^"\\\x00-\x1f]|\\(["\\/bfnrt]|u[0-9a-fA-F]{4}))'
+    if mx is None:
+        return '"' + char + ("{%d,}" % mn) + '"'
+    mx = int(mx)
+    if mx < mn:
+        raise ValueError(
+            f"unsatisfiable string bounds minLength={mn} > maxLength={mx}"
+        )
+    return '"' + char + ("{%d,%d}" % (mn, mx)) + '"'
+
+
+# Order-free objects are a union over property permutations; the DFA size
+# is factorial in the property count, so the door is deliberately small.
+_ORDER_FREE_MAX = 4
+
+
+def _object_body(props: list, required: set) -> str:
+    """Regex for an object's property list in the GIVEN order: every
+    property optional unless in ``required``, comma placement exact. Built
+    from two linear pieces — B(i) (``(, p_i)?`` suffix chain once something
+    was emitted) and a union over which property appears FIRST."""
+    sep = _WS_RE + "," + _WS_RE
+
+    def pair(name, sub):
+        return (
+            _re_escape(json.dumps(name)) + _WS_RE + ":" + _WS_RE
+            + _schema_regex(sub)
+        )
+    pairs = [pair(n, s) for n, s in props]
+    names = [n for n, _ in props]
+    # B-suffixes, built from the tail: B[i] covers properties i..n-1 given
+    # at least one earlier property was emitted.
+    n = len(props)
+    B = [""] * (n + 1)
+    for i in range(n - 1, -1, -1):
+        frag = sep + pairs[i]
+        B[i] = (frag if names[i] in required else "(" + frag + ")?") + B[i + 1]
+    # First-present union: property i can open the object only if every
+    # earlier property is optional.
+    alts = []
+    for i in range(n):
+        alts.append(pairs[i] + B[i + 1])
+        if names[i] in required:
+            break
+    body = "(" + "|".join(alts) + ")" if len(alts) > 1 else alts[0]
+    if not required:
+        body = "(" + body + ")?"  # {} is valid when nothing is required
+    return body
+
+
 def _schema_regex(schema: dict) -> str:
     if not isinstance(schema, dict):
         raise ValueError(f"schema must be a dict, got {type(schema).__name__}")
@@ -711,13 +855,32 @@ def _schema_regex(schema: dict) -> str:
         return "(" + "|".join(_re_escape(json.dumps(v)) for v in schema["enum"]) + ")"
     if "const" in schema:
         return _re_escape(json.dumps(schema["const"]))
+    for key in ("anyOf", "oneOf"):
+        subs = schema.get(key)
+        if subs:
+            # oneOf's exclusivity is not expressible as a regex union; the
+            # grammar admits anything matching at least one branch (the
+            # anyOf semantics) — documented in compile_json_schema. Sibling
+            # constraint keywords would be a CONJUNCTION in JSON Schema;
+            # silently dropping them would over-admit, so they reject.
+            extras = set(schema) - {
+                key, "description", "title", "default", "examples",
+                "$schema", "$id",
+            }
+            if extras:
+                raise ValueError(
+                    f"{key} cannot be combined with sibling constraint "
+                    f"keywords {sorted(extras)} (keyword conjunction is "
+                    "not supported; fold the constraints into each branch)"
+                )
+            return "(" + "|".join(_schema_regex(s) for s in subs) + ")"
     t = schema.get("type")
     if isinstance(t, list):
         return "(" + "|".join(_schema_regex({**schema, "type": x}) for x in t) + ")"
     if t == "string":
-        return _JSON_STRING_RE
+        return _string_regex(schema)
     if t == "integer":
-        return _JSON_INT_RE
+        return _integer_regex(schema)
     if t == "number":
         return _JSON_NUMBER_RE
     if t == "boolean":
@@ -753,30 +916,31 @@ def _schema_regex(schema: dict) -> str:
             body = "(" + item + "(" + sep + item + ")*" + ")?"
         return r"\[" + _WS_RE + body + _WS_RE + r"\]"
     if t == "object":
-        props = schema.get("properties")
-        if not props:
+        props_map = schema.get("properties")
+        if not props_map:
             raise ValueError("object schemas need 'properties' (closed schemas only)")
-        required = set(schema.get("required", props.keys()))
-        parts = []
-        first = True
-        for name, sub in props.items():
-            pair = (
-                _re_escape(json.dumps(name)) + _WS_RE + ":" + _WS_RE + _schema_regex(sub)
-            )
-            if first:
-                frag = pair
-            else:
-                frag = _WS_RE + "," + _WS_RE + pair
-            if name not in required:
-                frag = "(" + frag + ")?"
-                if first:
-                    raise ValueError(
-                        "an optional FIRST property is ambiguous with the "
-                        "comma grammar; make the first property required"
-                    )
-            parts.append(frag)
-            first = False
-        body = "".join(parts)
+        unknown = set(schema.get("required", ())) - set(props_map)
+        if unknown:
+            raise ValueError(f"required names not in properties: {unknown}")
+        # Standard JSON-Schema semantics: properties are OPTIONAL unless
+        # listed in 'required' (the r3 all-required default inverted this;
+        # ADVICE r3).
+        required = set(schema.get("required", ()))
+        props = list(props_map.items())
+        if (schema.get("additionalProperties") is False
+                and len(props) <= _ORDER_FREE_MAX):
+            # Order-free: a union over property permutations (strict-mode
+            # schemas; OpenAI structured outputs). Factorial — hence the
+            # small cap; larger objects keep declaration order.
+            import itertools
+
+            bodies = [
+                _object_body(list(perm), required)
+                for perm in itertools.permutations(props)
+            ]
+            body = "(" + "|".join(bodies) + ")"
+        else:
+            body = _object_body(props, required)
         return r"\{" + _WS_RE + body + _WS_RE + r"\}"
     raise ValueError(f"unsupported schema: {schema!r}")
 
@@ -857,25 +1021,59 @@ def token_strings(tokenizer) -> list[bytes]:
         specials |= set(getattr(inner, "all_special_ids", ()) or ())
     to_tokens = getattr(inner, "convert_ids_to_tokens", None)
     u2b = _gpt2_unicode_to_byte()
+    strings = [
+        to_tokens(i) if to_tokens is not None else None for i in range(v)
+    ]
+    # Byte-level-BPE detection is GLOBAL, not per token: a SentencePiece
+    # vocab entry like 'é' is one Latin-1-range char that also happens to
+    # sit in the GPT-2 alphabet — a per-token check would map it to byte
+    # 0xE9 instead of UTF-8 C3 A9 and guided output could then violate the
+    # constraint (ADVICE r3). Plain-ASCII strings are excluded from the
+    # vote: added tokens registered with literal text (" ", "\n\n" —
+    # chars a true byte-level vocab spells as Ġ/Ċ) would otherwise flip
+    # one real byte-level vocab to the decode() path, which mangles
+    # partial-UTF-8 tokens; they encode literally either way.
+    def _plain(s: str) -> bool:
+        return s.isascii()
+
+    byte_level = to_tokens is not None and all(
+        s is None or _plain(s) or all(ch in u2b for ch in s)
+        for i, s in enumerate(strings) if i not in specials
+    )
+    import re as _re
+
+    byte_fallback = _re.compile(r"^<0x([0-9A-Fa-f]{2})>$")
     out = []
     for i in range(v):
         if i in specials:
             out.append(b"")
             continue
-        if to_tokens is not None:
-            s = to_tokens(i)
-            if s is None:
-                out.append(b"")
+        s = strings[i]
+        if s is not None:
+            if byte_level:
+                if all(ch in u2b for ch in s):
+                    out.append(bytes(u2b[ch] for ch in s))
+                else:  # plain-ASCII added token ("\n\n"): literal text
+                    out.append(s.encode("utf-8"))
                 continue
-            if all(ch in u2b for ch in s):  # byte-level BPE alphabet
-                out.append(bytes(u2b[ch] for ch in s))
+            m = byte_fallback.match(s)
+            if m:  # SentencePiece byte-fallback token: ONE raw byte, not
+                # the literal 6-char text (ADVICE r3)
+                out.append(bytes([int(m.group(1), 16)]))
                 continue
             if s.startswith("▁"):  # SentencePiece word-start marker
                 out.append((" " + s[1:]).encode("utf-8"))
                 continue
-            if "▁" not in s and "�" not in s:
+            if s.isascii() and s.isprintable():
+                # Plain-ASCII vocab strings are their own surface form in
+                # every SP-family tokenizer; skip the decode() round trip.
                 out.append(s.encode("utf-8"))
                 continue
+        # Everything else (non-ASCII vocab strings on a non-byte-level
+        # vocab — e.g. 'é', which ALSO sits in the GPT-2 alphabet and
+        # would mis-map through the byte table) routes through decode():
+        # exact for SP-family tokens whose vocab string is not the
+        # surface form (ADVICE r3).
         out.append(tokenizer.decode([i]).encode("utf-8"))
     return out
 
@@ -983,8 +1181,23 @@ def compile_json_schema(
     *,
     max_states: int = 20_000,
 ) -> CompiledGrammar:
-    """Closed JSON-schema subset (type/enum/const/properties/items/required,
-    fixed property order) -> regex -> token DFA."""
+    """Closed JSON-schema subset -> regex -> token DFA.
+
+    Supported: ``type`` (scalar or list), ``enum``/``const``,
+    ``anyOf``/``oneOf`` (both compiled as the union — oneOf's exclusivity
+    is not regular), objects with ``properties``/``required``, arrays with
+    ``items`` + ``minItems``/``maxItems``, integers with
+    ``minimum``+``maximum`` (both sides — a one-sided bound is rejected),
+    strings with ``minLength``/``maxLength``.
+
+    Object semantics: properties are OPTIONAL unless listed in
+    ``required`` (standard JSON-Schema; note OpenAI strict mode requires
+    every property listed). Property ORDER is the schema's declaration
+    order — except when ``additionalProperties`` is explicitly ``false``
+    and the object has <= 4 properties, in which case any order is
+    admitted (a bounded permutation union; factorial, hence the cap).
+    Unknown keys are never admitted (the grammar is closed by
+    construction, with or without ``additionalProperties``)."""
     pattern = _schema_regex(schema)
     g = compile_regex(pattern, tokenizer, max_states=max_states)
     return dataclasses.replace(g, source=f"schema:{json.dumps(schema)[:80]}")

@@ -399,7 +399,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "stop" if hit_stop or len(out) < gen.max_new_tokens
                 else "length"
             )
-            total_out += len(tok.encode(text))
+            # Bill the tokens actually GENERATED: decode->encode is not
+            # idempotent for every tokenizer (byte tokenizers strip
+            # non-printables), so re-encoding under-counts (ADVICE r3).
+            total_out += len(out)
             choices.append(
                 {"index": i, "message": {"role": "assistant", "content": text},
                  "finish_reason": finish}
@@ -1008,7 +1011,11 @@ class _Handler(BaseHTTPRequestHandler):
             finish = (
                 "stop" if hit_stop or n_gen < gen.max_new_tokens else "length"
             )
-            n_out = len(tok.encode(text))
+            # Bill the tokens actually GENERATED (n_gen), not a re-encode
+            # of the decoded/stop-trimmed text — decode->encode is not
+            # idempotent for every tokenizer (ADVICE r3; a byte tokenizer
+            # stripping non-printables billed 0 for 8 generated tokens).
+            n_out = n_gen
             kind = "chat.completion" if chat else "text_completion"
             choice = (
                 {"index": 0, "message": {"role": "assistant", "content": text},

@@ -156,12 +156,13 @@ def test_schema_object_roundtrip():
             "ok": {"type": "boolean"},
         },
     }
+    schema["required"] = ["name", "age", "tags", "ok"]
     g = G.compile_json_schema(schema, tok)
     good = '{"name": "bo", "age": 3, "tags": ["x"], "ok": true}'
     json.loads(good)
     assert g.matches(good.encode())
     assert g.matches(b'{"name":"", "age":-1, "tags":[], "ok":false}')
-    # wrong type, wrong order, missing key
+    # wrong type, wrong order (no additionalProperties:false), missing key
     assert not g.matches(b'{"name": 3, "age": 3, "tags": [], "ok": true}')
     assert not g.matches(b'{"age": 3, "name": "bo", "tags": [], "ok": true}')
     assert not g.matches(b'{"name": "bo"}')
@@ -189,6 +190,238 @@ def test_schema_optional_properties():
     assert g.matches(b'{"a": 1, "b": true}')
     assert g.matches(b'{"a": 1}')
     assert not g.matches(b'{"b": true}')
+
+
+def test_schema_optional_first_property_and_empty_object():
+    """Standard semantics: absent 'required' means all optional — an
+    optional FIRST property and the empty object both parse (the r3
+    compiler inverted the default and rejected optional-first)."""
+    tok = ByteTokenizer()
+    schema = {
+        "type": "object",
+        "properties": {"a": {"type": "integer"}, "b": {"type": "boolean"}},
+    }
+    g = G.compile_json_schema(schema, tok)
+    for ok in (b'{}', b'{"a": 1}', b'{"b": true}', b'{"a": 1, "b": false}'):
+        assert g.matches(ok), ok
+    for bad in (b'{"a": 1,}', b'{, "b": true}', b'{"c": 1}'):
+        assert not g.matches(bad), bad
+
+
+def test_schema_order_free_with_additional_properties_false():
+    """additionalProperties:false with <= 4 properties admits ANY property
+    order (OpenAI strict-mode schemas); unknown keys stay rejected."""
+    tok = ByteTokenizer()
+    schema = {
+        "type": "object",
+        "properties": {
+            "x": {"type": "integer"},
+            "y": {"type": "string"},
+            "z": {"type": "boolean"},
+        },
+        "required": ["x", "y", "z"],
+        "additionalProperties": False,
+    }
+    g = G.compile_json_schema(schema, tok)
+    import itertools
+    import json as J
+
+    vals = {"x": 4, "y": "s", "z": True}
+    for perm in itertools.permutations(vals):
+        doc = "{" + ", ".join(f'"{k}": {J.dumps(vals[k])}' for k in perm) + "}"
+        assert g.matches(doc.encode()), doc
+    assert not g.matches(b'{"x": 4, "y": "s"}')  # missing required
+    assert not g.matches(b'{"x": 4, "y": "s", "z": true, "w": 1}')
+
+
+def test_schema_anyof_and_integer_bounds():
+    tok = ByteTokenizer()
+    g = G.compile_json_schema({
+        "anyOf": [
+            {"type": "integer", "minimum": -12, "maximum": 250},
+            {"const": "none"},
+        ],
+    }, tok)
+    for n in (-12, -1, 0, 5, 99, 100, 250):
+        assert g.matches(str(n).encode()), n
+    for n in (-13, -100, 251, 999, 1000):
+        assert not g.matches(str(n).encode()), n
+    assert g.matches(b'"none"')
+    assert not g.matches(b'"some"')
+    assert not g.matches(b"05")  # canonical integers only
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="BOTH"):
+        G.compile_json_schema({"type": "integer", "minimum": 3}, tok)
+    with _pytest.raises(ValueError, match="unsatisfiable"):
+        G.compile_json_schema(
+            {"type": "integer", "minimum": 5, "maximum": 4}, tok)
+
+
+def test_int_range_regex_brute_force():
+    """The digit-DP integer-range regex agrees with arithmetic over every
+    value near and inside randomized bounds."""
+    import random
+
+    rng = random.Random(7)
+    tok = ByteTokenizer()
+    cases = [(0, 0), (0, 9), (1, 10), (-5, 5), (-120, -7), (17, 4321),
+             (999, 1000), (-1, 0), (100, 100)]
+    cases += [tuple(sorted((rng.randint(-3000, 3000),
+                            rng.randint(-3000, 3000)))) for _ in range(6)]
+    for lo, hi in cases:
+        g = G.compile_regex(G._int_range_regex(lo, hi), tok)
+        lo_probe = lo - 15
+        hi_probe = hi + 15
+        step = max(1, (hi_probe - lo_probe) // 400)
+        probes = set(range(lo_probe, hi_probe + 1, step))
+        probes |= {lo - 1, lo, lo + 1, hi - 1, hi, hi + 1, 0}
+        for n in probes:
+            assert g.matches(str(n).encode()) == (lo <= n <= hi), (lo, hi, n)
+
+
+def test_realistic_schemas_compile_bounded_and_roundtrip():
+    """Five realistic structured-output schemas (the response_format
+    json_schema shapes clients actually send) compile within max_states
+    and accept exactly their valid instances."""
+    import json as J
+
+    tok = ByteTokenizer()
+    cases = [
+        # 1. extraction record, strict mode (order-free)
+        ({
+            "type": "object",
+            "properties": {
+                "name": {"type": "string"},
+                "age": {"type": "integer", "minimum": 0, "maximum": 130},
+                "email": {"type": "string"},
+            },
+            "required": ["name", "age", "email"],
+            "additionalProperties": False,
+        }, [{"name": "Ada", "age": 36, "email": "a@b.c"},
+            {"email": "x@y.z", "name": "", "age": 0}],
+           [{"name": "Ada", "age": 200, "email": "a@b.c"},
+            {"name": "Ada", "age": 36}]),
+        # 2. classification with confidence
+        ({
+            "type": "object",
+            "properties": {
+                "label": {"enum": ["positive", "negative", "neutral"]},
+                "confidence": {"type": "number"},
+            },
+            "required": ["label", "confidence"],
+        }, [{"label": "positive", "confidence": 0.93}],
+           [{"label": "mixed", "confidence": 0.9}]),
+        # 3. tool-call arguments: union via anyOf
+        ({
+            "type": "object",
+            "properties": {
+                "unit": {"anyOf": [{"const": "C"}, {"const": "F"},
+                                   {"type": "null"}]},
+                "city": {"type": "string", "minLength": 1, "maxLength": 40},
+            },
+            "required": ["city"],
+        }, [{"unit": "C", "city": "Oslo"}, {"city": "Pune"},
+            {"unit": None, "city": "x"}],
+           [{"unit": "K", "city": "Oslo"}, {"unit": "C", "city": ""}]),
+        # 4. list of items with bounds
+        ({
+            "type": "object",
+            "properties": {
+                "items": {
+                    "type": "array", "minItems": 1, "maxItems": 3,
+                    "items": {
+                        "type": "object",
+                        "properties": {"sku": {"type": "string"},
+                                       "qty": {"type": "integer",
+                                               "minimum": 1,
+                                               "maximum": 99}},
+                        "required": ["sku", "qty"],
+                    },
+                },
+            },
+            "required": ["items"],
+        }, [{"items": [{"sku": "a1", "qty": 2}]},
+            {"items": [{"sku": "a", "qty": 1}, {"sku": "b", "qty": 99}]}],
+           [{"items": []}, {"items": [{"sku": "a", "qty": 0}]}]),
+        # 5. nullable scalar union (type list)
+        ({
+            "type": "object",
+            "properties": {"score": {"type": ["integer", "null"]},
+                           "ok": {"type": "boolean"}},
+            "required": ["ok"],
+        }, [{"score": 7, "ok": True}, {"score": None, "ok": False},
+            {"ok": True}],
+           [{"score": 1.5, "ok": True}, {"score": 7}]),
+    ]
+    for schema, goods, bads in cases:
+        g = G.compile_json_schema(schema, tok, max_states=20_000)
+        assert g.n_states < 20_000, schema
+        for doc in goods:
+            assert g.matches(J.dumps(doc).encode()), (schema, doc)
+        for doc in bads:
+            assert not g.matches(J.dumps(doc).encode()), (schema, doc)
+
+
+def test_schema_exclusive_bounds_and_anyof_siblings():
+    tok = ByteTokenizer()
+    g = G.compile_json_schema(
+        {"type": "integer", "exclusiveMinimum": 0, "exclusiveMaximum": 10},
+        tok)
+    for n in range(1, 10):
+        assert g.matches(str(n).encode()), n
+    for bad in (b"0", b"10", b"-500", b"11"):
+        assert not g.matches(bad), bad
+    # mixed inclusive/exclusive folds to the tighter bound
+    g = G.compile_json_schema(
+        {"type": "integer", "exclusiveMinimum": 0, "maximum": 5}, tok)
+    assert g.matches(b"1") and g.matches(b"5")
+    assert not g.matches(b"0") and not g.matches(b"6")
+    # sibling constraint keywords next to anyOf would be silently dropped
+    # (JSON Schema conjunction is unsupported) — reject loudly instead
+    with pytest.raises(ValueError, match="sibling"):
+        G.compile_json_schema(
+            {"type": "integer", "anyOf": [{"const": "x"}]}, tok)
+
+
+def test_token_strings_byte_level_with_plain_ascii_added_token():
+    """One added token registered with literal text (' ', '\\n\\n' — chars
+    a true byte-level vocab spells as Ġ/Ċ) must not flip the whole vocab
+    off the byte-level path: partial-UTF-8 tokens would then route through
+    decode() and mangle to U+FFFD."""
+    b2u = {b: u for u, b in G._gpt2_unicode_to_byte().items()}
+
+    class FakeInner:
+        all_special_ids = [0]
+
+        def convert_ids_to_tokens(self, i):
+            return {
+                3: b2u[0xC3], 4: b2u[0xA9],  # partial-UTF-8 byte tokens
+                5: "\n\n",  # plain-text added token
+            }.get(i)
+
+    class FakeTok:
+        vocab_size = 6
+        pad_id, bos_id, eos_id = 0, 1, 2
+        _tok = FakeInner()
+
+        def decode(self, ids):
+            raise AssertionError("byte-level vocab must not decode()")
+
+    toks = G.token_strings(FakeTok())
+    assert toks[3] == b"\xc3" and toks[4] == b"\xa9"  # exact bytes
+    assert toks[5] == b"\n\n"  # added token: literal text
+
+
+def test_schema_string_length_bounds():
+    tok = ByteTokenizer()
+    g = G.compile_json_schema(
+        {"type": "string", "minLength": 2, "maxLength": 4}, tok)
+    assert not g.matches(b'"a"')
+    for ok in (b'"ab"', b'"abc"', b'"abcd"', b'"a\\nb"'):  # escape = 1 char
+        assert g.matches(ok), ok
+    assert not g.matches(b'"abcde"')
+    assert not g.matches(b'""')
 
 
 def test_schema_rejects_open_schemas():
@@ -261,6 +494,36 @@ def test_token_strings_sentencepiece_marker():
     toks = G.token_strings(FakeTok())
     assert toks[3] == b" hello"
     assert toks[4] == b"world"
+
+
+def test_token_strings_sentencepiece_not_byte_level(  # ADVICE r3
+):
+    """A SentencePiece vocab whose entries include Latin-1-range chars
+    (which ALSO sit in the GPT-2 byte alphabet) must NOT be mapped through
+    the byte table per token: 'é' is UTF-8 C3 A9, not byte 0xE9. And SP
+    byte-fallback tokens like <0x0A> are ONE raw byte, not literal text."""
+
+    class FakeInner:
+        all_special_ids = [0]
+
+        def convert_ids_to_tokens(self, i):
+            # '▁the' marks this vocab as NOT byte-level (▁ is outside the
+            # GPT-2 alphabet), as in any real SP vocab.
+            return {3: "é", 4: "<0x0A>", 5: "▁the", 6: "café"}.get(i)
+
+    class FakeTok:
+        vocab_size = 7
+        pad_id, bos_id, eos_id = 0, 1, 2
+        _tok = FakeInner()
+
+        def decode(self, ids):
+            return {3: "é", 6: "café"}[ids[0]]
+
+    toks = G.token_strings(FakeTok())
+    assert toks[3] == "é".encode("utf-8")  # C3 A9, not 0xE9
+    assert toks[4] == b"\x0a"  # byte-fallback token = one raw byte
+    assert toks[5] == b" the"
+    assert toks[6] == "café".encode("utf-8")
 
 
 # ---------------------------------------------------------------------------

@@ -296,6 +296,30 @@ def test_server_guided_routes(setup):
         })
         assert status == 200
         assert json.loads(out["choices"][0]["text"]) in ("on", "off")
+        # response_format json_schema: strict-mode object (order-free,
+        # bounded integer, anyOf) through the full HTTP path
+        status, out = post("/v1/chat/completions", {
+            "messages": [{"role": "user", "content": "extract"}],
+            "max_tokens": 48,
+            "response_format": {"type": "json_schema", "json_schema": {
+                "name": "rec", "schema": {
+                    "type": "object",
+                    "properties": {
+                        "n": {"type": "integer", "minimum": 0,
+                              "maximum": 99},
+                        "u": {"anyOf": [{"const": "a"}, {"const": "b"}]},
+                    },
+                    "required": ["n", "u"],
+                    "additionalProperties": False,
+                },
+            }},
+        })
+        assert status == 200
+        text = out["choices"][0]["message"]["content"]
+        if out["choices"][0]["finish_reason"] == "stop":
+            doc = json.loads(text)
+            assert set(doc) == {"n", "u"}
+            assert 0 <= doc["n"] <= 99 and doc["u"] in ("a", "b")
         # streaming + grammar: SSE chunks concatenate to a full match
         import http.client
 
