@@ -17,7 +17,8 @@ from __future__ import annotations
 
 from typing import Protocol, Sequence
 
-__all__ = ["Tokenizer", "ByteTokenizer", "HFTokenizer", "get_tokenizer"]
+__all__ = ["Tokenizer", "ByteTokenizer", "HFTokenizer", "check_vocab",
+           "get_tokenizer"]
 
 
 class Tokenizer(Protocol):
@@ -75,7 +76,34 @@ class HFTokenizer:
         return self._tok.encode(text, add_special_tokens=False)
 
     def decode(self, ids: Sequence[int]) -> str:
-        return self._tok.decode(list(ids))
+        # Drop ids outside the tokenizer's table: a model head can be wider
+        # than the tokenizer (vocab padded for MXU tiling, or Llama-3.1's
+        # reserved rows), and an undertrained model can emit those ids —
+        # HF decode would raise/garble instead of skipping.
+        return self._tok.decode([i for i in ids if 0 <= i < self.vocab_size])
+
+
+def check_vocab(tokenizer: Tokenizer, model_vocab: int, where: str) -> None:
+    """Padded-vocab seam validation (one rule everywhere): a tokenizer
+    WIDER than the model head means ids the model cannot embed — hard
+    error; a model head wider than the tokenizer is legitimate (padding /
+    reserved rows) — the decode paths skip those ids and grammar tables
+    mask them, so it only logs."""
+    tv = tokenizer.vocab_size
+    if tv > model_vocab:
+        raise ValueError(
+            f"{where}: tokenizer vocab {tv} exceeds the model's "
+            f"{model_vocab} — prompts could contain ids the embedding "
+            f"table does not have"
+        )
+    if tv < model_vocab:
+        from ditl_tpu.utils.logging import get_logger
+
+        get_logger(__name__).info(
+            "%s: model head (%d) wider than tokenizer (%d): padded/"
+            "reserved rows; out-of-table ids are skipped on decode and "
+            "masked in grammar tables", where, model_vocab, tv,
+        )
 
 
 def get_tokenizer(name: str = "byte") -> Tokenizer:

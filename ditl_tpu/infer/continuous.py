@@ -340,6 +340,9 @@ class ContinuousEngine:
         batching: every process runs the identical tick program on its
         shard. In paged mode the kernel is shard_mapped over the tensor
         axis (kv-heads split; page table replicated)."""
+        from ditl_tpu.data.tokenizer import check_vocab
+
+        check_vocab(tokenizer, model_cfg.vocab_size, "ContinuousEngine")
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer

@@ -89,6 +89,9 @@ class Generator:
         mesh=None,
         rules=None,
     ):
+        from ditl_tpu.data.tokenizer import check_vocab
+
+        check_vocab(tokenizer, model_cfg.vocab_size, "Generator")
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer

@@ -770,17 +770,40 @@ def _int_range_regex(lo: int, hi: int) -> str:
     return "(" + "|".join(parts) + ")" if len(parts) > 1 else parts[0]
 
 
+def _reject_unsupported(schema: dict, t: str, keys: tuple) -> None:
+    """Reject-don't-drop: an unsupported constraint keyword must raise, not
+    silently over-admit — the caller believes the output is constrained."""
+    present = [k for k in keys if schema.get(k) is not None]
+    if present:
+        raise ValueError(
+            f"unsupported {t} constraint keywords {present} (this closed "
+            "subset would otherwise silently ignore them)"
+        )
+
+
 def _integer_regex(schema: dict) -> str:
+    import math
+
+    for k in ("exclusiveMinimum", "exclusiveMaximum"):
+        if isinstance(schema.get(k), bool):
+            raise ValueError(
+                f"draft-4 boolean {k} is not supported; use the draft-6+ "
+                "numeric form"
+            )
+    _reject_unsupported(schema, "integer", ("multipleOf",))
     lo, hi = schema.get("minimum"), schema.get("maximum")
-    # Exclusive bounds (pydantic's gt/lt spelling) fold to inclusive
-    # integer bounds; silently ignoring them would emit out-of-bound
-    # values from a CONSTRAINT engine.
+    # ceil/floor, not int(): truncation-toward-zero corrupts negative and
+    # fractional bounds (int(-0.5)+1 = 1 would wrongly reject 0).
+    lo = None if lo is None else math.ceil(lo)
+    hi = None if hi is None else math.floor(hi)
+    # Exclusive bounds (pydantic's gt/lt spelling) fold to the tighter
+    # inclusive integer bound.
     if schema.get("exclusiveMinimum") is not None:
-        xlo = int(schema["exclusiveMinimum"]) + 1
-        lo = xlo if lo is None else max(int(lo), xlo)
+        xlo = math.floor(schema["exclusiveMinimum"]) + 1
+        lo = xlo if lo is None else max(lo, xlo)
     if schema.get("exclusiveMaximum") is not None:
-        xhi = int(schema["exclusiveMaximum"]) - 1
-        hi = xhi if hi is None else min(int(hi), xhi)
+        xhi = math.ceil(schema["exclusiveMaximum"]) - 1
+        hi = xhi if hi is None else min(hi, xhi)
     if lo is None and hi is None:
         return _JSON_INT_RE
     if lo is None or hi is None:
@@ -789,10 +812,11 @@ def _integer_regex(schema: dict) -> str:
             "one-sided bound has unbounded digit count; give the other "
             "side)"
         )
-    return _int_range_regex(int(lo), int(hi))
+    return _int_range_regex(lo, hi)
 
 
 def _string_regex(schema: dict) -> str:
+    _reject_unsupported(schema, "string", ("pattern", "format"))
     mn = schema.get("minLength")
     mx = schema.get("maxLength")
     if mn is None and mx is None:
@@ -818,15 +842,11 @@ def _object_body(props: list, required: set) -> str:
     """Regex for an object's property list in the GIVEN order: every
     property optional unless in ``required``, comma placement exact. Built
     from two linear pieces — B(i) (``(, p_i)?`` suffix chain once something
-    was emitted) and a union over which property appears FIRST."""
+    was emitted) and a union over which property appears FIRST.
+    ``props``: (name, pair_regex) entries — sub-schemas are compiled by the
+    caller ONCE, not per permutation."""
     sep = _WS_RE + "," + _WS_RE
-
-    def pair(name, sub):
-        return (
-            _re_escape(json.dumps(name)) + _WS_RE + ":" + _WS_RE
-            + _schema_regex(sub)
-        )
-    pairs = [pair(n, s) for n, s in props]
+    pairs = [p for _, p in props]
     names = [n for n, _ in props]
     # B-suffixes, built from the tail: B[i] covers properties i..n-1 given
     # at least one earlier property was emitted.
@@ -882,6 +902,10 @@ def _schema_regex(schema: dict) -> str:
     if t == "integer":
         return _integer_regex(schema)
     if t == "number":
+        _reject_unsupported(schema, "number", (
+            "minimum", "maximum", "exclusiveMinimum", "exclusiveMaximum",
+            "multipleOf",
+        ))
         return _JSON_NUMBER_RE
     if t == "boolean":
         return "(true|false)"
@@ -926,7 +950,15 @@ def _schema_regex(schema: dict) -> str:
         # listed in 'required' (the r3 all-required default inverted this;
         # ADVICE r3).
         required = set(schema.get("required", ()))
-        props = list(props_map.items())
+        # Sub-schemas compile ONCE here; only the B-suffix chain in
+        # _object_body depends on property order, so permutations reuse
+        # these pair strings.
+        props = [
+            (name,
+             _re_escape(json.dumps(name)) + _WS_RE + ":" + _WS_RE
+             + _schema_regex(sub))
+            for name, sub in props_map.items()
+        ]
         if (schema.get("additionalProperties") is False
                 and len(props) <= _ORDER_FREE_MAX):
             # Order-free: a union over property permutations (strict-mode
@@ -1028,16 +1060,16 @@ def token_strings(tokenizer) -> list[bytes]:
     # vocab entry like 'é' is one Latin-1-range char that also happens to
     # sit in the GPT-2 alphabet — a per-token check would map it to byte
     # 0xE9 instead of UTF-8 C3 A9 and guided output could then violate the
-    # constraint (ADVICE r3). Plain-ASCII strings are excluded from the
-    # vote: added tokens registered with literal text (" ", "\n\n" —
-    # chars a true byte-level vocab spells as Ġ/Ċ) would otherwise flip
-    # one real byte-level vocab to the decode() path, which mangles
-    # partial-UTF-8 tokens; they encode literally either way.
-    def _plain(s: str) -> bool:
-        return s.isascii()
-
-    byte_level = to_tokens is not None and all(
-        s is None or _plain(s) or all(ch in u2b for ch in s)
+    # constraint (ADVICE r3). The vote is a POSITIVE signal — some token
+    # contains a REMAPPED alphabet char (ord >= 0x100: Ġ for space, Ċ for
+    # newline, ...), which every real byte-level vocab has in thousands of
+    # tokens and no SentencePiece vocab has at all (▁ is U+2581, outside
+    # the alphabet). An absence vote would let any single added token
+    # registered as literal text (" ", CJK, emoji) flip a genuine
+    # byte-level vocab onto the decode() path that mangles partial-UTF-8
+    # tokens.
+    byte_level = to_tokens is not None and any(
+        s is not None and any(ord(ch) >= 0x100 and ch in u2b for ch in s)
         for i, s in enumerate(strings) if i not in specials
     )
     import re as _re
@@ -1053,7 +1085,8 @@ def token_strings(tokenizer) -> list[bytes]:
             if byte_level:
                 if all(ch in u2b for ch in s):
                     out.append(bytes(u2b[ch] for ch in s))
-                else:  # plain-ASCII added token ("\n\n"): literal text
+                else:  # added token registered as literal text (" ",
+                    # "\n\n", CJK, emoji): its surface IS the string
                     out.append(s.encode("utf-8"))
                 continue
             m = byte_fallback.match(s)

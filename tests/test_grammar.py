@@ -377,6 +377,23 @@ def test_schema_exclusive_bounds_and_anyof_siblings():
         {"type": "integer", "exclusiveMinimum": 0, "maximum": 5}, tok)
     assert g.matches(b"1") and g.matches(b"5")
     assert not g.matches(b"0") and not g.matches(b"6")
+    # fractional bounds fold with ceil/floor, not int() truncation
+    g = G.compile_json_schema(
+        {"type": "integer", "exclusiveMinimum": -0.5, "maximum": 2.5}, tok)
+    for n, ok in ((-1, False), (0, True), (2, True), (3, False)):
+        assert g.matches(str(n).encode()) == ok, n
+    # draft-4 boolean exclusive bounds are rejected, not mis-folded
+    with pytest.raises(ValueError, match="draft-4"):
+        G.compile_json_schema(
+            {"type": "integer", "minimum": 5, "exclusiveMinimum": True},
+            tok)
+    # unsupported constraints REJECT rather than silently over-admit
+    with pytest.raises(ValueError, match="unsupported number"):
+        G.compile_json_schema(
+            {"type": "number", "minimum": 0, "maximum": 1}, tok)
+    with pytest.raises(ValueError, match="unsupported string"):
+        G.compile_json_schema(
+            {"type": "string", "pattern": "[a-z]+"}, tok)
     # sibling constraint keywords next to anyOf would be silently dropped
     # (JSON Schema conjunction is unsupported) — reject loudly instead
     with pytest.raises(ValueError, match="sibling"):
@@ -385,10 +402,12 @@ def test_schema_exclusive_bounds_and_anyof_siblings():
 
 
 def test_token_strings_byte_level_with_plain_ascii_added_token():
-    """One added token registered with literal text (' ', '\\n\\n' — chars
-    a true byte-level vocab spells as Ġ/Ċ) must not flip the whole vocab
-    off the byte-level path: partial-UTF-8 tokens would then route through
-    decode() and mangle to U+FFFD."""
+    """Added tokens registered with literal text (' ', '\\n\\n', CJK,
+    emoji — chars a true byte-level vocab spells through the alphabet)
+    must not flip the whole vocab off the byte-level path: partial-UTF-8
+    tokens would then route through decode() and mangle to U+FFFD. The
+    detection is a POSITIVE vote — remapped alphabet chars (Ġ/Ċ) present —
+    so no added token can break it."""
     b2u = {b: u for u, b in G._gpt2_unicode_to_byte().items()}
 
     class FakeInner:
@@ -398,10 +417,12 @@ def test_token_strings_byte_level_with_plain_ascii_added_token():
             return {
                 3: b2u[0xC3], 4: b2u[0xA9],  # partial-UTF-8 byte tokens
                 5: "\n\n",  # plain-text added token
+                6: b2u[0x20] + "the",  # Ġthe: the positive signal
+                7: "你好",  # non-ASCII added token (outside the alphabet)
             }.get(i)
 
     class FakeTok:
-        vocab_size = 6
+        vocab_size = 8
         pad_id, bos_id, eos_id = 0, 1, 2
         _tok = FakeInner()
 
@@ -411,6 +432,8 @@ def test_token_strings_byte_level_with_plain_ascii_added_token():
     toks = G.token_strings(FakeTok())
     assert toks[3] == b"\xc3" and toks[4] == b"\xa9"  # exact bytes
     assert toks[5] == b"\n\n"  # added token: literal text
+    assert toks[6] == b" the"
+    assert toks[7] == "你好".encode("utf-8")
 
 
 def test_schema_string_length_bounds():
@@ -447,9 +470,11 @@ def test_token_strings_byte_level_bpe_partial_utf8():
 
         def convert_ids_to_tokens(self, i):
             # token 3: the lone byte 0xC3 (first half of 'é') — decode()
-            # would mangle this to U+FFFD
+            # would mangle this to U+FFFD. Token 6 carries the Ġ (space
+            # remap) every real byte-level vocab has — the positive
+            # byte-level detection signal.
             return {3: b2u[0xC3], 4: b2u[0xA9], 5: "".join(b2u[b] for b in b"hi"),
-                    9: "<unk>"}.get(i)
+                    6: b2u[0x20] + "a", 9: "<unk>"}.get(i)
 
     class FakeTok:
         vocab_size = 10
@@ -463,6 +488,7 @@ def test_token_strings_byte_level_bpe_partial_utf8():
     assert toks[3] == b"\xc3"
     assert toks[4] == b"\xa9"
     assert toks[5] == b"hi"
+    assert toks[6] == b" a"
     assert toks[9] == b""  # special beyond pad/bos/eos excluded too
     # and the partial pair composes: walking both halves matches 'é'
     g_next, g_acc = None, None

@@ -1,0 +1,92 @@
+"""Padded-vocab seam (VERDICT r3 weak #6): a model head WIDER than the
+tokenizer (MXU-tiling padding, Llama-3.1 reserved rows) must serve guided,
+logprobs, and sampling correctly — grammar tables mask the padded ids,
+decode paths skip them — and a tokenizer wider than the model must fail
+loudly at construction."""
+
+import jax
+import pytest
+
+from ditl_tpu.config import ModelConfig
+from ditl_tpu.data.tokenizer import ByteTokenizer, check_vocab
+from ditl_tpu.infer.continuous import ContinuousEngine
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.models import llama
+
+
+def _cfg(vocab):
+    return ModelConfig(
+        vocab_size=vocab, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_seq_len=128, dtype="float32", param_dtype="float32",
+    )
+
+
+@pytest.fixture(scope="module")
+def wide_setup():
+    # ByteTokenizer is 259 entries; the model head is padded to 320.
+    cfg = _cfg(320)
+    params = llama.init_params(jax.random.key(0), cfg)
+    return params, cfg, ByteTokenizer()
+
+
+def test_tokenizer_wider_than_model_rejected():
+    cfg = _cfg(128)  # narrower than the 259-entry byte tokenizer
+    params = llama.init_params(jax.random.key(0), cfg)
+    with pytest.raises(ValueError, match="exceeds the model"):
+        Generator(params, cfg, ByteTokenizer())
+    with pytest.raises(ValueError, match="exceeds the model"):
+        ContinuousEngine(params, cfg, ByteTokenizer())
+
+
+def test_wide_head_guided_never_emits_padded_ids(wide_setup):
+    """The grammar table is tokenizer-width, relocated into a model-width
+    device table with padded columns at -1 — guided decode can only emit
+    real tokens, and the output matches the constraint."""
+    params, cfg, tok = wide_setup
+    from ditl_tpu.infer import grammar as G
+
+    g = G.compile_regex("[ab]{2,6}", tok)
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4,
+        gen=GenerateConfig(max_new_tokens=12), fsm_capacity=g.n_states + 2,
+    )
+    rid = eng.submit([tok.bos_id] + tok.encode("go:"), grammar=g)
+    out = eng.run()[rid]
+    assert all(t < tok.vocab_size for t in out)
+    text = tok.decode(out)
+    assert 2 <= len(text) <= 6 and set(text) <= {"a", "b"}
+
+
+def test_wide_head_logprobs_and_sampling_decode_safely(wide_setup):
+    """Unguided sampling on a random wide-head model CAN pick padded ids;
+    the logprob top-k may contain them too. Both must flow through the
+    engine and decode without faulting (decode skips out-of-table ids)."""
+    params, cfg, tok = wide_setup
+    eng = ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4,
+        gen=GenerateConfig(max_new_tokens=10), logprobs_k=3,
+    )
+    rid = eng.submit(
+        [tok.bos_id] + tok.encode("hi"), temperature=1.0, seed=3,
+        logprobs=3,
+    )
+    done = {}
+    while eng.pending:
+        eng.step()
+        for req in eng.take_finished():
+            done[req.req_id] = req
+    req = done[rid]
+    assert len(req.tokens) > 0
+    tok.decode(req.tokens)  # must not raise, whatever ids were sampled
+    for row in req.lp_top_ids:
+        for tid in row:
+            tok.decode([tid])  # top-k alternatives decode safely too
+
+
+def test_check_vocab_polarity():
+    tok = ByteTokenizer()
+    check_vocab(tok, tok.vocab_size, "eq")  # equal: fine
+    check_vocab(tok, tok.vocab_size + 61, "wider")  # model wider: fine
+    with pytest.raises(ValueError):
+        check_vocab(tok, tok.vocab_size - 1, "narrower")
