@@ -154,13 +154,33 @@ def _model_cfg(name: str, platform: str):
     return cfg, batch, seq, optimizer
 
 
-def _repetitive_finetune(params, cfg, pattern, n_steps: int, batch: int,
-                         seq: int):
-    """Briefly fine-tune the bench model on sequences that repeat
-    ``pattern`` — the reproducible stand-in for the repetitive-continuation
-    serving regime (code edits, RAG quoting, structured output) where
-    prompt-lookup speculation pays. Returns the tuned params (bf16/f32 as
-    configured). ~n_steps x one train step of wall clock."""
+def _bigram_tokens(rng, batch: int, n: int, vocab: int):
+    """(batch, n) windows of a PEAKED bigram chain over tokens
+    [16, vocab): next = 16 + ((cur-16) + 17 + eps) mod (vocab-16), with
+    eps = 0 w.p. 0.65 (the mode a trained model locks onto). Predictable
+    to a model that learned the domain, but trajectories from fresh random
+    starts share almost no verbatim n-grams — the regime where
+    prompt-lookup speculation cannot draft and a draft MODEL can. The
+    chain is AFFINE (+17), not multiplicative: the Carmichael function of
+    a highly-composite modulus is tiny (lambda(1008) = 12), so x -> g*x
+    chains collapse into cycles shorter than one generation and become
+    lookup's best case."""
+    import numpy as np
+
+    m = vocab - 16
+    starts = rng.integers(0, m, size=(batch,))
+    eps = rng.choice(8, size=(batch, n - 1), p=[0.65] + [0.05] * 7)
+    x = np.empty((batch, n), np.int64)
+    x[:, 0] = starts
+    for t in range(1, n):
+        x[:, t] = (x[:, t - 1] + 17 + eps[:, t - 1]) % m
+    return (16 + x).astype(np.int32)
+
+
+def _domain_finetune(params, cfg, n_steps: int, batch: int, seq: int,
+                     make_batch, label: str):
+    """Briefly fine-tune ``params`` on batches from ``make_batch(rng)`` —
+    shared trainer harness for the workload-specific tune-ups below."""
     import jax
     import numpy as np
 
@@ -174,7 +194,6 @@ def _repetitive_finetune(params, cfg, pattern, n_steps: int, batch: int,
                        learning_rate=1e-3, optimizer="adamw")
     mesh = build_mesh(MeshConfig())
     rng = np.random.default_rng(1)
-    p = np.asarray(pattern, np.int32)
     host = {
         "input_ids": np.zeros((batch, seq), np.int32),
         "loss_mask": np.ones((batch, seq), np.float32),
@@ -187,16 +206,41 @@ def _repetitive_finetune(params, cfg, pattern, n_steps: int, batch: int,
     state = state.replace(params=params)
     step = make_train_step(cfg, tcfg, mesh, gb)
     for _ in range(n_steps):
-        offs = rng.integers(0, len(p), size=batch)
-        ids = np.stack([
-            np.resize(np.roll(p, -int(o)), seq) for o in offs
-        ]).astype(np.int32)
-        host["input_ids"] = ids
+        host["input_ids"] = make_batch(rng)
         state, metrics = step(state, make_global_batch(mesh, host))
     loss = float(metrics["loss"])
-    print(f"bench: repetitive fine-tune {n_steps} steps, loss {loss:.3f}",
+    print(f"bench: {label} fine-tune {n_steps} steps, loss {loss:.3f}",
           file=sys.stderr)
     return state.params
+
+
+def _bigram_finetune(params, cfg, vocab: int, n_steps: int, batch: int,
+                     seq: int):
+    return _domain_finetune(
+        params, cfg, n_steps, batch, seq,
+        lambda rng: _bigram_tokens(rng, batch, seq, vocab), "bigram",
+    )
+
+
+def _repetitive_finetune(params, cfg, pattern, n_steps: int, batch: int,
+                         seq: int):
+    """Briefly fine-tune the bench model on sequences that repeat
+    ``pattern`` — the reproducible stand-in for the repetitive-continuation
+    serving regime (code edits, RAG quoting, structured output) where
+    prompt-lookup speculation pays. Returns the tuned params (bf16/f32 as
+    configured). ~n_steps x one train step of wall clock."""
+    import numpy as np
+
+    p = np.asarray(pattern, np.int32)
+
+    def make_batch(rng):
+        offs = rng.integers(0, len(p), size=batch)
+        return np.stack([
+            np.resize(np.roll(p, -int(o)), seq) for o in offs
+        ]).astype(np.int32)
+
+    return _domain_finetune(params, cfg, n_steps, batch, seq, make_batch,
+                            "repetitive")
 
 
 def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
@@ -241,6 +285,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         attention_impl="xla", kv_cache_dtype="int8" if kv_quant else "",
     )
     batch = slots if platform == "tpu" else 2
+    max_new_explicit = bool(max_new)  # 0 = not passed on the CLI
     max_new = max_new or (128 if platform == "tpu" else 16)
     if platform != "tpu":
         cfg = dataclasses.replace(cfg, num_layers=2, hidden_size=256,
@@ -263,12 +308,40 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         params = _repetitive_finetune(params, cfg, pattern, n_steps,
                                       batch, seq)
         plen = prompt_len or (256 if platform == "tpu" else 32)
-        if not max_new or max_new == 128:
+        if not max_new_explicit:
             max_new = 192 if platform == "tpu" else 16
         prompts = []
         for i in range(batch):
             roll = pattern[i % len(pattern):] + pattern[: i % len(pattern)]
             prompts.append((roll * (plen // len(roll) + 1))[:plen])
+    elif workload == "bigram":
+        # Draft-model speculation's own turf: the peaked bigram domain is
+        # PREDICTABLE to a model trained on it, but prompts are NOVEL
+        # trajectories (fresh rng) sharing almost no verbatim n-grams with
+        # themselves or their continuations — prompt-lookup has nothing to
+        # draft from, so its acceptance collapses while a domain-tuned
+        # draft model keeps agreeing with the target.
+        # ~4080 transition rows x ~400 visits each: enough for the 350M
+        # target AND the 12M drafter to put their argmax on the chain's
+        # mode, which is what deterministic-proposal rejection sampling
+        # pays for (acceptance/token ~= p_T(draft)).
+        chain_vocab = min(4096, cfg.vocab_size)
+        n_steps, seq = (400, 512) if platform == "tpu" else (4, 64)
+        params = _bigram_finetune(params, cfg, chain_vocab, n_steps,
+                                  batch, seq)
+        plen = prompt_len or (256 if platform == "tpu" else 32)
+        if not max_new_explicit:
+            max_new = 192 if platform == "tpu" else 16
+        if temperature <= 0.0:
+            raise SystemExit(
+                "--infer-workload bigram needs --temperature > 0: the "
+                "greedy argmax path of a deterministic chain self-cycles "
+                "(period <= lambda(m)), turning the workload into prompt-"
+                "lookup's best case and invalidating the draft-vs-lookup "
+                "split it exists to measure (BASELINE.md r4)"
+            )
+        novel = np.random.default_rng(1234)  # disjoint from training rng(1)
+        prompts = _bigram_tokens(novel, batch, plen, chain_vocab).tolist()
     elif workload == "random":
         plen = prompt_len or 61
         prompts = [
@@ -304,6 +377,12 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             draft_params = _repetitive_finetune(
                 draft_params, draft_cfg, pattern, n_steps, batch, seq
             )
+        elif workload == "bigram":
+            # SAME chain space as the target's tune-up above — the whole
+            # acceptance lever is the two models agreeing on the domain.
+            draft_params = _bigram_finetune(
+                draft_params, draft_cfg, chain_vocab, n_steps, batch, seq,
+            )
     if quantize:
         from ditl_tpu.ops.quant import quantize_weights
 
@@ -334,7 +413,13 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 speculative=speculative,
                 # The bench measures the speculative path itself; the
                 # auto-decision's own probing is pinned by tests.
-                spec_threshold=0.0 if speculative else None,
+                # bigram keeps the AUTO decision: the claim under test is
+                # that lookup acceptance collapses and auto-disables while
+                # the draft model keeps paying — forcing every tick
+                # speculative would measure lookup drafting garbage.
+                spec_threshold=(
+                    0.0 if speculative and workload != "bigram" else None
+                ),
                 fsm_capacity=(grammar.n_states + 2) if grammar else 0,
                 draft_params=draft_params, draft_cfg=draft_cfg,
                 pipeline_ticks=pipeline,
@@ -599,7 +684,8 @@ if __name__ == "__main__":
                         help="speculative decode ticks (--infer --engine "
                         "continuous; A/B against the same command without "
                         "this flag)")
-    parser.add_argument("--infer-workload", choices=("random", "repetitive"),
+    parser.add_argument("--infer-workload",
+                        choices=("random", "repetitive", "bigram"),
                         default="random",
                         help="'repetitive' briefly fine-tunes on a repeated "
                         "pattern and prompts with it — the regime where "

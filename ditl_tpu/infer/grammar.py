@@ -1060,17 +1060,27 @@ def token_strings(tokenizer) -> list[bytes]:
     # vocab entry like 'é' is one Latin-1-range char that also happens to
     # sit in the GPT-2 alphabet — a per-token check would map it to byte
     # 0xE9 instead of UTF-8 C3 A9 and guided output could then violate the
-    # constraint (ADVICE r3). The vote is a POSITIVE signal — some token
-    # contains a REMAPPED alphabet char (ord >= 0x100: Ġ for space, Ċ for
-    # newline, ...), which every real byte-level vocab has in thousands of
-    # tokens and no SentencePiece vocab has at all (▁ is U+2581, outside
-    # the alphabet). An absence vote would let any single added token
-    # registered as literal text (" ", CJK, emoji) flip a genuine
-    # byte-level vocab onto the decode() path that mangles partial-UTF-8
-    # tokens.
-    byte_level = to_tokens is not None and any(
-        s is not None and any(ord(ch) >= 0x100 and ch in u2b for ch in s)
-        for i, s in enumerate(strings) if i not in specials
+    # constraint (ADVICE r3). Two signals combine:
+    # - POSITIVE: some token contains a remapped alphabet char
+    #   (ord >= 0x100 — Ġ for space, Ċ for newline), which every real
+    #   byte-level vocab has in thousands of tokens. A mere absence vote
+    #   would let one added token registered as literal text (" ", CJK,
+    #   emoji) flip a genuine byte-level vocab onto the decode() path that
+    #   mangles partial-UTF-8 tokens.
+    # - VETO: any token containing the SentencePiece word marker ▁
+    #   (U+2581, outside the alphabet). The remap range U+0100-U+0143
+    #   contains real Latin-Extended-A letters (ā, č, ł ...), so a
+    #   multilingual SP vocab ('▁český') would otherwise false-positive —
+    #   but every SP vocab carries ▁ pieces, and no byte-level vocab
+    #   spells one.
+    real = [
+        s for i, s in enumerate(strings)
+        if i not in specials and s is not None
+    ]
+    byte_level = (
+        to_tokens is not None
+        and any(any(ord(c) >= 0x100 and c in u2b for c in s) for s in real)
+        and not any("▁" in s for s in real)
     )
     import re as _re
 

@@ -436,6 +436,31 @@ def test_token_strings_byte_level_with_plain_ascii_added_token():
     assert toks[7] == "你好".encode("utf-8")
 
 
+def test_token_strings_sp_vocab_with_latin_extended_not_byte_level():
+    """The GPT-2 remap range U+0100–U+0143 contains real Latin-Extended-A
+    letters (ā, č, ł …): a multilingual SentencePiece vocab ('▁český')
+    must NOT flip onto the byte-level path — the ▁ marker vetoes."""
+
+    class FakeInner:
+        all_special_ids = [0]
+
+        def convert_ids_to_tokens(self, i):
+            return {3: "▁český", 4: "▁the", 5: "ně"}.get(i)
+
+    class FakeTok:
+        vocab_size = 6
+        pad_id, bos_id, eos_id = 0, 1, 2
+        _tok = FakeInner()
+
+        def decode(self, ids):
+            return {5: "ně"}[ids[0]]
+
+    toks = G.token_strings(FakeTok())
+    assert toks[3] == " český".encode("utf-8")  # ▁ branch, real UTF-8
+    assert toks[4] == b" the"
+    assert toks[5] == "ně".encode("utf-8")  # decode() route, not byte map
+
+
 def test_schema_string_length_bounds():
     tok = ByteTokenizer()
     g = G.compile_json_schema(
