@@ -409,8 +409,27 @@ class ContinuousEngine:
 
             if mesh is not None:
                 from ditl_tpu.ops.attention import _mesh_axes_size
-                from ditl_tpu.parallel.sharding import DEFAULT_RULES, named_sharding_tree
+                from ditl_tpu.parallel.sharding import (
+                    DEFAULT_RULES,
+                    named_sharding_tree,
+                    seq_shards,
+                )
 
+                if seq_shards(mesh, rules) > 1:
+                    # Deliberate (BASELINE.md r4 'sequence-sharded x
+                    # paged'): page pools shard kv-heads/tensor only and
+                    # REPLICATE over the sequence axis — paged capacity
+                    # does not scale with it. The sequence axis exists for
+                    # contexts that exceed one chip's HBM, where
+                    # concurrency is inherently tiny and paged's capacity
+                    # sharing buys nothing; use the contiguous cache there
+                    # (it context-shards over the axis).
+                    logger.warning(
+                        "cache_mode='paged' on a sequence-sharded mesh: "
+                        "page pools replicate over the sequence axis (no "
+                        "context-capacity scaling); long-context serving "
+                        "should use the contiguous cache"
+                    )
                 r = rules if rules is not None else DEFAULT_RULES
                 tp = _mesh_axes_size(mesh, r.get("act_kv_heads"))
                 if tp > 1 and (model_cfg.num_kv_heads % tp

@@ -121,6 +121,56 @@ def test_engine_seq_sharded_smax_divisibility(setup, seq_mesh):
 
 
 @pytest.mark.slow
+def test_engine_seq_sharded_int8_kv(setup, seq_mesh):
+    """int8 KV composes with the context-sharded cache: quantization is
+    per-position (elementwise over the sharded axis), so the sharded
+    engine matches the single-device int8 engine exactly."""
+    import dataclasses
+
+    params, cfg, tok = setup
+    cfg8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+    gen = GenerateConfig(max_new_tokens=10)
+    prompts = ["the quick brown fox jumps", "hello"]
+    ref = ContinuousEngine(
+        params, cfg8, tok, n_slots=2, decode_chunk=4, gen=gen,
+    ).generate(prompts)
+    eng = ContinuousEngine(
+        params, cfg8, tok, n_slots=2, decode_chunk=4, gen=gen,
+        mesh=seq_mesh,
+    )
+    assert eng.generate(prompts) == ref
+    assert eng.cache["k"].sharding.spec[2] is not None  # context-sharded
+
+
+@pytest.mark.slow
+def test_paged_pools_replicate_over_sequence_axis(setup, seq_mesh, caplog):
+    """The written decision (BASELINE.md r4): paged pools do NOT shard on
+    the sequence axis — they replicate (correct output, warned loudly),
+    because the axis's regime (contexts beyond one chip's HBM, concurrency
+    of a few) is exactly where paged capacity-sharing buys nothing. The
+    contiguous cache is the long-context configuration."""
+    import logging
+
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=8)
+    ref = ContinuousEngine(
+        params, cfg, tok, n_slots=2, decode_chunk=4, gen=gen,
+    ).generate(["hello world"])
+    with caplog.at_level(logging.WARNING):
+        eng = ContinuousEngine(
+            params, cfg, tok, n_slots=2, decode_chunk=4, gen=gen,
+            mesh=seq_mesh, cache_mode="paged", page_size=16,
+        )
+    assert any("sequence" in r.message for r in caplog.records)
+    # Construction intent: pools are NOT context-sharded (page-slot axis
+    # carries capacity, and no spec entry maps it to 'sequence'). After a
+    # step GSPMD may re-lay the donated pool however it likes.
+    spec = eng.cache["kp"].sharding.spec
+    assert len(spec) < 2 or spec[1] is None  # page-slot axis unsharded
+    assert eng.generate(["hello world"]) == ref  # correct, just unscaled
+
+
+@pytest.mark.slow
 def test_engine_seq_sharded_speculative(setup, seq_mesh):
     """Spec ticks' (B, K+1)-query verify also rides the sharded-context
     merge path."""
