@@ -648,6 +648,7 @@ class ContinuousEngine:
                     ),
                 )
             self._draft_prefill_cache: dict[int, Any] = {}
+            self._draft_suffix_cache: dict[int, Any] = {}
 
         # Per-slot token history (prompt + generated incl. the pending
         # ``cur``) — the draft source for speculative ticks. Rides the tick
@@ -875,22 +876,86 @@ class ContinuousEngine:
 
         return jax.jit(run, donate_argnums=(1,))
 
-    def _draft_prefill(self, req: Request, slot: int) -> None:
-        """Admission hook (model drafting only): load the prompt into the
-        draft model's cache for ``slot``."""
+    def _build_draft_suffix_prefill(self, s_bucket: int):
+        """Suffix continuation of the draft cache at an offset — the
+        chunked form of ``_build_draft_prefill`` (same shape as the target
+        model's suffix prefill: bucket tail beyond ``s_len`` writes garbage
+        that the draft scan overwrites before attending it)."""
+        dcfg = self.draft_cfg
+        slots_iota = jnp.arange(self.smax, dtype=jnp.int32)
+
+        def run(dparams, dcache, ids, offset, s_len, slot):
+            row = jax.tree.map(
+                lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
+                dcache,
+            )
+            q_pos = offset + jnp.arange(s_bucket, dtype=jnp.int32)
+            mask = slots_iota[None, None, :] <= q_pos[None, :, None]
+            _, row = llama.forward(
+                dparams, ids, dcfg, positions=q_pos[None],
+                cache=row, cache_index=offset, attn_mask=mask,
+                mesh=self.mesh, rules=self.rules,
+            )
+            return jax.tree.map(
+                lambda c, r: jax.lax.dynamic_update_slice_in_dim(
+                    c, r, slot, axis=1
+                ),
+                dcache,
+                row,
+            )
+
+        return jax.jit(run, donate_argnums=(1,))
+
+    def _draft_prefill(self, req: Request, slot: int,
+                       ctx: list[int] | None = None) -> None:
+        """Admission hook (model drafting only): load the context into the
+        draft model's cache for ``slot``. ``ctx`` defaults to the prompt;
+        preemption resume passes ``prompt + tokens`` — the draft cache has
+        no device-captured frontier, so every position up to the resumed
+        ``pos`` must be re-fed or the drafter would attend the prior
+        occupant's stale KV (ADVICE r4). Long contexts honor
+        ``prefill_chunk`` (resume contexts reach buckets no prompt does;
+        one fixed chunk program beats a pow2 ladder of mid-serving
+        compiles)."""
         if self.spec_draft != "model":
             return
-        p_bucket = min(_next_pow2(len(req.prompt), floor=16), self.smax)
+        if ctx is None:
+            ctx = req.prompt
+        if self.prefill_chunk and len(ctx) > self.prefill_chunk:
+            d, step = 0, self.prefill_chunk
+            while d < len(ctx):
+                s = min(step, len(ctx) - d)
+                s_bucket = (
+                    step if d + step <= self.smax
+                    else min(_next_pow2(s, floor=16), self.smax - d)
+                )
+                if s_bucket not in self._draft_suffix_cache:
+                    logger.info(
+                        "compiling draft suffix prefill for bucket %d",
+                        s_bucket,
+                    )
+                    self._draft_suffix_cache[s_bucket] = (
+                        self._build_draft_suffix_prefill(s_bucket)
+                    )
+                ids = np.full((1, s_bucket), self.tokenizer.pad_id, np.int32)
+                ids[0, :s] = ctx[d: d + s]
+                self.draft_cache = self._draft_suffix_cache[s_bucket](
+                    self.draft_params, self.draft_cache, jnp.asarray(ids),
+                    jnp.int32(d), jnp.int32(s), jnp.int32(slot),
+                )
+                d += s
+            return
+        p_bucket = min(_next_pow2(len(ctx), floor=16), self.smax)
         if p_bucket not in self._draft_prefill_cache:
             logger.info("compiling draft prefill for bucket %d", p_bucket)
             self._draft_prefill_cache[p_bucket] = self._build_draft_prefill(
                 p_bucket
             )
         ids = np.full((1, p_bucket), self.tokenizer.pad_id, np.int32)
-        ids[0, : len(req.prompt)] = req.prompt
+        ids[0, : len(ctx)] = ctx
         self.draft_cache = self._draft_prefill_cache[p_bucket](
             self.draft_params, self.draft_cache, jnp.asarray(ids),
-            jnp.int32(len(req.prompt)), jnp.int32(slot),
+            jnp.int32(len(ctx)), jnp.int32(slot),
         )
 
     def _draft_scan(self, dparams, dcache, cur, pos, smax):
@@ -2232,7 +2297,7 @@ class ContinuousEngine:
         if self.logprobs_k and req.preempt_lp is not None:
             self._store_lp(slot, *req.preempt_lp)
         self._set_hist(slot, ctx, req.preempt_cur)
-        self._draft_prefill(req, slot)
+        self._draft_prefill(req, slot, ctx=ctx)
         self.temps = self.temps.at[slot].set(req.temperature)
         self.top_ps = self.top_ps.at[slot].set(req.top_p)
         self.adapters = self.adapters.at[slot].set(req.adapter_id)
@@ -2243,13 +2308,17 @@ class ContinuousEngine:
         return True
 
     def _pick_victim(self, needy: Request) -> int | None:
-        """Youngest active request STRICTLY younger than ``needy`` (so the
-        oldest in-flight request is never preempted and always progresses —
-        the no-deadlock invariant). None when ``needy`` is itself the
-        youngest."""
+        """Youngest in-flight request STRICTLY younger than ``needy`` (so
+        the oldest in-flight request is never preempted and always
+        progresses — the no-deadlock invariant). Prefilling slots are
+        eligible victims too (ADVICE r4: skipping them let the needy
+        request preempt ITSELF when every younger request was still
+        prefilling, transiently breaking the invariant); a mid-prefill
+        victim has no sampling frontier yet and is simply requeued as
+        fresh. None when ``needy`` is itself the youngest."""
         best: int | None = None
         for slot, req in enumerate(self._slots):
-            if (req is None or req.prefilling or req.finished
+            if (req is None or req.finished
                     or req.cancelled or req.req_id <= needy.req_id):
                 continue
             if best is None or req.req_id > self._slots[best].req_id:
@@ -2264,6 +2333,24 @@ class ContinuousEngine:
         re-admission costs roughly one partial-page prefill. Capture of the
         sampling frontier stays device-lazy (no transfer)."""
         req = self._slots[slot]
+        if req.prefilling:
+            # Mid-prefill: nothing sampled yet, no frontier to capture —
+            # requeue as a FRESH request. The chunks already written are
+            # published (whole pages only) so re-admission prefix-matches
+            # them and the lost work is at most one partial page.
+            self._publish_tokens(
+                req.prompt[: req.prefill_pos], slot, req.adapter_id
+            )
+            req.prefilling = False
+            req.prefill_pos = 0
+            self._slots[slot] = None
+            self._free_slot_pages(slot)
+            self._queue.appendleft(req)
+            self.preemptions += 1
+            logger.info(
+                "preempted mid-prefill request %d; requeued fresh", req.req_id
+            )
+            return
         req.preempted = True
         req.preempt_cur = self.cur[slot]
         req.preempt_key = self.keys[slot]

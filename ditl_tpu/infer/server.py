@@ -390,19 +390,20 @@ class _Handler(BaseHTTPRequestHandler):
                 return (sum(lp[: len(toks)]) / max(1, len(toks))) if lp else 0.0
 
             cands.sort(key=score, reverse=True)
+        # Bill the tokens actually GENERATED — all best_of candidates, not
+        # just the n returned (OpenAI best_of billing); and count ids, not
+        # a re-encode: decode->encode is not idempotent for every tokenizer
+        # (byte tokenizers strip non-printables), so re-encoding
+        # under-counts (ADVICE r3, r4).
+        total_out = sum(len(out) for out, _ in cands)
         cands = cands[:n]
         choices = []
-        total_out = 0
         for i, (out, _) in enumerate(cands):
             text, hit_stop = _apply_stop(tok.decode(out), stops or [])
             finish = (
                 "stop" if hit_stop or len(out) < gen.max_new_tokens
                 else "length"
             )
-            # Bill the tokens actually GENERATED: decode->encode is not
-            # idempotent for every tokenizer (byte tokenizers strip
-            # non-printables), so re-encoding under-counts (ADVICE r3).
-            total_out += len(out)
             choices.append(
                 {"index": i, "message": {"role": "assistant", "content": text},
                  "finish_reason": finish}

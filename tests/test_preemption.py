@@ -196,6 +196,97 @@ def test_cancel_of_preempted_request_that_finished_while_queued(setup):
     assert sentinels == 1  # exactly one terminal None despite the cancel
 
 
+@pytest.mark.slow
+def test_preemption_resume_with_draft_model_spec(setup):
+    """ADVICE r4 (medium): resume must re-prefill the DRAFT model's cache
+    with the full resumed context, not just the prompt — otherwise the
+    drafter attends the slot's prior occupant's stale KV at every position
+    past the prompt, collapsing acceptance (and, for sampled requests,
+    shifting the realized stream through the rejection residual).
+
+    Deterministic probe: a PERFECT drafter (draft == target) holds
+    acceptance well above the bonus-only floor; after a resume into a
+    FOREIGN slot, a prompt-only draft re-prefill would leave it drafting
+    against the other request's context, and post-resume acceptance drops
+    to ~the floor. Pins post-resume acceptance high + tokens exact."""
+    params, cfg, tok = setup
+    a, b = PROMPT, [1] + list(range(30, 46))
+    # prefill_chunk=32 with the preemption taken past 20 generated tokens:
+    # the resume context (37+) exceeds the chunk, so the draft re-prefill
+    # exercises the CHUNKED suffix path (resume contexts reach buckets no
+    # prompt does; the draft prefill honors prefill_chunk like the target's
+    # resume loop).
+    kw = dict(
+        n_slots=2, n_pages=40, admission="optimistic", speculative=True,
+        spec_k=4, draft_params=params, draft_cfg=cfg, prefill_chunk=32,
+        gen=GenerateConfig(max_new_tokens=96),
+    )
+    ref_eng = _engine(setup, **kw)
+    rids = [ref_eng.submit(p) for p in (a, b)]
+    ref = ref_eng.run()
+
+    # Force the stale-slot case deterministically: preempt b (slot 1)
+    # mid-flight, hold it queued until a finishes, so b resumes into slot
+    # 0 — whose DRAFT cache holds a's KV at every position past b's
+    # prompt length.
+    eng = _engine(setup, **kw)
+    ra, rb = eng.submit(a), eng.submit(b)
+    while True:
+        eng.step()
+        breq = eng._slots[1]
+        if breq is not None and breq.req_id == rb and len(breq.tokens) >= 20:
+            break
+    eng._preempt_slot(1)
+    held = eng._queue.popleft()  # park b so it cannot resume into slot 1
+    while any(r is not None for r in eng._slots):
+        eng.step()  # drive a to completion; slot 0 frees
+    pre_t, pre_f = held.spec_tokens, held.spec_forwards
+    eng._queue.appendleft(held)
+    eng.step()
+    assert eng._slots[0] is held  # resumed into the foreign slot
+    res = eng.run()
+    assert res[ra] == ref[rids[0]]
+    assert res[rb] == ref[rids[1]]  # greedy exactness is unconditional
+    # The drafter kept drafting against b's REAL context after the resume:
+    # acceptance stays near its uncontended level (>2 tokens/forward with
+    # k=4 on random weights), not the ~1.0 bonus-only floor a stale-context
+    # drafter collapses to.
+    post = (held.spec_tokens - pre_t) / max(1, held.spec_forwards - pre_f)
+    assert post > 2.0
+
+
+def test_prefilling_younger_is_preempted_not_the_needy_oldest(setup):
+    """ADVICE r4 (low): when every younger request is still mid-prefill,
+    the pool squeeze must pick a prefilling YOUNGER victim — requeued as a
+    fresh request — never the needy oldest (the no-deadlock invariant)."""
+    params, cfg, tok = setup
+    gen = GenerateConfig(max_new_tokens=32)
+    long_b = [1] + list(range(2, 152))  # 151 tokens: 5 chunks of 32
+    solo = _engine(setup, n_pages=40, gen=gen, prefill_chunk=32)
+    ra, rb = solo.submit(PROMPT), solo.submit(long_b)
+    ref = solo.run()
+
+    eng = _engine(setup, n_pages=40, admission="optimistic", gen=gen,
+                  prefill_chunk=32)
+    ra2 = eng.submit(PROMPT)
+    eng.step()  # a admitted and decoding
+    rb2 = eng.submit(long_b)
+    eng.step()  # b admitted, still prefilling (151 > 32)
+    areq = next(r for r in eng._slots if r is not None and r.req_id == ra2)
+    breq = next(r for r in eng._slots if r is not None and r.req_id == rb2)
+    assert breq.prefilling
+    victim = eng._pick_victim(areq)
+    assert victim == breq.slot  # prefilling slots are eligible victims now
+    eng._preempt_slot(victim)
+    # Mid-prefill victims requeue FRESH: no frontier capture, no preempted
+    # flag — re-admission prefix-matches the published whole pages.
+    assert not breq.preempted and not breq.prefilling
+    assert breq in eng._queue
+    assert eng.preemptions == 1
+    res = eng.run()
+    assert res[ra2] == ref[ra] and res[rb2] == ref[rb]
+
+
 def test_optimistic_with_guided_early_finish(setup):
     """Guided requests finish far below max_tokens: optimistic admission
     turns the unused pessimistic budget into real concurrency, and the FSM
