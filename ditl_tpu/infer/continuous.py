@@ -879,12 +879,13 @@ class ContinuousEngine:
     def _build_draft_suffix_prefill(self, s_bucket: int):
         """Suffix continuation of the draft cache at an offset — the
         chunked form of ``_build_draft_prefill`` (same shape as the target
-        model's suffix prefill: bucket tail beyond ``s_len`` writes garbage
-        that the draft scan overwrites before attending it)."""
+        model's suffix prefill: the bucket tail past the chunk's real
+        tokens writes garbage that the draft scan overwrites before
+        attending it, so no valid-length masking is needed)."""
         dcfg = self.draft_cfg
         slots_iota = jnp.arange(self.smax, dtype=jnp.int32)
 
-        def run(dparams, dcache, ids, offset, s_len, slot):
+        def run(dparams, dcache, ids, offset, slot):
             row = jax.tree.map(
                 lambda c: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1),
                 dcache,
@@ -925,10 +926,7 @@ class ContinuousEngine:
             d, step = 0, self.prefill_chunk
             while d < len(ctx):
                 s = min(step, len(ctx) - d)
-                s_bucket = (
-                    step if d + step <= self.smax
-                    else min(_next_pow2(s, floor=16), self.smax - d)
-                )
+                s_bucket = self._chunk_bucket(d, s)
                 if s_bucket not in self._draft_suffix_cache:
                     logger.info(
                         "compiling draft suffix prefill for bucket %d",
@@ -941,7 +939,7 @@ class ContinuousEngine:
                 ids[0, :s] = ctx[d: d + s]
                 self.draft_cache = self._draft_suffix_cache[s_bucket](
                     self.draft_params, self.draft_cache, jnp.asarray(ids),
-                    jnp.int32(d), jnp.int32(s), jnp.int32(slot),
+                    jnp.int32(d), jnp.int32(slot),
                 )
                 d += s
             return
@@ -1956,6 +1954,15 @@ class ContinuousEngine:
             *self._fsm_args(req.fsm_start),
         ), slot)
 
+    def _chunk_bucket(self, d: int, s: int) -> int:
+        """Write-window bucket for a prefill chunk of ``s`` tokens at offset
+        ``d``: the fixed ``prefill_chunk`` program, except tail chunks near
+        the cache end, which take a smaller bucket — the window must fit
+        (a clamped dynamic_update_slice would silently shift the chunk)."""
+        if d + self.prefill_chunk <= self.smax:
+            return self.prefill_chunk
+        return min(_next_pow2(s, floor=16), self.smax - d)
+
     def _advance_prefill(self, req: Request) -> None:
         """One chunk of a chunked prefill (reuses the suffix-prefill program —
         a chunk IS a suffix continuation at offset ``prefill_pos``). The
@@ -1981,14 +1988,7 @@ class ContinuousEngine:
             return
         d = req.prefill_pos
         s = min(self.prefill_chunk, len(req.prompt) - d)
-        # The write window must fit: a clamped dynamic_update_slice would
-        # silently shift the chunk. Tail chunks near the cache end use a
-        # smaller bucket.
-        s_bucket = (
-            self.prefill_chunk
-            if d + self.prefill_chunk <= self.smax
-            else min(_next_pow2(s, floor=16), self.smax - d)
-        )
+        s_bucket = self._chunk_bucket(d, s)
         if s_bucket not in self._suffix_prefill:
             logger.info("compiling suffix prefill for bucket %d", s_bucket)
             self._suffix_prefill[s_bucket] = self._build_suffix_prefill(s_bucket)

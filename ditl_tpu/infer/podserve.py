@@ -803,10 +803,13 @@ class _Ticket:
         return self.result
 
 
-def continuous_worker_loop(engine) -> None:
+def continuous_worker_loop(engine) -> str:
     """Run on every ``jax.process_index() != 0`` process under
     ``--pod --engine continuous``: mirror the coordinator's tick broadcasts
-    on an identical engine replica until shutdown."""
+    on an identical engine replica until shutdown. Returns the exit reason
+    (``"shutdown"`` | ``"desync"`` | ``"bad-opcode"``) so launchers and the
+    multi-process drill can tell a clean teardown from a loud divergence
+    halt."""
     engine.freeze_spec_threshold()  # same reason as PodContinuousDriver
     logger.info("pod continuous worker: entering broadcast loop")
     while True:
@@ -814,10 +817,10 @@ def continuous_worker_loop(engine) -> None:
         op = int(header[0])
         if op == _SHUTDOWN:
             logger.info("pod continuous worker: shutdown")
-            return
+            return "shutdown"
         if op != _CTICK:
             logger.error("pod continuous worker: unexpected opcode %d", op)
-            return
+            return "bad-opcode"
         n_sub, ids_total, n_cancel = int(header[1]), int(header[2]), int(header[3])
         meta = (_broadcast(np.zeros((n_sub, 6), np.int32))
                 if n_sub else np.zeros((0, 6), np.int32))
@@ -839,4 +842,4 @@ def continuous_worker_loop(engine) -> None:
                 "pod continuous worker: tick status/scheduler-state "
                 "diverged; shutting down"
             )
-            return
+            return "desync"

@@ -1283,21 +1283,19 @@ def serve(argv: list[str] | None = None) -> int:
     if args.fsm_capacity and args.pod:
         parser.error("--fsm-capacity does not compose with --pod yet (the "
                      "tick broadcast does not carry grammar registrations)")
-    if args.pipeline_ticks and args.pod:
-        parser.error("--pipeline-ticks does not compose with --pod yet "
-                     "(the pod tick protocol broadcasts and harvests in "
-                     "lockstep; double-buffering it is untested)")
     if args.pipeline_ticks and args.engine != "continuous":
         parser.error("--pipeline-ticks requires --engine continuous")
+    # --pipeline-ticks and --admission optimistic both compose with --pod:
+    # the lagged harvest and the preemption decisions (_topup_pages /
+    # _pick_victim) are deterministic functions of the replicated scheduler
+    # state, so every replica double-buffers, preempts, and resumes
+    # identically. Pinned single-process in tests/test_podserve.py and at
+    # real process_count=2 by the "paged" drill leg (tests/multiproc_drill.py).
     if args.admission == "optimistic":
         if args.engine != "continuous" or args.cache_mode != "paged":
             parser.error("--admission optimistic requires --engine "
                          "continuous --cache-mode paged (only the page pool "
                          "can be reclaimed mid-flight)")
-        if args.pod:
-            parser.error("--admission optimistic does not compose with "
-                         "--pod yet (preemption decisions are host-local; "
-                         "the tick broadcast does not carry them)")
     if jax.process_index() != 0 and not args.pod:
         # Without --pod, one process binds the port and the others exit; with
         # --pod every process joins the collective decode loop below.

