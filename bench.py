@@ -133,7 +133,15 @@ def _model_cfg(name: str, platform: str):
             name="bench-1b3", vocab_size=32768, hidden_size=2048,
             intermediate_size=5632, num_layers=24, num_heads=16, num_kv_heads=8,
             head_dim=128, max_seq_len=2048, dtype="bfloat16",
-            param_dtype="bfloat16", remat="dots", attention_impl="flash",
+            param_dtype="bfloat16",
+            # r5: fused gate|up layout + the dots_inputs remat policy
+            # (save the norm outputs feeding the projections) measured
+            # -19 ms/step TOGETHER on v5e (582 -> 563; each alone is
+            # noise) — the first bite out of the r4 roofline's backward-
+            # scheduling residual (experiments/bwd_levers.py receipts in
+            # BASELINE.md). Same math: fused layout is bit-exact.
+            remat="dots_inputs", fused_gate_up=True,
+            attention_impl="flash",
             flash_block_q=1024, flash_block_kv=1024,
             # r3 sweep: CE block 4096 is +0.5% over 2048 (8192 matches
             # 4096); 2048-token flash tiles exceed v5e's 16M scoped VMEM,

@@ -166,9 +166,33 @@ class ModelConfig:
     # TPU) | "int8" (symmetric per-head absmax quantization, infer/cache.py)
     kv_cache_dtype: str = ""
     # Gradient checkpointing policy for the layer scan:
-    # "none" | "full" | "dots" | "attn" (save only attention outputs, so the
+    # "none" | "full" | "dots" | "dots_inputs" (dots plus the norm outputs
+    # feeding the qkv/gate/up projections, so every backward GEMM reads a
+    # stored operand) | "attn" (save only attention outputs, so the
     # backward never re-runs the attention kernel).
     remat: str = "full"
+    # Unroll factor for the training-path layer scan: >1 lets XLA fuse and
+    # overlap across consecutive layers' forward/backward at the cost of
+    # code size / compile time (the fusion-boundary lever the r4 roofline
+    # named). 1 = fully rolled (one layer's HLO).
+    scan_unroll: int = 1
+    # Store gate and up projections as ONE (D, 2F) matrix: half the MLP
+    # GEMM count forward and backward (one fwd GEMM, one dgrad, one wgrad
+    # instead of two each) — bigger MXU tiles, fewer kernel boundaries.
+    # Same math: the fused output splits into (gate, up) before SwiGLU.
+    # Tensor-parallel note: the gate|up boundary aligns with shard edges
+    # only for an EVEN tensor-axis size; odd sizes insert per-layer
+    # resharding around the split (correct, but erodes the fusion win).
+    fused_gate_up: bool = False
+    # Same trick for the attention input projections: q|k|v stored as one
+    # (D, (nh + 2*nkv)*hd) matrix — one GEMM (and one dgrad/wgrad pair)
+    # instead of three. Not composable with LoRA adapters (which target
+    # the per-projection names). Tensor-parallel note: under GQA
+    # (nkv < nh) the q|k|v boundaries generally do NOT align with
+    # head-axis shard edges, so TP meshes reshard around the split —
+    # prefer the unfused layout for TP serving; the fusion targets
+    # single-chip / data-parallel training.
+    fused_qkv: bool = False
     # Loss head: "naive" materializes (B, S, V) f32 logits; "fused" computes
     # the lm-head matmul + cross-entropy blockwise (ops/fused_ce.py) so peak
     # logits memory is loss_block_tokens x V instead of B*S*V.

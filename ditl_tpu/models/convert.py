@@ -122,12 +122,23 @@ def params_from_state_dict(
                     _stack(sd, "model.layers.{i}.input_layernorm.weight", L, False)
                 )
             },
-            "attn": {
-                "wq": cast(_stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, True)),
-                "wk": cast(_stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, True)),
-                "wv": cast(_stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, True)),
-                "wo": cast(_stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, True)),
-            },
+            "attn": (
+                {
+                    "w_qkv": cast(np.concatenate([
+                        _stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, True),
+                        _stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, True),
+                        _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, True),
+                    ], axis=-1)),
+                    "wo": cast(_stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, True)),
+                }
+                if cfg.fused_qkv else
+                {
+                    "wq": cast(_stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, True)),
+                    "wk": cast(_stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, True)),
+                    "wv": cast(_stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, True)),
+                    "wo": cast(_stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, True)),
+                }
+            ),
             "mlp_norm": {
                 "scale": cast(
                     _stack(
@@ -176,6 +187,14 @@ def params_from_state_dict(
             "w_up": cast(experts("w3", True)),
             "w_down": cast(experts("w2", True)),
         }
+    elif cfg.fused_gate_up:
+        params["layers"]["mlp"] = {
+            "w_gu": cast(np.concatenate([
+                _stack(sd, "model.layers.{i}.mlp.gate_proj.weight", L, True),
+                _stack(sd, "model.layers.{i}.mlp.up_proj.weight", L, True),
+            ], axis=-1)),
+            "w_down": cast(_stack(sd, "model.layers.{i}.mlp.down_proj.weight", L, True)),
+        }
     else:
         params["layers"]["mlp"] = {
             "w_gate": cast(_stack(sd, "model.layers.{i}.mlp.gate_proj.weight", L, True)),
@@ -212,8 +231,17 @@ def state_dict_from_params(params: Mapping[str, Any], cfg: ModelConfig) -> dict[
         p = f"model.layers.{i}"
         sd[f"{p}.input_layernorm.weight"] = host(layers["attn_norm"]["scale"][i])
         sd[f"{p}.post_attention_layernorm.weight"] = host(layers["mlp_norm"]["scale"][i])
-        for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
-            sd[f"{p}.self_attn.{theirs}.weight"] = host(layers["attn"][ours][i]).T
+        if "w_qkv" in layers["attn"]:
+            nq = cfg.num_heads * cfg.head_dim
+            nk = cfg.num_kv_heads * cfg.head_dim
+            w = layers["attn"]["w_qkv"][i]
+            sd[f"{p}.self_attn.q_proj.weight"] = host(w[:, :nq]).T
+            sd[f"{p}.self_attn.k_proj.weight"] = host(w[:, nq:nq + nk]).T
+            sd[f"{p}.self_attn.v_proj.weight"] = host(w[:, nq + nk:]).T
+            sd[f"{p}.self_attn.o_proj.weight"] = host(layers["attn"]["wo"][i]).T
+        else:
+            for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj"), ("wo", "o_proj")):
+                sd[f"{p}.self_attn.{theirs}.weight"] = host(layers["attn"][ours][i]).T
         if cfg.attention_bias:
             for ours, theirs in (("bq", "q_proj"), ("bk", "k_proj"), ("bv", "v_proj")):
                 sd[f"{p}.self_attn.{theirs}.bias"] = host(layers["attn"][ours][i])
@@ -225,6 +253,12 @@ def state_dict_from_params(params: Mapping[str, Any], cfg: ModelConfig) -> dict[
                 sd[f"{q}.w1.weight"] = host(moe["w_gate"][i, j]).T
                 sd[f"{q}.w3.weight"] = host(moe["w_up"][i, j]).T
                 sd[f"{q}.w2.weight"] = host(moe["w_down"][i, j]).T
+        elif "w_gu" in layers["mlp"]:
+            mlp = layers["mlp"]
+            f = cfg.intermediate_size
+            sd[f"{p}.mlp.gate_proj.weight"] = host(mlp["w_gu"][i, :, :f]).T
+            sd[f"{p}.mlp.up_proj.weight"] = host(mlp["w_gu"][i, :, f:]).T
+            sd[f"{p}.mlp.down_proj.weight"] = host(mlp["w_down"][i]).T
         else:
             mlp = layers["mlp"]
             sd[f"{p}.mlp.gate_proj.weight"] = host(mlp["w_gate"][i]).T

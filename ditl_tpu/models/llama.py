@@ -68,12 +68,21 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         },
         "layers": {
             "attn_norm": {"scale": jnp.ones((L, d), pd)},
-            "attn": {
-                "wq": dense(next(keys), (L, d, nh * hd), d),
-                "wk": dense(next(keys), (L, d, nkv * hd), d),
-                "wv": dense(next(keys), (L, d, nkv * hd), d),
-                "wo": dense(next(keys), (L, nh * hd, d), nh * hd),
-            },
+            "attn": (
+                {
+                    "w_qkv": dense(
+                        next(keys), (L, d, (nh + 2 * nkv) * hd), d
+                    ),
+                    "wo": dense(next(keys), (L, nh * hd, d), nh * hd),
+                }
+                if cfg.fused_qkv else
+                {
+                    "wq": dense(next(keys), (L, d, nh * hd), d),
+                    "wk": dense(next(keys), (L, d, nkv * hd), d),
+                    "wv": dense(next(keys), (L, d, nkv * hd), d),
+                    "wo": dense(next(keys), (L, nh * hd, d), nh * hd),
+                }
+            ),
             "mlp_norm": {"scale": jnp.ones((L, d), pd)},
         },
         "final_norm": {"scale": jnp.ones((d,), pd)},
@@ -88,6 +97,11 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
         from ditl_tpu.models.moe import init_moe_params
 
         params["layers"]["moe"] = init_moe_params(next(keys), cfg)
+    elif cfg.fused_gate_up:
+        params["layers"]["mlp"] = {
+            "w_gu": dense(next(keys), (L, d, 2 * f), d),
+            "w_down": dense(next(keys), (L, f, d), f),
+        }
     else:
         params["layers"]["mlp"] = {
             "w_gate": dense(next(keys), (L, d, f), d),
@@ -97,6 +111,11 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
     if not cfg.tie_embeddings:
         params["lm_head"] = {"kernel": dense(next(keys), (d, cfg.vocab_size), d)}
     if cfg.lora_rank > 0:
+        if cfg.fused_qkv:
+            raise ValueError(
+                "fused_qkv does not compose with LoRA adapters (deltas "
+                "target the per-projection names wq/wk/wv)"
+            )
         from ditl_tpu.models.lora import init_lora_params
 
         params["layers"]["lora"] = init_lora_params(next(keys), cfg)
@@ -110,9 +129,11 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
         "layers": {
             "attn_norm": {"scale": ("layers", "norm")},
             "attn": {
-                "wq": ("layers", "embed", "heads"),
-                "wk": ("layers", "embed", "kv_heads"),
-                "wv": ("layers", "embed", "kv_heads"),
+                **({"w_qkv": ("layers", "embed", "heads")}
+                   if cfg.fused_qkv else
+                   {"wq": ("layers", "embed", "heads"),
+                    "wk": ("layers", "embed", "kv_heads"),
+                    "wv": ("layers", "embed", "kv_heads")}),
                 "wo": ("layers", "heads", "embed"),
                 **({"bq": ("layers", "heads"),
                     "bk": ("layers", "kv_heads"),
@@ -127,6 +148,11 @@ def param_logical_axes(cfg: ModelConfig) -> Params:
         from ditl_tpu.models.moe import moe_logical_axes
 
         axes["layers"]["moe"] = moe_logical_axes(cfg)
+    elif cfg.fused_gate_up:
+        axes["layers"]["mlp"] = {
+            "w_gu": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
     else:
         axes["layers"]["mlp"] = {
             "w_gate": ("layers", "embed", "mlp"),
@@ -233,6 +259,23 @@ def _apply_remat(layer_fn, cfg: ModelConfig):
             layer_fn,
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
         )
+    if cfg.remat == "dots_inputs":
+        # "dots" plus the two norm outputs (attn_in/mlp_in): the backward's
+        # weight-gradient GEMMs read stored operands instead of a recompute
+        # chain. Deliberately does NOT save the flash attention output —
+        # measured on v5e (r5): adding attn_out REGRESSED the step by
+        # ~45 ms (the recompute overlaps fine; the extra resident buffers
+        # push XLA into worse layouts), while attn_in+mlp_in combined with
+        # fused_gate_up is -20 ms. ~64MB/layer extra HBM over "dots".
+        return jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+                jax.checkpoint_policies.save_only_these_names(
+                    "attn_in", "mlp_in"
+                ),
+            ),
+        )
     if cfg.remat == "attn":
         # Save only the per-layer attention outputs; recompute the rest.
         return jax.checkpoint(
@@ -240,7 +283,10 @@ def _apply_remat(layer_fn, cfg: ModelConfig):
             policy=jax.checkpoint_policies.save_only_these_names("attn_out"),
         )
     if cfg.remat != "none":
-        raise ValueError(f"unknown remat policy {cfg.remat!r} (none|full|dots|attn)")
+        raise ValueError(
+            f"unknown remat policy {cfg.remat!r} "
+            "(none|full|dots|dots_inputs|attn)"
+        )
     return layer_fn
 
 
@@ -297,14 +343,38 @@ def _decoder_layer(
 
     # Attention block
     h = rms_norm(x, layer_params["attn_norm"]["scale"], cfg.rms_norm_eps)
+    # Named for remat="dots_inputs": h is the qkv projections' WGRAD
+    # operand — saving it keeps the backward's weight-gradient GEMMs fed
+    # from a stored buffer instead of a recompute chain (r5 ablation:
+    # in-step wgrads ran at ~2x their isolated cost under remat="dots").
+    h = checkpoint_name(h, "attn_in")
 
     def _bias(t, name):
         # Qwen2-family q/k/v bias (o stays bias-free).
         return t + attn[name].astype(t.dtype) if name in attn else t
 
-    q = _bias(proj(h, attn["wq"], "wq"), "bq").reshape(b, s, nh, hd)
-    k = _bias(proj(h, attn["wk"], "wk"), "bk").reshape(b, s, nkv, hd)
-    v = _bias(proj(h, attn["wv"], "wv"), "bv").reshape(b, s, nkv, hd)
+    if "w_qkv" in attn:
+        # fused_qkv: one (D, (nh+2*nkv)*hd) GEMM replaces the q/k/v trio —
+        # and one dgrad/wgrad pair replaces three each in the backward.
+        if lora is not None:
+            # init_params guards config-time; this closes the runtime hole
+            # (adapters attached post-init by the serving path or a loaded
+            # tree) — silently dropping deltas would serve base outputs.
+            raise ValueError(
+                "fused_qkv does not compose with LoRA adapters (deltas "
+                "target the per-projection names wq/wk/wv)"
+            )
+        qkv = weight_einsum("bsd,df->bsf", h, attn["w_qkv"], compute_dtype=cd)
+        q, k, v = jnp.split(
+            qkv, (nh * hd, (nh + nkv) * hd), axis=-1
+        )
+        q = _bias(q, "bq").reshape(b, s, nh, hd)
+        k = _bias(k, "bk").reshape(b, s, nkv, hd)
+        v = _bias(v, "bv").reshape(b, s, nkv, hd)
+    else:
+        q = _bias(proj(h, attn["wq"], "wq"), "bq").reshape(b, s, nh, hd)
+        k = _bias(proj(h, attn["wk"], "wk"), "bk").reshape(b, s, nkv, hd)
+        v = _bias(proj(h, attn["wv"], "wv"), "bv").reshape(b, s, nkv, hd)
     q = apply_rope(q, positions, cfg=cfg)
     k = apply_rope(k, positions, cfg=cfg)
     q = _constrain(q, ("batch", "seq", "act_heads", "head_dim"), mesh, rules)
@@ -400,6 +470,7 @@ def _decoder_layer(
 
     # MLP / MoE block
     h = rms_norm(x, layer_params["mlp_norm"]["scale"], cfg.rms_norm_eps)
+    h = checkpoint_name(h, "mlp_in")  # gate/up wgrad operand (see attn_in)
     aux = jnp.zeros((), jnp.float32)
     if "moe" in layer_params:
         from ditl_tpu.models.moe import moe_block
@@ -407,10 +478,19 @@ def _decoder_layer(
         mlp_out, aux = moe_block(layer_params["moe"], h, cfg, mesh=mesh, rules=rules)
     else:
         mlp = layer_params["mlp"]
-        gate = weight_einsum("bsd,df->bsf", h, mlp["w_gate"], compute_dtype=cd)
-        up = weight_einsum("bsd,df->bsf", h, mlp["w_up"], compute_dtype=cd)
+        if "w_gu" in mlp:
+            # fused_gate_up: one (D, 2F) GEMM replaces the gate/up pair —
+            # and one dgrad/wgrad pair replaces two in the backward.
+            gu = weight_einsum("bsd,df->bsf", h, mlp["w_gu"], compute_dtype=cd)
+            gate, up = jnp.split(gu, 2, axis=-1)
+        else:
+            gate = weight_einsum("bsd,df->bsf", h, mlp["w_gate"], compute_dtype=cd)
+            up = weight_einsum("bsd,df->bsf", h, mlp["w_up"], compute_dtype=cd)
         inner = jax.nn.silu(gate) * up
         inner = _constrain(inner, ("batch", "seq", "act_mlp"), mesh, rules)
+        # Named so remat policies CAN save it (w_down's wgrad operand);
+        # no shipped policy does — measured neutral-to-negative on v5e.
+        inner = checkpoint_name(inner, "mlp_inner")
         mlp_out = weight_einsum("bsf,fd->bsd", inner, mlp["w_down"], compute_dtype=cd)
     x = x + mlp_out
     x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
@@ -538,7 +618,9 @@ def forward(
             )
 
         layer_fn = _apply_remat(layer_fn, cfg)
-        x, layer_aux = jax.lax.scan(layer_fn, x, params["layers"])
+        x, layer_aux = jax.lax.scan(
+            layer_fn, x, params["layers"], unroll=cfg.scan_unroll
+        )
         new_cache = None
 
     x = rms_norm(x, params["final_norm"]["scale"], cfg.rms_norm_eps)

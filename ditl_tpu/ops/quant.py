@@ -25,7 +25,7 @@ import jax.numpy as jnp
 __all__ = ["quantize_weights", "is_quantized_leaf", "weight_einsum"]
 
 # Param-tree leaves that are (…, d_in, d_out) matmul weights.
-_QUANT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "kernel")
+_QUANT_KEYS = ("wq", "wk", "wv", "w_qkv", "wo", "w_gate", "w_up", "w_gu", "w_down", "kernel")
 
 
 def is_quantized_leaf(w: Any) -> bool:

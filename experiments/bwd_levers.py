@@ -1,0 +1,211 @@
+"""Round-5 backward levers A/B on the pinned 1b3 config (follow-up to
+bwd_ablation.py, which measured in-step wgrads at ~2.1-2.3x their
+isolated-rate ideal: MLP wgrads 94.5 ms vs ~44, attn-proj wgrads 39.5 ms
+vs ~17 — ~73 ms of headroom in a 580.9 ms step).
+
+Legs (adjacent, one session):
+  base          pinned config re-anchor
+  gu            fused_gate_up=True (half the MLP GEMM count fwd+bwd)
+  di            remat="dots_inputs" (save the norm outputs: wgrad operands
+                come from stored buffers, not a recompute chain)
+  gu_di         both
+  iso           k-differenced ISOLATED rates of the exact wgrad GEMM
+                shapes (einsum 'bsd,bsf->df' over 8192 tokens, bf16) — is
+                the GEMM itself slow, or only its in-step schedule?
+
+Usage: python experiments/bwd_levers.py [chunk windows]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+
+import bench
+from ditl_tpu.config import MeshConfig, TrainConfig
+from ditl_tpu.data.loader import make_global_batch
+from ditl_tpu.runtime.mesh import build_mesh
+from ditl_tpu.train.state import create_train_state
+from ditl_tpu.train.step import make_multi_step
+
+
+def time_step_leg(name, cfg, mesh, tcfg, window, example, chunk, n_windows):
+    try:
+        t0 = time.perf_counter()
+        state = create_train_state(jax.random.key(0), cfg, tcfg)
+        multi = make_multi_step(cfg, tcfg, mesh, example, chunk)
+        state, m = multi(state, make_global_batch(mesh, window(0)))
+        float(m["loss"][-1])  # full sync (remote transport)
+        compile_s = time.perf_counter() - t0
+        staged = [make_global_batch(mesh, window(w))
+                  for w in range(1, n_windows + 1)]
+        jax.block_until_ready(staged)
+        times = []
+        for gb in staged:
+            t0 = time.perf_counter()
+            state, m = multi(state, gb)
+            float(m["loss"][-1])
+            times.append((time.perf_counter() - t0) / chunk * 1e3)
+        ms = float(np.median(times))
+        print(f"LEG {name}: {ms:.1f} ms/step (windows "
+              f"{[f'{t:.1f}' for t in times]}, compile {compile_s:.0f}s)",
+              flush=True)
+        del state
+        return ms
+    except Exception as e:  # noqa: BLE001
+        print(f"LEG {name}: FAILED {type(e).__name__}: {e}", flush=True)
+        return None
+
+
+def iso_wgrad_rates():
+    """k-differenced isolated rates for the backward GEMM shapes of the
+    1b3 MLP/attn families (T=8192 tokens). Weights/activations are
+    program ARGS; a data-dependence + ReLU barrier stops XLA folding the
+    loop (ditl-tpu-env-gotchas)."""
+    T, D, F = 8192, 2048, 5632
+    shapes = {
+        # wgrads: contraction over tokens
+        "wgrad_gate (TxD)^T @ (TxF)": ((T, D), (T, F), "td,tf->df"),
+        "wgrad_down (TxF)^T @ (TxD)": ((T, F), (T, D), "tf,td->fd"),
+        "wgrad_gu   (TxD)^T @ (Tx2F)": ((T, D), (T, 2 * F), "td,tf->df"),
+        "wgrad_qkvo (TxD)^T @ (TxD)": ((T, D), (T, D), "td,tf->df"),
+        # dgrads: same shape family as forward
+        "dgrad_gate (TxF) @ (FxD)": ((T, F), (F, D), "tf,fd->td"),
+    }
+    rng = jax.random.key(0)
+
+    for name, (sa, sb, spec) in shapes.items():
+        a = jax.random.normal(jax.random.fold_in(rng, 1), sa, jnp.bfloat16)
+        b = jax.random.normal(jax.random.fold_in(rng, 2), sb, jnp.bfloat16)
+
+        def run_k(k):
+            @jax.jit
+            def f(a, b):
+                def body(i, carry):
+                    s, a_ = carry
+                    out = jnp.einsum(
+                        spec, a_, b,
+                        preferred_element_type=jnp.float32,
+                    ).astype(jnp.bfloat16)
+                    d = out.reshape(-1)[0].astype(jnp.float32)
+                    # ReLU barrier + feed the scalar back into the input:
+                    # the next iteration's operand depends on this one's
+                    # output, so nothing hoists or folds.
+                    a2 = a_ + (jax.nn.relu(d) * 0.0).astype(a_.dtype)
+                    return (s + d, a2)
+
+                return jax.lax.fori_loop(0, k, body, (jnp.float32(0), a))[0]
+
+            f(a, b)  # compile + warm
+            float(f(a, b))
+            t0 = time.perf_counter()
+            float(f(a, b))
+            return time.perf_counter() - t0
+
+        k1, k2 = 6, 30
+        t1, t2 = run_k(k1), run_k(k2)
+        per = (t2 - t1) / (k2 - k1)
+        flops = 2 * sa[0] * sa[1] * (sb[1] if len(sb) > 1 else 1)
+        # einsum contracting over t: FLOPs = 2*T*D*F style — compute from
+        # output: 2 * T * (rows of out) * (cols of out)
+        if "wgrad" in name:
+            flops = 2 * sa[0] * sa[1] * sb[1]
+        else:
+            flops = 2 * sa[0] * sa[1] * sb[1]
+        tf = flops / per / 1e12
+        print(f"ISO {name}: {per * 1e3:.2f} ms  {tf:.0f} TF/s "
+              f"({tf / 197 * 100:.0f}% of peak)", flush=True)
+
+
+def main():
+    chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    n_windows = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    platform = jax.devices()[0].platform
+    print(f"platform={platform}", file=sys.stderr)
+
+    cfg, batch, seq, optimizer = bench._model_cfg("1b3", platform)
+    tcfg = TrainConfig(total_steps=1000, warmup_steps=10, optimizer=optimizer)
+    mesh = build_mesh(MeshConfig())
+
+    rng = np.random.default_rng(0)
+    all_tokens = bench._bigram_batches(
+        rng, chunk * (n_windows + 1), batch, seq, cfg.vocab_size
+    )
+    ones = np.ones((chunk, batch, seq), np.float32)
+    segs = np.ones((chunk, batch, seq), np.int32)
+    pos = np.tile(np.arange(seq, dtype=np.int32), (chunk, batch, 1))
+
+    def window(i):
+        toks = all_tokens[i * chunk:(i + 1) * chunk]
+        return {
+            "input_ids": toks, "loss_mask": ones,
+            "labels": np.zeros((chunk, batch), np.int32),
+            "segment_ids": segs, "positions": pos,
+        }
+
+    example = {k: v[0] for k, v in window(0).items()}
+
+    gu_di = dataclasses.replace(cfg, fused_gate_up=True,
+                                remat="dots_inputs")
+    legs = [
+        ("base", cfg),
+        ("gu_di", gu_di),
+        ("gu_di_bt512", dataclasses.replace(
+            gu_di, flash_block_q_bwd=512, flash_block_kv_bwd=1024)),
+        ("gu_di_bt512b", dataclasses.replace(
+            gu_di, flash_block_q_bwd=1024, flash_block_kv_bwd=512)),
+        ("gu_di_ce8k", dataclasses.replace(gu_di, loss_block_tokens=8192)),
+    ]
+    results = {}
+    for name, leg_cfg in legs:
+        if name == "gu_di_inner":
+            # Probe: ALSO save inner (w_down's wgrad operand) — patch the
+            # policy for this leg only.
+            from ditl_tpu.models import llama as _llama
+
+            orig = _llama._apply_remat
+
+            def patched(layer_fn, c):
+                import jax as _jax
+
+                return _jax.checkpoint(
+                    layer_fn,
+                    policy=_jax.checkpoint_policies.save_from_both_policies(
+                        _jax.checkpoint_policies
+                        .checkpoint_dots_with_no_batch_dims,
+                        _jax.checkpoint_policies.save_only_these_names(
+                            "attn_in", "mlp_in", "mlp_inner"
+                        ),
+                    ),
+                )
+
+            _llama._apply_remat = patched
+            try:
+                ms = time_step_leg(name, leg_cfg, mesh, tcfg, window,
+                                   example, chunk, n_windows)
+            finally:
+                _llama._apply_remat = orig
+        else:
+            ms = time_step_leg(name, leg_cfg, mesh, tcfg, window, example,
+                               chunk, n_windows)
+        if ms is not None:
+            results[name] = ms
+    if "base" in results:
+        for name, ms in results.items():
+            if name != "base":
+                print(f"DELTA {name}: {ms - results['base']:+.1f} ms",
+                      flush=True)
+    if platform == "tpu":
+        iso_wgrad_rates()
+
+
+if __name__ == "__main__":
+    main()

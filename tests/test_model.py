@@ -135,7 +135,8 @@ def test_num_params(tiny_model_cfg):
     assert n == sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
 
 
-@pytest.mark.parametrize("remat", ["none", "full", "dots", "attn"])
+@pytest.mark.parametrize("remat", ["none", "full", "dots", "dots_inputs",
+                                   "attn"])
 def test_remat_policies_preserve_loss_and_grads(tiny_model_cfg, remat):
     """Every remat policy is a memory schedule, not a math change."""
     from ditl_tpu.train.step import loss_fn
@@ -162,3 +163,127 @@ def test_remat_unknown_policy_raises(tiny_model_cfg):
     ids = jnp.ones((1, 8), jnp.int32)
     with pytest.raises(ValueError, match="unknown remat"):
         llama.forward(params, ids, cfg)
+
+
+def test_fused_gate_up_bit_exact_and_roundtrips(tiny_model_cfg):
+    """``fused_gate_up=True`` stores gate|up as one (D, 2F) matrix — same
+    math (one GEMM + split == two GEMMs), half the MLP GEMM count forward
+    and backward. Pins bit-exact forward vs the unfused layout, gradient
+    flow, and the HF state-dict round trip (fused tree -> gate/up_proj ->
+    fused tree)."""
+    cfg = _f32(tiny_model_cfg)
+    fcfg = dataclasses.replace(cfg, fused_gate_up=True)
+    p = llama.init_params(jax.random.key(0), cfg)
+    fp = llama.init_params(jax.random.key(0), fcfg)
+    fp = jax.tree.map(lambda x: x, fp)  # fresh containers
+    fp["layers"]["mlp"] = {
+        "w_gu": jnp.concatenate(
+            [p["layers"]["mlp"]["w_gate"], p["layers"]["mlp"]["w_up"]],
+            axis=-1,
+        ),
+        "w_down": p["layers"]["mlp"]["w_down"],
+    }
+    for k in set(p) - {"layers"}:
+        fp[k] = p[k]
+    for k in set(p["layers"]) - {"mlp"}:
+        fp["layers"][k] = p["layers"][k]
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    a = llama.forward(p, ids, cfg)
+    b = llama.forward(fp, ids, fcfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # Gradients flow through the fused matrix (split backward = concat).
+    g = jax.grad(lambda pp: jnp.sum(llama.forward(pp, ids, fcfg) ** 2))(fp)
+    assert float(jnp.abs(g["layers"]["mlp"]["w_gu"]).max()) > 0
+
+    # HF round trip: fused tree exports gate_proj/up_proj, re-imports fused.
+    from ditl_tpu.models.convert import (
+        params_from_state_dict, state_dict_from_params,
+    )
+
+    sd = state_dict_from_params(fp, fcfg)
+    assert any("gate_proj" in k for k in sd)
+    back = params_from_state_dict(sd, fcfg)
+    np.testing.assert_allclose(
+        np.asarray(back["layers"]["mlp"]["w_gu"]),
+        np.asarray(fp["layers"]["mlp"]["w_gu"]), rtol=1e-6,
+    )
+    c = llama.forward(back, ids, fcfg)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(a), rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_scan_unroll_preserves_forward(tiny_model_cfg):
+    """``scan_unroll`` is a fusion-boundary schedule knob, not math."""
+    cfg = _f32(tiny_model_cfg)
+    params = llama.init_params(jax.random.key(0), cfg)
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 12)),
+        jnp.int32,
+    )
+    a = llama.forward(params, ids, cfg)
+    b = llama.forward(
+        params, ids, dataclasses.replace(cfg, scan_unroll=2)
+    )
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fused_qkv_bit_exact_and_roundtrips(tiny_model_cfg):
+    """``fused_qkv=True`` stores q|k|v as one (D, (nh+2*nkv)*hd) matrix —
+    same math, one GEMM (and one backward pair) instead of three. Pins
+    bit-exact forward vs the unfused layout and the HF round trip."""
+    cfg = _f32(tiny_model_cfg)
+    fcfg = dataclasses.replace(cfg, fused_qkv=True)
+    p = llama.init_params(jax.random.key(0), cfg)
+    fp = llama.init_params(jax.random.key(0), fcfg)
+    fp["layers"]["attn"] = {
+        "w_qkv": jnp.concatenate(
+            [p["layers"]["attn"][k] for k in ("wq", "wk", "wv")], axis=-1
+        ),
+        "wo": p["layers"]["attn"]["wo"],
+    }
+    for k in set(p) - {"layers"}:
+        fp[k] = p[k]
+    for k in set(p["layers"]) - {"attn"}:
+        fp["layers"][k] = p["layers"][k]
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32,
+    )
+    a = llama.forward(p, ids, cfg)
+    b = llama.forward(fp, ids, fcfg)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    from ditl_tpu.models.convert import (
+        params_from_state_dict, state_dict_from_params,
+    )
+
+    sd = state_dict_from_params(fp, fcfg)
+    assert any("q_proj" in k for k in sd)
+    back = params_from_state_dict(sd, fcfg)
+    np.testing.assert_allclose(
+        np.asarray(back["layers"]["attn"]["w_qkv"]),
+        np.asarray(fp["layers"]["attn"]["w_qkv"]), rtol=1e-6,
+    )
+    with pytest.raises(ValueError, match="LoRA"):
+        llama.init_params(
+            jax.random.key(0),
+            dataclasses.replace(fcfg, lora_rank=4),
+        )
+
+
+def test_fused_qkv_rejects_runtime_lora_tree(tiny_model_cfg):
+    """The init-time guard has a runtime twin: a LoRA tree attached AFTER
+    init (serving adapters, loaded checkpoints) must error loudly, not
+    silently serve base-model outputs."""
+    fcfg = dataclasses.replace(_f32(tiny_model_cfg), fused_qkv=True)
+    fp = llama.init_params(jax.random.key(0), fcfg)
+    lcfg = dataclasses.replace(_f32(tiny_model_cfg), lora_rank=2)
+    lp = llama.init_params(jax.random.key(0), lcfg)
+    fp["layers"]["lora"] = lp["layers"]["lora"]
+    ids = jnp.ones((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="LoRA"):
+        llama.forward(fp, ids, fcfg)
