@@ -938,8 +938,50 @@ def _integer_regex(schema: dict) -> str:
     return _int_range_regex(lo, hi)
 
 
+def _strip_illegal_string_bytes(node):
+    """Narrow every byte class in a pattern AST to characters legal
+    UNESCAPED inside a JSON string (no quote, backslash, or controls —
+    the pattern constrains the raw value characters; escape sequences are
+    not expressible, documented in compile_json_schema). Keeps ``.`` and
+    negated classes sound instead of rejecting them."""
+    bad = _mask_of(0x22, 0x5C) | _range_mask(0x00, 0x1F)
+    if isinstance(node, ByteSet):
+        return ByteSet(node.mask & ~bad)
+    if isinstance(node, Seq):
+        return Seq(tuple(_strip_illegal_string_bytes(p) for p in node.parts))
+    if isinstance(node, Alt):
+        return Alt(tuple(_strip_illegal_string_bytes(o) for o in node.options))
+    if isinstance(node, Repeat):
+        return Repeat(_strip_illegal_string_bytes(node.node), node.min, node.max)
+    return node  # AnyMultibyte (>= 0x80: always legal)
+
+
+def _pattern_string_ast(schema: dict):
+    """``{"type": "string", "pattern": ...}`` → AST for the quoted value.
+
+    JSON-Schema ``pattern`` is a SEARCH per spec; a leading ``^`` /
+    trailing ``$`` anchor that side (the OpenAI strict-mode idiom is
+    ``^...$``), otherwise the side is padded with ``.*`` over legal
+    string characters."""
+    _reject_unsupported(schema, "string", ("format",))
+    for k in ("minLength", "maxLength"):
+        if k in schema:
+            raise ValueError(
+                "pattern cannot be combined with minLength/maxLength "
+                "(regex intersection is not supported; fold the length "
+                "bound into the pattern itself)"
+            )
+    core, pre, post = schema["pattern"], ".*", ".*"
+    if core.startswith("^"):
+        core, pre = core[1:], ""
+    if core.endswith("$") and not core.endswith(r"\$"):
+        core, post = core[:-1], ""
+    node = _strip_illegal_string_bytes(_ast(pre + "(" + core + ")" + post))
+    return Seq((_ast('"'), node, _ast('"')))
+
+
 def _string_regex(schema: dict) -> str:
-    _reject_unsupported(schema, "string", ("pattern", "format"))
+    _reject_unsupported(schema, "string", ("format",))
     mn = schema.get("minLength")
     mx = schema.get("maxLength")
     if mn is None and mx is None:
@@ -956,8 +998,6 @@ def _string_regex(schema: dict) -> str:
     return '"' + char + ("{%d,%d}" % (mn, mx)) + '"'
 
 
-# Order-free objects are a union over property permutations; the DFA size
-# is factorial in the property count, so the door is deliberately small.
 # Order-free compiles as a seen-bitmask NFA (see OrderFree), so the bound
 # is no longer factorial — but the determinized DFA is still inherently
 # ~n·2^(n-1)·|pair| states (order-freedom itself costs that), so very wide
@@ -1065,6 +1105,8 @@ def _schema_ast(schema: dict):
     if isinstance(t, list):
         return Alt(tuple(_schema_ast({**schema, "type": x}) for x in t))
     if t == "string":
+        if schema.get("pattern") is not None:
+            return _pattern_string_ast(schema)
         return _ast(_string_regex(schema))
     if t == "integer":
         return _ast(_integer_regex(schema))
@@ -1433,7 +1475,11 @@ def compile_json_schema(
     is not regular), objects with ``properties``/``required``, arrays with
     ``items`` + ``minItems``/``maxItems``, integers with
     ``minimum``+``maximum`` (both sides — a one-sided bound is rejected),
-    strings with ``minLength``/``maxLength``.
+    strings with ``minLength``/``maxLength`` OR ``pattern`` (search
+    semantics per spec; ``^``/``$`` anchor their side; byte classes are
+    narrowed to characters legal UNESCAPED in a JSON string, so a
+    pattern cannot demand a quote/backslash/control character — escape
+    sequences are not expressible through patterns).
 
     Object semantics: properties are OPTIONAL unless listed in
     ``required`` (standard JSON-Schema; note OpenAI strict mode requires

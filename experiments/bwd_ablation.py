@@ -113,6 +113,10 @@ def main():
     platform = jax.devices()[0].platform
     print(f"devices: {jax.devices()} platform={platform}", file=sys.stderr)
 
+    # NOTE: bench._model_cfg carries the ADOPTED pinned config — after r5
+    # that includes fused_gate_up + remat="dots_inputs", so re-running this
+    # script measures the remaining headroom under the shipped schedule
+    # (the sg_mlp path filter matches both w_gate/w_up/w_down and w_gu).
     cfg, batch, seq, optimizer = bench._model_cfg("1b3", platform)
     tcfg = TrainConfig(total_steps=1000, warmup_steps=10, optimizer=optimizer)
     mesh = build_mesh(MeshConfig())

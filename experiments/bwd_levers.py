@@ -113,13 +113,8 @@ def iso_wgrad_rates():
         k1, k2 = 6, 30
         t1, t2 = run_k(k1), run_k(k2)
         per = (t2 - t1) / (k2 - k1)
-        flops = 2 * sa[0] * sa[1] * (sb[1] if len(sb) > 1 else 1)
-        # einsum contracting over t: FLOPs = 2*T*D*F style — compute from
-        # output: 2 * T * (rows of out) * (cols of out)
-        if "wgrad" in name:
-            flops = 2 * sa[0] * sa[1] * sb[1]
-        else:
-            flops = 2 * sa[0] * sa[1] * sb[1]
+        # 2 * contraction * rows * cols for every shape here.
+        flops = 2 * sa[0] * sa[1] * sb[1]
         tf = flops / per / 1e12
         print(f"ISO {name}: {per * 1e3:.2f} ms  {tf:.0f} TF/s "
               f"({tf / 197 * 100:.0f}% of peak)", flush=True)
@@ -166,36 +161,8 @@ def main():
     ]
     results = {}
     for name, leg_cfg in legs:
-        if name == "gu_di_inner":
-            # Probe: ALSO save inner (w_down's wgrad operand) — patch the
-            # policy for this leg only.
-            from ditl_tpu.models import llama as _llama
-
-            orig = _llama._apply_remat
-
-            def patched(layer_fn, c):
-                import jax as _jax
-
-                return _jax.checkpoint(
-                    layer_fn,
-                    policy=_jax.checkpoint_policies.save_from_both_policies(
-                        _jax.checkpoint_policies
-                        .checkpoint_dots_with_no_batch_dims,
-                        _jax.checkpoint_policies.save_only_these_names(
-                            "attn_in", "mlp_in", "mlp_inner"
-                        ),
-                    ),
-                )
-
-            _llama._apply_remat = patched
-            try:
-                ms = time_step_leg(name, leg_cfg, mesh, tcfg, window,
-                                   example, chunk, n_windows)
-            finally:
-                _llama._apply_remat = orig
-        else:
-            ms = time_step_leg(name, leg_cfg, mesh, tcfg, window, example,
-                               chunk, n_windows)
+        ms = time_step_leg(name, leg_cfg, mesh, tcfg, window, example,
+                           chunk, n_windows)
         if ms is not None:
             results[name] = ms
     if "base" in results:

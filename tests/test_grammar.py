@@ -391,9 +391,11 @@ def test_schema_exclusive_bounds_and_anyof_siblings():
     with pytest.raises(ValueError, match="unsupported number"):
         G.compile_json_schema(
             {"type": "number", "minimum": 0, "maximum": 1}, tok)
+    # ``pattern`` is SUPPORTED as of r5 (test_schema_string_pattern);
+    # ``format`` remains an honest rejection.
     with pytest.raises(ValueError, match="unsupported string"):
         G.compile_json_schema(
-            {"type": "string", "pattern": "[a-z]+"}, tok)
+            {"type": "string", "format": "date-time"}, tok)
     # sibling constraint keywords next to anyOf would be silently dropped
     # (JSON Schema conjunction is unsupported) — reject loudly instead
     with pytest.raises(ValueError, match="sibling"):
@@ -844,3 +846,45 @@ def test_schema_negative_min_items_clamped():
     assert g.matches(b"[]")
     assert g.matches(b"[true]")
     assert not g.matches(b"[true, true]")
+
+
+def test_schema_string_pattern():
+    """``pattern`` (r5): search semantics per spec, ^/$ anchor their side,
+    byte classes narrowed to JSON-legal unescaped characters."""
+    tok = ByteTokenizer()
+    g = G.compile_json_schema(
+        {"type": "string", "pattern": "^[a-z]{2,4}-[0-9]+$"}, tok
+    )
+    assert g.matches(b'"ab-12"')
+    assert g.matches(b'"wxyz-0"')
+    assert not g.matches(b'"AB-12"')
+    assert not g.matches(b'"ab-12x"')  # $ anchors the end
+    assert not g.matches(b'ab-12')  # quotes required
+
+    # Unanchored = substring search (the JSON-Schema default).
+    s = G.compile_json_schema({"type": "string", "pattern": "cat"}, tok)
+    assert s.matches(b'"cat"') and s.matches(b'"a cat sat"')
+    assert not s.matches(b'"dog"')
+
+    # '.' narrows to legal unescaped chars: a quote can never satisfy it
+    # (which would otherwise break JSON framing).
+    d = G.compile_json_schema({"type": "string", "pattern": "^a.c$"}, tok)
+    assert d.matches(b'"abc"') and d.matches('"aéc"'.encode())
+    assert not d.matches(b'"a"c"')
+
+    # In an object property, alongside other constraints.
+    o = G.compile_json_schema({
+        "type": "object",
+        "properties": {"id": {"type": "string",
+                              "pattern": "^[A-F0-9]{4}$"}},
+        "required": ["id"],
+    }, tok)
+    assert o.matches(b'{"id": "BEEF"}')
+    assert not o.matches(b'{"id": "beef"}')
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="minLength"):
+        G.compile_json_schema(
+            {"type": "string", "pattern": "^a+$", "minLength": 2}, tok
+        )
