@@ -193,6 +193,13 @@ class ModelConfig:
     # prefer the unfused layout for TP serving; the fusion targets
     # single-chip / data-parallel training.
     fused_qkv: bool = False
+    # Hand-written VJP for the fused-gate|up MLP block (requires
+    # fused_gate_up): the whole block's backward — activation grads and
+    # BOTH weight grads — is emitted as one function with explicit
+    # einsum contractions instead of autodiff transposes. An instrument
+    # against the backward-scheduling residual (BASELINE.md r5);
+    # measured-neutral configs should leave it off.
+    mlp_custom_vjp: bool = False
     # Loss head: "naive" materializes (B, S, V) f32 logits; "fused" computes
     # the lm-head matmul + cross-entropy blockwise (ops/fused_ce.py) so peak
     # logits memory is loss_block_tokens x V instead of B*S*V.

@@ -478,20 +478,53 @@ def _decoder_layer(
         mlp_out, aux = moe_block(layer_params["moe"], h, cfg, mesh=mesh, rules=rules)
     else:
         mlp = layer_params["mlp"]
-        if "w_gu" in mlp:
-            # fused_gate_up: one (D, 2F) GEMM replaces the gate/up pair —
-            # and one dgrad/wgrad pair replaces two in the backward.
-            gu = weight_einsum("bsd,df->bsf", h, mlp["w_gu"], compute_dtype=cd)
-            gate, up = jnp.split(gu, 2, axis=-1)
+        if cfg.mlp_custom_vjp and "w_gu" not in mlp:
+            # Reject-don't-drop: silently falling back to autodiff would
+            # make an A/B of the flag measure byte-identical programs.
+            raise ValueError(
+                "mlp_custom_vjp requires fused_gate_up=True (the "
+                "hand-written backward targets the fused w_gu layout)"
+            )
+        if "w_gu" in mlp and cfg.mlp_custom_vjp:
+            from ditl_tpu.ops.quant import is_quantized_leaf
+
+            if is_quantized_leaf(mlp["w_gu"]) or is_quantized_leaf(mlp["w_down"]):
+                raise ValueError(
+                    "mlp_custom_vjp needs plain float weights (quantized "
+                    "serving never differentiates — leave it off)"
+                )
+            from ditl_tpu.ops.mlp import mlp_gu
+
+            mlp_out = mlp_gu(
+                lambda t: _constrain(t, ("batch", "seq", "act_mlp"),
+                                     mesh, rules),
+                h, mlp["w_gu"].astype(cd), mlp["w_down"].astype(cd),
+            )
         else:
-            gate = weight_einsum("bsd,df->bsf", h, mlp["w_gate"], compute_dtype=cd)
-            up = weight_einsum("bsd,df->bsf", h, mlp["w_up"], compute_dtype=cd)
-        inner = jax.nn.silu(gate) * up
-        inner = _constrain(inner, ("batch", "seq", "act_mlp"), mesh, rules)
-        # Named so remat policies CAN save it (w_down's wgrad operand);
-        # no shipped policy does — measured neutral-to-negative on v5e.
-        inner = checkpoint_name(inner, "mlp_inner")
-        mlp_out = weight_einsum("bsf,fd->bsd", inner, mlp["w_down"], compute_dtype=cd)
+            if "w_gu" in mlp:
+                # fused_gate_up: one (D, 2F) GEMM replaces the gate/up
+                # pair — and one dgrad/wgrad pair replaces two in the
+                # backward.
+                gu = weight_einsum(
+                    "bsd,df->bsf", h, mlp["w_gu"], compute_dtype=cd
+                )
+                gate, up = jnp.split(gu, 2, axis=-1)
+            else:
+                gate = weight_einsum(
+                    "bsd,df->bsf", h, mlp["w_gate"], compute_dtype=cd
+                )
+                up = weight_einsum(
+                    "bsd,df->bsf", h, mlp["w_up"], compute_dtype=cd
+                )
+            inner = jax.nn.silu(gate) * up
+            inner = _constrain(inner, ("batch", "seq", "act_mlp"), mesh, rules)
+            # Named so remat policies CAN save it (w_down's wgrad
+            # operand); no shipped policy does — measured
+            # neutral-to-negative on v5e.
+            inner = checkpoint_name(inner, "mlp_inner")
+            mlp_out = weight_einsum(
+                "bsf,fd->bsd", inner, mlp["w_down"], compute_dtype=cd
+            )
     x = x + mlp_out
     x = _constrain(x, ("batch", "seq", "act_embed"), mesh, rules)
     if new_kv is not None:

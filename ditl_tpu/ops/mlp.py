@@ -1,0 +1,70 @@
+"""Fused-gate|up MLP block with a hand-written VJP (r5 experiment).
+
+The r5 stop-gradient ablation (BASELINE.md, experiments/bwd_ablation.py)
+showed the MLP family's in-step weight-gradient GEMMs running at ~2x
+their isolated-peak rates — a property of XLA's backward SCHEDULE, not of
+the GEMM shapes. This module is the instrument against that: the whole
+block's backward (activation grads and BOTH weight grads) is emitted as
+ONE function with explicit einsum contractions — no autodiff-generated
+transposes, residuals chosen by hand (h, gate, up; ``inner`` recomputed
+elementwise like the "dots" remat policy would) — so XLA schedules the
+backward exactly as written.
+
+Exactness: forward is bit-identical to the inline path (same ops); the
+backward matches autodiff to f32 test tolerance
+(tests/test_model.py::test_mlp_custom_vjp_matches_autodiff). Enabled per
+config via ``ModelConfig.mlp_custom_vjp`` (requires ``fused_gate_up``;
+plain float weights only — quantized serving never differentiates).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["mlp_gu"]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0,))
+def mlp_gu(constrain, h: jax.Array, w_gu: jax.Array,
+           w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP over the fused gate|up layout: ``h @ w_gu`` → split →
+    ``silu(gate)*up @ w_down``. Shapes: h (B,S,D), w_gu (D,2F),
+    w_down (F,D). ``constrain`` (static): sharding-hint callback applied
+    to the inner activation — mirrors the inline path's
+    ``_constrain(inner, act_mlp)`` so a mesh A/B isolates the backward
+    SPELLING, not sharding-propagation differences. Pass identity for
+    single-chip."""
+    out, _ = _fwd(constrain, h, w_gu, w_down)
+    return out
+
+
+def _fwd(constrain, h, w_gu, w_down):
+    gu = jnp.einsum("bsd,df->bsf", h, w_gu)
+    gate, up = jnp.split(gu, 2, axis=-1)
+    inner = constrain(jax.nn.silu(gate) * up)
+    out = jnp.einsum("bsf,fd->bsd", inner, w_down)
+    return out, (h, w_gu, w_down, gate, up)
+
+
+def _bwd(constrain, res, g):
+    h, w_gu, w_down, gate, up = res
+    # Recompute the cheap elementwise pieces (the "dots"-policy choice).
+    sg = jax.nn.sigmoid(gate)
+    silu_gate = gate * sg
+    inner = constrain(silu_gate * up)
+    # One explicit contraction per gradient; all four GEMMs share the g /
+    # dgu operands, written so XLA sees the reuse directly.
+    d_w_down = jnp.einsum("bsf,bsd->fd", inner, g).astype(w_down.dtype)
+    dinner = jnp.einsum("bsd,fd->bsf", g, w_down)
+    dgate = dinner * up * (sg * (1.0 + gate * (1.0 - sg)))
+    dup = dinner * silu_gate
+    dgu = jnp.concatenate([dgate, dup], axis=-1)
+    d_w_gu = jnp.einsum("bsd,bsf->df", h, dgu).astype(w_gu.dtype)
+    dh = jnp.einsum("bsf,df->bsd", dgu, w_gu).astype(h.dtype)
+    return dh, d_w_gu, d_w_down
+
+
+mlp_gu.defvjp(_fwd, _bwd)

@@ -1,17 +1,18 @@
 """Round-5 backward levers A/B on the pinned 1b3 config (follow-up to
-bwd_ablation.py, which measured in-step wgrads at ~2.1-2.3x their
-isolated-rate ideal: MLP wgrads 94.5 ms vs ~44, attn-proj wgrads 39.5 ms
-vs ~17 — ~73 ms of headroom in a 580.9 ms step).
+bwd_ablation.py). This script's leg list evolved with the round — the
+results of every configuration it ran are recorded in BASELINE.md's r5
+section (gu/di lever sweep, attn_out/inner saves, flash-tile and CE-block
+re-sweeps, the custom-VJP null). CURRENT legs (adjacent, one session):
 
-Legs (adjacent, one session):
-  base          pinned config re-anchor
-  gu            fused_gate_up=True (half the MLP GEMM count fwd+bwd)
-  di            remat="dots_inputs" (save the norm outputs: wgrad operands
-                come from stored buffers, not a recompute chain)
-  gu_di         both
-  iso           k-differenced ISOLATED rates of the exact wgrad GEMM
-                shapes (einsum 'bsd,bsf->df' over 8192 tokens, bf16) — is
-                the GEMM itself slow, or only its in-step schedule?
+  base         the ADOPTED pinned config (post-r5: fused_gate_up +
+               remat="dots_inputs") — fresh anchor
+  custom_vjp   ModelConfig.mlp_custom_vjp=True: the hand-written
+               whole-block MLP backward (ops/mlp.py) instead of autodiff
+  base_again   anchor repeat (brackets the A/B against drift)
+
+plus `iso`: k-differenced ISOLATED rates of the exact backward GEMM
+shapes (einsum over 8192 tokens, bf16) — only trustworthy on a quiet
+host (concurrent load corrupts the k-difference).
 
 Usage: python experiments/bwd_levers.py [chunk windows]
 """
@@ -148,16 +149,12 @@ def main():
 
     example = {k: v[0] for k, v in window(0).items()}
 
-    gu_di = dataclasses.replace(cfg, fused_gate_up=True,
-                                remat="dots_inputs")
+    # cfg IS the adopted gu_di config post-r5-adoption; the custom-vjp leg
+    # swaps the MLP block's autodiff backward for the hand-written one.
     legs = [
         ("base", cfg),
-        ("gu_di", gu_di),
-        ("gu_di_bt512", dataclasses.replace(
-            gu_di, flash_block_q_bwd=512, flash_block_kv_bwd=1024)),
-        ("gu_di_bt512b", dataclasses.replace(
-            gu_di, flash_block_q_bwd=1024, flash_block_kv_bwd=512)),
-        ("gu_di_ce8k", dataclasses.replace(gu_di, loss_block_tokens=8192)),
+        ("custom_vjp", dataclasses.replace(cfg, mlp_custom_vjp=True)),
+        ("base_again", cfg),
     ]
     results = {}
     for name, leg_cfg in legs:
