@@ -209,6 +209,18 @@ class ModelConfig:
     # microbatches per pipeline flush; 0 => one per stage.
     pipeline_microbatches: int = 0
 
+    def __post_init__(self):
+        # Reject-don't-drop: the MoE block has no fused gate|up layout, so
+        # these flags would be silently ignored (an A/B would measure
+        # byte-identical programs) — the same failure mode the dense-path
+        # guard in models/llama.py exists to prevent.
+        if self.num_experts > 0 and (self.fused_gate_up or self.mlp_custom_vjp):
+            raise ValueError(
+                "fused_gate_up/mlp_custom_vjp target the dense MLP path and "
+                f"do not apply to MoE models (num_experts={self.num_experts}); "
+                "unset them rather than measuring a silently unfused program"
+            )
+
 
 @dataclass(frozen=True)
 class DataConfig:
@@ -283,6 +295,34 @@ class TrainConfig:
     # OUT-OF-PROCESS supervisor (launch --supervise, or k8s restartPolicy)
     # can recover from it. 0 => off.
     fault_kill_step: int = 0
+    # Which process index fault_kill_step applies to: -1 => every process
+    # (the single-host drill), >= 0 => only that worker dies — the pod-level
+    # drill (runtime/elastic.py), where the SURVIVORS are left wedged in a
+    # collective and the pod controller must tear them down and relaunch.
+    fault_kill_process: int = -1
+    # Elastic pod liveness (launch --supervise [--pod N], runtime/elastic.py):
+    # each process touches {heartbeat_dir}/worker-{process_index}.heartbeat
+    # every step window (path derived from the process index so the config
+    # stays identical pod-wide for the consistency check). "" => no
+    # heartbeats. The controller treats a heartbeat older than
+    # heartbeat_timeout_s as a dead worker (0 => exit-code liveness only).
+    # Heartbeats are emitted at HOST boundaries — once per steps_per_call
+    # window (a >1 window runs entirely on-device; nothing can emit
+    # mid-program) and again after a validation / API-eval pass — so size
+    # the timeout above worst-case first-step compile, one full window's
+    # wall time, AND one validation or eval pass, or a healthy slow
+    # boundary reads as a stall.
+    heartbeat_dir: str = ""
+    heartbeat_timeout_s: float = 0.0
+
+    def __post_init__(self):
+        if self.heartbeat_timeout_s > 0 and not self.heartbeat_dir:
+            # Reject-don't-drop: a timeout without a heartbeat dir would
+            # silently disarm the stall watchdog the operator asked for.
+            raise ValueError(
+                "heartbeat_timeout_s requires heartbeat_dir (without it no "
+                "heartbeats are emitted and stall detection is silently off)"
+            )
     # Path to a local HF checkpoint directory (transformers format) to
     # initialize parameters from instead of random init (models/convert.py).
     init_from_hf: str = ""
@@ -363,7 +403,13 @@ def _coerce(value: str, target_type: Any) -> Any:
 
 
 def parse_overrides(config: Config, overrides: Sequence[str]) -> Config:
-    """Apply ``section.key=value`` overrides, e.g. ``mesh.fsdp=8``."""
+    """Apply ``section.key=value`` overrides, e.g. ``mesh.fsdp=8``.
+
+    Overrides are staged and applied ONCE per section, so ``__post_init__``
+    validation sees only the final combination — `model.fused_gate_up=true
+    model.num_experts=0` is legal regardless of CLI order, while a finally
+    invalid combination still fails."""
+    staged: dict[str, dict[str, Any]] = {}
     for item in overrides:
         if "=" not in item:
             raise ValueError(f"override must be section.key=value, got {item!r}")
@@ -378,8 +424,11 @@ def parse_overrides(config: Config, overrides: Sequence[str]) -> Config:
         matching = [f for f in fields(section) if f.name == key]
         if not matching:
             raise ValueError(f"unknown key {key!r} in section {section_name!r}")
-        coerced = _coerce(value, matching[0].type)
-        config = replace(config, **{section_name: replace(section, **{key: coerced})})
+        staged.setdefault(section_name, {})[key] = _coerce(value, matching[0].type)
+    for section_name, kv in staged.items():
+        config = replace(
+            config, **{section_name: replace(getattr(config, section_name), **kv)}
+        )
     return config
 
 

@@ -974,8 +974,15 @@ def _pattern_string_ast(schema: dict):
     core, pre, post = schema["pattern"], ".*", ".*"
     if core.startswith("^"):
         core, pre = core[1:], ""
-    if core.endswith("$") and not core.endswith(r"\$"):
-        core, post = core[:-1], ""
+    if core.endswith("$"):
+        # The $ is a real anchor iff it is NOT escaped: an even run of
+        # backslashes before it is pairs of escaped backslashes (r"\\$" ends
+        # with a literal backslash then a true anchor), an odd run escapes
+        # the $ itself (r"\$" is a literal dollar). A single endswith(r"\$")
+        # check misreads the even case and feeds _Parser a bare "$".
+        stem = core[:-1]
+        if (len(stem) - len(stem.rstrip("\\"))) % 2 == 0:
+            core, post = stem, ""
     node = _strip_illegal_string_bytes(_ast(pre + "(" + core + ")" + post))
     return Seq((_ast('"'), node, _ast('"')))
 

@@ -29,6 +29,10 @@ def build_config(argv: list[str] | None = None) -> Config:
     parser = argparse.ArgumentParser(
         prog="ditl_tpu.launch",
         description="TPU-native distributed fine-tuning launcher (one command, every host)",
+        # No prefix abbreviation: every host (and the pod controller's
+        # rendezvous-clash guard) must see the same literal flag tokens —
+        # an abbreviated --coord would bypass the --pod ownership check.
+        allow_abbrev=False,
     )
     parser.add_argument("--preset", default=None, help="model preset name")
     parser.add_argument(
@@ -49,9 +53,18 @@ def build_config(argv: list[str] | None = None) -> Config:
         "restart resumes from the latest Orbax checkpoint",
     )
     parser.add_argument(
+        "--pod", type=int, default=0,
+        help="with --supervise: run an elastic POD of N distributed worker "
+        "processes on this host (runtime/elastic.py) — any worker death "
+        "tears down the survivors and relaunches the whole pod on a fresh "
+        "coordinator port, resuming from the multi-host Orbax checkpoint",
+    )
+    parser.add_argument(
         "overrides", nargs="*", help="config overrides like train.total_steps=50"
     )
     args = parser.parse_args(argv)
+    if args.pod and not args.supervise:
+        parser.error("--pod requires --supervise (the elastic pod controller)")
 
     config = Config()
     if args.preset:
@@ -105,10 +118,16 @@ def run_supervised(config: Config) -> dict:
             return summary
         except Exception:
             if (
-                restarts >= config.train.max_restarts
+                config.runtime.distributed
+                or restarts >= config.train.max_restarts
                 or not config.train.checkpoint_dir
                 or not config.train.resume
             ):
+                # Distributed: NEVER retry solo — re-entering train() while
+                # the peers sit mid-collective at a later step desyncs the
+                # pod into a permanent wedge. Die loudly instead; pod-level
+                # recovery (the controller relaunching ALL workers,
+                # runtime/elastic.py) is the only sound restart.
                 raise
             restarts += 1
             logging.getLogger(__name__).exception(
@@ -118,45 +137,154 @@ def run_supervised(config: Config) -> dict:
             )
 
 
-def run_process_supervised(argv: list[str]) -> int:
-    """Process-level restart supervisor: spawn the launcher as a child
-    process and restart it when it dies abnormally — the recovery story for
-    SIGKILL/OOM/host-crash failures that never reach a Python except block
-    (``run_supervised`` handles only in-process exceptions). Resumption
-    correctness comes from the same Orbax checkpoint + data-iterator
-    position the in-process path uses."""
+def _strip_supervisor_args(argv: list[str]) -> list[str]:
+    """Remove --supervise and --pod N/--pod=N from an argv: workers must not
+    recursively supervise."""
+    out, skip = [], False
+    for a in argv:
+        if skip:
+            skip = False
+            continue
+        if a == "--supervise" or a.startswith("--pod="):
+            continue
+        if a == "--pod":
+            skip = True
+            continue
+        out.append(a)
+    return out
+
+
+def run_process_supervised(argv: list[str], num_workers: int = 1) -> int:
+    """Process-level restart supervisor over the elastic pod controller
+    (runtime/elastic.py) — the recovery story for SIGKILL/OOM/host-crash
+    failures that never reach a Python except block (``run_supervised``
+    handles only in-process exceptions).
+
+    ``num_workers == 1`` (plain ``--supervise``) runs one non-distributed
+    child and restarts it on abnormal death. ``num_workers > 1``
+    (``--supervise --pod N``) runs N distributed workers rendezvousing on a
+    controller-owned coordinator port; ANY worker death tears down the
+    survivors (wedged in collectives with a dead peer) and relaunches the
+    whole pod on a fresh port. Resumption correctness comes from the same
+    multi-host Orbax checkpoint + data-iterator position in both modes."""
     import logging
-    import subprocess
+
+    from ditl_tpu.runtime.elastic import PodController
 
     logger = logging.getLogger(__name__)
-    child_argv = [a for a in argv if a != "--supervise"]
+    child_argv = _strip_supervisor_args(argv)
+    if num_workers > 1:
+        # Reject-don't-drop: the controller OWNS rendezvous in pod mode — it
+        # assigns a fresh coordinator port per generation and a distinct
+        # process id per worker. User-supplied rendezvous flags would
+        # argparse-last-win over the controller's (duplicate process ids,
+        # fixed ports across relaunches), so refuse them loudly.
+        owned = ("--distributed", "--coordinator", "--num-processes",
+                 "--process-id",
+                 # ...and the override spellings of the same fields, which
+                 # parse_overrides applies AFTER the flag-derived config.
+                 "runtime.distributed", "runtime.coordinator_address",
+                 "runtime.num_processes", "runtime.process_id")
+        clashes = [
+            a for a in child_argv
+            if a in owned or any(a.startswith(f"{o}=") for o in owned)
+        ]
+        if clashes:
+            raise SystemExit(
+                "ditl_tpu.launch: error: --pod manages rendezvous itself; "
+                f"remove {' '.join(sorted(set(clashes)))}"
+            )
     config = build_config(child_argv)
     can_resume = bool(config.train.checkpoint_dir and config.train.resume)
-    restarts = 0
-    while True:
-        rc = subprocess.call(
-            [sys.executable, "-m", "ditl_tpu.launch", *child_argv]
-        )
-        if rc == 0:
-            return 0
-        if restarts >= config.train.max_restarts or not can_resume:
-            logger.error(
-                "training process exited rc=%d; giving up (%d restarts used, "
-                "resume %s)", rc, restarts, "on" if can_resume else "off",
-            )
-            return rc
-        restarts += 1
+    if num_workers == 1 and config.runtime.distributed:
+        # A single supervised child that is one member of a LARGER pod must
+        # never be solo-restarted: relaunching it against peers sitting
+        # mid-collective at a later step wedges the whole pod (the same
+        # desync run_supervised's in-process guard forbids). Let the failure
+        # propagate; pod-level recovery (--pod on one host, or an external
+        # controller restarting EVERY host) is the only sound restart.
+        can_resume = False
+
+    def build_argv(proc_id: int, nproc: int, port: int, attempt: int):
+        worker = [sys.executable, "-m", "ditl_tpu.launch"]
+        if nproc > 1:
+            worker += [
+                "--distributed", "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", str(nproc), "--process-id", str(proc_id),
+            ]
+        return worker + child_argv
+
+    def on_restart(failure_rc, restarts, max_restarts):
         logger.error(
             "training process exited rc=%d; restart %d/%d from latest "
-            "checkpoint", rc, restarts, config.train.max_restarts,
+            "checkpoint", failure_rc, restarts, max_restarts,
         )
+
+    controller = PodController(
+        num_workers,
+        build_argv,
+        max_pod_restarts=config.train.max_restarts if can_resume else 0,
+        heartbeat_dir=config.train.heartbeat_dir,
+        heartbeat_timeout_s=config.train.heartbeat_timeout_s,
+        # The trainer emits heartbeats under its jax.process_index(): the
+        # worker slot for a controller-owned pod, but the configured (or,
+        # when rank is autodetected, unknowable — None = wildcard) process
+        # id for a single supervised member of a larger pod.
+        heartbeat_ids=(
+            None if num_workers > 1
+            else [config.runtime.process_id if config.runtime.distributed else 0]
+        ),
+        # State transitions on stderr for debuggability (the child's summary
+        # JSON owns stdout).
+        log=lambda msg: print(msg, file=sys.stderr, flush=True),
+        on_restart=on_restart,
+    )
+    result = controller.run()
+    if not result.ok:
+        rc = result.returncode
+        logger.error(
+            "training process exited rc=%d; giving up (%d restarts used, "
+            "resume %s)", rc, result.restarts, "on" if can_resume else "off",
+        )
+        return rc
+    return 0
+
+
+def _pod_size(argv: list[str]) -> int:
+    """Parse --pod N / --pod=N without argparse (main must decide the
+    supervisor mode before any config parsing)."""
+    for i, a in enumerate(argv):
+        value = None
+        if a == "--pod":
+            if i + 1 >= len(argv):
+                raise SystemExit(
+                    "ditl_tpu.launch: error: --pod expects a worker count"
+                )
+            value = argv[i + 1]
+        elif a.startswith("--pod="):
+            value = a.split("=", 1)[1]
+        if value is not None:
+            try:
+                n = int(value)
+            except ValueError:
+                n = -1
+            if n < 0:
+                raise SystemExit(
+                    f"ditl_tpu.launch: error: --pod expects a worker count "
+                    f">= 0, got {value!r}"
+                )
+            # 0 is the documented default: "no pod" — plain single-child
+            # supervision, so templated `--pod $N` invocations degrade
+            # gracefully.
+            return n
+    return 0
 
 
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
     if "--supervise" in argv:
-        return run_process_supervised(argv)
+        return run_process_supervised(argv, max(1, _pod_size(argv)))
     config = build_config(argv)
     try:
         summary = run_supervised(config)
@@ -165,7 +293,12 @@ def main(argv: list[str] | None = None) -> int:
 
         logging.getLogger(__name__).exception("training failed")
         return 1
-    print(json.dumps(summary, sort_keys=True))
+    # Only the coordinator answers on stdout — in a pod every worker runs
+    # this identical program and N copies of the summary would interleave.
+    from ditl_tpu.runtime.distributed import is_coordinator
+
+    if is_coordinator():
+        print(json.dumps(summary, sort_keys=True))
     return 0
 
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from ditl_tpu.utils.compat import shard_map
 
 __all__ = ["dot_product_attention"]
 
@@ -155,12 +156,12 @@ def _seq_sharded_decode(
         def local4(q_, k_, v_, mask_):
             return local(q_, k_, v_, mask_, None, None)
 
-        return jax.shard_map(
+        return shard_map(
             local4, mesh=mesh,
             in_specs=(q_spec, kv_spec, kv_spec, mask_spec),
             out_specs=q_spec, check_vma=False,
         )(q, k, v, mask)
-    return jax.shard_map(
+    return shard_map(
         local, mesh=mesh,
         in_specs=(q_spec, kv_spec, kv_spec, mask_spec, scale_spec, scale_spec),
         out_specs=q_spec, check_vma=False,
@@ -289,7 +290,7 @@ def dot_product_attention(
                 block_q=bq, block_kv=bkv, block_q_bwd=bqb, block_kv_bwd=bkvb,
             )
 
-        return jax.shard_map(
+        return shard_map(
             local,
             mesh=mesh,
             in_specs=tuple(in_specs),

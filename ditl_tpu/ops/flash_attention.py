@@ -43,6 +43,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["flash_attention"]
 
 from ditl_tpu.ops.attention import NEG_INF  # single source of the mask value
+from ditl_tpu.utils.compat import tpu_compiler_params
 
 NUM_LANES = 128
 NUM_SUBLANES = 8
@@ -283,7 +284,7 @@ def _fwd(
             pltpu.VMEM((bq, NUM_LANES), jnp.float32),  # l
             pltpu.VMEM((bq, d), jnp.float32),  # acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,
@@ -514,7 +515,7 @@ def _bwd_impl(
         out_specs=pl.BlockSpec((1, 1, bq, d), q_map),
         out_shape=jax.ShapeDtypeStruct((b, h, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary")
         ),
         interpret=interpret,

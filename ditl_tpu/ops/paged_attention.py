@@ -41,6 +41,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ditl_tpu.ops.attention import NEG_INF
 from ditl_tpu.ops.flash_attention import NUM_LANES, _lane_tile
+from ditl_tpu.utils.compat import shard_map, tpu_compiler_params
 
 __all__ = ["paged_attention", "paged_attention_xla"]
 
@@ -391,7 +392,7 @@ def paged_attention(
                     k_scale=ks_, v_scale=vs_, interpret=interpret,
                 )
 
-            return jax.shard_map(
+            return shard_map(
                 local,
                 mesh=mesh,
                 in_specs=tuple(in_specs),
@@ -447,7 +448,7 @@ def paged_attention(
         pltpu.VMEM((g_rows, d), jnp.float32),  # acc
     ]
     out_shape = jax.ShapeDtypeStruct((b, kv_heads, qg_rows, d), q.dtype)
-    compiler_params = pltpu.CompilerParams(
+    compiler_params = tpu_compiler_params(
         dimension_semantics=("parallel", "arbitrary")
     )
 

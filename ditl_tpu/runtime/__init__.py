@@ -6,3 +6,7 @@ from ditl_tpu.runtime.distributed import (  # noqa: F401
 )
 from ditl_tpu.runtime.mesh import build_mesh  # noqa: F401
 from ditl_tpu.runtime.consistency import check_cross_host_consistency  # noqa: F401
+
+# NOTE: runtime.elastic (PodController) is intentionally NOT imported here —
+# it is jax-free by design and used by the launcher before any backend
+# configuration; import it explicitly as `from ditl_tpu.runtime import elastic`.

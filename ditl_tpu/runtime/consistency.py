@@ -37,7 +37,15 @@ def host_payload(config=None, extra: Mapping[str, Any] | None = None) -> dict[st
         "global_device_count": jax.device_count(),
     }
     if config is not None:
-        payload["config"] = config.to_dict()
+        cfg = config.to_dict()
+        # process_id is per-process BY CONSTRUCTION (the launcher assigns a
+        # distinct one to every worker), so it must not poison the pod-wide
+        # fingerprint — without this, the first real multi-process training
+        # run would fail its own startup check. Everything else in the
+        # config (including coordinator_address) must genuinely agree.
+        if isinstance(cfg.get("runtime"), dict):
+            cfg["runtime"].pop("process_id", None)
+        payload["config"] = cfg
     if extra:
         payload.update(extra)
     return payload

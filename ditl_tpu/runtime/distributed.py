@@ -31,6 +31,7 @@ from ditl_tpu.utils.logging import get_logger, setup_logging
 logger = get_logger(__name__)
 
 _initialized = False
+_active_coordinator: str | None = None
 
 
 def simulate_devices(n: int) -> None:
@@ -38,16 +39,27 @@ def simulate_devices(n: int) -> None:
     *backend* touch (first ``jax.devices()``/array op). Env vars alone are not
     enough if something imported jax before us (jax snapshots env into its
     config at import time), so the config is also set directly."""
-    flags = os.environ.get("XLA_FLAGS", "")
-    flag = f"--xla_force_host_platform_device_count={n}"
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+    # REPLACE any inherited device-count flag rather than keeping it: an
+    # explicit simulate request must win over a parent process's env (e.g. a
+    # supervisor child launched from the 8-device test harness).
+    parts = [
+        p
+        for p in os.environ.get("XLA_FLAGS", "").split()
+        if not p.startswith("--xla_force_host_platform_device_count")
+    ]
+    parts.append(f"--xla_force_host_platform_device_count={n}")
+    os.environ["XLA_FLAGS"] = " ".join(parts)
     os.environ["JAX_NUM_CPU_DEVICES"] = str(n)  # newer-JAX equivalent
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n)
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        # Older jax: no such option; the env settings above (applied before
+        # the first backend touch) carry the device count alone.
+        pass
 
 
 def init_runtime(config: RuntimeConfig | None = None) -> None:
@@ -57,9 +69,21 @@ def init_runtime(config: RuntimeConfig | None = None) -> None:
     backends, and ``jax.distributed.initialize`` must run before any
     device access on multi-host.
     """
-    global _initialized
+    global _initialized, _active_coordinator
     config = config or RuntimeConfig()
     if _initialized:
+        if (
+            config.distributed
+            and config.coordinator_address
+            and _active_coordinator is not None
+            and config.coordinator_address != _active_coordinator
+        ):
+            # Elastic relaunch in-process: the pod came back on a bumped
+            # coordinator port (runtime/elastic.py restarts a generation
+            # against a fresh port), so the old distributed client — whose
+            # rendezvous state is generation-scoped — must be replaced, not
+            # reused.
+            reinit_distributed(config)
         return
     if config.simulate_devices > 0:
         simulate_devices(config.simulate_devices)
@@ -67,12 +91,14 @@ def init_runtime(config: RuntimeConfig | None = None) -> None:
     import jax
 
     if config.distributed:
+        _enable_cpu_cross_process_collectives()
         # Explicit args for CPU/GPU clusters; all-None autodetects on TPU pods.
         jax.distributed.initialize(
             coordinator_address=config.coordinator_address,
             num_processes=config.num_processes,
             process_id=config.process_id,
         )
+        _active_coordinator = config.coordinator_address
     setup_logging(config.log_level)
     if config.profiler_port > 0 and jax.process_index() == 0:
         jax.profiler.start_server(config.profiler_port)
@@ -86,6 +112,72 @@ def init_runtime(config: RuntimeConfig | None = None) -> None:
         jax.devices()[0].platform,
     )
     _initialized = True
+
+
+def _enable_cpu_cross_process_collectives() -> None:
+    """Select the Gloo transport for CPU cross-process collectives. The
+    default in-process CPU backend refuses multiprocess computations
+    ("Multiprocess computations aren't implemented on the CPU backend"), so
+    any distributed CPU pod — the multi-process drills, or a CPU cluster —
+    needs this set BEFORE the backend initializes. No-ops on TPU/GPU
+    platforms and on jax versions without the option."""
+    import jax
+
+    platforms = jax.config.jax_platforms or ""
+    # Unset platforms means auto-detection, which on a plain CPU host picks
+    # the very backend that needs this flag — only skip when the operator
+    # explicitly selected a non-CPU platform.
+    if platforms and "cpu" not in platforms.split(","):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass  # older jax (env/XLA flags decide) or gloo not compiled in
+
+
+def reinit_distributed(config: RuntimeConfig) -> None:
+    """Replace the distributed client for a new pod generation (elastic
+    relaunch on a bumped coordinator port).
+
+    Only possible BEFORE this process has executed any JAX computation —
+    jax refuses to re-initialize an already-computed process (drilled in
+    tests/elastic_drill.py, both polarities), because the backend's
+    collective channels were created against the old generation's store. A
+    process that has already computed must be RELAUNCHED to rejoin — which
+    is exactly what the pod controller does; this path serves workers that
+    brought the client up but died/rewired before touching a device. The
+    refusal is translated into an actionable error instead of jax's
+    generic one."""
+    global _active_coordinator
+    import jax
+
+    logger.info(
+        "re-initializing distributed runtime: coordinator %s -> %s",
+        _active_coordinator,
+        config.coordinator_address,
+    )
+    try:
+        jax.distributed.shutdown()
+    except RuntimeError:
+        pass  # old client already gone (e.g. coordinator died with the pod)
+    # The rejoin can only succeed when the backend has NOT initialized yet —
+    # which means the CPU collectives transport can (and must) still be
+    # selected for the new generation's first computation.
+    _enable_cpu_cross_process_collectives()
+    try:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+        )
+    except RuntimeError as e:
+        raise RuntimeError(
+            "cannot rejoin a new pod generation in-process: this process "
+            "already executed JAX computations against the old generation's "
+            "collective channels. Relaunch the process to rejoin (the pod "
+            "controller in runtime/elastic.py does this automatically)."
+        ) from e
+    _active_coordinator = config.coordinator_address
 
 
 def barrier(name: str = "startup") -> None:
@@ -109,7 +201,7 @@ def shutdown_runtime() -> None:
     """Tear down cleanly (analog of ``cleanup()``, ref ``:20-21``): final
     barrier so no host exits while peers are mid-collective, then release the
     distributed client."""
-    global _initialized
+    global _initialized, _active_coordinator
     if not _initialized:
         return
     import jax
@@ -120,4 +212,5 @@ def shutdown_runtime() -> None:
             jax.distributed.shutdown()
     finally:
         _initialized = False
+        _active_coordinator = None
     logger.info("runtime shut down")

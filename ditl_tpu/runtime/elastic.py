@@ -1,0 +1,478 @@
+"""Elastic pod-training subsystem (L2/L6).
+
+The reference's failure story for a dying node is a troubleshooting-doc
+paragraph; `launch.run_supervised` upgraded that to an in-process retry, and
+`launch --supervise` to a single-child process supervisor. Neither survives
+the scenario a real pod actually faces: N worker *processes* mid-collective,
+one of which is SIGKILLed (OOM-killer, host crash, preemption). The
+survivors then sit inside a collective that will never complete — the dead
+peer cannot be healed by restarting it alone, because `jax.distributed`
+rendezvous state and in-flight collectives are pod-global.
+
+This module is the pod-level answer:
+
+- :class:`PodController` launches N worker processes, watches liveness two
+  ways (process exit codes, and per-worker heartbeat files the trainer
+  touches every step), and on any worker death tears down the survivors and
+  relaunches the FULL pod against a fresh coordinator port (the old one can
+  linger in TIME_WAIT, and the distributed client's rendezvous state is
+  generation-scoped anyway).
+- Recovery correctness comes from multi-host Orbax checkpointing
+  (`train/checkpoint.py`): every process of the relaunched pod restores
+  params + optimizer state + data-iterator position and continues training
+  where the committed history left off.
+
+The controller is deliberately jax-free (stdlib only): it must stay
+responsive while its children are wedged inside native collectives, and it
+must be importable by the launcher before any backend is configured.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+import signal
+import socket
+import subprocess
+import time
+from typing import Callable, Sequence
+
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "PodState",
+    "PodResult",
+    "PodController",
+    "free_port",
+    "heartbeat_path",
+    "emit_heartbeat",
+    "read_heartbeat",
+]
+
+
+class PodState(enum.Enum):
+    """Controller lifecycle. Transitions are logged (and printed by
+    ``launch --supervise``) so a wedged pod is debuggable from the outside."""
+
+    IDLE = "IDLE"
+    LAUNCHING = "LAUNCHING"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+    RESTARTING = "RESTARTING"
+    DONE = "DONE"
+    FAILED = "FAILED"
+
+
+def _heartbeat_files(directory: str) -> list[str]:
+    """Every worker heartbeat file in ``directory`` — the single place
+    (besides :func:`heartbeat_path`) that knows the filename scheme."""
+    import glob
+
+    return glob.glob(os.path.join(directory, "worker-*.heartbeat"))
+
+
+def _describe_rc(rc: int) -> str:
+    """Human-readable death cause. Signal numbers without an enum member
+    (real-time signals) must not crash the controller mid-teardown."""
+    if rc >= 0:
+        return f"rc={rc}"
+    try:
+        return f"signal {signal.Signals(-rc).name}"
+    except ValueError:
+        return f"signal {-rc}"
+
+
+def free_port() -> int:
+    """A currently-free TCP port on localhost. Each pod generation binds a
+    fresh one: a crashed coordinator's port can sit in TIME_WAIT for minutes
+    (troubleshooting.md §1), and reusing it makes relaunch racy."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def heartbeat_path(directory: str, process_index: int) -> str:
+    """Per-worker heartbeat file. Keyed by process index (not PID) so the
+    config stays identical across workers — the cross-host consistency check
+    fingerprints the config, and per-worker paths would trip it."""
+    return os.path.join(directory, f"worker-{process_index}.heartbeat")
+
+
+def emit_heartbeat(directory: str, process_index: int, step: int) -> None:
+    """Atomically publish liveness (called by the trainer once per step
+    window, and once before the first step so compile time reads as alive)."""
+    os.makedirs(directory, exist_ok=True)
+    path = heartbeat_path(directory, process_index)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"step": int(step), "time": time.time(), "pid": os.getpid()}, f)
+    os.replace(tmp, path)
+
+
+def read_heartbeat(path: str) -> dict | None:
+    """Last published heartbeat, or None if absent/corrupt (a torn write is
+    impossible — emit is atomic — but a worker may die before its first,
+    and a foreign/hand-edited file must read as corrupt, not crash the
+    controller)."""
+    try:
+        with open(path) as f:
+            hb = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(hb, dict) or not isinstance(hb.get("time"), (int, float)):
+        return None
+    return hb
+
+
+@dataclasses.dataclass
+class PodResult:
+    """Outcome of :meth:`PodController.run`."""
+
+    state: PodState
+    restarts: int
+    returncodes: list[int | None]  # final generation's exit codes
+    ports: list[int]  # coordinator port per generation (len == restarts + 1)
+    transitions: list[str]  # "STATE -> STATE (why)" in order
+    # Exit code of the worker whose death triggered the LAST teardown — the
+    # actual failure, as opposed to the -SIGTERM codes the controller's own
+    # survivor teardown writes into ``returncodes``.
+    failure_rc: int | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.state is PodState.DONE
+
+    @property
+    def returncode(self) -> int:
+        if self.ok:
+            return 0
+        if self.failure_rc not in (0, None):
+            return self.failure_rc
+        for rc in self.returncodes:
+            if rc not in (0, None):
+                return rc
+        return 1
+
+
+class PodController:
+    """Launch, watch, and elastically relaunch a pod of worker processes.
+
+    ``build_argv(proc_id, nproc, port, attempt)`` produces each worker's
+    command line; the controller owns the coordinator port so every
+    generation rendezvouses on a fresh one. Liveness is judged by process
+    exit first (a nonzero exit is a death; exit 0 is completion) and by
+    heartbeat staleness second (``heartbeat_timeout_s > 0``): a worker that
+    is alive as a process but has stopped making training progress — wedged
+    in a collective whose peer died some other way — is treated as dead too.
+    """
+
+    def __init__(
+        self,
+        num_workers: int,
+        build_argv: Callable[[int, int, int, int], Sequence[str]],
+        *,
+        env: dict[str, str] | None = None,
+        max_pod_restarts: int = 0,
+        heartbeat_dir: str = "",
+        heartbeat_timeout_s: float = 0.0,
+        heartbeat_ids: Sequence[int | None] | None = None,
+        grace_s: float = 5.0,
+        completion_grace_s: float = 60.0,
+        poll_s: float = 0.2,
+        port_factory: Callable[[], int] = free_port,
+        log: Callable[[str], None] | None = None,
+        on_restart: Callable[[int, int, int], None] | None = None,
+    ):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        self.num_workers = num_workers
+        self.build_argv = build_argv
+        self.env = env
+        self.max_pod_restarts = max_pod_restarts
+        self.heartbeat_dir = heartbeat_dir
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        # Worker slot -> heartbeat file index. The trainer emits under its
+        # jax.process_index(), which equals the slot for a controller-owned
+        # pod but NOT for a single supervised member of a larger pod (its
+        # --process-id can be anything); the launcher passes the mapping. A
+        # None entry is a wildcard — "any heartbeat file in the dir counts"
+        # — for workers whose rank is autodetected and unknowable here.
+        self.heartbeat_ids: list[int | None] = (
+            list(heartbeat_ids) if heartbeat_ids is not None
+            else list(range(num_workers))
+        )
+        if len(self.heartbeat_ids) != num_workers:
+            raise ValueError(
+                f"heartbeat_ids must have one entry per worker "
+                f"({num_workers}), got {self.heartbeat_ids}"
+            )
+        self.grace_s = grace_s
+        # Once any worker exits 0 (SPMD: training completed pod-wide — the
+        # final barrier passed everywhere), stragglers get this long to
+        # finish their own teardown before being reaped as wedged-in-
+        # shutdown. Without it a hung (not crashed) straggler would spin
+        # the supervisor forever when no heartbeats/deadline are armed.
+        self.completion_grace_s = completion_grace_s
+        self.poll_s = poll_s
+        self.port_factory = port_factory
+        self._log = log or (lambda msg: logger.info("%s", msg))
+        self.on_restart = on_restart
+        self.state = PodState.IDLE
+        self.restarts = 0
+        self.transitions: list[str] = []
+        self.ports: list[int] = []
+        self._procs: list[subprocess.Popen] = []
+        self._spawned_at = 0.0
+        self._failure_rc: int | None = None
+
+    # -- state machine ------------------------------------------------------
+
+    def _transition(self, new: PodState, why: str) -> None:
+        line = f"pod-controller: {self.state.value} -> {new.value} ({why})"
+        self.transitions.append(line)
+        self.state = new
+        self._log(line)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def _spawn(self, attempt: int) -> None:
+        port = self.port_factory()
+        self.ports.append(port)
+        self._transition(
+            PodState.LAUNCHING,
+            f"generation {attempt}: {self.num_workers} workers, "
+            f"coordinator port {port}",
+        )
+        if self.heartbeat_dir:
+            # Stale heartbeats from the previous generation must not mask a
+            # worker that dies before its first step. Wildcard slots clear
+            # every heartbeat file in the dir.
+            stale_files = set()
+            for hb_id in self.heartbeat_ids:
+                if hb_id is None:
+                    stale_files.update(_heartbeat_files(self.heartbeat_dir))
+                else:
+                    stale_files.add(heartbeat_path(self.heartbeat_dir, hb_id))
+            for f in stale_files:
+                try:
+                    os.remove(f)
+                except OSError:
+                    pass
+        # Append as we go (not a comprehension): if a later Popen fails, the
+        # already-launched workers must remain referenced so the run()-level
+        # teardown can reap them instead of leaking them into rendezvous.
+        self._procs = []
+        for i in range(self.num_workers):
+            self._procs.append(
+                subprocess.Popen(
+                    list(self.build_argv(i, self.num_workers, port, attempt)),
+                    env=self.env,
+                )
+            )
+        # Wall clock, not monotonic: heartbeats carry time.time() stamps.
+        self._spawned_at = time.time()
+        self._transition(PodState.RUNNING, f"all {self.num_workers} workers spawned")
+
+    def _teardown(self, why: str) -> None:
+        """SIGTERM the survivors, then SIGKILL stragglers after ``grace_s``.
+        A worker wedged in a native collective never runs Python signal
+        handlers, but SIGTERM's default disposition still terminates it; the
+        SIGKILL backstop covers processes that installed handlers."""
+        self._transition(PodState.STOPPING, why)
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.terminate()
+                except OSError:
+                    pass
+        deadline = time.monotonic() + self.grace_s
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.0, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    try:
+                        p.kill()
+                        p.wait(timeout=self.grace_s)
+                    except (OSError, subprocess.TimeoutExpired):
+                        pass
+
+    def _stale_workers(self) -> list[int]:
+        """Workers whose heartbeat is older than the timeout (wall clock —
+        heartbeats carry ``time.time()`` stamps). The clock for a worker with
+        no heartbeat yet starts at spawn time (first-step compile can
+        dominate, so callers size the timeout above worst-case compile)."""
+        if not (self.heartbeat_dir and self.heartbeat_timeout_s > 0):
+            return []
+        now = time.time()
+        stale = []
+        for i, p in enumerate(self._procs):
+            if p.poll() is not None:
+                continue
+            hb_id = self.heartbeat_ids[i]
+            if hb_id is None:
+                # Wildcard slot (autodetected rank): the freshest heartbeat
+                # in the dir stands in for this worker.
+                times = [
+                    hb["time"]
+                    for f in _heartbeat_files(self.heartbeat_dir)
+                    if (hb := read_heartbeat(f)) is not None
+                ]
+                last = max(times, default=None)
+            else:
+                hb = read_heartbeat(heartbeat_path(self.heartbeat_dir, hb_id))
+                last = hb["time"] if hb else None
+            base = max(last, self._spawned_at) if last else self._spawned_at
+            if now - base > self.heartbeat_timeout_s:
+                stale.append(i)
+        return stale
+
+    def run(self, timeout_s: float | None = None) -> PodResult:
+        """Drive the pod to DONE or FAILED. ``timeout_s`` is a hard wall-clock
+        deadline over ALL generations (drills use it so a wedged pod fails
+        the test instead of hanging the suite). Any exception escaping the
+        controller itself (spawn failure, bug) still tears the workers down
+        — leaking them wedged in rendezvous is never acceptable."""
+        try:
+            return self._run(timeout_s)
+        except BaseException:
+            self._teardown("controller error; tearing down workers")
+            raise
+
+    def _run(self, timeout_s: float | None) -> PodResult:
+        start = time.monotonic()
+        attempt = 0
+        first_zero_at: float | None = None
+        self._spawn(attempt)
+        while True:
+            time.sleep(self.poll_s)
+            # The deadline is checked UNCONDITIONALLY (not only on idle
+            # iterations): a fast-crash-looping pod with a deep restart
+            # budget must still stop at the deadline, not minutes past it.
+            timed_out = (
+                timeout_s is not None and time.monotonic() - start > timeout_s
+            )
+            rcs = [p.poll() for p in self._procs]
+            failure: str | None = None
+            if all(rc == 0 for rc in rcs):
+                self._transition(PodState.DONE, "all workers exited 0")
+                return self._result()
+            if any(rc == 0 for rc in rcs):
+                if first_zero_at is None:
+                    first_zero_at = time.monotonic()
+                elif (
+                    time.monotonic() - first_zero_at > self.completion_grace_s
+                    and any(rc is None for rc in rcs)
+                ):
+                    # Training completed (a worker exited 0 ⇒ the final
+                    # barrier passed pod-wide) but a straggler is wedged in
+                    # its own shutdown: reap it and finish rather than spin
+                    # forever (no death, no heartbeat, maybe no deadline).
+                    self._teardown(
+                        "straggler(s) still alive "
+                        f"{self.completion_grace_s:.0f}s after a peer "
+                        "completed; reaping"
+                    )
+                    self._transition(
+                        PodState.DONE,
+                        "training completed; wedged straggler(s) reaped "
+                        "post-completion",
+                    )
+                    return self._result()
+            dead = [(i, rc) for i, rc in enumerate(rcs) if rc not in (0, None)]
+            if dead:
+                i, rc = dead[0]
+                if any(r == 0 for r in rcs):
+                    # SPMD: a worker exits 0 only when training completed
+                    # pod-wide, so a peer dying AFTER that is a
+                    # teardown-time death (e.g. an XLA shutdown abort), not
+                    # a training failure — relaunching would retrain the
+                    # tail and print a second summary. Reap stragglers and
+                    # finish.
+                    self._teardown(
+                        f"worker {i} died ({_describe_rc(rc)}) after a peer "
+                        "completed; reaping stragglers"
+                    )
+                    self._transition(
+                        PodState.DONE,
+                        f"training completed; worker {i} death "
+                        f"({_describe_rc(rc)}) was post-completion",
+                    )
+                    return self._result()
+                failure = f"worker {i} died ({_describe_rc(rc)})"
+                self._failure_rc = rc
+            else:
+                stale = self._stale_workers()
+                if stale and any(r == 0 for r in rcs):
+                    # Same post-completion rule as the exit-code branch: a
+                    # worker wedged in SHUTDOWN after a peer exited 0 is not
+                    # a training failure — reap it and finish, don't retrain
+                    # the completed tail.
+                    self._teardown(
+                        f"worker {stale[0]} heartbeat stale after a peer "
+                        "completed; reaping stragglers"
+                    )
+                    self._transition(
+                        PodState.DONE,
+                        f"training completed; worker {stale[0]} stale "
+                        "heartbeat was post-completion",
+                    )
+                    return self._result()
+                if stale:
+                    failure = (
+                        f"worker {stale[0]} heartbeat stale "
+                        f"(> {self.heartbeat_timeout_s:.1f}s)"
+                    )
+                    # No exit code exists for a stall; don't let the
+                    # teardown's own SIGTERM codes masquerade as one.
+                    self._failure_rc = 1
+            if failure is None:
+                if timed_out:
+                    # Like the stale branch: no worker failed — don't let
+                    # the teardown's own SIGTERM codes masquerade as the
+                    # failure returncode.
+                    self._failure_rc = 1
+                    self._teardown(f"pod deadline exceeded ({timeout_s:.0f}s)")
+                    self._transition(PodState.FAILED, "deadline exceeded")
+                    return self._result()
+                continue
+            self._teardown(f"{failure}; tearing down survivors")
+            if timed_out:
+                self._transition(
+                    PodState.FAILED,
+                    f"{failure}; pod deadline exceeded ({timeout_s:.0f}s)",
+                )
+                return self._result()
+            if self.restarts >= self.max_pod_restarts:
+                self._transition(
+                    PodState.FAILED,
+                    f"{failure}; restart budget exhausted "
+                    f"({self.restarts}/{self.max_pod_restarts})",
+                )
+                return self._result()
+            self.restarts += 1
+            attempt += 1
+            self._transition(
+                PodState.RESTARTING,
+                f"{failure}; relaunching full pod "
+                f"(restart {self.restarts}/{self.max_pod_restarts}, "
+                "bumping coordinator port)",
+            )
+            if self.on_restart is not None:
+                self.on_restart(self._failure_rc or 1, self.restarts,
+                                self.max_pod_restarts)
+            self._spawn(attempt)
+
+    def _result(self) -> PodResult:
+        return PodResult(
+            state=self.state,
+            restarts=self.restarts,
+            returncodes=[p.poll() for p in self._procs],
+            ports=list(self.ports),
+            transitions=list(self.transitions),
+            failure_rc=self._failure_rc,
+        )

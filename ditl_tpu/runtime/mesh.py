@@ -41,14 +41,20 @@ def build_mesh(config: MeshConfig | None = None, devices=None) -> "jax.sharding.
     shape = tuple(by_name[a] for a in AXIS_ORDER)
     # Auto axis types: GSPMD infers intermediate shardings from the constraints
     # we annotate (with_sharding_constraint / in_shardings), which is the
-    # propagation model this framework is designed around.
-    auto = (jax.sharding.AxisType.Auto,) * len(AXIS_ORDER)
+    # propagation model this framework is designed around. Older jax has no
+    # AxisType at all — every axis is implicitly Auto there.
+    axis_type = getattr(jax.sharding, "AxisType", None)
+    kwargs = (
+        {"axis_types": (axis_type.Auto,) * len(AXIS_ORDER)}
+        if axis_type is not None
+        else {}
+    )
     try:
         # Topology-aware layout when available (real TPU slices).
-        mesh = jax.make_mesh(shape, AXIS_ORDER, devices=devices, axis_types=auto)
-    except (TypeError, ValueError):
+        mesh = jax.make_mesh(shape, AXIS_ORDER, devices=devices, **kwargs)
+    except (AttributeError, TypeError, ValueError):
         device_grid = np.asarray(devices).reshape(shape)
-        mesh = Mesh(device_grid, AXIS_ORDER, axis_types=auto)
+        mesh = Mesh(device_grid, AXIS_ORDER, **kwargs)
     logger.info("mesh: %s", dict(zip(AXIS_ORDER, shape)))
     return mesh
 

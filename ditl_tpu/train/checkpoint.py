@@ -96,7 +96,13 @@ class CheckpointManager:
         the difference between serving a 70B checkpoint and OOMing on it.
         ``abstract_params`` (a ``jax.eval_shape`` tree) is validated against
         the checkpoint metadata so a preset/checkpoint mismatch fails loudly
-        here, not as a shape error mid-forward."""
+        here, not as a shape error mid-forward.
+
+        Multi-process serving: when ``abstract_params`` leaves carry
+        shardings (``jax.ShapeDtypeStruct(..., sharding=...)``), each process
+        restores only its addressable shards of the global arrays — the
+        cross-process mirror of how the checkpoint was written. Without
+        shardings the restore yields host numpy (single-process serving)."""
         import jax
         import orbax.checkpoint as ocp
 
@@ -105,13 +111,16 @@ class CheckpointManager:
             return None
         ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
         path = f"{self.directory}/{step}/state"
-        meta_tree = ckptr.metadata(path).item_metadata.tree
+        meta = ckptr.metadata(path)
+        # Orbax < 0.9 returns the metadata TREE directly; newer wraps it.
+        meta_tree = meta if isinstance(meta, dict) else meta.item_metadata.tree
         if "params" not in meta_tree:
             raise ValueError(f"checkpoint at {path} has no 'params' subtree")
         abstract = jax.tree.map(
             lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype),
             {"params": meta_tree["params"]},
         )
+        restore_args = None
         if abstract_params is not None:
             expect = {
                 jax.tree_util.keystr(p): l.shape
@@ -132,9 +141,38 @@ class CheckpointManager:
                     f"missing={missing[:3]} extra={extra[:3]} shape_mismatch="
                     f"{[(k, expect[k], got[k]) for k in shape_diff[:3]]}"
                 )
-        restored = ckptr.restore(
-            path, args=ocp.args.PyTreeRestore(item=abstract, partial_restore=True)
-        )
+            if any(
+                getattr(l, "sharding", None) is not None
+                for l in jax.tree_util.tree_leaves(abstract_params)
+            ):
+                restore_args = {
+                    "params": jax.tree.map(
+                        lambda meta, user: ocp.ArrayRestoreArgs(
+                            sharding=user.sharding,
+                            global_shape=meta.shape,
+                            dtype=meta.dtype,
+                        )
+                        if getattr(user, "sharding", None) is not None
+                        else ocp.RestoreArgs(),
+                        abstract["params"],
+                        abstract_params,
+                    )
+                }
+        try:
+            restore = ocp.args.PyTreeRestore(
+                item=abstract, restore_args=restore_args, partial_restore=True
+            )
+        except TypeError:
+            # Older orbax spells partial restore as "transforms={}": only the
+            # item's keys are read, everything else is dropped unread. That
+            # spelling requires explicit restore_args for every leaf.
+            restore = ocp.args.PyTreeRestore(
+                item=abstract,
+                restore_args=restore_args
+                or jax.tree.map(lambda _: ocp.RestoreArgs(), abstract),
+                transforms={},
+            )
+        restored = ckptr.restore(path, args=restore)
         logger.info("restored params (only) from checkpoint at step %d", step)
         return restored["params"]
 

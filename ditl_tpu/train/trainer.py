@@ -33,6 +33,7 @@ from ditl_tpu.runtime.distributed import (
     is_coordinator,
     shutdown_runtime,
 )
+from ditl_tpu.runtime.elastic import emit_heartbeat
 from ditl_tpu.runtime.mesh import build_mesh
 from ditl_tpu.train.checkpoint import CheckpointManager, DataIterState
 from ditl_tpu.train.metrics import MetricsLogger
@@ -285,6 +286,15 @@ def train(config: Config) -> dict[str, Any]:
     client = LLMClient(config.api)
     total_steps = config.train.total_steps
     global_step = data_iter.global_step
+    def beat(step: int) -> None:
+        """Publish liveness for the pod controller (runtime/elastic.py)."""
+        if config.train.heartbeat_dir:
+            emit_heartbeat(config.train.heartbeat_dir, jax.process_index(), step)
+
+    # First heartbeat BEFORE the first step: first-step compile can dominate
+    # wall time, and the pod controller must read "alive, still compiling"
+    # rather than "never came up".
+    beat(global_step)
     step_metrics = None
     last_val_loss = None
     last_saved = None
@@ -332,6 +342,7 @@ def train(config: Config) -> dict[str, Any]:
                 metrics.end_step(
                     global_step - 1, window_metrics, n_steps=len(window)
                 )
+                beat(global_step)
                 position = DataIterState(epoch, step_in_epoch, global_step)
                 if ckpt is not None and ckpt.should_save(global_step, len(window)):
                     ckpt.save(global_step, state, position)
@@ -356,10 +367,19 @@ def train(config: Config) -> dict[str, Any]:
                         [dataset[int(i)]["label"] for i in idx],
                         max_samples=config.train.eval_samples,
                     )
+                if _crossed(
+                    global_step, len(window), config.train.val_every
+                ) or _crossed(global_step, len(window), config.train.eval_every):
+                    # Validation / remote-API eval can dwarf a step window;
+                    # re-arm the stall watchdog so a long (healthy) eval
+                    # isn't read as a wedged worker.
+                    beat(global_step)
                 if (
                     config.train.fault_kill_step > 0
                     and not resumed
                     and global_step >= config.train.fault_kill_step
+                    and config.train.fault_kill_process
+                    in (-1, jax.process_index())
                 ):
                     # SIGKILL drill (host-crash simulation): bypasses every
                     # Python-level handler, so only a process-level

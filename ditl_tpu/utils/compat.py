@@ -1,0 +1,52 @@
+"""Pinned-toolchain version shims.
+
+The repo targets current jax APIs (``jax.shard_map``, pallas
+``CompilerParams``, orbax metadata wrappers); the baked image can pin an
+older toolchain where those live under their pre-promotion names. Each shim
+prefers the modern spelling and falls back, so the code reads current and
+still runs on the pinned versions. Keep these thin: one public name per
+drifted API, no behavior of our own.
+"""
+
+from __future__ import annotations
+
+__all__ = ["axis_size", "shard_map", "tpu_compiler_params"]
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis inside a shard_map body:
+    ``jax.lax.axis_size`` (modern) or the ambient axis env (older jax)."""
+    import jax
+
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    from jax._src import core
+
+    return core.axis_frame(axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True, **kw):
+    """``jax.shard_map`` (modern) or ``jax.experimental.shard_map.shard_map``
+    (older jax, where ``check_vma`` was still called ``check_rep``)."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma, **kw,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, **kw,
+    )
+
+
+def tpu_compiler_params(**kw):
+    """``pltpu.CompilerParams`` (modern) / ``pltpu.TPUCompilerParams``
+    (older jax) with identical field names."""
+    import jax.experimental.pallas.tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    return cls(**kw)

@@ -24,7 +24,12 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax: the option doesn't exist; the XLA_FLAGS/env settings above
+    # (applied before the first backend touch) carry the device count alone.
+    pass
 
 # NOTE: jax_compilation_cache_dir was tried here to cut suite wall time and
 # reverted: this jaxlib's XLA:CPU intermittently aborts (SIGABRT) when

@@ -74,3 +74,45 @@ def test_mesh_resolve():
         MeshConfig(data=3).resolve(8)
     with pytest.raises(ValueError):
         MeshConfig(data=-1, fsdp=-1).resolve(8)
+
+
+def test_moe_rejects_dense_only_fusion_flags():
+    """ADVICE r5 #1: the MoE branch has no fused gate|up layout, so a MoE
+    config carrying fused_gate_up / mlp_custom_vjp would silently measure an
+    unfused program — reject at construction, not at trace time."""
+    from ditl_tpu.config import ModelConfig
+
+    with pytest.raises(ValueError, match="fused_gate_up"):
+        ModelConfig(num_experts=4, fused_gate_up=True)
+    with pytest.raises(ValueError, match="mlp_custom_vjp"):
+        ModelConfig(num_experts=4, mlp_custom_vjp=True)
+    # The override path validates the FINAL combination, not intermediate
+    # states: a finally-invalid combo raises in either order, and turning a
+    # MoE base dense while enabling fusion is legal regardless of order.
+    with pytest.raises(ValueError, match="MoE"):
+        parse_overrides(
+            Config(), ["model.num_experts=4", "model.fused_gate_up=true"]
+        )
+    with pytest.raises(ValueError, match="MoE"):
+        parse_overrides(
+            Config(), ["model.fused_gate_up=true", "model.num_experts=4"]
+        )
+    import dataclasses
+
+    moe_base = dataclasses.replace(Config(), model=ModelConfig(num_experts=4))
+    out = parse_overrides(
+        moe_base, ["model.fused_gate_up=true", "model.num_experts=0"]
+    )
+    assert out.model.num_experts == 0 and out.model.fused_gate_up
+    # Dense configs keep both flags; MoE without the flags stays legal.
+    ModelConfig(fused_gate_up=True, mlp_custom_vjp=True)
+    ModelConfig(num_experts=4)
+
+
+def test_heartbeat_timeout_requires_dir():
+    from ditl_tpu.config import TrainConfig
+
+    with pytest.raises(ValueError, match="heartbeat_dir"):
+        TrainConfig(heartbeat_timeout_s=30.0)
+    TrainConfig(heartbeat_dir="/tmp/hb", heartbeat_timeout_s=30.0)
+    TrainConfig()  # both unset stays legal

@@ -888,3 +888,30 @@ def test_schema_string_pattern():
         G.compile_json_schema(
             {"type": "string", "pattern": "^a+$", "minLength": 2}, tok
         )
+
+
+def test_schema_string_pattern_trailing_backslash_anchor():
+    """ADVICE r5 #2: escaped-ness of a trailing ``$`` is decided by the
+    PARITY of the consecutive backslashes before it, not a single
+    ``endswith(r"\\$")`` check."""
+    tok = ByteTokenizer()
+    # Odd run (r"\$"): a literal dollar, NOT an anchor — the right side
+    # stays an open-ended search.
+    g = G.compile_json_schema({"type": "string", "pattern": r"price\$"}, tok)
+    assert g.matches(b'"price$"') and g.matches(b'"price$ cut"')
+    assert not g.matches(b'"price"')
+
+    # Even run (r"\\$"): an escaped BACKSLASH followed by a REAL anchor.
+    # Before the parity fix the $ was misread as escaped and leaked bare
+    # into _Parser, which raised a RegexError pointing at the anchor — the
+    # wrong cause. The true failure is that a raw backslash can never
+    # appear unescaped inside a JSON string value, so the grammar is
+    # unsatisfiable, and the error must say exactly that.
+    with pytest.raises(ValueError, match="admits no completion"):
+        G.compile_json_schema({"type": "string", "pattern": r"^ab\\$"}, tok)
+    try:
+        G.compile_json_schema({"type": "string", "pattern": r"^ab\\$"}, tok)
+    except G.RegexError:
+        raise AssertionError("bare $ leaked into the regex parser")
+    except ValueError:
+        pass

@@ -11,53 +11,19 @@ This is the regime the reference's two-node bring-up actually exercises
 and that ``process_count == 1`` tests structurally cannot."""
 
 import os
-import socket
-import subprocess
-import sys
 
 import pytest
 
+from tests.cluster_harness import ClusterHarness
+
 DRILL = os.path.join(os.path.dirname(__file__), "multiproc_drill.py")
 
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+pytestmark = pytest.mark.multiproc
 
 
 def _run_drill(nproc: int, *extra: str, timeout: int = 420):
     """Launch nproc copies of the drill; return their (rc, stdout) pairs."""
-    port = _free_port()
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(DRILL)))
-    env = {
-        **os.environ,
-        "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
-        "JAX_PLATFORMS": "cpu",
-        "PALLAS_AXON_POOL_IPS": "",
-        # One real device per process: the point is cross-PROCESS
-        # coordination, not virtual-device SPMD (the dryrun covers that).
-        "JAX_NUM_CPU_DEVICES": "1",
-        "XLA_FLAGS": "",
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, DRILL, str(i), str(nproc), str(port), *extra],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            env=env,
-        )
-        for i in range(nproc)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=timeout)
-            outs.append((p.returncode, out))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    return outs
+    return ClusterHarness(nproc, DRILL, timeout=timeout).run(*extra)
 
 
 @pytest.mark.slow
