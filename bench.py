@@ -259,7 +259,8 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
                 prompt_len: int = 0, max_new: int = 0,
                 temperature: float = 0.0, guided: str = "",
                 spec_draft: bool = False, pipeline: bool = False,
-                admission: str = "reserve", pages: int = 0) -> int:
+                admission: str = "reserve", pages: int = 0,
+                compile_cache_dir: str = "") -> int:
     """Decode/serving benchmark — one JSON line. Every serving claim in
     BASELINE.md is reproducible from here: ``--engine continuous`` ticks the
     production slot engine (``--cache paged`` for the page pool + Pallas
@@ -275,7 +276,9 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
     from ditl_tpu.config import ModelConfig
     from ditl_tpu.data.tokenizer import ByteTokenizer
     from ditl_tpu.models import llama
+    from ditl_tpu.runtime.distributed import enable_compile_cache
 
+    enable_compile_cache(compile_cache_dir)
     platform = jax.devices()[0].platform
     cfg = ModelConfig(
         name="bench-moe" if moe else "bench-350m", vocab_size=32768,
@@ -536,8 +539,42 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
     return 0
 
 
+def _effective_bwd_impls(cfg, batch: int, seq: int, mesh=None) -> dict[str, str]:
+    """Which backward implementation will actually run for this config —
+    delegates to the SAME predicates the dispatch uses (ops/mlp.py,
+    ops/projection.py: shape tiling + mesh batch-divisibility gates), over
+    the model's ACTUAL projection layout (fused vs per-projection qkv).
+    The Pallas kernels fall back to the einsum spelling where those gates
+    fail, and a round-over-round ``vs_baseline`` must never silently
+    attribute a delta to a kernel that was never executed. A projection
+    set that only partially tiles reports "mixed"."""
+    from ditl_tpu.ops import mlp, projection
+
+    d, hd = cfg.hidden_size, cfg.head_dim
+    mlp_eff = mlp.effective_bwd_impl(
+        cfg.mlp_bwd_impl, batch, seq, d, cfg.intermediate_size,
+        (cfg.mlp_bwd_block_n, cfg.mlp_bwd_block_f, cfg.mlp_bwd_block_d),
+        mesh,
+    )
+    if cfg.fused_qkv:
+        proj_shapes = [(d, (cfg.num_heads + 2 * cfg.num_kv_heads) * hd)]
+    else:
+        proj_shapes = [(d, cfg.num_heads * hd), (d, cfg.num_kv_heads * hd)]
+    proj_shapes.append((cfg.num_heads * hd, d))  # wo
+    blocks = (cfg.proj_bwd_block_n, cfg.proj_bwd_block_d)
+    effs = {
+        projection.effective_bwd_impl(
+            cfg.proj_bwd_impl, batch, seq, d_in, f, blocks, mesh
+        )
+        for d_in, f in proj_shapes
+    }
+    proj_eff = effs.pop() if len(effs) == 1 else "mixed"
+    return {"mlp": mlp_eff, "proj": proj_eff}
+
+
 def main(model_name: str = "350m", overrides: list[str] | None = None,
-         batch_override: int = 0, seq_override: int = 0) -> int:
+         batch_override: int = 0, seq_override: int = 0,
+         compile_cache_dir: str = "") -> int:
     import dataclasses
 
     import jax
@@ -546,10 +583,14 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
     from ditl_tpu.config import MeshConfig, TrainConfig
     from ditl_tpu.data.loader import make_global_batch
     from ditl_tpu.models import llama
+    from ditl_tpu.runtime.distributed import enable_compile_cache
     from ditl_tpu.runtime.mesh import build_mesh
     from ditl_tpu.train.state import create_train_state
     from ditl_tpu.train.step import make_multi_step
 
+    if enable_compile_cache(compile_cache_dir):
+        print(f"bench: persistent compile cache at {compile_cache_dir}",
+              file=sys.stderr)
     n_chips = len(jax.devices())
     platform = jax.devices()[0].platform
     print(f"bench: {n_chips} {platform} device(s)", file=sys.stderr)
@@ -653,6 +694,10 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
         "params_m": round(params_m, 1),
         "loss_start": round(loss_start, 4),
         "final_loss": round(final_loss, 4),
+        # The backward implementations that ACTUALLY ran (pallas falls back
+        # to the einsum spelling on untileable shapes) — keeps
+        # round-over-round vs_baseline attributable (ISSUE 2 satellite).
+        "bwd_impl": _effective_bwd_impls(cfg, batch, seq, mesh),
     }
     if swept:
         result["swept"] = {
@@ -749,6 +794,12 @@ if __name__ == "__main__":
                         help="train-bench batch override (0 = config default)")
     parser.add_argument("--seq", type=int, default=0,
                         help="train-bench seq-len override (0 = config default)")
+    parser.add_argument("--compile-cache-dir",
+                        default="~/.cache/ditl_tpu/xla-cache",
+                        help="persistent XLA compilation cache directory "
+                        "(on by default — a warm second run skips the "
+                        "~85 s compile+first-window; pass '' to disable; "
+                        "see docs/troubleshooting.md §20 for staleness)")
     args = parser.parse_args()
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"
@@ -778,6 +829,8 @@ if __name__ == "__main__":
             temperature=args.temperature, guided=args.guided,
             spec_draft=args.spec_draft, pipeline=args.pipeline,
             admission=args.admission, pages=args.pages,
+            compile_cache_dir=args.compile_cache_dir,
         ))
     sys.exit(main(args.model, overrides=args.override,
-                  batch_override=args.batch, seq_override=args.seq))
+                  batch_override=args.batch, seq_override=args.seq,
+                  compile_cache_dir=args.compile_cache_dir))

@@ -56,6 +56,16 @@ class RuntimeConfig:
     distributed: bool = False  # True => call jax.distributed.initialize
     log_level: str = "INFO"
     profiler_port: int = 0  # >0 => start jax.profiler server on this port
+    # Persistent XLA compilation cache (VERDICT r5 item 9: compile+first
+    # window is 85.6 s per session and pays on every restart, drill, and
+    # bench run). On by default; "" disables. The pinned directory is shared
+    # across sessions so a relaunch/elastic restart reuses compiled
+    # programs. On CPU the cache is only honored for single-device,
+    # single-process runs — this jaxlib's XLA:CPU intermittently crashes
+    # (SIGABRT/SIGSEGV) deserializing cached executables under the
+    # multi-device host platform and in multi-process gloo pods (see
+    # tests/conftest.py and docs/troubleshooting.md §20).
+    compile_cache_dir: str = "~/.cache/ditl_tpu/xla-cache"
 
 
 @dataclass(frozen=True)
@@ -200,6 +210,28 @@ class ModelConfig:
     # against the backward-scheduling residual (BASELINE.md r5);
     # measured-neutral configs should leave it off.
     mlp_custom_vjp: bool = False
+    # MLP backward implementation behind the custom-VJP seam: "xla"
+    # (explicit einsums, scheduled by XLA — the r5 null) | "pallas"
+    # (hand-tiled Mosaic kernels, ops/mlp_bwd.py — the schedule is pinned
+    # by the grid). "pallas" requires fused_gate_up and routes through the
+    # custom VJP even when mlp_custom_vjp is off. Shapes the kernels
+    # cannot tile fall back to the einsum spelling; bench.py records which
+    # implementation actually ran.
+    mlp_bwd_impl: str = "xla"
+    # Pallas MLP-backward tile sizes (0 = kernel defaults, sized for the
+    # 1b3 shapes on v5e): token tile, intermediate-dim tile (pass 1),
+    # hidden-dim tile (pass 2). Sweepable per chip like the flash blocks.
+    mlp_bwd_block_n: int = 0
+    mlp_bwd_block_f: int = 0
+    mlp_bwd_block_d: int = 0
+    # Attention-projection (qkv/out) backward: "xla" | "pallas"
+    # (ops/projection.py — dx and the wgrad emitted from one kernel with a
+    # shared cotangent read). Targets the ~33 ms attn-proj wgrad residual
+    # of the r4 roofline. Plain float weights only (reject-don't-drop at
+    # the projection site, like mlp_custom_vjp).
+    proj_bwd_impl: str = "xla"
+    proj_bwd_block_n: int = 0
+    proj_bwd_block_d: int = 0
     # Loss head: "naive" materializes (B, S, V) f32 logits; "fused" computes
     # the lm-head matmul + cross-entropy blockwise (ops/fused_ce.py) so peak
     # logits memory is loss_block_tokens x V instead of B*S*V.
@@ -214,11 +246,39 @@ class ModelConfig:
         # these flags would be silently ignored (an A/B would measure
         # byte-identical programs) — the same failure mode the dense-path
         # guard in models/llama.py exists to prevent.
-        if self.num_experts > 0 and (self.fused_gate_up or self.mlp_custom_vjp):
+        if self.num_experts > 0 and (
+            self.fused_gate_up or self.mlp_custom_vjp
+            or self.mlp_bwd_impl != "xla"
+        ):
             raise ValueError(
-                "fused_gate_up/mlp_custom_vjp target the dense MLP path and "
-                f"do not apply to MoE models (num_experts={self.num_experts}); "
-                "unset them rather than measuring a silently unfused program"
+                "fused_gate_up/mlp_custom_vjp/mlp_bwd_impl target the dense "
+                f"MLP path and do not apply to MoE models (num_experts="
+                f"{self.num_experts}); unset them rather than measuring a "
+                "silently unfused program"
+            )
+        if self.mlp_bwd_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown mlp_bwd_impl {self.mlp_bwd_impl!r} (xla|pallas)"
+            )
+        if self.proj_bwd_impl not in ("xla", "pallas"):
+            raise ValueError(
+                f"unknown proj_bwd_impl {self.proj_bwd_impl!r} (xla|pallas)"
+            )
+        for blk in ("mlp_bwd_block_n", "mlp_bwd_block_f", "mlp_bwd_block_d",
+                    "proj_bwd_block_n", "proj_bwd_block_d"):
+            if getattr(self, blk) < 0:
+                # Negative blocks sneak through the kernels' divisibility
+                # checks (Python modulo) into a cryptic Mosaic error —
+                # reject at config time like every other knob.
+                raise ValueError(f"{blk} must be >= 0 (0 = kernel default), "
+                                 f"got {getattr(self, blk)}")
+        if self.mlp_bwd_impl == "pallas" and not self.fused_gate_up:
+            # Reject-don't-drop: the Pallas backward targets the fused w_gu
+            # layout; silently ignoring the flag on the unfused layout would
+            # make an A/B measure byte-identical programs.
+            raise ValueError(
+                "mlp_bwd_impl='pallas' requires fused_gate_up=True (the "
+                "kernels target the fused w_gu layout)"
             )
 
 

@@ -331,10 +331,31 @@ def _decoder_layer(
     attn = layer_params["attn"]
     lora = layer_params.get("lora")
 
-    from ditl_tpu.ops.quant import weight_einsum
+    from ditl_tpu.ops.quant import is_quantized_leaf, weight_einsum
+
+    def base_proj(t, w):
+        """The attention projections' base matmul — the proj_bwd_impl seam.
+        The Pallas variant (ops/projection.py) keeps the forward
+        bit-identical and swaps only the backward's spelling."""
+        if cfg.proj_bwd_impl == "pallas":
+            if is_quantized_leaf(w):
+                # Reject-don't-drop (same failure mode as mlp_custom_vjp):
+                # quantized serving never differentiates — leave it off.
+                raise ValueError(
+                    "proj_bwd_impl='pallas' needs plain float weights "
+                    "(quantized serving never differentiates — leave it off)"
+                )
+            from ditl_tpu.ops.projection import projection
+
+            return projection(
+                t, w.astype(cd), bwd_impl="pallas",
+                blocks=(cfg.proj_bwd_block_n, cfg.proj_bwd_block_d),
+                mesh=mesh, rules=rules,
+            )
+        return weight_einsum("bsd,df->bsf", t, w, compute_dtype=cd)
 
     def proj(h, w, name):
-        out = weight_einsum("bsd,df->bsf", h, w, compute_dtype=cd)
+        out = base_proj(h, w)
         if lora is not None and name in lora:
             from ditl_tpu.models.lora import lora_delta
 
@@ -364,7 +385,7 @@ def _decoder_layer(
                 "fused_qkv does not compose with LoRA adapters (deltas "
                 "target the per-projection names wq/wk/wv)"
             )
-        qkv = weight_einsum("bsd,df->bsf", h, attn["w_qkv"], compute_dtype=cd)
+        qkv = base_proj(h, attn["w_qkv"])
         q, k, v = jnp.split(
             qkv, (nh * hd, (nh + nkv) * hd), axis=-1
         )
@@ -478,27 +499,31 @@ def _decoder_layer(
         mlp_out, aux = moe_block(layer_params["moe"], h, cfg, mesh=mesh, rules=rules)
     else:
         mlp = layer_params["mlp"]
-        if cfg.mlp_custom_vjp and "w_gu" not in mlp:
+        use_custom_vjp = cfg.mlp_custom_vjp or cfg.mlp_bwd_impl == "pallas"
+        if use_custom_vjp and "w_gu" not in mlp:
             # Reject-don't-drop: silently falling back to autodiff would
             # make an A/B of the flag measure byte-identical programs.
             raise ValueError(
-                "mlp_custom_vjp requires fused_gate_up=True (the "
-                "hand-written backward targets the fused w_gu layout)"
+                "mlp_custom_vjp/mlp_bwd_impl='pallas' require "
+                "fused_gate_up=True (the hand-written backward targets the "
+                "fused w_gu layout)"
             )
-        if "w_gu" in mlp and cfg.mlp_custom_vjp:
-            from ditl_tpu.ops.quant import is_quantized_leaf
-
+        if "w_gu" in mlp and use_custom_vjp:
             if is_quantized_leaf(mlp["w_gu"]) or is_quantized_leaf(mlp["w_down"]):
                 raise ValueError(
-                    "mlp_custom_vjp needs plain float weights (quantized "
-                    "serving never differentiates — leave it off)"
+                    "mlp_custom_vjp/mlp_bwd_impl need plain float weights "
+                    "(quantized serving never differentiates — leave it off)"
                 )
-            from ditl_tpu.ops.mlp import mlp_gu
+            from ditl_tpu.ops.mlp import mlp_block
 
-            mlp_out = mlp_gu(
+            mlp_out = mlp_block(
                 lambda t: _constrain(t, ("batch", "seq", "act_mlp"),
                                      mesh, rules),
                 h, mlp["w_gu"].astype(cd), mlp["w_down"].astype(cd),
+                bwd_impl=cfg.mlp_bwd_impl,
+                bwd_blocks=(cfg.mlp_bwd_block_n, cfg.mlp_bwd_block_f,
+                            cfg.mlp_bwd_block_d),
+                mesh=mesh, rules=rules,
             )
         else:
             if "w_gu" in mlp:

@@ -1,20 +1,26 @@
-"""Fused-gate|up MLP block with a hand-written VJP (r5 experiment).
+"""Fused-gate|up MLP block with a hand-written VJP and, per config, a
+Pallas fused-backward implementation.
 
 The r5 stop-gradient ablation (BASELINE.md, experiments/bwd_ablation.py)
 showed the MLP family's in-step weight-gradient GEMMs running at ~2x
 their isolated-peak rates — a property of XLA's backward SCHEDULE, not of
-the GEMM shapes. This module is the instrument against that: the whole
-block's backward (activation grads and BOTH weight grads) is emitted as
-ONE function with explicit einsum contractions — no autodiff-generated
-transposes, residuals chosen by hand (h, gate, up; ``inner`` recomputed
-elementwise like the "dots" remat policy would) — so XLA schedules the
-backward exactly as written.
+the GEMM shapes. The first instrument against that was this module's
+custom VJP: the whole block's backward (activation grads and BOTH weight
+grads) emitted as ONE function with explicit einsum contractions. The r5
+A/B came back a definitive null — XLA still owned tiling and interleaving
+— which is exactly what ``bwd_impl="pallas"`` now changes: the same
+backward emitted as hand-tiled Pallas kernels (ops/mlp_bwd.py), so the
+schedule is pinned by the grid, not chosen by XLA.
 
 Exactness: forward is bit-identical to the inline path (same ops); the
-backward matches autodiff to f32 test tolerance
-(tests/test_model.py::test_mlp_custom_vjp_matches_autodiff). Enabled per
-config via ``ModelConfig.mlp_custom_vjp`` (requires ``fused_gate_up``;
-plain float weights only — quantized serving never differentiates).
+backward matches autodiff to f32 test tolerance for BOTH implementations
+(tests/test_model.py::test_mlp_custom_vjp_matches_autodiff,
+tests/test_bwd_kernels.py). Enabled per config via
+``ModelConfig.mlp_custom_vjp`` (einsum spelling) /
+``ModelConfig.mlp_bwd_impl="pallas"`` (Pallas kernels; requires
+``fused_gate_up``; plain float weights only — quantized serving never
+differentiates). Shapes ops/mlp_bwd.supports rejects fall back to the
+einsum spelling; bench.py records the implementation that actually ran.
 """
 
 from __future__ import annotations
@@ -24,24 +30,17 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-__all__ = ["mlp_gu"]
+__all__ = ["mlp_gu", "mlp_block", "effective_bwd_impl"]
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(0,))
-def mlp_gu(constrain, h: jax.Array, w_gu: jax.Array,
-           w_down: jax.Array) -> jax.Array:
-    """SwiGLU MLP over the fused gate|up layout: ``h @ w_gu`` → split →
-    ``silu(gate)*up @ w_down``. Shapes: h (B,S,D), w_gu (D,2F),
-    w_down (F,D). ``constrain`` (static): sharding-hint callback applied
-    to the inner activation — mirrors the inline path's
-    ``_constrain(inner, act_mlp)`` so a mesh A/B isolates the backward
-    SPELLING, not sharding-propagation differences. Pass identity for
-    single-chip."""
-    out, _ = _fwd(constrain, h, w_gu, w_down)
+@partial(jax.custom_vjp, nondiff_argnums=(0, 4, 5, 6))
+def _mlp_gu(constrain, h: jax.Array, w_gu: jax.Array, w_down: jax.Array,
+            bwd_impl, bwd_blocks, interpret) -> jax.Array:
+    out, _ = _fwd(constrain, h, w_gu, w_down, bwd_impl, bwd_blocks, interpret)
     return out
 
 
-def _fwd(constrain, h, w_gu, w_down):
+def _fwd(constrain, h, w_gu, w_down, bwd_impl, bwd_blocks, interpret):
     gu = jnp.einsum("bsd,df->bsf", h, w_gu)
     gate, up = jnp.split(gu, 2, axis=-1)
     inner = constrain(jax.nn.silu(gate) * up)
@@ -49,8 +48,20 @@ def _fwd(constrain, h, w_gu, w_down):
     return out, (h, w_gu, w_down, gate, up)
 
 
-def _bwd(constrain, res, g):
+def _bwd(constrain, bwd_impl, bwd_blocks, interpret, res, g):
     h, w_gu, w_down, gate, up = res
+    if bwd_impl == "pallas":
+        from ditl_tpu.ops import mlp_bwd
+
+        b, s, d = h.shape
+        if mlp_bwd.supports(b * s, d, w_down.shape[0], bwd_blocks):
+            return mlp_bwd.fused_mlp_bwd(
+                h, w_gu, w_down, gate, up, g,
+                blocks=bwd_blocks, interpret=interpret,
+            )
+        # Shapes the kernel can't tile (tiny tests, odd dims): the einsum
+        # spelling below. bench.py re-derives this decision and records the
+        # implementation that actually ran, so an A/B stays attributable.
     # Recompute the cheap elementwise pieces (the "dots"-policy choice).
     sg = jax.nn.sigmoid(gate)
     silu_gate = gate * sg
@@ -67,4 +78,70 @@ def _bwd(constrain, res, g):
     return dh, d_w_gu, d_w_down
 
 
-mlp_gu.defvjp(_fwd, _bwd)
+_mlp_gu.defvjp(_fwd, _bwd)
+
+
+def mlp_gu(constrain, h: jax.Array, w_gu: jax.Array, w_down: jax.Array,
+           bwd_impl: str = "xla", bwd_blocks=(), interpret=None) -> jax.Array:
+    """SwiGLU MLP over the fused gate|up layout: ``h @ w_gu`` → split →
+    ``silu(gate)*up @ w_down``. Shapes: h (B,S,D), w_gu (D,2F),
+    w_down (F,D). ``constrain`` (static): sharding-hint callback applied
+    to the inner activation — mirrors the inline path's
+    ``_constrain(inner, act_mlp)`` so a mesh A/B isolates the backward
+    SPELLING, not sharding-propagation differences. Pass identity for
+    single-chip. ``bwd_impl`` selects the backward: "xla" (explicit
+    einsums, scheduled by XLA) or "pallas" (ops/mlp_bwd.py kernels;
+    ``bwd_blocks`` = (block_n, block_f, block_d), 0/empty = defaults)."""
+    return _mlp_gu(constrain, h, w_gu, w_down, bwd_impl,
+                   tuple(bwd_blocks or ()), interpret)
+
+
+def _identity(t):
+    return t
+
+
+def effective_bwd_impl(bwd_impl: str, b: int, s: int, d: int, f: int,
+                       blocks=(), mesh=None, rules=None) -> str:
+    """The backward implementation ``mlp_block`` will ACTUALLY run for these
+    shapes — shared gate logic in parallel/sharding.pallas_bwd_effective,
+    bound to this op's shape predicate; bench.py records the same call, so
+    an A/B can never attribute a delta to a kernel that fell back."""
+    from ditl_tpu.ops import mlp_bwd
+    from ditl_tpu.parallel.sharding import pallas_bwd_effective
+
+    return pallas_bwd_effective(bwd_impl, b, s, d, f, blocks, mesh, rules,
+                                mlp_bwd.supports)
+
+
+def mlp_block(constrain, h: jax.Array, w_gu: jax.Array, w_down: jax.Array,
+              *, bwd_impl: str = "xla", bwd_blocks=(), mesh=None,
+              rules=None) -> jax.Array:
+    """Mesh-aware dispatch for the custom-VJP MLP block (models/llama.py).
+
+    Pallas calls carry no GSPMD partitioning rules, so under a mesh the
+    Pallas-backward variant is shard_map'ed over the batch axes with
+    replicated weights — shard_map's transpose inserts the psum that turns
+    per-shard weight grads into the global ones (mirrors
+    ops/attention.py's flash dispatch). Meshes that don't divide the batch,
+    or sequence-sharded activations, keep the GSPMD-partitionable einsum
+    backward instead (the constrain hint preserves the activation
+    sharding A/Bs rely on)."""
+    b, s, d = h.shape
+    eff = effective_bwd_impl(bwd_impl, b, s, d, w_down.shape[0], bwd_blocks,
+                             mesh, rules)
+    if eff != "pallas" or mesh is None:
+        return mlp_gu(constrain, h, w_gu, w_down, eff, bwd_blocks)
+    from ditl_tpu.parallel.sharding import DEFAULT_RULES, logical_to_spec
+    from ditl_tpu.utils.compat import shard_map
+
+    rules = rules if rules is not None else DEFAULT_RULES
+    h_spec = logical_to_spec(("batch", None, None), rules)
+    w_spec = logical_to_spec((None, None), rules)
+
+    def local(h_, wgu_, wdn_):
+        return mlp_gu(_identity, h_, wgu_, wdn_, "pallas", bwd_blocks)
+
+    return shard_map(
+        local, mesh=mesh, in_specs=(h_spec, w_spec, w_spec),
+        out_specs=h_spec, check_vma=False,
+    )(h, w_gu, w_down)

@@ -32,7 +32,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = [
     "DEFAULT_RULES", "logical_to_spec", "spec_tree", "named_sharding_tree",
-    "mesh_axes_size", "seq_shards",
+    "mesh_axes_size", "seq_shards", "pallas_batch_shards",
+    "pallas_bwd_effective",
 ]
 
 
@@ -56,6 +57,46 @@ def seq_shards(mesh, rules=None) -> int:
         return 1
     r = rules if rules is not None else DEFAULT_RULES
     return mesh_axes_size(mesh, r.get("cache_seq"))
+
+
+def pallas_batch_shards(mesh, rules, batch: int) -> int | None:
+    """Shard count over the batch axes for shard_map'ing a Pallas op whose
+    weights stay replicated, or None when this mesh cannot host it:
+    sequence-sharded activations, batch not divisible by the batch axes,
+    or TENSOR parallelism in use — TP shards the very weights the wrapper
+    would replicate, so running the kernel would silently de-shard TP's
+    compute (tensor-x redundant FLOPs) while looking like a kernel A/B.
+    (FSDP-sharded weights are fine: FSDP all-gathers weights per use
+    anyway, so replication inside the island matches its cost model.)
+    ONE definition shared by the backward-kernel seams (ops/mlp.py,
+    ops/projection.py) and bench.py's ``bwd_impl`` record, so the dispatch
+    and its attribution can never drift apart."""
+    if mesh is None:
+        return 1
+    r = rules if rules is not None else DEFAULT_RULES
+    if mesh_axes_size(mesh, r.get("seq")) > 1:
+        return None
+    if max(mesh_axes_size(mesh, r.get("heads")),
+           mesh_axes_size(mesh, r.get("mlp"))) > 1:
+        return None
+    dp = mesh_axes_size(mesh, r.get("batch"))
+    return None if batch % dp else dp
+
+
+def pallas_bwd_effective(bwd_impl: str, batch: int, seq: int, d: int, f: int,
+                         blocks, mesh, rules, supports_fn) -> str:
+    """The backward implementation a Pallas-seamed op will ACTUALLY run —
+    the mesh gate above plus the op's own shape predicate on the per-shard
+    token count. Shared by ops/mlp.py and ops/projection.py (and through
+    them bench.py's ``bwd_impl`` field) so the two seams cannot diverge."""
+    if bwd_impl != "pallas":
+        return bwd_impl
+    shard = pallas_batch_shards(mesh, rules, batch)
+    if shard is None:
+        return "xla"
+    return "pallas" if supports_fn(
+        (batch // shard) * seq, d, f, tuple(blocks or ())
+    ) else "xla"
 
 # logical axis -> mesh axis (or tuple of mesh axes, or None = replicated)
 DEFAULT_RULES: dict[str, Any] = {

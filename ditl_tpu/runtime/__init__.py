@@ -1,5 +1,6 @@
 from ditl_tpu.runtime.distributed import (  # noqa: F401
     barrier,
+    enable_compile_cache,
     init_runtime,
     is_coordinator,
     shutdown_runtime,

@@ -62,6 +62,42 @@ def simulate_devices(n: int) -> None:
         pass
 
 
+def enable_compile_cache(path: str, *, force: bool = False) -> bool:
+    """Point JAX's persistent compilation cache at ``path`` (idempotent;
+    VERDICT r5 item 9: compile+first-window is 85.6 s per session and pays
+    on every restart, drill, and bench run — the cache amortizes it to one
+    cold run per program).
+
+    The thresholds are dropped to zero so every program is cached — the
+    big training step is one entry; the small host-side programs cost
+    nothing. Returns True when the cache was enabled. On CPU the cache is
+    only honored for single-device processes unless ``force``: this
+    jaxlib's XLA:CPU intermittently aborts (SIGABRT) when deserializing
+    cached executables under the multi-device host platform (the 8-device
+    test sim — see tests/conftest.py and docs/troubleshooting.md §20)."""
+    if not path:
+        return False
+    import jax
+
+    if (not force and jax.default_backend() == "cpu"
+            and (jax.local_device_count() > 1 or jax.process_count() > 1)):
+        logger.debug(
+            "compile cache skipped: multi-device/multi-process CPU host "
+            "platform (known-bad executable deserialization in this jaxlib "
+            "— worker SIGSEGV/SIGABRT in the pod drills)"
+        )
+        return False
+    full = os.path.abspath(os.path.expanduser(path))
+    os.makedirs(full, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", full)
+    # Cache everything: the default thresholds skip exactly the small
+    # programs whose re-compiles add up across drills and restarts.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    logger.info("persistent compilation cache at %s", full)
+    return True
+
+
 def init_runtime(config: RuntimeConfig | None = None) -> None:
     """Bring up the distributed runtime (idempotent).
 
@@ -100,6 +136,8 @@ def init_runtime(config: RuntimeConfig | None = None) -> None:
         )
         _active_coordinator = config.coordinator_address
     setup_logging(config.log_level)
+    if config.compile_cache_dir:
+        enable_compile_cache(config.compile_cache_dir)
     if config.profiler_port > 0 and jax.process_index() == 0:
         jax.profiler.start_server(config.profiler_port)
         logger.info("jax.profiler server on port %d", config.profiler_port)

@@ -15,6 +15,8 @@ import pytest
 from ditl_tpu.ops.attention import _xla_attention
 from ditl_tpu.ops.flash_attention import flash_attention, supports
 
+pytestmark = pytest.mark.pallas
+
 
 def _make_qkv(key, b, s, h, kv, d, dtype=jnp.float32):
     kq, kk, kv_ = jax.random.split(key, 3)

@@ -19,6 +19,8 @@ from ditl_tpu.infer.engine import GenerateConfig, Generator
 from ditl_tpu.infer.paged_cache import PageAllocator, block_keys
 from ditl_tpu.models import llama
 
+pytestmark = pytest.mark.pallas
+
 
 @pytest.fixture(scope="module")
 def tiny_setup():
