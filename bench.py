@@ -468,6 +468,11 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             times.append(time.perf_counter() - t)
         dt = statistics.median(times)
         extra = {}
+        # Telemetry snapshot (ISSUE 3 satellite): the engine's cumulative
+        # serving metrics — TTFT/TPOT/e2e histogram stats and the
+        # operational counters — ride the bench JSON so BENCH_r*.json rows
+        # carry latency attribution, not just throughput.
+        extra["telemetry"] = eng.metrics.summary()
         if guided:
             extra["guided"] = guided
         if speculative:
@@ -588,6 +593,13 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
     from ditl_tpu.train.state import create_train_state
     from ditl_tpu.train.step import make_multi_step
 
+    from ditl_tpu.telemetry import GoodputTracker
+
+    # Goodput accounting for the bench itself (ISSUE 3 satellite): the same
+    # bucket convention as the trainer, so BENCH_r*.json rows say where the
+    # bench's wall clock went (compile vs data staging vs timed steps).
+    tracker = GoodputTracker()
+    tracker.start()
     if enable_compile_cache(compile_cache_dir):
         print(f"bench: persistent compile cache at {compile_cache_dir}",
               file=sys.stderr)
@@ -649,20 +661,25 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
     loss_start = float(metrics["loss"][0])
     float(metrics["loss"][-1])  # full host sync (block_until_ready alone does
     # not guarantee completion through remote-device transports)
+    tracker.add("compile", time.perf_counter() - t0)
     print(f"bench: compile+first window {time.perf_counter() - t0:.1f}s "
           f"({params_m:.1f}M params)", file=sys.stderr)
 
     # Pre-stage every window on device before timing: distinct data per step
     # stays honest, while the host->device copy is excluded — the trainer's
     # prefetch pipeline (data/loader.py) overlaps it with compute in real runs.
-    staged = [make_global_batch(mesh, window(i)) for i in range(1, n_windows + 1)]
-    jax.block_until_ready(staged)
+    with tracker.span("data_wait"):
+        staged = [make_global_batch(mesh, window(i))
+                  for i in range(1, n_windows + 1)]
+        jax.block_until_ready(staged)
     times = []
     for stacked in staged:
         t = time.perf_counter()
         state, metrics = multi(state, stacked)
         float(metrics["loss"][-1])  # sync
-        times.append((time.perf_counter() - t) / chunk)
+        dt_w = time.perf_counter() - t
+        tracker.add_step(dt_w, chunk)
+        times.append(dt_w / chunk)
     p50 = statistics.median(times)
     final_loss = float(metrics["loss"][-1])
     tokens_per_step = batch * seq
@@ -698,6 +715,10 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
         # to the einsum spelling on untileable shapes) — keeps
         # round-over-round vs_baseline attributable (ISSUE 2 satellite).
         "bwd_impl": _effective_bwd_impls(cfg, batch, seq, mesh),
+        # Phase attribution (ISSUE 3 satellite): where the bench's own wall
+        # clock went — conservation-checked buckets, same convention as the
+        # trainer's goodput report.
+        "goodput": tracker.report(),
     }
     if swept:
         result["swept"] = {

@@ -374,6 +374,14 @@ class TrainConfig:
     # boundary reads as a stall.
     heartbeat_dir: str = ""
     heartbeat_timeout_s: float = 0.0
+    # Telemetry event journal (ditl_tpu/telemetry/journal.py): each process
+    # appends typed lifecycle/progress events to
+    # {telemetry_dir}/events-worker-{process_index}.jsonl, and the elastic
+    # pod controller adds its own events-controller.jsonl plus a merged
+    # pod_timeline.jsonl at the end of a supervised run. Also the source for
+    # restart lost-work attribution in the goodput report. "" => no journal
+    # (goodput/phase accounting stays on; it needs no files).
+    telemetry_dir: str = ""
 
     def __post_init__(self):
         if self.heartbeat_timeout_s > 0 and not self.heartbeat_dir:

@@ -50,6 +50,7 @@ multi-query paged-attention kernel) and int8 KV.
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -63,6 +64,7 @@ from ditl_tpu.infer.cache import init_cache
 from ditl_tpu.infer.engine import GenerateConfig, _next_pow2
 from ditl_tpu.infer.sampling import sample_logits
 from ditl_tpu.models import llama
+from ditl_tpu.telemetry.serving import ServingMetrics
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -239,6 +241,13 @@ class Request:
     lp_token: list[float] = field(default_factory=list)
     lp_top_ids: list[list[int]] = field(default_factory=list)
     lp_top: list[list[float]] = field(default_factory=list)
+    # Telemetry timestamps (time.monotonic; 0.0 = not yet): submit, slot
+    # admission, first harvested token, last harvest. Host wall clocks only
+    # — the latency histograms (telemetry/serving.py) are built from these.
+    t_submit: float = 0.0
+    t_admitted: float = 0.0
+    t_first: float = 0.0
+    t_last_emit: float = 0.0
 
 
 class ContinuousEngine:
@@ -277,6 +286,7 @@ class ContinuousEngine:
         pipeline_ticks: bool = False,
         admission: str = "reserve",
         thrash_window: int = 32,
+        metrics: ServingMetrics | None = None,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -347,6 +357,11 @@ class ContinuousEngine:
         self.params = params
         self.cfg = model_cfg
         self.tokenizer = tokenizer
+        # Serving telemetry (telemetry/serving.py): per-request latency
+        # histograms + operational counters, recorded on the host scheduler
+        # path only (zero device syncs). Pass a shared bundle to aggregate
+        # across engines; by default each engine owns its own.
+        self.metrics = metrics if metrics is not None else ServingMetrics()
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if decode_chunk < 1:
@@ -1808,6 +1823,7 @@ class ContinuousEngine:
         requires the engine constructed with ``fsm_capacity > 0``."""
         gen = self.gen
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
+            self.metrics.queue_full.inc()
             raise QueueFullError(
                 f"admission queue full ({self.max_queue} waiting requests)"
             )
@@ -1875,8 +1891,10 @@ class ContinuousEngine:
             logprobs=logprobs,
             adapter_id=adapter_id or 0,
             fsm_start=fsm_start,
+            t_submit=time.monotonic(),
         )
         self._next_id += 1
+        self.metrics.requests.inc()
         self._queue.append(req)
         return req.req_id
 
@@ -2235,6 +2253,7 @@ class ContinuousEngine:
                 self.allocator.release(pid)
             return False
         self._queue.popleft()
+        self._note_admitted(req)
         pages = matched + fresh
         self._slot_pages[slot] = pages
         self._table[slot, :] = 0
@@ -2295,6 +2314,7 @@ class ContinuousEngine:
                 self.allocator.release(pid)
             return False
         self._queue.popleft()
+        self._note_admitted(req)  # no-op for an already-admitted resume
         pages = matched + fresh
         self._slot_pages[slot] = pages
         self._table[slot, :] = 0
@@ -2386,6 +2406,7 @@ class ContinuousEngine:
             self._free_slot_pages(slot)
             self._queue.appendleft(req)
             self.preemptions += 1
+            self.metrics.preemptions.inc()
             logger.info(
                 "preempted mid-prefill request %d; requeued fresh", req.req_id
             )
@@ -2404,6 +2425,7 @@ class ContinuousEngine:
         self._free_slot_pages(slot)
         self._queue.appendleft(req)
         self.preemptions += 1
+        self.metrics.preemptions.inc()
         logger.info(
             "preempted request %d (%d tokens in); pages reclaimed",
             req.req_id, len(req.tokens),
@@ -2433,6 +2455,7 @@ class ContinuousEngine:
             if not self._degraded and ratio > self._thrash_engage:
                 self._degraded = True
                 self.admission_degrades += 1
+                self.metrics.admission_degrades.inc()
                 logger.info(
                     "optimistic admission degraded to worst-case reservation"
                     " (resume-prefill/generated = %.2f over %d ticks)",
@@ -2480,6 +2503,17 @@ class ContinuousEngine:
                 self._slot_pages[slot].extend(fresh)
                 self._table_dirty = True
 
+    def _note_admitted(self, req: Request) -> None:
+        """Telemetry at queue -> slot admission. A preemption-resume is not
+        a second admission (queue wait is measured once, submit -> first
+        slot)."""
+        if req.t_admitted:
+            return
+        req.t_admitted = time.monotonic()
+        self.metrics.admitted.inc()
+        if req.t_submit:  # directly-constructed Requests carry no stamp
+            self.metrics.queue_wait.observe(req.t_admitted - req.t_submit)
+
     def _admit(self) -> None:
         for slot in range(self.n_slots):
             if self._slots[slot] is not None or not self._queue:
@@ -2491,6 +2525,7 @@ class ContinuousEngine:
                     break
                 continue
             req = self._queue.popleft()
+            self._note_admitted(req)
             slot_key = jax.random.key(req.seed)
             slot_key, sub = jax.random.split(slot_key)
             req.slot = slot
@@ -2534,6 +2569,7 @@ class ContinuousEngine:
         eos, pad = self.tokenizer.eos_id, self.tokenizer.pad_id
         if snapshot is None:
             snapshot = self._snapshot_slots()
+        t_now = time.monotonic()  # one clock read per harvest, shared below
         for slot, (req, was_prefilling) in enumerate(snapshot):
             if req is None or was_prefilling:
                 # A still-prefilling slot is parked: its decode-row output is
@@ -2562,6 +2598,25 @@ class ContinuousEngine:
                 req.finished = True
             if self.cache_mode == "paged":
                 self._win_gen_tokens += len(fresh)  # thrash-guard accounting
+            if fresh:
+                m = self.metrics
+                m.tokens_generated.inc(len(fresh))
+                if req.fsm_start > 0:
+                    # Every one of these tokens decoded under the FSM mask.
+                    m.grammar_masked.inc(len(fresh))
+                if req.t_first == 0.0:
+                    req.t_first = t_now
+                    if req.t_submit:
+                        m.ttft.observe(t_now - req.t_submit)
+                elif req.t_last_emit:
+                    # TPOT: this harvest interval amortized over the chunk's
+                    # tokens, observed once per token. The first chunk is
+                    # excluded (its interval is prefill-dominated — that is
+                    # TTFT's job).
+                    m.decode_token.observe(
+                        (t_now - req.t_last_emit) / len(fresh), n=len(fresh)
+                    )
+                req.t_last_emit = t_now
             if req.stream is not None and fresh:
                 if req.logprobs is not None and lp is not None:
                     # Streamed logprobs ride the chunk: the entries for the
@@ -2577,6 +2632,9 @@ class ContinuousEngine:
                 else:
                     req.stream.put(fresh)
             if req.finished:
+                self.metrics.completed.inc()
+                if req.t_submit:
+                    self.metrics.e2e.observe(t_now - req.t_submit)
                 if req.stream is not None:
                     req.stream.put(None)
                 self._completed[req.req_id] = req
@@ -2832,6 +2890,16 @@ class ContinuousEngine:
             req.spec_tokens += int(counts[slot])
             req.spec_forwards += int(rr[slot])
             if rr[slot] > 0:
+                # Drafted-token accounting: each verify round emits its
+                # accepted draft prefix + one bonus/corrective token, so
+                # accepted drafts = emitted - rounds (the bonus is ordinary
+                # decode output, not a draft); the round's remaining spec_k
+                # drafts were rejected. Clamped: a row hitting its token
+                # limit mid-round can trim emissions below the identity.
+                accepted = max(0, int(counts[slot]) - int(rr[slot]))
+                drafted = int(rr[slot]) * self.spec_k
+                self.metrics.spec_accepted.inc(accepted)
+                self.metrics.spec_rejected.inc(max(0, drafted - accepted))
                 accs.append(counts[slot] / rr[slot])
         if accs:
             mean = float(np.mean(accs))
@@ -3170,6 +3238,11 @@ class ThreadedEngine:
 
     def stats(self) -> dict:
         return self._engine.stats()
+
+    @property
+    def metrics(self) -> ServingMetrics:
+        """The engine's telemetry bundle (rendered by /metrics)."""
+        return self._engine.metrics
 
     @property
     def queue_full(self) -> bool:

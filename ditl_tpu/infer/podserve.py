@@ -437,6 +437,13 @@ class PodContinuousDriver:
         return eng_stats
 
     @property
+    def metrics(self):
+        """Coordinator-replica telemetry (telemetry/serving.py) for the
+        /metrics route — every replica ticks identical scheduler state, so
+        process 0's counters ARE the pod's."""
+        return self._engine.metrics
+
+    @property
     def queue_full(self) -> bool:
         # Lock-free on purpose: _stage calls this while holding _cond (the
         # same non-reentrant lock), and the check is best-effort anyway —
@@ -614,6 +621,11 @@ class PodContinuousDriver:
             if self._stop:
                 raise RuntimeError("pod serving stopped") from self._error
             if self.queue_full:
+                # The driver-level rejection bypasses engine.submit (the
+                # other queue_full.inc site) — count it here or pod-mode
+                # overload would read 0 on the 429-rate alert the
+                # troubleshooting doc tells operators to build.
+                self._engine.metrics.queue_full.inc()
                 raise QueueFullError("admission queue full (pod)")
             self._staged.append((
                 prompt,

@@ -35,6 +35,7 @@ from ditl_tpu.config import ModelConfig
 from ditl_tpu.data.tokenizer import Tokenizer
 from ditl_tpu.infer.continuous import BadRequestError, QueueFullError
 from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.telemetry.serving import ServingMetrics
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -132,6 +133,10 @@ class _Handler(BaseHTTPRequestHandler):
     grammar_cache = None  # guided decoding: spec-key -> CompiledGrammar LRU
     grammar_lock: threading.Lock = None
     embed_cache = None  # /v1/embeddings: (batch, plen) -> jitted program LRU
+    # Telemetry bundle (telemetry/serving.py): the continuous engine's own
+    # when one is serving (it records queue-wait/TTFT/TPOT on its scheduler
+    # ticks), else a server-owned bundle the lock-step path records into.
+    serving_metrics: ServingMetrics = None
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
@@ -186,12 +191,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
     def _metrics(self) -> None:
-        """Prometheus text exposition of the same host-only state /v1/stats
-        serves (no device sync): numeric leaves become
-        ``ditl_serving_<path>`` gauges, nested dicts flatten with ``_``.
-        Lets a standard scrape-based stack watch slot occupancy, queue
-        depth, page pool, speculation acceptance, and guided-table usage
-        without custom glue."""
+        """Prometheus text exposition (no device sync), two sections:
+
+        1. The telemetry registry (telemetry/serving.py): REAL cumulative
+           series — latency histograms (queue-wait, TTFT, per-token decode,
+           e2e) as ``_bucket``/``_sum``/``_count`` triples and monotonic
+           ``_total`` counters (admissions, 429s, preemptions, degrade
+           windows, grammar-masked tokens, speculative accept/reject).
+        2. The /v1/stats snapshot flattened to ``ditl_serving_<path>``
+           gauges (slot occupancy, queue depth, page pool, acceptance EMA)
+           — point-in-time state, kept as gauges on purpose."""
         stats: dict = {}
         eng = self._engine_for_stats()
         if eng is not None:
@@ -209,11 +218,21 @@ class _Handler(BaseHTTPRequestHandler):
                 stats["lockstep_speculative_acceptance"] = round(acc, 3)
 
         lines: list[str] = []
+        reserved: set[str] = set()
+        if self.serving_metrics is not None:
+            lines.extend(self.serving_metrics.render().splitlines())
+            # A flattened stats gauge must not shadow a registry metric
+            # (e.g. the lifetime "preemptions" count, now a real _total
+            # counter) — exposing both a `x` gauge and an `x_total` counter
+            # for the same fact invites dashboards built on the wrong one.
+            reserved = set(self.serving_metrics.registry._metrics)
 
         def emit(prefix: str, obj) -> None:
             if isinstance(obj, dict):
                 for k, v in obj.items():
                     emit(f"{prefix}_{k}" if prefix else str(k), v)
+            elif f"ditl_serving_{prefix}" in reserved:
+                return
             elif isinstance(obj, bool):
                 lines.append(f"# TYPE ditl_serving_{prefix} gauge")
                 lines.append(f"ditl_serving_{prefix} {int(obj)}")
@@ -279,6 +298,20 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"prompt": tok.decode(ids)})
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _observe_lockstep(self, t0: float, n_gen: int) -> None:
+        """Telemetry for requests the LOCK-STEP path served (the continuous
+        engine records its own on scheduler ticks): end-to-end latency plus
+        the request/completion/token counters. Queue-wait/TTFT/TPOT have no
+        lock-step analog — the device lock serializes whole requests."""
+        m = self.serving_metrics
+        if m is None:
+            return
+        dt = time.time() - t0
+        m.requests.inc()
+        m.completed.inc()
+        m.tokens_generated.inc(n_gen)
+        m.e2e.observe(dt)
 
     def _lockstep_generate(self, prompt_ids, gen, adapter_ids) -> list:
         """One lock-step generation, speculatively when eligible: greedy,
@@ -423,6 +456,8 @@ class _Handler(BaseHTTPRequestHandler):
                 "total_tokens": n_prompt + total_out,
             },
         })
+        if not use_cont:
+            self._observe_lockstep(t0, total_out)
 
     def _embeddings(self, payload: dict) -> None:
         """OpenAI ``/v1/embeddings``: mean-pooled, L2-normalized final
@@ -600,7 +635,8 @@ class _Handler(BaseHTTPRequestHandler):
         ``lp_n`` (continuous engine only, validated by the caller): attach
         per-chunk logprobs with ``lp_n`` alternatives."""
         cmpl_id = f"cmpl-{uuid.uuid4().hex[:24]}"
-        created = int(time.time())
+        t_stream0 = time.time()
+        created = int(t_stream0)
         model = payload.get("model") or self.model_name
         kind = "chat.completion.chunk" if chat else "text_completion"
 
@@ -711,6 +747,7 @@ class _Handler(BaseHTTPRequestHandler):
                 prompt_ids = [tok.bos_id] + tok.encode(prompt)
                 out = self._lockstep_generate(prompt_ids, gen, adapter_ids)
                 n_gen = len(out)
+                self._observe_lockstep(t_stream0, n_gen)
                 text, hit = _apply_stop(tok.decode(out), tracker.stops)
                 if hit:
                     # Fold into the tracker so the finish computation reports
@@ -850,6 +887,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             t0 = time.time()
             logprobs_json = None
+            lockstep_served = False
             if has_lp:
                 # OpenAI logprobs: completions' `logprobs: N` = top-N; chat's
                 # `logprobs: true` + `top_logprobs: N`. N is clamped (OpenAI
@@ -918,6 +956,7 @@ class _Handler(BaseHTTPRequestHandler):
                         )
                     gen_ids = outs[0]
                     lp = lps[0]
+                    lockstep_served = True
                 # Apply stop truncation at TOKEN granularity before building
                 # the logprobs JSON: the entries must stay aligned with the
                 # returned text (keep whole tokens up to the stop cut).
@@ -1006,6 +1045,7 @@ class _Handler(BaseHTTPRequestHandler):
                 n_gen = len(out)
                 text, hit_stop = _apply_stop(tok.decode(out), stops)
                 n_prompt = len(prompt_ids)
+                lockstep_served = True
             # "length" = the GENERATED token count hit the budget (decoded
             # text round-trips are not token-count-preserving, so never
             # re-encode to decide this).
@@ -1041,6 +1081,8 @@ class _Handler(BaseHTTPRequestHandler):
                     },
                 },
             )
+            if lockstep_served:
+                self._observe_lockstep(t0, n_out)
             logger.info(
                 "served %s: %d prompt + %d completion tokens in %.2fs",
                 kind, n_prompt, n_out, time.time() - t0,
@@ -1094,6 +1136,12 @@ def make_server(
     lock-step requests — streaming and non-streaming — speculatively."""
     import collections
 
+    # One telemetry bundle per server: the continuous engine's own when one
+    # is serving (its scheduler records into it), else a fresh bundle the
+    # lock-step handler path records into. Either way /metrics renders it.
+    serving_metrics = getattr(threaded_engine, "metrics", None)
+    if serving_metrics is None:
+        serving_metrics = ServingMetrics()
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -1108,6 +1156,7 @@ def make_server(
             "grammar_cache": collections.OrderedDict(),
             "grammar_lock": threading.Lock(),
             "embed_cache": collections.OrderedDict(),
+            "serving_metrics": serving_metrics,
         },
     )
     return ThreadingHTTPServer((host, port), handler)

@@ -244,6 +244,9 @@ def run_process_supervised(argv: list[str], num_workers: int = 1) -> int:
         # JSON owns stdout).
         log=lambda msg: print(msg, file=sys.stderr, flush=True),
         on_restart=on_restart,
+        # The workers journal under the same dir (train.telemetry_dir), so
+        # the controller's end-of-run merge yields one ordered pod timeline.
+        journal_dir=config.train.telemetry_dir,
     )
     result = controller.run()
     if not result.ok:

@@ -39,6 +39,11 @@ import subprocess
 import time
 from typing import Callable, Sequence
 
+from ditl_tpu.telemetry import (
+    EventJournal,
+    controller_journal_path,
+    write_pod_timeline,
+)
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -186,6 +191,7 @@ class PodController:
         port_factory: Callable[[], int] = free_port,
         log: Callable[[str], None] | None = None,
         on_restart: Callable[[int, int, int], None] | None = None,
+        journal_dir: str = "",
     ):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -228,6 +234,20 @@ class PodController:
         self._procs: list[subprocess.Popen] = []
         self._spawned_at = 0.0
         self._failure_rc: int | None = None
+        # Cross-process event journal (telemetry/journal.py): the controller
+        # appends its lifecycle events to events-controller.jsonl and merges
+        # every participant's journal into pod_timeline.jsonl when the run
+        # ends — the ordered answer to "what happened when the worker died".
+        self.journal_dir = journal_dir
+        self._journal: EventJournal | None = (
+            EventJournal(controller_journal_path(journal_dir),
+                         source="controller")
+            if journal_dir else None
+        )
+
+    def _jevent(self, event: str, **attrs) -> None:
+        if self._journal is not None:
+            self._journal.event(event, **attrs)
 
     # -- state machine ------------------------------------------------------
 
@@ -247,6 +267,8 @@ class PodController:
             f"generation {attempt}: {self.num_workers} workers, "
             f"coordinator port {port}",
         )
+        self._jevent("pod.spawn", generation=attempt, port=port,
+                     num_workers=self.num_workers)
         if self.heartbeat_dir:
             # Stale heartbeats from the previous generation must not mask a
             # worker that dies before its first step. Wildcard slots clear
@@ -283,6 +305,7 @@ class PodController:
         handlers, but SIGTERM's default disposition still terminates it; the
         SIGKILL backstop covers processes that installed handlers."""
         self._transition(PodState.STOPPING, why)
+        self._jevent("pod.teardown", why=why)
         for p in self._procs:
             if p.poll() is None:
                 try:
@@ -405,6 +428,8 @@ class PodController:
                     return self._result()
                 failure = f"worker {i} died ({_describe_rc(rc)})"
                 self._failure_rc = rc
+                self._jevent("pod.worker_died", worker=i, rc=rc,
+                             cause=_describe_rc(rc))
             else:
                 stale = self._stale_workers()
                 if stale and any(r == 0 for r in rcs):
@@ -430,6 +455,8 @@ class PodController:
                     # No exit code exists for a stall; don't let the
                     # teardown's own SIGTERM codes masquerade as one.
                     self._failure_rc = 1
+                    self._jevent("pod.heartbeat_stale", worker=stale[0],
+                                 timeout_s=self.heartbeat_timeout_s)
             if failure is None:
                 if timed_out:
                     # Like the stale branch: no worker failed — don't let
@@ -462,13 +489,15 @@ class PodController:
                 f"(restart {self.restarts}/{self.max_pod_restarts}, "
                 "bumping coordinator port)",
             )
+            self._jevent("pod.relaunch", restart=self.restarts,
+                         max_restarts=self.max_pod_restarts, why=failure)
             if self.on_restart is not None:
                 self.on_restart(self._failure_rc or 1, self.restarts,
                                 self.max_pod_restarts)
             self._spawn(attempt)
 
     def _result(self) -> PodResult:
-        return PodResult(
+        result = PodResult(
             state=self.state,
             restarts=self.restarts,
             returncodes=[p.poll() for p in self._procs],
@@ -476,3 +505,15 @@ class PodController:
             transitions=list(self.transitions),
             failure_rc=self._failure_rc,
         )
+        if self._journal is not None:
+            self._jevent(
+                "pod.done" if result.ok else "pod.failed",
+                restarts=self.restarts, returncode=result.returncode,
+            )
+            self._journal.close()
+            self._journal = None
+            # Merge every participant's journal (controller + workers across
+            # all generations) into the ordered pod timeline.
+            path = write_pod_timeline(self.journal_dir)
+            self._log(f"pod-controller: merged pod timeline at {path}")
+        return result

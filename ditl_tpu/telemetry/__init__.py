@@ -1,0 +1,47 @@
+"""Unified telemetry subsystem (ISSUE 3): process-local metrics registry
+(registry.py), serving instrument bundle (serving.py), goodput/badput
+accounting (goodput.py), and the cross-process JSONL event journal
+(journal.py). Host-only by design — importing this package never touches
+jax, and no instrument accepts a device value."""
+
+from ditl_tpu.telemetry.goodput import (
+    BADPUT_BUCKETS,
+    GoodputTracker,
+    lost_work_from_journal,
+)
+from ditl_tpu.telemetry.journal import (
+    EventJournal,
+    controller_journal_path,
+    merge_journals,
+    read_journal,
+    worker_journal_path,
+    write_pod_timeline,
+)
+from ditl_tpu.telemetry.registry import (
+    LATENCY_BUCKETS_S,
+    TOKEN_LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from ditl_tpu.telemetry.serving import ServingMetrics
+
+__all__ = [
+    "BADPUT_BUCKETS",
+    "Counter",
+    "EventJournal",
+    "Gauge",
+    "GoodputTracker",
+    "Histogram",
+    "LATENCY_BUCKETS_S",
+    "MetricsRegistry",
+    "ServingMetrics",
+    "TOKEN_LATENCY_BUCKETS_S",
+    "controller_journal_path",
+    "lost_work_from_journal",
+    "merge_journals",
+    "read_journal",
+    "worker_journal_path",
+    "write_pod_timeline",
+]

@@ -1,0 +1,132 @@
+"""Goodput / badput accounting (ISSUE 3 tentpole leg 2).
+
+Buckets a run's total wall time into where it actually went — the Google
+ML-goodput convention: **goodput** is the fraction of wall time spent in
+productive training steps; everything else is attributed **badput** (compile,
+data-wait, checkpoint save/restore, eval, profiler overhead, work lost to a
+restart) or ``other`` (the measured remainder: startup, teardown, untracked
+host work).
+
+Conservation is the design invariant: ``productive_step`` plus every badput
+bucket plus ``other`` equals total tracked wall time EXACTLY by construction
+(``other`` is the remainder), and the tier-1 test asserts the tracked buckets
+themselves (everything except ``other``) stay within the total — a span
+accounted twice would push the sum past it.
+
+All timers are host wall clocks; nothing here touches a device value, so
+always-on goodput tracking adds zero device syncs (the no-device-sync rule
+shared with telemetry/registry.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+__all__ = ["GoodputTracker", "BADPUT_BUCKETS", "lost_work_from_journal"]
+
+# Canonical bucket names (report keys are f"{name}_s"). "productive_step" is
+# the goodput bucket; the rest are badput. "other" is computed, not added.
+BADPUT_BUCKETS = (
+    "startup",
+    "compile",
+    "data_wait",
+    "checkpoint_save",
+    "checkpoint_restore",
+    "eval",
+    "profiler",
+    "restart_lost_work",
+)
+
+
+class GoodputTracker:
+    """Accumulate wall-time buckets; ``report()`` closes the books.
+
+    Spans may not nest into the same wall time twice: the caller wraps
+    disjoint phases (the trainer's loop structure guarantees this — data
+    fetch, step window, checkpoint, eval are sequential on the host).
+    """
+
+    def __init__(self):
+        self._t0: float | None = None
+        self._t_end: float | None = None
+        self._buckets: dict[str, float] = {}
+        self.steps = 0
+
+    def start(self) -> None:
+        if self._t0 is None:
+            self._t0 = time.perf_counter()
+
+    def add(self, bucket: str, seconds: float) -> None:
+        if seconds < 0:
+            seconds = 0.0
+        self._buckets[bucket] = self._buckets.get(bucket, 0.0) + seconds
+
+    def add_step(self, seconds: float, n_steps: int = 1) -> None:
+        """One productive step window's wall time."""
+        self.add("productive_step", seconds)
+        self.steps += n_steps
+
+    @contextlib.contextmanager
+    def span(self, bucket: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(bucket, time.perf_counter() - t0)
+
+    def stop(self) -> None:
+        """Pin the total-wall endpoint (report() calls it implicitly once;
+        later report() calls reuse the same endpoint so summaries agree)."""
+        if self._t_end is None:
+            self._t_end = time.perf_counter()
+
+    def report(self) -> dict:
+        """Bucketed wall-time report. Keys: ``total_wall_s``, one
+        ``{bucket}_s`` per non-empty bucket, ``other_s`` (remainder),
+        ``goodput_fraction`` (productive / total), ``badput_fraction``
+        (attributed badput / total; ``other`` excluded so the two fractions
+        name ATTRIBUTED time only), and ``steps``."""
+        if self._t0 is None:
+            return {"total_wall_s": 0.0, "goodput_fraction": 0.0, "steps": 0}
+        self.stop()
+        total = max(self._t_end - self._t0, 1e-9)
+        tracked = sum(self._buckets.values())
+        productive = self._buckets.get("productive_step", 0.0)
+        out: dict = {"total_wall_s": round(total, 6), "steps": self.steps}
+        for name, v in sorted(self._buckets.items()):
+            out[f"{name}_s"] = round(v, 6)
+        # Remainder, floored at 0: tracked spans can (rarely) overshoot the
+        # total by timer granularity; conservation tests bound that at 1%.
+        out["other_s"] = round(max(0.0, total - tracked), 6)
+        out["goodput_fraction"] = round(productive / total, 4)
+        out["badput_fraction"] = round(
+            max(0.0, tracked - productive) / total, 4
+        )
+        return out
+
+
+def lost_work_from_journal(
+    records: list[dict], resume_step: int, before_ts: float
+) -> float:
+    """Wall-clock seconds of training lost to the restart we are resuming
+    from, computed from a previous generation's journal ``records``
+    (telemetry/journal.py): the span between the checkpoint save we are
+    resuming at and the last sign of life before ``before_ts`` (this
+    process's start). Returns 0.0 when the journal carries no usable pair —
+    lost work is then simply unattributed (``other``), never guessed."""
+    prior = [r for r in records if r["ts"] < before_ts]
+    if not prior:
+        return 0.0
+    save_ts = None
+    for r in prior:
+        if (
+            r["event"] == "checkpoint.save"
+            and isinstance(r.get("step"), int)
+            and r["step"] <= resume_step
+        ):
+            save_ts = r["ts"] if save_ts is None else max(save_ts, r["ts"])
+    if save_ts is None:
+        return 0.0
+    last_ts = max(r["ts"] for r in prior)
+    return max(0.0, last_ts - save_ts)

@@ -1,0 +1,140 @@
+"""Structured JSONL event journal (ISSUE 3 tentpole leg 3).
+
+Typed events and wall-clock spans, one JSON object per line, append-only and
+line-buffered so a SIGKILLed process loses at most the line it never wrote.
+Each elastic-pod participant writes its OWN file (``events-controller.jsonl``,
+``events-worker-N.jsonl``) — no cross-process locking, no torn lines — and
+the pod controller merges them into one time-ordered pod timeline at the end
+of a run, which is how "what happened, in order, when a worker died" becomes
+a readable artifact instead of interleaved stderr archaeology.
+
+Ordering: events are sorted by wall-clock ``ts`` with a per-file monotonic
+``seq`` tiebreak. Wall clocks are shared here (one host per pod in this
+repo's drills); cross-host skew would reorder only events closer together
+than the skew, and the per-source ``seq`` keeps each process's own story
+internally ordered regardless.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import glob
+import json
+import os
+import time
+
+__all__ = [
+    "EventJournal",
+    "controller_journal_path",
+    "worker_journal_path",
+    "read_journal",
+    "merge_journals",
+    "write_pod_timeline",
+]
+
+TIMELINE_FILENAME = "pod_timeline.jsonl"
+
+
+def controller_journal_path(directory: str) -> str:
+    return os.path.join(directory, "events-controller.jsonl")
+
+
+def worker_journal_path(directory: str, process_index: int) -> str:
+    return os.path.join(directory, f"events-worker-{process_index}.jsonl")
+
+
+class EventJournal:
+    """Append-only JSONL event writer for ONE process."""
+
+    def __init__(self, path: str, source: str = ""):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.source = source or os.path.basename(path).rsplit(".", 1)[0]
+        self._seq = 0
+        # Line-buffered append: one write per event, durable up to the last
+        # whole line even through SIGKILL.
+        self._fh = open(path, "a", buffering=1)
+
+    def event(self, event: str, **attrs) -> dict:
+        """Record one instantaneous event; returns the record written."""
+        rec = {
+            "ts": time.time(),
+            "seq": self._seq,
+            "source": self.source,
+            "pid": os.getpid(),
+            "event": event,
+            **attrs,
+        }
+        self._seq += 1
+        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        return rec
+
+    @contextlib.contextmanager
+    def span(self, event: str, **attrs):
+        """Wall-clock span: writes ONE line at exit with start ``ts`` and
+        measured ``dur_s`` (start-stamped so the merged timeline orders the
+        span where it began)."""
+        t0 = time.time()
+        try:
+            yield
+        finally:
+            rec = {
+                "ts": t0,
+                "seq": self._seq,
+                "source": self.source,
+                "pid": os.getpid(),
+                "event": event,
+                "dur_s": round(time.time() - t0, 6),
+                **attrs,
+            }
+            self._seq += 1
+            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+def read_journal(path: str) -> list[dict]:
+    """Parse one journal file; corrupt/truncated lines (a process died
+    mid-write on a non-line boundary) are skipped, never fatal."""
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and "ts" in rec and "event" in rec:
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def merge_journals(directory: str) -> list[dict]:
+    """All ``events-*.jsonl`` files in ``directory`` merged into one list
+    ordered by (ts, source, seq)."""
+    records: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(directory, "events-*.jsonl"))):
+        records.extend(read_journal(path))
+    records.sort(key=lambda r: (r["ts"], str(r.get("source", "")),
+                                r.get("seq", 0)))
+    return records
+
+
+def write_pod_timeline(directory: str) -> str:
+    """Merge every per-process journal in ``directory`` into
+    ``pod_timeline.jsonl`` (overwritten whole each call — the merge is
+    idempotent, and a partial previous merge must not prefix the new one).
+    Returns the timeline path."""
+    path = os.path.join(directory, TIMELINE_FILENAME)
+    records = merge_journals(directory)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,97 @@
+"""Serving-side telemetry bundle (ISSUE 3 tentpole leg 1).
+
+One object carrying every per-request instrument the serving stack records —
+latency histograms (queue-wait, TTFT, per-token decode, end-to-end) and the
+operational counters (admissions, 429s, preemptions, degrade windows,
+grammar-masked tokens, speculative accept/reject) — shared between
+``infer/continuous.ContinuousEngine`` (which records on its scheduler ticks)
+and ``infer/server.py`` (which records the lock-step path and renders
+``/metrics``).
+
+Semantics worth pinning (the vLLM-style contract, adapted to chunked ticks):
+
+- **queue wait**: submit -> the admission that moved the request into a slot.
+  A preemption-resume is NOT a second admission (the request never left the
+  user's perspective of "running").
+- **TTFT**: submit -> the harvest that delivered the first generated token to
+  the host. Harvests happen once per decode tick, so TTFT is quantized by the
+  tick (decode_chunk steps) — that IS when a streaming client can first see
+  the token, so the quantization is honest, not an artifact.
+- **per-token decode latency**: harvest-interval / tokens-in-chunk, observed
+  once per token of the chunk. The histogram's shape answers "TPOT p50/p99".
+- **grammar-masked tokens**: generated tokens whose request carried an FSM
+  constraint — every one of those decode steps paid the mask gather.
+- **speculative accepted/rejected**: accepted = drafted tokens the verify
+  forward kept; rejected = drafted tokens it threw away. The per-round bonus
+  token (emitted even at zero acceptance) is neither — it is ordinary decode
+  output, counted by ``tokens_generated``.
+
+All increments are host-side floats/ints the scheduler already holds — zero
+device syncs (registry.py's rule).
+"""
+
+from __future__ import annotations
+
+from ditl_tpu.telemetry.registry import (
+    LATENCY_BUCKETS_S,
+    MetricsRegistry,
+    TOKEN_LATENCY_BUCKETS_S,
+)
+
+__all__ = ["ServingMetrics"]
+
+PREFIX = "ditl_serving"
+
+
+class ServingMetrics:
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self.queue_wait = r.histogram(
+            f"{PREFIX}_request_queue_wait_seconds",
+            "submit -> slot admission", LATENCY_BUCKETS_S,
+        )
+        self.ttft = r.histogram(
+            f"{PREFIX}_request_ttft_seconds",
+            "submit -> first generated token harvested", LATENCY_BUCKETS_S,
+        )
+        self.decode_token = r.histogram(
+            f"{PREFIX}_decode_token_seconds",
+            "per-token decode latency (harvest interval / chunk tokens)",
+            TOKEN_LATENCY_BUCKETS_S,
+        )
+        self.e2e = r.histogram(
+            f"{PREFIX}_request_e2e_seconds",
+            "submit -> request finished", LATENCY_BUCKETS_S,
+        )
+        self.requests = r.counter(
+            f"{PREFIX}_requests", "requests accepted by submit")
+        self.admitted = r.counter(
+            f"{PREFIX}_requests_admitted", "requests admitted into a slot")
+        self.completed = r.counter(
+            f"{PREFIX}_requests_completed", "requests finished")
+        self.queue_full = r.counter(
+            f"{PREFIX}_queue_full", "submissions rejected QueueFull (HTTP 429)")
+        self.preemptions = r.counter(
+            f"{PREFIX}_preemptions",
+            "optimistic-admission preemptions (pages reclaimed mid-flight)")
+        self.admission_degrades = r.counter(
+            f"{PREFIX}_admission_degrade_windows",
+            "tick windows that engaged the anti-thrash admission degrade")
+        self.grammar_masked = r.counter(
+            f"{PREFIX}_grammar_masked_tokens",
+            "generated tokens decoded under an FSM grammar mask")
+        self.spec_accepted = r.counter(
+            f"{PREFIX}_spec_accepted_tokens",
+            "speculative drafted tokens accepted by verify forwards")
+        self.spec_rejected = r.counter(
+            f"{PREFIX}_spec_rejected_tokens",
+            "speculative drafted tokens rejected by verify forwards")
+        self.tokens_generated = r.counter(
+            f"{PREFIX}_tokens_generated", "tokens generated (all requests)")
+
+    def render(self) -> str:
+        return self.registry.render()
+
+    def summary(self) -> dict:
+        return self.registry.summary()

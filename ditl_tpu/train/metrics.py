@@ -5,7 +5,17 @@ The reference logs per-example prompt/response/label lines on rank 0 (ref
 train step, and the headline numbers are the BASELINE.json metrics:
 **tokens/sec/chip** and **step-time p50**. Device metrics arrive as jax.Arrays;
 they are only synced to host at ``log_every`` boundaries so the metric path
-never stalls the device pipeline.
+never stalls the device pipeline — and that boundary sync is ONE
+``jax.device_get`` over every pending step's metrics, not one transfer per
+step or per key.
+
+Per-step phase breakdown (ISSUE 3): each flushed JSONL row carries
+``data_wait_s`` (host time blocked on the data pipeline, passed in by the
+trainer) and ``dispatch_s`` (host wall inside the step call — dispatch is
+async, so this is host work, not device time); each flush records its own
+blocking-sync wall as ``sync_s`` on the row that triggered it. The summary
+totals the three, which is where "where did the wall clock go" starts before
+the goodput report (telemetry/goodput.py) finishes it.
 """
 
 from __future__ import annotations
@@ -31,7 +41,9 @@ class MetricsLogger:
         metrics_file: str = "",
     ):
         """``metrics_file``: optional coordinator-only JSONL scalar stream
-        (one ``{"step": ..., "loss": ..., ...}`` object per flush) — the
+        (one object per STEP WINDOW — every pending entry is written at each
+        flush, not just the newest; the flush used to drop all interior
+        steps of a log_every window, ISSUE 3 satellite) — the
         TensorBoard-scalar equivalent without a TF dependency; any dashboard
         can tail it."""
         import jax
@@ -41,37 +53,64 @@ class MetricsLogger:
         self.step_times: list[float] = []
         self.tokens_per_sec_chip: list[float] = []
         self._last_t: float | None = None
-        self._pending: list[tuple[int, Any, int]] = []  # (step, metrics, n_steps)
+        # (step, metrics, n_steps, dt, data_wait_s) per un-flushed window.
+        self._pending: list[tuple[int, Any, int, float | None, float]] = []
         self._metrics_fh = None
+        # Phase totals (host wall seconds) across the run.
+        self.data_wait_s = 0.0
+        self.dispatch_s = 0.0
+        self.sync_s = 0.0
         if metrics_file and is_coordinator():
             self._metrics_fh = open(metrics_file, "a", buffering=1)
 
     def start_step(self) -> None:
         self._last_t = time.perf_counter()
 
-    def end_step(self, step: int, device_metrics: Any, n_steps: int = 1) -> None:
+    def end_step(
+        self, step: int, device_metrics: Any, n_steps: int = 1,
+        data_wait_s: float = 0.0,
+    ) -> None:
         """Record wall time; stash device metrics without forcing a sync.
         ``n_steps > 1`` when one call ran a whole compiled step window
         (train/step.make_multi_step): wall time is divided per step, and
-        ``device_metrics['n_tokens']`` is expected to cover the window."""
+        ``device_metrics['n_tokens']`` is expected to cover the window.
+        ``data_wait_s``: host time spent waiting on the data pipeline for
+        this window (phase breakdown column)."""
         now = time.perf_counter()
+        dt = None
         if self._last_t is not None:
-            self.step_times.append((now - self._last_t) / max(1, n_steps))
+            dt = (now - self._last_t) / max(1, n_steps)
+            self.step_times.append(dt)
+            self.dispatch_s += now - self._last_t
         self._last_t = None
-        self._pending.append((step, device_metrics, max(1, n_steps)))
+        self.data_wait_s += data_wait_s
+        self._pending.append(
+            (step, device_metrics, max(1, n_steps), dt, data_wait_s)
+        )
         if step % self.log_every < n_steps:
             self.flush()
 
     def flush(self) -> None:
         if not self._pending:
             return
-        step, metrics, n_steps = self._pending[-1]
-        host = {k: float(v) for k, v in metrics.items()}  # device sync point
-        if self.step_times:
-            dt = self.step_times[-1]
+        import jax
+
+        # ONE blocking transfer for every pending window's metrics — the
+        # only device sync on the metrics path, and its wall time is the
+        # "device-blocked" phase (the host catching up to the async-
+        # dispatched step stream).
+        t0 = time.perf_counter()
+        host_all = jax.device_get([m for _, m, _, _, _ in self._pending])
+        sync_s = time.perf_counter() - t0
+        self.sync_s += sync_s
+        last_i = len(self._pending) - 1
+        for i, (step, _, n_steps, dt, data_wait_s) in enumerate(self._pending):
+            host = {k: float(v) for k, v in host_all[i].items()}
+            if dt is None:
+                continue
             tps_chip = host.get("n_tokens", 0.0) / (dt * n_steps) / self.n_chips
             self.tokens_per_sec_chip.append(tps_chip)
-            if is_coordinator():
+            if i == last_i and is_coordinator():
                 logger.info(
                     "step %d: loss=%.4f grad_norm=%.3f step_time=%.3fs "
                     "tokens/sec/chip=%.1f",
@@ -82,18 +121,19 @@ class MetricsLogger:
                     tps_chip,
                 )
             if self._metrics_fh is not None:
-                self._metrics_fh.write(
-                    json.dumps(
-                        {
-                            "step": step,
-                            "step_time_s": round(dt, 6),
-                            "tokens_per_sec_per_chip": round(tps_chip, 2),
-                            **{k: round(v, 6) for k, v in host.items()},
-                        },
-                        sort_keys=True,
-                    )
-                    + "\n"
-                )
+                row = {
+                    "step": step,
+                    "step_time_s": round(dt, 6),
+                    "tokens_per_sec_per_chip": round(tps_chip, 2),
+                    "data_wait_s": round(data_wait_s, 6),
+                    "dispatch_s": round(dt * n_steps, 6),
+                    **{k: round(v, 6) for k, v in host.items()},
+                }
+                if i == last_i:
+                    # The sync belongs to the flush, not any single step;
+                    # carried on the row that triggered it.
+                    row["sync_s"] = round(sync_s, 6)
+                self._metrics_fh.write(json.dumps(row, sort_keys=True) + "\n")
         self._pending.clear()
 
     def close(self) -> None:
@@ -101,6 +141,15 @@ class MetricsLogger:
         if self._metrics_fh is not None:
             self._metrics_fh.close()
             self._metrics_fh = None
+
+    def phase_totals(self) -> dict[str, float]:
+        """Cumulative host-wall phase breakdown: data-wait / host dispatch /
+        device-blocked (flush sync)."""
+        return {
+            "data_wait_s": round(self.data_wait_s, 6),
+            "dispatch_s": round(self.dispatch_s, 6),
+            "device_blocked_s": round(self.sync_s, 6),
+        }
 
     def summary(self) -> dict[str, float]:
         """BASELINE.md numbers. p50 over steps after compile warm-up."""
@@ -111,6 +160,7 @@ class MetricsLogger:
             out["step_time_p50_s"] = statistics.median(times)
         if tps:
             out["tokens_per_sec_per_chip_p50"] = statistics.median(tps)
+        out.update({f"phase_{k}": v for k, v in self.phase_totals().items()})
         return out
 
     def summary_json(self) -> str:

@@ -35,6 +35,13 @@ from ditl_tpu.runtime.distributed import (
 )
 from ditl_tpu.runtime.elastic import emit_heartbeat
 from ditl_tpu.runtime.mesh import build_mesh
+from ditl_tpu.telemetry import (
+    EventJournal,
+    GoodputTracker,
+    lost_work_from_journal,
+    read_journal,
+    worker_journal_path,
+)
 from ditl_tpu.train.checkpoint import CheckpointManager, DataIterState
 from ditl_tpu.train.metrics import MetricsLogger
 from ditl_tpu.train.state import TrainState, create_train_state, state_logical_axes
@@ -98,6 +105,22 @@ def _windows(it, size: int):
         yield window
 
 
+def _timed_iter(it, on_wait):
+    """Pass-through iterator reporting the host wall spent blocked in each
+    ``next()`` to ``on_wait`` — the data-wait phase of the step breakdown
+    (prefetch usually makes this ~0; when it isn't, the pipeline is the
+    bottleneck and this is the number that says so)."""
+    while True:
+        t0 = time.perf_counter()
+        try:
+            item = next(it)
+        except StopIteration:
+            on_wait(time.perf_counter() - t0)
+            return
+        on_wait(time.perf_counter() - t0)
+        yield item
+
+
 def _run_validation(eval_step, params, val_batches, mesh) -> float:
     """Token-weighted mean NLL over the pre-materialized held-out batches
     (host numpy; shipped to the mesh per pass)."""
@@ -124,8 +147,25 @@ def _crossed(step: int, n_advanced: int, every: int) -> bool:
 def train(config: Config) -> dict[str, Any]:
     """Run the full fine-tune. Returns summary metrics (also logged)."""
     t_start = time.time()
+    # Always-on goodput accounting (telemetry/goodput.py): pure host wall
+    # clocks, zero device syncs. Every second of this run lands in a bucket
+    # (productive step / compile / data-wait / checkpoint / eval / profiler
+    # / restart lost-work) or the measured "other" remainder.
+    tracker = GoodputTracker()
+    tracker.start()
+    t_setup0 = time.perf_counter()
+    setup_excl = 0.0  # setup time already attributed to a finer bucket
     init_runtime(config.runtime)
     setup_logging(config.runtime.log_level)
+    journal: EventJournal | None = None
+    if config.train.telemetry_dir:
+        journal = EventJournal(
+            worker_journal_path(
+                config.train.telemetry_dir, jax.process_index()
+            ),
+            source=f"worker-{jax.process_index()}",
+        )
+        journal.event("worker.start")
     mesh = build_mesh(config.mesh)
     model_cfg = config.model  # preset resolution happens in launch.build_config
 
@@ -214,7 +254,11 @@ def train(config: Config) -> dict[str, Any]:
                 jax.eval_shape(lambda: state),
                 state_shardings,
             )
+            t_restore0 = time.perf_counter()
             restored = ckpt.restore_latest(abstract)
+            dt_restore = time.perf_counter() - t_restore0
+            tracker.add("checkpoint_restore", dt_restore)
+            setup_excl += dt_restore
             if restored is not None:
                 state, data_iter = restored
                 resumed = True
@@ -223,6 +267,20 @@ def train(config: Config) -> dict[str, Any]:
                     "(epoch %d, batch offset %d)",
                     int(state.step), data_iter.epoch, data_iter.step_in_epoch,
                 )
+                if journal is not None:
+                    # Restart lost-work: the previous generation's journal
+                    # (same per-process file, appended across generations)
+                    # brackets the span between the checkpoint we resumed at
+                    # and its last sign of life.
+                    lost = lost_work_from_journal(
+                        read_journal(journal.path),
+                        data_iter.global_step, t_start,
+                    )
+                    tracker.add("restart_lost_work", lost)
+                    journal.event(
+                        "worker.resume", step=data_iter.global_step,
+                        lost_work_s=round(lost, 6),
+                    )
 
     if config.train.init_from_hf and not resumed:
         # Overwrite the random base weights with a converted HF checkpoint
@@ -300,18 +358,37 @@ def train(config: Config) -> dict[str, Any]:
     last_saved = None
     epoch = data_iter.epoch
 
+    # Everything before the loop is startup (minus spans already attributed
+    # to finer buckets, e.g. checkpoint restore).
+    tracker.add("startup", time.perf_counter() - t_setup0 - setup_excl)
+    data_wait_acc = [0.0]  # host wall blocked in the data iterator, per window
+
+    def _note_wait(dt: float) -> None:
+        data_wait_acc[0] += dt
+        tracker.add("data_wait", dt)
+
+    first_window = True
     try:
         for epoch in range(data_iter.epoch, config.data.num_epochs):
             # Resume skips already-consumed batches at the sampler level.
             start = data_iter.step_in_epoch if epoch == data_iter.epoch else 0
-            batch_iter = iter(pipeline.epoch(epoch, start_step=start))
+            batch_iter = _timed_iter(
+                iter(pipeline.epoch(epoch, start_step=start)), _note_wait
+            )
             step_in_epoch = start
             for window in _windows(batch_iter, spc):
                 if global_step >= total_steps:
                     break
                 window = window[: total_steps - global_step]
+                t_window0 = time.perf_counter()
                 metrics.start_step()
+                # Profiler work (start_trace, and maybe_stop's
+                # effects_barrier + trace write) happens INSIDE the window
+                # interval — timed explicitly and subtracted from the
+                # window wall below, or it would be double-counted into
+                # compile/productive_step and break conservation.
                 profiler.maybe_start(global_step)
+                prof_s = time.perf_counter() - t_window0
                 with profiler.annotate(global_step):
                     if train_multi is not None and len(window) == spc:
                         # One device program runs the whole window: zero host
@@ -336,37 +413,64 @@ def train(config: Config) -> dict[str, Any]:
                                 else window_tokens + step_metrics["n_tokens"]
                             )
                         window_metrics = dict(step_metrics, n_tokens=window_tokens)
+                t_prof = time.perf_counter()
                 profiler.maybe_stop(global_step + len(window) - 1)
+                prof_s += time.perf_counter() - t_prof
+                tracker.add("profiler", prof_s)
                 global_step += len(window)
                 step_in_epoch += len(window)
+                window_wait, data_wait_acc[0] = data_wait_acc[0], 0.0
                 metrics.end_step(
-                    global_step - 1, window_metrics, n_steps=len(window)
+                    global_step - 1, window_metrics, n_steps=len(window),
+                    data_wait_s=window_wait,
                 )
+                # Window wall (dispatch + any flush sync inside end_step;
+                # data wait happened before the window body, profiler work
+                # is subtracted — both have their own buckets): the FIRST
+                # compiled window is compile-dominated, so it is attributed
+                # to the compile badput bucket whole — the same convention
+                # bench.py and summary() use when they drop the warm-up
+                # step from p50.
+                dt_window = time.perf_counter() - t_window0 - prof_s
+                if first_window:
+                    tracker.add("compile", dt_window)
+                    first_window = False
+                else:
+                    tracker.add_step(dt_window, len(window))
+                if journal is not None and _crossed(
+                    global_step, len(window), config.train.log_every
+                ):
+                    journal.event("train.progress", step=global_step)
                 beat(global_step)
                 position = DataIterState(epoch, step_in_epoch, global_step)
                 if ckpt is not None and ckpt.should_save(global_step, len(window)):
-                    ckpt.save(global_step, state, position)
+                    with tracker.span("checkpoint_save"):
+                        ckpt.save(global_step, state, position)
+                    if journal is not None:
+                        journal.event("checkpoint.save", step=global_step)
                     last_saved = global_step
                 if val_batches is not None and _crossed(
                     global_step, len(window), config.train.val_every
                 ):
                     if eval_step is None:
                         eval_step = make_eval_step(model_cfg, mesh)
-                    last_val_loss = _run_validation(
-                        eval_step, state.params, val_batches, mesh
-                    )
+                    with tracker.span("eval"):
+                        last_val_loss = _run_validation(
+                            eval_step, state.params, val_batches, mesh
+                        )
                     if is_coordinator():
                         logger.info(
                             "step %d: val_loss=%.4f", global_step, last_val_loss
                         )
                 if _crossed(global_step, len(window), config.train.eval_every):
                     idx = np.arange(min(config.train.eval_samples, len(dataset)))
-                    run_api_eval(
-                        client,
-                        [dataset[int(i)]["text"] for i in idx],
-                        [dataset[int(i)]["label"] for i in idx],
-                        max_samples=config.train.eval_samples,
-                    )
+                    with tracker.span("eval"):
+                        run_api_eval(
+                            client,
+                            [dataset[int(i)]["text"] for i in idx],
+                            [dataset[int(i)]["label"] for i in idx],
+                            max_samples=config.train.eval_samples,
+                        )
                 if _crossed(
                     global_step, len(window), config.train.val_every
                 ) or _crossed(global_step, len(window), config.train.eval_every):
@@ -391,6 +495,11 @@ def train(config: Config) -> dict[str, Any]:
                         "fault_kill_step: SIGKILLing self at step %d",
                         global_step,
                     )
+                    if journal is not None:
+                        # Line-buffered: the event is on disk before the
+                        # uncatchable kill — the timeline's first entry of
+                        # the death sequence.
+                        journal.event("worker.sigkill_self", step=global_step)
                     _os.kill(_os.getpid(), _signal.SIGKILL)
                 if (
                     config.train.fault_inject_step > 0
@@ -408,13 +517,20 @@ def train(config: Config) -> dict[str, Any]:
                 break
         metrics.flush()
         if ckpt is not None and last_saved != global_step:
-            ckpt.save(global_step, state, DataIterState(epoch, 0, global_step))
-            ckpt.wait()
+            with tracker.span("checkpoint_save"):
+                ckpt.save(global_step, state, DataIterState(epoch, 0, global_step))
+                ckpt.wait()
+            if journal is not None:
+                journal.event("checkpoint.save", step=global_step)
     finally:
         metrics.close()
-        profiler.close()
+        with tracker.span("profiler"):
+            profiler.close()
         if ckpt is not None:
             ckpt.close()
+        if journal is not None:
+            journal.event("worker.exit", step=global_step)
+            journal.close()
         barrier("end-of-training")
 
     summary = metrics.summary()
@@ -428,7 +544,11 @@ def train(config: Config) -> dict[str, Any]:
         summary["val_loss"] = last_val_loss
     summary["params_m"] = n_params / 1e6
     summary["wall_s"] = time.time() - t_start
+    # Goodput report: where the wall clock went, conservation-checked (the
+    # tier-1 test asserts buckets + other sum to total within 1%).
+    summary["goodput"] = tracker.report()
     if is_coordinator():
         logger.info("training done: %s", summary)
+        logger.info("goodput report: %s", summary["goodput"])
     shutdown_runtime()
     return summary

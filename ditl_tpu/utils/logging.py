@@ -13,7 +13,7 @@ import logging
 import sys
 
 _FORMAT = "%(asctime)s - %(levelname)s - [p%(process_index)s] %(name)s - %(message)s"
-_configured = False
+_handler: logging.Handler | None = None
 
 
 class _ProcessIndexFilter(logging.Filter):
@@ -36,8 +36,15 @@ def _process_index() -> int:
 
 def setup_logging(level: str = "INFO", all_processes: bool = False) -> None:
     """Configure root logging. On processes != 0, raise the threshold to
-    WARNING (the reference's ``if rank == 0`` gate, made structural)."""
-    global _configured
+    WARNING (the reference's ``if rank == 0`` gate, made structural).
+
+    Re-entrant and embedding-safe: we track OUR OWN handler and replace only
+    it on reconfiguration. The old behavior cleared root handlers only when
+    we had already configured once, so under pytest (which installs its own
+    capture handler first) or any embedding app, the first setup_logging
+    added a second root handler and every record was emitted twice — and a
+    re-setup would wipe the HOST's handlers (ISSUE 3 satellite)."""
+    global _handler
     effective = level.upper()
     if not all_processes and _process_index() != 0:
         effective = "WARNING"
@@ -45,11 +52,11 @@ def setup_logging(level: str = "INFO", all_processes: bool = False) -> None:
     handler.setFormatter(logging.Formatter(_FORMAT))
     handler.addFilter(_ProcessIndexFilter())
     root = logging.getLogger()
-    if _configured:
-        root.handlers.clear()
+    if _handler is not None and _handler in root.handlers:
+        root.removeHandler(_handler)
     root.addHandler(handler)
     root.setLevel(effective)
-    _configured = True
+    _handler = handler
 
 
 def get_logger(name: str) -> logging.Logger:

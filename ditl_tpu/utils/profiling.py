@@ -84,6 +84,15 @@ class StepProfiler:
             logger.info("profiler: trace written to %s", self.directory)
 
     def close(self) -> None:
+        """Mirror ``maybe_stop`` for a trainer exiting mid-window (epoch end,
+        exception, total_steps inside the window): effects_barrier first so
+        the trace still contains the device timeline of the steps that DID
+        run, and mark ``_done`` so a reused profiler cannot restart a second
+        window after its trace was finalized (ISSUE 3 satellite)."""
         if self._active:
+            jax.effects_barrier()
             jax.profiler.stop_trace()
             self._active = False
+            self._done = True
+            logger.info("profiler: trace (partial window) written to %s",
+                        self.directory)

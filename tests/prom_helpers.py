@@ -1,0 +1,33 @@
+"""Shared Prometheus classic-text-exposition parsing for telemetry tests —
+one copy of the format knowledge (tests/test_telemetry.py and
+tests/test_server_api.py both assert against it; drifting duplicates would
+let one suite accept a format the other rejects)."""
+
+from __future__ import annotations
+
+__all__ = ["exposition_index", "sample_family"]
+
+
+def exposition_index(body: str) -> tuple[dict[str, str], dict[str, float]]:
+    """(types, samples): declared ``# TYPE`` kind per family, and sample
+    name (labels included) -> float value."""
+    types: dict[str, str] = {}
+    samples: dict[str, float] = {}
+    for line in body.strip().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types[name] = kind
+        elif not line.startswith("#") and line:
+            name, value = line.rsplit(" ", 1)
+            samples[name] = float(value)
+    return types, samples
+
+
+def sample_family(name: str) -> str:
+    """Classic text-format family of a sample: histogram series strip their
+    suffixes; counters are typed under their full ``_total`` name."""
+    base = name.split("{", 1)[0]
+    for suffix in ("_bucket", "_sum", "_count"):
+        if base.endswith(suffix):
+            return base[: -len(suffix)]
+    return base

@@ -253,6 +253,7 @@ def test_multihost_checkpoint_save_and_fresh_pod_params_restore(tmp_path):
 def test_elastic_pod_kill_and_resume(tmp_path):
     ckpt_dir = tmp_path / "ckpt"
     hb_dir = tmp_path / "hb"
+    telemetry_dir = tmp_path / "telemetry"
     metrics_file = tmp_path / "metrics.jsonl"
     env = hermetic_env(REPO_ROOT)
     cmd = [
@@ -263,6 +264,7 @@ def test_elastic_pod_kill_and_resume(tmp_path):
         f"train.checkpoint_dir={ckpt_dir}",
         f"train.heartbeat_dir={hb_dir}",
         f"train.metrics_file={metrics_file}",
+        f"train.telemetry_dir={telemetry_dir}",
         "train.fault_kill_step=6", "train.fault_kill_process=1",
         *_TINY_MODEL,
     ]
@@ -331,3 +333,28 @@ def test_elastic_pod_kill_and_resume(tmp_path):
     for i in range(2):
         hb = read_heartbeat(heartbeat_path(str(hb_dir), i))
         assert hb is not None and hb["step"] >= 8, hb
+
+    # ISSUE 3 acceptance: the controller merged every participant's journal
+    # into one ordered pod timeline containing the SIGKILL, relaunch, and
+    # resume events in causal order.
+    from ditl_tpu.telemetry import read_journal
+
+    timeline = read_journal(str(telemetry_dir / "pod_timeline.jsonl"))
+    assert timeline, "pod timeline missing or empty"
+    events = [(r["source"], r["event"]) for r in timeline]
+    names = [e for _, e in events]
+    i_kill = names.index("worker.sigkill_self")
+    i_died = names.index("pod.worker_died")
+    i_relaunch = names.index("pod.relaunch")
+    i_resume = names.index("worker.resume")
+    assert i_kill < i_died < i_relaunch < i_resume, events
+    # the dying worker's own marker came from worker 1, the SIGKILL target
+    assert timeline[i_kill]["source"] == "worker-1"
+    assert timeline[i_kill]["step"] == 6
+    assert timeline[i_died]["cause"] == "signal SIGKILL"
+    # both generations spawned, resume landed at a committed boundary with
+    # the lost-work span attributed
+    assert names.count("pod.spawn") == 2
+    assert timeline[i_resume]["step"] == resume_step
+    assert timeline[i_resume]["lost_work_s"] >= 0
+    assert names[-1] == "pod.done"

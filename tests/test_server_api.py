@@ -18,6 +18,7 @@ from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
 from ditl_tpu.infer.engine import GenerateConfig, Generator
 from ditl_tpu.infer.server import make_server
 from ditl_tpu.models import llama
+from tests.prom_helpers import exposition_index, sample_family
 
 
 @pytest.fixture(scope="module")
@@ -196,12 +197,102 @@ def test_prometheus_metrics_endpoint(setup):
         for line in body.strip().splitlines():
             if line.startswith("#"):
                 continue
-            name, value = line.split(" ", 1)
+            name, value = line.rsplit(" ", 1)
             float(value)
             assert name.startswith("ditl_serving_")
     finally:
         server.shutdown()
         threaded.close()
+
+
+def _scrape_metrics(port: int) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=30
+    ) as resp:
+        assert resp.status == 200
+        return resp.read().decode()
+
+
+@pytest.mark.telemetry
+def test_metrics_exposition_invariants_live_server(setup):
+    """ISSUE 3 acceptance: a LIVE continuous-batching server serves real
+    histogram series (TTFT / per-token / e2e) and `_total` counters on
+    /metrics, obeying the Prometheus text-format contract — every sample's
+    family declares a TYPE, histogram buckets are cumulative and end in
+    +Inf, and counters are monotonic across two scrapes with traffic in
+    between."""
+    params, cfg, tok = setup
+    server, threaded, port = _serve(params, cfg, tok, continuous=True)
+    try:
+        status, _ = _post(port, "/v1/completions",
+                          {"prompt": "hello", "max_tokens": 6})
+        assert status == 200
+        body1 = _scrape_metrics(port)
+        types1, samples1 = exposition_index(body1)
+        # Every sample has a declared family TYPE.
+        for name in samples1:
+            fam = sample_family(name)
+            assert fam in types1, f"sample {name} has no # TYPE for {fam}"
+        # Real histogram series from the live engine, not flattened gauges.
+        for fam in ("ditl_serving_request_ttft_seconds",
+                    "ditl_serving_decode_token_seconds",
+                    "ditl_serving_request_e2e_seconds",
+                    "ditl_serving_request_queue_wait_seconds"):
+            assert types1[fam] == "histogram", fam
+            buckets = [
+                (n, v) for n, v in samples1.items()
+                if n.startswith(f"{fam}_bucket")
+            ]
+            counts = [v for _, v in buckets]
+            assert counts == sorted(counts), f"{fam} buckets not cumulative"
+            assert buckets[-1][0] == f'{fam}_bucket{{le="+Inf"}}'
+            assert buckets[-1][1] == samples1[f"{fam}_count"]
+        assert samples1["ditl_serving_request_ttft_seconds_count"] >= 1
+        assert samples1["ditl_serving_request_e2e_seconds_count"] >= 1
+        # Counters end in _total, are typed under that name, and carried
+        # the request.
+        counter_fams = [f for f, k in types1.items() if k == "counter"]
+        assert "ditl_serving_requests_total" in counter_fams
+        for fam in counter_fams:
+            assert fam.endswith("_total") and fam in samples1, fam
+        assert samples1["ditl_serving_requests_total"] >= 1
+        assert samples1["ditl_serving_tokens_generated_total"] >= 1
+        # Monotonic across scrapes with traffic in between.
+        status, _ = _post(port, "/v1/completions",
+                          {"prompt": "again", "max_tokens": 4})
+        assert status == 200
+        _, samples2 = exposition_index(_scrape_metrics(port))
+        for fam in counter_fams:
+            assert samples2[fam] >= samples1[fam], fam
+        assert (samples2["ditl_serving_requests_total"]
+                > samples1["ditl_serving_requests_total"])
+        # No duplicate TYPE declarations (family collisions between the
+        # registry and the flattened stats gauges).
+        type_lines = [ln for ln in body1.splitlines()
+                      if ln.startswith("# TYPE ")]
+        fams = [ln.split(" ", 3)[2] for ln in type_lines]
+        assert len(fams) == len(set(fams)), "duplicate metric family"
+    finally:
+        server.shutdown()
+        threaded.close()
+
+
+@pytest.mark.telemetry
+def test_metrics_lockstep_server_records_e2e(setup):
+    """The lock-step (no continuous engine) server still exposes e2e
+    latency + request counters on /metrics."""
+    params, cfg, tok = setup
+    server, _, port = _serve(params, cfg, tok)
+    try:
+        status, _ = _post(port, "/v1/completions",
+                          {"prompt": "x", "max_tokens": 4})
+        assert status == 200
+        types, samples = exposition_index(_scrape_metrics(port))
+        assert samples["ditl_serving_requests_total"] >= 1
+        assert samples["ditl_serving_request_e2e_seconds_count"] >= 1
+        assert types["ditl_serving_request_e2e_seconds"] == "histogram"
+    finally:
+        server.shutdown()
 
 
 def test_tokenize_detokenize_endpoints(setup):

@@ -1,0 +1,413 @@
+"""Unified telemetry subsystem (ISSUE 3): registry exposition invariants,
+goodput conservation, event-journal merge ordering, the MetricsLogger flush
+fix, logging re-entrancy, profiler close, and the no-device-sync contract of
+an instrumented trainer run."""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ditl_tpu.telemetry import (
+    EventJournal,
+    GoodputTracker,
+    MetricsRegistry,
+    ServingMetrics,
+    lost_work_from_journal,
+    merge_journals,
+    read_journal,
+    worker_journal_path,
+    write_pod_timeline,
+)
+
+pytestmark = pytest.mark.telemetry
+
+
+from tests.prom_helpers import exposition_index, sample_family
+
+# ---------------------------------------------------------------------------
+# Registry: Prometheus exposition invariants.
+# ---------------------------------------------------------------------------
+
+
+def test_registry_exposition_invariants():
+    r = MetricsRegistry()
+    r.counter("x_requests", "reqs").inc(3)
+    h = r.histogram("x_lat_seconds", "lat", buckets=(0.01, 0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5, n=2)
+    h.observe(99.0)  # lands in +Inf
+    r.gauge("x_depth").set(4)
+    body = r.render()
+    fams, samples = exposition_index(body)
+    # classic text format: the counter's TYPE names the _total sample itself
+    assert fams == {"x_requests_total": "counter",
+                    "x_lat_seconds": "histogram", "x_depth": "gauge"}
+    # every sample belongs to a declared family
+    for name in samples:
+        assert sample_family(name) in fams, name
+    # histogram buckets are cumulative, end in +Inf, agree with _count
+    buckets = [(n, v) for n, v in samples.items()
+               if n.startswith("x_lat_seconds_bucket")]
+    counts = [v for _, v in buckets]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert buckets[-1][0] == 'x_lat_seconds_bucket{le="+Inf"}'
+    assert counts[-1] == 4
+    assert samples["x_lat_seconds_count"] == 4
+    # counters expose _total
+    assert samples["x_requests_total"] == 3
+
+
+def test_counter_rejects_decrease_and_histogram_quantiles():
+    r = MetricsRegistry()
+    c = r.counter("c")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    h = r.histogram("h", buckets=(1.0, 2.0, 4.0))
+    assert h.quantile(0.5) is None  # empty
+    for v in (0.5, 1.5, 3.0, 3.5):
+        h.observe(v)
+    q50 = h.quantile(0.5)
+    assert 1.0 <= q50 <= 2.0
+    assert h.quantile(1.0) <= 4.0
+    # idempotent get-or-create, type-checked
+    assert r.histogram("h") is h
+    with pytest.raises(ValueError):
+        r.counter("h")
+
+
+def test_serving_metrics_summary_shape():
+    m = ServingMetrics()
+    m.requests.inc()
+    m.ttft.observe(0.2)
+    s = m.summary()
+    assert s["ditl_serving_requests"] == 1.0
+    assert s["ditl_serving_request_ttft_seconds"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Goodput tracker.
+# ---------------------------------------------------------------------------
+
+
+def test_goodput_conservation_and_fractions():
+    t = GoodputTracker()
+    t.start()
+    with t.span("compile"):
+        time.sleep(0.02)
+    t0 = time.perf_counter()
+    time.sleep(0.03)
+    t.add_step(time.perf_counter() - t0, n_steps=2)
+    with t.span("checkpoint_save"):
+        time.sleep(0.01)
+    rep = t.report()
+    tracked = sum(
+        v for k, v in rep.items()
+        if k.endswith("_s") and k not in ("total_wall_s", "other_s")
+    )
+    assert tracked <= rep["total_wall_s"] * 1.01
+    assert tracked + rep["other_s"] == pytest.approx(
+        rep["total_wall_s"], rel=0.01
+    )
+    assert rep["steps"] == 2
+    assert 0 < rep["goodput_fraction"] < 1
+    # report() is stable across calls (endpoint pinned once)
+    assert t.report()["total_wall_s"] == rep["total_wall_s"]
+
+
+def test_lost_work_from_journal():
+    recs = [
+        {"ts": 100.0, "event": "worker.start"},
+        {"ts": 101.0, "event": "checkpoint.save", "step": 2},
+        {"ts": 103.0, "event": "checkpoint.save", "step": 4},
+        {"ts": 106.5, "event": "train.progress", "step": 6},
+    ]
+    # resuming at step 4: lost the span from its save to the last event
+    assert lost_work_from_journal(recs, 4, before_ts=200.0) == pytest.approx(3.5)
+    # no prior events (fresh run): nothing to attribute
+    assert lost_work_from_journal(recs, 4, before_ts=50.0) == 0.0
+    # no save at/below the resume step: refuse to guess
+    assert lost_work_from_journal(
+        [{"ts": 1.0, "event": "train.progress", "step": 9}], 0, 200.0
+    ) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Event journal.
+# ---------------------------------------------------------------------------
+
+
+def test_journal_roundtrip_merge_and_timeline(tmp_path):
+    d = str(tmp_path)
+    w0 = EventJournal(worker_journal_path(d, 0), source="worker-0")
+    w1 = EventJournal(worker_journal_path(d, 1), source="worker-1")
+    w0.event("worker.start")
+    w1.event("worker.start")
+    with w0.span("checkpoint.save", step=2):
+        pass
+    w1.event("worker.sigkill_self", step=3)
+    w0.close()
+    w1.close()
+    # corrupt tail (a SIGKILL mid-write) is skipped, not fatal
+    with open(worker_journal_path(d, 1), "a") as f:
+        f.write('{"truncated": ')
+    merged = merge_journals(d)
+    assert [r["event"] for r in merged].count("worker.start") == 2
+    assert merged == sorted(
+        merged, key=lambda r: (r["ts"], r["source"], r["seq"])
+    )
+    span = next(r for r in merged if r["event"] == "checkpoint.save")
+    assert span["step"] == 2 and span["dur_s"] >= 0
+    path = write_pod_timeline(d)
+    assert os.path.basename(path) == "pod_timeline.jsonl"
+    timeline = read_journal(path)
+    assert [r["event"] for r in timeline] == [r["event"] for r in merged]
+    # merge is idempotent (timeline file is not an events-*.jsonl input)
+    write_pod_timeline(d)
+    assert read_journal(path) == timeline
+
+
+def test_pod_controller_writes_merged_timeline(tmp_path):
+    """jax-free controller drill: a worker that journals its own death is
+    merged, in causal order, with the controller's detection/relaunch/done
+    events."""
+    import sys
+
+    from ditl_tpu.runtime.elastic import PodController
+
+    d = str(tmp_path)
+    flag = tmp_path / "gen0-ran"
+    code = (
+        "import json, os, sys, time\n"
+        "d, flag = sys.argv[1], sys.argv[2]\n"
+        "p = os.path.join(d, 'events-worker-0.jsonl')\n"
+        "def ev(e, **a):\n"
+        "    with open(p, 'a') as f:\n"
+        "        f.write(json.dumps({'ts': time.time(), 'event': e, "
+        "'source': 'worker-0', **a}) + chr(10))\n"
+        "ev('worker.start')\n"
+        "if os.path.exists(flag):\n"
+        "    ev('worker.resume', step=2)\n"
+        "    ev('worker.exit', step=4)\n"
+        "    sys.exit(0)\n"
+        "open(flag, 'w').close()\n"
+        "ev('worker.sigkill_self', step=2)\n"
+        "os.kill(os.getpid(), 9)\n"
+    )
+    ctl = PodController(
+        1,
+        lambda i, n, port, a: [sys.executable, "-c", code, d, str(flag)],
+        max_pod_restarts=1, poll_s=0.05, journal_dir=d,
+    )
+    result = ctl.run(timeout_s=60)
+    assert result.ok, result.transitions
+    timeline = read_journal(os.path.join(d, "pod_timeline.jsonl"))
+    events = [r["event"] for r in timeline]
+    # causal order: self-kill marker -> controller detection -> relaunch ->
+    # new generation's resume -> pod done
+    for a, b in [
+        ("worker.sigkill_self", "pod.worker_died"),
+        ("pod.worker_died", "pod.relaunch"),
+        ("pod.relaunch", "worker.resume"),
+        ("worker.resume", "pod.done"),
+    ]:
+        assert events.index(a) < events.index(b), events
+    died = next(r for r in timeline if r["event"] == "pod.worker_died")
+    assert died["cause"] == "signal SIGKILL"
+    assert events.count("pod.spawn") == 2
+
+
+# ---------------------------------------------------------------------------
+# MetricsLogger flush fix (satellite): every pending row is written.
+# ---------------------------------------------------------------------------
+
+
+def test_metrics_logger_flush_writes_all_pending_rows(tmp_path):
+    from ditl_tpu.train.metrics import MetricsLogger
+
+    path = str(tmp_path / "m.jsonl")
+    m = MetricsLogger(log_every=4, metrics_file=path)
+    for step in range(8):
+        m.start_step()
+        time.sleep(0.001)
+        m.end_step(step, {"loss": float(10 - step), "n_tokens": 64.0})
+    m.close()
+    rows = [json.loads(ln) for ln in open(path)]
+    # One row per STEP — the old flush dropped every interior step of a
+    # log_every window (wrote only _pending[-1]).
+    assert [r["step"] for r in rows] == list(range(8))
+    assert [r["loss"] for r in rows] == [float(10 - s) for s in range(8)]
+    # flush-boundary rows carry the sync wall; interior rows don't
+    # (end_step flushes when step % log_every < n_steps: steps 0 and 4
+    # here, plus close()'s final flush on the last pending row)
+    assert "sync_s" in rows[0] and "sync_s" in rows[4] and "sync_s" in rows[7]
+    assert all("sync_s" not in rows[i] for i in (1, 2, 3, 5, 6))
+    totals = m.phase_totals()
+    assert totals["dispatch_s"] > 0 and totals["device_blocked_s"] >= 0
+
+
+# ---------------------------------------------------------------------------
+# Logging re-entrancy (satellite): no duplicate emission, host handlers kept.
+# ---------------------------------------------------------------------------
+
+
+def test_setup_logging_replaces_only_own_handler():
+    from ditl_tpu.utils.logging import setup_logging
+
+    root = logging.getLogger()
+    before = list(root.handlers)
+
+    class _Capture(logging.Handler):
+        def __init__(self):
+            super().__init__()
+            self.records = []
+
+        def emit(self, record):
+            self.records.append(record)
+
+    host = _Capture()  # an embedding app's (or pytest's) pre-existing handler
+    root.addHandler(host)
+    try:
+        setup_logging("INFO")
+        setup_logging("INFO")  # re-entry must not stack a second handler
+        ours = [
+            h for h in root.handlers
+            if h is not host and h not in before
+        ]
+        assert len(ours) == 1, "re-setup must replace, not stack, our handler"
+        assert host in root.handlers, "host handler must survive re-setup"
+        probe = logging.getLogger("ditl_tpu.test.reentrancy")
+        host.records.clear()
+        probe.info("once")
+        assert len(host.records) == 1  # exactly one copy reaches the host
+    finally:
+        root.removeHandler(host)
+        for h in [h for h in root.handlers if h not in before]:
+            root.removeHandler(h)
+        for h in before:
+            if h not in root.handlers:
+                root.addHandler(h)
+
+
+# ---------------------------------------------------------------------------
+# StepProfiler.close (satellite): mid-window exit still writes a trace.
+# ---------------------------------------------------------------------------
+
+
+def test_step_profiler_close_mid_window_writes_trace(tmp_path):
+    import jax.numpy as jnp
+
+    from ditl_tpu.utils.profiling import StepProfiler
+
+    prof = StepProfiler(str(tmp_path), start_step=0, num_steps=10)
+
+    @jax.jit
+    def step(x):
+        return x @ x.T
+
+    x = jnp.ones((32, 32))
+    for s in range(2):  # exit well before the 10-step window completes
+        prof.maybe_start(s)
+        with prof.annotate(s):
+            x = step(x)
+        prof.maybe_stop(s)
+    assert prof._active
+    prof.close()
+    assert not prof._active and prof._done
+    traces = glob.glob(str(tmp_path / "**" / "*.xplane.pb"), recursive=True)
+    assert traces and os.path.getsize(traces[0]) > 0
+    # a closed profiler must not restart
+    prof.maybe_start(99)
+    assert not prof._active
+
+
+# ---------------------------------------------------------------------------
+# Trainer integration: goodput conservation + the no-device-sync contract.
+# ---------------------------------------------------------------------------
+
+
+def _tiny_train_config(tmp_path, **train_kw):
+    from ditl_tpu.config import Config, DataConfig, ModelConfig, TrainConfig
+
+    return Config(
+        model=ModelConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            max_seq_len=64,
+        ),
+        data=DataConfig(synthetic=True, synthetic_examples=64, batch_size=8,
+                        seq_len=32, num_epochs=1),
+        train=TrainConfig(total_steps=6, warmup_steps=1, log_every=2,
+                          **train_kw),
+    )
+
+
+def test_trainer_goodput_conservation_and_no_per_step_sync(
+    tmp_path, monkeypatch
+):
+    """The acceptance invariant: badput buckets + productive step time sum
+    to total tracked wall time within 1%, and telemetry adds no per-step
+    blocking transfer beyond the existing log_every flush — asserted by
+    counting jax.device_get calls through the whole run."""
+    from ditl_tpu.train.trainer import train
+
+    calls = []
+    real_device_get = jax.device_get
+
+    def counting_device_get(x):
+        calls.append(1)
+        return real_device_get(x)
+
+    monkeypatch.setattr(jax, "device_get", counting_device_get)
+    cfg = _tiny_train_config(
+        tmp_path, telemetry_dir=str(tmp_path / "telemetry")
+    )
+    out = train(cfg)
+    assert out["steps"] == 6
+    g = out["goodput"]
+    tracked = sum(
+        v for k, v in g.items()
+        if k.endswith("_s") and k not in ("total_wall_s", "other_s")
+    )
+    # conservation: attributed buckets never exceed the total (within 1%),
+    # and buckets + remainder reconstruct it.
+    assert tracked <= g["total_wall_s"] * 1.01, g
+    assert tracked + g["other_s"] == pytest.approx(
+        g["total_wall_s"], rel=0.01
+    ), g
+    assert g["compile_s"] > 0 and g["productive_step_s"] > 0
+    assert g["steps"] == 5  # first window attributed to compile
+    # Blocking host transfers: steps 0..5 at log_every=2 flush at end_step
+    # steps 0, 2, 4 plus the final-flush (pending step 5) = 4 device_get
+    # calls from the metrics path + 1 for the summary's final_loss. Nothing
+    # per-step: 6 steps with telemetry on must not add 6 syncs.
+    assert len(calls) == 5, f"unexpected blocking transfers: {len(calls)}"
+    # journal recorded lifecycle + progress
+    recs = read_journal(
+        worker_journal_path(str(tmp_path / "telemetry"), 0)
+    )
+    events = [r["event"] for r in recs]
+    assert events[0] == "worker.start" and events[-1] == "worker.exit"
+    assert "train.progress" in events
+
+
+def test_trainer_phase_breakdown_in_metrics_stream(tmp_path):
+    from ditl_tpu.train.trainer import train
+
+    mf = tmp_path / "m.jsonl"
+    out = train(_tiny_train_config(tmp_path, metrics_file=str(mf)))
+    assert out["steps"] == 6
+    rows = [json.loads(ln) for ln in mf.read_text().splitlines()]
+    assert [r["step"] for r in rows] == list(range(6))
+    for r in rows:
+        assert {"data_wait_s", "dispatch_s", "step_time_s"} <= r.keys()
+        assert np.isfinite(r["loss"])
+    assert "sync_s" in rows[-1]
+    assert out["phase_dispatch_s"] > 0
