@@ -544,6 +544,153 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
     return 0
 
 
+def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
+                  prompt_len: int = 0, max_new: int = 0,
+                  router: str = "affinity",
+                  compile_cache_dir: str = "") -> int:
+    """Fleet-level serving benchmark (ISSUE 4 satellite): N in-process
+    continuous-engine replicas behind the gateway, driven over real HTTP
+    with a prefix-grouped workload (the regime cache-affinity routing
+    exists for). Records fleet throughput, the measured affinity hit-rate,
+    and retry counts in the bench JSON so BENCH_r*.json rows can track
+    fleet-level numbers round over round. One JSON line, like every other
+    bench mode."""
+    import dataclasses
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    from ditl_tpu.config import GatewayConfig, ModelConfig
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.gateway import Fleet, GatewayMetrics, InProcessReplica, make_gateway
+    from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
+    from ditl_tpu.infer.engine import GenerateConfig, Generator
+    from ditl_tpu.infer.server import make_server
+    from ditl_tpu.models import llama
+    from ditl_tpu.runtime.distributed import enable_compile_cache
+
+    enable_compile_cache(compile_cache_dir)
+    platform = jax.devices()[0].platform
+    cfg = ModelConfig(
+        name="bench-350m", vocab_size=32768, hidden_size=1024,
+        intermediate_size=2816, num_layers=24, num_heads=16, num_kv_heads=8,
+        head_dim=64, max_seq_len=1024, dtype="bfloat16", param_dtype="float32",
+    )
+    max_new = max_new or (128 if platform == "tpu" else 8)
+    plen = prompt_len or (64 if platform == "tpu" else 24)
+    if platform != "tpu":
+        cfg = dataclasses.replace(cfg, num_layers=2, hidden_size=256,
+                                  intermediate_size=688, vocab_size=4096)
+    params = llama.init_params(jax.random.key(0), cfg)
+    tok = ByteTokenizer()
+    shared_gen = Generator(params, cfg, tok)  # tokenize/metadata routes only
+    n_requests = n_replicas * slots * 2
+    engines = [
+        ThreadedEngine(ContinuousEngine(
+            params, cfg, tok, n_slots=slots, decode_chunk=decode_chunk,
+            gen=GenerateConfig(max_new_tokens=max_new),
+            max_queue=n_requests,
+        ))
+        for _ in range(n_replicas)
+    ]
+
+    def factory(eng):
+        return lambda: make_server(shared_gen, port=0, threaded_engine=eng,
+                                   default_max_tokens=max_new)
+
+    fleet = Fleet([
+        InProcessReplica(f"r{i}", factory(eng))
+        for i, eng in enumerate(engines)
+    ])
+    fleet.start_all(wait_healthy_s=30.0)
+    metrics = GatewayMetrics()
+    # Key on exactly the shared group prefix (plen tokens): the default 32
+    # would swallow the unique suffix whenever plen < 32 (the CPU smoke),
+    # making every key distinct and the affinity A/B meaningless.
+    gwcfg = GatewayConfig(router=router, affinity_prefix_tokens=plen)
+    server = make_gateway(fleet, config=gwcfg, metrics=metrics, port=0)
+    import threading
+
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+
+    # Prefix-grouped workload: n_replicas * 2 groups x slots requests, each
+    # sharing its group's long prefix — the fleet analog of the paged
+    # prefix-reuse regime. Shuffled deterministically so groups interleave.
+    groups = n_replicas * 2
+    prompts = []
+    for g in range(groups):
+        prefix = " ".join(f"g{g}tok{j}" for j in range(plen))
+        for i in range(max(1, n_requests // groups)):
+            prompts.append(f"{prefix} q{i}")
+    import random as _random
+
+    _random.Random(7).shuffle(prompts)
+
+    import urllib.request
+
+    def one(prompt):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": prompt, "max_tokens": max_new}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            return json.loads(resp.read())["usage"]["completion_tokens"]
+
+    def warm(view):
+        # Compile each engine OUTSIDE the timed region by hitting every
+        # replica directly — routed warm-ups would herd on whatever subset
+        # the policy picks (affinity hashes a handful of prompts to
+        # arbitrary homes), leaving cold engines to compile inside the
+        # timed section by a policy-dependent amount, which would corrupt
+        # the router A/B this bench exists for.
+        req = urllib.request.Request(
+            f"http://{view.address[0]}:{view.address[1]}/v1/completions",
+            data=json.dumps(
+                {"prompt": "warm up", "max_tokens": max_new}
+            ).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=600) as resp:
+            resp.read()
+
+    with ThreadPoolExecutor(max_workers=n_replicas * slots) as pool:
+        list(pool.map(warm, fleet.views()))
+        t0 = time.perf_counter()
+        tokens = sum(pool.map(one, prompts))
+        dt = time.perf_counter() - t0
+    summary = metrics.summary()
+    print(json.dumps({
+        "metric": "fleet decode tokens/sec (%d replica(s) x %d slots, "
+                  "router=%s)" % (n_replicas, slots, router),
+        "value": round(tokens / dt, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+        "platform": platform,
+        "generated_tokens": tokens,
+        "requests": len(prompts),
+        "gateway": {
+            "router": router,
+            "affinity_ratio": summary.get("ditl_gateway_affinity_ratio"),
+            "retries": summary.get("ditl_gateway_retries", 0),
+            "hedges": summary.get("ditl_gateway_hedges", 0),
+            "routed": {
+                k.removeprefix("ditl_gateway_replica_").removesuffix("_routed"): v
+                for k, v in summary.items()
+                if k.startswith("ditl_gateway_replica_")
+                and k.endswith("_routed")
+            },
+        },
+    }))
+    server.shutdown()
+    server.server_close()
+    fleet.stop_all(drain=True, timeout=10.0)
+    for eng in engines:
+        eng.close()
+    return 0
+
+
 def _effective_bwd_impls(cfg, batch: int, seq: int, mesh=None) -> dict[str, str]:
     """Which backward implementation will actually run for this config —
     delegates to the SAME predicates the dispatch uses (ops/mlp.py,
@@ -806,6 +953,17 @@ if __name__ == "__main__":
                         "continuous --speculative): a ~10x-smaller draft "
                         "model drafts (fine-tuned alongside the target on "
                         "the repetitive workload) instead of prompt lookup")
+    parser.add_argument("--serve-replicas", type=int, default=0,
+                        help="fleet serving bench (--infer): N in-process "
+                        "replicas behind the gateway (ditl_tpu/gateway/); "
+                        "records fleet throughput, affinity hit-rate, and "
+                        "retry counts in the bench JSON")
+    parser.add_argument("--serve-router", default="affinity",
+                        choices=("round_robin", "least_outstanding",
+                                 "affinity"),
+                        help="gateway routing policy for --serve-replicas "
+                        "(A/B round_robin vs affinity for the fleet-level "
+                        "prefix-cache claim)")
     parser.add_argument("--override", action="append", default=[],
                         metavar="FIELD=VALUE",
                         help="ModelConfig override for the TRAIN bench "
@@ -826,7 +984,7 @@ if __name__ == "__main__":
                   or args.engine != "lockstep" or args.cache != "contiguous"
                   or args.infer_workload != "random" or args.moe
                   or args.prompt_len or args.max_new or args.guided
-                  or args.spec_draft)
+                  or args.spec_draft or args.serve_replicas)
     if infer_only and not args.infer:
         parser.error("serving flags require --infer")
     if args.infer and (args.override or args.batch or args.seq):
@@ -838,6 +996,13 @@ if __name__ == "__main__":
         # Validate HERE, not after bench_infer's expensive fine-tune has
         # already burned minutes of chip time.
         parser.error("--spec-draft needs --speculative --engine continuous")
+    if args.infer and args.serve_replicas:
+        sys.exit(bench_gateway(
+            args.serve_replicas, slots=args.slots,
+            decode_chunk=args.decode_chunk, prompt_len=args.prompt_len,
+            max_new=args.max_new, router=args.serve_router,
+            compile_cache_dir=args.compile_cache_dir,
+        ))
     if args.infer:
         sys.exit(bench_infer(
             engine=args.engine, cache=args.cache,

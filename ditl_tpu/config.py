@@ -33,6 +33,7 @@ __all__ = [
     "DataConfig",
     "TrainConfig",
     "APIConfig",
+    "GatewayConfig",
     "Config",
     "parse_overrides",
     "config_fingerprint",
@@ -421,6 +422,63 @@ class APIConfig:
 
 
 @dataclass(frozen=True)
+class GatewayConfig:
+    """Serving-gateway fleet config (ditl_tpu/gateway/, ISSUE 4): one
+    OpenAI-compatible endpoint over N engine replicas, with routing,
+    supervision, and per-tenant admission knobs. Launched via
+    ``python -m ditl_tpu.launch gateway`` (subprocess replicas) and
+    overridable with the usual dotted syntax (``gateway.router=affinity``).
+    """
+
+    host: str = "127.0.0.1"
+    port: int = 8400
+    replicas: int = 2  # fleet size when the launcher spawns the replicas
+    # Routing policy: "round_robin" | "least_outstanding" | "affinity"
+    # (consistent hashing over session_id / the prompt's leading tokens,
+    # spilling to least-loaded when the home replica is saturated).
+    router: str = "affinity"
+    # How many leading (whitespace) prompt tokens form the affinity key.
+    affinity_prefix_tokens: int = 32
+    # Supervision: health-poll cadence, consecutive failures before a
+    # replica is declared dead (died -> drain -> relaunch -> re-admit),
+    # and how long a relaunch may take to become healthy.
+    health_interval_s: float = 0.5
+    fail_threshold: int = 3
+    probe_timeout_s: float = 2.0
+    restart_timeout_s: float = 300.0
+    drain_timeout_s: float = 60.0
+    # Proxying: attempts across distinct replicas per request (retries are
+    # idempotent-safe — nothing has been relayed when a retry fires),
+    # upstream timeout, and optional tail-latency hedging (0 = off).
+    max_attempts: int = 3
+    request_timeout_s: float = 300.0
+    hedge_after_s: float = 0.0
+    # Per-tenant admission (keyed on the request's Bearer token): token-
+    # bucket rate (requests/s; 0 = unlimited), burst (0 = max(1, rate)),
+    # and concurrent-request cap (0 = unlimited).
+    tenant_rate: float = 0.0
+    tenant_burst: float = 0.0
+    tenant_max_concurrent: int = 0
+    # Journal directory for replica lifecycle events
+    # (events-gateway.jsonl via telemetry/journal.py); "" = no journal.
+    journal_dir: str = ""
+
+    def __post_init__(self):
+        if self.router not in ("round_robin", "least_outstanding",
+                               "affinity"):
+            raise ValueError(
+                f"unknown gateway.router {self.router!r} "
+                "(round_robin|least_outstanding|affinity)"
+            )
+        if self.replicas < 1:
+            raise ValueError(f"gateway.replicas must be >= 1, got "
+                             f"{self.replicas}")
+        if self.max_attempts < 1:
+            raise ValueError(f"gateway.max_attempts must be >= 1, got "
+                             f"{self.max_attempts}")
+
+
+@dataclass(frozen=True)
 class Config:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -428,6 +486,7 @@ class Config:
     data: DataConfig = field(default_factory=DataConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     api: APIConfig = field(default_factory=APIConfig)
+    gateway: GatewayConfig = field(default_factory=GatewayConfig)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

@@ -1,0 +1,57 @@
+"""Serving gateway subsystem (ISSUE 4): one OpenAI-compatible front door
+over a fleet of engine replicas — cache-affinity routing (router.py),
+drain/restart supervision (replica.py), per-tenant admission
+(admission.py), and the proxying HTTP gateway itself (gateway.py).
+
+Stdlib-only by design: importing this package never touches jax, so the
+gateway can run as a thin front process and its logic is unit-testable
+against stub replicas."""
+
+from ditl_tpu.gateway.admission import (
+    AdmissionDecision,
+    TenantAdmission,
+    TokenBucket,
+    sanitize_label,
+    tenant_label,
+)
+from ditl_tpu.gateway.gateway import GatewayMetrics, make_gateway
+from ditl_tpu.gateway.replica import (
+    Fleet,
+    FleetSupervisor,
+    InProcessReplica,
+    ReplicaHandle,
+    ReplicaView,
+    SubprocessReplica,
+    gateway_journal_path,
+)
+from ditl_tpu.gateway.router import (
+    CacheAffinityPolicy,
+    LeastOutstandingPolicy,
+    RoundRobinPolicy,
+    affinity_key,
+    make_policy,
+    stable_hash,
+)
+
+__all__ = [
+    "AdmissionDecision",
+    "CacheAffinityPolicy",
+    "Fleet",
+    "FleetSupervisor",
+    "GatewayMetrics",
+    "InProcessReplica",
+    "LeastOutstandingPolicy",
+    "ReplicaHandle",
+    "ReplicaView",
+    "RoundRobinPolicy",
+    "SubprocessReplica",
+    "TenantAdmission",
+    "TokenBucket",
+    "affinity_key",
+    "gateway_journal_path",
+    "make_gateway",
+    "make_policy",
+    "sanitize_label",
+    "stable_hash",
+    "tenant_label",
+]

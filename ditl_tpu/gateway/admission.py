@@ -1,0 +1,205 @@
+"""Per-tenant admission control for the serving gateway (ISSUE 4).
+
+The single-replica server already protects the *device* (queue caps,
+``max_pending``), but nothing protects tenants from EACH OTHER: one client
+hammering the fleet starves everyone equally. This module is the fairness
+layer the gateway applies before any routing happens:
+
+- **Token-bucket rate limits** per tenant (requests/second with a burst
+  allowance) — the classic leaky-bucket shape every API gateway speaks, so
+  ``Retry-After`` can be computed exactly (time until the bucket holds a
+  token again) instead of guessed.
+- **Concurrency caps** per tenant — even a tenant within its rate can't
+  occupy the whole fleet's slots with long generations.
+
+Tenants are keyed on the request's API key (``Authorization: Bearer <key>``
+— the gateway extracts it; requests without one share the ``anonymous``
+tenant). Like everything in telemetry/, this is host-only stdlib code: no
+jax, no locks on any device path.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import re
+import threading
+import time
+from typing import Container
+
+__all__ = ["AdmissionDecision", "TenantAdmission", "TokenBucket",
+           "sanitize_label", "tenant_label"]
+
+
+def sanitize_label(s: str) -> str:
+    """Metric-name-safe tenant/replica label. API keys may hold arbitrary
+    bytes (and are secrets): keep only word characters and cap the length so
+    a tenant id can ride in a Prometheus metric NAME without breaking the
+    exposition — callers should pass tenant *names*, not live credentials,
+    when secrecy matters (docs/troubleshooting.md §22)."""
+    out = re.sub(r"[^A-Za-z0-9_]", "_", s or "")[:48]
+    return out or "anonymous"
+
+
+def tenant_label(tenant: str, known: Container[str] = ()) -> str:
+    """Exposition-safe tenant identifier. Tenants are keyed on the raw
+    Bearer token, which is usually a live credential — and /metrics and
+    /stats are unauthenticated, so the raw value must never reach them.
+    Explicitly configured tenant names (``TenantAdmission.per_tenant``
+    keys) and the ``anonymous`` tenant are operator-chosen public
+    identifiers and stay readable; EVERY other token is reduced to a short
+    stable digest (``t_<sha256[:12]>`` — enough to correlate a tenant
+    across scrapes without revealing the key; docs/troubleshooting.md §22
+    shows how to map a digest back to a key you hold)."""
+    if tenant == "anonymous" or tenant in known:
+        return sanitize_label(tenant)
+    digest = hashlib.sha256(
+        tenant.encode("utf-8", "surrogatepass")
+    ).hexdigest()[:12]
+    return f"t_{digest}"
+
+
+class TokenBucket:
+    """Thread-safe token bucket: ``rate`` tokens/second refill up to
+    ``burst`` capacity. ``try_take`` returns 0.0 on success or the seconds
+    until the requested tokens will be available (the Retry-After)."""
+
+    def __init__(self, rate: float, burst: float):
+        if rate <= 0:
+            raise ValueError(f"rate must be > 0, got {rate}")
+        self.rate = float(rate)
+        self.burst = max(1.0, float(burst))
+        self._tokens = self.burst
+        self._t_last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_take(self, n: float = 1.0) -> float:
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t_last) * self.rate
+            )
+            self._t_last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return 0.0
+            return (n - self._tokens) / self.rate
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionDecision:
+    ok: bool
+    retry_after_s: float = 0.0
+    reason: str = ""
+
+
+@dataclasses.dataclass
+class _TenantState:
+    bucket: TokenBucket | None
+    max_concurrent: int
+    active: int = 0
+    admitted: int = 0
+    throttled: int = 0
+
+
+class TenantAdmission:
+    """Admission policy over tenants. ``rate``/``burst``/``max_concurrent``
+    are the defaults applied to every tenant (0 = unlimited); ``per_tenant``
+    maps a tenant key to overrides, e.g. ``{"free-tier": {"rate": 1,
+    "burst": 2, "max_concurrent": 2}}``.
+
+    ``acquire`` is paired with ``release`` (the concurrency count); callers
+    MUST release exactly once per successful acquire (the gateway does so in
+    a ``finally``)."""
+
+    def __init__(
+        self,
+        rate: float = 0.0,
+        burst: float = 0.0,
+        max_concurrent: int = 0,
+        per_tenant: dict[str, dict] | None = None,
+        max_tenants: int = 4096,
+    ):
+        self.default_rate = float(rate)
+        self.default_burst = float(burst) if burst else max(1.0, float(rate))
+        self.default_max_concurrent = int(max_concurrent)
+        self.per_tenant = dict(per_tenant or {})
+        self.max_tenants = int(max_tenants)
+        self._tenants: collections.OrderedDict[str, _TenantState] = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def _state(self, tenant: str) -> _TenantState:
+        st = self._tenants.get(tenant)
+        if st is None:
+            cfg = self.per_tenant.get(tenant, {})
+            rate = float(cfg.get("rate", self.default_rate))
+            burst = float(cfg.get("burst", 0.0)) or (
+                self.default_burst if rate == self.default_rate
+                else max(1.0, rate)
+            )
+            st = _TenantState(
+                bucket=TokenBucket(rate, burst) if rate > 0 else None,
+                max_concurrent=int(
+                    cfg.get("max_concurrent", self.default_max_concurrent)
+                ),
+            )
+            self._tenants[tenant] = st
+            # Tenants arrive as arbitrary unauthenticated bearer tokens:
+            # without a cap, a client cycling random keys grows this map
+            # (and the per-tenant metric families downstream) without
+            # bound. Evict least-recently-seen INACTIVE tenants only —
+            # an evicted-and-returning tenant just gets a fresh bucket
+            # (strictly more permissive, never less fair).
+            if len(self._tenants) > self.max_tenants:
+                for key in list(self._tenants):
+                    if len(self._tenants) <= self.max_tenants:
+                        break
+                    if key != tenant and self._tenants[key].active == 0:
+                        del self._tenants[key]
+        else:
+            self._tenants.move_to_end(tenant)
+        return st
+
+    def acquire(self, tenant: str) -> AdmissionDecision:
+        with self._lock:
+            st = self._state(tenant)
+            if st.max_concurrent > 0 and st.active >= st.max_concurrent:
+                st.throttled += 1
+                return AdmissionDecision(
+                    False, retry_after_s=1.0,
+                    reason=f"tenant concurrency cap ({st.max_concurrent}) "
+                           "reached",
+                )
+            if st.bucket is not None:
+                wait = st.bucket.try_take(1.0)
+                if wait > 0:
+                    st.throttled += 1
+                    return AdmissionDecision(
+                        False, retry_after_s=wait,
+                        reason="tenant rate limit exceeded",
+                    )
+            st.active += 1
+            st.admitted += 1
+            return AdmissionDecision(True)
+
+    def release(self, tenant: str) -> None:
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None and st.active > 0:
+                st.active -= 1
+
+    def snapshot(self) -> dict:
+        """Per-tenant counters for /stats and the per-tenant metric names
+        (keys reduced via :func:`tenant_label` — raw API keys never leave
+        this module)."""
+        with self._lock:
+            return {
+                tenant_label(t, self.per_tenant): {
+                    "active": st.active,
+                    "admitted": st.admitted,
+                    "throttled": st.throttled,
+                }
+                for t, st in self._tenants.items()
+            }

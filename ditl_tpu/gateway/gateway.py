@@ -1,0 +1,723 @@
+"""The serving gateway front door (ISSUE 4 tentpole): one OpenAI-compatible
+HTTP endpoint over N engine replicas.
+
+``infer/server.py`` is one listener over one engine; this module is the
+layer above it that production serving actually needs — horizontal
+scale-out (a fleet of replicas behind one URL), failover (idempotent
+requests retry on surviving replicas when one dies mid-request),
+cache-aware routing (router.py's consistent-hash affinity policy feeds
+same-prefix/same-session traffic to the replica that already holds the
+prefix KV), and tenant isolation (admission.py's per-tenant token buckets
+and concurrency caps, applied before any routing).
+
+Surface:
+
+- ``POST /v1/completions``, ``/v1/chat/completions`` — routed + proxied,
+  including SSE streaming pass-through (chunks relay as they arrive).
+- ``POST /v1/embeddings``, ``/tokenize``, ``/detokenize`` — routed+proxied.
+- ``GET /v1/models`` — proxied from a live replica.
+- ``GET /health``, ``/stats`` — fleet state; ``GET /metrics`` — the
+  gateway's own Prometheus exposition (per-replica routed/retried/hedged
+  counts, affinity hit-rate, per-tenant throttles, fleet gauges).
+- ``429`` with a backlog-aware ``Retry-After`` when the WHOLE fleet is
+  saturated (every replica answered 429) or a tenant is over budget.
+
+The gateway is stdlib-only (no jax import anywhere in ditl_tpu/gateway):
+it must be runnable as a thin front process and unit-testable against stub
+replicas. Wire-up lives in ``launch.py gateway`` (subprocess replicas) and
+``bench.py --serve-replicas`` (in-process fleet benchmark).
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ditl_tpu.config import GatewayConfig
+from ditl_tpu.gateway.admission import (
+    TenantAdmission, sanitize_label, tenant_label,
+)
+from ditl_tpu.gateway.replica import Fleet, FleetSupervisor
+from ditl_tpu.gateway.router import affinity_key, make_policy
+from ditl_tpu.telemetry.registry import LATENCY_BUCKETS_S, MetricsRegistry
+from ditl_tpu.telemetry.serving import backlog_retry_after
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["GatewayMetrics", "make_gateway", "main"]
+
+PREFIX = "ditl_gateway"
+
+
+class GatewayMetrics:
+    """Gateway-side telemetry bundle (telemetry/registry.py instruments;
+    rendered by the gateway's /metrics). Per-replica and per-tenant
+    counters are created lazily with the id sanitized into the metric NAME
+    (the registry has no label support; each replica/tenant becomes its own
+    family, which the classic text format is fine with)."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._tenant_labels: set[str] = set()
+        r = self.registry
+        self.requests = r.counter(
+            f"{PREFIX}_requests", "requests received by the gateway")
+        self.completed = r.counter(
+            f"{PREFIX}_requests_completed", "requests relayed to completion")
+        self.retries = r.counter(
+            f"{PREFIX}_retries",
+            "proxy attempts retried on another replica (replica death/busy)")
+        self.hedges = r.counter(
+            f"{PREFIX}_hedges", "hedged duplicate requests fired")
+        self.throttled = r.counter(
+            f"{PREFIX}_throttled", "requests rejected by tenant admission")
+        self.saturated = r.counter(
+            f"{PREFIX}_fleet_saturated",
+            "requests 429'd because every replica was saturated")
+        self.no_replica = r.counter(
+            f"{PREFIX}_no_replica", "requests failed with no live replica")
+        self.stream_aborts = r.counter(
+            f"{PREFIX}_stream_aborts",
+            "streams cut mid-flight by a dying replica (not retryable)")
+        self.affinity_hits = r.counter(
+            f"{PREFIX}_affinity_hits",
+            "requests routed to the same replica as the previous request "
+            "with the same affinity key")
+        self.affinity_misses = r.counter(
+            f"{PREFIX}_affinity_misses",
+            "requests whose affinity key landed on a different replica "
+            "than last time")
+        self.e2e = r.histogram(
+            f"{PREFIX}_request_e2e_seconds",
+            "gateway receive -> response relayed", LATENCY_BUCKETS_S)
+        self.replicas_live = r.gauge(
+            f"{PREFIX}_replicas_live", "replicas currently routable")
+        self.replicas_draining = r.gauge(
+            f"{PREFIX}_replicas_draining", "replicas currently draining")
+
+    # Each distinct tenant label becomes its own metric family; tenants
+    # arrive as arbitrary unauthenticated bearer tokens, so beyond this
+    # many distinct labels the long tail aggregates into one
+    # `..._tenant_other_*` family instead of growing the registry (and
+    # the /metrics exposition) without bound.
+    MAX_TENANT_FAMILIES = 256
+
+    def replica_counter(self, replica_id: str, kind: str):
+        return self.registry.counter(
+            f"{PREFIX}_replica_{sanitize_label(replica_id)}_{kind}",
+            f"requests {kind} for replica {sanitize_label(replica_id)}")
+
+    def tenant_counter(self, tenant: str, kind: str):
+        label = sanitize_label(tenant)
+        if label not in self._tenant_labels:
+            if len(self._tenant_labels) >= self.MAX_TENANT_FAMILIES:
+                label = "other"
+            else:
+                self._tenant_labels.add(label)
+        return self.registry.counter(
+            f"{PREFIX}_tenant_{label}_{kind}",
+            f"requests {kind} for tenant {label}")
+
+    def affinity_ratio(self) -> float | None:
+        """Measured affinity hit-rate (hits / (hits + misses)); None before
+        any repeated key. Policy-independent: computed from where requests
+        actually LANDED, so round-robin and affinity are comparable on the
+        same trace."""
+        total = self.affinity_hits.value + self.affinity_misses.value
+        if total == 0:
+            return None
+        return self.affinity_hits.value / total
+
+    def render(self, fleet: Fleet | None = None) -> str:
+        if fleet is not None:
+            self.replicas_live.set(fleet.live_count())
+            self.replicas_draining.set(fleet.draining_count())
+        return self.registry.render()
+
+    def summary(self) -> dict:
+        out = self.registry.summary()
+        ratio = self.affinity_ratio()
+        if ratio is not None:
+            out[f"{PREFIX}_affinity_ratio"] = round(ratio, 4)
+        return out
+
+
+class GatewayHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, *args, **kwargs):
+        # (timestamp, completed) samples for the fleet-level backlog-aware
+        # Retry-After (same derivation the single server satellite uses).
+        self._rate_samples: collections.deque = collections.deque(maxlen=64)
+        super().__init__(*args, **kwargs)
+
+
+class _GatewayHandler(BaseHTTPRequestHandler):
+    # Injected by make_gateway:
+    fleet: Fleet = None
+    router = None
+    admission: TenantAdmission = None
+    gw: GatewayMetrics = None
+    gwcfg: GatewayConfig = None
+    # key -> replica id that last served it (affinity hit-rate measurement)
+    affinity_last: collections.OrderedDict = None
+    affinity_lock: threading.Lock = None
+
+    def log_message(self, *args):
+        logger.debug("gateway http: " + args[0], *args[1:])
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _send_json(self, status: int, payload: dict,
+                   retry_after: int | None = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        if retry_after is not None:
+            self.send_header("Retry-After", str(retry_after))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _tenant(self) -> str:
+        auth = self.headers.get("Authorization", "")
+        if auth.lower().startswith("bearer "):
+            return auth[7:].strip() or "anonymous"
+        return "anonymous"
+
+    def _sample_rate(self) -> None:
+        self.server._rate_samples.append(
+            (time.time(), self.gw.completed.value)
+        )
+
+    def _fleet_retry_after(self, floor: int = 1) -> int:
+        """Backlog-aware Retry-After for fleet-level 429s: total backlog
+        (queue + active across live replicas) over the gateway's recent
+        completion rate — the same telemetry.serving.backlog_retry_after
+        derivation the single server uses per replica."""
+        backlog = sum(
+            v.queue_depth + v.active_slots + v.outstanding
+            for v in self.fleet.views() if v.live
+        )
+        return backlog_retry_after(
+            self.server._rate_samples, backlog, floor=floor
+        )
+
+    # -- GET ----------------------------------------------------------------
+
+    def do_GET(self):
+        path = self.path.rstrip("/") or "/"
+        if path in ("/health", "/v1/health"):
+            live = self.fleet.live_count()
+            self._send_json(200 if live else 503, {
+                "status": "ok" if live else "no_live_replicas",
+                "replicas_live": live,
+                "replicas_draining": self.fleet.draining_count(),
+                "replicas_total": len(self.fleet.ids),
+            })
+        elif path in ("/stats", "/v1/stats"):
+            payload = {
+                "router": getattr(self.router, "name", "unknown"),
+                "replicas": {
+                    v.id: {
+                        "address": list(v.address),
+                        "live": v.live,
+                        "draining": v.draining,
+                        "outstanding": v.outstanding,
+                        "queue_depth": v.queue_depth,
+                        "active_slots": v.active_slots,
+                        "capacity": v.capacity,
+                    }
+                    for v in self.fleet.views()
+                },
+            }
+            ratio = self.gw.affinity_ratio()
+            if ratio is not None:
+                payload["affinity_ratio"] = round(ratio, 4)
+            if self.admission is not None:
+                payload["tenants"] = self.admission.snapshot()
+            self._send_json(200, payload)
+        elif path == "/metrics":
+            body = (self.gw.render(self.fleet)
+                    + f"\n# TYPE {PREFIX}_up gauge\n{PREFIX}_up 1\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path in ("/v1/models", "/models"):
+            self._proxy_get("/v1/models")
+        else:
+            self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _proxy_get(self, path: str) -> None:
+        for view in self.fleet.routable():
+            try:
+                with urllib.request.urlopen(
+                    f"http://{view.address[0]}:{view.address[1]}{path}",
+                    timeout=self.gwcfg.probe_timeout_s,
+                ) as resp:
+                    self._send_json(resp.status, json.loads(resp.read()))
+                    return
+            except (urllib.error.URLError, OSError, ValueError):
+                self.fleet.note_failure(view.id)
+                continue
+        self._send_json(503, {"error": {"message": "no live replica"}})
+
+    # -- POST ---------------------------------------------------------------
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) or b"{}"
+            payload = json.loads(raw)
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": {"message": f"bad request: {e}"}})
+            return
+        path = self.path.rstrip("/")
+        if path.endswith(("/chat/completions", "/completions", "/embeddings")):
+            self.gw.requests.inc()
+            self._admit_and_route(path, payload, raw)
+        elif path.endswith(("/tokenize", "/detokenize")):
+            # Metadata routes: cheap, not admission-controlled, and kept
+            # OUT of the serving instruments (record=False) — a stream of
+            # millisecond tokenize calls would otherwise inflate the
+            # measured completion rate behind Retry-After and corrupt the
+            # affinity hit-rate the router A/B records.
+            self.gw.requests.inc()
+            self._route_and_relay(path, payload, raw, record=False)
+        else:
+            self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _admit_and_route(self, path: str, payload: dict, raw: bytes) -> None:
+        m = self.gw
+        tenant = self._tenant()
+        if self.admission is not None:
+            # Raw Bearer token keys the admission state (per_tenant
+            # overrides match on it); metrics get the credential-safe
+            # label only (/metrics is unauthenticated).
+            label = tenant_label(tenant, self.admission.per_tenant)
+            decision = self.admission.acquire(tenant)
+            if not decision.ok:
+                m.throttled.inc()
+                m.tenant_counter(label, "throttled").inc()
+                self._send_json(
+                    429,
+                    {"error": {"message": decision.reason,
+                               "type": "rate_limit_error"}},
+                    retry_after=max(1, min(30, math.ceil(
+                        decision.retry_after_s))),
+                )
+                return
+            m.tenant_counter(label, "admitted").inc()
+        t0 = time.time()
+        try:
+            self._route_and_relay(path, payload, raw)
+        finally:
+            if self.admission is not None:
+                self.admission.release(tenant)
+            m.e2e.observe(time.time() - t0)
+
+    def _route_and_relay(self, path: str, payload: dict, raw: bytes,
+                         record: bool = True) -> None:
+        m, cfg = self.gw, self.gwcfg
+        stream = bool(payload.get("stream"))
+        key = affinity_key(payload, cfg.affinity_prefix_tokens)
+        tried: list[str] = []
+        saw_busy = False
+        busy_hint = 0
+        for attempt in range(max(1, cfg.max_attempts)):
+            candidates = self.fleet.routable(exclude=tried)
+            if not candidates:
+                break
+            view = self.router.pick(key, candidates)
+            if record:
+                if attempt > 0:
+                    m.retries.inc()
+                    m.replica_counter(view.id, "retried").inc()
+                m.replica_counter(view.id, "routed").inc()
+            elif attempt > 0:
+                m.retries.inc()
+            hedge_peers = (
+                [v for v in candidates if v.id != view.id]
+                if cfg.hedge_after_s > 0 and not stream else []
+            )
+            # The gateway's own in-flight count is the live half of the
+            # load signal (least-outstanding, affinity spill, hedge-peer
+            # choice, rolling_restart's drain-wait all read it); health-poll
+            # queue depth alone is a full interval stale.
+            self.fleet.inc_outstanding(view.id)
+            try:
+                outcome, info = self._relay_one(
+                    view, path, raw, stream, hedge_peers
+                )
+            finally:
+                self.fleet.dec_outstanding(view.id)
+            if outcome == "done":
+                if record:
+                    self._note_affinity(key, info or view.id)
+                    m.completed.inc()
+                    self._sample_rate()
+                return
+            if outcome == "aborted":
+                # Bytes already relayed; nothing more the gateway can do.
+                m.stream_aborts.inc()
+                return
+            if outcome == "busy":
+                saw_busy = True
+                hint, busy_id = info
+                busy_hint = max(busy_hint, hint)
+                # Exclude the replica that actually SAID busy — under
+                # hedging that can be the peer, not the primary (a merely
+                # slow primary stays eligible for the next attempt).
+                tried.append(busy_id)
+            else:
+                tried.append(view.id)
+        if saw_busy:
+            m.saturated.inc()
+            self._send_json(
+                429,
+                {"error": {"message": "fleet saturated; retry later",
+                           "type": "rate_limit_error"}},
+                retry_after=self._fleet_retry_after(floor=busy_hint),
+            )
+        else:
+            m.no_replica.inc()
+            self._send_json(503, {"error": {
+                "message": "no live replica available"}})
+
+    # -- relaying -----------------------------------------------------------
+
+    def _open(self, view, path: str, raw: bytes):
+        """One upstream request; returns (conn, resp) or raises OSError/
+        HTTPException on connection-level failure (retryable — no bytes
+        have been relayed to the client yet)."""
+        conn = http.client.HTTPConnection(
+            view.address[0], view.address[1],
+            timeout=self.gwcfg.request_timeout_s,
+        )
+        try:
+            conn.request("POST", path, body=raw, headers={
+                "Content-Type": "application/json",
+                "Authorization": self.headers.get("Authorization", ""),
+            })
+            return conn, conn.getresponse()
+        except BaseException:
+            conn.close()
+            raise
+
+    def _relay_one(self, view, path, raw, stream, hedge_peers):
+        """Proxy one attempt. Returns (outcome, info):
+        ``("done", served_replica_id)`` — response relayed;
+        ``("retry", None)`` — connection-level failure, safe to fail over;
+        ``("busy", (retry_after, busy_replica_id))`` — a replica said
+        429/503 (spill; under hedging the busy answer can come from the
+        peer rather than the primary);
+        ``("aborted", None)`` — died mid-stream after bytes were relayed."""
+        served = view.id
+        try:
+            if hedge_peers:
+                conn, resp, served = self._hedged_open(
+                    view, hedge_peers, path, raw
+                )
+            else:
+                conn, resp = self._open(view, path, raw)
+        except (OSError, http.client.HTTPException):
+            self.fleet.note_failure(view.id)
+            return ("retry", None)
+        try:
+            if resp.status in (429, 503):
+                try:
+                    hint = int(resp.getheader("Retry-After") or 1)
+                except ValueError:
+                    hint = 1
+                resp.read()
+                return ("busy", (hint, served))
+            ctype = resp.getheader("Content-Type", "application/json")
+            if stream and ctype.startswith("text/event-stream"):
+                return (self._relay_stream(view, resp, ctype), served)
+            try:
+                data = resp.read()
+            except (OSError, http.client.HTTPException):
+                # Full response never arrived: nothing relayed, retryable.
+                self.fleet.note_failure(view.id)
+                return ("retry", None)
+            self.send_response(resp.status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+            return ("done", served)
+        finally:
+            conn.close()
+
+    def _relay_stream(self, view, resp, ctype) -> str:
+        """SSE pass-through: relay chunks as they arrive (read1 returns
+        whatever the socket holds, preserving incremental delivery). The
+        FIRST upstream chunk is read before any header goes to the client,
+        so a replica dying at stream start is still retryable — once our
+        200 is out, a death can only abort."""
+        try:
+            first = resp.read1(65536)
+        except (OSError, http.client.HTTPException):
+            self.fleet.note_failure(view.id)
+            return "retry"
+        self.send_response(resp.status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        try:
+            chunk = first
+            while chunk:
+                self.wfile.write(chunk)
+                self.wfile.flush()
+                chunk = resp.read1(65536)
+            return "done"
+        except (OSError, http.client.HTTPException):
+            self.fleet.note_failure(view.id)
+            logger.warning("replica %s died mid-stream", view.id)
+            return "aborted"
+
+    def _hedged_open(self, view, peers, path, raw):
+        """Tail-latency hedging (non-streaming only): if the primary has
+        not answered within ``hedge_after_s``, fire the same request at the
+        least-loaded peer and take whichever responds first. The loser's
+        connection is abandoned (its replica finishes the wasted work —
+        the standard hedging trade). Completions are idempotent from the
+        client's perspective, so duplicates are safe."""
+        pool = ThreadPoolExecutor(max_workers=2)
+        try:
+            primary = pool.submit(self._open, view, path, raw)
+            done, _ = wait([primary], timeout=self.gwcfg.hedge_after_s)
+            if done:
+                conn, resp = primary.result()  # may raise: caller retries
+                return conn, resp, view.id
+            peer = min(peers, key=lambda v: v.outstanding + v.queue_depth)
+            self.gw.hedges.inc()
+            self.gw.replica_counter(peer.id, "hedged").inc()
+            secondary = pool.submit(self._open, peer, path, raw)
+            futures = {primary: view.id, secondary: peer.id}
+            last_exc: BaseException | None = None
+            pending = set(futures)
+            while pending:
+                done, pending = wait(pending, return_when=FIRST_COMPLETED)
+                for f in done:
+                    try:
+                        conn, resp = f.result()
+                    except BaseException as e:
+                        last_exc = e
+                        continue
+                    # Abandon every loser: the still-pending future AND any
+                    # that completed in the same wake-up (both can land in
+                    # `done` at once — its connection must close too, not
+                    # leak an FD per hedge).
+                    for other in done | pending:
+                        if other is not f:
+                            other.add_done_callback(_close_result)
+                    return conn, resp, futures[f]
+            raise last_exc  # both failed
+        finally:
+            pool.shutdown(wait=False)
+
+    def _note_affinity(self, key, replica_id: str) -> None:
+        if key is None:
+            return
+        with self.affinity_lock:
+            prev = self.affinity_last.get(key)
+            if prev is not None:
+                if prev == replica_id:
+                    self.gw.affinity_hits.inc()
+                else:
+                    self.gw.affinity_misses.inc()
+            self.affinity_last[key] = replica_id
+            self.affinity_last.move_to_end(key)
+            while len(self.affinity_last) > 4096:
+                self.affinity_last.popitem(last=False)
+
+
+def _close_result(future) -> None:
+    try:
+        conn, _resp = future.result()
+        conn.close()
+    except BaseException:
+        pass
+
+
+def make_gateway(
+    fleet: Fleet,
+    *,
+    config: GatewayConfig | None = None,
+    router=None,
+    admission: TenantAdmission | None = None,
+    metrics: GatewayMetrics | None = None,
+    host: str | None = None,
+    port: int | None = None,
+) -> GatewayHTTPServer:
+    """Build (not start) the gateway server over ``fleet`` — tests drive it
+    on a thread, ``main`` drives it with ``serve_forever``. ``router``
+    defaults to the config's policy; ``admission`` defaults to the config's
+    tenant budgets (None when the config sets no limits — requests are then
+    admitted unconditionally)."""
+    config = config or GatewayConfig()
+    if router is None:
+        router = make_policy(config.router)
+    if admission is None and (
+        config.tenant_rate > 0 or config.tenant_max_concurrent > 0
+    ):
+        admission = TenantAdmission(
+            rate=config.tenant_rate, burst=config.tenant_burst,
+            max_concurrent=config.tenant_max_concurrent,
+        )
+    handler = type(
+        "BoundGatewayHandler",
+        (_GatewayHandler,),
+        {
+            "fleet": fleet,
+            "router": router,
+            "admission": admission,
+            "gw": metrics if metrics is not None else GatewayMetrics(),
+            "gwcfg": config,
+            "affinity_last": collections.OrderedDict(),
+            "affinity_lock": threading.Lock(),
+        },
+    )
+    return GatewayHTTPServer(
+        (host if host is not None else config.host,
+         port if port is not None else config.port),
+        handler,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``python -m ditl_tpu.launch gateway``: spawn N subprocess replicas
+    of ``infer/server.py`` and front them with one gateway endpoint."""
+    import argparse
+    import signal
+    import sys
+
+    from ditl_tpu.config import Config, parse_overrides
+    from ditl_tpu.gateway.replica import (
+        SubprocessReplica, gateway_journal_path,
+    )
+    from ditl_tpu.telemetry.journal import EventJournal
+
+    parser = argparse.ArgumentParser(prog="ditl_tpu.launch gateway")
+    parser.add_argument("--preset", default=None,
+                        help="model preset for every replica")
+    parser.add_argument("--tokenizer", default="byte")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--engine", choices=("lockstep", "continuous"),
+                        default="continuous")
+    parser.add_argument("--slots", type=int, default=8,
+                        help="decode slots per replica (continuous engine)")
+    parser.add_argument("--max-queue", type=int, default=32,
+                        help="per-replica admission queue cap (replica "
+                        "429s beyond it; the gateway spills/429s in turn)")
+    parser.add_argument("--replica-arg", action="append", default=[],
+                        metavar="ARG",
+                        help="extra argument passed through to every "
+                        "ditl_tpu.infer.server replica (repeatable), e.g. "
+                        "--replica-arg=--cache-mode --replica-arg=paged")
+    parser.add_argument("overrides", nargs="*",
+                        help="gateway config overrides like "
+                        "gateway.router=affinity gateway.replicas=4")
+    args = parser.parse_args(argv)
+
+    config = parse_overrides(
+        Config(),
+        [o for o in args.overrides if o.startswith("gateway.")],
+    ).gateway
+
+    def build_argv(port: int):
+        cmd = [sys.executable, "-m", "ditl_tpu.infer.server",
+               "--host", "127.0.0.1", "--port", str(port),
+               "--tokenizer", args.tokenizer,
+               "--engine", args.engine]
+        if args.engine == "continuous":
+            cmd += ["--slots", str(args.slots),
+                    "--max-queue", str(args.max_queue)]
+        if args.preset:
+            cmd += ["--preset", args.preset]
+        if args.checkpoint_dir:
+            cmd += ["--checkpoint-dir", args.checkpoint_dir]
+        return cmd + list(args.replica_arg)
+
+    journal = None
+    if config.journal_dir:
+        journal = EventJournal(
+            gateway_journal_path(config.journal_dir), source="gateway"
+        )
+    handles = [
+        SubprocessReplica(f"r{i}", build_argv)
+        for i in range(config.replicas)
+    ]
+    fleet = Fleet(handles)
+    supervisor = None
+    server = None
+    # One finally covers startup too: a replica that never turns healthy
+    # (bad --preset, broken checkpoint) raises out of start_all, and the
+    # other N-1 subprocess replicas must not be left orphaned holding
+    # ports and devices.
+    try:
+        logger.info("starting %d replica(s)...", config.replicas)
+        fleet.start_all(wait_healthy_s=config.restart_timeout_s)
+        supervisor = FleetSupervisor(
+            fleet,
+            interval_s=config.health_interval_s,
+            fail_threshold=config.fail_threshold,
+            probe_timeout_s=config.probe_timeout_s,
+            restart_timeout_s=config.restart_timeout_s,
+            journal=journal,
+        )
+        supervisor.start()
+        server = make_gateway(fleet, config=config)
+        stopping = threading.Event()
+
+        def _shutdown(signum, frame):
+            if not stopping.is_set():
+                stopping.set()
+                threading.Thread(target=server.shutdown, daemon=True).start()
+
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                signal.signal(sig, _shutdown)
+            except ValueError:
+                pass
+        logger.info(
+            "gateway serving %d replica(s) on %s:%d (router=%s)",
+            config.replicas, *server.server_address[:2], config.router,
+        )
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+    finally:
+        if supervisor is not None:
+            supervisor.stop()
+        if server is not None:
+            server.server_close()
+        fleet.stop_all(drain=True, timeout=config.drain_timeout_s)
+        if journal is not None:
+            journal.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    from ditl_tpu.utils.logging import setup_logging
+
+    setup_logging()
+    sys.exit(main())

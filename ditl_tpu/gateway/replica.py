@@ -1,0 +1,612 @@
+"""Replica handles and fleet supervision for the serving gateway (ISSUE 4).
+
+A *replica* is one ``infer/server.py`` instance — spawned in a thread
+(:class:`InProcessReplica`, how tests and single-host fleets run) or as a
+subprocess (:class:`SubprocessReplica`, how ``launch.py gateway`` runs).
+The handle owns the replica's lifecycle (start / drain / stop / kill /
+restart) and its liveness probe (GET ``/health``, which since ISSUE 4 also
+carries the load signal: queue depth, active slots, draining state).
+
+:class:`Fleet` is the shared routing state the gateway reads on every
+request (live/draining flags, gateway-tracked outstanding counts, the last
+health snapshot), and :class:`FleetSupervisor` is the control loop that
+reuses the elastic playbook from ``runtime/elastic.py`` at the serving
+layer: health-check failure -> **died** -> **drain** (stop routing, let
+in-flight finish) -> **relaunch** -> **re-admit**, every transition
+journaled through ``telemetry/journal.py`` so "what happened when replica
+r1 died" is an ordered artifact, not interleaved log archaeology. The same
+loop's :meth:`FleetSupervisor.rolling_restart` drains and restarts the
+fleet one replica at a time — with the gateway routing around the draining
+replica, a rolling restart completes with zero failed requests.
+
+Everything here is stdlib-only (no jax): the supervisor must stay
+responsive while a replica wedges, and the gateway must be importable
+without a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Sequence
+
+from ditl_tpu.telemetry.journal import EventJournal
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "Fleet",
+    "FleetSupervisor",
+    "InProcessReplica",
+    "ReplicaHandle",
+    "ReplicaView",
+    "SubprocessReplica",
+    "gateway_journal_path",
+]
+
+
+def gateway_journal_path(directory: str) -> str:
+    """The gateway's journal file — an ``events-*.jsonl`` sibling of the
+    elastic controller's, so ``merge_journals`` folds serving and training
+    events into one pod timeline when they share a directory."""
+    import os
+
+    return os.path.join(directory, "events-gateway.jsonl")
+
+
+class ReplicaHandle:
+    """Lifecycle + probe surface every replica kind implements."""
+
+    def __init__(self, replica_id: str):
+        self.id = replica_id
+
+    # lifecycle ------------------------------------------------------------
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        raise NotImplementedError
+
+    def kill(self) -> None:
+        raise NotImplementedError
+
+    def restart(self) -> None:
+        """Stop (hard, if still up) and start fresh. After a ``kill`` the
+        stop side is a no-op; after a graceful drain it already happened."""
+        self.stop(drain=False, timeout=0.0)
+        self.start()
+
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    # probes ---------------------------------------------------------------
+    @property
+    def address(self) -> tuple[str, int] | None:
+        raise NotImplementedError
+
+    def _get(self, path: str, timeout: float) -> dict | None:
+        addr = self.address
+        if addr is None:
+            return None
+        try:
+            with urllib.request.urlopen(
+                f"http://{addr[0]}:{addr[1]}{path}", timeout=timeout
+            ) as resp:
+                return json.loads(resp.read())
+        except (urllib.error.URLError, OSError, ValueError):
+            return None
+
+    def fetch_health(self, timeout: float = 2.0) -> dict | None:
+        return self._get("/health", timeout)
+
+    def fetch_stats(self, timeout: float = 2.0) -> dict | None:
+        return self._get("/stats", timeout)
+
+
+class InProcessReplica(ReplicaHandle):
+    """A replica served on a thread inside this process. ``server_factory``
+    builds a fresh (unstarted) ``DrainableHTTPServer`` — typically a
+    closure over ``infer.server.make_server`` binding port 0, so every
+    (re)launch gets a fresh port and the engine behind it can be reused
+    across restarts ("adopt" semantics: the expensive compiled engine
+    outlives the HTTP front that died)."""
+
+    def __init__(self, replica_id: str, server_factory: Callable[[], object]):
+        super().__init__(replica_id)
+        self._factory = server_factory
+        self._server = None
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._server = self._factory()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"replica-{self.id}",
+        )
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        server, self._server = self._server, None
+        if server is None:
+            return
+        try:
+            if drain:
+                server.close(drain=True, timeout=timeout)
+            else:
+                server.kill()
+        except OSError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def kill(self) -> None:
+        """Abrupt death (the in-process stand-in for kill -9): sever the
+        listening socket and every open connection; see
+        ``DrainableHTTPServer.kill``."""
+        server, self._server = self._server, None
+        if server is not None:
+            server.kill()
+
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive() \
+            and self._server is not None
+
+    @property
+    def address(self) -> tuple[str, int] | None:
+        server = self._server
+        if server is None:
+            return None
+        host, port = server.server_address[:2]
+        return (host, port)
+
+
+class SubprocessReplica(ReplicaHandle):
+    """A replica in its own OS process (``python -m ditl_tpu.infer.server
+    ...``). ``build_argv(port)`` produces the command line; each
+    (re)launch binds a fresh port (a SIGKILLed listener can linger in
+    TIME_WAIT — the same reason runtime/elastic.py bumps its coordinator
+    port per generation). ``stop(drain=True)`` sends SIGTERM, which the
+    server satellite turns into a graceful drain."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        build_argv: Callable[[int], Sequence[str]],
+        *,
+        host: str = "127.0.0.1",
+        port_factory: Callable[[], int] | None = None,
+        env: dict | None = None,
+    ):
+        super().__init__(replica_id)
+        self._build_argv = build_argv
+        self._host = host
+        if port_factory is None:
+            from ditl_tpu.runtime.elastic import free_port
+
+            port_factory = free_port
+        self._port_factory = port_factory
+        self._env = env
+        self._proc: subprocess.Popen | None = None
+        self._port: int | None = None
+
+    def start(self) -> None:
+        self._port = self._port_factory()
+        self._proc = subprocess.Popen(
+            list(self._build_argv(self._port)), env=self._env
+        )
+
+    def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        proc, self._proc = self._proc, None
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            if drain:
+                proc.terminate()  # SIGTERM -> server drains and exits
+                try:
+                    proc.wait(timeout=timeout)
+                    return
+                except subprocess.TimeoutExpired:
+                    logger.warning(
+                        "replica %s did not drain in %.1fs; killing",
+                        self.id, timeout,
+                    )
+            proc.kill()
+            proc.wait(timeout=10.0)
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        proc, self._proc = self._proc, None
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.kill()
+                proc.wait(timeout=10.0)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    @property
+    def address(self) -> tuple[str, int] | None:
+        if self._port is None:
+            return None
+        return (self._host, self._port)
+
+
+@dataclasses.dataclass(frozen=True)
+class ReplicaView:
+    """Immutable routing snapshot of one replica (what router policies
+    see). ``outstanding`` is the gateway's own in-flight count (instant);
+    ``queue_depth``/``active_slots`` come from the last health poll
+    (slightly stale, refreshed every supervisor interval)."""
+
+    id: str
+    address: tuple[str, int]
+    outstanding: int
+    queue_depth: int
+    active_slots: int
+    capacity: int
+    live: bool
+    draining: bool
+
+
+@dataclasses.dataclass
+class _ReplicaState:
+    handle: ReplicaHandle
+    live: bool = False
+    draining: bool = False
+    restarting: bool = False
+    outstanding: int = 0
+    fails: int = 0
+    health: dict = dataclasses.field(default_factory=dict)
+    restarts: int = 0
+
+
+class Fleet:
+    """Thread-safe shared state over a set of replica handles — the data
+    plane's view (gateway reads it per request) and the control plane's
+    (the supervisor writes it per poll)."""
+
+    def __init__(self, handles: Sequence[ReplicaHandle],
+                 default_capacity: int = 8):
+        if not handles:
+            raise ValueError("a fleet needs at least one replica")
+        ids = [h.id for h in handles]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        self.default_capacity = default_capacity
+        self._lock = threading.Lock()
+        self._states = {h.id: _ReplicaState(handle=h) for h in handles}
+
+    @property
+    def ids(self) -> list[str]:
+        return list(self._states)
+
+    def handle(self, replica_id: str) -> ReplicaHandle:
+        return self._states[replica_id].handle
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start_all(self, wait_healthy_s: float = 0.0) -> None:
+        """Start every replica; optionally block until each answers
+        /health (subprocess replicas pay a jax import + engine build before
+        the port even opens)."""
+        for st in self._states.values():
+            st.handle.start()
+        if wait_healthy_s > 0:
+            deadline = time.monotonic() + wait_healthy_s
+            for rid in self.ids:
+                while time.monotonic() < deadline:
+                    if self.probe(rid):
+                        break
+                    time.sleep(0.1)
+                else:
+                    raise TimeoutError(
+                        f"replica {rid} not healthy after "
+                        f"{wait_healthy_s:.0f}s"
+                    )
+
+    def stop_all(self, drain: bool = True, timeout: float = 30.0) -> None:
+        for st in self._states.values():
+            st.handle.stop(drain=drain, timeout=timeout)
+            with self._lock:
+                st.live = False
+
+    def probe(self, replica_id: str, timeout: float = 2.0) -> bool:
+        """One health poll, folded into the routing state. Returns True if
+        the replica answered."""
+        st = self._states[replica_id]
+        health = st.handle.fetch_health(timeout=timeout)
+        with self._lock:
+            if health is None:
+                st.fails += 1
+                # One refused connect is already definitive when the
+                # process/thread is gone; stale-but-alive needs the
+                # supervisor's threshold.
+                if not st.handle.alive():
+                    st.live = False
+            else:
+                st.fails = 0
+                st.live = True
+                st.health = health
+                # A replica draining ITSELF (SIGTERM) must fall out of
+                # routing even if the gateway didn't initiate the drain.
+                if health.get("draining"):
+                    st.draining = True
+        return health is not None
+
+    # -- routing-plane accessors -------------------------------------------
+
+    def _view(self, st: _ReplicaState) -> ReplicaView | None:
+        addr = st.handle.address
+        if addr is None:
+            return None
+        h = st.health
+        n_slots = int(h.get("n_slots", 0)) or self.default_capacity
+        return ReplicaView(
+            id=st.handle.id,
+            address=addr,
+            outstanding=st.outstanding,
+            queue_depth=int(h.get("queue_depth", 0)),
+            active_slots=int(h.get("active_slots", 0)),
+            capacity=n_slots,
+            live=st.live,
+            draining=st.draining,
+        )
+
+    def routable(self, exclude: Sequence[str] = ()) -> list[ReplicaView]:
+        """Live, non-draining replicas (minus ``exclude`` — the ones this
+        request already failed on)."""
+        with self._lock:
+            views = [
+                self._view(st) for rid, st in self._states.items()
+                if st.live and not st.draining and rid not in exclude
+            ]
+        return [v for v in views if v is not None]
+
+    def views(self) -> list[ReplicaView]:
+        with self._lock:
+            views = [self._view(st) for st in self._states.values()]
+        return [v for v in views if v is not None]
+
+    def live_count(self) -> int:
+        with self._lock:
+            return sum(st.live for st in self._states.values())
+
+    def draining_count(self) -> int:
+        with self._lock:
+            return sum(st.draining for st in self._states.values())
+
+    # -- data-plane bookkeeping --------------------------------------------
+
+    def inc_outstanding(self, replica_id: str) -> None:
+        with self._lock:
+            self._states[replica_id].outstanding += 1
+
+    def dec_outstanding(self, replica_id: str) -> None:
+        with self._lock:
+            st = self._states[replica_id]
+            st.outstanding = max(0, st.outstanding - 1)
+
+    def outstanding(self, replica_id: str) -> int:
+        with self._lock:
+            return self._states[replica_id].outstanding
+
+    def note_failure(self, replica_id: str) -> None:
+        """The gateway observed a connection error proxying to this
+        replica: mark it down IMMEDIATELY if its process/thread is gone
+        (routing must not wait a poll interval to stop feeding a corpse);
+        otherwise bump the failure count for the supervisor's threshold."""
+        with self._lock:
+            st = self._states[replica_id]
+            st.fails += 1
+            if not st.handle.alive():
+                st.live = False
+
+    def mark_draining(self, replica_id: str, draining: bool) -> None:
+        with self._lock:
+            self._states[replica_id].draining = draining
+
+    def _state(self, replica_id: str) -> _ReplicaState:
+        return self._states[replica_id]
+
+
+class FleetSupervisor:
+    """Health-poll loop + recovery state machine over a :class:`Fleet`.
+
+    Poll every ``interval_s``; a replica whose process died, or that missed
+    ``fail_threshold`` consecutive health checks, takes the recovery path::
+
+        replica.died -> replica.drain -> replica.relaunch -> replica.readmit
+
+    each step journaled (``events-gateway.jsonl``). The same primitives
+    compose into :meth:`rolling_restart`, the zero-downtime fleet restart.
+    """
+
+    def __init__(
+        self,
+        fleet: Fleet,
+        *,
+        interval_s: float = 0.5,
+        fail_threshold: int = 3,
+        probe_timeout_s: float = 2.0,
+        restart_timeout_s: float = 120.0,
+        max_restarts_per_replica: int = 10,
+        journal: EventJournal | None = None,
+        log: Callable[[str], None] | None = None,
+    ):
+        self.fleet = fleet
+        self.interval_s = interval_s
+        self.fail_threshold = fail_threshold
+        self.probe_timeout_s = probe_timeout_s
+        self.restart_timeout_s = restart_timeout_s
+        self.max_restarts_per_replica = max_restarts_per_replica
+        self._journal = journal
+        self._journal_lock = threading.Lock()
+        self._log = log or (lambda msg: logger.info("%s", msg))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._recoveries: dict[str, threading.Thread] = {}
+        self._given_up: set[str] = set()
+
+    def journal_event(self, event: str, **attrs) -> None:
+        if self._journal is not None:
+            with self._journal_lock:
+                self._journal.event(event, **attrs)
+
+    # -- loop ---------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="fleet-supervisor"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        # Recovery threads watch _stop inside _await_healthy; give them a
+        # moment to unwind (daemon threads — a wedged restart never blocks
+        # process exit).
+        for t in list(self._recoveries.values()):
+            t.join(timeout=5.0)
+        self._recoveries.clear()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("fleet supervisor poll failed")
+
+    def poll_once(self) -> None:
+        for rid in self.fleet.ids:
+            if self._stop.is_set():
+                return
+            st = self.fleet._state(rid)
+            if st.restarting or rid in self._given_up:
+                continue
+            self.fleet.probe(rid, timeout=self.probe_timeout_s)
+            dead = (not st.handle.alive()) or st.fails >= self.fail_threshold
+            if dead and not st.restarting:
+                # Recover on a per-replica thread: a relaunch can block up
+                # to restart_timeout_s, and the poll loop must keep probing
+                # (and recovering) the REST of the fleet meanwhile.
+                st.restarting = True
+                t = threading.Thread(
+                    target=self._recover, args=(rid,), daemon=True,
+                    name=f"recover-{rid}",
+                )
+                self._recoveries[rid] = t
+                t.start()
+
+    # -- recovery -----------------------------------------------------------
+
+    def _recover(self, rid: str) -> None:
+        """Run one died -> drain -> relaunch -> re-admit cycle. The caller
+        (poll_once / tests) sets ``st.restarting`` BEFORE invoking so the
+        poll loop cannot double-recover; this method clears it."""
+        st = self.fleet._state(rid)
+        try:
+            if st.restarts >= self.max_restarts_per_replica:
+                self._log(f"replica {rid}: restart budget exhausted "
+                          f"({st.restarts}); leaving dead")
+                st.live = False
+                self._given_up.add(rid)
+                return
+            st.live = False
+            self.journal_event("replica.died", replica=rid,
+                              fails=st.fails,
+                              process_alive=st.handle.alive())
+            self._log(f"replica {rid}: died (failed health checks: "
+                      f"{st.fails}); draining routing")
+            # Drain: routing already stopped (live=False); anything still
+            # in flight on the gateway side fails over via its retry path.
+            self.fleet.mark_draining(rid, True)
+            self.journal_event("replica.drain", replica=rid)
+            st.restarts += 1
+            self.journal_event("replica.relaunch", replica=rid,
+                              attempt=st.restarts)
+            self._log(f"replica {rid}: relaunching "
+                      f"(attempt {st.restarts})")
+            st.handle.restart()
+            if self._await_healthy(rid):
+                st.fails = 0
+                self.fleet.mark_draining(rid, False)
+                self.journal_event("replica.readmit", replica=rid,
+                                  address=list(st.handle.address or ()))
+                self._log(f"replica {rid}: healthy again; re-admitted")
+            else:
+                self.journal_event("replica.restart_failed", replica=rid,
+                                  attempt=st.restarts)
+                self._log(f"replica {rid}: relaunch did not become healthy "
+                          f"within {self.restart_timeout_s:.0f}s")
+                # fails stays >= threshold: next poll retries recovery.
+                st.fails = max(st.fails, self.fail_threshold)
+        finally:
+            st.restarting = False
+
+    def _await_healthy(self, rid: str) -> bool:
+        deadline = time.monotonic() + self.restart_timeout_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            if self.fleet.probe(rid, timeout=self.probe_timeout_s):
+                return True
+            time.sleep(min(0.2, self.interval_s))
+        return False
+
+    # -- rolling restart ----------------------------------------------------
+
+    def rolling_restart(self, drain_timeout_s: float = 60.0) -> None:
+        """Restart every replica one at a time with zero failed requests:
+        drain (gateway stops routing to it; in-flight work finishes inside
+        the replica's own ``close(drain=True)``), relaunch, wait healthy,
+        re-admit — then the next replica. Requires >= 2 replicas to be
+        zero-downtime (the rest of the fleet absorbs the traffic)."""
+        for rid in self.fleet.ids:
+            st = self.fleet._state(rid)
+            st.restarting = True  # the poll loop must not double-recover
+            try:
+                self.fleet.mark_draining(rid, True)
+                self.journal_event("replica.drain", replica=rid,
+                                  rolling=True)
+                self._log(f"rolling restart: draining {rid}")
+                # Wait for the gateway's own in-flight proxies to clear;
+                # the replica-side close(drain=True) below then has nothing
+                # (or only direct clients) to wait on.
+                deadline = time.monotonic() + drain_timeout_s
+                while (self.fleet.outstanding(rid) > 0
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                st.handle.stop(drain=True, timeout=drain_timeout_s)
+                st.live = False
+                # A planned restart does NOT consume the crash-restart
+                # budget (max_restarts_per_replica guards crash LOOPS);
+                # nightly rolling restarts must never leave a replica
+                # permanently dead on its first real failure.
+                self.journal_event("replica.relaunch", replica=rid,
+                                  rolling=True)
+                st.handle.start()
+                if not self._await_healthy(rid):
+                    self.journal_event("replica.restart_failed",
+                                      replica=rid, rolling=True)
+                    raise TimeoutError(
+                        f"rolling restart: {rid} not healthy within "
+                        f"{self.restart_timeout_s:.0f}s"
+                    )
+                st.fails = 0
+                self.fleet.mark_draining(rid, False)
+                self.journal_event("replica.readmit", replica=rid,
+                                  rolling=True)
+                self._log(f"rolling restart: {rid} re-admitted")
+            finally:
+                st.restarting = False

@@ -1,0 +1,163 @@
+"""Routing policies for the serving gateway (ISSUE 4 tentpole).
+
+The fleet-level scheduling question is "which replica should serve this
+request?", and the answer depends on what you optimize:
+
+- ``round_robin`` — spread blindly; the baseline every comparison runs
+  against.
+- ``least_outstanding`` — spread by LIVE load (gateway-tracked in-flight
+  count plus the replica's last-reported queue depth); the Orca/vLLM-style
+  answer once replicas run continuous batching, because a replica mid-way
+  through long generations is not an equal target.
+- ``affinity`` — consistent hashing over a request's affinity key (an
+  explicit ``session_id``, else the prompt's leading tokens) so same-prefix
+  and same-session traffic lands on the SAME replica, whose
+  ``PageAllocator.match_prefix`` (infer/paged_cache.py) then reuses the
+  prefix KV automatically — the SGLang/RadixAttention observation that
+  prefix-cache hit rate is a *routing* property at fleet scale. Saturated
+  home replicas spill to the least-loaded peer (correctness first, locality
+  second), and consistent hashing confines the remap blast radius of a
+  dead replica to that replica's own keys.
+
+Policies are pure host code over ``ReplicaView`` snapshots (replica.py);
+no jax, no I/O — unit-testable with plain namedtuples.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import itertools
+import threading
+
+__all__ = ["CacheAffinityPolicy", "LeastOutstandingPolicy",
+           "RoundRobinPolicy", "affinity_key", "make_policy", "stable_hash"]
+
+POLICIES = ("round_robin", "least_outstanding", "affinity")
+
+
+def stable_hash(s: str) -> int:
+    """Process-independent 64-bit hash (Python's ``hash`` is salted per
+    process; a routing ring must agree across gateway restarts)."""
+    return int.from_bytes(
+        hashlib.sha1(s.encode("utf-8", "surrogatepass")).digest()[:8], "big"
+    )
+
+
+def affinity_key(payload: dict, prefix_tokens: int) -> str | None:
+    """The request's routing key: an explicit ``session_id`` (or OpenAI
+    ``user``) wins; otherwise the first ``prefix_tokens`` whitespace tokens
+    of the prompt/conversation. Whitespace tokens, not model tokens — the
+    gateway has no tokenizer, and any stable prefix function partitions
+    same-prefix traffic identically. None = no key (sampled spread)."""
+    sid = payload.get("session_id") or payload.get("user")
+    if sid:
+        return f"sid:{sid}"
+    if isinstance(payload.get("messages"), list):
+        text = "\x1e".join(
+            str(m.get("content", "")) for m in payload["messages"]
+            if isinstance(m, dict)
+        )
+    else:
+        prompt = payload.get("prompt")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        text = prompt if isinstance(prompt, str) else ""
+    toks = text.split()
+    if not toks:
+        return None
+    return "pfx:" + " ".join(toks[:max(1, prefix_tokens)])
+
+
+def _load(view) -> tuple:
+    """Comparable load: gateway-observed in-flight + replica-reported queue
+    depth, tie-broken by id for determinism."""
+    return (view.outstanding + view.queue_depth, view.id)
+
+
+class RoundRobinPolicy:
+    name = "round_robin"
+
+    def __init__(self):
+        self._counter = itertools.count()
+
+    def pick(self, key, candidates):
+        ordered = sorted(candidates, key=lambda v: v.id)
+        return ordered[next(self._counter) % len(ordered)]
+
+
+class LeastOutstandingPolicy:
+    name = "least_outstanding"
+
+    def pick(self, key, candidates):
+        return min(candidates, key=_load)
+
+
+class CacheAffinityPolicy:
+    """Consistent-hash ring with ``vnodes`` virtual nodes per replica.
+    The ring is built from the CANDIDATE set (live, non-draining replicas)
+    and cached by membership, so a dead replica remaps only its own keys
+    while every other key keeps its home — the property that preserves the
+    fleet's accumulated prefix caches through churn."""
+
+    name = "affinity"
+
+    def __init__(self, vnodes: int = 64):
+        self.vnodes = vnodes
+        self._rings: dict[frozenset, tuple[list[int], list[str]]] = {}
+        self._lock = threading.Lock()
+        self._fallback = LeastOutstandingPolicy()
+
+    def _ring(self, ids: frozenset) -> tuple[list[int], list[str]]:
+        with self._lock:
+            ring = self._rings.get(ids)
+            if ring is None:
+                points = sorted(
+                    (stable_hash(f"{rid}#{v}"), rid)
+                    for rid in ids for v in range(self.vnodes)
+                )
+                ring = ([h for h, _ in points], [r for _, r in points])
+                # Membership churn is tiny (fleet size); keep the cache from
+                # growing without bound across many generations anyway.
+                if len(self._rings) > 64:
+                    self._rings.clear()
+                self._rings[ids] = ring
+        return ring
+
+    def home(self, key: str, candidates) -> object:
+        """The key's home replica on the current ring (ignoring load)."""
+        by_id = {v.id: v for v in candidates}
+        hashes, rids = self._ring(frozenset(by_id))
+        i = bisect.bisect_left(hashes, stable_hash(key)) % len(rids)
+        return by_id[rids[i]]
+
+    def pick(self, key, candidates):
+        if key is None:
+            return self._fallback.pick(key, candidates)
+        by_id = {v.id: v for v in candidates}
+        hashes, rids = self._ring(frozenset(by_id))
+        start = bisect.bisect_left(hashes, stable_hash(key))
+        # Walk the ring from the key's position: the first UNSATURATED
+        # replica wins. Walking (rather than jumping straight to
+        # least-loaded) keeps the spill target deterministic per key, so
+        # even spilled traffic builds cache on a consistent secondary.
+        seen: set[str] = set()
+        for j in range(len(rids)):
+            rid = rids[(start + j) % len(rids)]
+            if rid in seen:
+                continue
+            seen.add(rid)
+            view = by_id[rid]
+            if view.outstanding + view.queue_depth < max(1, view.capacity):
+                return view
+        return self._fallback.pick(key, candidates)
+
+
+def make_policy(name: str):
+    if name == "round_robin":
+        return RoundRobinPolicy()
+    if name == "least_outstanding":
+        return LeastOutstandingPolicy()
+    if name == "affinity":
+        return CacheAffinityPolicy()
+    raise ValueError(f"unknown router policy {name!r} (one of {POLICIES})")

@@ -24,8 +24,10 @@ CLI (any host of a pod; serving is process-0-gated):
 from __future__ import annotations
 
 import argparse
+import collections
 import dataclasses
 import json
+import socket
 import threading
 import time
 import uuid
@@ -40,7 +42,128 @@ from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-__all__ = ["serve", "make_server"]
+__all__ = ["DrainableHTTPServer", "serve", "make_server"]
+
+
+class DrainableHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a graceful-drain lifecycle — the primitive
+    the gateway's rolling restart (ditl_tpu/gateway/) builds on:
+
+    - ``drain()`` flips ``/health`` to ``{"status": "draining"}`` and makes
+      new completion/embedding work answer 503; in-flight requests finish.
+    - ``close(drain=True)`` drains, waits for in-flight work to complete
+      (bounded), then stops the serve loop and closes the socket — the
+      ``SIGTERM`` disposition ``serve()`` installs.
+    - ``kill()`` is the abrupt path (the in-process stand-in for kill -9):
+      stop accepting, close the listening socket, and sever every open
+      client connection mid-flight — clients observe connection reset /
+      refused exactly as they would for a SIGKILLed process, which is what
+      the gateway's retry-on-replica-death drills exercise.
+
+    In-flight accounting covers the *completion-shaped* POST work (the
+    device-occupying routes); metadata GETs are never blocked by a drain so
+    health polling keeps working while draining.
+    """
+
+    def __init__(self, *args, **kwargs):
+        self.draining = False
+        self._inflight = 0
+        self._idle = threading.Condition()
+        self._conns: set = set()
+        self._conn_lock = threading.Lock()
+        # (timestamp, completed-counter) samples for the backlog-aware
+        # Retry-After derivation (_Handler._retry_after_s).
+        self._rate_samples: collections.deque = collections.deque(maxlen=64)
+        super().__init__(*args, **kwargs)
+
+    # -- connection tracking (for kill()) -----------------------------------
+
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        # Severed connections (client gone, or kill() cut the socket) are
+        # expected during drills — log, don't stack-trace to stderr.
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, BrokenPipeError, OSError)):
+            logger.debug("connection error from %s: %s", client_address, exc)
+            return
+        super().handle_error(request, client_address)
+
+    # -- in-flight accounting ----------------------------------------------
+
+    def _enter_request(self) -> int:
+        """Register one in-flight completion; returns the new count (the
+        lockstep admission cap compares it against ``max_pending``)."""
+        with self._idle:
+            self._inflight += 1
+            return self._inflight
+
+    def _exit_request(self) -> None:
+        with self._idle:
+            self._inflight -= 1
+            self._idle.notify_all()
+
+    @property
+    def inflight(self) -> int:
+        return self._inflight
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def drain(self) -> None:
+        """Stop accepting new work (503) while in-flight requests finish;
+        /health reports ``draining`` so a router stops sending traffic."""
+        self.draining = True
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Block until no completion work is in flight. Returns False on
+        timeout (callers may proceed to a hard stop)."""
+        with self._idle:
+            return self._idle.wait_for(
+                lambda: self._inflight == 0, timeout=timeout
+            )
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Graceful shutdown: drain, wait for in-flight work (bounded by
+        ``timeout``), stop the serve loop, close the socket. Must be called
+        from a thread other than the one running ``serve_forever``."""
+        if drain:
+            self.drain()
+            if not self.wait_idle(timeout):
+                logger.warning(
+                    "drain timed out after %.1fs with %d request(s) in "
+                    "flight; closing anyway", timeout, self._inflight,
+                )
+        self.shutdown()
+        self.server_close()
+
+    def kill(self) -> None:
+        """Abrupt death: close the listening socket and sever every open
+        client connection. From the network's perspective this is
+        indistinguishable from the process being SIGKILLed — new connects
+        are refused, in-flight requests see a reset."""
+        self.shutdown()
+        self.server_close()
+        with self._conn_lock:
+            conns = list(self._conns)
+        for s in conns:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
 
 def _stop_list(stop) -> list[str]:
@@ -129,6 +252,12 @@ class _Handler(BaseHTTPRequestHandler):
     model_name: str = "ditl-tpu"
     device_lock: threading.Lock = None
     default_max_tokens: int = 64
+    # Admission cap for the handler-thread-per-request paths: completions
+    # beyond this many in flight answer 429 instead of piling up on the
+    # device lock (None = unbounded, the historical behavior). The
+    # continuous engine has its own queue cap (--max-queue); this one is
+    # the LOCKSTEP overload control.
+    max_pending: int = None
     adapter_names: dict = {}  # multi-LoRA: request "model" name -> adapter id
     grammar_cache = None  # guided decoding: spec-key -> CompiledGrammar LRU
     grammar_lock: threading.Lock = None
@@ -149,23 +278,78 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _load_snapshot(self) -> dict:
+        """The load signal routers consume (gateway/router.py
+        least-outstanding): queue depth + active slots, from the engine's
+        stats when a continuous engine serves, else from the server's own
+        in-flight accounting (the device lock serializes, so the lockstep
+        server is a 1-slot engine with ``inflight - 1`` waiting)."""
+        eng = self._engine_for_stats()
+        if eng is not None:
+            st = eng.stats()
+            return {
+                "queue_depth": int(st.get("queue_depth", 0)),
+                "active_slots": int(st.get("slots_busy", 0)),
+                "n_slots": int(st.get("n_slots", 1)),
+            }
+        inflight = int(getattr(self.server, "inflight", 0))
+        return {
+            "queue_depth": max(0, inflight - 1),
+            "active_slots": min(1, inflight),
+            "n_slots": 1,
+        }
+
+    def _sample_service_rate(self) -> None:
+        """Append a (now, completed) sample for the Retry-After derivation;
+        called after every completion-shaped request (cheap host reads)."""
+        samples = getattr(self.server, "_rate_samples", None)
+        if samples is not None and self.serving_metrics is not None:
+            samples.append((time.time(), self.serving_metrics.completed.value))
+
+    def _retry_after_s(self) -> int:
+        """Backlog-aware Retry-After: how long until the CURRENT backlog
+        (queued + active requests) clears at the recently measured service
+        rate — the shared telemetry.serving.backlog_retry_after derivation
+        (clamped [1, 30] s, stale samples aged out), replacing the old
+        hardcoded 1 s that synchronized the whole herd's retries onto the
+        same instant."""
+        from ditl_tpu.telemetry.serving import backlog_retry_after
+
+        self._sample_service_rate()
+        load = self._load_snapshot()
+        backlog = load["queue_depth"] + load["active_slots"]
+        samples = getattr(self.server, "_rate_samples", None)
+        return backlog_retry_after(samples or (), backlog)
+
     def _send_429(self, message: str) -> None:
-        """OpenAI rate-limit shape: clients back off and retry."""
+        """OpenAI rate-limit shape: clients back off and retry, spaced by
+        the backlog-aware Retry-After (was a hardcoded 1 s, which
+        synchronized the whole herd's retries onto the same instant)."""
         body = json.dumps({"error": {
             "message": message, "type": "rate_limit_error",
         }}).encode()
         self.send_response(429)
         self.send_header("Content-Type", "application/json")
-        self.send_header("Retry-After", "1")
+        self.send_header("Retry-After", str(self._retry_after_s()))
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):
         if self.path in ("/health", "/v1/health"):
-            self._send_json(200, {"status": "ok", "model": self.model_name})
+            draining = bool(getattr(self.server, "draining", False))
+            payload = {
+                "status": "draining" if draining else "ok",
+                "model": self.model_name,
+                "draining": draining,
+            }
+            payload.update(self._load_snapshot())
+            self._send_json(200, payload)
         elif self.path in ("/v1/stats", "/stats"):
-            stats = {"model": self.model_name, "engine": "lockstep"}
+            stats = {"model": self.model_name, "engine": "lockstep",
+                     "draining": bool(getattr(self.server, "draining", False)),
+                     "inflight": int(getattr(self.server, "inflight", 0))}
+            stats.update(self._load_snapshot())
             eng = self._engine_for_stats()
             if eng is not None:
                 stats.update(eng.stats())
@@ -263,16 +447,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": {"message": f"bad request: {e}"}})
             return
         path = self.path.rstrip("/")
-        if path.endswith("/chat/completions"):
-            self._complete(payload, chat=True)
-        elif path.endswith("/completions"):
-            self._complete(payload, chat=False)
-        elif path.endswith("/embeddings"):
-            try:
-                self._embeddings(payload)
-            except Exception as e:
-                logger.exception("embeddings failed")
-                self._send_json(500, {"error": {"message": str(e)}})
+        if path.endswith(("/chat/completions", "/completions", "/embeddings")):
+            self._device_work(payload, path)
         elif path.endswith("/tokenize"):
             tok = self.generator.tokenizer
             text = payload.get("prompt")
@@ -298,6 +474,47 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"prompt": tok.decode(ids)})
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _device_work(self, payload: dict, path: str) -> None:
+        """Admission wrapper for the device-occupying POST routes
+        (completions / chat completions / embeddings): reject while
+        draining (503 — the rolling-restart protocol; a router retries on a
+        peer replica), count in-flight work (the drain wait and the
+        lockstep load signal), and apply the lockstep overload cap
+        (``max_pending``) with a real 429 instead of an unbounded pile-up
+        on the device lock."""
+        srv = self.server
+        if getattr(srv, "draining", False):
+            self._send_json(503, {"error": {
+                "message": "server is draining; retry on another replica",
+                "type": "unavailable_error",
+            }})
+            return
+        tracked = hasattr(srv, "_enter_request")
+        n = srv._enter_request() if tracked else 0
+        try:
+            if self.max_pending is not None and n > self.max_pending:
+                if self.serving_metrics is not None:
+                    self.serving_metrics.queue_full.inc()
+                self._send_429(
+                    f"server at capacity ({self.max_pending} requests in "
+                    "flight)"
+                )
+                return
+            if path.endswith("/chat/completions"):
+                self._complete(payload, chat=True)
+            elif path.endswith("/completions"):
+                self._complete(payload, chat=False)
+            else:
+                try:
+                    self._embeddings(payload)
+                except Exception as e:
+                    logger.exception("embeddings failed")
+                    self._send_json(500, {"error": {"message": str(e)}})
+        finally:
+            if tracked:
+                srv._exit_request()
+            self._sample_service_rate()
 
     def _observe_lockstep(self, t0: float, n_gen: int) -> None:
         """Telemetry for requests the LOCK-STEP path served (the continuous
@@ -1126,15 +1343,22 @@ def make_server(
     threaded_engine=None,
     adapter_names: dict | None = None,
     spec_generator=None,
-) -> ThreadingHTTPServer:
+    max_pending: int | None = None,
+) -> DrainableHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
     continuous batching instead of the lock-step Generator;
     ``adapter_names`` maps OpenAI "model" names to multi-LoRA adapter ids
     (the generator's params must be a stacked-adapter tree);
     ``spec_generator`` (Speculative/AutoSpeculativeGenerator) serves greedy
-    lock-step requests — streaming and non-streaming — speculatively."""
-    import collections
+    lock-step requests — streaming and non-streaming — speculatively;
+    ``max_pending`` caps concurrent in-flight completion work (429 beyond
+    it) — the lockstep overload control.
+
+    The returned :class:`DrainableHTTPServer` supports ``drain()`` /
+    ``close(drain=True)`` (graceful: /health flips to draining, new work
+    gets 503, in-flight finishes) and ``kill()`` (abrupt, for failover
+    drills)."""
 
     # One telemetry bundle per server: the continuous engine's own when one
     # is serving (its scheduler records into it), else a fresh bundle the
@@ -1157,9 +1381,10 @@ def make_server(
             "grammar_lock": threading.Lock(),
             "embed_cache": collections.OrderedDict(),
             "serving_metrics": serving_metrics,
+            "max_pending": max_pending,
         },
     )
-    return ThreadingHTTPServer((host, port), handler)
+    return DrainableHTTPServer((host, port), handler)
 
 
 def serve(argv: list[str] | None = None) -> int:
@@ -1206,6 +1431,12 @@ def serve(argv: list[str] | None = None) -> int:
         "--max-queue", type=int, default=0,
         help="admission-queue depth cap for --engine continuous; beyond it "
         "requests get HTTP 429 (0 = unbounded)",
+    )
+    parser.add_argument(
+        "--max-pending", type=int, default=0,
+        help="cap on concurrent in-flight completion requests (the lockstep "
+        "overload control — beyond it requests get HTTP 429 instead of "
+        "piling up on the device lock); 0 = unbounded",
     )
     parser.add_argument(
         "--admission", choices=("reserve", "optimistic"), default="reserve",
@@ -1546,7 +1777,26 @@ def serve(argv: list[str] | None = None) -> int:
         generator, host=args.host, port=args.port, model_name=cfg.name,
         default_max_tokens=args.max_tokens, threaded_engine=threaded,
         adapter_names=adapter_names, spec_generator=spec,
+        max_pending=args.max_pending or None,
     )
+
+    # SIGTERM = graceful drain (the gateway/orchestrator rolling-restart
+    # protocol): /health flips to draining so routers stop sending traffic,
+    # new work answers 503, in-flight requests finish, then the serve loop
+    # exits. close() must not run on the serve_forever thread, so the
+    # handler hands it to a helper thread.
+    import signal as _signal
+
+    def _on_sigterm(signum, frame):
+        logger.info("SIGTERM: draining (in-flight requests will finish)")
+        threading.Thread(
+            target=server.close, kwargs={"drain": True}, daemon=True
+        ).start()
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass  # not the main thread (embedded serve()); drain via close()
     logger.info("serving %s (%s) on %s:%d", cfg.name, args.engine, args.host, args.port)
     try:
         server.serve_forever()

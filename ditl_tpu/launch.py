@@ -292,6 +292,16 @@ def _pod_size(argv: list[str]) -> int:
 def main(argv: list[str] | None = None) -> int:
     if argv is None:
         argv = sys.argv[1:]
+    if argv and argv[0] == "gateway":
+        # Serving-gateway subcommand (ditl_tpu/gateway/, ISSUE 4): spawn N
+        # subprocess replicas of infer/server.py and front them with one
+        # OpenAI-compatible endpoint. Deliberately dispatched before the
+        # training argparse — the gateway has its own CLI surface.
+        from ditl_tpu.gateway.gateway import main as gateway_main
+        from ditl_tpu.utils.logging import setup_logging
+
+        setup_logging()
+        return gateway_main(argv[1:])
     if "--supervise" in argv:
         return run_process_supervised(argv, max(1, _pod_size(argv)))
     config = build_config(argv)

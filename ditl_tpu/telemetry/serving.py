@@ -32,13 +32,53 @@ device syncs (registry.py's rule).
 
 from __future__ import annotations
 
+import math
+import time
+from typing import Iterable
+
 from ditl_tpu.telemetry.registry import (
     LATENCY_BUCKETS_S,
     MetricsRegistry,
     TOKEN_LATENCY_BUCKETS_S,
 )
 
-__all__ = ["ServingMetrics"]
+__all__ = ["ServingMetrics", "backlog_retry_after"]
+
+
+def backlog_retry_after(
+    samples: Iterable[tuple[float, float]],
+    backlog: int,
+    *,
+    floor: int = 1,
+    now: float | None = None,
+    max_age_s: float = 60.0,
+    clamp_s: int = 30,
+) -> int:
+    """Backlog-aware ``Retry-After``: seconds until ``backlog`` requests
+    clear at the recently measured service rate, clamped to
+    ``[max(1, floor), clamp_s]``. ``samples`` are ``(wall_time,
+    cumulative_completed)`` pairs; only the last ``max_age_s`` worth count —
+    an hour-old sample would otherwise collapse the measured rate to ~zero
+    and send a trivial backlog straight to the clamp. With no measurable
+    rate (cold start, burst before the first completion) the estimate
+    degrades to one second per backlogged request — still
+    backlog-proportional, so client herds honoring Retry-After
+    (client/llm.py) space out instead of synchronizing. Shared by
+    ``infer/server.py`` (per-replica 429s) and ``gateway/gateway.py``
+    (fleet-level 429s); jax-free like everything in telemetry/."""
+    now = time.time() if now is None else now
+    # Callers pass a LIVE deque that other handler threads append to
+    # mid-overload (exactly when 429s fire); tuple() snapshots it in one
+    # C-level pass, where iterating directly would raise "deque mutated
+    # during iteration".
+    recent = [(t, c) for t, c in tuple(samples) if now - t <= max_age_s]
+    rate = 0.0
+    if len(recent) >= 2:
+        (t0, c0), (t1, c1) = recent[0], recent[-1]
+        if t1 - t0 >= 0.5 and c1 > c0:
+            rate = (c1 - c0) / (t1 - t0)
+    estimate = backlog / rate if rate > 0 else float(1 + backlog)
+    return max(1, floor, min(clamp_s, math.ceil(estimate)))
 
 PREFIX = "ditl_serving"
 

@@ -116,3 +116,23 @@ def test_heartbeat_timeout_requires_dir():
         TrainConfig(heartbeat_timeout_s=30.0)
     TrainConfig(heartbeat_dir="/tmp/hb", heartbeat_timeout_s=30.0)
     TrainConfig()  # both unset stays legal
+
+
+def test_gateway_config_overrides_and_validation():
+    from ditl_tpu.config import Config, GatewayConfig, parse_overrides
+
+    cfg = parse_overrides(
+        Config(),
+        ["gateway.router=least_outstanding", "gateway.replicas=4",
+         "gateway.tenant_rate=2.5", "gateway.affinity_prefix_tokens=16"],
+    ).gateway
+    assert cfg.router == "least_outstanding"
+    assert cfg.replicas == 4
+    assert cfg.tenant_rate == 2.5
+    assert cfg.affinity_prefix_tokens == 16
+    with pytest.raises(ValueError, match="gateway.router"):
+        GatewayConfig(router="random")
+    with pytest.raises(ValueError, match="replicas"):
+        GatewayConfig(replicas=0)
+    with pytest.raises(ValueError, match="max_attempts"):
+        GatewayConfig(max_attempts=0)

@@ -1,0 +1,839 @@
+"""Serving gateway (ISSUE 4): multi-replica fleet behind one
+OpenAI-compatible endpoint — cache-affinity routing, failover with
+supervised restart, rolling restart under load, and per-tenant admission.
+
+Two tiers of coverage in one file:
+
+- jax-free unit tests over stub replicas (routing ring properties,
+  admission math, hedging, fleet-saturated 429) — these never build an
+  engine;
+- acceptance tests over a REAL fleet of 3 in-process tiny-model replicas
+  (continuous engines), shared module-wide: replica HTTP fronts are
+  killed/restarted per test while the compiled engines persist across
+  restarts ("adopt" semantics), which is what keeps the whole drill
+  tier-1-speed.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import pytest
+
+from ditl_tpu.config import GatewayConfig, ModelConfig
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.gateway import (
+    Fleet,
+    FleetSupervisor,
+    GatewayMetrics,
+    InProcessReplica,
+    ReplicaView,
+    TenantAdmission,
+    TokenBucket,
+    affinity_key,
+    gateway_journal_path,
+    make_gateway,
+    make_policy,
+)
+from ditl_tpu.gateway.admission import sanitize_label, tenant_label
+from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.infer.server import make_server
+from ditl_tpu.models import llama
+from ditl_tpu.telemetry.journal import EventJournal, read_journal
+from tests.prom_helpers import exposition_index, sample_family
+
+pytestmark = pytest.mark.gateway
+
+
+# ---------------------------------------------------------------------------
+# Unit layer: routing / admission (no jax, no servers)
+# ---------------------------------------------------------------------------
+
+
+def _view(rid, outstanding=0, queue_depth=0, capacity=4):
+    return ReplicaView(
+        id=rid, address=("127.0.0.1", 0), outstanding=outstanding,
+        queue_depth=queue_depth, active_slots=0, capacity=capacity,
+        live=True, draining=False,
+    )
+
+
+def test_affinity_ring_is_stable_and_consistent():
+    policy = make_policy("affinity")
+    views = [_view(f"r{i}") for i in range(4)]
+    homes = {f"key-{k}": policy.pick(f"key-{k}", views).id for k in range(64)}
+    # Deterministic: the same key maps to the same replica every time.
+    for k, rid in homes.items():
+        assert policy.pick(k, views).id == rid
+    # All replicas get some keys (64 keys over 4 replicas, vnodes smooth it).
+    assert len(set(homes.values())) == 4
+    # Consistency: removing one replica remaps ONLY its own keys.
+    dead = views[2].id
+    survivors = [v for v in views if v.id != dead]
+    for k, rid in homes.items():
+        new = policy.pick(k, survivors).id
+        if rid != dead:
+            assert new == rid, f"key {k} moved {rid}->{new} though {rid} lives"
+
+
+def test_affinity_spills_deterministically_when_home_saturated():
+    policy = make_policy("affinity")
+    views = [_view(f"r{i}", capacity=2) for i in range(3)]
+    key = "hot-prefix"
+    home = policy.pick(key, views).id
+    saturated = [
+        _view(v.id, outstanding=2 if v.id == home else 0, capacity=2)
+        for v in views
+    ]
+    spill = policy.pick(key, saturated)
+    assert spill.id != home
+    # Same key spills to the SAME secondary (ring-walk order), so even
+    # spilled traffic warms a consistent replica.
+    assert policy.pick(key, saturated).id == spill.id
+    # Home recovers -> traffic returns home.
+    assert policy.pick(key, views).id == home
+
+
+def test_least_outstanding_and_round_robin():
+    lo = make_policy("least_outstanding")
+    views = [_view("r0", outstanding=3), _view("r1", queue_depth=1),
+             _view("r2", outstanding=2)]
+    assert lo.pick(None, views).id == "r1"
+    rr = make_policy("round_robin")
+    picks = [rr.pick(None, views).id for _ in range(6)]
+    assert picks == ["r0", "r1", "r2", "r0", "r1", "r2"]
+
+
+def test_affinity_key_extraction():
+    assert affinity_key({"session_id": "s1", "prompt": "x y"}, 4) == "sid:s1"
+    assert affinity_key({"prompt": "a b c d e f"}, 4) == "pfx:a b c d"
+    assert affinity_key({"prompt": "a b"}, 4) == "pfx:a b"
+    assert affinity_key(
+        {"messages": [{"role": "user", "content": "hello there friend"}]}, 2
+    ) == "pfx:hello there"
+    assert affinity_key({"prompt": ""}, 4) is None
+    assert affinity_key({"prompt": ["listed prompt text"]}, 2) == \
+        "pfx:listed prompt"
+
+
+def test_token_bucket_and_tenant_admission():
+    bucket = TokenBucket(rate=10.0, burst=2.0)
+    assert bucket.try_take() == 0.0
+    assert bucket.try_take() == 0.0
+    wait = bucket.try_take()
+    assert 0.0 < wait <= 0.1  # refills at 10/s
+    adm = TenantAdmission(rate=0.001, burst=2, max_concurrent=0)
+    assert adm.acquire("a").ok and adm.acquire("a").ok
+    denied = adm.acquire("a")
+    assert not denied.ok and denied.retry_after_s > 0
+    # Tenant isolation: b has its own bucket. (Unconfigured tenants are
+    # digested in the snapshot — bearer tokens are credentials.)
+    assert adm.acquire("b").ok
+    snap = adm.snapshot()
+    a_label, b_label = tenant_label("a"), tenant_label("b")
+    assert snap[a_label]["throttled"] == 1
+    assert snap[b_label]["throttled"] == 0
+    # Concurrency cap path.
+    adm2 = TenantAdmission(max_concurrent=1)
+    assert adm2.acquire("t").ok
+    assert not adm2.acquire("t").ok
+    adm2.release("t")
+    assert adm2.acquire("t").ok
+    assert sanitize_label("sk-abc/123!") == "sk_abc_123_"
+    assert sanitize_label("") == "anonymous"
+    # Exposition-safe tenant identity: configured tenant names stay
+    # readable, any OTHER bearer token (a live credential) is digested so
+    # it can never be harvested from unauthenticated /metrics or /stats.
+    assert tenant_label("free-tier", known={"free-tier": {}}) == "free_tier"
+    assert tenant_label("anonymous") == "anonymous"
+    secret = "sk_live_abc123DEF456"
+    label = tenant_label(secret)
+    assert secret not in label and label.startswith("t_")
+    assert tenant_label(secret) == label  # stable across calls
+    snap_adm = TenantAdmission(rate=100.0)
+    assert snap_adm.acquire(secret).ok
+    assert list(snap_adm.snapshot()) == [label]
+
+
+def test_tenant_state_and_metric_families_are_bounded():
+    """Tenants arrive as arbitrary unauthenticated bearer tokens: neither
+    the admission state nor the per-tenant metric families may grow
+    without bound when a client cycles random keys."""
+    adm = TenantAdmission(rate=100.0, max_tenants=4)
+    for i in range(10):
+        assert adm.acquire(f"key-{i}").ok
+        adm.release(f"key-{i}")
+    assert len(adm.snapshot()) <= 4
+    # An ACTIVE tenant is never evicted, however many keys churn past.
+    adm2 = TenantAdmission(rate=100.0, max_tenants=2)
+    assert adm2.acquire("sticky").ok  # held, not released
+    for i in range(8):
+        assert adm2.acquire(f"churn-{i}").ok
+        adm2.release(f"churn-{i}")
+    assert tenant_label("sticky") in adm2.snapshot()
+    # Metric families: beyond the cap, the long tail lands in "other".
+    m = GatewayMetrics()
+    m.MAX_TENANT_FAMILIES = 2
+    m.tenant_counter("t1", "admitted").inc()
+    m.tenant_counter("t2", "admitted").inc()
+    m.tenant_counter("t3", "admitted").inc()
+    m.tenant_counter("t4", "admitted").inc()
+    body = m.registry.render()
+    assert "ditl_gateway_tenant_t1_admitted_total" in body
+    assert "ditl_gateway_tenant_t3_admitted_total" not in body
+    assert "ditl_gateway_tenant_other_admitted_total 2" in body
+
+
+def test_backlog_retry_after_ages_out_stale_samples():
+    """The shared Retry-After derivation (telemetry/serving.py — both the
+    single server and the gateway use it) must age out stale rate samples:
+    an hour-old sample would collapse the measured service rate to ~zero
+    and send a trivial backlog straight to the 30 s clamp."""
+    from ditl_tpu.telemetry.serving import backlog_retry_after
+
+    now = 1000.0
+    recent = [(now - 2.0, 100.0), (now - 0.5, 110.0)]  # ~6.7 done/s
+    assert backlog_retry_after(recent, 5, now=now) <= 2
+    # One sample from an hour ago + one fresh: only the fresh one counts,
+    # so the estimate degrades to the 1 s/backlogged-request fallback
+    # instead of backlog / (50 completions / 3600 s) -> clamp.
+    stale = [(now - 3600.0, 0.0), (now, 50.0)]
+    assert backlog_retry_after(stale, 1, now=now) <= 2
+    # No rate yet: backlog-proportional, clamped to [max(1, floor), 30].
+    assert backlog_retry_after([], 1, now=now) == 2
+    assert backlog_retry_after([], 100, now=now) == 30
+    assert backlog_retry_after([], 0, now=now, floor=5) == 5
+
+
+# ---------------------------------------------------------------------------
+# Stub-replica layer: gateway proxy behaviors without any engine
+# ---------------------------------------------------------------------------
+
+
+class _StubServer(ThreadingHTTPServer):
+    """Minimal replica stand-in with the DrainableHTTPServer lifecycle the
+    InProcessReplica handle drives."""
+
+    daemon_threads = True
+    allow_reuse_address = True
+    behavior = "ok"  # "ok" | "slow" | "busy" | "draining"
+    delay_s = 0.0
+    label = "stub"
+
+    def close(self, drain=True, timeout=30.0):
+        self.shutdown()
+        self.server_close()
+
+    def kill(self):
+        self.close()
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, status, payload, headers=()):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path == "/health":
+            draining = self.server.behavior == "draining"
+            self._json(200, {
+                "status": "draining" if draining else "ok",
+                "model": "stub", "draining": draining,
+                "queue_depth": 0, "active_slots": 0, "n_slots": 2,
+            })
+        else:
+            self._json(404, {"error": {"message": "no route"}})
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0)))
+        behavior = self.server.behavior
+        if behavior == "busy":
+            self._json(429, {"error": {"message": "queue full",
+                                       "type": "rate_limit_error"}},
+                       headers=[("Retry-After", "2")])
+            return
+        if behavior == "draining":
+            self._json(503, {"error": {"message": "draining"}})
+            return
+        if self.server.delay_s:
+            time.sleep(self.server.delay_s)
+        self._json(200, {
+            "object": "text_completion",
+            "choices": [{"index": 0, "text": self.server.label,
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                      "total_tokens": 2},
+        })
+
+
+def _stub_replica(rid, behavior="ok", delay_s=0.0):
+    def factory():
+        server = _StubServer(("127.0.0.1", 0), _StubHandler)
+        server.behavior = behavior
+        server.delay_s = delay_s
+        server.label = rid
+        return server
+
+    return InProcessReplica(rid, factory)
+
+
+def _start_gateway(fleet, config=None, **kw):
+    server = make_gateway(fleet, config=config or GatewayConfig(), port=0,
+                          **kw)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, server.server_address[1]
+
+
+def _post(port, body, path="/v1/completions", headers=None, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers or {}), json.loads(e.read())
+
+
+def _stub_fleet(*handles):
+    fleet = Fleet(list(handles))
+    fleet.start_all()
+    for rid in fleet.ids:
+        assert fleet.probe(rid, timeout=5.0)
+    return fleet
+
+
+def test_gateway_retries_on_draining_replica_and_relays():
+    fleet = _stub_fleet(_stub_replica("r0", behavior="draining"),
+                        _stub_replica("r1"))
+    metrics = GatewayMetrics()
+    server, port = _start_gateway(
+        fleet, GatewayConfig(router="round_robin"), metrics=metrics)
+    try:
+        # r0 answers 503 (draining): the gateway must spill to r1, every
+        # time, regardless of round-robin order.
+        for _ in range(4):
+            status, _, out = _post(port, {"prompt": "hi", "max_tokens": 1})
+            assert status == 200
+            assert out["choices"][0]["text"] == "r1"
+        assert metrics.completed.value == 4
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.stop_all(drain=False)
+
+
+def test_gateway_fleet_saturated_429_with_backlog_retry_after():
+    fleet = _stub_fleet(_stub_replica("r0", behavior="busy"),
+                        _stub_replica("r1", behavior="busy"))
+    metrics = GatewayMetrics()
+    server, port = _start_gateway(
+        fleet, GatewayConfig(router="round_robin"), metrics=metrics)
+    try:
+        status, headers, out = _post(port, {"prompt": "hi", "max_tokens": 1})
+        assert status == 429
+        assert out["error"]["type"] == "rate_limit_error"
+        ra = int(headers["Retry-After"])
+        # Backlog-aware and honoring the replicas' own hint (2), clamped.
+        assert 2 <= ra <= 30
+        assert metrics.saturated.value == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.stop_all(drain=False)
+
+
+def test_gateway_tracks_outstanding_inflight():
+    """The gateway's per-replica in-flight count (the live half of the
+    load signal — least-outstanding, affinity spill, and
+    rolling_restart's drain-wait all read it) rises while a request is
+    being relayed and returns to zero after."""
+    fleet = _stub_fleet(_stub_replica("r0", delay_s=0.4))
+    server, port = _start_gateway(
+        fleet, GatewayConfig(router="least_outstanding"))
+    try:
+        t = threading.Thread(
+            target=_post, args=(port, {"prompt": "hi", "max_tokens": 1}))
+        t.start()
+        seen = 0
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and seen == 0:
+            seen = fleet.outstanding("r0")
+            time.sleep(0.01)
+        t.join(timeout=30)
+        assert seen == 1, "in-flight relay not tracked as outstanding"
+        assert fleet.outstanding("r0") == 0
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.stop_all(drain=False)
+
+
+def test_gateway_hedges_slow_replica():
+    fleet = _stub_fleet(_stub_replica("r0", delay_s=1.5),
+                        _stub_replica("r1"))
+    metrics = GatewayMetrics()
+    server, port = _start_gateway(
+        fleet,
+        GatewayConfig(router="round_robin", hedge_after_s=0.15),
+        metrics=metrics,
+    )
+    try:
+        t0 = time.time()
+        status, _, out = _post(port, {"prompt": "hi", "max_tokens": 1})
+        dt = time.time() - t0
+        assert status == 200
+        # Round-robin picked r0 (slow) first; the hedge won on r1.
+        assert out["choices"][0]["text"] == "r1"
+        assert dt < 1.4, f"hedge did not cut the tail: {dt:.2f}s"
+        assert metrics.hedges.value == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.stop_all(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance layer: a real 3-replica tiny-model fleet (ISSUE 4 criteria)
+# ---------------------------------------------------------------------------
+
+N_REPLICAS = 3
+
+
+@pytest.fixture(scope="module")
+def model_setup():
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_seq_len=128, dtype="float32", param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    return params, cfg, ByteTokenizer()
+
+
+@pytest.fixture(scope="module")
+def engine_pool(model_setup):
+    """One compiled continuous engine per replica, shared by every test in
+    the module — replica HTTP fronts die and restart around them."""
+    params, cfg, tok = model_setup
+    engines = [
+        ThreadedEngine(ContinuousEngine(
+            params, cfg, tok, n_slots=2, decode_chunk=4,
+            gen=GenerateConfig(max_new_tokens=8), max_queue=64,
+        ))
+        for _ in range(N_REPLICAS)
+    ]
+    yield engines
+    for eng in engines:
+        eng.close()
+
+
+@pytest.fixture()
+def fleet(model_setup, engine_pool):
+    params, cfg, tok = model_setup
+    shared_gen = Generator(params, cfg, tok)  # tokenizer-only surface here
+
+    def factory(eng):
+        return lambda: make_server(
+            shared_gen, port=0, threaded_engine=eng, default_max_tokens=6,
+        )
+
+    handles = [
+        InProcessReplica(f"r{i}", factory(engine_pool[i]))
+        for i in range(N_REPLICAS)
+    ]
+    fl = Fleet(handles)
+    fl.start_all()
+    for rid in fl.ids:
+        assert fl.probe(rid, timeout=5.0)
+    yield fl
+    fl.stop_all(drain=False)
+
+
+def _drive_trace(port, prompts, max_tokens=2):
+    statuses = []
+    for p in prompts:
+        status, _, _ = _post(port, {"prompt": p, "max_tokens": max_tokens},
+                             timeout=120)
+        statuses.append(status)
+    return statuses
+
+
+def _prefix_trace(groups=4, per_group=5):
+    """Interleaved trace of `groups` distinct 4-word prefixes, `per_group`
+    requests each with unique suffixes — the same trace drives both
+    routing policies."""
+    prefixes = [
+        " ".join(f"grp{g} word{j}" for j in range(2)) for g in range(groups)
+    ]
+    trace = []
+    for i in range(per_group):
+        for g, prefix in enumerate(prefixes):
+            trace.append(f"{prefix} item {g}-{i}")
+    return trace
+
+
+def test_affinity_beats_round_robin_on_same_trace(fleet):
+    """ISSUE 4 acceptance (a): identical-prefix requests route to one
+    replica under the affinity policy, and its measured hit-rate beats
+    round-robin's on the same trace."""
+    trace = _prefix_trace()
+    cfg = GatewayConfig(router="affinity", affinity_prefix_tokens=4)
+    aff_metrics = GatewayMetrics()
+    server, port = _start_gateway(fleet, cfg, metrics=aff_metrics)
+    try:
+        assert all(s == 200 for s in _drive_trace(port, trace))
+        aff_ratio = aff_metrics.affinity_ratio()
+    finally:
+        server.shutdown()
+        server.server_close()
+    # Every repeated key landed where its previous occurrence did.
+    assert aff_ratio == 1.0
+    rr_metrics = GatewayMetrics()
+    server, port = _start_gateway(
+        fleet, GatewayConfig(router="round_robin"), metrics=rr_metrics)
+    try:
+        assert all(s == 200 for s in _drive_trace(port, trace))
+        rr_ratio = rr_metrics.affinity_ratio() or 0.0
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert aff_ratio > rr_ratio, (
+        f"affinity {aff_ratio} must beat round-robin {rr_ratio}"
+    )
+    assert rr_ratio < 0.5  # 3 replicas, blind spread
+
+
+def test_kill_replica_mid_load_failover_and_supervised_restart(fleet, tmp_path):
+    """ISSUE 4 acceptance (b): kill -9 one replica mid-load -> zero
+    client-visible failures (requests retry to survivors), and the
+    supervisor restarts it with died -> drain -> relaunch -> re-admit in
+    causal journal order."""
+    journal_dir = str(tmp_path)
+    journal = EventJournal(gateway_journal_path(journal_dir),
+                          source="gateway")
+    metrics = GatewayMetrics()
+    server, port = _start_gateway(
+        fleet, GatewayConfig(router="round_robin", max_attempts=3),
+        metrics=metrics)
+    supervisor = FleetSupervisor(
+        fleet, interval_s=0.1, fail_threshold=2, restart_timeout_s=60.0,
+        journal=journal,
+    )
+    results: list[int] = []
+    errors: list[BaseException] = []
+
+    def client(n):
+        for i in range(n):
+            try:
+                status, _, _ = _post(
+                    port, {"prompt": f"load test {i}", "max_tokens": 3},
+                    timeout=120)
+                results.append(status)
+            except BaseException as e:  # a transport error IS a failure
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(5,)) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        # kill -9 equivalent: listening socket closed, open connections
+        # severed. The supervisor is NOT running yet, so the failover is
+        # purely the gateway's retry path.
+        fleet.handle("r1").kill()
+        # Post-kill burst: round-robin still believes r1 is live until the
+        # first connection error, so at least one of these retries.
+        for i in range(6):
+            status, _, _ = _post(
+                port, {"prompt": f"post kill {i}", "max_tokens": 3},
+                timeout=120)
+            results.append(status)
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, f"client-visible transport failures: {errors[:3]}"
+        assert all(s == 200 for s in results), (
+            f"non-200 during failover: {sorted(set(results))}"
+        )
+        assert metrics.retries.value >= 1  # retried to survivors
+        assert fleet.live_count() == N_REPLICAS - 1
+        # Now the supervisor notices the corpse and runs the recovery
+        # playbook.
+        supervisor.start()
+        deadline = time.monotonic() + 60
+        while fleet.live_count() < N_REPLICAS and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert fleet.live_count() == N_REPLICAS, "supervisor did not restart r1"
+        # The restarted replica serves again.
+        status, _, _ = _post(port, {"prompt": "after restart",
+                                    "max_tokens": 2}, timeout=120)
+        assert status == 200
+    finally:
+        supervisor.stop()
+        server.shutdown()
+        server.server_close()
+        journal.close()
+    events = [e for e in read_journal(gateway_journal_path(journal_dir))
+              if e.get("replica") == "r1"]
+    names = [e["event"] for e in events]
+    order = ["replica.died", "replica.drain", "replica.relaunch",
+             "replica.readmit"]
+    indices = [names.index(n) for n in order]  # raises if any is missing
+    assert indices == sorted(indices), (
+        f"recovery events out of causal order: {names}"
+    )
+
+
+def test_rolling_restart_under_load_zero_failures(fleet, tmp_path):
+    """ISSUE 4 acceptance (c): rolling restart of ALL replicas while
+    clients stream requests completes with zero failed requests."""
+    journal_dir = str(tmp_path)
+    journal = EventJournal(gateway_journal_path(journal_dir),
+                          source="gateway")
+    metrics = GatewayMetrics()
+    server, port = _start_gateway(
+        fleet, GatewayConfig(router="least_outstanding", max_attempts=3),
+        metrics=metrics)
+    supervisor = FleetSupervisor(
+        fleet, interval_s=0.1, fail_threshold=3, restart_timeout_s=60.0,
+        journal=journal,
+    )
+    supervisor.start()
+    stop = threading.Event()
+    results: list[int] = []
+    errors: list[BaseException] = []
+
+    def client():
+        i = 0
+        while not stop.is_set():
+            try:
+                status, _, _ = _post(
+                    port, {"prompt": f"rolling load {i}", "max_tokens": 2},
+                    timeout=120)
+                results.append(status)
+            except BaseException as e:
+                errors.append(e)
+            i += 1
+
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        supervisor.rolling_restart(drain_timeout_s=30.0)
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        supervisor.stop()
+        server.shutdown()
+        server.server_close()
+        journal.close()
+    assert not errors, f"transport failures during rolling restart: {errors[:3]}"
+    assert results and all(s == 200 for s in results), (
+        f"failed requests during rolling restart: {sorted(set(results))}"
+    )
+    assert fleet.live_count() == N_REPLICAS
+    events = read_journal(gateway_journal_path(journal_dir))
+    for rid in fleet.ids:
+        names = [e["event"] for e in events if e.get("replica") == rid]
+        for needed in ("replica.drain", "replica.relaunch",
+                       "replica.readmit"):
+            assert needed in names, f"{rid} missing {needed}: {names}"
+
+
+def test_tenant_throttling_isolated_and_metrics_invariants(fleet):
+    """ISSUE 4 acceptance (d): a tenant over its token bucket gets 429s
+    (with Retry-After) while other tenants are unaffected, and the gateway
+    /metrics exposition passes the Prometheus invariants."""
+    metrics = GatewayMetrics()
+    # Tenant A gets a tiny bucket (burst 2, ~no refill); everyone else is
+    # unlimited — A's throttle must not touch B.
+    admission = TenantAdmission(
+        per_tenant={"tenant-a": {"rate": 0.001, "burst": 2}})
+    server, port = _start_gateway(
+        fleet, GatewayConfig(router="least_outstanding"),
+        metrics=metrics, admission=admission)
+    try:
+        a_statuses, b_statuses = [], []
+        for i in range(4):
+            status, headers, out = _post(
+                port, {"prompt": f"tenant a {i}", "max_tokens": 2},
+                headers={"Authorization": "Bearer tenant-a"}, timeout=120)
+            a_statuses.append(status)
+            if status == 429:
+                assert out["error"]["type"] == "rate_limit_error"
+                assert 1 <= int(headers["Retry-After"]) <= 30
+            status, _, _ = _post(
+                port, {"prompt": f"tenant b {i}", "max_tokens": 2},
+                headers={"Authorization": "Bearer tenant-b"}, timeout=120)
+            b_statuses.append(status)
+        # Burst of 2, refill ~never: exactly the first two A requests pass.
+        assert a_statuses == [200, 200, 429, 429]
+        assert b_statuses == [200] * 4  # B untouched by A's throttle
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=30
+        ) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            body = resp.read().decode()
+        types, samples = exposition_index(body)
+        for name in samples:
+            fam = sample_family(name)
+            assert fam in types, f"sample {name} has no # TYPE for {fam}"
+        # tenant-a is a CONFIGURED tenant name (per_tenant key): readable
+        # label. tenant-b arrived as an unknown bearer token — treated as
+        # a credential and digested; the raw value must never appear in
+        # the unauthenticated exposition.
+        b_label = tenant_label("tenant-b")
+        assert samples["ditl_gateway_tenant_tenant_a_throttled_total"] == 2
+        assert samples[f"ditl_gateway_tenant_{b_label}_admitted_total"] == 4
+        assert f"ditl_gateway_tenant_{b_label}_throttled_total" not in samples
+        assert "tenant_b" not in body and "tenant-b" not in body
+        assert samples["ditl_gateway_requests_total"] == 8
+        assert samples["ditl_gateway_requests_completed_total"] == 6
+        assert samples["ditl_gateway_replicas_live"] == N_REPLICAS
+        assert types["ditl_gateway_request_e2e_seconds"] == "histogram"
+        buckets = [(n, v) for n, v in samples.items()
+                   if n.startswith("ditl_gateway_request_e2e_seconds_bucket")]
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts)
+        assert buckets[-1][0].endswith('le="+Inf"}')
+        assert buckets[-1][1] == samples[
+            "ditl_gateway_request_e2e_seconds_count"]
+        # Per-replica routed counters exist and sum to completed requests.
+        routed = sum(v for n, v in samples.items()
+                     if n.startswith("ditl_gateway_replica_")
+                     and n.endswith("_routed_total"))
+        assert routed >= 6
+        # /stats carries the tenant snapshot with sanitized keys.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30
+        ) as resp:
+            stats = json.loads(resp.read())
+        assert stats["tenants"]["tenant_a"]["throttled"] == 2
+        assert stats["tenants"][b_label]["throttled"] == 0
+        assert "tenant_b" not in stats["tenants"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_gateway_streaming_passthrough(fleet):
+    """SSE streaming relays through the gateway incrementally and ends in
+    [DONE] — the continuous engine's chunks survive the proxy hop."""
+    server, port = _start_gateway(
+        fleet, GatewayConfig(router="least_outstanding"))
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": "stream me", "max_tokens": 6,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            raw = resp.read().decode()
+        events = [ln[len("data: "):] for ln in raw.splitlines()
+                  if ln.startswith("data: ")]
+        assert events[-1] == "[DONE]"
+        parsed = [json.loads(e) for e in events[:-1]]
+        assert parsed and parsed[-1]["choices"][0]["finish_reason"] in (
+            "stop", "length")
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+@pytest.mark.slow
+@pytest.mark.multiproc
+def test_launch_gateway_subcommand_end_to_end(tmp_path):
+    """`python -m ditl_tpu.launch gateway`: a real subprocess replica
+    behind the real gateway process — health, one completion, graceful
+    SIGTERM shutdown. Hard-bounded like every multiproc drill."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from ditl_tpu.runtime.elastic import free_port
+
+    port = free_port()
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ditl_tpu.launch", "gateway",
+         "--engine", "lockstep", "--tokenizer", "byte",
+         f"gateway.port={port}", "gateway.replicas=1",
+         f"gateway.journal_dir={tmp_path}"],
+        env=env, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.monotonic() + 180
+        health = None
+        while time.monotonic() < deadline:
+            assert proc.poll() is None, "gateway process died during startup"
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=2
+                ) as resp:
+                    health = json.loads(resp.read())
+                if health.get("status") == "ok":
+                    break
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.5)
+        assert health is not None and health["status"] == "ok", health
+        status, _, out = _post(port, {"prompt": "hi", "max_tokens": 2},
+                               timeout=180)
+        assert status == 200 and out["choices"][0]["finish_reason"]
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def test_gateway_health_stats_and_models(fleet):
+    server, port = _start_gateway(fleet, GatewayConfig())
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/health", timeout=30
+        ) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
+        assert health["replicas_live"] == N_REPLICAS
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/models", timeout=30
+        ) as resp:
+            models = json.loads(resp.read())
+        assert models["object"] == "list" and models["data"]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/stats", timeout=30
+        ) as resp:
+            stats = json.loads(resp.read())
+        assert set(stats["replicas"]) == {"r0", "r1", "r2"}
+        for info in stats["replicas"].values():
+            assert {"live", "draining", "outstanding", "queue_depth",
+                    "capacity"} <= set(info)
+    finally:
+        server.shutdown()
+        server.server_close()

@@ -42,6 +42,7 @@ def setup():
 
 def _serve(params, cfg, tok, **engine_kw):
     threaded = None
+    max_pending = engine_kw.pop("max_pending", None)
     if engine_kw.pop("continuous", False):
         threaded = ThreadedEngine(ContinuousEngine(
             params, cfg, tok, n_slots=8, decode_chunk=4,
@@ -50,6 +51,7 @@ def _serve(params, cfg, tok, **engine_kw):
     server = make_server(
         Generator(params, cfg, tok), host="127.0.0.1", port=0,
         threaded_engine=threaded, default_max_tokens=10,
+        max_pending=max_pending,
     )
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server, threaded, server.server_address[1]
@@ -373,6 +375,88 @@ def test_generate_many_cancels_orphans_on_midloop_failure(setup):
     finally:
         eng.submit = orig
         te.close()
+
+
+def test_lockstep_overload_concurrent_clients_result_or_429(setup):
+    """ISSUE 4 satellite: M threads against a 1-slot lockstep server
+    (max_pending=1) must each get either a result or a well-formed 429 —
+    never a hang or a 500 — and the 429 counter must move on /metrics."""
+    import concurrent.futures
+
+    params, cfg, tok = setup
+    server, _, port = _serve(params, cfg, tok, max_pending=1)
+    barrier = threading.Barrier(6)
+
+    def one(i):
+        barrier.wait()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": f"load {i}",
+                             "max_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, dict(resp.headers), json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers or {}), json.loads(e.read())
+
+    try:
+        with concurrent.futures.ThreadPoolExecutor(max_workers=6) as pool:
+            outcomes = list(pool.map(one, range(6)))
+        statuses = [s for s, _, _ in outcomes]
+        assert set(statuses) <= {200, 429}, statuses
+        assert 200 in statuses  # someone actually got served
+        assert 429 in statuses  # and the cap actually rejected
+        n_429 = statuses.count(429)
+        for status, headers, body in outcomes:
+            if status == 429:
+                assert body["error"]["type"] == "rate_limit_error"
+                # Backlog-aware Retry-After, clamped to [1, 30].
+                assert 1 <= int(headers["Retry-After"]) <= 30
+            else:
+                assert "choices" in body
+        _, samples = exposition_index(_scrape_metrics(port))
+        assert samples["ditl_serving_queue_full_total"] == n_429
+        assert samples["ditl_serving_requests_total"] == statuses.count(200)
+    finally:
+        server.shutdown()
+
+
+def test_drain_lifecycle_health_503_and_close(setup):
+    """ISSUE 4 satellite: drain() flips /health to draining, new
+    completion work answers 503 while metadata routes stay up, and
+    close(drain=True) completes; /health also carries the load signal
+    (queue_depth / active_slots / n_slots) the gateway router consumes."""
+    params, cfg, tok = setup
+    server, _, port = _serve(params, cfg, tok)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/health", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "ok"
+        assert health["draining"] is False
+        assert health["queue_depth"] == 0
+        assert health["active_slots"] == 0
+        assert health["n_slots"] == 1  # lockstep: the device lock is 1 slot
+        server.drain()
+        with urllib.request.urlopen(f"{base}/health", timeout=30) as r:
+            health = json.loads(r.read())
+        assert health["status"] == "draining" and health["draining"] is True
+        status, body = _post(port, "/v1/completions",
+                             {"prompt": "x", "max_tokens": 2},
+                             expect_error=True)
+        assert status == 503
+        assert body["error"]["type"] == "unavailable_error"
+        # Metadata routes keep serving while draining (health polling and
+        # tokenization must not go dark mid-drain).
+        status, _ = _post(port, "/tokenize", {"prompt": "hi"})
+        assert status == 200
+        with urllib.request.urlopen(f"{base}/stats", timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats["draining"] is True and stats["inflight"] == 0
+    finally:
+        server.close(drain=True, timeout=10)
 
 
 @pytest.mark.slow
