@@ -36,6 +36,16 @@ import time
 R02_1B3_BASELINE_TPS = 14160.0
 R01_350M_BASELINE_TPS = 33162.0
 
+
+def _chaos_result() -> dict:
+    """`{"chaos": ...}` when a fault plane is armed (bench --chaos), else
+    empty — a perf row measured under injected faults is only
+    interpretable with the injected-fault counts attached (ISSUE 5)."""
+    from ditl_tpu.chaos import injected_summary
+
+    summary = injected_summary()
+    return {"chaos": summary} if summary is not None else {}
+
 # bf16 peak TFLOP/s per chip, EXACT device_kind match (lowercased). A
 # substring table silently mis-scaled MFU when device_kind strings
 # reshuffled; unknown kinds now warn loudly and omit MFU instead of
@@ -540,6 +550,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         "platform": platform,
         "generated_tokens": tokens,
         **extra,
+        **_chaos_result(),
     }))
     return 0
 
@@ -682,6 +693,7 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
                 and k.endswith("_routed")
             },
         },
+        **_chaos_result(),
     }))
     server.shutdown()
     server.server_close()
@@ -866,6 +878,7 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
         # clock went — conservation-checked buckets, same convention as the
         # trainer's goodput report.
         "goodput": tracker.report(),
+        **_chaos_result(),
     }
     if swept:
         result["swept"] = {
@@ -979,7 +992,22 @@ if __name__ == "__main__":
                         "(on by default — a warm second run skips the "
                         "~85 s compile+first-window; pass '' to disable; "
                         "see docs/troubleshooting.md §20 for staleness)")
+    parser.add_argument("--chaos", default="", metavar="SPEC",
+                        help="arm the fault plane (ditl_tpu/chaos/) with a "
+                        "rule spec, e.g. 'engine.tick:delay@p=0.05,"
+                        "delay=0.01' — measure perf UNDER fault; injected-"
+                        "fault counts land in the bench JSON so the row "
+                        "stays attributable")
+    parser.add_argument("--chaos-seed", type=int, default=0,
+                        help="fault-plane seed (--chaos): the same seed "
+                        "replays the identical fault sequence")
     args = parser.parse_args()
+    if args.chaos:
+        from ditl_tpu.chaos import FaultPlane, arm
+
+        arm(FaultPlane(seed=args.chaos_seed, rules=args.chaos))
+        print(f"bench: chaos armed ({args.chaos!r}, seed {args.chaos_seed})",
+              file=sys.stderr)
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"
                   or args.infer_workload != "random" or args.moe

@@ -1,0 +1,41 @@
+"""Chaos plane (ISSUE 5): deterministic, seed-driven fault injection
+consulted at instrumented seams across all three legs — data/train
+(loader batches, checkpoint save/restore), elastic runtime (heartbeats,
+pod spawn), and serving (engine ticks, HTTP relays, remote-LLM
+transport). Stdlib-only; importing this package never touches jax."""
+
+from ditl_tpu.chaos.plane import (
+    ACTIONS,
+    CORRUPT_SITES,
+    SITES,
+    STEP_SITES,
+    Fault,
+    FaultPlane,
+    FaultRule,
+    InjectedFault,
+    arm,
+    arm_chaos,
+    disarm,
+    get_plane,
+    injected_summary,
+    maybe_inject,
+    parse_rules,
+)
+
+__all__ = [
+    "ACTIONS",
+    "CORRUPT_SITES",
+    "SITES",
+    "STEP_SITES",
+    "Fault",
+    "FaultPlane",
+    "FaultRule",
+    "InjectedFault",
+    "arm",
+    "arm_chaos",
+    "disarm",
+    "get_plane",
+    "injected_summary",
+    "maybe_inject",
+    "parse_rules",
+]

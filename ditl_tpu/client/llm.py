@@ -31,16 +31,44 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Sequence
 
+from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.config import APIConfig
+from ditl_tpu.telemetry.registry import MetricsRegistry
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
 ERROR_SENTINEL = "Error: Unable to get model response"
 
-__all__ = ["ERROR_SENTINEL", "LLMClient", "get_model_response"]
+__all__ = ["ERROR_SENTINEL", "ClientMetrics", "LLMClient",
+           "client_metrics", "get_model_response"]
 
 Transport = Callable[[str, dict, bytes, float], tuple[int, dict, bytes]]
+
+
+class ClientMetrics:
+    """Remote-LLM client telemetry (telemetry/registry.py instruments):
+    how often the retry machinery engages and how it ends. Module-level
+    singleton (``client_metrics``) shared by every LLMClient in the
+    process — the eval loop constructs clients per call, and per-instance
+    registries would scatter the counts."""
+
+    def __init__(self, registry: MetricsRegistry | None = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
+        self.requests = r.counter(
+            "ditl_client_requests", "remote-LLM logical calls started")
+        self.retries = r.counter(
+            "ditl_client_retries", "HTTP attempts retried (429/5xx/conn)")
+        self.retry_exhausted = r.counter(
+            "ditl_client_retry_exhausted",
+            "calls that failed after exhausting max_retries")
+        self.deadline_exhausted = r.counter(
+            "ditl_client_deadline_exhausted",
+            "calls aborted by the total_timeout_s wall-clock bound")
+
+
+client_metrics = ClientMetrics()
 
 
 class HTTPStatusError(Exception):
@@ -67,36 +95,85 @@ class LLMClient:
 
     # -- low level ----------------------------------------------------------
 
-    def _request_once(self, payload: dict, endpoint: str = "/chat/completions") -> dict:
+    def _request_once(self, payload: dict, endpoint: str = "/chat/completions",
+                      timeout_s: float | None = None) -> dict:
         cfg = self.config
+        # Chaos seam: `error` becomes an OSError — a transport-level
+        # failure that exercises the REAL retry/backoff/deadline path
+        # (an InjectedFault would bypass the retryable-exception filter).
+        fault = maybe_inject("client.request", handles=("error",))
+        if fault is not None and fault.action == "error":
+            raise OSError("chaos: injected client transport failure")
         url = cfg.api_base.rstrip("/") + endpoint
         headers = {
             "Content-Type": "application/json",
             "Authorization": f"Bearer {cfg.api_key()}",
         }
         body = json.dumps(payload).encode("utf-8")
-        status, resp_headers, resp_body = self.transport(url, headers, body, cfg.timeout_s)
+        status, resp_headers, resp_body = self.transport(
+            url, headers, body,
+            cfg.timeout_s if timeout_s is None else timeout_s,
+        )
         if status != 200:
             raise HTTPStatusError(status, resp_headers, resp_body)
         return json.loads(resp_body)
 
     def _request_with_retries(self, payload: dict, endpoint: str = "/chat/completions") -> dict:
+        """Retry loop with exponential backoff, bounded two ways: attempt
+        count (``max_retries``) and — the ISSUE 5 satellite — total wall
+        clock (``total_timeout_s``): per-attempt timeouts are clamped to
+        the remaining budget and backoff never sleeps past the deadline,
+        so one dead endpoint can no longer stall a caller for
+        ``max_retries x (timeout_s + backoff_max_s)``."""
         cfg = self.config
+        deadline = (
+            time.monotonic() + cfg.total_timeout_s
+            if cfg.total_timeout_s > 0 else None
+        )
+        client_metrics.requests.inc()
         last_exc: Exception | None = None
+
+        def _remaining() -> float | None:
+            return None if deadline is None else deadline - time.monotonic()
+
         for attempt in range(cfg.max_retries + 1):
+            timeout_s = cfg.timeout_s
+            remaining = _remaining()
+            if remaining is not None:
+                if remaining <= 0:
+                    client_metrics.deadline_exhausted.inc()
+                    raise TimeoutError(
+                        f"total_timeout_s={cfg.total_timeout_s}s exhausted "
+                        f"after {attempt} attempt(s)"
+                    ) from last_exc
+                timeout_s = min(timeout_s, remaining)
             try:
-                return self._request_once(payload, endpoint)
+                return self._request_once(payload, endpoint, timeout_s)
             except HTTPStatusError as e:
                 last_exc = e
                 retryable = e.status == 429 or e.status >= 500
                 if not retryable or attempt == cfg.max_retries:
+                    if retryable:
+                        client_metrics.retry_exhausted.inc()
                     raise
                 delay = self._backoff_delay(attempt, e.headers)
             except (urllib.error.URLError, OSError, TimeoutError) as e:
                 last_exc = e
                 if attempt == cfg.max_retries:
+                    client_metrics.retry_exhausted.inc()
                     raise
                 delay = self._backoff_delay(attempt, {})
+            remaining = _remaining()
+            if remaining is not None and delay >= remaining:
+                # The backoff alone would overshoot the deadline: stop now
+                # rather than sleep into a guaranteed failure.
+                client_metrics.deadline_exhausted.inc()
+                raise TimeoutError(
+                    f"total_timeout_s={cfg.total_timeout_s}s exhausted "
+                    f"after {attempt + 1} attempt(s) (backoff {delay:.2f}s "
+                    "would overshoot)"
+                ) from last_exc
+            client_metrics.retries.inc()
             logger.warning(
                 "API request failed (%s), retry %d/%d in %.2fs",
                 last_exc,

@@ -34,6 +34,7 @@ __all__ = [
     "TrainConfig",
     "APIConfig",
     "GatewayConfig",
+    "ChaosConfig",
     "Config",
     "parse_overrides",
     "config_fingerprint",
@@ -307,6 +308,11 @@ class DataConfig:
     prefetch: int = 2  # device prefetch depth (double buffering)
     synthetic: bool = False  # True => generated data, no HF hub (hermetic tests)
     synthetic_examples: int = 256
+    # Max seconds the consumer may block waiting for the prefetch producer
+    # before raising a diagnosable DataStallError (data/loader.py) instead
+    # of hanging the step loop forever behind a wedged pipeline (hub stall,
+    # injected hang). 0 = wait forever (the historical behavior).
+    data_wait_timeout_s: float = 0.0
 
 
 @dataclass(frozen=True)
@@ -375,6 +381,16 @@ class TrainConfig:
     # boundary reads as a stall.
     heartbeat_dir: str = ""
     heartbeat_timeout_s: float = 0.0
+    # Straggler escalation (runtime/elastic.py): a worker whose heartbeat
+    # STEP trails the pod median by more than this many steps is flagged
+    # (journaled `pod.straggler`) — the slow-not-dead failure class the
+    # dead-or-silent liveness checks cannot see. 0 = off. Requires
+    # heartbeat_dir (steps ride the heartbeat files).
+    straggler_lag_steps: int = 0
+    # Escalate a flagged straggler to a pod relaunch (same teardown +
+    # fresh-port relaunch path as a death; consumes the restart budget).
+    # False = journal-and-log only.
+    straggler_relaunch: bool = False
     # Telemetry event journal (ditl_tpu/telemetry/journal.py): each process
     # appends typed lifecycle/progress events to
     # {telemetry_dir}/events-worker-{process_index}.jsonl, and the elastic
@@ -391,6 +407,14 @@ class TrainConfig:
             raise ValueError(
                 "heartbeat_timeout_s requires heartbeat_dir (without it no "
                 "heartbeats are emitted and stall detection is silently off)"
+            )
+        if self.straggler_lag_steps > 0 and not self.heartbeat_dir:
+            # Same reject-don't-drop rule: straggler detection reads step
+            # progress off the heartbeat files.
+            raise ValueError(
+                "straggler_lag_steps requires heartbeat_dir (step progress "
+                "rides the heartbeat files; without them straggler "
+                "detection is silently off)"
             )
     # Path to a local HF checkpoint directory (transformers format) to
     # initialize parameters from instead of random init (models/convert.py).
@@ -415,6 +439,12 @@ class APIConfig:
     max_retries: int = 5
     backoff_base_s: float = 0.5  # exponential backoff, doc'd-but-unimplemented
     backoff_max_s: float = 30.0  # in the reference (troubleshooting.md:42-51)
+    # Hard wall-clock bound over the WHOLE retry loop (one logical call):
+    # without it, max_retries x (timeout_s + backoff_max_s) can stall an
+    # eval loop for minutes behind one dead endpoint. Per-attempt timeouts
+    # are clamped to the remaining budget and backoff never sleeps past
+    # the deadline. 0 = unbounded (the historical behavior).
+    total_timeout_s: float = 0.0
     max_concurrency: int = 8  # async client fan-out (vs ref's serial loop)
 
     def api_key(self) -> str:
@@ -479,6 +509,33 @@ class GatewayConfig:
 
 
 @dataclass(frozen=True)
+class ChaosConfig:
+    """Fault-injection plane (ditl_tpu/chaos/, ISSUE 5). ``rules`` is the
+    compact spec string ``site:action[@k=v,...];...`` (see
+    ``chaos.parse_rules``); empty = disarmed. The same ``seed`` replays
+    the identical fault sequence — drills assert journal-diff equality.
+    Armed by the trainer (``launch.py``) and ``bench.py --chaos``; every
+    worker of a pod receives the identical rules (the config fingerprint
+    covers this section), with per-worker targeting via the rule's
+    ``proc=N`` option."""
+
+    seed: int = 0
+    rules: str = ""
+    # Chaos events journal + persisted fire-count state ("" = ride the
+    # caller's journal / train.telemetry_dir). Fire counts persist across
+    # relaunches so `max=N` caps survive the kills they inject.
+    journal_dir: str = ""
+
+    def __post_init__(self):
+        if self.rules:
+            # Validate at config time (reject-don't-drop): a typo'd site or
+            # action must fail the launch, not silently never fire.
+            from ditl_tpu.chaos.plane import parse_rules
+
+            parse_rules(self.rules)
+
+
+@dataclass(frozen=True)
 class Config:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -487,6 +544,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     api: APIConfig = field(default_factory=APIConfig)
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
+    chaos: ChaosConfig = field(default_factory=ChaosConfig)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

@@ -20,10 +20,12 @@ from __future__ import annotations
 
 import collections
 import threading
+import time
 from typing import Iterator
 
 import numpy as np
 
+from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.config import DataConfig
 from ditl_tpu.data.dataset import TextDataset
 from ditl_tpu.data.sampler import ShardedSampler
@@ -33,7 +35,17 @@ from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-__all__ = ["make_global_batch", "DataPipeline"]
+__all__ = ["DataStallError", "make_global_batch", "DataPipeline"]
+
+
+class DataStallError(RuntimeError):
+    """The training loop waited longer than ``data.data_wait_timeout_s``
+    for the prefetch producer to yield a batch. Distinguishes a wedged
+    data pipeline (hub stall, hung tokenizer, injected ``hang``) from a
+    wedged device program: the exception names the pipeline, carries the
+    producer's liveness, and fails the step loop diagnosably instead of
+    letting it hang forever (where the only external signal would be a
+    heartbeat stall attributing the death to the wrong subsystem)."""
 
 
 def tokenize_example(
@@ -240,14 +252,31 @@ class DataPipeline:
             counts.append((tokens // seq_len) // self.host_batch_size)
         return min(counts)
 
+    def _chaos_batches(
+        self, epoch: int, start_step: int
+    ) -> Iterator[dict[str, np.ndarray]]:
+        """Host batches with the chaos seam applied (runs on the PREFETCH
+        PRODUCER thread, so injected errors/hangs exercise the real
+        cross-thread propagation path): ``error`` raises InjectedFault into
+        the consumer, ``hang`` wedges the producer (the data-wait timeout's
+        drill), ``corrupt`` zeroes the batch's tokens (garbage data, valid
+        shapes — the silent-corruption class)."""
+        for i, hb in enumerate(self._host_batches(epoch, start_step)):
+            fault = maybe_inject("data.batch", request=start_step + i)
+            if fault is not None and fault.action == "corrupt":
+                hb = dict(hb)
+                hb["input_ids"] = np.zeros_like(hb["input_ids"])
+            yield hb
+
     def epoch(self, epoch: int, start_step: int = 0) -> Iterator[dict]:
         """Globally-sharded batches for one epoch, with prefetch."""
         yield from _prefetch(
             (
                 make_global_batch(self.mesh, hb)
-                for hb in self._host_batches(epoch, start_step)
+                for hb in self._chaos_batches(epoch, start_step)
             ),
             self.config.prefetch,
+            timeout_s=self.config.data_wait_timeout_s,
         )
 
     def __iter__(self) -> Iterator[dict]:
@@ -258,9 +287,19 @@ class DataPipeline:
             epoch += 1
 
 
-def _prefetch(it: Iterator, depth: int) -> Iterator:
+def _prefetch(it: Iterator, depth: int, timeout_s: float = 0.0) -> Iterator:
     """Background-thread prefetch of up to ``depth`` items (device transfer is
     async in JAX, so buffering the host side is enough for double buffering).
+
+    Producer exceptions (tokenizer bugs, injected faults) propagate to the
+    consumer — the iterator never ends silently because the producer died.
+    ``timeout_s > 0`` additionally bounds how long the consumer may block
+    waiting for ONE item: past it, a :class:`DataStallError` names the
+    pipeline as the wedged subsystem (a producer that is alive-but-hung —
+    e.g. a stalled hub read — produces no exception to propagate, and
+    without the bound the step loop would hang forever). No prefetch
+    thread (``depth <= 0``) means no cross-thread seam to time out;
+    the producer runs inline and its exceptions are the consumer's.
 
     Abandoning the returned generator (partial consumption + ``close()`` /
     garbage collection) stops the worker thread — without that, every
@@ -299,8 +338,21 @@ def _prefetch(it: Iterator, depth: int) -> Iterator:
     try:
         while True:
             with lock:
+                t_wait0 = time.monotonic()
                 while not queue:
-                    lock.wait()
+                    if timeout_s > 0:
+                        remaining = timeout_s - (time.monotonic() - t_wait0)
+                        if remaining <= 0:
+                            raise DataStallError(
+                                f"data pipeline produced no batch for "
+                                f"{timeout_s:.1f}s (producer thread "
+                                f"{'alive' if t.is_alive() else 'dead'}, "
+                                f"prefetch depth {depth}); the data side is "
+                                "wedged — see data.data_wait_timeout_s"
+                            )
+                        lock.wait(timeout=remaining)
+                    else:
+                        lock.wait()
                 item = queue.popleft()
                 lock.notify_all()
             if item is done:

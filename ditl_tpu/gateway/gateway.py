@@ -41,6 +41,7 @@ import urllib.request
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.config import GatewayConfig
 from ditl_tpu.gateway.admission import (
     TenantAdmission, sanitize_label, tenant_label,
@@ -335,10 +336,37 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         m, cfg = self.gw, self.gwcfg
         stream = bool(payload.get("stream"))
         key = affinity_key(payload, cfg.affinity_prefix_tokens)
+        # Deadline propagation (ISSUE 5): the effective budget is the
+        # smaller of the client's `deadline_s` and the gateway's own
+        # request_timeout_s; each relay attempt forwards the REMAINING
+        # budget as X-Request-Deadline-S so the replica's engine evicts
+        # work the gateway will have abandoned anyway (otherwise a retry
+        # storm leaves dead generations burning slots fleet-wide).
+        budget = cfg.request_timeout_s
+        client_deadline = payload.get("deadline_s")
+        has_client_deadline = (
+            isinstance(client_deadline, (int, float)) and client_deadline > 0
+        )
+        if has_client_deadline:
+            budget = min(budget, float(client_deadline))
+        # Streams are the exception to "work the gateway will have
+        # abandoned anyway": the gateway's socket timeout is per-read, so a
+        # healthy stream longer than request_timeout_s is never abandoned
+        # here — stamping the header would make the replica's engine evict
+        # it and silently truncate the generation. Only an explicit client
+        # deadline propagates into a stream; `budget` still bounds the
+        # pre-first-byte attempt loop either way.
+        propagate_deadline = has_client_deadline or not stream
+        t_deadline0 = time.monotonic()
+        timed_out = False
         tried: list[str] = []
         saw_busy = False
         busy_hint = 0
         for attempt in range(max(1, cfg.max_attempts)):
+            remaining = budget - (time.monotonic() - t_deadline0)
+            if remaining <= 0:
+                timed_out = True
+                break
             candidates = self.fleet.routable(exclude=tried)
             if not candidates:
                 break
@@ -361,7 +389,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self.fleet.inc_outstanding(view.id)
             try:
                 outcome, info = self._relay_one(
-                    view, path, raw, stream, hedge_peers
+                    view, path, raw, stream, hedge_peers,
+                    deadline_left=remaining if propagate_deadline else None,
                 )
             finally:
                 self.fleet.dec_outstanding(view.id)
@@ -385,7 +414,12 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 tried.append(busy_id)
             else:
                 tried.append(view.id)
-        if saw_busy:
+        if timed_out:
+            self._send_json(504, {"error": {
+                "message": "request deadline exhausted before any replica "
+                           "answered",
+                "type": "timeout_error"}})
+        elif saw_busy:
             m.saturated.inc()
             self._send_json(
                 429,
@@ -400,25 +434,33 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 
     # -- relaying -----------------------------------------------------------
 
-    def _open(self, view, path: str, raw: bytes):
+    def _open(self, view, path: str, raw: bytes,
+              deadline_left: float | None = None):
         """One upstream request; returns (conn, resp) or raises OSError/
         HTTPException on connection-level failure (retryable — no bytes
-        have been relayed to the client yet)."""
+        have been relayed to the client yet). ``deadline_left`` (seconds)
+        bounds the socket AND is forwarded as X-Request-Deadline-S so the
+        replica's engine gives up when the gateway will."""
+        timeout = self.gwcfg.request_timeout_s
+        headers = {
+            "Content-Type": "application/json",
+            "Authorization": self.headers.get("Authorization", ""),
+        }
+        if deadline_left is not None:
+            timeout = min(timeout, max(0.001, deadline_left))
+            headers["X-Request-Deadline-S"] = f"{max(0.001, deadline_left):.3f}"
         conn = http.client.HTTPConnection(
-            view.address[0], view.address[1],
-            timeout=self.gwcfg.request_timeout_s,
+            view.address[0], view.address[1], timeout=timeout,
         )
         try:
-            conn.request("POST", path, body=raw, headers={
-                "Content-Type": "application/json",
-                "Authorization": self.headers.get("Authorization", ""),
-            })
+            conn.request("POST", path, body=raw, headers=headers)
             return conn, conn.getresponse()
         except BaseException:
             conn.close()
             raise
 
-    def _relay_one(self, view, path, raw, stream, hedge_peers):
+    def _relay_one(self, view, path, raw, stream, hedge_peers,
+                   deadline_left: float | None = None):
         """Proxy one attempt. Returns (outcome, info):
         ``("done", served_replica_id)`` — response relayed;
         ``("retry", None)`` — connection-level failure, safe to fail over;
@@ -426,14 +468,21 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         429/503 (spill; under hedging the busy answer can come from the
         peer rather than the primary);
         ``("aborted", None)`` — died mid-stream after bytes were relayed."""
+        # Chaos seam: `error` = an upstream connection failure before any
+        # byte moved (exercises idempotent-safe failover), `delay` = a slow
+        # relay (hedging drills), `kill` = losing the gateway process.
+        fault = maybe_inject("gateway.relay", handles=("error",))
+        if fault is not None and fault.action == "error":
+            self.fleet.note_failure(view.id)
+            return ("retry", None)
         served = view.id
         try:
             if hedge_peers:
                 conn, resp, served = self._hedged_open(
-                    view, hedge_peers, path, raw
+                    view, hedge_peers, path, raw, deadline_left
                 )
             else:
-                conn, resp = self._open(view, path, raw)
+                conn, resp = self._open(view, path, raw, deadline_left)
         except (OSError, http.client.HTTPException):
             self.fleet.note_failure(view.id)
             return ("retry", None)
@@ -490,16 +539,18 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             logger.warning("replica %s died mid-stream", view.id)
             return "aborted"
 
-    def _hedged_open(self, view, peers, path, raw):
+    def _hedged_open(self, view, peers, path, raw, deadline_left=None):
         """Tail-latency hedging (non-streaming only): if the primary has
         not answered within ``hedge_after_s``, fire the same request at the
         least-loaded peer and take whichever responds first. The loser's
         connection is abandoned (its replica finishes the wasted work —
-        the standard hedging trade). Completions are idempotent from the
-        client's perspective, so duplicates are safe."""
+        the standard hedging trade; a propagated deadline caps even that
+        waste). Completions are idempotent from the client's perspective,
+        so duplicates are safe."""
         pool = ThreadPoolExecutor(max_workers=2)
         try:
-            primary = pool.submit(self._open, view, path, raw)
+            t0 = time.monotonic()
+            primary = pool.submit(self._open, view, path, raw, deadline_left)
             done, _ = wait([primary], timeout=self.gwcfg.hedge_after_s)
             if done:
                 conn, resp = primary.result()  # may raise: caller retries
@@ -507,7 +558,14 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             peer = min(peers, key=lambda v: v.outstanding + v.queue_depth)
             self.gw.hedges.inc()
             self.gw.replica_counter(peer.id, "hedged").inc()
-            secondary = pool.submit(self._open, peer, path, raw)
+            # The secondary starts hedge_after_s (at least) into the budget:
+            # re-derive its remaining deadline, or its replica keeps the
+            # hedged generation alive past the moment the gateway gives up.
+            secondary_left = (
+                deadline_left - (time.monotonic() - t0)
+                if deadline_left is not None else None
+            )
+            secondary = pool.submit(self._open, peer, path, raw, secondary_left)
             futures = {primary: view.id, secondary: peer.id}
             last_exc: BaseException | None = None
             pending = set(futures)

@@ -58,6 +58,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.config import ModelConfig
 from ditl_tpu.data.tokenizer import Tokenizer
 from ditl_tpu.infer.cache import init_cache
@@ -69,8 +70,8 @@ from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-__all__ = ["BadRequestError", "ContinuousEngine", "QueueFullError",
-           "Request", "ThreadedEngine", "derive_copy_seed"]
+__all__ = ["BadRequestError", "ContinuousEngine", "DeadlineExceededError",
+           "QueueFullError", "Request", "ThreadedEngine", "derive_copy_seed"]
 
 
 def _quantize_pages(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -189,6 +190,13 @@ class QueueFullError(RuntimeError):
     instead of letting waiting requests accumulate without bound."""
 
 
+class DeadlineExceededError(RuntimeError):
+    """A request's deadline expired before it completed: the engine evicted
+    it from the queue/slot (its remaining token budget is never decoded)
+    and the HTTP layer answers 504. Partial tokens, if any, ride the
+    Request object."""
+
+
 @dataclass
 class Request:
     """One in-flight generation request (host bookkeeping)."""
@@ -248,6 +256,12 @@ class Request:
     t_admitted: float = 0.0
     t_first: float = 0.0
     t_last_emit: float = 0.0
+    # Deadline (time.monotonic absolute; None = none): past it the request
+    # is evicted from the queue/slot at the next scheduler tick instead of
+    # burning device time (ISSUE 5). ``expired`` marks that eviction —
+    # waiters raise DeadlineExceededError, streams get their terminal None.
+    deadline: float | None = None
+    expired: bool = False
 
 
 class ContinuousEngine:
@@ -583,6 +597,7 @@ class ContinuousEngine:
         self.pipeline_ticks = bool(pipeline_ticks)
         self._pending_fetch: tuple | None = None
         self._next_id = 0
+        self.tick_count = 0  # scheduler ticks (the chaos seam's step index)
         self._prefill_cache: dict[int, Any] = {}
         self._decode_cache: dict[tuple[bool, bool], Any] = {}
         # Prefix cache: prompt-prefix tokens -> (1-row KV slice over P slots,
@@ -1810,6 +1825,7 @@ class ContinuousEngine:
         logprobs: int | None = None,
         adapter_id: int | None = None,
         grammar: Any = None,
+        deadline_s: float | None = None,
     ) -> int:
         """Queue a request; returns its id (see ``results``/``run``).
         ``stream``: optional ``queue.Queue`` receiving per-chunk token lists
@@ -1820,7 +1836,12 @@ class ContinuousEngine:
         (0 = base). ``grammar`` constrains the COMPLETION (not the prompt)
         to a compiled grammar — an ``infer.grammar.CompiledGrammar`` (auto-
         registered) or an int start state from ``register_grammar``;
-        requires the engine constructed with ``fsm_capacity > 0``."""
+        requires the engine constructed with ``fsm_capacity > 0``.
+        ``deadline_s``: relative deadline — past it the request is evicted
+        from the queue/slot (DeadlineExceededError for waiters) instead of
+        decoding work nobody will read. Solo serving only: the pod tick
+        broadcast never carries deadlines (per-process wall clocks would
+        desync the replicated scheduler)."""
         gen = self.gen
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             self.metrics.queue_full.inc()
@@ -1857,6 +1878,12 @@ class ContinuousEngine:
             # Checked BEFORE grammar registration: fsm rows are never
             # evicted, so a rejected request must not consume one.
             raise BadRequestError("seed must fit in int32")
+        if deadline_s is not None and not (
+            isinstance(deadline_s, (int, float))
+            and deadline_s == deadline_s  # NaN would poison every sweep
+        ):
+            # Also BEFORE grammar registration, for the same reason.
+            raise BadRequestError("deadline_s must be a number")
         max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
         prompt = prompt_tokens or [self.tokenizer.bos_id]
         self.validate_request(prompt, max_new)
@@ -1892,6 +1919,10 @@ class ContinuousEngine:
             adapter_id=adapter_id or 0,
             fsm_start=fsm_start,
             t_submit=time.monotonic(),
+            deadline=(
+                time.monotonic() + float(deadline_s)
+                if deadline_s is not None else None
+            ),
         )
         self._next_id += 1
         self.metrics.requests.inc()
@@ -2503,6 +2534,50 @@ class ContinuousEngine:
                 self._slot_pages[slot].extend(fresh)
                 self._table_dirty = True
 
+    def _expire(self, req: Request) -> None:
+        """Terminal bookkeeping for a deadline eviction: the request
+        completes (with whatever tokens it already produced), waiters see
+        ``expired``, streams get their terminal None, and the dedicated
+        counter moves — distinguishable from completion AND from client
+        cancellation on /metrics."""
+        req.expired = True
+        req.finished = True
+        req.cancelled = True  # lagged pipelined harvests must skip it
+        self.metrics.deadline_expired.inc()
+        if req.stream is not None:
+            req.stream.put(None)
+        self._completed[req.req_id] = req
+
+    def _expire_deadlines(self) -> None:
+        """Evict every queued/slotted request whose deadline passed — run
+        once per scheduler tick BEFORE admission and dispatch, so expired
+        work never costs a prefill or decode chunk it no longer needs. A
+        request mid-chunk when its deadline passes finishes that one chunk
+        (the program is already dispatched) and is evicted at the next
+        tick: at most one chunk of overrun, pinned by test_chaos."""
+        now = time.monotonic()
+        for req in list(self._queue):
+            if req.finished or req.cancelled:
+                # Preempted request that COMPLETED via its pending tick's
+                # lagged harvest while queued: its stream already got its
+                # terminal None and the result sits in _completed —
+                # re-expiring it would double-count the metric and turn a
+                # full result into a 504 (same state cancel() handles).
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._queue.remove(req)
+                self._expire(req)
+        for slot, req in enumerate(self._slots):
+            if (
+                req is not None and not req.finished and not req.cancelled
+                and req.deadline is not None
+                and now >= req.deadline
+            ):
+                self._slots[slot] = None
+                if self.cache_mode == "paged":
+                    self._free_slot_pages(slot)
+                self._expire(req)
+
     def _note_admitted(self, req: Request) -> None:
         """Telemetry at queue -> slot admission. A preemption-resume is not
         a second admission (queue wait is measured once, submit -> first
@@ -3013,6 +3088,10 @@ class ContinuousEngine:
         freed (masked out by the harvest snapshot). Token streams are
         identical to serial ticks — per-slot RNG derives from the request
         seed, never from tick alignment."""
+        # Chaos seam: `delay`/`hang` stall the scheduler (TTFT/stall
+        # drills); `error` surfaces through the driver as an engine death.
+        self.tick_count += 1
+        maybe_inject("engine.tick", step=self.tick_count)
         prev, self._pending_fetch = self._pending_fetch, None
         probe = self._serial_probe_due()
         if probe and prev is not None:
@@ -3020,6 +3099,7 @@ class ContinuousEngine:
             # interval times a quiet device, not the tail of tick N.
             self._finish_tick(prev)
             prev = None
+        self._expire_deadlines()
         self._admit()
         for req in self._slots:
             if req is not None and req.prefilling:
@@ -3320,10 +3400,13 @@ class ThreadedEngine:
         seed: int | None = None,
         adapter_id: int | None = None,
         grammar: Any = None,
+        deadline_s: float | None = None,
     ) -> list[int]:
         """Submit one request and block until it completes. Raises if the
         driver has stopped (shutdown or device error) — callers turn that
-        into an HTTP 500 instead of hanging the connection."""
+        into an HTTP 500 instead of hanging the connection — and
+        ``DeadlineExceededError`` when ``deadline_s`` expired the request
+        before completion (HTTP 504)."""
         with self._cond:
             if self._stop:
                 raise RuntimeError("continuous engine is stopped") from self._error
@@ -3335,9 +3418,16 @@ class ThreadedEngine:
                 seed=seed,
                 adapter_id=adapter_id,
                 grammar=grammar,
+                deadline_s=deadline_s,
             )
             self._cond.notify_all()
-            return self._wait_one(rid).tokens
+            req = self._wait_one(rid)
+            if req.expired:
+                raise DeadlineExceededError(
+                    f"request exceeded its {deadline_s}s deadline "
+                    f"({len(req.tokens)} tokens generated before eviction)"
+                )
+            return req.tokens
 
     def generate_one_with_logprobs(
         self,
@@ -3349,6 +3439,7 @@ class ThreadedEngine:
         top_p: float | None = None,
         seed: int | None = None,
         grammar: Any = None,
+        deadline_s: float | None = None,
     ) -> tuple[list[int], dict]:
         """``generate_one`` + per-token logprob stats (same dict layout as
         engine.Generator.generate_tokens_with_logprobs: ``token_logprobs``,
@@ -3366,9 +3457,15 @@ class ThreadedEngine:
                 seed=seed,
                 logprobs=n_top,
                 grammar=grammar,
+                deadline_s=deadline_s,
             )
             self._cond.notify_all()
             req = self._wait_one(rid)
+            if req.expired:
+                raise DeadlineExceededError(
+                    f"request exceeded its {deadline_s}s deadline "
+                    f"({len(req.tokens)} tokens generated before eviction)"
+                )
             return req.tokens, {
                 "token_logprobs": req.lp_token,
                 "top_ids": [row[:n_top] for row in req.lp_top_ids],
@@ -3438,12 +3535,15 @@ class ThreadedEngine:
         seed: int | None = None,
         adapter_id: int | None = None,
         grammar: Any = None,
+        deadline_s: float | None = None,
     ):
         """Submit one request and return an iterator of per-chunk token-id
         lists as they are decoded (SSE streaming). The submit happens
         EAGERLY — ``QueueFullError`` raises here, while the HTTP layer can
         still answer 429; once the SSE headers are out there is no status
-        left to send (ADVICE r2). Raises if the driver stops mid-stream."""
+        left to send (ADVICE r2). A ``deadline_s`` expiry simply ends the
+        stream (the terminal None — headers are long gone). Raises if the
+        driver stops mid-stream."""
         import queue as _queue
 
         stream: _queue.Queue = _queue.Queue()
@@ -3459,6 +3559,7 @@ class ThreadedEngine:
                 stream=stream,
                 adapter_id=adapter_id,
                 grammar=grammar,
+                deadline_s=deadline_s,
             )
             self._cond.notify_all()
 
@@ -3494,6 +3595,7 @@ class ThreadedEngine:
         top_p: float | None = None,
         seed: int | None = None,
         grammar: Any = None,
+        deadline_s: float | None = None,
     ):
         """``stream_one`` + per-chunk logprob stats: yields
         ``(token_ids, lp_dict)`` pairs where ``lp_dict`` carries the chunk's
@@ -3514,6 +3616,7 @@ class ThreadedEngine:
                 stream=stream,
                 logprobs=n_top,
                 grammar=grammar,
+                deadline_s=deadline_s,
             )
             self._cond.notify_all()
 

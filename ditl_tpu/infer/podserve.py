@@ -649,9 +649,33 @@ class PodContinuousDriver:
     def multi_lora(self) -> bool:
         return self._engine.multi_lora
 
+    # The server consults this for HEADER-derived deadlines (the gateway
+    # stamps every relay with its remaining budget): a best-effort hint is
+    # dropped rather than 400-ing every gateway-routed request. An explicit
+    # client `deadline_s` payload still goes through _reject_deadline.
+    supports_deadlines = False
+
+    @staticmethod
+    def _reject_deadline(deadline_s) -> None:
+        """Pod serving carries no deadlines: the tick broadcast replicates
+        the scheduler on every process, and per-process wall-clock expiry
+        sweeps would desync the replicas (divergent slot tables -> SPMD
+        fingerprint shutdown). Reject-don't-drop, so a client's deadline is
+        never silently ignored."""
+        if deadline_s is not None:
+            from ditl_tpu.infer.continuous import BadRequestError
+
+            raise BadRequestError(
+                "deadline_s does not compose with --pod serving (the tick "
+                "broadcast carries no deadlines; per-process clocks would "
+                "desync the replicated scheduler)"
+            )
+
     def generate_one(self, prompt_tokens, *, max_new_tokens=None,
                      temperature=None, top_p=None, seed=None,
-                     adapter_id=None, grammar=None) -> list[int]:
+                     adapter_id=None, grammar=None,
+                     deadline_s=None) -> list[int]:
+        self._reject_deadline(deadline_s)
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
                              top_p, seed, adapter_id=adapter_id,
                              grammar=grammar)
@@ -713,9 +737,10 @@ class PodContinuousDriver:
 
     def stream_one(self, prompt_tokens, *, max_new_tokens=None,
                    temperature=None, top_p=None, seed=None, adapter_id=None,
-                   grammar=None):
+                   grammar=None, deadline_s=None):
         import queue as _queue
 
+        self._reject_deadline(deadline_s)
         stream: _queue.Queue = _queue.Queue()
         # Staged EAGERLY (not on first next()): QueueFullError must raise
         # while the HTTP layer can still answer 429 — after the SSE headers

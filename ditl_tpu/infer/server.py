@@ -33,9 +33,14 @@ import time
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ditl_tpu.chaos import InjectedFault, maybe_inject
 from ditl_tpu.config import ModelConfig
 from ditl_tpu.data.tokenizer import Tokenizer
-from ditl_tpu.infer.continuous import BadRequestError, QueueFullError
+from ditl_tpu.infer.continuous import (
+    BadRequestError,
+    DeadlineExceededError,
+    QueueFullError,
+)
 from ditl_tpu.infer.engine import GenerateConfig, Generator
 from ditl_tpu.telemetry.serving import ServingMetrics
 from ditl_tpu.utils.logging import get_logger
@@ -490,6 +495,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "type": "unavailable_error",
             }})
             return
+        # Chaos seam: `error` answers a clean 500 (the gateway's retry
+        # fodder), `delay`/`hang` make this replica slow-not-dead (hedging
+        # and health-poll drills), `kill` is a real replica death.
+        try:
+            maybe_inject("server.request")
+        except InjectedFault as e:
+            self._send_json(500, {"error": {"message": str(e)}})
+            return
         tracked = hasattr(srv, "_enter_request")
         n = srv._enter_request() if tracked else 0
         try:
@@ -554,16 +567,34 @@ class _Handler(BaseHTTPRequestHandler):
             )[0]
 
     def _send_sse(self, events) -> None:
-        """Stream pre-serialized JSON events as Server-Sent Events."""
+        """Stream pre-serialized JSON events as Server-Sent Events.
+
+        A client that vanishes mid-stream (broken pipe / reset on write)
+        CANCELS the in-flight generation deterministically: closing the
+        events generator unwinds its ``finally`` chain into
+        ``ThreadedEngine.stream_one``'s cancel, freeing the slot instead of
+        decoding the abandoned token budget — and the eviction is counted
+        (``client_disconnects``) so vanishing clients are visible on
+        /metrics, not just a GC side effect."""
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
         self.end_headers()
-        for event in events:
-            self.wfile.write(f"data: {json.dumps(event)}\n\n".encode())
+        try:
+            for event in events:
+                self.wfile.write(f"data: {json.dumps(event)}\n\n".encode())
+                self.wfile.flush()
+            self.wfile.write(b"data: [DONE]\n\n")
             self.wfile.flush()
-        self.wfile.write(b"data: [DONE]\n\n")
-        self.wfile.flush()
+        except OSError:  # BrokenPipeError/ConnectionError are subclasses
+            if self.serving_metrics is not None:
+                self.serving_metrics.client_disconnects.inc()
+            logger.info(
+                "client disconnected mid-stream; cancelling in-flight "
+                "generation"
+            )
+        finally:
+            events.close()
 
     def _multi_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, n: int,
@@ -845,7 +876,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None,
-        stops=None, lp_n=None, grammar=None,
+        stops=None, lp_n=None, grammar=None, deadline_s=None,
     ) -> None:
         """OpenAI streaming: real incremental chunks from the continuous
         engine; the lockstep engine generates fully, then emits one chunk.
@@ -890,6 +921,7 @@ class _Handler(BaseHTTPRequestHandler):
                     top_p=gen.top_p,
                     seed=gen.seed,
                     grammar=grammar,
+                    deadline_s=deadline_s,
                 )
             else:
                 stream_iter = self.threaded_engine.stream_one(
@@ -900,6 +932,7 @@ class _Handler(BaseHTTPRequestHandler):
                     seed=gen.seed,
                     adapter_id=adapter_ids[0] if adapter_ids else None,
                     grammar=grammar,
+                    deadline_s=deadline_s,
                 )
 
         def events():
@@ -1006,6 +1039,36 @@ class _Handler(BaseHTTPRequestHandler):
                 top_p=float(payload.get("top_p") or 1.0),
                 seed=int(seed),
             )
+            # Per-request deadline (ISSUE 5): the client's `deadline_s`
+            # payload field, or the `X-Request-Deadline-S` header the
+            # gateway stamps with the remaining fleet budget. Enforced by
+            # the continuous engine (queue/slot eviction); an
+            # already-expired arrival answers 504 before any device work
+            # on either engine.
+            deadline_s = payload.get("deadline_s")
+            from_header = False
+            if deadline_s is None:
+                deadline_s = self.headers.get("X-Request-Deadline-S")
+                from_header = deadline_s is not None
+            if deadline_s is not None:
+                try:
+                    deadline_s = float(deadline_s)
+                except (TypeError, ValueError):
+                    self._send_json(400, {"error": {"message":
+                        "deadline_s must be a number (seconds)"}})
+                    return
+                if deadline_s != deadline_s:  # NaN: poisons deadline sweeps
+                    self._send_json(400, {"error": {"message":
+                        "deadline_s must be a number (seconds)"}})
+                    return
+                if deadline_s <= 0:
+                    if self.serving_metrics is not None:
+                        self.serving_metrics.deadline_expired.inc()
+                    self._send_json(504, {"error": {
+                        "message": "deadline expired before any work began",
+                        "type": "timeout_error",
+                    }})
+                    return
             try:
                 stops = _stop_list(payload.get("stop"))
             except ValueError as e:
@@ -1033,6 +1096,33 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": {"message":
                     "n and best_of must be integers"}})
                 return
+            if deadline_s is not None:
+                # Deadline ENFORCEMENT (queue/slot eviction) lives in the
+                # continuous engine's single-choice path only. Everywhere
+                # else — lockstep, the pod driver (per-process clocks would
+                # desync its replicated scheduler), the adapter fallback,
+                # n/best_of batching — an explicit client `deadline_s` is
+                # rejected rather than silently decoded to the full budget
+                # (reject-don't-drop), while the gateway's header (stamped
+                # on every relay) is a best-effort hint and is dropped.
+                enforceable = (
+                    self.threaded_engine is not None
+                    and getattr(self.threaded_engine, "supports_deadlines",
+                                True)
+                    and (adapter_ids is None
+                         or getattr(self.threaded_engine, "multi_lora",
+                                    False))
+                    and n_choices == 1 and best_of == 1
+                )
+                if not enforceable:
+                    if from_header:
+                        deadline_s = None
+                    else:
+                        self._send_json(400, {"error": {"message":
+                            "deadline_s requires the continuous-engine "
+                            "single-choice serving path (no lockstep/pod "
+                            "engine, adapter fallback, or n/best_of)"}})
+                        return
             if n_choices > 1 or best_of > 1:
                 if not (1 <= n_choices <= best_of <= 8):
                     self._send_json(400, {"error": {"message":
@@ -1084,7 +1174,7 @@ class _Handler(BaseHTTPRequestHandler):
                     self._stream_complete(
                         payload, prompt, gen, chat=chat,
                         adapter_ids=adapter_ids, stops=stops, lp_n=lp_n,
-                        grammar=grammar,
+                        grammar=grammar, deadline_s=deadline_s,
                     )
                 except QueueFullError as e:
                     # The stream's submit is eager (before SSE headers), so
@@ -1136,6 +1226,7 @@ class _Handler(BaseHTTPRequestHandler):
                         temperature=gen.temperature, top_p=gen.top_p,
                         seed=gen.seed,
                         grammar=grammar,
+                        deadline_s=deadline_s,
                     )
                 elif grammar is not None:
                     # Guided requests never fall back to the lock-step
@@ -1247,6 +1338,7 @@ class _Handler(BaseHTTPRequestHandler):
                     seed=gen.seed,
                     adapter_id=adapter_ids[0] if adapter_ids else None,
                     grammar=grammar,
+                    deadline_s=deadline_s,
                 )
                 n_gen = len(out)
                 text, hit_stop = _apply_stop(tok.decode(out), stops)
@@ -1309,6 +1401,14 @@ class _Handler(BaseHTTPRequestHandler):
 
             if isinstance(e, QueueFullError):
                 self._send_429(str(e))
+                return
+            if isinstance(e, DeadlineExceededError):
+                # The engine already evicted the request and counted it
+                # (deadline_expired); 504 tells the client (and gateway)
+                # the deadline — not the server — ended this request.
+                self._send_json(504, {"error": {
+                    "message": str(e), "type": "timeout_error",
+                }})
                 return
             if isinstance(e, ValueError) and "fsm_capacity exhausted" in str(e):
                 # Guided table full: a server-capacity condition, not a

@@ -232,6 +232,10 @@ def run_process_supervised(argv: list[str], num_workers: int = 1) -> int:
         max_pod_restarts=config.train.max_restarts if can_resume else 0,
         heartbeat_dir=config.train.heartbeat_dir,
         heartbeat_timeout_s=config.train.heartbeat_timeout_s,
+        # Slow-not-dead escalation (ISSUE 5): heartbeat STEP lag vs. the
+        # pod median, journaled `pod.straggler`, optionally relaunching.
+        straggler_lag_steps=config.train.straggler_lag_steps,
+        straggler_relaunch=config.train.straggler_relaunch,
         # The trainer emits heartbeats under its jax.process_index(): the
         # worker slot for a controller-owned pod, but the configured (or,
         # when rank is autodetected, unknowable — None = wildcard) process

@@ -35,10 +35,12 @@ import json
 import os
 import signal
 import socket
+import statistics
 import subprocess
 import time
 from typing import Callable, Sequence
 
+from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.telemetry import (
     EventJournal,
     controller_journal_path,
@@ -110,6 +112,9 @@ def heartbeat_path(directory: str, process_index: int) -> str:
 def emit_heartbeat(directory: str, process_index: int, step: int) -> None:
     """Atomically publish liveness (called by the trainer once per step
     window, and once before the first step so compile time reads as alive)."""
+    # Chaos seam: `delay`/`hang` here starve the controller's staleness
+    # watchdog (drilling stall-detection), `error` crashes the beat path.
+    maybe_inject("elastic.heartbeat", step=step)
     os.makedirs(directory, exist_ok=True)
     path = heartbeat_path(directory, process_index)
     tmp = f"{path}.tmp.{os.getpid()}"
@@ -192,6 +197,8 @@ class PodController:
         log: Callable[[str], None] | None = None,
         on_restart: Callable[[int, int, int], None] | None = None,
         journal_dir: str = "",
+        straggler_lag_steps: int = 0,
+        straggler_relaunch: bool = False,
     ):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -244,6 +251,17 @@ class PodController:
                          source="controller")
             if journal_dir else None
         )
+        # Straggler escalation (ISSUE 5): _stale_workers only sees
+        # dead-or-silent workers; a slow-NOT-dead worker (thermal throttle,
+        # noisy neighbor, degraded NIC) heartbeats on time while its STEP
+        # falls behind the pod — in SPMD that drags every peer to its pace.
+        # A worker lagging the pod-median heartbeat step by more than
+        # straggler_lag_steps is journaled (`pod.straggler`) once per
+        # generation, and with straggler_relaunch=True escalated to the
+        # same teardown-and-relaunch path as a death.
+        self.straggler_lag_steps = straggler_lag_steps
+        self.straggler_relaunch = straggler_relaunch
+        self._straggler_flagged: set[int] = set()
 
     def _jevent(self, event: str, **attrs) -> None:
         if self._journal is not None:
@@ -260,6 +278,11 @@ class PodController:
     # -- lifecycle ----------------------------------------------------------
 
     def _spawn(self, attempt: int) -> None:
+        # Chaos seam: `delay` slows generation bring-up, `error` fails the
+        # spawn (run()'s teardown still reaps earlier workers), `kill`
+        # drills losing the controller itself.
+        maybe_inject("elastic.spawn", step=attempt)
+        self._straggler_flagged = set()
         port = self.port_factory()
         self.ports.append(port)
         self._transition(
@@ -353,6 +376,34 @@ class PodController:
             if now - base > self.heartbeat_timeout_s:
                 stale.append(i)
         return stale
+
+    def _straggler_workers(self) -> list[tuple[int, int, int, int]]:
+        """(worker, step, lag, pod_median) for live workers whose heartbeat
+        STEP trails the pod median by more than ``straggler_lag_steps`` —
+        the slow-not-dead class the liveness checks cannot see. Needs >= 2
+        live step-reporting workers (a median of one is the worker itself)
+        and attributable heartbeat slots (wildcard slots cannot be blamed)."""
+        if not (self.heartbeat_dir and self.straggler_lag_steps > 0):
+            return []
+        steps: dict[int, int] = {}
+        for i, p in enumerate(self._procs):
+            if p.poll() is not None:
+                continue
+            hb_id = self.heartbeat_ids[i]
+            if hb_id is None:
+                continue
+            hb = read_heartbeat(heartbeat_path(self.heartbeat_dir, hb_id))
+            if hb is None or not isinstance(hb.get("step"), (int, float)):
+                continue
+            steps[i] = int(hb["step"])
+        if len(steps) < 2:
+            return []
+        med = int(statistics.median(steps.values()))
+        return [
+            (i, s, med - s, med)
+            for i, s in sorted(steps.items())
+            if med - s > self.straggler_lag_steps
+        ]
 
     def run(self, timeout_s: float | None = None) -> PodResult:
         """Drive the pod to DONE or FAILED. ``timeout_s`` is a hard wall-clock
@@ -457,6 +508,34 @@ class PodController:
                     self._failure_rc = 1
                     self._jevent("pod.heartbeat_stale", worker=stale[0],
                                  timeout_s=self.heartbeat_timeout_s)
+                else:
+                    stragglers = self._straggler_workers()
+                    for i, step_i, lag_i, med in stragglers:
+                        if i in self._straggler_flagged:
+                            continue
+                        # Journal once per (worker, generation): the lag
+                        # persists poll after poll and must not spam the
+                        # timeline.
+                        self._straggler_flagged.add(i)
+                        self._jevent(
+                            "pod.straggler", worker=i, step=step_i,
+                            lag=lag_i, median=med,
+                            escalate=self.straggler_relaunch,
+                        )
+                        self._log(
+                            f"pod-controller: worker {i} straggling "
+                            f"(step {step_i}, {lag_i} behind pod median "
+                            f"{med}; escalate="
+                            f"{'relaunch' if self.straggler_relaunch else 'log-only'})"
+                        )
+                    if stragglers and self.straggler_relaunch:
+                        i, step_i, lag_i, _med = stragglers[0]
+                        failure = (
+                            f"worker {i} straggling "
+                            f"({lag_i} steps behind pod median)"
+                        )
+                        # A straggler has no exit code either.
+                        self._failure_rc = 1
             if failure is None:
                 if timed_out:
                     # Like the stale branch: no worker failed — don't let

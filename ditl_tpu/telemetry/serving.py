@@ -129,6 +129,14 @@ class ServingMetrics:
             "speculative drafted tokens rejected by verify forwards")
         self.tokens_generated = r.counter(
             f"{PREFIX}_tokens_generated", "tokens generated (all requests)")
+        self.deadline_expired = r.counter(
+            f"{PREFIX}_deadline_expired",
+            "requests evicted from the queue/slots at their deadline "
+            "(expired work stops consuming engine ticks)")
+        self.client_disconnects = r.counter(
+            f"{PREFIX}_client_disconnects",
+            "in-flight generations cancelled because the client vanished "
+            "mid-stream")
 
     def render(self) -> str:
         return self.registry.render()

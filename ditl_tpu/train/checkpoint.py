@@ -8,18 +8,56 @@ step-within-epoch) so resume continues the exact epoch-seeded shuffle the
 contract extends across restarts. Saves are async (Orbax writes in the
 background while training continues) and multi-host-safe (each host writes its
 addressable shards; Orbax coordinates the commit).
+
+**Crash consistency (ISSUE 5):** Orbax's finalize-rename makes a *clean*
+interrupted save invisible, but it cannot see bit rot, truncation after
+commit, or a SIGKILL landing mid-finalize on a filesystem without atomic
+directory rename. This module therefore adds its own integrity layer:
+
+- at commit, a per-item manifest (``ditl_manifest.json``: relpath ->
+  size + crc32 for every file under the step dir) is written atomically
+  into the step dir;
+- ``restore_latest`` / ``restore_latest_params`` verify the newest step
+  against its manifest first, QUARANTINE torn/corrupt steps (moved whole
+  into ``<dir>/quarantine/`` — never deleted, an operator can autopsy) and
+  leftover ``*.orbax-checkpoint-tmp*`` wreckage from a killed save, and
+  fall back to the newest step that verifies — zero manual cleanup;
+- every quarantine/fallback is journaled (telemetry/journal.py), which is
+  what the kill-mid-save chaos drill asserts in causal order.
+
+A step with NO manifest (written by an older build) is "legacy": restore is
+attempted, and only a failing read quarantines it — old checkpoint dirs
+keep resuming.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
+import threading
+import time
+import zlib
 from typing import Any
 
+from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
 
-__all__ = ["CheckpointManager", "DataIterState"]
+__all__ = ["CheckpointManager", "DataIterState", "MANIFEST_NAME"]
+
+MANIFEST_NAME = "ditl_manifest.json"
+
+
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
 
 
 @dataclasses.dataclass
@@ -30,12 +68,21 @@ class DataIterState:
 
 
 class CheckpointManager:
-    """Thin wrapper over ``orbax.checkpoint.CheckpointManager``."""
+    """Thin wrapper over ``orbax.checkpoint.CheckpointManager`` adding the
+    crash-consistency layer (module docstring). ``journal`` (an
+    ``EventJournal``) records commit/quarantine/fallback events into the
+    caller's timeline."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3, save_every: int = 0):
+    def __init__(self, directory: str, max_to_keep: int = 3, save_every: int = 0,
+                 journal=None):
         import orbax.checkpoint as ocp
 
         self.save_every = save_every
+        self._journal = journal
+        # Steps whose async save has been issued but whose integrity
+        # manifest is not yet on disk (written once the save finishes).
+        self._pending_manifest: list[int] = []
+        self._manifest_thread: threading.Thread | None = None
         # Register the item handlers up front so a FRESH manager (the
         # serving path restores from checkpoints it never wrote) can answer
         # item_metadata()/restore() without the hand-built
@@ -72,9 +119,217 @@ class CheckpointManager:
             and (step // self.save_every) > ((step - n_advanced) // self.save_every)
         )
 
+    # -- crash-consistency layer --------------------------------------------
+
+    def _jevent(self, event: str, **attrs) -> None:
+        if self._journal is not None:
+            self._journal.event(event, **attrs)
+
+    def _is_primary(self) -> bool:
+        """Exactly one process writes manifests / quarantines (shared fs);
+        every process VERIFIES."""
+        try:
+            import jax
+
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+    def _step_path(self, step: int) -> str:
+        return os.path.join(str(self.directory), str(step))
+
+    def _list_steps(self) -> list[int]:
+        """Finalized step dirs, newest first — read from the filesystem, not
+        the Orbax manager's cache, so a quarantine is visible immediately."""
+        try:
+            names = os.listdir(str(self.directory))
+        except OSError:
+            return []
+        return sorted((int(n) for n in names if n.isdigit()), reverse=True)
+
+    def _write_manifest(self, step: int) -> None:
+        d = self._step_path(step)
+        if not os.path.isdir(d):
+            return  # save never finalized (or already quarantined)
+        files: dict[str, dict] = {}
+        for root, _dirs, names in os.walk(d):
+            for name in names:
+                if name == MANIFEST_NAME:
+                    continue
+                path = os.path.join(root, name)
+                rel = os.path.relpath(path, d)
+                try:
+                    files[rel] = {
+                        "size": os.path.getsize(path),
+                        "crc32": _file_crc32(path),
+                    }
+                except OSError:
+                    return  # step mutating under us (gc?): skip, stay legacy
+        tmp = os.path.join(d, f"{MANIFEST_NAME}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump({"step": step, "files": files}, f, sort_keys=True)
+        os.replace(tmp, os.path.join(d, MANIFEST_NAME))
+        self._jevent("checkpoint.commit", step=step, n_files=len(files))
+
+    def _flush_manifests(self, sync: bool = True) -> None:
+        """Manifest every save that has finished since the last flush.
+        Called where the manager already synchronizes (next save / wait /
+        close), so saves stay async: the manifest lands at the first
+        barrier after the commit, and a crash in the gap just leaves a
+        legacy-status step (restore still verifies it by reading).
+
+        ``sync=False`` (the next-save path): the checksum walk re-reads
+        every checkpoint byte, so it runs on a background thread instead
+        of stalling the training thread beyond Orbax's own barrier — by
+        the following save interval the thread has long finished (the
+        join is free). Restore/wait/close use ``sync=True``: manifests
+        must be ON DISK before verify_step reads them."""
+        if self._manifest_thread is not None:
+            self._manifest_thread.join()
+            self._manifest_thread = None
+        if not self._pending_manifest:
+            return
+        self._mgr.wait_until_finished()
+        pending, self._pending_manifest = self._pending_manifest, []
+        if not self._is_primary():
+            return
+        if sync:
+            for step in pending:
+                self._write_manifest(step)
+            return
+
+        def _write_all():
+            for step in pending:
+                self._write_manifest(step)
+
+        self._manifest_thread = threading.Thread(
+            target=_write_all, name="ckpt-manifest", daemon=True
+        )
+        self._manifest_thread.start()
+
+    def verify_step(self, step: int) -> str:
+        """``"verified"`` (manifest matches), ``"corrupt"`` (manifest
+        present but a file is missing/resized/bit-flipped), or ``"legacy"``
+        (no manifest — an older build wrote it; restore decides by
+        reading)."""
+        d = self._step_path(step)
+        mpath = os.path.join(d, MANIFEST_NAME)
+        if not os.path.exists(mpath):
+            return "legacy"
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+        except (OSError, ValueError, KeyError, TypeError):
+            return "corrupt"
+        for rel, meta in files.items():
+            path = os.path.join(d, rel)
+            try:
+                if os.path.getsize(path) != meta["size"]:
+                    return "corrupt"
+                if _file_crc32(path) != meta["crc32"]:
+                    return "corrupt"
+            except OSError:
+                return "corrupt"
+        return "verified"
+
+    def quarantine_step(self, step: int, reason: str) -> str | None:
+        """Move a torn/corrupt step dir whole into ``<dir>/quarantine/`` —
+        out of the restore scan, preserved for autopsy. Multi-host safe: a
+        concurrent peer's rename winning is the same outcome (ENOENT =
+        already quarantined)."""
+        return self._quarantine_path(self._step_path(step), reason, step=step)
+
+    def _quarantine_path(self, src: str, reason: str,
+                         step: int | None = None) -> str | None:
+        qdir = os.path.join(str(self.directory), "quarantine")
+        name = os.path.basename(src.rstrip(os.sep))
+        dest = os.path.join(qdir, name)
+        if os.path.exists(dest):
+            dest = f"{dest}.{int(time.time() * 1000)}"
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            os.rename(src, dest)
+        except OSError:
+            return None  # a peer got there first (or src vanished)
+        logger.warning(
+            "checkpoint quarantined: %s -> %s (%s)", src, dest, reason
+        )
+        self._jevent("checkpoint.quarantine", step=step, reason=reason,
+                     path=dest)
+        # The writing manager caches its step list at construction; a step
+        # quarantined out from under it would crash the NEXT save's
+        # max_to_keep GC scan (reading metadata of a dir that moved).
+        try:
+            self._mgr.reload()
+        except Exception:
+            logger.exception("orbax manager reload after quarantine failed")
+        return dest
+
+    def _sweep_tmp_dirs(self) -> None:
+        """Quarantine leftover ``*.orbax-checkpoint-tmp*`` wreckage — the
+        footprint of a save that was mid-write when its process died
+        (SIGKILL). Orbax never lists them as steps, but they hold disk and
+        confuse operators; sweeping them is the 'zero manual cleanup' half
+        of the kill-mid-save contract."""
+        try:
+            names = os.listdir(str(self.directory))
+        except OSError:
+            return
+        for name in names:
+            if "orbax-checkpoint-tmp" in name:
+                self._quarantine_path(
+                    os.path.join(str(self.directory), name),
+                    "torn save (process died mid-write)",
+                )
+
+    def _apply_save_fault(self, fault, step: int) -> None:
+        """Chaos drill support: make the just-issued save COMMIT, manifest
+        it, then tear one file — the deterministic spelling of 'the process
+        died mid-save / the storage lied'. ``kill`` then SIGKILLs self
+        (journal already has chaos.inject + checkpoint.commit on disk);
+        ``corrupt`` returns, leaving a silently corrupt newest step the
+        next restore must detect and fall back from."""
+        self._mgr.wait_until_finished()
+        if self._manifest_thread is not None:
+            # Drills want deterministic disk state at the kill: older
+            # steps' manifests must not be mid-write when it lands.
+            self._manifest_thread.join()
+            self._manifest_thread = None
+        self._pending_manifest = [s for s in self._pending_manifest
+                                  if s != step]
+        self._write_manifest(step)
+        d = self._step_path(step)
+        victim, vsize = None, -1
+        for root, _dirs, names in os.walk(d):
+            for name in sorted(names):
+                if name == MANIFEST_NAME:
+                    continue
+                p = os.path.join(root, name)
+                size = os.path.getsize(p)
+                if size > vsize:
+                    victim, vsize = p, size
+        if victim is not None:
+            with open(victim, "r+b") as f:
+                f.truncate(max(0, vsize // 2))
+            logger.error(
+                "chaos: tore checkpoint step %d (%s truncated %d -> %d)",
+                step, os.path.relpath(victim, d), vsize, max(0, vsize // 2),
+            )
+            self._jevent("checkpoint.torn", step=step,
+                         file=os.path.relpath(victim, d))
+        if fault.action == "kill":
+            fault.kill_now()
+
+    # -- save / restore ------------------------------------------------------
+
     def save(self, step: int, state: Any, data_iter: DataIterState) -> None:
         import orbax.checkpoint as ocp
 
+        # Previous async save is done by now (Orbax serializes saves);
+        # manifest it before committing new work (checksums run off-thread).
+        self._flush_manifests(sync=False)
+        fault = maybe_inject("ckpt.save", step=step, handles=("kill",))
         self._mgr.save(
             step,
             args=ocp.args.Composite(
@@ -82,26 +337,74 @@ class CheckpointManager:
                 data_iter=ocp.args.JsonSave(dataclasses.asdict(data_iter)),
             ),
         )
+        self._pending_manifest.append(step)
+        if fault is not None and fault.action in ("kill", "corrupt"):
+            self._apply_save_fault(fault, step)
         logger.info("checkpoint save queued at step %d", step)
 
+    def _restore_newest_verified(self, restore_fn):
+        """The fallback walk both restore entry points share (module
+        docstring): newest -> oldest, verify each step against its
+        manifest, quarantine corrupt steps, attempt ``restore_fn(step)``,
+        re-raise when VERIFIED bytes fail to restore (intact bytes mean a
+        config mismatch or code bug — falling back would silently serve
+        an older state than asked for), quarantine failing legacy steps.
+        Returns ``(step, result, fell_back)``, or None when no restorable
+        step remains."""
+        fell_back = False
+        for step in self._list_steps():
+            status = self.verify_step(step)
+            if status == "corrupt":
+                self.quarantine_step(step, "integrity manifest mismatch")
+                fell_back = True
+                continue
+            try:
+                out = restore_fn(step)
+            except Exception as e:
+                if status == "verified":
+                    raise  # intact bytes: the failure is not corruption
+                self.quarantine_step(
+                    step, f"restore failed: {type(e).__name__}: {e}"
+                )
+                fell_back = True
+                continue
+            return step, out, fell_back
+        return None
+
     def restore_latest(self, abstract_state: Any) -> tuple[Any, DataIterState] | None:
-        """Restore the newest checkpoint, sharded per ``abstract_state``
-        (a jax.eval_shape tree with shardings attached). Returns None if no
-        checkpoint exists."""
+        """Restore the newest VERIFIED checkpoint, sharded per
+        ``abstract_state`` (a jax.eval_shape tree with shardings attached).
+        Torn/corrupt newer steps are quarantined and skipped (module
+        docstring); a step whose bytes verify but whose restore raises is a
+        REAL error (config mismatch, code bug) and re-raises. Returns None
+        if no restorable checkpoint exists."""
         import orbax.checkpoint as ocp
 
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract_state),
-                data_iter=ocp.args.JsonRestore(),
-            ),
+        maybe_inject("ckpt.restore")
+        self._flush_manifests()
+        self._sweep_tmp_dirs()
+        hit = self._restore_newest_verified(
+            lambda step: self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract_state),
+                    data_iter=ocp.args.JsonRestore(),
+                ),
+            )
         )
+        if hit is None:
+            return None
+        step, restored, fell_back = hit
         data_iter = DataIterState(**restored["data_iter"])
-        logger.info("restored checkpoint at step %d", step)
+        logger.info(
+            "restored checkpoint at step %d%s", step,
+            " (fell back past quarantined step(s))" if fell_back else "",
+        )
+        self._jevent(
+            "checkpoint.fallback_restore" if fell_back
+            else "checkpoint.restore",
+            step=step,
+        )
         return restored["state"], data_iter
 
     def restore_latest_params(self, abstract_params: Any = None) -> Any | None:
@@ -118,11 +421,13 @@ class CheckpointManager:
         restores only its addressable shards of the global arrays — the
         cross-process mirror of how the checkpoint was written. Without
         shardings the restore yields host numpy (single-process serving)."""
-        import jax
         import orbax.checkpoint as ocp
 
-        step = self._mgr.latest_step()
-        if step is None:
+        maybe_inject("ckpt.restore")
+        self._flush_manifests()
+        self._sweep_tmp_dirs()
+        steps = self._list_steps()
+        if not steps:
             return None
         # Manager-API route (no hand-built "{dir}/{step}/state" paths): a
         # READ-ONLY manager over the same directory whose "state" handler is
@@ -139,7 +444,20 @@ class CheckpointManager:
             options=ocp.CheckpointManagerOptions(read_only=True),
         )
         try:
-            return self._restore_params_via(reader, step, abstract_params)
+            hit = self._restore_newest_verified(
+                lambda step: self._restore_params_via(
+                    reader, step, abstract_params
+                )
+            )
+            if hit is None:
+                return None
+            step, params, fell_back = hit
+            self._jevent(
+                "checkpoint.fallback_restore" if fell_back
+                else "checkpoint.restore",
+                step=step, params_only=True,
+            )
+            return params
         finally:
             reader.close()
 
@@ -217,6 +535,13 @@ class CheckpointManager:
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_manifests()
 
     def close(self) -> None:
+        try:
+            self._flush_manifests()
+        except Exception:
+            # Close must succeed even when a final manifest cannot be
+            # written (fs gone mid-teardown); the step just stays legacy.
+            logger.exception("manifest flush failed during close")
         self._mgr.close()

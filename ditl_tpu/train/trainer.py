@@ -18,6 +18,7 @@ from typing import Any
 import jax
 import numpy as np
 
+from ditl_tpu.chaos import arm_chaos
 from ditl_tpu.client.eval_loop import run_api_eval
 from ditl_tpu.client.llm import LLMClient
 from ditl_tpu.config import Config
@@ -166,6 +167,18 @@ def train(config: Config) -> dict[str, Any]:
             source=f"worker-{jax.process_index()}",
         )
         journal.event("worker.start")
+    # Chaos plane (ditl_tpu/chaos/, ISSUE 5): armed pod-wide from the
+    # identical config (the fingerprint covers chaos.*); per-worker
+    # targeting via rule `proc=N`. Injections journal into this worker's
+    # event stream so the merged pod timeline shows inject -> death ->
+    # relaunch -> recovery in causal order; fire counts persist under
+    # telemetry_dir so `max=N` caps survive the kills they inject.
+    arm_chaos(
+        config.chaos,
+        journal=journal,
+        process_id=jax.process_index(),
+        state_dir=config.chaos.journal_dir or config.train.telemetry_dir,
+    )
     mesh = build_mesh(config.mesh)
     model_cfg = config.model  # preset resolution happens in launch.build_config
 
@@ -247,6 +260,9 @@ def train(config: Config) -> dict[str, Any]:
             config.train.checkpoint_dir,
             max_to_keep=config.train.keep_checkpoints,
             save_every=config.train.checkpoint_every,
+            # Commit/quarantine/fallback events land in this worker's
+            # journal — the kill-mid-save drill asserts them in order.
+            journal=journal,
         )
         if config.train.resume:
             abstract = jax.tree.map(
