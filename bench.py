@@ -558,7 +558,8 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
 def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
                   prompt_len: int = 0, max_new: int = 0,
                   router: str = "affinity",
-                  compile_cache_dir: str = "") -> int:
+                  compile_cache_dir: str = "",
+                  trace_out: str = "") -> int:
     """Fleet-level serving benchmark (ISSUE 4 satellite): N in-process
     continuous-engine replicas behind the gateway, driven over real HTTP
     with a prefix-grouped workload (the regime cache-affinity routing
@@ -596,16 +597,49 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     tok = ByteTokenizer()
     shared_gen = Generator(params, cfg, tok)  # tokenize/metadata routes only
     n_requests = n_replicas * slots * 2
+    # --trace-out (ISSUE 6): arm request tracing across the gateway and
+    # every replica engine; after the run the merged journals export to
+    # Chrome-trace JSON (open at ui.perfetto.dev) — the per-request
+    # timeline artifact behind the bench row's aggregate numbers.
+    trace_dir = ""
+    tracers: list = [None] * n_replicas
+    gw_tracer = None
+    trace_journals: list = []
+    if trace_out:
+        import os
+        import tempfile
+
+        from ditl_tpu.telemetry.journal import EventJournal
+        from ditl_tpu.telemetry.tracing import Tracer
+
+        trace_dir = tempfile.mkdtemp(prefix="ditl-bench-trace-")
+        tracers = []
+        for i in range(n_replicas):
+            j = EventJournal(
+                os.path.join(trace_dir, f"events-replica-{i}.jsonl"),
+                source=f"replica-{i}",
+            )
+            trace_journals.append(j)
+            tracers.append(Tracer(j))
+        gw_journal = EventJournal(
+            os.path.join(trace_dir, "events-gateway.jsonl"),
+            source="gateway",
+        )
+        trace_journals.append(gw_journal)
+        gw_tracer = Tracer(gw_journal)
     engines = [
         ThreadedEngine(ContinuousEngine(
             params, cfg, tok, n_slots=slots, decode_chunk=decode_chunk,
             gen=GenerateConfig(max_new_tokens=max_new),
             max_queue=n_requests,
+            tracer=tracers[i],
         ))
-        for _ in range(n_replicas)
+        for i in range(n_replicas)
     ]
 
     def factory(eng):
+        # make_server derives its tracer from the engine's, so replica
+        # server.request spans land in the same per-replica journal.
         return lambda: make_server(shared_gen, port=0, threaded_engine=eng,
                                    default_max_tokens=max_new)
 
@@ -619,7 +653,8 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     # would swallow the unique suffix whenever plen < 32 (the CPU smoke),
     # making every key distinct and the affinity A/B meaningless.
     gwcfg = GatewayConfig(router=router, affinity_prefix_tokens=plen)
-    server = make_gateway(fleet, config=gwcfg, metrics=metrics, port=0)
+    server = make_gateway(fleet, config=gwcfg, metrics=metrics, port=0,
+                          tracer=gw_tracer)
     import threading
 
     threading.Thread(target=server.serve_forever, daemon=True).start()
@@ -672,6 +707,24 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         tokens = sum(pool.map(one, prompts))
         dt = time.perf_counter() - t0
     summary = metrics.summary()
+    trace_extra = {}
+    if trace_out:
+        from ditl_tpu.telemetry.trace_export import (
+            load_trace_records, to_chrome_trace, trace_ids,
+        )
+
+        for j in trace_journals:
+            j.close()
+        records = load_trace_records(trace_dir)
+        with open(trace_out, "w") as f:
+            json.dump(to_chrome_trace(records), f)
+        trace_extra = {"trace": {
+            "out": trace_out,
+            "traces": len(trace_ids(records)),
+            "journal_dir": trace_dir,
+        }}
+        print(f"bench: wrote Chrome-trace JSON to {trace_out} "
+              f"(open at https://ui.perfetto.dev)", file=sys.stderr)
     print(json.dumps({
         "metric": "fleet decode tokens/sec (%d replica(s) x %d slots, "
                   "router=%s)" % (n_replicas, slots, router),
@@ -693,6 +746,7 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
                 and k.endswith("_routed")
             },
         },
+        **trace_extra,
         **_chaos_result(),
     }))
     server.shutdown()
@@ -1001,6 +1055,11 @@ if __name__ == "__main__":
     parser.add_argument("--chaos-seed", type=int, default=0,
                         help="fault-plane seed (--chaos): the same seed "
                         "replays the identical fault sequence")
+    parser.add_argument("--trace-out", default="", metavar="PATH",
+                        help="with --serve-replicas: arm end-to-end request "
+                        "tracing (ISSUE 6) across the gateway and every "
+                        "replica, and write the merged Chrome-trace/"
+                        "Perfetto JSON here (open at ui.perfetto.dev)")
     args = parser.parse_args()
     if args.chaos:
         from ditl_tpu.chaos import FaultPlane, arm
@@ -1024,12 +1083,16 @@ if __name__ == "__main__":
         # Validate HERE, not after bench_infer's expensive fine-tune has
         # already burned minutes of chip time.
         parser.error("--spec-draft needs --speculative --engine continuous")
+    if args.trace_out and not args.serve_replicas:
+        parser.error("--trace-out requires --infer --serve-replicas (the "
+                     "fleet serving bench is the traced path)")
     if args.infer and args.serve_replicas:
         sys.exit(bench_gateway(
             args.serve_replicas, slots=args.slots,
             decode_chunk=args.decode_chunk, prompt_len=args.prompt_len,
             max_new=args.max_new, router=args.serve_router,
             compile_cache_dir=args.compile_cache_dir,
+            trace_out=args.trace_out,
         ))
     if args.infer:
         sys.exit(bench_infer(

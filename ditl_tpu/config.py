@@ -35,6 +35,7 @@ __all__ = [
     "APIConfig",
     "GatewayConfig",
     "ChaosConfig",
+    "TelemetryConfig",
     "Config",
     "parse_overrides",
     "config_fingerprint",
@@ -536,6 +537,101 @@ class ChaosConfig:
 
 
 @dataclass(frozen=True)
+class TelemetryConfig:
+    """Observability knobs shared by the serving and training legs
+    (ditl_tpu/telemetry/, ISSUE 6): journal size control, and the SLO
+    objectives the ``/slo`` burn-rate endpoints grade against. Latency
+    thresholds snap DOWN to the histogram bucket ladders
+    (telemetry/registry.py) — the effective bound is reported in the
+    ``/slo`` body so nobody grades against a number that was silently
+    rounded."""
+
+    # Per-process JSONL journal rotation cap in MiB (0 = unbounded, the
+    # historical behavior). With tracing armed, span records arrive per
+    # request and tick instants per scheduler tick — a long serving run
+    # must not grow its journal without bound. Total footprint stays
+    # ~this cap (telemetry/journal.py keeps the newest segments only).
+    journal_max_mb: float = 0.0
+    # Server (replica) SLOs: TTFT / TPOT latency objectives over the
+    # engine's harvest-observed histograms, plus availability.
+    slo_ttft_s: float = 2.5
+    slo_ttft_target: float = 0.95
+    slo_tpot_s: float = 0.25
+    slo_tpot_target: float = 0.95
+    slo_availability_target: float = 0.999
+    # Gateway SLOs: end-to-end relay latency + fleet availability.
+    slo_gateway_e2e_s: float = 10.0
+    slo_gateway_e2e_target: float = 0.95
+    # Multi-window burn-rate evaluation: the alert fires only when BOTH
+    # windows burn the error budget faster than slo_burn_alert (fast window
+    # for responsiveness, slow window to de-flap).
+    slo_fast_window_s: float = 300.0
+    slo_slow_window_s: float = 3600.0
+    slo_burn_alert: float = 1.0
+
+    def __post_init__(self):
+        if self.journal_max_mb < 0:
+            raise ValueError(
+                f"telemetry.journal_max_mb must be >= 0 (0 = unbounded), "
+                f"got {self.journal_max_mb}"
+            )
+        for name in ("slo_ttft_target", "slo_tpot_target",
+                     "slo_availability_target", "slo_gateway_e2e_target"):
+            v = getattr(self, name)
+            if not 0.0 < v < 1.0:
+                # target == 1.0 has zero error budget: burn rate divides
+                # by it — reject at config time, not at the first scrape.
+                raise ValueError(
+                    f"telemetry.{name} must be in (0, 1), got {v}"
+                )
+        for name in ("slo_ttft_s", "slo_tpot_s", "slo_gateway_e2e_s",
+                     "slo_fast_window_s", "slo_slow_window_s",
+                     "slo_burn_alert"):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"telemetry.{name} must be > 0, got {getattr(self, name)}"
+                )
+        if self.slo_fast_window_s >= self.slo_slow_window_s:
+            raise ValueError(
+                "telemetry.slo_fast_window_s must be shorter than "
+                f"slo_slow_window_s, got {self.slo_fast_window_s} >= "
+                f"{self.slo_slow_window_s}"
+            )
+
+    def journal_max_bytes(self) -> int | None:
+        """The journal rotation cap in bytes (None = unbounded) —
+        the form ``EventJournal(max_bytes=...)`` takes."""
+        return int(self.journal_max_mb * 1048576) or None
+
+    def slo_windows(self) -> tuple[float, float]:
+        return (self.slo_fast_window_s, self.slo_slow_window_s)
+
+    def serving_slo_kwargs(self) -> dict:
+        """Keyword form of the server objectives — exactly what
+        ``telemetry.slo.serving_slo`` takes."""
+        return dict(
+            ttft_s=self.slo_ttft_s,
+            ttft_target=self.slo_ttft_target,
+            tpot_s=self.slo_tpot_s,
+            tpot_target=self.slo_tpot_target,
+            availability_target=self.slo_availability_target,
+            windows=self.slo_windows(),
+            burn_alert=self.slo_burn_alert,
+        )
+
+    def gateway_slo_kwargs(self) -> dict:
+        """Keyword form of the gateway objectives — exactly what
+        ``telemetry.slo.gateway_slo`` takes."""
+        return dict(
+            e2e_s=self.slo_gateway_e2e_s,
+            e2e_target=self.slo_gateway_e2e_target,
+            availability_target=self.slo_availability_target,
+            windows=self.slo_windows(),
+            burn_alert=self.slo_burn_alert,
+        )
+
+
+@dataclass(frozen=True)
 class Config:
     runtime: RuntimeConfig = field(default_factory=RuntimeConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
@@ -545,6 +641,7 @@ class Config:
     api: APIConfig = field(default_factory=APIConfig)
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

@@ -50,6 +50,14 @@ from ditl_tpu.gateway.replica import Fleet, FleetSupervisor
 from ditl_tpu.gateway.router import affinity_key, make_policy
 from ditl_tpu.telemetry.registry import LATENCY_BUCKETS_S, MetricsRegistry
 from ditl_tpu.telemetry.serving import backlog_retry_after
+from ditl_tpu.telemetry.slo import BurnRateMonitor, gateway_slo
+from ditl_tpu.telemetry.tracing import (
+    NULL_TRACER,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+    resolve_request_id,
+)
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -173,17 +181,37 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     # key -> replica id that last served it (affinity hit-rate measurement)
     affinity_last: collections.OrderedDict = None
     affinity_lock: threading.Lock = None
+    # Request tracing (ISSUE 6): the gateway roots (or continues) each
+    # request's trace and stamps every relay attempt's span context on the
+    # upstream request (W3C traceparent), so replica/engine spans nest
+    # under the relay that carried them. Unarmed by default.
+    tracer: Tracer = NULL_TRACER
+    # Fleet-level SLO burn-rate monitor (telemetry/slo.py), served at /slo.
+    slo: BurnRateMonitor = None
 
     def log_message(self, *args):
         logger.debug("gateway http: " + args[0], *args[1:])
 
     # -- plumbing -----------------------------------------------------------
 
+    def _request_id(self) -> str:
+        """Stable per-request id echoed on EVERY response — including
+        429/503/504 and SSE relays — and forwarded upstream, so one id
+        joins the client's logs, the gateway's spans, and the replica's
+        (ISSUE 6 satellite). Reset per request in do_GET/do_POST (handler
+        instances persist across keep-alive requests)."""
+        rid = getattr(self, "_rid", None)
+        if rid is None:
+            rid = resolve_request_id(self.headers.get("X-Request-Id"))
+            self._rid = rid
+        return rid
+
     def _send_json(self, status: int, payload: dict,
                    retry_after: int | None = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
+        self.send_header("X-Request-Id", self._request_id())
         if retry_after is not None:
             self.send_header("Retry-After", str(retry_after))
         self.send_header("Content-Length", str(len(body)))
@@ -217,6 +245,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     # -- GET ----------------------------------------------------------------
 
     def do_GET(self):
+        self._rid = None  # fresh id per request on keep-alive connections
         path = self.path.rstrip("/") or "/"
         if path in ("/health", "/v1/health"):
             live = self.fleet.live_count()
@@ -249,13 +278,25 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 payload["tenants"] = self.admission.snapshot()
             self._send_json(200, payload)
         elif path == "/metrics":
+            if self.slo is not None:
+                # Refresh the ditl_slo_* gauges (same registry) so /metrics
+                # carries the burn rates /slo renders; the scrape doubles
+                # as the monitor's sample tick.
+                self.slo.report()
             body = (self.gw.render(self.fleet)
                     + f"\n# TYPE {PREFIX}_up gauge\n{PREFIX}_up 1\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("X-Request-Id", self._request_id())
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path in ("/slo", "/v1/slo"):
+            if self.slo is None:
+                self._send_json(404, {"error": {"message":
+                    "no SLO monitor configured"}})
+            else:
+                self._send_json(200, self.slo.report())
         elif path in ("/v1/models", "/models"):
             self._proxy_get("/v1/models")
         else:
@@ -278,6 +319,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     # -- POST ---------------------------------------------------------------
 
     def do_POST(self):
+        self._rid = None  # fresh id per request on keep-alive connections
         try:
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length) or b"{}"
@@ -290,7 +332,20 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         path = self.path.rstrip("/")
         if path.endswith(("/chat/completions", "/completions", "/embeddings")):
             self.gw.requests.inc()
-            self._admit_and_route(path, payload, raw)
+            # Root (or continue, if the client sent traceparent) this
+            # request's trace: every relay attempt below becomes a child
+            # span, and the replica continues the chain across the process
+            # boundary.
+            span = self.tracer.start_span(
+                "gateway.request",
+                parent=parse_traceparent(self.headers.get("traceparent")),
+                request_id=self._request_id(),
+                route=path,
+            )
+            try:
+                self._admit_and_route(path, payload, raw, span=span)
+            finally:
+                span.end()
         elif path.endswith(("/tokenize", "/detokenize")):
             # Metadata routes: cheap, not admission-controlled, and kept
             # OUT of the serving instruments (record=False) — a stream of
@@ -302,7 +357,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
-    def _admit_and_route(self, path: str, payload: dict, raw: bytes) -> None:
+    def _admit_and_route(self, path: str, payload: dict, raw: bytes,
+                         span=None) -> None:
         m = self.gw
         tenant = self._tenant()
         if self.admission is not None:
@@ -314,6 +370,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             if not decision.ok:
                 m.throttled.inc()
                 m.tenant_counter(label, "throttled").inc()
+                if span is not None:
+                    span.annotate(throttled=True)
                 self._send_json(
                     429,
                     {"error": {"message": decision.reason,
@@ -325,14 +383,14 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             m.tenant_counter(label, "admitted").inc()
         t0 = time.time()
         try:
-            self._route_and_relay(path, payload, raw)
+            self._route_and_relay(path, payload, raw, span=span)
         finally:
             if self.admission is not None:
                 self.admission.release(tenant)
             m.e2e.observe(time.time() - t0)
 
     def _route_and_relay(self, path: str, payload: dict, raw: bytes,
-                         record: bool = True) -> None:
+                         record: bool = True, span=None) -> None:
         m, cfg = self.gw, self.gwcfg
         stream = bool(payload.get("stream"))
         key = affinity_key(payload, cfg.affinity_prefix_tokens)
@@ -386,14 +444,35 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             # load signal (least-outstanding, affinity spill, hedge-peer
             # choice, rolling_restart's drain-wait all read it); health-poll
             # queue depth alone is a full interval stale.
+            # One relay span per attempt (retries are tagged, hedged
+            # secondaries become SIBLING spans inside _hedged_open); the
+            # attempt's span context rides the upstream request as
+            # traceparent so the replica's spans nest under it.
+            rspan = (
+                self.tracer.start_span(
+                    "gateway.relay", parent=span, replica=view.id,
+                    attempt=attempt, retry=attempt > 0,
+                )
+                if span is not None else None
+            )
             self.fleet.inc_outstanding(view.id)
+            outcome, info = "error", None
             try:
                 outcome, info = self._relay_one(
                     view, path, raw, stream, hedge_peers,
                     deadline_left=remaining if propagate_deadline else None,
+                    span=rspan, root=span,
                 )
             finally:
                 self.fleet.dec_outstanding(view.id)
+                if rspan is not None:
+                    if outcome == "done" and info and info != view.id:
+                        # A hedged peer served: THIS attempt lost — its
+                        # span must not read as the one that answered (the
+                        # winner's hedge span carries outcome="won").
+                        rspan.end(outcome="lost", served_by=info)
+                    else:
+                        rspan.end(outcome=outcome)
             if outcome == "done":
                 if record:
                     self._note_affinity(key, info or view.id)
@@ -435,17 +514,23 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     # -- relaying -----------------------------------------------------------
 
     def _open(self, view, path: str, raw: bytes,
-              deadline_left: float | None = None):
+              deadline_left: float | None = None, trace=None):
         """One upstream request; returns (conn, resp) or raises OSError/
         HTTPException on connection-level failure (retryable — no bytes
         have been relayed to the client yet). ``deadline_left`` (seconds)
         bounds the socket AND is forwarded as X-Request-Deadline-S so the
-        replica's engine gives up when the gateway will."""
+        replica's engine gives up when the gateway will. ``trace`` (this
+        attempt's relay span) is forwarded as the W3C traceparent, and the
+        request id always rides X-Request-Id — the replica's logs/spans
+        join the client's on either."""
         timeout = self.gwcfg.request_timeout_s
         headers = {
             "Content-Type": "application/json",
             "Authorization": self.headers.get("Authorization", ""),
+            "X-Request-Id": self._request_id(),
         }
+        if trace is not None:
+            headers["traceparent"] = format_traceparent(trace.context)
         if deadline_left is not None:
             timeout = min(timeout, max(0.001, deadline_left))
             headers["X-Request-Deadline-S"] = f"{max(0.001, deadline_left):.3f}"
@@ -460,29 +545,36 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             raise
 
     def _relay_one(self, view, path, raw, stream, hedge_peers,
-                   deadline_left: float | None = None):
+                   deadline_left: float | None = None, span=None, root=None):
         """Proxy one attempt. Returns (outcome, info):
         ``("done", served_replica_id)`` — response relayed;
         ``("retry", None)`` — connection-level failure, safe to fail over;
         ``("busy", (retry_after, busy_replica_id))`` — a replica said
         429/503 (spill; under hedging the busy answer can come from the
         peer rather than the primary);
-        ``("aborted", None)`` — died mid-stream after bytes were relayed."""
+        ``("aborted", None)`` — died mid-stream after bytes were relayed.
+        ``span`` is this attempt's relay span (its context rides upstream);
+        ``root`` is the request span hedged secondaries chain under as
+        SIBLINGS of this attempt."""
         # Chaos seam: `error` = an upstream connection failure before any
         # byte moved (exercises idempotent-safe failover), `delay` = a slow
         # relay (hedging drills), `kill` = losing the gateway process.
         fault = maybe_inject("gateway.relay", handles=("error",))
         if fault is not None and fault.action == "error":
+            if span is not None:
+                span.annotate(injected_fault=True)
             self.fleet.note_failure(view.id)
             return ("retry", None)
         served = view.id
         try:
             if hedge_peers:
                 conn, resp, served = self._hedged_open(
-                    view, hedge_peers, path, raw, deadline_left
+                    view, hedge_peers, path, raw, deadline_left,
+                    span=span, root=root,
                 )
             else:
-                conn, resp = self._open(view, path, raw, deadline_left)
+                conn, resp = self._open(view, path, raw, deadline_left,
+                                        trace=span)
         except (OSError, http.client.HTTPException):
             self.fleet.note_failure(view.id)
             return ("retry", None)
@@ -505,6 +597,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 return ("retry", None)
             self.send_response(resp.status)
             self.send_header("Content-Type", ctype)
+            self.send_header("X-Request-Id", self._request_id())
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -525,6 +618,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             return "retry"
         self.send_response(resp.status)
         self.send_header("Content-Type", ctype)
+        self.send_header("X-Request-Id", self._request_id())
         self.send_header("Cache-Control", "no-cache")
         self.end_headers()
         try:
@@ -539,18 +633,23 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             logger.warning("replica %s died mid-stream", view.id)
             return "aborted"
 
-    def _hedged_open(self, view, peers, path, raw, deadline_left=None):
+    def _hedged_open(self, view, peers, path, raw, deadline_left=None,
+                     span=None, root=None):
         """Tail-latency hedging (non-streaming only): if the primary has
         not answered within ``hedge_after_s``, fire the same request at the
         least-loaded peer and take whichever responds first. The loser's
         connection is abandoned (its replica finishes the wasted work —
         the standard hedging trade; a propagated deadline caps even that
         waste). Completions are idempotent from the client's perspective,
-        so duplicates are safe."""
+        so duplicates are safe. A fired hedge gets its OWN relay span as a
+        SIBLING of the primary attempt's (both children of ``root``) — the
+        trace shows two overlapping relays and which one won."""
         pool = ThreadPoolExecutor(max_workers=2)
+        hspan = None
         try:
             t0 = time.monotonic()
-            primary = pool.submit(self._open, view, path, raw, deadline_left)
+            primary = pool.submit(self._open, view, path, raw, deadline_left,
+                                  span)
             done, _ = wait([primary], timeout=self.gwcfg.hedge_after_s)
             if done:
                 conn, resp = primary.result()  # may raise: caller retries
@@ -558,6 +657,11 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             peer = min(peers, key=lambda v: v.outstanding + v.queue_depth)
             self.gw.hedges.inc()
             self.gw.replica_counter(peer.id, "hedged").inc()
+            if root is not None:
+                hspan = self.tracer.start_span(
+                    "gateway.relay", parent=root, replica=peer.id,
+                    hedge=True,
+                )
             # The secondary starts hedge_after_s (at least) into the budget:
             # re-derive its remaining deadline, or its replica keeps the
             # hedged generation alive past the moment the gateway gives up.
@@ -565,7 +669,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 deadline_left - (time.monotonic() - t0)
                 if deadline_left is not None else None
             )
-            secondary = pool.submit(self._open, peer, path, raw, secondary_left)
+            secondary = pool.submit(self._open, peer, path, raw,
+                                    secondary_left, hspan)
             futures = {primary: view.id, secondary: peer.id}
             last_exc: BaseException | None = None
             pending = set(futures)
@@ -584,9 +689,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                     for other in done | pending:
                         if other is not f:
                             other.add_done_callback(_close_result)
+                    if hspan is not None:
+                        hspan.end(outcome=(
+                            "won" if futures[f] == peer.id else "lost"
+                        ))
                     return conn, resp, futures[f]
+            if hspan is not None:
+                hspan.end(outcome="error")
             raise last_exc  # both failed
         finally:
+            if hspan is not None:
+                hspan.end()  # no-op when already ended with an outcome
             pool.shutdown(wait=False)
 
     def _note_affinity(self, key, replica_id: str) -> None:
@@ -622,12 +735,17 @@ def make_gateway(
     metrics: GatewayMetrics | None = None,
     host: str | None = None,
     port: int | None = None,
+    tracer: Tracer | None = None,
+    slo: BurnRateMonitor | None = None,
+    telemetry=None,
 ) -> GatewayHTTPServer:
     """Build (not start) the gateway server over ``fleet`` — tests drive it
     on a thread, ``main`` drives it with ``serve_forever``. ``router``
     defaults to the config's policy; ``admission`` defaults to the config's
     tenant budgets (None when the config sets no limits — requests are then
-    admitted unconditionally)."""
+    admitted unconditionally). ``tracer`` (telemetry/tracing.py) arms
+    request tracing; ``slo`` defaults to a fleet-level burn-rate monitor
+    built from ``telemetry`` (config.TelemetryConfig) or its defaults."""
     config = config or GatewayConfig()
     if router is None:
         router = make_policy(config.router)
@@ -638,6 +756,10 @@ def make_gateway(
             rate=config.tenant_rate, burst=config.tenant_burst,
             max_concurrent=config.tenant_max_concurrent,
         )
+    gw_metrics = metrics if metrics is not None else GatewayMetrics()
+    if slo is None:
+        kw = telemetry.gateway_slo_kwargs() if telemetry is not None else {}
+        slo = gateway_slo(gw_metrics, **kw)
     handler = type(
         "BoundGatewayHandler",
         (_GatewayHandler,),
@@ -645,10 +767,12 @@ def make_gateway(
             "fleet": fleet,
             "router": router,
             "admission": admission,
-            "gw": metrics if metrics is not None else GatewayMetrics(),
+            "gw": gw_metrics,
             "gwcfg": config,
             "affinity_last": collections.OrderedDict(),
             "affinity_lock": threading.Lock(),
+            "tracer": tracer if tracer is not None else NULL_TRACER,
+            "slo": slo,
         },
     )
     return GatewayHTTPServer(
@@ -688,15 +812,24 @@ def main(argv: list[str] | None = None) -> int:
                         help="extra argument passed through to every "
                         "ditl_tpu.infer.server replica (repeatable), e.g. "
                         "--replica-arg=--cache-mode --replica-arg=paged")
+    parser.add_argument("--trace-dir", default="",
+                        help="arm end-to-end request tracing (ISSUE 6): "
+                        "the gateway AND every replica journal their spans "
+                        "into this directory; merge + export with "
+                        "python -m ditl_tpu.telemetry.trace_export --dir "
+                        "DIR")
     parser.add_argument("overrides", nargs="*",
-                        help="gateway config overrides like "
-                        "gateway.router=affinity gateway.replicas=4")
+                        help="config overrides like gateway.router=affinity "
+                        "gateway.replicas=4 telemetry.slo_ttft_s=0.5")
     args = parser.parse_args(argv)
 
-    config = parse_overrides(
+    full_config = parse_overrides(
         Config(),
-        [o for o in args.overrides if o.startswith("gateway.")],
-    ).gateway
+        [o for o in args.overrides
+         if o.startswith(("gateway.", "telemetry."))],
+    )
+    config = full_config.gateway
+    telemetry_cfg = full_config.telemetry
 
     def build_argv(port: int):
         cmd = [sys.executable, "-m", "ditl_tpu.infer.server",
@@ -710,13 +843,27 @@ def main(argv: list[str] | None = None) -> int:
             cmd += ["--preset", args.preset]
         if args.checkpoint_dir:
             cmd += ["--checkpoint-dir", args.checkpoint_dir]
+        if args.trace_dir:
+            # Each replica journals its own spans (events-server-<pid>)
+            # into the shared directory; trace_export merges by trace_id.
+            cmd += ["--trace-dir", args.trace_dir]
         return cmd + list(args.replica_arg)
 
     journal = None
     if config.journal_dir:
         journal = EventJournal(
-            gateway_journal_path(config.journal_dir), source="gateway"
+            gateway_journal_path(config.journal_dir), source="gateway",
+            max_bytes=telemetry_cfg.journal_max_bytes(),
         )
+    tracer = None
+    if args.trace_dir:
+        import os as _os
+
+        tracer = Tracer(EventJournal(
+            _os.path.join(args.trace_dir, "events-gateway-trace.jsonl"),
+            source="gateway",
+            max_bytes=telemetry_cfg.journal_max_bytes(),
+        ))
     handles = [
         SubprocessReplica(f"r{i}", build_argv)
         for i in range(config.replicas)
@@ -740,7 +887,8 @@ def main(argv: list[str] | None = None) -> int:
             journal=journal,
         )
         supervisor.start()
-        server = make_gateway(fleet, config=config)
+        server = make_gateway(fleet, config=config, tracer=tracer,
+                              telemetry=telemetry_cfg)
         stopping = threading.Event()
 
         def _shutdown(signum, frame):
@@ -769,6 +917,8 @@ def main(argv: list[str] | None = None) -> int:
         fleet.stop_all(drain=True, timeout=config.drain_timeout_s)
         if journal is not None:
             journal.close()
+        if tracer is not None and tracer.journal is not None:
+            tracer.journal.close()
     return 0
 
 

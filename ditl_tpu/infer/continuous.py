@@ -66,6 +66,7 @@ from ditl_tpu.infer.engine import GenerateConfig, _next_pow2
 from ditl_tpu.infer.sampling import sample_logits
 from ditl_tpu.models import llama
 from ditl_tpu.telemetry.serving import ServingMetrics
+from ditl_tpu.telemetry.tracing import NULL_TRACER, Tracer
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -262,6 +263,23 @@ class Request:
     # waiters raise DeadlineExceededError, streams get their terminal None.
     deadline: float | None = None
     expired: bool = False
+    # Request tracing (ISSUE 6, telemetry/tracing.py): ``trace`` is the
+    # upstream SpanContext (the server's request span) this request's
+    # engine-lifecycle spans chain under; request_span/queue_span are the
+    # engine's own open spans (None when the engine's tracer is unarmed —
+    # tracing is host bookkeeping only and never reaches the scheduler's
+    # replicated state).
+    trace: Any = None
+    request_span: Any = None
+    queue_span: Any = None
+    # Scheduler-interference attribution (ISSUE 6): wall seconds of OTHER
+    # requests' prefill chunks that shared (and lengthened) this request's
+    # decode ticks. ``interference_pending`` holds per-tick
+    # (culprit_req_id, culprit_prefill_tokens, seconds) entries since the
+    # last harvest (drained into the decode span's annotation);
+    # ``interference_s`` is the lifetime total.
+    interference_pending: list = field(default_factory=list)
+    interference_s: float = 0.0
 
 
 class ContinuousEngine:
@@ -301,6 +319,7 @@ class ContinuousEngine:
         admission: str = "reserve",
         thrash_window: int = 32,
         metrics: ServingMetrics | None = None,
+        tracer: Tracer | None = None,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -376,6 +395,16 @@ class ContinuousEngine:
         # path only (zero device syncs). Pass a shared bundle to aggregate
         # across engines; by default each engine owns its own.
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        # Request tracing (telemetry/tracing.py): an armed tracer records
+        # each request's engine lifecycle (queue -> prefill chunk(s) ->
+        # decode chunks) as spans plus per-tick instants into its journal.
+        # Unarmed (the default) every span site is skipped — tracing is
+        # host-only bookkeeping and never touches replicated scheduler
+        # state, so pod replicas may disagree about it freely.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        # Per-tick prefill work [(req_id, tokens, wall_s)] — the
+        # interference-attribution input (see step()).
+        self._tick_prefills: list[tuple[int, int, float]] = []
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         if decode_chunk < 1:
@@ -1826,6 +1855,7 @@ class ContinuousEngine:
         adapter_id: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        trace: Any = None,
     ) -> int:
         """Queue a request; returns its id (see ``results``/``run``).
         ``stream``: optional ``queue.Queue`` receiving per-chunk token lists
@@ -1841,7 +1871,9 @@ class ContinuousEngine:
         from the queue/slot (DeadlineExceededError for waiters) instead of
         decoding work nobody will read. Solo serving only: the pod tick
         broadcast never carries deadlines (per-process wall clocks would
-        desync the replicated scheduler)."""
+        desync the replicated scheduler). ``trace``: upstream span/
+        SpanContext (telemetry/tracing.py) the engine's lifecycle spans
+        chain under when the engine's tracer is armed; ignored otherwise."""
         gen = self.gen
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             self.metrics.queue_full.inc()
@@ -1925,6 +1957,20 @@ class ContinuousEngine:
             ),
         )
         self._next_id += 1
+        if self.tracer.armed:
+            # The whole-lifecycle span stays open until completion/expiry/
+            # cancel; the queue span closes at slot admission. Both chain
+            # under the caller's (server's) span so the merged trace nests
+            # across the HTTP boundary.
+            req.trace = trace
+            req.request_span = self.tracer.start_span(
+                "engine.request", parent=trace, req=req.req_id,
+                prompt_tokens=len(req.prompt),
+                max_new_tokens=req.max_new_tokens,
+            )
+            req.queue_span = self.tracer.start_span(
+                "engine.queue", parent=req.request_span, req=req.req_id,
+            )
         self.metrics.requests.inc()
         self._queue.append(req)
         return req.req_id
@@ -2301,7 +2347,10 @@ class ContinuousEngine:
             self.cur = self.cur.at[slot].set(self.tokenizer.pad_id)
             self.pos = self.pos.at[slot].set(0)
         else:
+            w0, m0 = time.time(), time.monotonic()
             first = self._paged_prefill_chunk(req, slot, d0, s, s, sub)
+            self._record_prefill(req, s, d0, w0,
+                                 time.monotonic() - m0, "prompt")
             self._publish_prompt_pages(req, slot)
             self.cur = self.cur.at[slot].set(first)
             self.pos = self.pos.at[slot].set(len(req.prompt))
@@ -2368,6 +2417,7 @@ class ContinuousEngine:
         self.resume_prefill_tokens += pos - d0
         step = self.prefill_chunk or s
         d = d0
+        w0, m0 = time.time(), time.monotonic()
         while d < pos:
             n = min(step, pos - d)
             self._run_paged_prefill(
@@ -2379,6 +2429,11 @@ class ContinuousEngine:
                 adapter=req.adapter_id, fsm_start=req.fsm_start,
             )
             d += n
+        if pos > d0:
+            # Resume prefills monopolize ticks exactly like fresh ones —
+            # they must show up in the interference attribution too.
+            self._record_prefill(req, pos - d0, d0, w0,
+                                 time.monotonic() - m0, "resume")
         self.cur = self.cur.at[slot].set(req.preempt_cur)
         self.pos = self.pos.at[slot].set(pos)
         self.keys = self.keys.at[slot].set(req.preempt_key)
@@ -2534,6 +2589,17 @@ class ContinuousEngine:
                 self._slot_pages[slot].extend(fresh)
                 self._table_dirty = True
 
+    def _close_spans(self, req: Request, **attrs) -> None:
+        """End the request's open tracing spans (idempotent). Every
+        terminal path — completion, deadline expiry, cancellation — funnels
+        here so an armed tracer never leaks an unclosed request span."""
+        if req.queue_span is not None:
+            req.queue_span.end(**attrs)
+            req.queue_span = None
+        if req.request_span is not None:
+            req.request_span.end(tokens=len(req.tokens), **attrs)
+            req.request_span = None
+
     def _expire(self, req: Request) -> None:
         """Terminal bookkeeping for a deadline eviction: the request
         completes (with whatever tokens it already produced), waiters see
@@ -2544,6 +2610,7 @@ class ContinuousEngine:
         req.finished = True
         req.cancelled = True  # lagged pipelined harvests must skip it
         self.metrics.deadline_expired.inc()
+        self._close_spans(req, expired=True)
         if req.stream is not None:
             req.stream.put(None)
         self._completed[req.req_id] = req
@@ -2588,6 +2655,24 @@ class ContinuousEngine:
         self.metrics.admitted.inc()
         if req.t_submit:  # directly-constructed Requests carry no stamp
             self.metrics.queue_wait.observe(req.t_admitted - req.t_submit)
+        if req.queue_span is not None:
+            req.queue_span.end(
+                queue_wait_s=round(req.t_admitted - req.t_submit, 6)
+                if req.t_submit else 0.0,
+            )
+            req.queue_span = None
+
+    def _record_prefill(self, req: Request, tokens: int, offset: int,
+                        w0: float, dt: float, kind: str) -> None:
+        """Register one prefill dispatch: feeds this tick's interference
+        attribution (step()) and — when tracing — writes the chunk's span
+        under the request's lifecycle span."""
+        self._tick_prefills.append((req.req_id, tokens, dt))
+        if req.request_span is not None:
+            self.tracer.start_span(
+                "engine.prefill", parent=req.request_span, t0=w0,
+                req=req.req_id, offset=offset, tokens=tokens, kind=kind,
+            ).end(t_end=w0 + dt)
 
     def _admit(self) -> None:
         for slot in range(self.n_slots):
@@ -2604,7 +2689,15 @@ class ContinuousEngine:
             slot_key = jax.random.key(req.seed)
             slot_key, sub = jax.random.split(slot_key)
             req.slot = slot
+            w0, m0 = time.time(), time.monotonic()
             first = self._prefill_into_slot(req, slot, sub)
+            if first is not None:
+                # Chunked prefill (first is None) records per chunk in
+                # step()'s advance loop instead.
+                self._record_prefill(
+                    req, len(req.prompt), 0, w0,
+                    time.monotonic() - m0, "prompt",
+                )
             self._slots[slot] = req
             if first is None:
                 # Chunked prefill in progress: park the row's decode writes
@@ -2679,7 +2772,8 @@ class ContinuousEngine:
                 if req.fsm_start > 0:
                     # Every one of these tokens decoded under the FSM mask.
                     m.grammar_masked.inc(len(fresh))
-                if req.t_first == 0.0:
+                first_chunk = req.t_first == 0.0
+                if first_chunk:
                     req.t_first = t_now
                     if req.t_submit:
                         m.ttft.observe(t_now - req.t_submit)
@@ -2691,6 +2785,32 @@ class ContinuousEngine:
                     m.decode_token.observe(
                         (t_now - req.t_last_emit) / len(fresh), n=len(fresh)
                     )
+                if req.request_span is not None:
+                    # One decode span per harvested chunk, covering the
+                    # interval a streaming client actually waited for it;
+                    # interference absorbed since the last harvest rides it
+                    # as the victim-side annotation (culprit = the tick's
+                    # biggest prefill).
+                    prev = req.t_last_emit or req.t_admitted or req.t_submit
+                    dur = max(0.0, t_now - prev) if prev else 0.0
+                    attrs = {"req": req.req_id, "tokens": len(fresh),
+                             "first": first_chunk}
+                    if req.interference_pending:
+                        cid, ctok, _ = max(req.interference_pending,
+                                           key=lambda e: e[2])
+                        attrs.update(
+                            interference_s=round(sum(
+                                s for *_, s in req.interference_pending
+                            ), 6),
+                            interference_culprit=cid,
+                            culprit_prefill_tokens=ctok,
+                        )
+                    w_now = time.time()
+                    self.tracer.start_span(
+                        "engine.decode", parent=req.request_span,
+                        t0=w_now - dur, **attrs,
+                    ).end(t_end=w_now)
+                req.interference_pending.clear()
                 req.t_last_emit = t_now
             if req.stream is not None and fresh:
                 if req.logprobs is not None and lp is not None:
@@ -2710,6 +2830,10 @@ class ContinuousEngine:
                 self.metrics.completed.inc()
                 if req.t_submit:
                     self.metrics.e2e.observe(t_now - req.t_submit)
+                self._close_spans(
+                    req,
+                    interference_total_s=round(req.interference_s, 6),
+                )
                 if req.stream is not None:
                     req.stream.put(None)
                 self._completed[req.req_id] = req
@@ -3100,10 +3224,56 @@ class ContinuousEngine:
             self._finish_tick(prev)
             prev = None
         self._expire_deadlines()
+        # Interference attribution (ISSUE 6): requests that were ALREADY
+        # decode-ready before this tick's admissions and prefill chunks are
+        # the victims whose next decode chunk every prefill below delays —
+        # the "long prefill monopolizes the tick, co-running streams' TPOT
+        # spikes" effect the chunked-prefill refactor will be judged on.
+        decode_ready = [
+            r for r in self._slots
+            if r is not None and not r.prefilling
+            and not r.finished and not r.cancelled
+        ]
+        self._tick_prefills = []
         self._admit()
         for req in self._slots:
             if req is not None and req.prefilling:
+                d_before = req.prefill_pos
+                w0, m0 = time.time(), time.monotonic()
                 self._advance_prefill(req)
+                self._record_prefill(
+                    req, req.prefill_pos - d_before, d_before, w0,
+                    time.monotonic() - m0, "chunk",
+                )
+        prefill_s = sum(dt for _, _, dt in self._tick_prefills)
+        if self._tick_prefills and prefill_s > 0 and decode_ready:
+            # One histogram observation per victim per tick (the aggregate
+            # answer "how much decode delay is prefill causing"), plus a
+            # per-victim annotation naming the biggest culprit — consumed
+            # by the next harvest's decode span.
+            culprit_id, culprit_tokens, _ = max(
+                self._tick_prefills, key=lambda e: e[2]
+            )
+            for victim in decode_ready:
+                if victim.finished or victim.cancelled:
+                    continue
+                self.metrics.tpot_interference.observe(prefill_s)
+                victim.interference_s += prefill_s
+                victim.interference_pending.append(
+                    (culprit_id, culprit_tokens, prefill_s)
+                )
+        if self.tracer.armed:
+            self.tracer.instant(
+                "engine.tick",
+                tick=self.tick_count,
+                slots_busy=sum(r is not None for r in self._slots),
+                prefilling=sum(
+                    1 for r in self._slots
+                    if r is not None and r.prefilling
+                ),
+                queue_depth=len(self._queue),
+                prefill_s=round(prefill_s, 6),
+            )
         self._topup_pages()  # optimistic paged admission; may preempt
         occupied = [r is not None and not r.prefilling for r in self._slots]
         rec = None
@@ -3267,6 +3437,7 @@ class ContinuousEngine:
                     self._completed.pop(req_id, None)
                     return True
                 req.cancelled = True
+                self._close_spans(req, cancelled=True)
                 if req.stream is not None:
                     req.stream.put(None)
                 return True
@@ -3274,6 +3445,7 @@ class ContinuousEngine:
             if req is not None and req.req_id == req_id:
                 self._slots[slot] = None
                 req.cancelled = True
+                self._close_spans(req, cancelled=True)
                 if self.cache_mode == "paged":
                     self._free_slot_pages(slot)
                 if req.stream is not None:
@@ -3323,6 +3495,13 @@ class ThreadedEngine:
     def metrics(self) -> ServingMetrics:
         """The engine's telemetry bundle (rendered by /metrics)."""
         return self._engine.metrics
+
+    @property
+    def tracer(self) -> Tracer:
+        """The engine's span tracer (telemetry/tracing.py) — the HTTP
+        server derives its own tracer from this so arming the engine arms
+        the whole replica with one knob."""
+        return self._engine.tracer
 
     @property
     def queue_full(self) -> bool:
@@ -3401,6 +3580,7 @@ class ThreadedEngine:
         adapter_id: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        trace: Any = None,
     ) -> list[int]:
         """Submit one request and block until it completes. Raises if the
         driver has stopped (shutdown or device error) — callers turn that
@@ -3419,6 +3599,7 @@ class ThreadedEngine:
                 adapter_id=adapter_id,
                 grammar=grammar,
                 deadline_s=deadline_s,
+                trace=trace,
             )
             self._cond.notify_all()
             req = self._wait_one(rid)
@@ -3440,6 +3621,7 @@ class ThreadedEngine:
         seed: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        trace: Any = None,
     ) -> tuple[list[int], dict]:
         """``generate_one`` + per-token logprob stats (same dict layout as
         engine.Generator.generate_tokens_with_logprobs: ``token_logprobs``,
@@ -3458,6 +3640,7 @@ class ThreadedEngine:
                 logprobs=n_top,
                 grammar=grammar,
                 deadline_s=deadline_s,
+                trace=trace,
             )
             self._cond.notify_all()
             req = self._wait_one(rid)
@@ -3484,6 +3667,7 @@ class ThreadedEngine:
         adapter_id: int | None = None,
         grammar: Any = None,
         logprobs: int | None = None,
+        trace: Any = None,
     ) -> list[Request]:
         """Submit ``n`` copies of one prompt (distinct derived seeds) and
         block until all complete; returns the finished Request objects in
@@ -3512,6 +3696,7 @@ class ThreadedEngine:
                         adapter_id=adapter_id,
                         grammar=grammar,
                         logprobs=logprobs,
+                        trace=trace,
                     ))
             except BaseException:
                 # A mid-loop failure (e.g. QueueFullError on copy k) must
@@ -3536,6 +3721,7 @@ class ThreadedEngine:
         adapter_id: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        trace: Any = None,
     ):
         """Submit one request and return an iterator of per-chunk token-id
         lists as they are decoded (SSE streaming). The submit happens
@@ -3560,6 +3746,7 @@ class ThreadedEngine:
                 adapter_id=adapter_id,
                 grammar=grammar,
                 deadline_s=deadline_s,
+                trace=trace,
             )
             self._cond.notify_all()
 
@@ -3596,6 +3783,7 @@ class ThreadedEngine:
         seed: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        trace: Any = None,
     ):
         """``stream_one`` + per-chunk logprob stats: yields
         ``(token_ids, lp_dict)`` pairs where ``lp_dict`` carries the chunk's
@@ -3617,6 +3805,7 @@ class ThreadedEngine:
                 logprobs=n_top,
                 grammar=grammar,
                 deadline_s=deadline_s,
+                trace=trace,
             )
             self._cond.notify_all()
 

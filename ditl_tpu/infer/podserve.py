@@ -368,12 +368,15 @@ def worker_loop(generator: Generator) -> None:
 
 
 def _apply_ctick(engine, meta: np.ndarray, ids: np.ndarray, cancels: np.ndarray,
-                 streams: list | None = None):
+                 streams: list | None = None, traces: list | None = None):
     """Apply one broadcast tick's scheduler inputs, then run one tick.
     Returns the submitted request ids (identical on every process).
     ``streams`` (process 0 only) attaches per-request stream queues at
     submit time — before the tick's step, so first-tick chunks are not
-    lost; worker replicas stream to nowhere."""
+    lost; worker replicas stream to nowhere. ``traces`` (process 0 only,
+    same shape) attaches upstream span contexts: tracing is host-side
+    bookkeeping like streams, never broadcast, so worker replicas simply
+    record no spans — scheduler state stays identical pod-wide."""
     from ditl_tpu.infer.continuous import QueueFullError
 
     rids = []
@@ -390,6 +393,7 @@ def _apply_ctick(engine, meta: np.ndarray, ids: np.ndarray, cancels: np.ndarray,
                 top_p=_i2f(top_p_bits), seed=seed,
                 stream=streams[i] if streams is not None else None,
                 adapter_id=adapter or None,
+                trace=traces[i] if traces is not None else None,
             ))
         except (ValueError, QueueFullError) as e:
             # Deterministic per-request rejection: the same submit fails
@@ -547,6 +551,7 @@ class PodContinuousDriver:
             rids = _apply_ctick(
                 self._engine, meta, ids, cc,
                 streams=[t.stream for (*_, t) in staged],
+                traces=[t.trace for (*_, t) in staged],
             )
         except Exception as e:  # noqa: BLE001 — surfaced via tickets
             ok = False
@@ -585,7 +590,8 @@ class PodContinuousDriver:
     # -- ThreadedEngine surface ----------------------------------------------
 
     def _stage(self, prompt_tokens, max_new_tokens, temperature, top_p, seed,
-               stream=None, adapter_id=None, grammar=None) -> "_Ticket":
+               stream=None, adapter_id=None, grammar=None,
+               trace=None) -> "_Ticket":
         from ditl_tpu.infer.continuous import BadRequestError, QueueFullError
 
         if grammar is not None:
@@ -598,7 +604,7 @@ class PodContinuousDriver:
                 "tick broadcast does not carry grammar registrations)"
             )
         gen = self._engine.gen
-        ticket = _Ticket(stream)
+        ticket = _Ticket(stream, trace)
         prompt = list(prompt_tokens) or [self.tokenizer.bos_id]
         max_new = (max_new_tokens if max_new_tokens is not None
                    else gen.max_new_tokens)
@@ -671,19 +677,26 @@ class PodContinuousDriver:
                 "desync the replicated scheduler)"
             )
 
+    @property
+    def tracer(self):
+        """Process-0 engine's tracer — make_server derives the HTTP span
+        layer from it, same as solo serving."""
+        return self._engine.tracer
+
     def generate_one(self, prompt_tokens, *, max_new_tokens=None,
                      temperature=None, top_p=None, seed=None,
                      adapter_id=None, grammar=None,
-                     deadline_s=None) -> list[int]:
+                     deadline_s=None, trace=None) -> list[int]:
         self._reject_deadline(deadline_s)
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
                              top_p, seed, adapter_id=adapter_id,
-                             grammar=grammar)
+                             grammar=grammar, trace=trace)
         return ticket.wait()
 
     def generate_many(self, prompt_tokens, n, *, max_new_tokens=None,
                       temperature=None, top_p=None, seed=None,
-                      adapter_id=None, grammar=None, logprobs=None):
+                      adapter_id=None, grammar=None, logprobs=None,
+                      trace=None):
         """OpenAI ``n``/``best_of`` over the pod: stage ``n`` copies with
         derived seeds (same 7919-stride rule as ThreadedEngine.generate_many
         so pod and solo serving replay identically for a given seed), then
@@ -728,7 +741,7 @@ class PodContinuousDriver:
                 tickets.append(self._stage(
                     prompt_tokens, max_new_tokens, temperature, top_p,
                     derive_copy_seed(seed, i),
-                    adapter_id=adapter_id, grammar=grammar,
+                    adapter_id=adapter_id, grammar=grammar, trace=trace,
                 ))
             return [_PodResult(t.wait()) for t in tickets]
         except BaseException:
@@ -737,7 +750,7 @@ class PodContinuousDriver:
 
     def stream_one(self, prompt_tokens, *, max_new_tokens=None,
                    temperature=None, top_p=None, seed=None, adapter_id=None,
-                   grammar=None, deadline_s=None):
+                   grammar=None, deadline_s=None, trace=None):
         import queue as _queue
 
         self._reject_deadline(deadline_s)
@@ -747,7 +760,8 @@ class PodContinuousDriver:
         # there is no status left to send (ADVICE r2).
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
                              top_p, seed, stream=stream,
-                             adapter_id=adapter_id, grammar=grammar)
+                             adapter_id=adapter_id, grammar=grammar,
+                             trace=trace)
 
         def chunks():
             try:
@@ -815,8 +829,9 @@ class _PodResult:
 class _Ticket:
     """One staged request's handoff between an HTTP thread and the pump."""
 
-    def __init__(self, stream=None):
+    def __init__(self, stream=None, trace=None):
         self.stream = stream
+        self.trace = trace  # upstream span context (process-0 spans only)
         self.req_id: int | None = None
         self.result: list[int] | None = None
         self.error: BaseException | None = None

@@ -43,6 +43,13 @@ from ditl_tpu.infer.continuous import (
 )
 from ditl_tpu.infer.engine import GenerateConfig, Generator
 from ditl_tpu.telemetry.serving import ServingMetrics
+from ditl_tpu.telemetry.slo import BurnRateMonitor, serving_slo
+from ditl_tpu.telemetry.tracing import (
+    NULL_TRACER,
+    Tracer,
+    parse_traceparent,
+    resolve_request_id,
+)
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -271,14 +278,34 @@ class _Handler(BaseHTTPRequestHandler):
     # when one is serving (it records queue-wait/TTFT/TPOT on its scheduler
     # ticks), else a server-owned bundle the lock-step path records into.
     serving_metrics: ServingMetrics = None
+    # Request tracing (ISSUE 6, telemetry/tracing.py): unarmed by default;
+    # make_server derives it from the engine's tracer so one knob arms the
+    # replica end-to-end (server span -> engine lifecycle spans).
+    tracer: Tracer = NULL_TRACER
+    # SLO burn-rate monitor (telemetry/slo.py), rendered at /slo and as
+    # gauges on /metrics.
+    slo: BurnRateMonitor = None
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
+
+    def _request_id(self) -> str:
+        """Stable per-request id: the client's sanitized ``X-Request-Id``
+        or a generated one — echoed on EVERY response (success, 429, 504,
+        SSE) so client-side logs join to traces (ISSUE 6 satellite). Reset
+        per request in do_GET/do_POST: one handler instance serves many
+        requests on a keep-alive connection."""
+        rid = getattr(self, "_rid", None)
+        if rid is None:
+            rid = resolve_request_id(self.headers.get("X-Request-Id"))
+            self._rid = rid
+        return rid
 
     def _send_json(self, status: int, payload: dict) -> None:
         body = json.dumps(payload).encode("utf-8")
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
+        self.send_header("X-Request-Id", self._request_id())
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -335,12 +362,14 @@ class _Handler(BaseHTTPRequestHandler):
         }}).encode()
         self.send_response(429)
         self.send_header("Content-Type", "application/json")
+        self.send_header("X-Request-Id", self._request_id())
         self.send_header("Retry-After", str(self._retry_after_s()))
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
 
     def do_GET(self):
+        self._rid = None  # fresh id per request on keep-alive connections
         if self.path in ("/health", "/v1/health"):
             draining = bool(getattr(self.server, "draining", False))
             payload = {
@@ -376,6 +405,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"object": "list", "data": models})
         elif self.path == "/metrics":
             self._metrics()
+        elif self.path in ("/slo", "/v1/slo"):
+            # SLO burn-rate evaluation (telemetry/slo.py): the scrape IS
+            # the sampling cadence — each hit appends one cumulative
+            # snapshot and grades the windows against it.
+            if self.slo is None:
+                self._send_json(404, {"error": {"message":
+                    "no SLO monitor configured"}})
+            else:
+                self._send_json(200, self.slo.report())
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -408,6 +446,11 @@ class _Handler(BaseHTTPRequestHandler):
 
         lines: list[str] = []
         reserved: set[str] = set()
+        if self.slo is not None:
+            # Refresh the ditl_slo_* burn-rate gauges (they live in the
+            # serving registry) so /metrics carries the same numbers /slo
+            # renders; the scrape doubles as the monitor's sample tick.
+            self.slo.report()
         if self.serving_metrics is not None:
             lines.extend(self.serving_metrics.render().splitlines())
             # A flattened stats gauge must not shadow a registry metric
@@ -436,6 +479,7 @@ class _Handler(BaseHTTPRequestHandler):
         body = ("\n".join(lines) + "\n").encode()
         self.send_response(200)
         self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("X-Request-Id", self._request_id())
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -445,6 +489,7 @@ class _Handler(BaseHTTPRequestHandler):
         return self.threaded_engine
 
     def do_POST(self):
+        self._rid = None  # fresh id per request on keep-alive connections
         try:
             length = int(self.headers.get("Content-Length", 0))
             payload = json.loads(self.rfile.read(length) or b"{}")
@@ -578,6 +623,7 @@ class _Handler(BaseHTTPRequestHandler):
         /metrics, not just a GC side effect."""
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
+        self.send_header("X-Request-Id", self._request_id())
         self.send_header("Cache-Control", "no-cache")
         self.end_headers()
         try:
@@ -599,6 +645,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _multi_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, n: int,
         best_of: int, adapter_ids=None, stops=None, grammar=None,
+        trace=None,
     ) -> None:
         """OpenAI ``n``/``best_of``: generate ``best_of`` candidates (the
         continuous engine batches them into shared decode ticks; the
@@ -624,6 +671,7 @@ class _Handler(BaseHTTPRequestHandler):
                 adapter_id=adapter_ids[0] if adapter_ids else None,
                 grammar=grammar,
                 logprobs=0 if rank else None,
+                trace=trace,
             )
             cands = [(r.tokens, r.lp_token) for r in reqs]
         else:
@@ -876,7 +924,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None,
-        stops=None, lp_n=None, grammar=None, deadline_s=None,
+        stops=None, lp_n=None, grammar=None, deadline_s=None, trace=None,
     ) -> None:
         """OpenAI streaming: real incremental chunks from the continuous
         engine; the lockstep engine generates fully, then emits one chunk.
@@ -922,6 +970,7 @@ class _Handler(BaseHTTPRequestHandler):
                     seed=gen.seed,
                     grammar=grammar,
                     deadline_s=deadline_s,
+                    trace=trace,
                 )
             else:
                 stream_iter = self.threaded_engine.stream_one(
@@ -933,6 +982,7 @@ class _Handler(BaseHTTPRequestHandler):
                     adapter_id=adapter_ids[0] if adapter_ids else None,
                     grammar=grammar,
                     deadline_s=deadline_s,
+                    trace=trace,
                 )
 
         def events():
@@ -1016,6 +1066,18 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_sse(events())
 
     def _complete(self, payload: dict, *, chat: bool) -> None:
+        # Request tracing (ISSUE 6): continue the client's/gateway's trace
+        # (W3C traceparent) or root a fresh one; the engine's lifecycle
+        # spans chain under this span via submit(trace=...), so the merged
+        # timeline nests gateway -> server -> engine across processes. The
+        # span also covers the stream-write leg (SSE chunks relay inside
+        # _stream_complete before this method returns).
+        span = self.tracer.start_span(
+            "server.request",
+            parent=parse_traceparent(self.headers.get("traceparent")),
+            request_id=self._request_id(),
+            route="chat" if chat else "completions",
+        )
         try:
             if chat:
                 messages = payload.get("messages") or []
@@ -1139,7 +1201,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._multi_complete(
                     payload, prompt, gen, chat=chat, n=n_choices,
                     best_of=best_of, adapter_ids=adapter_ids, stops=stops,
-                    grammar=grammar,
+                    grammar=grammar, trace=span,
                 )
                 return
             # OpenAI semantics: completions' `logprobs: 0` is a real request
@@ -1174,7 +1236,7 @@ class _Handler(BaseHTTPRequestHandler):
                     self._stream_complete(
                         payload, prompt, gen, chat=chat,
                         adapter_ids=adapter_ids, stops=stops, lp_n=lp_n,
-                        grammar=grammar, deadline_s=deadline_s,
+                        grammar=grammar, deadline_s=deadline_s, trace=span,
                     )
                 except QueueFullError as e:
                     # The stream's submit is eager (before SSE headers), so
@@ -1227,6 +1289,7 @@ class _Handler(BaseHTTPRequestHandler):
                         seed=gen.seed,
                         grammar=grammar,
                         deadline_s=deadline_s,
+                        trace=span,
                     )
                 elif grammar is not None:
                     # Guided requests never fall back to the lock-step
@@ -1339,6 +1402,7 @@ class _Handler(BaseHTTPRequestHandler):
                     adapter_id=adapter_ids[0] if adapter_ids else None,
                     grammar=grammar,
                     deadline_s=deadline_s,
+                    trace=span,
                 )
                 n_gen = len(out)
                 text, hit_stop = _apply_stop(tok.decode(out), stops)
@@ -1399,6 +1463,7 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # total-server: errors become JSON, not crashes
             from ditl_tpu.infer.continuous import BadRequestError, QueueFullError
 
+            span.annotate(error=type(e).__name__)
             if isinstance(e, QueueFullError):
                 self._send_429(str(e))
                 return
@@ -1431,6 +1496,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             logger.exception("completion failed")
             self._send_json(500, {"error": {"message": str(e)}})
+        finally:
+            span.end()
 
 
 def make_server(
@@ -1444,6 +1511,9 @@ def make_server(
     adapter_names: dict | None = None,
     spec_generator=None,
     max_pending: int | None = None,
+    tracer: Tracer | None = None,
+    slo: BurnRateMonitor | None = None,
+    telemetry=None,
 ) -> DrainableHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
@@ -1466,6 +1536,19 @@ def make_server(
     serving_metrics = getattr(threaded_engine, "metrics", None)
     if serving_metrics is None:
         serving_metrics = ServingMetrics()
+    # Tracing (ISSUE 6): default to the engine's tracer so one knob
+    # (constructing the engine with a journal-backed tracer) arms the whole
+    # replica — server.request spans and engine lifecycle spans land in the
+    # same per-process journal and nest under one trace.
+    if tracer is None:
+        tracer = getattr(threaded_engine, "tracer", None) or NULL_TRACER
+    if slo is None:
+        # SLO burn-rate monitor over this server's bundle; ``telemetry``
+        # (config.TelemetryConfig) overrides the objectives, defaults
+        # otherwise. Always on: sampling happens only on /slo//metrics
+        # scrapes, so an unscraped server pays nothing.
+        kw = telemetry.serving_slo_kwargs() if telemetry is not None else {}
+        slo = serving_slo(serving_metrics, **kw)
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -1482,6 +1565,8 @@ def make_server(
             "embed_cache": collections.OrderedDict(),
             "serving_metrics": serving_metrics,
             "max_pending": max_pending,
+            "tracer": tracer,
+            "slo": slo,
         },
     )
     return DrainableHTTPServer((host, port), handler)
@@ -1625,7 +1710,43 @@ def serve(argv: list[str] | None = None) -> int:
         "max_seq_len (set this for long-context presets like llama31-8b, "
         "whose 131072-token cache would be ~17 GB per slot)",
     )
+    parser.add_argument(
+        "--trace-dir", default="",
+        help="arm end-to-end request tracing (ISSUE 6): span records "
+        "(server.request + the engine's queue/prefill/decode lifecycle, "
+        "tick instants) append to {dir}/events-server-<pid>.jsonl; export "
+        "with python -m ditl_tpu.telemetry.trace_export --dir DIR",
+    )
+    parser.add_argument(
+        "--telemetry-override", action="append", default=[],
+        metavar="FIELD=VALUE",
+        help="TelemetryConfig override (repeatable), e.g. slo_ttft_s=0.5 "
+        "or journal_max_mb=64 — tunes the /slo objectives and the trace "
+        "journal's rotation cap",
+    )
     args = parser.parse_args(argv)
+
+    from ditl_tpu.config import Config, parse_overrides
+
+    telemetry_cfg = parse_overrides(
+        Config(), [f"telemetry.{o}" for o in args.telemetry_override]
+    ).telemetry
+    tracer = None
+    if args.trace_dir and jax.process_index() == 0:
+        # Process-0-gated like serving itself: pod WORKER replicas replay
+        # the coordinator's scheduler ticks with no upstream trace context
+        # — an armed worker tracer would journal a rootless phantom span
+        # tree per request (N traces for one client request in the export).
+        import os
+
+        from ditl_tpu.telemetry.journal import EventJournal
+
+        tag = os.getpid()  # unique per replica subprocess behind a gateway
+        tracer = Tracer(EventJournal(
+            os.path.join(args.trace_dir, f"events-server-{tag}.jsonl"),
+            source=f"server-{tag}",
+            max_bytes=telemetry_cfg.journal_max_bytes(),
+        ))
 
     if args.mesh and not args.pod and jax.process_count() > 1:
         parser.error("--mesh on a multi-host pod requires --pod: the mesh "
@@ -1818,6 +1939,7 @@ def serve(argv: list[str] | None = None) -> int:
             draft_params=draft_params, draft_cfg=draft_cfg,
             pipeline_ticks=args.pipeline_ticks,
             admission=args.admission,
+            tracer=tracer,
         )
 
     if args.pod and jax.process_index() != 0:
@@ -1878,6 +2000,7 @@ def serve(argv: list[str] | None = None) -> int:
         default_max_tokens=args.max_tokens, threaded_engine=threaded,
         adapter_names=adapter_names, spec_generator=spec,
         max_pending=args.max_pending or None,
+        tracer=tracer, telemetry=telemetry_cfg,
     )
 
     # SIGTERM = graceful drain (the gateway/orchestrator rolling-restart

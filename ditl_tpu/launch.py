@@ -251,6 +251,9 @@ def run_process_supervised(argv: list[str], num_workers: int = 1) -> int:
         # The workers journal under the same dir (train.telemetry_dir), so
         # the controller's end-of-run merge yields one ordered pod timeline.
         journal_dir=config.train.telemetry_dir,
+        # Size control (ISSUE 6 satellite): telemetry.journal_max_mb caps
+        # every per-process journal via segment rotation.
+        journal_max_bytes=config.telemetry.journal_max_bytes(),
     )
     result = controller.run()
     if not result.ok:

@@ -197,6 +197,7 @@ class PodController:
         log: Callable[[str], None] | None = None,
         on_restart: Callable[[int, int, int], None] | None = None,
         journal_dir: str = "",
+        journal_max_bytes: int | None = None,
         straggler_lag_steps: int = 0,
         straggler_relaunch: bool = False,
     ):
@@ -248,7 +249,8 @@ class PodController:
         self.journal_dir = journal_dir
         self._journal: EventJournal | None = (
             EventJournal(controller_journal_path(journal_dir),
-                         source="controller")
+                         source="controller",
+                         max_bytes=journal_max_bytes)
             if journal_dir else None
         )
         # Straggler escalation (ISSUE 5): _stale_workers only sees

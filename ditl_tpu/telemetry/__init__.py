@@ -1,8 +1,10 @@
-"""Unified telemetry subsystem (ISSUE 3): process-local metrics registry
-(registry.py), serving instrument bundle (serving.py), goodput/badput
-accounting (goodput.py), and the cross-process JSONL event journal
-(journal.py). Host-only by design — importing this package never touches
-jax, and no instrument accepts a device value."""
+"""Unified telemetry subsystem (ISSUE 3 + 6): process-local metrics
+registry (registry.py), serving instrument bundle (serving.py),
+goodput/badput accounting (goodput.py), the cross-process JSONL event
+journal (journal.py), end-to-end request tracing (tracing.py), Chrome-trace
+export (trace_export.py), and SLO burn-rate monitoring (slo.py). Host-only
+by design — importing this package never touches jax, and no instrument
+accepts a device value."""
 
 from ditl_tpu.telemetry.goodput import (
     BADPUT_BUCKETS,
@@ -26,9 +28,25 @@ from ditl_tpu.telemetry.registry import (
     MetricsRegistry,
 )
 from ditl_tpu.telemetry.serving import ServingMetrics
+from ditl_tpu.telemetry.slo import (
+    BurnRateMonitor,
+    Objective,
+    gateway_slo,
+    serving_slo,
+)
+from ditl_tpu.telemetry.tracing import (
+    NULL_TRACER,
+    Span,
+    SpanContext,
+    Tracer,
+    format_traceparent,
+    new_request_id,
+    parse_traceparent,
+)
 
 __all__ = [
     "BADPUT_BUCKETS",
+    "BurnRateMonitor",
     "Counter",
     "EventJournal",
     "Gauge",
@@ -36,12 +54,22 @@ __all__ = [
     "Histogram",
     "LATENCY_BUCKETS_S",
     "MetricsRegistry",
+    "NULL_TRACER",
+    "Objective",
     "ServingMetrics",
+    "Span",
+    "SpanContext",
     "TOKEN_LATENCY_BUCKETS_S",
+    "Tracer",
     "controller_journal_path",
+    "format_traceparent",
+    "gateway_slo",
     "lost_work_from_journal",
     "merge_journals",
+    "new_request_id",
+    "parse_traceparent",
     "read_journal",
+    "serving_slo",
     "worker_journal_path",
     "write_pod_timeline",
 ]

@@ -13,6 +13,17 @@ Ordering: events are sorted by wall-clock ``ts`` with a per-file monotonic
 repo's drills); cross-host skew would reorder only events closer together
 than the skew, and the per-source ``seq`` keeps each process's own story
 internally ordered regardless.
+
+Size control (ISSUE 6 satellite): ``max_bytes`` arms rotation so a
+long-lived serving process (span records arrive per request, tick instants
+per scheduler tick) cannot grow its journal unboundedly. The journal
+rotates into sibling segments named ``<stem>.rNNNN.jsonl`` — still matching
+the ``events-*.jsonl`` merge glob, and carrying the SAME ``source`` and a
+``seq`` that keeps counting, so ``merge_journals`` orders rotated segments
+correctly with no special casing. Total footprint is bounded: each segment
+caps at ``max_bytes // KEEP_SEGMENTS`` and only the newest
+``KEEP_SEGMENTS - 1`` rotated segments are kept (the oldest is deleted),
+so disk usage stays ~``max_bytes`` while the newest events always survive.
 """
 
 from __future__ import annotations
@@ -21,6 +32,7 @@ import contextlib
 import glob
 import json
 import os
+import threading
 import time
 
 __all__ = [
@@ -34,6 +46,10 @@ __all__ = [
 
 TIMELINE_FILENAME = "pod_timeline.jsonl"
 
+# Rotation keeps this many segments (the live file + KEEP_SEGMENTS - 1
+# rotated ones), each capped at max_bytes / KEEP_SEGMENTS.
+KEEP_SEGMENTS = 4
+
 
 def controller_journal_path(directory: str) -> str:
     return os.path.join(directory, "events-controller.jsonl")
@@ -44,29 +60,84 @@ def worker_journal_path(directory: str, process_index: int) -> str:
 
 
 class EventJournal:
-    """Append-only JSONL event writer for ONE process."""
+    """Append-only JSONL event writer for ONE process. Writes are
+    lock-serialized: serving hands one journal to many HTTP handler threads
+    (span records), and interleaved partial writes would tear lines."""
 
-    def __init__(self, path: str, source: str = ""):
+    def __init__(self, path: str, source: str = "",
+                 max_bytes: int | None = None):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self.path = path
         self.source = source or os.path.basename(path).rsplit(".", 1)[0]
+        if max_bytes is not None and max_bytes <= 0:
+            max_bytes = None
+        self.max_bytes = max_bytes
+        self._segment_bytes = (
+            max(4096, max_bytes // KEEP_SEGMENTS) if max_bytes else None
+        )
+        # Resume the segment counter from what is already on disk: a
+        # relaunched process (elastic worker, restarted replica) reuses the
+        # same journal path, and restarting at 0 would os.replace() onto —
+        # and silently destroy — the previous incarnation's rotated
+        # segments while they are still inside the keep budget.
+        self._rotated = 0
+        if self._segment_bytes is not None:
+            stem, ext = os.path.splitext(self.path)
+            for p in glob.glob(f"{stem}.r[0-9][0-9][0-9][0-9]{ext}"):
+                try:
+                    n = int(p[len(stem) + 2: len(p) - len(ext)])
+                except ValueError:
+                    continue
+                self._rotated = max(self._rotated, n)
         self._seq = 0
+        self._lock = threading.Lock()
         # Line-buffered append: one write per event, durable up to the last
         # whole line even through SIGKILL.
         self._fh = open(path, "a", buffering=1)
+        self._bytes = self._fh.tell()
 
-    def event(self, event: str, **attrs) -> dict:
-        """Record one instantaneous event; returns the record written."""
-        rec = {
-            "ts": time.time(),
-            "seq": self._seq,
+    def _rotated_path(self, n: int) -> str:
+        stem, ext = os.path.splitext(self.path)
+        return f"{stem}.r{n:04d}{ext}"
+
+    def _maybe_rotate(self, incoming: int) -> None:
+        """Called under the lock, before a write: when the live segment
+        would exceed its cap, rename it to the next rotated-segment name and
+        start fresh, deleting segments that age out of the keep budget."""
+        if self._segment_bytes is None or self._bytes == 0:
+            return
+        if self._bytes + incoming <= self._segment_bytes:
+            return
+        self._fh.close()
+        self._rotated += 1
+        os.replace(self.path, self._rotated_path(self._rotated))
+        expired = self._rotated - (KEEP_SEGMENTS - 1)
+        if expired >= 1:
+            with contextlib.suppress(OSError):
+                os.remove(self._rotated_path(expired))
+        self._fh = open(self.path, "a", buffering=1)
+        self._bytes = 0
+
+    def event(self, event: str, _ts: float | None = None, **attrs) -> dict:
+        """Record one instantaneous event; returns the record written.
+        ``_ts`` overrides the stamped wall clock — span records
+        (telemetry/tracing.py) are written at END but stamped with their
+        START so the merged timeline orders them where they began."""
+        base = {
+            "ts": time.time() if _ts is None else _ts,
             "source": self.source,
             "pid": os.getpid(),
             "event": event,
             **attrs,
         }
-        self._seq += 1
-        self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+        with self._lock:
+            rec = {**base, "seq": self._seq}
+            self._seq += 1
+            if self._fh is not None:
+                line = json.dumps(rec, sort_keys=True) + "\n"
+                self._maybe_rotate(len(line))
+                self._fh.write(line)
+                self._bytes += len(line)
         return rec
 
     @contextlib.contextmanager
@@ -78,22 +149,14 @@ class EventJournal:
         try:
             yield
         finally:
-            rec = {
-                "ts": t0,
-                "seq": self._seq,
-                "source": self.source,
-                "pid": os.getpid(),
-                "event": event,
-                "dur_s": round(time.time() - t0, 6),
-                **attrs,
-            }
-            self._seq += 1
-            self._fh.write(json.dumps(rec, sort_keys=True) + "\n")
+            self.event(event, _ts=t0,
+                       dur_s=round(time.time() - t0, 6), **attrs)
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
 
 
 def read_journal(path: str) -> list[dict]:
@@ -116,7 +179,9 @@ def read_journal(path: str) -> list[dict]:
 
 def merge_journals(directory: str) -> list[dict]:
     """All ``events-*.jsonl`` files in ``directory`` merged into one list
-    ordered by (ts, source, seq)."""
+    ordered by (ts, source, seq). Rotated segments (``events-x.rNNNN.jsonl``)
+    match the same glob and carry the same source + monotonic seq, so they
+    interleave back into order with no special casing."""
     records: list[dict] = []
     for path in sorted(glob.glob(os.path.join(directory, "events-*.jsonl"))):
         records.extend(read_journal(path))

@@ -148,6 +148,18 @@ class Histogram:
     def sum(self) -> float:
         return self._sum
 
+    def count_le(self, bound: float) -> tuple[int, float | None]:
+        """Cumulative observations in buckets whose upper bound is <=
+        ``bound``, with the effective (snapped-down) bound — the exact
+        question a fixed-bucket histogram can answer, used by the SLO
+        burn-rate monitor (telemetry/slo.py) to count "requests under the
+        latency threshold". ``(0, None)`` when ``bound`` sits below the
+        first bucket (no bucket can answer it)."""
+        i = bisect.bisect_right(self.buckets, bound)
+        if i == 0:
+            return 0, None
+        return sum(self._counts[:i]), self.buckets[i - 1]
+
     def quantile(self, q: float) -> float | None:
         """Approximate quantile from the bucket ladder (linear interpolation
         within the bucket, Prometheus ``histogram_quantile`` style). None

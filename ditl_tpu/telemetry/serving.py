@@ -129,6 +129,13 @@ class ServingMetrics:
             "speculative drafted tokens rejected by verify forwards")
         self.tokens_generated = r.counter(
             f"{PREFIX}_tokens_generated", "tokens generated (all requests)")
+        self.tpot_interference = r.histogram(
+            f"{PREFIX}_tpot_interference_seconds",
+            "per-tick decode delay a victim request absorbed because the "
+            "tick also ran another request's prefill chunk(s) — the "
+            "scheduler-interference signal behind chunked-prefill tuning "
+            "(ISSUE 6)", TOKEN_LATENCY_BUCKETS_S,
+        )
         self.deadline_expired = r.counter(
             f"{PREFIX}_deadline_expired",
             "requests evicted from the queue/slots at their deadline "

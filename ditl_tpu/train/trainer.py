@@ -39,6 +39,7 @@ from ditl_tpu.runtime.mesh import build_mesh
 from ditl_tpu.telemetry import (
     EventJournal,
     GoodputTracker,
+    Tracer,
     lost_work_from_journal,
     read_journal,
     worker_journal_path,
@@ -165,6 +166,7 @@ def train(config: Config) -> dict[str, Any]:
                 config.train.telemetry_dir, jax.process_index()
             ),
             source=f"worker-{jax.process_index()}",
+            max_bytes=config.telemetry.journal_max_bytes(),
         )
         journal.event("worker.start")
     # Chaos plane (ditl_tpu/chaos/, ISSUE 5): armed pod-wide from the
@@ -356,6 +358,10 @@ def train(config: Config) -> dict[str, Any]:
         config.train.profile_dir,
         config.train.profile_start_step,
         config.train.profile_num_steps,
+        # ISSUE 6 satellite: a journaled run records the xprof capture
+        # window as a `profiler.capture` span on the training-leg timeline
+        # (not only as a goodput bucket).
+        tracer=Tracer(journal) if journal is not None else None,
     )
     client = LLMClient(config.api)
     total_steps = config.train.total_steps

@@ -38,9 +38,16 @@ class StepProfiler:
         with prof.annotate(global_step):
             state, metrics = train_step(state, batch)
         prof.maybe_stop(global_step)
+
+    ``tracer`` (telemetry/tracing.py, ISSUE 6 satellite): when armed, the
+    capture window is recorded as a ``profiler.capture`` span in the
+    training journal — the xprof window shows up ON the merged timeline
+    (with its step range and output dir) instead of existing only as a
+    goodput bucket.
     """
 
-    def __init__(self, directory: str, start_step: int, num_steps: int = 3):
+    def __init__(self, directory: str, start_step: int, num_steps: int = 3,
+                 tracer=None):
         self.directory = directory
         self.start_step = start_step
         self.num_steps = num_steps
@@ -48,18 +55,34 @@ class StepProfiler:
         self._done = False
         self._stop_after = start_step + num_steps - 1
         self._enabled = bool(directory) and num_steps > 0 and jax.process_index() == 0
+        self._tracer = tracer
+        self._span_t0 = 0.0
+        self._window_start = 0
 
     def maybe_start(self, step: int) -> None:
         # >= not ==: a resumed run whose restored step is already past
         # start_step still gets its window (shifted to the resume point).
         if self._enabled and not self._active and not self._done and step >= self.start_step:
+            import time as _time
+
             jax.profiler.start_trace(self.directory)
             self._active = True
             self._stop_after = step + self.num_steps - 1
+            self._span_t0 = _time.time()
+            self._window_start = step
             logger.info(
                 "profiler: tracing steps %d..%d to %s",
                 step, self._stop_after, self.directory,
             )
+
+    def _record_span(self, last_step: int, partial: bool) -> None:
+        if self._tracer is None or not getattr(self._tracer, "armed", False):
+            return
+        self._tracer.start_span(
+            "profiler.capture", t0=self._span_t0,
+            start_step=self._window_start, last_step=last_step,
+            directory=self.directory, partial=partial,
+        ).end()
 
     def annotate(self, step: int):
         if self._active:
@@ -81,6 +104,7 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
+            self._record_span(step, partial=False)
             logger.info("profiler: trace written to %s", self.directory)
 
     def close(self) -> None:
@@ -94,5 +118,6 @@ class StepProfiler:
             jax.profiler.stop_trace()
             self._active = False
             self._done = True
+            self._record_span(self._stop_after, partial=True)
             logger.info("profiler: trace (partial window) written to %s",
                         self.directory)

@@ -195,13 +195,17 @@ def test_prometheus_metrics_endpoint(setup):
         assert "ditl_serving_up 1" in body
         assert "ditl_serving_n_slots 8" in body
         assert "# TYPE ditl_serving_queue_depth gauge" in body
-        # every non-comment line parses as "name value"
+        # every non-comment line parses as "name value"; the registry now
+        # carries the serving families plus the SLO burn-rate gauges
+        # (ISSUE 6 — refreshed on every /metrics scrape)
         for line in body.strip().splitlines():
             if line.startswith("#"):
                 continue
             name, value = line.rsplit(" ", 1)
             float(value)
-            assert name.startswith("ditl_serving_")
+            assert name.startswith(("ditl_serving_", "ditl_slo_"))
+        assert "ditl_slo_ttft_burn_rate_w300" in body
+        assert "ditl_slo_availability_alerting" in body
     finally:
         server.shutdown()
         threaded.close()
@@ -277,6 +281,67 @@ def test_metrics_exposition_invariants_live_server(setup):
     finally:
         server.shutdown()
         threaded.close()
+
+
+@pytest.mark.tracing
+@pytest.mark.telemetry
+def test_request_id_echo_slo_endpoint_and_interference_family(setup):
+    """ISSUE 6 satellites on the live server: every response carries a
+    stable X-Request-Id (client-provided echoed, otherwise generated —
+    including on SSE), /slo renders the burn-rate evaluation, and the
+    interference histogram family obeys the exposition invariants."""
+    params, cfg, tok = setup
+    server, threaded, port = _serve(params, cfg, tok, continuous=True)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 3}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Request-Id": "client-id-7"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["X-Request-Id"] == "client-id-7"
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions",
+            data=json.dumps({"prompt": "hi", "max_tokens": 3,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            # Generated on the SSE path too (headers precede the stream).
+            assert resp.headers["X-Request-Id"].startswith("req-")
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream")
+            resp.read()
+        # /slo: the three server objectives, graded over real traffic.
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/slo", timeout=30
+        ) as resp:
+            slo = json.loads(resp.read())
+        assert set(slo["objectives"]) == {"ttft", "tpot", "availability"}
+        avail = slo["objectives"]["availability"]
+        assert avail["total"] >= 2  # both completions above
+        for obj in slo["objectives"].values():
+            for w in obj["windows"].values():
+                assert w["errors"] <= w["requests"]
+        # Interference histogram family: typed, cumulative, +Inf-closed —
+        # the prom_helpers invariants extended to the ISSUE 6 metrics.
+        types, samples = exposition_index(_scrape_metrics(port))
+        fam = "ditl_serving_tpot_interference_seconds"
+        assert types[fam] == "histogram"
+        buckets = [(n, v) for n, v in samples.items()
+                   if n.startswith(f"{fam}_bucket")]
+        counts = [v for _, v in buckets]
+        assert counts == sorted(counts)
+        assert buckets[-1][0] == f'{fam}_bucket{{le="+Inf"}}'
+        assert buckets[-1][1] == samples[f"{fam}_count"]
+        # SLO burn-rate gauges are typed gauges in the same exposition.
+        for name, kind in types.items():
+            if name.startswith("ditl_slo_"):
+                assert kind == "gauge", name
+        assert any(n.startswith("ditl_slo_ttft_burn_rate_w") for n in types)
+    finally:
+        server.shutdown()
 
 
 @pytest.mark.telemetry
