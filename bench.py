@@ -46,6 +46,16 @@ def _chaos_result() -> dict:
     summary = injected_summary()
     return {"chaos": summary} if summary is not None else {}
 
+
+def _record_meta() -> dict:
+    """Schema + provenance stamp for every bench JSON row (ISSUE 7
+    satellite): records are versioned and name the code revision they were
+    measured at, so `perf_compare` can refuse cross-schema diffs and a row
+    pasted into BASELINE.md stays attributable."""
+    from ditl_tpu.telemetry.perf import SWEEP_SCHEMA, git_rev
+
+    return {"schema": SWEEP_SCHEMA, "git_rev": git_rev()}
+
 # bf16 peak TFLOP/s per chip, EXACT device_kind match (lowercased). A
 # substring table silently mis-scaled MFU when device_kind strings
 # reshuffled; unknown kinds now warn loudly and omit MFU instead of
@@ -543,9 +553,11 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         "metric": "decode tokens/sec (%s %dM, batch %d, ctx %d+%d, %s, %s)"
                   % (arch, round(params_m), batch, len(prompts[0]), max_new,
                      label, workload),
+        **_record_meta(),
         "value": round(tokens / dt, 1),
         "unit": "tokens/sec",
         "vs_baseline": 1.0,
+        "vs_baseline_key": "self",
         "params_m": round(params_m, 1),
         "platform": platform,
         "generated_tokens": tokens,
@@ -728,9 +740,11 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     print(json.dumps({
         "metric": "fleet decode tokens/sec (%d replica(s) x %d slots, "
                   "router=%s)" % (n_replicas, slots, router),
+        **_record_meta(),
         "value": round(tokens / dt, 1),
         "unit": "tokens/sec",
         "vs_baseline": 1.0,
+        "vs_baseline_key": "self",
         "platform": platform,
         "generated_tokens": tokens,
         "requests": len(prompts),
@@ -790,9 +804,13 @@ def _effective_bwd_impls(cfg, batch: int, seq: int, mesh=None) -> dict[str, str]
     return {"mlp": mlp_eff, "proj": proj_eff}
 
 
-def main(model_name: str = "350m", overrides: list[str] | None = None,
-         batch_override: int = 0, seq_override: int = 0,
-         compile_cache_dir: str = "") -> int:
+def run_train_bench(model_name: str = "350m",
+                    overrides: list[str] | None = None,
+                    batch_override: int = 0, seq_override: int = 0,
+                    compile_cache_dir: str = "") -> dict:
+    """One fine-tune bench measurement; returns the result record (the
+    JSON row ``main`` prints). Extracted so ``--sweep`` can run it once per
+    grid cell and record each row into the versioned sweep JSON."""
     import dataclasses
 
     import jax
@@ -806,7 +824,10 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
     from ditl_tpu.train.state import create_train_state
     from ditl_tpu.train.step import make_multi_step
 
-    from ditl_tpu.telemetry import GoodputTracker
+    from ditl_tpu.telemetry import (
+        GoodputTracker, MemoryWatcher, StepAnatomy, compiled_cost, roofline,
+    )
+    from ditl_tpu.telemetry.perf import peak_hbm_bw
 
     # Goodput accounting for the bench itself (ISSUE 3 satellite): the same
     # bucket convention as the trainer, so BENCH_r*.json rows say where the
@@ -866,11 +887,17 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
     # (lax.scan over stacked batches, train/step.make_multi_step) — the device
     # runs autonomously with zero host dispatch between steps; the same
     # mechanism the trainer exposes as `train.steps_per_call`.
+    # Explicit lower().compile() (instead of tracing on first call) so the
+    # SAME executable the timed loop runs also answers cost_analysis() —
+    # XLA's own flops/bytes for the roofline report (ISSUE 7).
     t0 = time.perf_counter()
     state = create_train_state(jax.random.key(0), cfg, tcfg)
     params_m = llama.num_params(state.params) / 1e6
     multi = make_multi_step(cfg, tcfg, mesh, gb, chunk)
-    state, metrics = multi(state, make_global_batch(mesh, window(0)))
+    gb0 = make_global_batch(mesh, window(0))
+    multi_exe = multi.lower(state, gb0).compile()
+    cost = compiled_cost(multi_exe, n_steps=chunk)
+    state, metrics = multi_exe(state, gb0)
     loss_start = float(metrics["loss"][0])
     float(metrics["loss"][-1])  # full host sync (block_until_ready alone does
     # not guarantee completion through remote-device transports)
@@ -885,14 +912,26 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
         staged = [make_global_batch(mesh, window(i))
                   for i in range(1, n_windows + 1)]
         jax.block_until_ready(staged)
+    # Step-time anatomy over the timed windows (telemetry/perf.py): data is
+    # pre-staged (data_wait excluded by design), so the wall decomposes into
+    # host_dispatch (the async call returning) + device_compute (the host
+    # blocked on the window's results) — conservation-exact by measurement.
+    anatomy = StepAnatomy()
+    memwatch = MemoryWatcher()
     times = []
     for stacked in staged:
         t = time.perf_counter()
-        state, metrics = multi(state, stacked)
+        state, metrics = multi_exe(state, stacked)
+        t_disp = time.perf_counter()
         float(metrics["loss"][-1])  # sync
-        dt_w = time.perf_counter() - t
+        t_end = time.perf_counter()
+        dt_w = t_end - t
+        anatomy.add("host_dispatch", t_disp - t)
+        anatomy.add("device_compute", t_end - t_disp)
+        anatomy.add_wall(dt_w, chunk)
         tracker.add_step(dt_w, chunk)
         times.append(dt_w / chunk)
+    memwatch.sample()  # post-run high-watermark (no-op on statless backends)
     p50 = statistics.median(times)
     final_loss = float(metrics["loss"][-1])
     tokens_per_step = batch * seq
@@ -903,21 +942,32 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
         print("bench: WARNING loss did not fall — training regression?",
               file=sys.stderr)
 
-    anchors = {"1b3": R02_1B3_BASELINE_TPS, "350m": R01_350M_BASELINE_TPS}
+    anchors = {"1b3": ("R02_1B3_BASELINE_TPS", R02_1B3_BASELINE_TPS),
+               "350m": ("R01_350M_BASELINE_TPS", R01_350M_BASELINE_TPS)}
     swept = bool(overrides or batch_override or seq_override)
+    # vs_baseline names the EXACT anchor it divides by (ISSUE 7 satellite):
+    # a swept run measures a different config (no anchor), a CPU smoke has
+    # nothing real to compare against (self), and a pinned TPU run names
+    # the bench constant — no more implicit pairing.
+    anchor_key, anchor_tps = anchors[model_name]
+    if swept:
+        vs_baseline, vs_key = None, None
+    elif platform == "tpu":
+        vs_baseline, vs_key = round(tps_chip / anchor_tps, 4), \
+            f"bench.{anchor_key}"
+    else:
+        vs_baseline, vs_key = 1.0, "self"
     result = {
         "metric": "fine-tune tokens/sec/chip (Llama-style %dM, bf16, seq %d)"
                   % (round(params_m), seq),
+        **_record_meta(),
         "value": round(tps_chip, 1),
         "unit": "tokens/sec/chip",
         # A swept run measures a DIFFERENT config/workload than the pinned
         # anchor — comparing would misattribute progress, so swept runs
         # carry their knobs in the JSON and no vs_baseline.
-        "vs_baseline": (
-            None if swept
-            else round(tps_chip / anchors[model_name], 4)
-            if platform == "tpu" else 1.0
-        ),
+        "vs_baseline": vs_baseline,
+        "vs_baseline_key": vs_key,
         "step_time_p50_ms": round(p50 * 1e3, 2),
         "n_chips": n_chips,
         "platform": platform,
@@ -932,8 +982,14 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
         # clock went — conservation-checked buckets, same convention as the
         # trainer's goodput report.
         "goodput": tracker.report(),
+        # Step-time anatomy over the timed windows (ISSUE 7): dispatch vs
+        # device-blocked decomposition of the p50 the headline divides by.
+        "step_anatomy": anatomy.report(),
         **_chaos_result(),
     }
+    mem = memwatch.report()
+    if mem:
+        result["memory"] = mem
     if swept:
         result["swept"] = {
             "overrides": list(overrides or []),
@@ -943,8 +999,164 @@ def main(model_name: str = "350m", overrides: list[str] | None = None,
     if peak:
         train_flops_per_token = 3 * _model_flops_per_token(cfg, seq)
         result["mfu"] = round(tps_chip * train_flops_per_token / peak, 4)
+        if cost is not None:
+            # Roofline from XLA's own cost model (ISSUE 7): cost-counted
+            # flops INCLUDE remat recompute, so mfu_cost - mfu is the
+            # measured recompute tax; arithmetic intensity + the bandwidth
+            # ceiling say which wall the remaining gap sits against.
+            result["roofline"] = roofline(
+                cost["flops_per_step"], cost.get("bytes_per_step"), p50,
+                peak * n_chips,
+                (peak_hbm_bw(jax.devices()[0].device_kind) or 0) * n_chips
+                or None,
+            )
+            result["roofline"]["mfu_analytic"] = result["mfu"]
+    elif cost is not None:
+        # No known peak (CPU smoke): record the raw cost-model numbers so
+        # the record format is exercised everywhere the bench runs.
+        result["cost"] = {
+            k: v for k, v in cost.items() if v is not None
+        }
+    return result
+
+
+def main(model_name: str = "350m", overrides: list[str] | None = None,
+         batch_override: int = 0, seq_override: int = 0,
+         compile_cache_dir: str = "") -> int:
+    result = run_train_bench(
+        model_name, overrides=overrides, batch_override=batch_override,
+        seq_override=seq_override, compile_cache_dir=compile_cache_dir,
+    )
     print(json.dumps(result))
     return 0
+
+
+def _parse_sweep_spec(spec: str) -> list[dict[str, str]]:
+    """``"flash_block_q=512,1024;remat=dots,dots_inputs"`` -> the list of
+    grid cells (cross-product), each a {field: value} dict. Fields are
+    ModelConfig knobs (the ``--override`` namespace) plus the special
+    ``batch`` / ``seq`` axes."""
+    import itertools
+
+    axes: list[tuple[str, list[str]]] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise SystemExit(
+                f"--sweep axis must be field=v1,v2,... got {part!r}"
+            )
+        key, values = part.split("=", 1)
+        vals = [v.strip() for v in values.split(",") if v.strip()]
+        if not vals:
+            raise SystemExit(f"--sweep axis {key!r} has no values")
+        axes.append((key.strip(), vals))
+    if not axes:
+        raise SystemExit("--sweep spec is empty")
+    cells = []
+    for combo in itertools.product(*(vals for _, vals in axes)):
+        cells.append({k: v for (k, _), v in zip(axes, combo)})
+    return cells
+
+
+def run_sweep(model_name: str, spec: str, out_path: str,
+              overrides: list[str] | None = None,
+              batch_override: int = 0, seq_override: int = 0,
+              compile_cache_dir: str = "") -> int:
+    """``bench.py --sweep`` (ISSUE 7 tentpole leg 3): run a dotted-override
+    grid, one resumable record per cell, into the versioned sweep JSON at
+    ``out_path``. Cells already present in an existing record (same schema)
+    are skipped, so a sweep killed at cell k resumes at cell k — on a TPU
+    where each cell costs a fresh ~85 s compile, that is the difference
+    between a usable overnight grid and a babysat one. Diff two sweeps with
+    ``python -m ditl_tpu.telemetry.perf_compare``."""
+    import jax
+
+    from ditl_tpu.telemetry.perf import (
+        cell_key, load_sweep_record, new_sweep_record, record_sweep_cell,
+    )
+
+    cells = _parse_sweep_spec(spec)
+    platform = jax.devices()[0].platform
+    meta = {"model": model_name, "platform": platform,
+            "base_overrides": list(overrides or []),
+            "batch": batch_override, "seq": seq_override}
+    record = load_sweep_record(out_path)
+    if record is not None:
+        # Resume only a record measured under the SAME base configuration:
+        # cell keys name only the swept knobs, so resuming a 350m record
+        # from a 1b3 invocation would silently reuse the other model's
+        # numbers — and feed perf_compare wrong-config baselines.
+        got = record.get("meta", {})
+        mismatch = {k: (got.get(k), v) for k, v in meta.items()
+                    if got.get(k) != v}
+        if mismatch:
+            raise SystemExit(
+                f"--sweep-out {out_path} was recorded under a different "
+                f"base config ({mismatch}); point --sweep-out elsewhere "
+                "or delete the stale record"
+            )
+    else:
+        record = new_sweep_record(f"train-{model_name}", meta=meta)
+    completed = skipped = failed = 0
+    for cell in cells:
+        key = cell_key(cell)
+        prior = record["cells"].get(key)
+        if prior is not None and "error" not in prior:
+            skipped += 1
+            print(f"bench: sweep cell [{key}] already recorded — skipping",
+                  file=sys.stderr)
+            continue
+        if prior is not None:
+            # An errored cell is retried on resume: the failure may have
+            # been transient (host pressure, a preempted chip). A
+            # persistent failure just re-records its error — and still
+            # fails the run's exit code.
+            print(f"bench: sweep cell [{key}] previously FAILED — retrying",
+                  file=sys.stderr)
+        cell_overrides = list(overrides or [])
+        cell_batch, cell_seq = batch_override, seq_override
+        for k, v in cell.items():
+            if k == "batch":
+                cell_batch = int(v)
+            elif k == "seq":
+                cell_seq = int(v)
+            else:
+                cell_overrides.append(f"{k}={v}")
+        print(f"bench: sweep cell [{key}]", file=sys.stderr)
+        try:
+            result = run_train_bench(
+                model_name, overrides=cell_overrides,
+                batch_override=cell_batch, seq_override=cell_seq,
+                compile_cache_dir=compile_cache_dir,
+            )
+        except Exception as e:  # noqa: BLE001 - an OOM cell must not kill
+            # the rest of the grid; the failure IS the cell's result.
+            result = {"error": f"{type(e).__name__}: {str(e)[:500]}"}
+            failed += 1
+            print(f"bench: sweep cell [{key}] FAILED {result['error']}",
+                  file=sys.stderr)
+        else:
+            completed += 1
+        result["cell"] = dict(cell)
+        record = record_sweep_cell(out_path, record, key, result)
+    print(json.dumps({
+        "metric": f"train sweep ({model_name}, {len(cells)} cell(s))",
+        **_record_meta(),
+        "value": completed,
+        "unit": "cells",
+        "vs_baseline": None,
+        "vs_baseline_key": None,
+        "platform": platform,
+        "cells": len(cells),
+        "completed": completed,
+        "skipped": skipped,
+        "failed": failed,
+        "out": out_path,
+        **_chaos_result(),
+    }))
+    return 0 if failed == 0 else 1
 
 
 if __name__ == "__main__":
@@ -1036,6 +1248,18 @@ if __name__ == "__main__":
                         help="ModelConfig override for the TRAIN bench "
                         "(repeatable), e.g. flash_block_q=2048 — sweep a "
                         "knob without editing the pinned config")
+    parser.add_argument("--sweep", default="", metavar="GRID",
+                        help="train-bench grid sweep (ISSUE 7): semicolon-"
+                        "separated axes of ModelConfig knobs (plus the "
+                        "special batch/seq axes), cross-producted, e.g. "
+                        "'flash_block_q=512,1024;remat=dots,dots_inputs'. "
+                        "One resumable record per cell lands in --sweep-out; "
+                        "diff two sweeps with python -m "
+                        "ditl_tpu.telemetry.perf_compare")
+    parser.add_argument("--sweep-out", default="sweep.json", metavar="PATH",
+                        help="versioned sweep-record JSON for --sweep "
+                        "(existing cells at the same schema are skipped — "
+                        "a killed sweep resumes where it died)")
     parser.add_argument("--batch", type=int, default=0,
                         help="train-bench batch override (0 = config default)")
     parser.add_argument("--seq", type=int, default=0,
@@ -1078,6 +1302,9 @@ if __name__ == "__main__":
         parser.error("--override/--batch/--seq sweep the TRAIN bench only; "
                      "the serving bench has its own knobs (--slots, "
                      "--decode-chunk, --prompt-len, --max-new, ...)")
+    if args.sweep and args.infer:
+        parser.error("--sweep is a TRAIN-bench grid (the serving bench has "
+                     "its own knobs)")
     if args.spec_draft and (not args.speculative
                             or args.engine != "continuous"):
         # Validate HERE, not after bench_infer's expensive fine-tune has
@@ -1106,6 +1333,13 @@ if __name__ == "__main__":
             temperature=args.temperature, guided=args.guided,
             spec_draft=args.spec_draft, pipeline=args.pipeline,
             admission=args.admission, pages=args.pages,
+            compile_cache_dir=args.compile_cache_dir,
+        ))
+    if args.sweep:
+        sys.exit(run_sweep(
+            args.model, args.sweep, args.sweep_out,
+            overrides=args.override, batch_override=args.batch,
+            seq_override=args.seq,
             compile_cache_dir=args.compile_cache_dir,
         ))
     sys.exit(main(args.model, overrides=args.override,

@@ -552,6 +552,15 @@ class TelemetryConfig:
     # must not grow its journal without bound. Total footprint stays
     # ~this cap (telemetry/journal.py keeps the newest segments only).
     journal_max_mb: float = 0.0
+    # HBM accounting (telemetry/memwatch.py, ISSUE 7): sample per-device
+    # allocator stats whenever the step counter crosses a multiple of N
+    # (host-only reads, zero device syncs; with steps_per_call > 1 that is
+    # at most once per window; 0 disables sampling). Backends without
+    # memory_stats (CPU) degrade to no gauges, never a crash.
+    memory_sample_every: int = 1
+    # How many live buffers the OOM post-mortem dump records
+    # (shape/dtype/sharding/nbytes, largest first).
+    memory_topk: int = 8
     # Server (replica) SLOs: TTFT / TPOT latency objectives over the
     # engine's harvest-observed histograms, plus availability.
     slo_ttft_s: float = 2.5
@@ -574,6 +583,15 @@ class TelemetryConfig:
             raise ValueError(
                 f"telemetry.journal_max_mb must be >= 0 (0 = unbounded), "
                 f"got {self.journal_max_mb}"
+            )
+        if self.memory_sample_every < 0:
+            raise ValueError(
+                f"telemetry.memory_sample_every must be >= 0 (0 = off), "
+                f"got {self.memory_sample_every}"
+            )
+        if self.memory_topk < 1:
+            raise ValueError(
+                f"telemetry.memory_topk must be >= 1, got {self.memory_topk}"
             )
         for name in ("slo_ttft_target", "slo_tpot_target",
                      "slo_availability_target", "slo_gateway_e2e_target"):

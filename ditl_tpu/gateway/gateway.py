@@ -284,6 +284,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 # as the monitor's sample tick.
                 self.slo.report()
             body = (self.gw.render(self.fleet)
+                    + self._replica_memory_section()
                     + f"\n# TYPE {PREFIX}_up gauge\n{PREFIX}_up 1\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -301,6 +302,54 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self._proxy_get("/v1/models")
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _replica_memory_section(self) -> str:
+        """Fleet HBM view (ISSUE 7): each routable replica's
+        ``ditl_memory_*`` gauges, re-namespaced per replica
+        (``ditl_memory_<rid>_device0_bytes_in_use``) so the fleet's memory
+        headroom is scrapable from ONE endpoint. Replicas are fetched
+        CONCURRENTLY with one shared deadline (~probe_timeout_s for the
+        whole section, not per replica — N slow replicas must not push the
+        gateway scrape past Prometheus's own timeout); a slow or dead
+        replica costs one skipped section, never a wedged scrape. CPU
+        replicas contribute nothing (no ditl_memory_* lines to filter)."""
+        views = self.fleet.routable()
+        if not views:
+            return ""
+
+        def fetch(view):
+            with urllib.request.urlopen(
+                f"http://{view.address[0]}:{view.address[1]}/metrics",
+                timeout=self.gwcfg.probe_timeout_s,
+            ) as resp:
+                return resp.read().decode("utf-8", "replace")
+
+        out: list[str] = []
+        # No context manager: `with` would shutdown(wait=True) and block on
+        # running fetches Future.cancel() cannot stop — a dribbling replica
+        # would wedge the scrape past the deadline anyway. shutdown with
+        # wait=False abandons stragglers (their threads die at their own
+        # socket timeouts) so the SECTION returns at the shared deadline.
+        pool = ThreadPoolExecutor(max_workers=min(8, len(views)))
+        try:
+            futures = {pool.submit(fetch, v): v for v in views}
+            done, _ = wait(futures, timeout=self.gwcfg.probe_timeout_s)
+            for f in done:
+                try:
+                    text = f.result()
+                except (urllib.error.URLError, OSError, ValueError):
+                    continue
+                rid = sanitize_label(futures[f].id)
+                for line in text.splitlines():
+                    # Matches both samples and their # TYPE/# HELP metadata
+                    # (the family name follows the directive keyword).
+                    if "ditl_memory_" in line.split("{", 1)[0]:
+                        out.append(line.replace(
+                            "ditl_memory_", f"ditl_memory_{rid}_"
+                        ))
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+        return ("\n" + "\n".join(out)) if out else ""
 
     def _proxy_get(self, path: str) -> None:
         for view in self.fleet.routable():

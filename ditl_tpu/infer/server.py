@@ -474,6 +474,12 @@ class _Handler(BaseHTTPRequestHandler):
             # strings (engine/cache_mode names) have no gauge form; skip
 
         emit("", stats)
+        # HBM accounting (telemetry/memwatch.py, ISSUE 7): per-device
+        # allocator gauges (bytes in use, high-watermark, limit) sampled at
+        # scrape time — absent (not zero) on backends without memory stats.
+        from ditl_tpu.telemetry.memwatch import memory_metrics_lines
+
+        lines.extend(memory_metrics_lines())
         lines.append("# TYPE ditl_serving_up gauge")
         lines.append("ditl_serving_up 1")
         body = ("\n".join(lines) + "\n").encode()

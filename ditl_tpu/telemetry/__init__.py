@@ -1,15 +1,29 @@
-"""Unified telemetry subsystem (ISSUE 3 + 6): process-local metrics
+"""Unified telemetry subsystem (ISSUE 3 + 6 + 7): process-local metrics
 registry (registry.py), serving instrument bundle (serving.py),
 goodput/badput accounting (goodput.py), the cross-process JSONL event
 journal (journal.py), end-to-end request tracing (tracing.py), Chrome-trace
-export (trace_export.py), and SLO burn-rate monitoring (slo.py). Host-only
-by design — importing this package never touches jax, and no instrument
-accepts a device value."""
+export (trace_export.py), SLO burn-rate monitoring (slo.py), the training
+performance observatory (perf.py: step-time anatomy, roofline cost
+analysis, versioned sweep records; perf_compare.py: the regression gate),
+and HBM accounting (memwatch.py). Host-only by design — importing this
+package never touches jax (memwatch imports it lazily inside functions),
+and no instrument accepts a device value."""
 
 from ditl_tpu.telemetry.goodput import (
     BADPUT_BUCKETS,
     GoodputTracker,
     lost_work_from_journal,
+)
+from ditl_tpu.telemetry.memwatch import MemoryWatcher, live_buffer_topk
+from ditl_tpu.telemetry.perf import (
+    ANATOMY_BUCKETS,
+    SWEEP_SCHEMA,
+    StepAnatomy,
+    compiled_cost,
+    load_sweep_record,
+    new_sweep_record,
+    record_sweep_cell,
+    roofline,
 )
 from ditl_tpu.telemetry.journal import (
     EventJournal,
@@ -45,6 +59,7 @@ from ditl_tpu.telemetry.tracing import (
 )
 
 __all__ = [
+    "ANATOMY_BUCKETS",
     "BADPUT_BUCKETS",
     "BurnRateMonitor",
     "Counter",
@@ -53,22 +68,31 @@ __all__ = [
     "GoodputTracker",
     "Histogram",
     "LATENCY_BUCKETS_S",
+    "MemoryWatcher",
     "MetricsRegistry",
     "NULL_TRACER",
     "Objective",
+    "SWEEP_SCHEMA",
     "ServingMetrics",
     "Span",
     "SpanContext",
+    "StepAnatomy",
     "TOKEN_LATENCY_BUCKETS_S",
     "Tracer",
+    "compiled_cost",
     "controller_journal_path",
     "format_traceparent",
     "gateway_slo",
+    "live_buffer_topk",
+    "load_sweep_record",
     "lost_work_from_journal",
     "merge_journals",
     "new_request_id",
+    "new_sweep_record",
     "parse_traceparent",
     "read_journal",
+    "record_sweep_cell",
+    "roofline",
     "serving_slo",
     "worker_journal_path",
     "write_pod_timeline",

@@ -1,0 +1,224 @@
+"""HBM accounting (ISSUE 7 tentpole leg 2).
+
+Per-device memory gauges from ``device.memory_stats()`` — allocator-level
+host reads, zero device syncs — plus a live-buffer top-k dump journaled
+when an OOM-class allocation failure unwinds through the training loop.
+
+Degradation contract (tier-1-tested): backends without memory stats (CPU,
+some plugin runtimes return ``None`` or lack the method entirely) produce
+**no gauges and no crash** — the ``ditl_memory_*`` families are simply
+absent from /metrics, never zero-valued lies.
+
+Unlike the rest of telemetry/ this module is *about* the device, so its
+functions import jax lazily — importing the module (or the telemetry
+package) still never touches jax, preserving the package contract that the
+jax-free gateway relies on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any
+
+from ditl_tpu.telemetry.registry import MetricsRegistry
+
+__all__ = [
+    "PREFIX",
+    "MemoryWatcher",
+    "device_memory_stats",
+    "live_buffer_topk",
+    "is_oom_error",
+    "memory_metrics_lines",
+]
+
+PREFIX = "ditl_memory"
+
+# The allocator-stat keys worth exposing, when present.
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_alloc_size")
+
+# Substrings that identify an allocation failure across jax/XLA spellings
+# (XlaRuntimeError RESOURCE_EXHAUSTED, Mosaic/TPU "out of memory" variants).
+# "oom" is matched as a whole word separately (below): the substring would
+# false-positive on "zoom"/"bloom"-class messages.
+_OOM_MARKERS = (
+    "resource_exhausted", "resource exhausted", "out of memory",
+    "failed to allocate", "allocation failure", "exceeds the memory",
+)
+
+
+def device_memory_stats(device: Any) -> dict[str, float] | None:
+    """``device.memory_stats()`` filtered to the exposed keys; None when
+    the backend has no stats (absent method, None return, or a raising
+    plugin) — the caller's signal to emit nothing."""
+    fn = getattr(device, "memory_stats", None)
+    if fn is None:
+        return None
+    try:
+        stats = fn()
+    except Exception:  # noqa: BLE001 - plugin backends; advisory telemetry
+        return None
+    if not isinstance(stats, dict):
+        return None
+    out = {k: float(stats[k]) for k in _STAT_KEYS if k in stats}
+    return out or None
+
+
+def live_buffer_topk(k: int = 8) -> dict:
+    """The ``k`` largest live device buffers (shape/dtype/sharding/nbytes)
+    plus the totals — the "what is actually holding HBM" answer an OOM
+    post-mortem starts with. Host-only reads of buffer metadata; the
+    arrays' bytes are never touched."""
+    import jax
+
+    arrays = [a for a in jax.live_arrays() if not getattr(a, "is_deleted",
+                                                          lambda: False)()]
+    infos = []
+    total = 0
+    for a in arrays:
+        try:
+            nbytes = int(a.nbytes)
+            infos.append({
+                "shape": list(a.shape),
+                "dtype": str(a.dtype),
+                "nbytes": nbytes,
+                "sharding": _sharding_str(a),
+            })
+            total += nbytes
+        except Exception:  # noqa: BLE001 - deleted/donated mid-walk
+            continue
+    infos.sort(key=lambda i: i["nbytes"], reverse=True)
+    return {
+        "n_live_buffers": len(infos),
+        "live_bytes_total": total,
+        "top": infos[: max(1, k)],
+    }
+
+
+def _sharding_str(a: Any) -> str:
+    try:
+        sh = a.sharding
+        spec = getattr(sh, "spec", None)
+        if spec is not None:
+            return f"{type(sh).__name__}{tuple(spec)}"
+        return type(sh).__name__
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """True for OOM-class allocation failures — matched on the message and
+    type name, since jaxlib's XlaRuntimeError carries the status code only
+    in text form."""
+    import re
+
+    text = f"{type(exc).__name__}: {exc}".lower()
+    if any(m in text for m in _OOM_MARKERS):
+        return True
+    return re.search(r"\boom\b", text) is not None
+
+
+class MemoryWatcher:
+    """Sampled HBM gauges + OOM dump hook for one process's devices.
+
+    ``sample()`` refreshes per-device ``ditl_memory_device{i}_*`` gauges
+    (and a local high-watermark that survives allocator counter resets);
+    ``guard()`` wraps device work and journals a ``memory.oom_dump`` event
+    — top-k live buffers with shapes and shardings, plus the last sampled
+    stats — before re-raising an OOM-class failure. Everything degrades to
+    a silent no-op when the backend exposes no stats."""
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 journal=None, topk: int = 8):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.journal = journal
+        self.topk = topk
+        self._peaks: dict[int, float] = {}
+        self._last: dict[int, dict[str, float]] = {}
+        self.available: bool | None = None  # None until the first sample
+
+    def sample(self, devices=None) -> dict[int, dict[str, float]]:
+        """Read every device's allocator stats and refresh the gauges.
+        Returns ``{device_index: stats}`` (empty on statless backends)."""
+        if devices is None:
+            import jax
+
+            devices = jax.local_devices()
+        out: dict[int, dict[str, float]] = {}
+        for i, d in enumerate(devices):
+            stats = device_memory_stats(d)
+            if stats is None:
+                continue
+            in_use = stats.get("bytes_in_use", 0.0)
+            peak = max(self._peaks.get(i, 0.0),
+                       stats.get("peak_bytes_in_use", 0.0), in_use)
+            self._peaks[i] = peak
+            stats["peak_bytes_in_use"] = peak
+            for key, v in stats.items():
+                self.registry.gauge(
+                    f"{PREFIX}_device{i}_{key}",
+                    f"device {i} allocator {key}",
+                ).set(v)
+            out[i] = stats
+        self.available = bool(out)
+        self._last = out
+        return out
+
+    def report(self) -> dict:
+        """Summary for bench/trainer JSON: per-device last sample +
+        high-watermark + utilization; ``{}`` on statless backends (the
+        absent-not-zero rule)."""
+        out: dict = {}
+        for i, stats in sorted(self._last.items()):
+            row = {k: int(v) for k, v in stats.items()}
+            limit = stats.get("bytes_limit", 0.0)
+            if limit > 0:
+                row["peak_utilization"] = round(self._peaks[i] / limit, 4)
+            out[f"device{i}"] = row
+        return out
+
+    def oom_dump(self, exc: BaseException | None = None) -> dict:
+        """Build (and journal, when armed) the OOM post-mortem record."""
+        dump = live_buffer_topk(self.topk)
+        if exc is not None:
+            dump["error"] = f"{type(exc).__name__}: {str(exc)[:500]}"
+        if self._last:
+            dump["device_stats"] = {
+                f"device{i}": {k: int(v) for k, v in s.items()}
+                for i, s in sorted(self._last.items())
+            }
+        if self.journal is not None:
+            self.journal.event("memory.oom_dump", **dump)
+        return dump
+
+    @contextlib.contextmanager
+    def guard(self):
+        """Re-raise everything; journal the top-k live-buffer dump first
+        when the failure is OOM-class. The dump runs before the exception
+        unwinds frames holding array references, so the buffer list still
+        shows the step's working set."""
+        try:
+            yield
+        except Exception as e:  # noqa: BLE001 - classify, dump, re-raise
+            if is_oom_error(e):
+                with contextlib.suppress(Exception):
+                    self.oom_dump(e)
+            raise
+
+
+# Module-level watcher for the serving path: infer/server.py appends these
+# lines to /metrics. One sample per scrape (allocator reads are cheap), and
+# the scrape never breaks on a statless backend.
+_scrape_watcher: MemoryWatcher | None = None
+
+
+def memory_metrics_lines() -> list[str]:
+    global _scrape_watcher
+    try:
+        if _scrape_watcher is None:
+            _scrape_watcher = MemoryWatcher()
+        if not _scrape_watcher.sample():
+            return []
+        return _scrape_watcher.registry.render().splitlines()
+    except Exception:  # noqa: BLE001 - /metrics must never 500 over gauges
+        return []

@@ -1,0 +1,194 @@
+"""Bench/sweep regression gate (ISSUE 7 tentpole leg 3).
+
+    python -m ditl_tpu.telemetry.perf_compare old.json new.json \
+        [--threshold 0.05]
+
+Diffs two performance records — either two single bench rows (``bench.py``'s
+one-JSON-line output, saved to a file) or two versioned sweep records
+(``bench.py --sweep`` / ``experiments/bwd_kernels.py``) — metric by metric
+against a relative threshold, and **exits nonzero on regression**. This is
+the gate every MFU-push PR runs against the previous round's record: a
+lever that silently lost throughput fails CI instead of shipping.
+
+Comparison rules:
+
+- Each known metric has a direction: throughput/MFU regress when they FALL,
+  step time regresses when it RISES. Unknown keys are ignored (records may
+  grow fields without breaking old gates).
+- Sweep records compare cell-by-cell on the cell key (the dotted-override
+  spec), so only identical configurations are ever diffed; cells present
+  only on one side are reported but do not gate (a grown grid is not a
+  regression).
+- Mismatched schema versions or record shapes are a usage error (exit 2),
+  never a silent pass.
+
+Exit codes: 0 = within thresholds, 1 = regression, 2 = usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ditl_tpu.telemetry.perf import SWEEP_SCHEMA
+
+__all__ = ["compare_metrics", "compare_records", "main"]
+
+# Metric -> direction: +1 = higher is better (regression when it falls),
+# -1 = lower is better (regression when it rises).
+COMPARE_KEYS = {
+    "value": +1,  # bench headline (tokens/sec[/chip])
+    "tokens_per_sec_per_chip": +1,
+    "mfu": +1,
+    "mfu_cost": +1,
+    "roofline_mfu_cap": 0,  # informational: config property, never gates
+    "step_time_p50_ms": -1,
+    "step_ms": -1,
+}
+
+
+def _flat(rec: dict) -> dict:
+    """The comparable view of one record/cell: top-level keys plus the
+    nested ``roofline`` block hoisted (mfu_cost / roofline_mfu_cap live
+    there in bench rows — without the hoist the gate would silently never
+    compare cost-counted MFU)."""
+    nested = rec.get("roofline")
+    if isinstance(nested, dict):
+        return {**nested, **rec}
+    return rec
+
+
+def compare_metrics(
+    old: dict, new: dict, threshold: float, label: str
+) -> tuple[list[str], list[str]]:
+    """(report lines, regression lines) for one old/new metric-dict pair.
+    A record that went from measured to errored is itself a regression —
+    a config that now crashes must not pass the gate because it has no
+    numbers to compare."""
+    lines: list[str] = []
+    regressions: list[str] = []
+    old, new = _flat(old), _flat(new)
+    if new.get("error") and not old.get("error"):
+        msg = (f"{label}previously measured, now fails: "
+               f"{str(new['error'])[:200]}")
+        lines.append(f"  {msg} REGRESSION")
+        regressions.append(msg)
+        return lines, regressions
+    if old.get("error"):
+        state = "still failing" if new.get("error") else "now measured"
+        lines.append(f"  {label}old record errored ({state}; not gated)")
+        return lines, regressions
+    for key, direction in COMPARE_KEYS.items():
+        a, b = old.get(key), new.get(key)
+        if not isinstance(a, (int, float)) or not isinstance(b, (int, float)):
+            continue
+        if a == 0:
+            continue
+        rel = (b - a) / abs(a)
+        # Signed "improvement" in the metric's own direction.
+        gain = rel * direction
+        verdict = "ok"
+        if direction != 0 and gain < -threshold:
+            verdict = "REGRESSION"
+            regressions.append(
+                f"{label}{key}: {a:g} -> {b:g} ({rel:+.1%}, threshold "
+                f"{threshold:.0%})"
+            )
+        lines.append(f"  {label}{key}: {a:g} -> {b:g} ({rel:+.1%}) {verdict}")
+    return lines, regressions
+
+
+def _is_sweep(rec: dict) -> bool:
+    return isinstance(rec.get("cells"), dict)
+
+
+def compare_records(old: dict, new: dict, threshold: float) -> tuple[int, str]:
+    """(exit code, human report). Accepts two bench rows or two sweep
+    records; mixing shapes is a usage error."""
+    out: list[str] = []
+    regressions: list[str] = []
+    if _is_sweep(old) != _is_sweep(new):
+        return 2, "error: cannot compare a sweep record with a bench row"
+    for side, rec in (("old", old), ("new", new)):
+        schema = rec.get("schema")
+        if schema is not None and schema != SWEEP_SCHEMA:
+            return 2, (
+                f"error: {side} record has schema {schema!r}; this tool "
+                f"understands schema {SWEEP_SCHEMA}"
+            )
+    if _is_sweep(old):
+        old_cells, new_cells = old["cells"], new["cells"]
+        common = [k for k in old_cells if k in new_cells]
+        if not common:
+            return 2, "error: the two sweep records share no cells"
+        for side, only in (
+            ("old", sorted(set(old_cells) - set(new_cells))),
+            ("new", sorted(set(new_cells) - set(old_cells))),
+        ):
+            for k in only:
+                out.append(f"  [{k}] only in {side} record (not gated)")
+        for k in sorted(common):
+            lines, regs = compare_metrics(
+                old_cells[k], new_cells[k], threshold, f"[{k}] "
+            )
+            out.extend(lines)
+            regressions.extend(regs)
+    else:
+        m_old, m_new = old.get("metric"), new.get("metric")
+        if m_old != m_new:
+            out.append(
+                f"  warning: metric labels differ ({m_old!r} vs {m_new!r}) "
+                "— comparing anyway; make sure the configs match"
+            )
+        lines, regs = compare_metrics(old, new, threshold, "")
+        out.extend(lines)
+        regressions.extend(regs)
+        if not lines:
+            return 2, "error: no comparable numeric metrics in the records"
+    if regressions:
+        out.append("")
+        out.append(f"FAIL: {len(regressions)} regression(s)")
+        out.extend(f"  {r}" for r in regressions)
+        return 1, "\n".join(out)
+    out.append("")
+    out.append(f"PASS: no metric regressed past {threshold:.0%}")
+    return 0, "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ditl_tpu.telemetry.perf_compare",
+        description="diff two bench/sweep JSON records; exit 1 on regression",
+    )
+    parser.add_argument("old", help="baseline record (bench row or sweep JSON)")
+    parser.add_argument("new", help="candidate record to gate")
+    parser.add_argument(
+        "--threshold", type=float, default=0.05,
+        help="relative regression threshold (default 0.05 = 5%%)",
+    )
+    args = parser.parse_args(argv)
+    if not 0 < args.threshold < 1:
+        print(f"error: --threshold must be in (0, 1), got {args.threshold}",
+              file=sys.stderr)
+        return 2
+    records = []
+    for path in (args.old, args.new):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+        if not isinstance(rec, dict):
+            print(f"error: {path} is not a JSON object", file=sys.stderr)
+            return 2
+        records.append(rec)
+    code, report = compare_records(records[0], records[1], args.threshold)
+    print(f"perf_compare: {args.old} -> {args.new}")
+    print(report)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

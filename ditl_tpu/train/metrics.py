@@ -39,15 +39,23 @@ class MetricsLogger:
         log_every: int = 10,
         n_chips: int | None = None,
         metrics_file: str = "",
+        anatomy=None,
     ):
         """``metrics_file``: optional coordinator-only JSONL scalar stream
         (one object per STEP WINDOW — every pending entry is written at each
         flush, not just the newest; the flush used to drop all interior
         steps of a log_every window, ISSUE 3 satellite) — the
         TensorBoard-scalar equivalent without a TF dependency; any dashboard
-        can tail it."""
+        can tail it.
+
+        ``anatomy``: optional ``telemetry.perf.StepAnatomy`` fed from the
+        same phase clocks this logger already keeps (ISSUE 7): ``data_wait``
+        and ``host_dispatch`` at each end_step, ``device_compute`` at each
+        flush sync — the trainer adds the matching wall spans and the
+        checkpoint bucket."""
         import jax
 
+        self.anatomy = anatomy
         self.log_every = max(1, log_every)
         self.n_chips = n_chips if n_chips is not None else jax.device_count()
         self.step_times: list[float] = []
@@ -68,22 +76,33 @@ class MetricsLogger:
 
     def end_step(
         self, step: int, device_metrics: Any, n_steps: int = 1,
-        data_wait_s: float = 0.0,
+        data_wait_s: float = 0.0, excluded_s: float = 0.0,
     ) -> None:
         """Record wall time; stash device metrics without forcing a sync.
         ``n_steps > 1`` when one call ran a whole compiled step window
         (train/step.make_multi_step): wall time is divided per step, and
         ``device_metrics['n_tokens']`` is expected to cover the window.
         ``data_wait_s``: host time spent waiting on the data pipeline for
-        this window (phase breakdown column)."""
+        this window (phase breakdown column). ``excluded_s``: wall inside
+        the start/end interval that belongs to another accounting bucket
+        (the trainer passes its measured profiler work) — subtracted from
+        the ANATOMY's host_dispatch feed so conservation against the
+        profiler-excluded wall holds; the phase columns keep the historical
+        full-interval semantics."""
         now = time.perf_counter()
         dt = None
         if self._last_t is not None:
             dt = (now - self._last_t) / max(1, n_steps)
             self.step_times.append(dt)
             self.dispatch_s += now - self._last_t
+            if self.anatomy is not None:
+                self.anatomy.add(
+                    "host_dispatch", now - self._last_t - excluded_s
+                )
         self._last_t = None
         self.data_wait_s += data_wait_s
+        if self.anatomy is not None:
+            self.anatomy.add("data_wait", data_wait_s)
         self._pending.append(
             (step, device_metrics, max(1, n_steps), dt, data_wait_s)
         )
@@ -103,6 +122,8 @@ class MetricsLogger:
         host_all = jax.device_get([m for _, m, _, _, _ in self._pending])
         sync_s = time.perf_counter() - t0
         self.sync_s += sync_s
+        if self.anatomy is not None:
+            self.anatomy.add("device_compute", sync_s)
         last_i = len(self._pending) - 1
         for i, (step, _, n_steps, dt, data_wait_s) in enumerate(self._pending):
             host = {k: float(v) for k, v in host_all[i].items()}

@@ -39,6 +39,8 @@ from ditl_tpu.runtime.mesh import build_mesh
 from ditl_tpu.telemetry import (
     EventJournal,
     GoodputTracker,
+    MemoryWatcher,
+    StepAnatomy,
     Tracer,
     lost_work_from_journal,
     read_journal,
@@ -350,6 +352,18 @@ def train(config: Config) -> dict[str, Any]:
         else None
     )
 
+    # Step-time anatomy (telemetry/perf.py, ISSUE 7): the per-step wall
+    # decomposition the goodput report is too coarse for. Attached to the
+    # MetricsLogger AFTER the compile window (goodput attributes that whole
+    # window to compile; anatomy describes warm steps only) and conserved
+    # against the independently measured step-path wall to 5% in tier-1.
+    anatomy = StepAnatomy()
+    # HBM accounting (telemetry/memwatch.py): per-window allocator samples
+    # (high-watermark gauges) + a journaled live-buffer top-k dump when an
+    # OOM-class failure unwinds the loop. No-op on statless backends (CPU).
+    memwatch = MemoryWatcher(
+        journal=journal, topk=config.telemetry.memory_topk,
+    )
     metrics = MetricsLogger(
         log_every=config.train.log_every,
         metrics_file=config.train.metrics_file,
@@ -445,6 +459,12 @@ def train(config: Config) -> dict[str, Any]:
                 metrics.end_step(
                     global_step - 1, window_metrics, n_steps=len(window),
                     data_wait_s=window_wait,
+                    # Profiler work inside the window interval has its own
+                    # goodput bucket AND is subtracted from the anatomy
+                    # wall below — exclude it from the anatomy's dispatch
+                    # feed too, or a capture window would break the 5%
+                    # conservation invariant.
+                    excluded_s=prof_s,
                 )
                 # Window wall (dispatch + any flush sync inside end_step;
                 # data wait happened before the window body, profiler work
@@ -457,8 +477,18 @@ def train(config: Config) -> dict[str, Any]:
                 if first_window:
                     tracker.add("compile", dt_window)
                     first_window = False
+                    # Anatomy starts AFTER the compile window: from here on
+                    # the MetricsLogger feeds host_dispatch / data_wait /
+                    # device_compute and the trainer adds the matching wall.
+                    metrics.anatomy = anatomy
                 else:
                     tracker.add_step(dt_window, len(window))
+                    anatomy.add_wall(window_wait + dt_window, len(window))
+                if config.telemetry.memory_sample_every and _crossed(
+                    global_step, len(window),
+                    config.telemetry.memory_sample_every,
+                ):
+                    memwatch.sample()
                 if journal is not None and _crossed(
                     global_step, len(window), config.train.log_every
                 ):
@@ -466,8 +496,15 @@ def train(config: Config) -> dict[str, Any]:
                 beat(global_step)
                 position = DataIterState(epoch, step_in_epoch, global_step)
                 if ckpt is not None and ckpt.should_save(global_step, len(window)):
+                    t_ck0 = time.perf_counter()
                     with tracker.span("checkpoint_save"):
                         ckpt.save(global_step, state, position)
+                    dt_ck = time.perf_counter() - t_ck0
+                    # The blocking portion of the async save interleaves the
+                    # step stream — the anatomy's checkpoint_overlap bucket
+                    # (the async remainder overlaps device compute for free).
+                    anatomy.add("checkpoint_overlap", dt_ck)
+                    anatomy.add_wall(dt_ck)
                     if journal is not None:
                         journal.event("checkpoint.save", step=global_step)
                     last_saved = global_step
@@ -537,13 +574,33 @@ def train(config: Config) -> dict[str, Any]:
                     )
             if global_step >= total_steps:
                 break
+        # The catch-up flush after the loop blocks on the last window's
+        # device work — step-path wall like any in-loop flush, so the
+        # anatomy counts the interval (its sync feeds device_compute via
+        # the logger hook) and conservation holds.
+        t_flush0 = time.perf_counter()
         metrics.flush()
+        anatomy.add_wall(time.perf_counter() - t_flush0)
         if ckpt is not None and last_saved != global_step:
             with tracker.span("checkpoint_save"):
                 ckpt.save(global_step, state, DataIterState(epoch, 0, global_step))
                 ckpt.wait()
             if journal is not None:
                 journal.event("checkpoint.save", step=global_step)
+    except Exception as e:
+        # OOM post-mortem (ISSUE 7): journal the live-buffer top-k dump
+        # BEFORE the finally teardown releases the step's working set, so
+        # the record shows what was actually holding HBM. Non-OOM failures
+        # pass through untouched.
+        from ditl_tpu.telemetry.memwatch import is_oom_error
+
+        if is_oom_error(e):
+            import contextlib as _ctx
+
+            with _ctx.suppress(Exception):
+                memwatch.sample()
+                memwatch.oom_dump(e)
+        raise
     finally:
         metrics.close()
         with tracker.span("profiler"):
@@ -569,8 +626,16 @@ def train(config: Config) -> dict[str, Any]:
     # Goodput report: where the wall clock went, conservation-checked (the
     # tier-1 test asserts buckets + other sum to total within 1%).
     summary["goodput"] = tracker.report()
+    # Step-time anatomy (ISSUE 7): the warm-step wall decomposed into
+    # data-wait / host-dispatch / device-compute / checkpoint-overlap,
+    # conservation-checked against the measured step-path wall to 5%.
+    summary["step_anatomy"] = anatomy.report()
+    mem = memwatch.report()
+    if mem:
+        summary["memory"] = mem
     if is_coordinator():
         logger.info("training done: %s", summary)
         logger.info("goodput report: %s", summary["goodput"])
+        logger.info("step anatomy: %s", summary["step_anatomy"])
     shutdown_runtime()
     return summary

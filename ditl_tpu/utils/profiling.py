@@ -75,13 +75,39 @@ class StepProfiler:
                 step, self._stop_after, self.directory,
             )
 
+    def _trace_bytes(self) -> int:
+        """Total bytes of trace artifacts under ``directory`` — the size of
+        what this capture wrote to disk (xplane.pb + json sidecars)."""
+        import os
+
+        total = 0
+        try:
+            for root, _dirs, files in os.walk(self.directory):
+                for name in files:
+                    try:
+                        total += os.path.getsize(os.path.join(root, name))
+                    except OSError:
+                        continue
+        except OSError:
+            pass
+        return total
+
     def _record_span(self, last_step: int, partial: bool) -> None:
+        """ISSUE 7 satellite: the span carries the capture's measured wall
+        (``capture_s`` — start_trace through the trace write; the goodput
+        ``profiler`` bucket the trainer tracks covers the same interval, so
+        the overhead is attributable instead of vanishing into ``other``)
+        and the on-disk trace size (``trace_bytes``)."""
         if self._tracer is None or not getattr(self._tracer, "armed", False):
             return
+        import time as _time
+
         self._tracer.start_span(
             "profiler.capture", t0=self._span_t0,
             start_step=self._window_start, last_step=last_step,
             directory=self.directory, partial=partial,
+            capture_s=round(_time.time() - self._span_t0, 6),
+            trace_bytes=self._trace_bytes(),
         ).end()
 
     def annotate(self, step: int):

@@ -26,7 +26,13 @@ the flags off. Every leg prints the EFFECTIVE backward impls
 (bench._effective_bwd_impls) so a silent shape-fallback can never
 masquerade as a null.
 
-Usage: python experiments/bwd_kernels.py [chunk windows [sweep]]
+Every finished leg also lands as one cell in a versioned sweep record
+(telemetry/perf.py format, `--out=PATH`, default
+bwd_kernels_sweep.json) — ISSUE 7: the first real TPU session's numbers
+are `perf_compare`-diffable JSON, not scraped stdout; a killed session
+resumes at the first unrecorded leg.
+
+Usage: python experiments/bwd_kernels.py [chunk windows [sweep]] [--out=PATH]
 """
 
 from __future__ import annotations
@@ -51,6 +57,8 @@ from ditl_tpu.train.step import make_multi_step
 
 def time_step_leg(name, cfg, mesh, tcfg, window, example, chunk, n_windows,
                   batch, seq):
+    """Returns the leg's cell record (telemetry/perf.py sweep-cell shape:
+    ``step_ms`` is the key perf_compare gates on) or None on failure."""
     try:
         eff = bench._effective_bwd_impls(cfg, batch, seq, mesh)
         t0 = time.perf_counter()
@@ -73,16 +81,27 @@ def time_step_leg(name, cfg, mesh, tcfg, window, example, chunk, n_windows,
               f"{[f'{t:.1f}' for t in times]}, compile {compile_s:.0f}s, "
               f"bwd_impl={eff})", flush=True)
         del state
-        return ms
+        return {
+            "step_ms": round(ms, 2),
+            "window_ms": [round(t, 2) for t in times],
+            "compile_s": round(compile_s, 1),
+            "bwd_impl": eff,
+        }
     except Exception as e:  # noqa: BLE001
         print(f"LEG {name}: FAILED {type(e).__name__}: {e}", flush=True)
-        return None
+        # Recorded as an error cell: perf_compare gates measured->crashing,
+        # and a resumed session retries it (telemetry/perf.py semantics).
+        return {"error": f"{type(e).__name__}: {str(e)[:500]}"}
 
 
 def main():
-    chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 10
-    n_windows = int(sys.argv[2]) if len(sys.argv) > 2 else 3
-    sweep = len(sys.argv) > 3 and sys.argv[3] == "sweep"
+    from ditl_tpu.telemetry.perf import pop_out_arg, run_recorded_cells
+
+    args = list(sys.argv[1:])
+    out_path = pop_out_arg(args, "bwd_kernels_sweep.json")
+    chunk = int(args[0]) if len(args) > 0 else 10
+    n_windows = int(args[1]) if len(args) > 1 else 3
+    sweep = len(args) > 2 and args[2] == "sweep"
     platform = jax.devices()[0].platform
     print(f"platform={platform}", file=sys.stderr)
 
@@ -133,17 +152,29 @@ def main():
                 dataclasses.replace(cfg, proj_bwd_impl="pallas",
                                     proj_bwd_block_n=bn),
             ))
-    results = {}
-    for name, leg_cfg in legs:
-        ms = time_step_leg(name, leg_cfg, mesh, tcfg, window, example,
-                           chunk, n_windows, batch, seq)
-        if ms is not None:
-            results[name] = ms
+    # Record-as-you-go sweep cells (telemetry/perf.py): a killed session
+    # reruns only unrecorded/errored legs. Mind the adjacency rigor — a
+    # resumed base_again brackets a DIFFERENT session than its base; rerun
+    # from scratch with a fresh --out when that matters.
+    cells = run_recorded_cells(
+        out_path, "bwd_kernels",
+        meta={"platform": platform, "chunk": chunk, "n_windows": n_windows,
+              "batch": batch, "seq": seq, "model": "1b3"},
+        items=legs,
+        runner=lambda name, leg_cfg: time_step_leg(
+            name, leg_cfg, mesh, tcfg, window, example, chunk, n_windows,
+            batch, seq,
+        ),
+    )
+    results = {k: c["step_ms"] for k, c in cells.items() if "step_ms" in c}
     if "base" in results:
         for name, ms in results.items():
             if name != "base":
                 print(f"DELTA {name}: {ms - results['base']:+.1f} ms",
                       flush=True)
+    print(f"sweep record: {out_path} ({len(cells)} cell(s) this session); "
+          f"diff sessions with python -m ditl_tpu.telemetry.perf_compare",
+          flush=True)
 
 
 if __name__ == "__main__":

@@ -14,7 +14,11 @@ plus `iso`: k-differenced ISOLATED rates of the exact backward GEMM
 shapes (einsum over 8192 tokens, bf16) — only trustworthy on a quiet
 host (concurrent load corrupts the k-difference).
 
-Usage: python experiments/bwd_levers.py [chunk windows]
+Every finished leg lands as one cell in a versioned sweep record
+(telemetry/perf.py format, `--out=PATH`, default bwd_levers_sweep.json)
+so sessions are `perf_compare`-diffable (ISSUE 7).
+
+Usage: python experiments/bwd_levers.py [chunk windows] [--out=PATH]
 """
 
 from __future__ import annotations
@@ -39,6 +43,8 @@ from ditl_tpu.train.step import make_multi_step
 
 
 def time_step_leg(name, cfg, mesh, tcfg, window, example, chunk, n_windows):
+    """Returns the leg's sweep-cell record (telemetry/perf.py format;
+    ``step_ms`` is what perf_compare gates) or None on failure."""
     try:
         t0 = time.perf_counter()
         state = create_train_state(jax.random.key(0), cfg, tcfg)
@@ -60,10 +66,16 @@ def time_step_leg(name, cfg, mesh, tcfg, window, example, chunk, n_windows):
               f"{[f'{t:.1f}' for t in times]}, compile {compile_s:.0f}s)",
               flush=True)
         del state
-        return ms
+        return {
+            "step_ms": round(ms, 2),
+            "window_ms": [round(t, 2) for t in times],
+            "compile_s": round(compile_s, 1),
+        }
     except Exception as e:  # noqa: BLE001
         print(f"LEG {name}: FAILED {type(e).__name__}: {e}", flush=True)
-        return None
+        # Recorded as an error cell: perf_compare gates measured->crashing,
+        # and a resumed session retries it (telemetry/perf.py semantics).
+        return {"error": f"{type(e).__name__}: {str(e)[:500]}"}
 
 
 def iso_wgrad_rates():
@@ -122,8 +134,12 @@ def iso_wgrad_rates():
 
 
 def main():
-    chunk = int(sys.argv[1]) if len(sys.argv) > 1 else 10
-    n_windows = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    from ditl_tpu.telemetry.perf import pop_out_arg, run_recorded_cells
+
+    args = list(sys.argv[1:])
+    out_path = pop_out_arg(args, "bwd_levers_sweep.json")
+    chunk = int(args[0]) if len(args) > 0 else 10
+    n_windows = int(args[1]) if len(args) > 1 else 3
     platform = jax.devices()[0].platform
     print(f"platform={platform}", file=sys.stderr)
 
@@ -156,17 +172,24 @@ def main():
         ("custom_vjp", dataclasses.replace(cfg, mlp_custom_vjp=True)),
         ("base_again", cfg),
     ]
-    results = {}
-    for name, leg_cfg in legs:
-        ms = time_step_leg(name, leg_cfg, mesh, tcfg, window, example,
-                           chunk, n_windows)
-        if ms is not None:
-            results[name] = ms
+    cells = run_recorded_cells(
+        out_path, "bwd_levers",
+        meta={"platform": platform, "chunk": chunk,
+              "n_windows": n_windows, "model": "1b3"},
+        items=legs,
+        runner=lambda name, leg_cfg: time_step_leg(
+            name, leg_cfg, mesh, tcfg, window, example, chunk, n_windows,
+        ),
+    )
+    results = {k: c["step_ms"] for k, c in cells.items() if "step_ms" in c}
     if "base" in results:
         for name, ms in results.items():
             if name != "base":
                 print(f"DELTA {name}: {ms - results['base']:+.1f} ms",
                       flush=True)
+    print(f"sweep record: {out_path} ({len(cells)} cell(s) this session); "
+          f"diff sessions with python -m ditl_tpu.telemetry.perf_compare",
+          flush=True)
     if platform == "tpu":
         iso_wgrad_rates()
 

@@ -475,16 +475,22 @@ def _post(port, body, headers=None, timeout=120):
 
 
 def test_http_deadline_maps_to_504(served):
+    # Deadline 5 ms: positive (so it passes the server's instant-expiry
+    # check and reaches the ENGINE's eviction sweep) but far below the
+    # ~30 ticks the 60-token budget needs at decode_chunk=2 — the old
+    # 30 ms deadline sat exactly at a warm host's completion time, so a
+    # fast run legitimately finished inside the window and flaked this
+    # assert.
     _server, _threaded, engine, port = served
     before = engine.metrics.deadline_expired.value
     status, out = _post(port, {"prompt": "hello", "max_tokens": 60,
-                               "deadline_s": 0.03})
+                               "deadline_s": 0.005})
     assert status == 504, out
     assert out["error"]["type"] == "timeout_error"
     assert engine.metrics.deadline_expired.value >= before + 1
     # The gateway's header spelling reaches the same eviction path.
     status, out = _post(port, {"prompt": "hello", "max_tokens": 60},
-                        headers={"X-Request-Deadline-S": "0.03"})
+                        headers={"X-Request-Deadline-S": "0.005"})
     assert status == 504, out
     # Garbage deadline is a client error, already-expired is an instant 504.
     status, _ = _post(port, {"prompt": "x", "deadline_s": "soon"})

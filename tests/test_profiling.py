@@ -38,6 +38,43 @@ def test_step_profiler_disabled_is_noop(tmp_path):
             pass
         prof.maybe_stop(s)
     prof.close()
+
+
+def test_step_profiler_span_records_size_and_wall(tmp_path):
+    """ISSUE 7 satellite: the profiler.capture span carries the capture's
+    wall seconds and the on-disk trace size, so profiling overhead is
+    attributable on the timeline instead of vanishing into `other`."""
+    import json
+
+    from ditl_tpu.telemetry import EventJournal, Tracer
+
+    jpath = str(tmp_path / "events.jsonl")
+    journal = EventJournal(jpath, source="test")
+    prof = StepProfiler(
+        str(tmp_path / "trace"), start_step=0, num_steps=2,
+        tracer=Tracer(journal),
+    )
+
+    @jax.jit
+    def step(x):
+        return x @ x.T
+
+    x = jnp.ones((64, 64))
+    for s in range(2):
+        prof.maybe_start(s)
+        with prof.annotate(s):
+            x = step(x)
+        prof.maybe_stop(s)
+    x.block_until_ready()
+    journal.close()
+    recs = [json.loads(ln) for ln in open(jpath)]
+    spans = [r for r in recs if r.get("event") == "trace.span"
+             and r.get("name") == "profiler.capture"]
+    assert len(spans) == 1
+    span = spans[0]
+    assert span["trace_bytes"] > 0, span
+    assert span["capture_s"] > 0, span
+    assert span["partial"] is False
     assert not prof._active
 
 
