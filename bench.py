@@ -474,7 +474,13 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             if cache == "paged":
                 from ditl_tpu.infer.paged_cache import PageAllocator
 
-                eng.allocator = PageAllocator(eng.n_pages)
+                # Keep the eviction counter wired (ISSUE 8): the engine's
+                # constructor hooks it, and a bare replacement would
+                # silently zero evictions in the row's telemetry snapshot.
+                eng.allocator = PageAllocator(
+                    eng.n_pages,
+                    on_evict=eng.metrics.prefix_cache_evictions.inc,
+                )
                 eng._table[:] = 0
                 eng._slot_pages = [[] for _ in range(eng.n_slots)]
 
@@ -571,7 +577,9 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
                   prompt_len: int = 0, max_new: int = 0,
                   router: str = "affinity",
                   compile_cache_dir: str = "",
-                  trace_out: str = "") -> int:
+                  trace_out: str = "",
+                  prefill_chunk: int = -1,
+                  token_budget: int = -1) -> int:
     """Fleet-level serving benchmark (ISSUE 4 satellite): N in-process
     continuous-engine replicas behind the gateway, driven over real HTTP
     with a prefix-grouped workload (the regime cache-affinity routing
@@ -592,6 +600,9 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     from ditl_tpu.infer.server import make_server
     from ditl_tpu.models import llama
     from ditl_tpu.runtime.distributed import enable_compile_cache
+    from ditl_tpu.telemetry.serving import (
+        serving_bench_summary, snapshot_serving,
+    )
 
     enable_compile_cache(compile_cache_dir)
     platform = jax.devices()[0].platform
@@ -609,6 +620,19 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     tok = ByteTokenizer()
     shared_gen = Generator(params, cfg, tok)  # tokenize/metadata routes only
     n_requests = n_replicas * slots * 2
+    # Pinned serving config (ISSUE 8): paged KV (so the prefix-cache hit
+    # ratio the row embeds is a real measured number, not vacuously zero)
+    # with chunked prefill ON at a page-size-aligned default and a per-tick
+    # token budget — the budgeted scheduler makes chunking strictly
+    # beneficial (decode-ready slots never starve behind a prefill), and
+    # the row records the interference p50/p95 the budget bounds. Pass 0
+    # to either knob for the unbudgeted/unchunked A/B; perf_compare gates
+    # the serving block either way.
+    page_size = 64 if platform == "tpu" else 16
+    if prefill_chunk < 0:
+        prefill_chunk = 256 if platform == "tpu" else 16
+    if token_budget < 0:
+        token_budget = slots * decode_chunk + max(prefill_chunk, page_size)
     # --trace-out (ISSUE 6): arm request tracing across the gateway and
     # every replica engine; after the run the merged journals export to
     # Chrome-trace JSON (open at ui.perfetto.dev) — the per-request
@@ -644,6 +668,9 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
             params, cfg, tok, n_slots=slots, decode_chunk=decode_chunk,
             gen=GenerateConfig(max_new_tokens=max_new),
             max_queue=n_requests,
+            cache_mode="paged", page_size=page_size,
+            prefill_chunk=prefill_chunk,
+            token_budget=token_budget,
             tracer=tracers[i],
         ))
         for i in range(n_replicas)
@@ -696,6 +723,12 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         with urllib.request.urlopen(req, timeout=600) as resp:
             return json.loads(resp.read())["usage"]["completion_tokens"]
 
+    # Group-length warm prompt (distinct from every group prefix): the
+    # paged chunked-prefill programs are keyed by (chunk, ctx-pages)
+    # bucket, so a short warm-up would leave the long-prompt buckets to
+    # compile inside the timed region.
+    warm_prompt = " ".join(f"warmtok{j}" for j in range(plen))
+
     def warm(view):
         # Compile each engine OUTSIDE the timed region by hitting every
         # replica directly — routed warm-ups would herd on whatever subset
@@ -706,7 +739,7 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         req = urllib.request.Request(
             f"http://{view.address[0]}:{view.address[1]}/v1/completions",
             data=json.dumps(
-                {"prompt": "warm up", "max_tokens": max_new}
+                {"prompt": warm_prompt, "max_tokens": max_new}
             ).encode(),
             headers={"Content-Type": "application/json"}, method="POST",
         )
@@ -715,6 +748,12 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
 
     with ThreadPoolExecutor(max_workers=n_replicas * slots) as pool:
         list(pool.map(warm, fleet.views()))
+        # Snapshot AFTER warm-up: the gated serving block must cover the
+        # timed region only (warm TTFTs are compile seconds, and the warm
+        # prompts' misses would deflate the hit ratio).
+        serving_base = snapshot_serving(
+            [eng._engine.metrics for eng in engines]
+        )
         t0 = time.perf_counter()
         tokens = sum(pool.map(one, prompts))
         dt = time.perf_counter() - t0
@@ -748,6 +787,23 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         "platform": platform,
         "generated_tokens": tokens,
         "requests": len(prompts),
+        # Serving scheduler block (ISSUE 8): fleet-merged interference
+        # quantiles + the measured prefix-cache hit ratio, flat numeric
+        # keys so telemetry/perf_compare.py gates serving regressions the
+        # same way it gates train rows (the block is hoisted like
+        # `roofline`).
+        "serving": {
+            "prefill_chunk": prefill_chunk,
+            "token_budget": token_budget,
+            "page_size": page_size,
+            "max_tick_prefill_tokens": max(
+                eng._engine.max_tick_prefill_tokens for eng in engines
+            ),
+            **serving_bench_summary(
+                [eng._engine.metrics for eng in engines],
+                since=serving_base,
+            ),
+        },
         "gateway": {
             "router": router,
             "affinity_ratio": summary.get("ditl_gateway_affinity_ratio"),
@@ -1284,6 +1340,16 @@ if __name__ == "__main__":
                         "tracing (ISSUE 6) across the gateway and every "
                         "replica, and write the merged Chrome-trace/"
                         "Perfetto JSON here (open at ui.perfetto.dev)")
+    parser.add_argument("--serve-prefill-chunk", type=int, default=-1,
+                        help="with --serve-replicas: chunked-prefill size "
+                        "per replica (-1 = pinned page-size-aligned "
+                        "default, ON; 0 = whole-prompt prefill — the "
+                        "unchunked A/B leg whose interference p95 the "
+                        "budgeted default is gated against)")
+    parser.add_argument("--serve-token-budget", type=int, default=-1,
+                        help="with --serve-replicas: per-tick token budget "
+                        "per replica engine (-1 = slots x decode-chunk + "
+                        "prefill-chunk, ON; 0 = unbudgeted scheduler)")
     args = parser.parse_args()
     if args.chaos:
         from ditl_tpu.chaos import FaultPlane, arm
@@ -1320,6 +1386,8 @@ if __name__ == "__main__":
             max_new=args.max_new, router=args.serve_router,
             compile_cache_dir=args.compile_cache_dir,
             trace_out=args.trace_out,
+            prefill_chunk=args.serve_prefill_chunk,
+            token_budget=args.serve_token_budget,
         ))
     if args.infer:
         sys.exit(bench_infer(

@@ -490,6 +490,12 @@ class GatewayConfig:
     tenant_rate: float = 0.0
     tenant_burst: float = 0.0
     tenant_max_concurrent: int = 0
+    # Default SLO-class pin applied to every admission-managed tenant
+    # ("" = no pin): the gateway stamps X-SLO-Class on each relay, which
+    # overrides the request payload at the replica — scheduling-priority
+    # enforcement at the front door (ISSUE 8). Programmatic per-tenant pins
+    # ride TenantAdmission(per_tenant={"name": {"slo_class": ...}}).
+    tenant_slo_class: str = ""
     # Journal directory for replica lifecycle events
     # (events-gateway.jsonl via telemetry/journal.py); "" = no journal.
     journal_dir: str = ""
@@ -507,6 +513,19 @@ class GatewayConfig:
         if self.max_attempts < 1:
             raise ValueError(f"gateway.max_attempts must be >= 1, got "
                              f"{self.max_attempts}")
+        if self.tenant_slo_class:
+            # Reject-don't-drop at config time: a typo'd class would 400
+            # every relayed request at the replica. Lazy import keeps the
+            # single source of truth (the gateway package is stdlib-only,
+            # so this never drags jax into config loading).
+            from ditl_tpu.gateway.admission import SLO_CLASS_NAMES
+
+            if self.tenant_slo_class not in SLO_CLASS_NAMES:
+                raise ValueError(
+                    f"unknown gateway.tenant_slo_class "
+                    f"{self.tenant_slo_class!r} "
+                    f"(one of {SLO_CLASS_NAMES}, or empty for no pin)"
+                )
 
 
 @dataclass(frozen=True)

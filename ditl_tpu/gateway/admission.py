@@ -28,8 +28,8 @@ import threading
 import time
 from typing import Container
 
-__all__ = ["AdmissionDecision", "TenantAdmission", "TokenBucket",
-           "sanitize_label", "tenant_label"]
+__all__ = ["AdmissionDecision", "SLO_CLASS_NAMES", "TenantAdmission",
+           "TokenBucket", "sanitize_label", "tenant_label"]
 
 
 def sanitize_label(s: str) -> str:
@@ -87,17 +87,28 @@ class TokenBucket:
             return (n - self._tokens) / self.rate
 
 
+# Mirror of infer/continuous.SLO_CLASSES — duplicated (not imported) so the
+# gateway package stays provably jax-free on import; pinned equal by test.
+SLO_CLASS_NAMES = ("interactive", "batch", "best_effort")
+
+
 @dataclasses.dataclass(frozen=True)
 class AdmissionDecision:
     ok: bool
     retry_after_s: float = 0.0
     reason: str = ""
+    # SLO class this tenant is pinned to ("" = no pin): the gateway stamps
+    # it as X-SLO-Class on every relay, which OVERRIDES the payload at the
+    # replica — a tenant cannot escape its pin by claiming interactive in
+    # the request body (ISSUE 8).
+    slo_class: str = ""
 
 
 @dataclasses.dataclass
 class _TenantState:
     bucket: TokenBucket | None
     max_concurrent: int
+    slo_class: str = ""
     active: int = 0
     admitted: int = 0
     throttled: int = 0
@@ -120,12 +131,27 @@ class TenantAdmission:
         max_concurrent: int = 0,
         per_tenant: dict[str, dict] | None = None,
         max_tenants: int = 4096,
+        slo_class: str = "",
     ):
         self.default_rate = float(rate)
         self.default_burst = float(burst) if burst else max(1.0, float(rate))
         self.default_max_concurrent = int(max_concurrent)
         self.per_tenant = dict(per_tenant or {})
         self.max_tenants = int(max_tenants)
+        # Default SLO-class pin for every tenant ("" = none); a per-tenant
+        # "slo_class" override wins. Validated here (reject-don't-drop): a
+        # typo'd class would otherwise 400 every request of that tenant at
+        # the replica.
+        self.default_slo_class = slo_class
+        for name, cls in [("slo_class", slo_class)] + [
+            (f"per_tenant[{t!r}].slo_class", cfg.get("slo_class", ""))
+            for t, cfg in self.per_tenant.items()
+        ]:
+            if cls and cls not in SLO_CLASS_NAMES:
+                raise ValueError(
+                    f"{name}: unknown SLO class {cls!r} "
+                    f"(one of {SLO_CLASS_NAMES})"
+                )
         self._tenants: collections.OrderedDict[str, _TenantState] = \
             collections.OrderedDict()
         self._lock = threading.Lock()
@@ -143,6 +169,9 @@ class TenantAdmission:
                 bucket=TokenBucket(rate, burst) if rate > 0 else None,
                 max_concurrent=int(
                     cfg.get("max_concurrent", self.default_max_concurrent)
+                ),
+                slo_class=str(
+                    cfg.get("slo_class", self.default_slo_class) or ""
                 ),
             )
             self._tenants[tenant] = st
@@ -182,7 +211,7 @@ class TenantAdmission:
                     )
             st.active += 1
             st.admitted += 1
-            return AdmissionDecision(True)
+            return AdmissionDecision(True, slo_class=st.slo_class)
 
     def release(self, tenant: str) -> None:
         with self._lock:

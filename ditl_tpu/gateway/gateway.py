@@ -44,7 +44,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.config import GatewayConfig
 from ditl_tpu.gateway.admission import (
-    TenantAdmission, sanitize_label, tenant_label,
+    SLO_CLASS_NAMES, TenantAdmission, sanitize_label, tenant_label,
 )
 from ditl_tpu.gateway.replica import Fleet, FleetSupervisor
 from ditl_tpu.gateway.router import affinity_key, make_policy
@@ -150,7 +150,35 @@ class GatewayMetrics:
         if fleet is not None:
             self.replicas_live.set(fleet.live_count())
             self.replicas_draining.set(fleet.draining_count())
+            self._set_cache_gauges(fleet)
         return self.registry.render()
+
+    def _set_cache_gauges(self, fleet: Fleet) -> None:
+        """Per-replica + token-weighted fleet prefix-cache hit ratios
+        (ISSUE 8), sourced from each replica's last /health poll (no scrape
+        fan-out) and rendered NEXT TO the routing-side affinity hit-rate so
+        the router's claim (routed hit => KV reuse) is checkable from one
+        exposition: affinity_ratio high while fleet_prefix_cache_hit_ratio
+        is ~0 means the router is keying on something the engines cannot
+        reuse (docs/troubleshooting.md §26)."""
+        hit = miss = 0
+        for v in fleet.views():
+            ratio = v.cache_hit_ratio
+            if ratio is None:
+                continue
+            hit += v.cache_hit_tokens
+            miss += v.cache_miss_tokens
+            self.registry.gauge(
+                f"{PREFIX}_replica_{sanitize_label(v.id)}_prefix_cache_hit_ratio",
+                f"measured engine prefix-cache hit ratio of replica "
+                f"{sanitize_label(v.id)} (from its last health poll)",
+            ).set(round(ratio, 4))
+        if hit + miss:
+            self.registry.gauge(
+                f"{PREFIX}_fleet_prefix_cache_hit_ratio",
+                "token-weighted fleet prefix-cache hit ratio - compare "
+                "against the affinity hit-rate counters",
+            ).set(round(hit / (hit + miss), 4))
 
     def summary(self) -> dict:
         out = self.registry.summary()
@@ -267,6 +295,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                         "queue_depth": v.queue_depth,
                         "active_slots": v.active_slots,
                         "capacity": v.capacity,
+                        "prefix_cache_hit_ratio": v.cache_hit_ratio,
                     }
                     for v in self.fleet.views()
                 },
@@ -410,6 +439,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                          span=None) -> None:
         m = self.gw
         tenant = self._tenant()
+        # Reject-don't-drop for explicit client classes: a malformed
+        # X-SLO-Class must 400 HERE, exactly as the replica would — the
+        # relay layer only forwards KNOWN names (header-injection guard),
+        # so silently stripping a typo'd class would serve the request at
+        # the default priority with no error signal.
+        cls_hdr = self.headers.get("X-SLO-Class")
+        if cls_hdr is not None and cls_hdr not in SLO_CLASS_NAMES:
+            self._send_json(400, {"error": {"message":
+                f"unknown X-SLO-Class (one of {list(SLO_CLASS_NAMES)})"}})
+            return
+        pinned_class = None
         if self.admission is not None:
             # Raw Bearer token keys the admission state (per_tenant
             # overrides match on it); metrics get the credential-safe
@@ -430,16 +470,19 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 )
                 return
             m.tenant_counter(label, "admitted").inc()
+            pinned_class = decision.slo_class or None
         t0 = time.time()
         try:
-            self._route_and_relay(path, payload, raw, span=span)
+            self._route_and_relay(path, payload, raw, span=span,
+                                  slo_class=pinned_class)
         finally:
             if self.admission is not None:
                 self.admission.release(tenant)
             m.e2e.observe(time.time() - t0)
 
     def _route_and_relay(self, path: str, payload: dict, raw: bytes,
-                         record: bool = True, span=None) -> None:
+                         record: bool = True, span=None,
+                         slo_class: str | None = None) -> None:
         m, cfg = self.gw, self.gwcfg
         stream = bool(payload.get("stream"))
         key = affinity_key(payload, cfg.affinity_prefix_tokens)
@@ -510,7 +553,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 outcome, info = self._relay_one(
                     view, path, raw, stream, hedge_peers,
                     deadline_left=remaining if propagate_deadline else None,
-                    span=rspan, root=span,
+                    span=rspan, root=span, slo_class=slo_class,
                 )
             finally:
                 self.fleet.dec_outstanding(view.id)
@@ -563,7 +606,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     # -- relaying -----------------------------------------------------------
 
     def _open(self, view, path: str, raw: bytes,
-              deadline_left: float | None = None, trace=None):
+              deadline_left: float | None = None, trace=None,
+              slo_class: str | None = None):
         """One upstream request; returns (conn, resp) or raises OSError/
         HTTPException on connection-level failure (retryable — no bytes
         have been relayed to the client yet). ``deadline_left`` (seconds)
@@ -578,6 +622,15 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             "Authorization": self.headers.get("Authorization", ""),
             "X-Request-Id": self._request_id(),
         }
+        # SLO class (ISSUE 8): a tenant pin from admission wins; otherwise
+        # the client's own header is relayed. The header OVERRIDES the
+        # payload at the replica, which is exactly what makes the pin
+        # enforceable. Forwarded only when it names a known class — the
+        # header-injection guard; malformed client values were already
+        # 400'd in _admit_and_route before any relay.
+        cls = slo_class or self.headers.get("X-SLO-Class")
+        if cls in SLO_CLASS_NAMES:
+            headers["X-SLO-Class"] = cls
         if trace is not None:
             headers["traceparent"] = format_traceparent(trace.context)
         if deadline_left is not None:
@@ -594,7 +647,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             raise
 
     def _relay_one(self, view, path, raw, stream, hedge_peers,
-                   deadline_left: float | None = None, span=None, root=None):
+                   deadline_left: float | None = None, span=None, root=None,
+                   slo_class: str | None = None):
         """Proxy one attempt. Returns (outcome, info):
         ``("done", served_replica_id)`` — response relayed;
         ``("retry", None)`` — connection-level failure, safe to fail over;
@@ -619,11 +673,11 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             if hedge_peers:
                 conn, resp, served = self._hedged_open(
                     view, hedge_peers, path, raw, deadline_left,
-                    span=span, root=root,
+                    span=span, root=root, slo_class=slo_class,
                 )
             else:
                 conn, resp = self._open(view, path, raw, deadline_left,
-                                        trace=span)
+                                        trace=span, slo_class=slo_class)
         except (OSError, http.client.HTTPException):
             self.fleet.note_failure(view.id)
             return ("retry", None)
@@ -683,7 +737,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             return "aborted"
 
     def _hedged_open(self, view, peers, path, raw, deadline_left=None,
-                     span=None, root=None):
+                     span=None, root=None, slo_class=None):
         """Tail-latency hedging (non-streaming only): if the primary has
         not answered within ``hedge_after_s``, fire the same request at the
         least-loaded peer and take whichever responds first. The loser's
@@ -698,7 +752,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         try:
             t0 = time.monotonic()
             primary = pool.submit(self._open, view, path, raw, deadline_left,
-                                  span)
+                                  span, slo_class)
             done, _ = wait([primary], timeout=self.gwcfg.hedge_after_s)
             if done:
                 conn, resp = primary.result()  # may raise: caller retries
@@ -719,7 +773,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 if deadline_left is not None else None
             )
             secondary = pool.submit(self._open, peer, path, raw,
-                                    secondary_left, hspan)
+                                    secondary_left, hspan, slo_class)
             futures = {primary: view.id, secondary: peer.id}
             last_exc: BaseException | None = None
             pending = set(futures)
@@ -800,10 +854,12 @@ def make_gateway(
         router = make_policy(config.router)
     if admission is None and (
         config.tenant_rate > 0 or config.tenant_max_concurrent > 0
+        or config.tenant_slo_class
     ):
         admission = TenantAdmission(
             rate=config.tenant_rate, burst=config.tenant_burst,
             max_concurrent=config.tenant_max_concurrent,
+            slo_class=config.tenant_slo_class,
         )
     gw_metrics = metrics if metrics is not None else GatewayMetrics()
     if slo is None:

@@ -256,6 +256,21 @@ class ReplicaView:
     capacity: int
     live: bool
     draining: bool
+    # Measured prefix-cache accounting from the replica's last /health poll
+    # (ISSUE 8): lifetime reused vs prefilled prompt tokens. The gateway's
+    # /metrics derives per-replica and token-weighted fleet hit ratios from
+    # these, next to the routing-side affinity hit-rate — the measurement
+    # the affinity router's "routed hit => KV reuse" claim is validated
+    # against. 0/0 on engines without the accounting (lockstep replicas).
+    cache_hit_tokens: int = 0
+    cache_miss_tokens: int = 0
+
+    @property
+    def cache_hit_ratio(self) -> float | None:
+        total = self.cache_hit_tokens + self.cache_miss_tokens
+        if total == 0:
+            return None
+        return self.cache_hit_tokens / total
 
 
 @dataclasses.dataclass
@@ -360,6 +375,8 @@ class Fleet:
             capacity=n_slots,
             live=st.live,
             draining=st.draining,
+            cache_hit_tokens=int(h.get("cache_hit_tokens", 0)),
+            cache_miss_tokens=int(h.get("cache_miss_tokens", 0)),
         )
 
     def routable(self, exclude: Sequence[str] = ()) -> list[ReplicaView]:

@@ -26,6 +26,22 @@ simple — admission policy is not a TPU problem. Per-request sampling params
 are supported for temperature 0/>0 mixtures by keeping sampling greedy when
 ``temperature == 0`` per-slot (a (B,) vector fed to the tick program).
 
+**Per-tick token budget + SLO classes** (ISSUE 8, the Sarathi-Serve
+observation): with ``token_budget > 0`` each tick composes its decode work
+(``decode_ready x decode_chunk`` tokens) plus at most ``budget - decode``
+prefill tokens, so a long admission's prefill chunks can never monopolize
+ticks that decode-ready slots are waiting on — the stall the interference
+histogram (``ditl_serving_tpot_interference_seconds``, ISSUE 6) measures.
+The first prefill of a tick always runs (at-least-one-chunk progress rule:
+a tight budget bounds the stall, it must not starve admission), so the
+honest per-tick prefill bound is ``max(one chunk, budget - decode)``.
+Requests carry an SLO class (``interactive`` < ``batch`` < ``best_effort``)
+— admission orders the queue by class then arrival, prefill chunks advance
+in the same order, and under pool pressure the preemption machinery evicts
+by class first, youth second, so a best-effort request is always the first
+casualty and the highest-priority oldest request always progresses (the
+same no-deadlock invariant as before, lifted to (class, age) order).
+
 **Speculative ticks** (``speculative=True``): when every active slot is
 greedy, the decode tick can run as ``spec_rounds`` verify rounds instead of
 ``decode_chunk`` single-token steps. Each round drafts ``spec_k`` tokens per
@@ -72,7 +88,15 @@ from ditl_tpu.utils.logging import get_logger
 logger = get_logger(__name__)
 
 __all__ = ["BadRequestError", "ContinuousEngine", "DeadlineExceededError",
-           "QueueFullError", "Request", "ThreadedEngine", "derive_copy_seed"]
+           "QueueFullError", "Request", "SLO_CLASSES", "ThreadedEngine",
+           "derive_copy_seed"]
+
+# SLO class -> scheduling rank (lower = served first). Admission orders the
+# queue by (rank, arrival), prefill chunks advance in the same order, and
+# preemption evicts the highest (rank, req_id) first — so the ranks ARE the
+# eviction order reversed. The names ride the HTTP surface (`slo_class`
+# payload / `X-SLO-Class` header), so changing them is an API change.
+SLO_CLASSES: dict[str, int] = {"interactive": 0, "batch": 1, "best_effort": 2}
 
 
 def _quantize_pages(chunk: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -280,6 +304,22 @@ class Request:
     # ``interference_s`` is the lifetime total.
     interference_pending: list = field(default_factory=list)
     interference_s: float = 0.0
+    # SLO class (ISSUE 8): scheduling priority rank key into SLO_CLASSES.
+    # Orders admission and prefill advance; picked first for eviction under
+    # pool pressure when ranked worse than the needy request.
+    slo_class: str = "interactive"
+    # Prefix-cache accounting (ISSUE 8): prompt tokens whose KV was reused
+    # from the cache at first admission vs tokens actually prefilled.
+    # Resume re-prefills after preemption touch NEITHER field — the prompt
+    # was already credited once; thrash cost is tracked separately
+    # (resume_prefill_tokens).
+    cache_hit_tokens: int = 0
+    cache_miss_tokens: int = 0
+
+    @property
+    def slo_rank(self) -> tuple[int, int]:
+        """Scheduling order key: class rank, then arrival."""
+        return (SLO_CLASSES[self.slo_class], self.req_id)
 
 
 class ContinuousEngine:
@@ -317,6 +357,7 @@ class ContinuousEngine:
         draft_cfg: ModelConfig | None = None,
         pipeline_ticks: bool = False,
         admission: str = "reserve",
+        token_budget: int = 0,
         thrash_window: int = 32,
         metrics: ServingMetrics | None = None,
         tracer: Tracer | None = None,
@@ -414,6 +455,30 @@ class ContinuousEngine:
         if prefill_chunk < 0:
             raise ValueError(f"prefill_chunk must be >= 0, got {prefill_chunk}")
         self.prefill_chunk = prefill_chunk
+        # Per-tick token budget (ISSUE 8, module docstring): 0 = unbudgeted
+        # (the historical scheduler). When armed, each tick's prefill spend
+        # is capped at budget - decode_ready*decode_chunk; the floor below
+        # guarantees that cap is >= decode_chunk whenever prefill work can
+        # exist (a prefilling or free slot means decode_ready < n_slots), so
+        # a legal budget can bound stalls but never starve admission.
+        if token_budget < 0:
+            raise ValueError(f"token_budget must be >= 0, got {token_budget}")
+        if token_budget and token_budget < n_slots * decode_chunk:
+            raise ValueError(
+                f"token_budget {token_budget} must cover a full decode tick "
+                f"(n_slots {n_slots} x decode_chunk {decode_chunk} = "
+                f"{n_slots * decode_chunk}); smaller budgets would zero the "
+                f"prefill allowance forever and starve admission"
+            )
+        self.token_budget = token_budget
+        self._tick_prefill_left: int | None = None  # None = unbudgeted tick
+        self._tick_prefill_spent = 0
+        # Observability for the budget bound (pinned by the mixed-workload
+        # drill): the largest prefill token spend any single tick made, and
+        # the largest single interference observation — deterministic and
+        # wall-clock views of the same stall.
+        self.max_tick_prefill_tokens = 0
+        self.interference_max_s = 0.0
         self.max_queue = max_queue
         self.mesh = mesh
         self.rules = rules
@@ -519,7 +584,10 @@ class ContinuousEngine:
                 self.cache = jax.jit(fresh_pools, out_shardings=shardings)()
             else:
                 self.cache = fresh_pools()
-            self.allocator = PageAllocator(self.n_pages)
+            self.allocator = PageAllocator(
+                self.n_pages,
+                on_evict=self.metrics.prefix_cache_evictions.inc,
+            )
             self._table = np.zeros((n_slots, self.maxp), np.int32)
             # Device-resident mirror, re-uploaded only when the host table
             # changes (admission / slot free): a per-tick jnp.asarray would
@@ -611,10 +679,14 @@ class ContinuousEngine:
         self.keys = jax.vmap(jax.random.key)(jnp.arange(n_slots, dtype=jnp.uint32))
         self._base_seed = seed
 
-        import collections
-
         self._slots: list[Request | None] = [None] * n_slots
-        self._queue: collections.deque[Request] = collections.deque()
+        # Admission queue, kept sorted by (SLO class rank, req_id) — FIFO
+        # within a class, interactive ahead of batch ahead of best_effort.
+        # A preempted request re-enters with its ORIGINAL req_id, so it
+        # lands at the front of its class (the old appendleft semantics,
+        # scoped to the class). Plain list: depths are bounded by max_queue
+        # and every consumer below indexes/pops the head.
+        self._queue: list[Request] = []
         self._completed: dict[int, Request] = {}
         # Double-buffered (pipelined) ticks: dispatch tick N+1 before
         # fetching tick N's outputs, so the host→device dispatch and
@@ -1855,6 +1927,7 @@ class ContinuousEngine:
         adapter_id: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        slo_class: str | None = None,
         trace: Any = None,
     ) -> int:
         """Queue a request; returns its id (see ``results``/``run``).
@@ -1871,7 +1944,11 @@ class ContinuousEngine:
         from the queue/slot (DeadlineExceededError for waiters) instead of
         decoding work nobody will read. Solo serving only: the pod tick
         broadcast never carries deadlines (per-process wall clocks would
-        desync the replicated scheduler). ``trace``: upstream span/
+        desync the replicated scheduler). ``slo_class``: scheduling
+        priority class (``interactive`` | ``batch`` | ``best_effort``,
+        default interactive) — orders admission/prefill and picks eviction
+        victims under pool pressure (module docstring); never changes a
+        request's RESULT, only when it runs. ``trace``: upstream span/
         SpanContext (telemetry/tracing.py) the engine's lifecycle spans
         chain under when the engine's tracer is armed; ignored otherwise."""
         gen = self.gen
@@ -1916,6 +1993,14 @@ class ContinuousEngine:
         ):
             # Also BEFORE grammar registration, for the same reason.
             raise BadRequestError("deadline_s must be a number")
+        if slo_class is None:
+            slo_class = "interactive"
+        elif slo_class not in SLO_CLASSES:
+            # Also BEFORE grammar registration (FSM rows are never evicted).
+            raise BadRequestError(
+                f"unknown slo_class {slo_class!r} "
+                f"(one of {sorted(SLO_CLASSES)})"
+            )
         max_new = max_new_tokens if max_new_tokens is not None else gen.max_new_tokens
         prompt = prompt_tokens or [self.tokenizer.bos_id]
         self.validate_request(prompt, max_new)
@@ -1955,6 +2040,7 @@ class ContinuousEngine:
                 time.monotonic() + float(deadline_s)
                 if deadline_s is not None else None
             ),
+            slo_class=slo_class,
         )
         self._next_id += 1
         if self.tracer.armed:
@@ -1972,8 +2058,19 @@ class ContinuousEngine:
                 "engine.queue", parent=req.request_span, req=req.req_id,
             )
         self.metrics.requests.inc()
-        self._queue.append(req)
+        self._enqueue(req)
         return req.req_id
+
+    def _enqueue(self, req: Request) -> None:
+        """Insert by (class rank, req_id): FIFO within a class, classes in
+        priority order. Monotonic req_ids make this a stable sort; a
+        requeued (preempted) request's old id puts it ahead of everything
+        newer in its class — the old queue-head semantics, class-scoped.
+        Deterministic, so pod replicas order identically."""
+        import bisect
+
+        keys = [r.slo_rank for r in self._queue]
+        self._queue.insert(bisect.bisect_right(keys, req.slo_rank), req)
 
     def validate_request(self, prompt: list[int], max_new: int) -> None:
         """Per-request shape validation, raising ``ValueError`` on requests
@@ -1997,16 +2094,18 @@ class ContinuousEngine:
                     f"page_size={self.page_size})"
                 )
 
-    def _prefill_into_slot(self, req: Request, slot: int, rng) -> jax.Array | None:
+    def _prefill_into_slot(self, req: Request, slot: int, rng,
+                           prefix) -> jax.Array | None:
         """Fill the slot's cache for ``req``'s prompt and return the first
-        sampled token. Uses a registered prefix's KV when one matches (seed
-        copy + suffix-only prefill), else the full prefill program. Returns
-        ``None`` when chunked prefill takes over (the request finishes
-        prefilling across subsequent ticks, see ``_advance_prefill``)."""
-        prefix = (
-            self._match_prefix(req.prompt) if req.adapter_id == 0 else None
-        )
+        sampled token. ``prefix`` is the caller's ``_match_prefix`` result
+        (``_admit`` already computed it for the token-budget gate — one
+        scan per admission, not two). Uses the matched prefix's KV when
+        present (seed copy + suffix-only prefill), else the full prefill
+        program. Returns ``None`` when chunked prefill takes over (the
+        request finishes prefilling across subsequent ticks, see
+        ``_advance_prefill``)."""
         d0 = 0 if prefix is None else prefix[2]
+        self._note_prefix_cache(req, d0)
         if self.prefill_chunk and len(req.prompt) - d0 > self.prefill_chunk:
             if prefix is not None:
                 row, _, _ = prefix
@@ -2307,7 +2406,7 @@ class ContinuousEngine:
             # queued — its pending tick's lagged harvest delivered the
             # final chunk and already recorded it in _completed. Nothing
             # to admit; drop it and try the next head.
-            self._queue.popleft()
+            self._queue.pop(0)
             if not self._queue:
                 return False
         if req.preempted:
@@ -2316,6 +2415,17 @@ class ContinuousEngine:
         matched = self.allocator.match_prefix(
             req.prompt, ps, root=-req.adapter_id
         )  # retained
+        d0 = len(matched) * ps
+        # Token-budget gate (ISSUE 8): an unchunked admission prefills its
+        # whole unmatched prompt THIS tick; defer it when that would bust
+        # the tick's prefill allowance (a chunked admission costs nothing
+        # now — its chunks draw the allowance as they run).
+        s = len(req.prompt) - d0
+        cost = 0 if (self.prefill_chunk and s > self.prefill_chunk) else s
+        if not self._budget_allows(cost):
+            for pid in matched:
+                self.allocator.release(pid)
+            return False
         worst = -(-(len(req.prompt) + req.max_new_tokens) // ps)
         if self.admission == "optimistic" and not self._degraded:
             want = -(-(len(req.prompt) + self._tick_advance_bound()) // ps)
@@ -2329,8 +2439,9 @@ class ContinuousEngine:
             for pid in matched:
                 self.allocator.release(pid)
             return False
-        self._queue.popleft()
+        self._queue.pop(0)
         self._note_admitted(req)
+        self._note_prefix_cache(req, d0)
         pages = matched + fresh
         self._slot_pages[slot] = pages
         self._table[slot, :] = 0
@@ -2381,6 +2492,13 @@ class ContinuousEngine:
         pos = len(ctx)  # cur's write position
         cap = len(req.prompt) + req.max_new_tokens
         matched = self.allocator.match_prefix(ctx, ps, root=-req.adapter_id)
+        # Budget gate: the resume's chunks run back-to-back inside THIS
+        # admission (they never interleave across ticks — see below), so
+        # the whole unmatched remainder is this tick's prefill cost.
+        if not self._budget_allows(pos - len(matched) * ps):
+            for pid in matched:
+                self.allocator.release(pid)
+            return False
         worst = -(-cap // ps)
         if self.admission == "optimistic" and not self._degraded:
             n_total = min(-(-(pos + self._tick_advance_bound()) // ps), worst)
@@ -2393,7 +2511,7 @@ class ContinuousEngine:
             for pid in matched:
                 self.allocator.release(pid)
             return False
-        self._queue.popleft()
+        self._queue.pop(0)
         self._note_admitted(req)  # no-op for an already-admitted resume
         pages = matched + fresh
         self._slot_pages[slot] = pages
@@ -2453,20 +2571,26 @@ class ContinuousEngine:
         return True
 
     def _pick_victim(self, needy: Request) -> int | None:
-        """Youngest in-flight request STRICTLY younger than ``needy`` (so
-        the oldest in-flight request is never preempted and always
-        progresses — the no-deadlock invariant). Prefilling slots are
-        eligible victims too (ADVICE r4: skipping them let the needy
-        request preempt ITSELF when every younger request was still
-        prefilling, transiently breaking the invariant); a mid-prefill
-        victim has no sampling frontier yet and is simply requeued as
-        fresh. None when ``needy`` is itself the youngest."""
+        """The in-flight request ranked STRICTLY worse than ``needy`` in
+        (SLO class, age) order, worst first — so under pressure best-effort
+        work is always the first casualty, batch next, and within a class
+        the youngest goes first (the pre-SLO rule). The request with the
+        minimal (class, req_id) key is never preempted and always
+        progresses — the same no-deadlock invariant as the age-only rule,
+        lifted to the lexicographic (class, age) order; cross-class
+        ping-pong is impossible because a lower class can never evict a
+        higher one. Prefilling slots are eligible victims too (ADVICE r4:
+        skipping them let the needy request preempt ITSELF when every
+        younger request was still prefilling, transiently breaking the
+        invariant); a mid-prefill victim has no sampling frontier yet and
+        is simply requeued as fresh. None when ``needy`` itself holds the
+        worst rank."""
         best: int | None = None
         for slot, req in enumerate(self._slots):
             if (req is None or req.finished
-                    or req.cancelled or req.req_id <= needy.req_id):
+                    or req.cancelled or req.slo_rank <= needy.slo_rank):
                 continue
-            if best is None or req.req_id > self._slots[best].req_id:
+            if best is None or req.slo_rank > self._slots[best].slo_rank:
                 best = slot
         return best
 
@@ -2490,7 +2614,7 @@ class ContinuousEngine:
             req.prefill_pos = 0
             self._slots[slot] = None
             self._free_slot_pages(slot)
-            self._queue.appendleft(req)
+            self._enqueue(req)  # old req_id => front of its class
             self.preemptions += 1
             self.metrics.preemptions.inc()
             logger.info(
@@ -2509,7 +2633,7 @@ class ContinuousEngine:
         self._publish_tokens(req.prompt + req.tokens, slot, req.adapter_id)
         self._slots[slot] = None
         self._free_slot_pages(slot)
-        self._queue.appendleft(req)
+        self._enqueue(req)  # old req_id => front of its class
         self.preemptions += 1
         self.metrics.preemptions.inc()
         logger.info(
@@ -2662,12 +2786,45 @@ class ContinuousEngine:
             )
             req.queue_span = None
 
+    def _budget_allows(self, cost: int) -> bool:
+        """Does this tick's prefill allowance cover ``cost`` more tokens?
+        The tick's FIRST prefill always passes (at-least-one-chunk progress
+        rule — a tight budget bounds the stall, it must not starve
+        admission forever), so the honest per-tick bound is
+        ``max(one chunk, budget - decode_ready*decode_chunk)``."""
+        if self._tick_prefill_left is None or cost <= 0:
+            return True
+        return self._tick_prefill_spent == 0 or cost <= self._tick_prefill_left
+
+    def _note_prefix_cache(self, req: Request, hit_tokens: int) -> None:
+        """Record a FIRST admission's reused-vs-prefilled prompt split
+        (prefix-cache accounting, ISSUE 8). Resume re-prefills never come
+        here — their cost is thrash (resume_prefill_tokens), not a cache
+        verdict on the prompt. Idempotent: a mid-prefill preemption victim
+        is requeued as FRESH (no sampling frontier to capture), and its
+        re-admission would otherwise count the prompt twice — with its own
+        just-published pages masquerading as hits."""
+        if req.cache_hit_tokens or req.cache_miss_tokens:
+            return  # re-admission after a mid-prefill preemption
+        req.cache_hit_tokens = hit_tokens
+        req.cache_miss_tokens = len(req.prompt) - hit_tokens
+        self.metrics.note_prefix_cache(
+            req.cache_hit_tokens, req.cache_miss_tokens
+        )
+
     def _record_prefill(self, req: Request, tokens: int, offset: int,
                         w0: float, dt: float, kind: str) -> None:
         """Register one prefill dispatch: feeds this tick's interference
-        attribution (step()) and — when tracing — writes the chunk's span
-        under the request's lifecycle span."""
+        attribution (step()), debits the tick's token-budget allowance,
+        and — when tracing — writes the chunk's span under the request's
+        lifecycle span."""
         self._tick_prefills.append((req.req_id, tokens, dt))
+        self._tick_prefill_spent += tokens
+        if self._tick_prefill_left is not None:
+            self._tick_prefill_left = max(0, self._tick_prefill_left - tokens)
+        self.max_tick_prefill_tokens = max(
+            self.max_tick_prefill_tokens, self._tick_prefill_spent
+        )
         if req.request_span is not None:
             self.tracer.start_span(
                 "engine.prefill", parent=req.request_span, t0=w0,
@@ -2680,22 +2837,44 @@ class ContinuousEngine:
                 continue
             if self.cache_mode == "paged":
                 if not self._admit_paged_slot(slot):
-                    # FIFO: the head request doesn't fit the pool right now;
-                    # don't let smaller requests starve it indefinitely.
+                    # Priority FIFO: the head (highest class, oldest)
+                    # request doesn't fit the pool or the tick's prefill
+                    # allowance right now; don't let smaller or
+                    # lower-class requests starve it indefinitely.
                     break
                 continue
-            req = self._queue.popleft()
+            req = self._queue[0]
+            # Token-budget gate (ISSUE 8): an unchunked admission prefills
+            # its whole unmatched prompt this tick — defer when that would
+            # bust the allowance (chunked admissions only seed the slot
+            # here; their chunks draw the allowance as they run). The
+            # match is passed down so _prefill_into_slot never recomputes
+            # it.
+            prefix = (
+                self._match_prefix(req.prompt) if req.adapter_id == 0
+                else None
+            )
+            d0 = 0 if prefix is None else prefix[2]
+            s = len(req.prompt) - d0
+            if not self._budget_allows(
+                0 if (self.prefill_chunk and s > self.prefill_chunk) else s
+            ):
+                break
+            self._queue.pop(0)
             self._note_admitted(req)
             slot_key = jax.random.key(req.seed)
             slot_key, sub = jax.random.split(slot_key)
             req.slot = slot
             w0, m0 = time.time(), time.monotonic()
-            first = self._prefill_into_slot(req, slot, sub)
+            first = self._prefill_into_slot(req, slot, sub, prefix)
             if first is not None:
                 # Chunked prefill (first is None) records per chunk in
-                # step()'s advance loop instead.
+                # step()'s advance loop instead. Tokens = the suffix the
+                # program actually prefilled (prefix-matched tokens cost
+                # no device work and must not debit the token budget the
+                # gate above charged only `s` against).
                 self._record_prefill(
-                    req, len(req.prompt), 0, w0,
+                    req, s, d0, w0,
                     time.monotonic() - m0, "prompt",
                 )
             self._slots[slot] = req
@@ -2714,6 +2893,26 @@ class ContinuousEngine:
             self.top_ps = self.top_ps.at[slot].set(req.top_p)
             self.keys = self.keys.at[slot].set(slot_key)
             self.adapters = self.adapters.at[slot].set(req.adapter_id)
+
+    def _advance_prefill_chunks(self, reqs: list) -> None:
+        """Advance one prefill chunk per request in SLO order (class rank,
+        then age) so a tight allowance feeds interactive prefills before
+        batch/best-effort ones; a chunk that would bust the remaining
+        allowance parks until a later tick (the slot stays prefilling, its
+        decode row parked)."""
+        for req in sorted(reqs, key=lambda r: r.slo_rank):
+            if not req.prefilling or req.finished or req.cancelled:
+                continue
+            cost = min(self.prefill_chunk, len(req.prompt) - req.prefill_pos)
+            if not self._budget_allows(cost):
+                continue
+            d_before = req.prefill_pos
+            w0, m0 = time.time(), time.monotonic()
+            self._advance_prefill(req)
+            self._record_prefill(
+                req, req.prefill_pos - d_before, d_before, w0,
+                time.monotonic() - m0, "chunk",
+            )
 
     def _snapshot_slots(self) -> list[tuple[Request | None, bool]]:
         """(request, was_prefilling) per slot AT DISPATCH TIME — pipelined
@@ -2776,7 +2975,13 @@ class ContinuousEngine:
                 if first_chunk:
                     req.t_first = t_now
                     if req.t_submit:
-                        m.ttft.observe(t_now - req.t_submit)
+                        ttft = t_now - req.t_submit
+                        m.ttft.observe(ttft)
+                        # Hit/miss split (ISSUE 8): the histogram pair that
+                        # answers "does a prefix-cache hit actually buy
+                        # TTFT" from /metrics alone.
+                        (m.ttft_cache_hit if req.cache_hit_tokens > 0
+                         else m.ttft_cache_miss).observe(ttft)
                 elif req.t_last_emit:
                     # TPOT: this harvest interval amortized over the chunk's
                     # tokens, observed once per token. The first chunk is
@@ -3235,16 +3440,32 @@ class ContinuousEngine:
             and not r.finished and not r.cancelled
         ]
         self._tick_prefills = []
+        # Token budget (ISSUE 8): this tick's decode work is fixed
+        # (decode_ready slots x decode_chunk steps); whatever the budget
+        # leaves over is the prefill allowance admission and the chunk
+        # advances below draw from. None = unbudgeted (historical).
+        self._tick_prefill_spent = 0
+        self._tick_prefill_left = (
+            max(0, self.token_budget - len(decode_ready) * self.decode_chunk)
+            if self.token_budget else None
+        )
+        # In-flight prefill chunks draw the allowance BEFORE admission
+        # (Sarathi's order: decode > ongoing prefill > new work) — letting
+        # admission spend first would burn each tick's at-least-one-chunk
+        # free pass on fresh arrivals and park an older mid-prefill request
+        # indefinitely behind a stream of new admissions. Newly admitted
+        # chunked requests still advance their first chunk this tick
+        # (second pass below) when allowance remains.
+        inflight = [
+            r for r in self._slots if r is not None and r.prefilling
+        ]
+        self._advance_prefill_chunks(inflight)
         self._admit()
-        for req in self._slots:
-            if req is not None and req.prefilling:
-                d_before = req.prefill_pos
-                w0, m0 = time.time(), time.monotonic()
-                self._advance_prefill(req)
-                self._record_prefill(
-                    req, req.prefill_pos - d_before, d_before, w0,
-                    time.monotonic() - m0, "chunk",
-                )
+        seen = {id(r) for r in inflight}
+        self._advance_prefill_chunks([
+            r for r in self._slots
+            if r is not None and r.prefilling and id(r) not in seen
+        ])
         prefill_s = sum(dt for _, _, dt in self._tick_prefills)
         if self._tick_prefills and prefill_s > 0 and decode_ready:
             # One histogram observation per victim per tick (the aggregate
@@ -3254,6 +3475,7 @@ class ContinuousEngine:
             culprit_id, culprit_tokens, _ = max(
                 self._tick_prefills, key=lambda e: e[2]
             )
+            self.interference_max_s = max(self.interference_max_s, prefill_s)
             for victim in decode_ready:
                 if victim.finished or victim.cancelled:
                     continue
@@ -3330,6 +3552,10 @@ class ContinuousEngine:
 
         h = hashlib.sha256()
         h.update(len(self._queue).to_bytes(4, "big"))
+        # Queue ORDER is scheduler state now (class-priority admission): a
+        # replica whose queue sorted differently would admit a different
+        # request next tick.
+        h.update(bytes(SLO_CLASSES[r.slo_class] for r in self._queue))
         h.update(bytes(
             0 if r is None else (2 if r.prefilling else 1)
             for r in self._slots
@@ -3342,6 +3568,27 @@ class ContinuousEngine:
             # replica whose switch drifted must fingerprint differently.
             h.update(bytes([self._degraded]))
         return int.from_bytes(h.digest()[:4], "big") >> 1
+
+    def _prefix_cache_stats(self) -> dict:
+        """Measured prefix-reuse accounting (ISSUE 8): lifetime reused vs
+        prefilled prompt tokens, their ratio, and LRU evictions — the
+        numbers /stats, /health, and the gateway's per-replica aggregation
+        all read. Counter-backed, so a shared metrics bundle aggregates
+        across engines exactly like the latency histograms do."""
+        m = self.metrics
+        hit = int(m.prefix_cache_hit_tokens.value)
+        miss = int(m.prefix_cache_miss_tokens.value)
+        out = {
+            "hit_tokens": hit,
+            "miss_tokens": miss,
+            "evictions": (
+                self.allocator.evictions if self.cache_mode == "paged"
+                else 0
+            ),
+        }
+        if hit + miss:
+            out["hit_ratio"] = round(hit / (hit + miss), 4)
+        return out
 
     def stats(self) -> dict:
         """Operational snapshot (host state only — no device sync): slot
@@ -3359,6 +3606,13 @@ class ContinuousEngine:
             "max_queue": self.max_queue,
             "decode_chunk": self.decode_chunk,
             "max_context": self.smax,
+            "token_budget": self.token_budget,
+            "max_tick_prefill_tokens": self.max_tick_prefill_tokens,
+            "queue_by_class": {
+                cls: sum(1 for r in self._queue if r.slo_class == cls)
+                for cls in SLO_CLASSES
+            },
+            "prefix_cache": self._prefix_cache_stats(),
         }
         if self.cache_mode == "paged":
             out.update({
@@ -3472,6 +3726,12 @@ class ThreadedEngine:
     continuous batching across connections), unlike the lock-step server
     path where each request runs the device exclusively."""
 
+    # The server consults these before passing scheduling extensions
+    # through: this front supports both; the pod driver (podserve) sets its
+    # own to False and rejects explicit values (reject-don't-drop).
+    supports_deadlines = True
+    supports_slo_classes = True
+
     def __init__(self, engine: ContinuousEngine):
         import threading
 
@@ -3580,6 +3840,7 @@ class ThreadedEngine:
         adapter_id: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        slo_class: str | None = None,
         trace: Any = None,
     ) -> list[int]:
         """Submit one request and block until it completes. Raises if the
@@ -3599,6 +3860,7 @@ class ThreadedEngine:
                 adapter_id=adapter_id,
                 grammar=grammar,
                 deadline_s=deadline_s,
+                slo_class=slo_class,
                 trace=trace,
             )
             self._cond.notify_all()
@@ -3621,6 +3883,7 @@ class ThreadedEngine:
         seed: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        slo_class: str | None = None,
         trace: Any = None,
     ) -> tuple[list[int], dict]:
         """``generate_one`` + per-token logprob stats (same dict layout as
@@ -3640,6 +3903,7 @@ class ThreadedEngine:
                 logprobs=n_top,
                 grammar=grammar,
                 deadline_s=deadline_s,
+                slo_class=slo_class,
                 trace=trace,
             )
             self._cond.notify_all()
@@ -3667,6 +3931,7 @@ class ThreadedEngine:
         adapter_id: int | None = None,
         grammar: Any = None,
         logprobs: int | None = None,
+        slo_class: str | None = None,
         trace: Any = None,
     ) -> list[Request]:
         """Submit ``n`` copies of one prompt (distinct derived seeds) and
@@ -3696,6 +3961,7 @@ class ThreadedEngine:
                         adapter_id=adapter_id,
                         grammar=grammar,
                         logprobs=logprobs,
+                        slo_class=slo_class,
                         trace=trace,
                     ))
             except BaseException:
@@ -3721,6 +3987,7 @@ class ThreadedEngine:
         adapter_id: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        slo_class: str | None = None,
         trace: Any = None,
     ):
         """Submit one request and return an iterator of per-chunk token-id
@@ -3746,6 +4013,7 @@ class ThreadedEngine:
                 adapter_id=adapter_id,
                 grammar=grammar,
                 deadline_s=deadline_s,
+                slo_class=slo_class,
                 trace=trace,
             )
             self._cond.notify_all()
@@ -3783,6 +4051,7 @@ class ThreadedEngine:
         seed: int | None = None,
         grammar: Any = None,
         deadline_s: float | None = None,
+        slo_class: str | None = None,
         trace: Any = None,
     ):
         """``stream_one`` + per-chunk logprob stats: yields
@@ -3805,6 +4074,7 @@ class ThreadedEngine:
                 logprobs=n_top,
                 grammar=grammar,
                 deadline_s=deadline_s,
+                slo_class=slo_class,
                 trace=trace,
             )
             self._cond.notify_all()

@@ -56,10 +56,17 @@ def block_keys(tokens: list[int], page_size: int, parents: list[int]) -> list[Pa
 class PageAllocator:
     """Refcounted page pool bookkeeping with content-hash reuse."""
 
-    def __init__(self, n_pages: int):
+    def __init__(self, n_pages: int, on_evict=None):
         if n_pages < 2:
             raise ValueError(f"need >= 2 pages (page 0 is reserved), got {n_pages}")
         self.n_pages = n_pages
+        # LRU reclaims of published (cache-only) pages. ``on_evict`` is an
+        # optional zero-arg callback fired once per reclaimed page — the
+        # engine wires its ``prefix_cache_evictions`` counter here so pool
+        # pressure that churns the content cache is visible on /metrics
+        # (ISSUE 8), not just as a mysteriously low hit ratio.
+        self.evictions = 0
+        self._on_evict = on_evict
         self._free: deque[int] = deque(range(1, n_pages))
         self._ref = [0] * n_pages
         self._key_to_page: dict[PageKey, int] = {}
@@ -114,6 +121,9 @@ class PageAllocator:
             pid = self._key_to_page[key]
             if self._ref[pid] == 1:  # only the content cache holds it
                 self._unpublish(key, pid, claimed=True)
+                self.evictions += 1
+                if self._on_evict is not None:
+                    self._on_evict()
                 return pid
         return None
 

@@ -660,6 +660,26 @@ class PodContinuousDriver:
     # dropped rather than 400-ing every gateway-routed request. An explicit
     # client `deadline_s` payload still goes through _reject_deadline.
     supports_deadlines = False
+    # Same stance for SLO classes (ISSUE 8): queue order is replicated
+    # scheduler state, and staging does not broadcast a class lane, so a
+    # non-default class on one process would desync admission order pod-
+    # wide. Header-derived hints are dropped by the server; explicit
+    # payload values go through _reject_slo_class.
+    supports_slo_classes = False
+
+    @staticmethod
+    def _reject_slo_class(slo_class) -> None:
+        """Pod serving carries no SLO classes: the tick broadcast stages
+        requests FIFO and every replica must sort its queue identically.
+        Reject-don't-drop for explicit client values."""
+        if slo_class is not None and slo_class != "interactive":
+            from ditl_tpu.infer.continuous import BadRequestError
+
+            raise BadRequestError(
+                "slo_class does not compose with --pod serving (the tick "
+                "broadcast stages requests FIFO; a per-process priority "
+                "queue would desync the replicated scheduler)"
+            )
 
     @staticmethod
     def _reject_deadline(deadline_s) -> None:
@@ -686,8 +706,9 @@ class PodContinuousDriver:
     def generate_one(self, prompt_tokens, *, max_new_tokens=None,
                      temperature=None, top_p=None, seed=None,
                      adapter_id=None, grammar=None,
-                     deadline_s=None, trace=None) -> list[int]:
+                     deadline_s=None, slo_class=None, trace=None) -> list[int]:
         self._reject_deadline(deadline_s)
+        self._reject_slo_class(slo_class)
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
                              top_p, seed, adapter_id=adapter_id,
                              grammar=grammar, trace=trace)
@@ -696,12 +717,13 @@ class PodContinuousDriver:
     def generate_many(self, prompt_tokens, n, *, max_new_tokens=None,
                       temperature=None, top_p=None, seed=None,
                       adapter_id=None, grammar=None, logprobs=None,
-                      trace=None):
+                      slo_class=None, trace=None):
         """OpenAI ``n``/``best_of`` over the pod: stage ``n`` copies with
         derived seeds (same 7919-stride rule as ThreadedEngine.generate_many
         so pod and solo serving replay identically for a given seed), then
         block until all finish. Returns objects with ``.tokens`` and
         ``.lp_token`` — the server's candidate surface."""
+        self._reject_slo_class(slo_class)
         if logprobs is not None:
             from ditl_tpu.infer.continuous import BadRequestError
 
@@ -750,10 +772,11 @@ class PodContinuousDriver:
 
     def stream_one(self, prompt_tokens, *, max_new_tokens=None,
                    temperature=None, top_p=None, seed=None, adapter_id=None,
-                   grammar=None, deadline_s=None, trace=None):
+                   grammar=None, deadline_s=None, slo_class=None, trace=None):
         import queue as _queue
 
         self._reject_deadline(deadline_s)
+        self._reject_slo_class(slo_class)
         stream: _queue.Queue = _queue.Queue()
         # Staged EAGERLY (not on first next()): QueueFullError must raise
         # while the HTTP layer can still answer 429 — after the SSE headers

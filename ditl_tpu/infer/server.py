@@ -319,11 +319,20 @@ class _Handler(BaseHTTPRequestHandler):
         eng = self._engine_for_stats()
         if eng is not None:
             st = eng.stats()
-            return {
+            out = {
                 "queue_depth": int(st.get("queue_depth", 0)),
                 "active_slots": int(st.get("slots_busy", 0)),
                 "n_slots": int(st.get("n_slots", 1)),
             }
+            # Prefix-cache accounting rides the health payload (ISSUE 8):
+            # the gateway's Fleet folds each poll into its ReplicaView, so
+            # per-replica hit ratios aggregate on the gateway /metrics
+            # without an extra scrape fan-out.
+            pc = st.get("prefix_cache")
+            if isinstance(pc, dict):
+                out["cache_hit_tokens"] = int(pc.get("hit_tokens", 0))
+                out["cache_miss_tokens"] = int(pc.get("miss_tokens", 0))
+            return out
         inflight = int(getattr(self.server, "inflight", 0))
         return {
             "queue_depth": max(0, inflight - 1),
@@ -367,6 +376,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _gate_slo_class(self, slo_class, from_header) -> tuple:
+        """This serving path cannot honor a scheduling class (lockstep,
+        pod FIFO staging, adapter/logprobs fallbacks): drop a header-
+        derived hint (the gateway stamps every relay best-effort), 400 an
+        explicit non-default payload value (reject-don't-drop — the PR 5
+        deadline split). Returns (ok, slo_class)."""
+        if slo_class in (None, "interactive"):
+            return True, slo_class
+        if from_header:
+            return True, None
+        self._send_json(400, {"error": {"message":
+            "slo_class requires the continuous-engine serving path (no "
+            "lockstep or pod engine, adapter fallback, or logprobs beyond "
+            "--logprobs-k)"}})
+        return False, None
 
     def do_GET(self):
         self._rid = None  # fresh id per request on keep-alive connections
@@ -651,7 +676,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _multi_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, n: int,
         best_of: int, adapter_ids=None, stops=None, grammar=None,
-        trace=None,
+        slo_class=None, slo_from_header=False, trace=None,
     ) -> None:
         """OpenAI ``n``/``best_of``: generate ``best_of`` candidates (the
         continuous engine batches them into shared decode ticks; the
@@ -676,11 +701,17 @@ class _Handler(BaseHTTPRequestHandler):
                 seed=gen.seed,
                 adapter_id=adapter_ids[0] if adapter_ids else None,
                 grammar=grammar,
+                slo_class=slo_class,
                 logprobs=0 if rank else None,
                 trace=trace,
             )
             cands = [(r.tokens, r.lp_token) for r in reqs]
         else:
+            # Lock-step batch fallback: no class-ordered scheduler here —
+            # drop/400 a non-default class (reject-don't-drop).
+            ok, slo_class = self._gate_slo_class(slo_class, slo_from_header)
+            if not ok:
+                return
             if grammar is not None:
                 # Name the ACTUAL missing piece: a guided request can land
                 # here despite a guided-armed continuous engine when
@@ -746,6 +777,9 @@ class _Handler(BaseHTTPRequestHandler):
                 else {"index": i, "text": text, "finish_reason": finish}
             )
         n_prompt = len(prompt_ids)
+        if not use_cont:
+            # Before the response write — see _complete's lockstep note.
+            self._observe_lockstep(t0, total_out)
         self._send_json(200, {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion" if chat else "text_completion",
@@ -758,8 +792,6 @@ class _Handler(BaseHTTPRequestHandler):
                 "total_tokens": n_prompt + total_out,
             },
         })
-        if not use_cont:
-            self._observe_lockstep(t0, total_out)
 
     def _embeddings(self, payload: dict) -> None:
         """OpenAI ``/v1/embeddings``: mean-pooled, L2-normalized final
@@ -930,7 +962,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None,
-        stops=None, lp_n=None, grammar=None, deadline_s=None, trace=None,
+        stops=None, lp_n=None, grammar=None, deadline_s=None, slo_class=None,
+        trace=None,
     ) -> None:
         """OpenAI streaming: real incremental chunks from the continuous
         engine; the lockstep engine generates fully, then emits one chunk.
@@ -976,6 +1009,7 @@ class _Handler(BaseHTTPRequestHandler):
                     seed=gen.seed,
                     grammar=grammar,
                     deadline_s=deadline_s,
+                    slo_class=slo_class,
                     trace=trace,
                 )
             else:
@@ -988,6 +1022,7 @@ class _Handler(BaseHTTPRequestHandler):
                     adapter_id=adapter_ids[0] if adapter_ids else None,
                     grammar=grammar,
                     deadline_s=deadline_s,
+                    slo_class=slo_class,
                     trace=trace,
                 )
 
@@ -1137,6 +1172,38 @@ class _Handler(BaseHTTPRequestHandler):
                         "type": "timeout_error",
                     }})
                     return
+            # SLO class (ISSUE 8): scheduling priority for the continuous
+            # engine's class-ordered admission/preemption. The X-SLO-Class
+            # HEADER wins over the payload field — the gateway stamps it
+            # when per-tenant admission pins a tenant to a class, and the
+            # pin must override whatever the tenant's payload claims. On
+            # paths whose scheduler cannot honor classes (lockstep, the pod
+            # driver's replicated FIFO staging) an explicit non-default
+            # payload value is rejected (reject-don't-drop) while a
+            # header-derived hint is dropped, so gateway-routed traffic
+            # still serves (the PR 5 deadline lesson).
+            from ditl_tpu.infer.continuous import SLO_CLASSES
+
+            slo_class = self.headers.get("X-SLO-Class")
+            slo_from_header = slo_class is not None
+            if slo_class is None:
+                slo_class = payload.get("slo_class")
+            if slo_class is not None:
+                if slo_class not in SLO_CLASSES:
+                    self._send_json(400, {"error": {"message":
+                        f"unknown slo_class {slo_class!r} (one of "
+                        f"{sorted(SLO_CLASSES)})"}})
+                    return
+                classful = (
+                    self.threaded_engine is not None
+                    and getattr(self.threaded_engine,
+                                "supports_slo_classes", True)
+                )
+                if not classful:
+                    ok, slo_class = self._gate_slo_class(
+                        slo_class, slo_from_header)
+                    if not ok:
+                        return
             try:
                 stops = _stop_list(payload.get("stop"))
             except ValueError as e:
@@ -1164,6 +1231,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(400, {"error": {"message":
                     "n and best_of must be integers"}})
                 return
+            if (slo_class is not None and adapter_ids is not None
+                    and not getattr(self.threaded_engine, "multi_lora",
+                                    False)):
+                # Adapter requests on a non-multi-LoRA engine serve via the
+                # lock-step generator — no class-ordered scheduler there.
+                ok, slo_class = self._gate_slo_class(
+                    slo_class, slo_from_header)
+                if not ok:
+                    return
             if deadline_s is not None:
                 # Deadline ENFORCEMENT (queue/slot eviction) lives in the
                 # continuous engine's single-choice path only. Everywhere
@@ -1207,7 +1283,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._multi_complete(
                     payload, prompt, gen, chat=chat, n=n_choices,
                     best_of=best_of, adapter_ids=adapter_ids, stops=stops,
-                    grammar=grammar, trace=span,
+                    grammar=grammar, slo_class=slo_class,
+                    slo_from_header=slo_from_header, trace=span,
                 )
                 return
             # OpenAI semantics: completions' `logprobs: 0` is a real request
@@ -1242,7 +1319,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._stream_complete(
                         payload, prompt, gen, chat=chat,
                         adapter_ids=adapter_ids, stops=stops, lp_n=lp_n,
-                        grammar=grammar, deadline_s=deadline_s, trace=span,
+                        grammar=grammar, deadline_s=deadline_s,
+                        slo_class=slo_class, trace=span,
                     )
                 except QueueFullError as e:
                     # The stream's submit is eager (before SSE headers), so
@@ -1295,6 +1373,7 @@ class _Handler(BaseHTTPRequestHandler):
                         seed=gen.seed,
                         grammar=grammar,
                         deadline_s=deadline_s,
+                        slo_class=slo_class,
                         trace=span,
                     )
                 elif grammar is not None:
@@ -1316,6 +1395,12 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     return
                 else:
+                    # Falling back to lock-step loses the class-ordered
+                    # scheduler: drop/400 a non-default class first.
+                    ok, slo_class = self._gate_slo_class(
+                        slo_class, slo_from_header)
+                    if not ok:
+                        return
                     # Lock-step generator (exact per-step logits): the
                     # no-continuous-engine server, adapter requests, and
                     # n_top beyond the engine's compiled logprobs_k. The
@@ -1408,6 +1493,7 @@ class _Handler(BaseHTTPRequestHandler):
                     adapter_id=adapter_ids[0] if adapter_ids else None,
                     grammar=grammar,
                     deadline_s=deadline_s,
+                    slo_class=slo_class,
                     trace=span,
                 )
                 n_gen = len(out)
@@ -1445,6 +1531,12 @@ class _Handler(BaseHTTPRequestHandler):
             )
             if logprobs_json is not None:
                 choice["logprobs"] = logprobs_json
+            if lockstep_served:
+                # BEFORE the response write: a client that scrapes /metrics
+                # the instant its completion returns must see the counters
+                # moved (the response write itself is not service time —
+                # and recording after it raced exactly that scrape).
+                self._observe_lockstep(t0, n_out)
             self._send_json(
                 200,
                 {
@@ -1460,8 +1552,6 @@ class _Handler(BaseHTTPRequestHandler):
                     },
                 },
             )
-            if lockstep_served:
-                self._observe_lockstep(t0, n_out)
             logger.info(
                 "served %s: %d prompt + %d completion tokens in %.2fs",
                 kind, n_prompt, n_out, time.time() - t0,
@@ -1602,6 +1692,15 @@ def serve(argv: list[str] | None = None) -> int:
         help="chunked prefill for --engine continuous: prompts longer than "
         "this prefill one chunk per tick, interleaved with in-flight "
         "decodes (0 = whole-prompt prefill)",
+    )
+    parser.add_argument(
+        "--token-budget", type=int, default=0,
+        help="per-tick token budget for --engine continuous (ISSUE 8): "
+        "each scheduler tick spends at most budget - decode_ready x "
+        "decode_chunk tokens on prefill chunks, so co-scheduled long "
+        "prompts cannot stall decode-ready streams (stall-free batching; "
+        "pair with --prefill-chunk). Must cover one full decode tick "
+        "(slots x decode-chunk); 0 = unbudgeted",
     )
     parser.add_argument(
         "--speculative", choices=("off", "on", "auto"), default="off",
@@ -1945,6 +2044,7 @@ def serve(argv: list[str] | None = None) -> int:
             draft_params=draft_params, draft_cfg=draft_cfg,
             pipeline_ticks=args.pipeline_ticks,
             admission=args.admission,
+            token_budget=args.token_budget,
             tracer=tracer,
         )
 

@@ -45,18 +45,28 @@ COMPARE_KEYS = {
     "roofline_mfu_cap": 0,  # informational: config property, never gates
     "step_time_p50_ms": -1,
     "step_ms": -1,
+    # Serving-row keys (ISSUE 8, bench --serve-* rows' hoisted `serving`
+    # block): scheduler-interference p95 regresses when it RISES (a stall
+    # crept back into the budgeted tick composition); the measured
+    # prefix-cache hit ratio regresses when it FALLS (routing or paging
+    # stopped reusing KV). p50 and totals are reported-not-gated noise.
+    "interference_p95_s": -1,
+    "prefix_cache_hit_ratio": +1,
+    "ttft_p95_s": -1,
 }
 
 
 def _flat(rec: dict) -> dict:
     """The comparable view of one record/cell: top-level keys plus the
-    nested ``roofline`` block hoisted (mfu_cost / roofline_mfu_cap live
-    there in bench rows — without the hoist the gate would silently never
-    compare cost-counted MFU)."""
-    nested = rec.get("roofline")
-    if isinstance(nested, dict):
-        return {**nested, **rec}
-    return rec
+    nested ``roofline`` (train rows) and ``serving`` (serve rows) blocks
+    hoisted — without the hoist the gate would silently never compare
+    cost-counted MFU or the serving scheduler metrics."""
+    out = rec
+    for block in ("roofline", "serving"):
+        nested = rec.get(block)
+        if isinstance(nested, dict):
+            out = {**nested, **out}
+    return out
 
 
 def compare_metrics(

@@ -25,6 +25,15 @@ Semantics worth pinning (the vLLM-style contract, adapted to chunked ticks):
   forward kept; rejected = drafted tokens it threw away. The per-round bonus
   token (emitted even at zero acceptance) is neither — it is ordinary decode
   output, counted by ``tokens_generated``.
+- **prefix-cache hit/miss tokens** (ISSUE 8): at slot admission, prompt
+  tokens whose KV came from the prefix cache (paged content-hash match or a
+  registered contiguous prefix) count as hits; tokens the engine actually
+  prefilled count as misses. Resume re-prefills after a preemption are
+  NEITHER — the request already paid (and was credited) for its prompt at
+  first admission; resume cost is thrash, tracked separately. The ratio
+  gauge is recomputed from the counters at render time, and TTFT is
+  additionally observed into a hit/miss split pair so "does a routed cache
+  hit actually buy latency" is answerable from /metrics alone.
 
 All increments are host-side floats/ints the scheduler already holds — zero
 device syncs (registry.py's rule).
@@ -34,15 +43,17 @@ from __future__ import annotations
 
 import math
 import time
-from typing import Iterable
+from typing import Iterable, Sequence
 
 from ditl_tpu.telemetry.registry import (
     LATENCY_BUCKETS_S,
+    Histogram,
     MetricsRegistry,
     TOKEN_LATENCY_BUCKETS_S,
 )
 
-__all__ = ["ServingMetrics", "backlog_retry_after"]
+__all__ = ["ServingMetrics", "backlog_retry_after", "merged_histogram",
+           "serving_bench_summary", "snapshot_serving"]
 
 
 def backlog_retry_after(
@@ -144,9 +155,147 @@ class ServingMetrics:
             f"{PREFIX}_client_disconnects",
             "in-flight generations cancelled because the client vanished "
             "mid-stream")
+        # -- prefix-cache accounting (ISSUE 8) ---------------------------
+        self.prefix_cache_hit_tokens = r.counter(
+            f"{PREFIX}_prefix_cache_hit_tokens",
+            "prompt tokens whose KV was reused from the prefix cache at "
+            "slot admission (paged content-hash match or registered prefix)")
+        self.prefix_cache_miss_tokens = r.counter(
+            f"{PREFIX}_prefix_cache_miss_tokens",
+            "prompt tokens the engine prefilled because no cached KV "
+            "covered them")
+        self.prefix_cache_evictions = r.counter(
+            f"{PREFIX}_prefix_cache_evictions",
+            "published prefix pages reclaimed by LRU eviction under pool "
+            "pressure")
+        self.prefix_cache_hit_ratio = r.gauge(
+            f"{PREFIX}_prefix_cache_hit_ratio",
+            "measured hit tokens / (hit + miss) tokens — the number the "
+            "gateway affinity router's score is validated against")
+        self.ttft_cache_hit = r.histogram(
+            f"{PREFIX}_request_ttft_cache_hit_seconds",
+            "TTFT of requests whose prompt hit the prefix cache (>= 1 "
+            "reused token)", LATENCY_BUCKETS_S,
+        )
+        self.ttft_cache_miss = r.histogram(
+            f"{PREFIX}_request_ttft_cache_miss_seconds",
+            "TTFT of requests whose prompt missed the prefix cache "
+            "entirely", LATENCY_BUCKETS_S,
+        )
+
+    def note_prefix_cache(self, hit_tokens: int, miss_tokens: int) -> None:
+        """Record one admission's reused-vs-prefilled prompt token split."""
+        if hit_tokens > 0:
+            self.prefix_cache_hit_tokens.inc(hit_tokens)
+        if miss_tokens > 0:
+            self.prefix_cache_miss_tokens.inc(miss_tokens)
+
+    def cache_hit_ratio(self) -> float | None:
+        """hit / (hit + miss) tokens; None before any admission."""
+        hit = self.prefix_cache_hit_tokens.value
+        total = hit + self.prefix_cache_miss_tokens.value
+        if total == 0:
+            return None
+        return hit / total
+
+    def _refresh_derived(self) -> None:
+        ratio = self.cache_hit_ratio()
+        if ratio is not None:
+            self.prefix_cache_hit_ratio.set(round(ratio, 6))
 
     def render(self) -> str:
+        self._refresh_derived()
         return self.registry.render()
 
     def summary(self) -> dict:
+        self._refresh_derived()
         return self.registry.summary()
+
+
+def merged_histogram(hists: Sequence[Histogram]) -> Histogram:
+    """One histogram holding every input's observations (identical bucket
+    ladders required) — how fleet-level quantiles are computed from
+    per-replica instruments without a shared registry (bench.py embeds
+    the p50/p95 of the merged interference histogram, not a quantile of
+    per-replica quantiles, which would not be a quantile of anything)."""
+    if not hists:
+        raise ValueError("need at least one histogram to merge")
+    buckets = hists[0].buckets
+    out = Histogram("_merged", buckets=buckets)
+    for h in hists:
+        if h.buckets != buckets:
+            raise ValueError(
+                f"bucket ladders differ: {h.buckets} vs {buckets}"
+            )
+        for i, c in enumerate(h._counts):
+            out._counts[i] += c
+        out._sum += h._sum
+        out._count += h._count
+    return out
+
+
+def snapshot_serving(bundles: Sequence["ServingMetrics"]) -> dict:
+    """Cumulative snapshot of the instruments ``serving_bench_summary``
+    consumes — taken AFTER warm-up so the gated summary covers only the
+    timed region (warm-up TTFTs are compile seconds, and their prompt
+    misses deflate the hit ratio; both would corrupt the perf_compare
+    gate)."""
+    return {
+        "interference": [
+            (list(b.tpot_interference._counts), b.tpot_interference.sum,
+             b.tpot_interference.count) for b in bundles
+        ],
+        "ttft": [
+            (list(b.ttft._counts), b.ttft.sum, b.ttft.count)
+            for b in bundles
+        ],
+        "hit": sum(b.prefix_cache_hit_tokens.value for b in bundles),
+        "miss": sum(b.prefix_cache_miss_tokens.value for b in bundles),
+        "evictions": sum(
+            b.prefix_cache_evictions.value for b in bundles
+        ),
+    }
+
+
+def _subtract(hist: Histogram, snaps) -> None:
+    for counts, s, c in snaps:
+        for i, v in enumerate(counts):
+            hist._counts[i] -= v
+        hist._sum -= s
+        hist._count -= c
+
+
+def serving_bench_summary(bundles: Sequence["ServingMetrics"],
+                          since: dict | None = None) -> dict:
+    """The serving block a ``bench.py --serve-*`` row embeds (ISSUE 8
+    satellite): fleet-merged interference quantiles plus the measured
+    prefix-cache hit ratio, flat numeric keys so
+    ``telemetry/perf_compare.py`` can gate them like train metrics.
+    ``since`` (a :func:`snapshot_serving` taken after warm-up) restricts
+    every number to the timed region."""
+    interference = merged_histogram([b.tpot_interference for b in bundles])
+    ttft = merged_histogram([b.ttft for b in bundles])
+    hit = sum(b.prefix_cache_hit_tokens.value for b in bundles)
+    miss = sum(b.prefix_cache_miss_tokens.value for b in bundles)
+    evictions = sum(b.prefix_cache_evictions.value for b in bundles)
+    if since is not None:
+        _subtract(interference, since["interference"])
+        _subtract(ttft, since["ttft"])
+        hit -= since["hit"]
+        miss -= since["miss"]
+        evictions -= since["evictions"]
+    out = {
+        "interference_count": interference.count,
+        "interference_total_s": round(interference.sum, 6),
+        "prefix_cache_hit_tokens": int(hit),
+        "prefix_cache_miss_tokens": int(miss),
+        "prefix_cache_evictions": int(evictions),
+    }
+    tq = ttft.quantile(0.95)
+    out["ttft_p95_s"] = round(tq, 6) if tq is not None else None
+    for q, key in ((0.5, "interference_p50_s"), (0.95, "interference_p95_s")):
+        v = interference.quantile(q)
+        out[key] = round(v, 6) if v is not None else None
+    if hit + miss > 0:
+        out["prefix_cache_hit_ratio"] = round(hit / (hit + miss), 4)
+    return out
