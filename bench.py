@@ -573,20 +573,34 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
     return 0
 
 
-def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
-                  prompt_len: int = 0, max_new: int = 0,
-                  router: str = "affinity",
-                  compile_cache_dir: str = "",
-                  trace_out: str = "",
-                  prefill_chunk: int = -1,
-                  token_budget: int = -1) -> int:
+def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
+                      prompt_len: int = 0, max_new: int = 0,
+                      router: str = "affinity",
+                      compile_cache_dir: str = "",
+                      trace_out: str = "",
+                      prefill_chunk: int = -1,
+                      token_budget: int = -1,
+                      roles: str = "",
+                      mixed_trace: bool = False,
+                      _model_overrides: dict | None = None) -> dict:
     """Fleet-level serving benchmark (ISSUE 4 satellite): N in-process
     continuous-engine replicas behind the gateway, driven over real HTTP
     with a prefix-grouped workload (the regime cache-affinity routing
     exists for). Records fleet throughput, the measured affinity hit-rate,
-    and retry counts in the bench JSON so BENCH_r*.json rows can track
-    fleet-level numbers round over round. One JSON line, like every other
-    bench mode."""
+    and retry counts in a bench row dict so BENCH_r*.json rows can track
+    fleet-level numbers round over round.
+
+    ``roles`` (ISSUE 9) arms a heterogeneous fleet: a comma-separated role
+    per replica (gateway/roles.py; shorter specs pad with hybrid), each
+    replica's engine knobs derived via role_knobs from the base
+    slots/prefill_chunk/token_budget. ``mixed_trace`` adds long batch-class
+    prompts alongside the interactive short streams — the
+    disagg-vs-homogeneous A/B workload; the row then carries per-class
+    TTFT/interference p95s (perf_compare-gated on the interactive pair),
+    the worst single interactive interference observation, ``fleet_roles``
+    and per-role serving sub-blocks. ``_model_overrides`` shrinks the bench
+    model (tier-1 acceptance drills only — a published row must not use
+    it)."""
     import dataclasses
     from concurrent.futures import ThreadPoolExecutor
 
@@ -594,7 +608,10 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
 
     from ditl_tpu.config import GatewayConfig, ModelConfig
     from ditl_tpu.data.tokenizer import ByteTokenizer
-    from ditl_tpu.gateway import Fleet, GatewayMetrics, InProcessReplica, make_gateway
+    from ditl_tpu.gateway import (
+        Fleet, GatewayMetrics, InProcessReplica, make_gateway, parse_roles,
+        role_knobs,
+    )
     from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
     from ditl_tpu.infer.engine import GenerateConfig, Generator
     from ditl_tpu.infer.server import make_server
@@ -616,10 +633,17 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     if platform != "tpu":
         cfg = dataclasses.replace(cfg, num_layers=2, hidden_size=256,
                                   intermediate_size=688, vocab_size=4096)
+    if _model_overrides:
+        cfg = dataclasses.replace(cfg, **_model_overrides)
+    role_list = parse_roles(roles, n_replicas)
     params = llama.init_params(jax.random.key(0), cfg)
     tok = ByteTokenizer()
     shared_gen = Generator(params, cfg, tok)  # tokenize/metadata routes only
     n_requests = n_replicas * slots * 2
+    # Mixed traces add one long batch prompt per replica on top of the
+    # short streams; every request must fit in one replica's admission
+    # queue (a worst-case affinity pileup must spill, not 429 the bench).
+    total_requests = n_requests + (n_replicas if mixed_trace else 0)
     # Pinned serving config (ISSUE 8): paged KV (so the prefix-cache hit
     # ratio the row embeds is a real measured number, not vacuously zero)
     # with chunked prefill ON at a page-size-aligned default and a per-tick
@@ -663,27 +687,41 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         )
         trace_journals.append(gw_journal)
         gw_tracer = Tracer(gw_journal)
+    # Per-replica engine knobs from the role (gateway/roles.py): hybrid =
+    # the base config untouched, prefill_heavy = fewer slots / 4x chunk /
+    # 4x budget / 2x pages, decode_heavy = 2x slots with the tightest legal
+    # budget. Pages are made explicit so the scale applies to the same
+    # contiguous-equivalent default the engine would have picked.
+    maxp = -(-cfg.max_seq_len // page_size)
+    knob_list = [
+        role_knobs(role, n_slots=slots, decode_chunk=decode_chunk,
+                   prefill_chunk=prefill_chunk, token_budget=token_budget)
+        for role in role_list
+    ]
     engines = [
         ThreadedEngine(ContinuousEngine(
-            params, cfg, tok, n_slots=slots, decode_chunk=decode_chunk,
+            params, cfg, tok, n_slots=k["n_slots"],
+            decode_chunk=decode_chunk,
             gen=GenerateConfig(max_new_tokens=max_new),
-            max_queue=n_requests,
+            max_queue=total_requests,
             cache_mode="paged", page_size=page_size,
-            prefill_chunk=prefill_chunk,
-            token_budget=token_budget,
+            n_pages=int(k["pages_scale"] * (k["n_slots"] * maxp + 1)),
+            prefill_chunk=k["prefill_chunk"],
+            token_budget=k["token_budget"],
             tracer=tracers[i],
         ))
-        for i in range(n_replicas)
+        for i, k in enumerate(knob_list)
     ]
 
-    def factory(eng):
+    def factory(eng, role):
         # make_server derives its tracer from the engine's, so replica
         # server.request spans land in the same per-replica journal.
         return lambda: make_server(shared_gen, port=0, threaded_engine=eng,
-                                   default_max_tokens=max_new)
+                                   default_max_tokens=max_new, role=role)
 
     fleet = Fleet([
-        InProcessReplica(f"r{i}", factory(eng))
+        InProcessReplica(f"r{i}", factory(eng, role_list[i]),
+                         role=role_list[i])
         for i, eng in enumerate(engines)
     ])
     fleet.start_all(wait_healthy_s=30.0)
@@ -702,22 +740,44 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     # Prefix-grouped workload: n_replicas * 2 groups x slots requests, each
     # sharing its group's long prefix — the fleet analog of the paged
     # prefix-reuse regime. Shuffled deterministically so groups interleave.
+    # With mixed_trace the shorts become explicit interactive-class STREAMS
+    # (alternating generation lengths — identical max_new would march the
+    # fleet in synchronized admit/decode cohorts where prefills never
+    # co-schedule against live decodes, hiding exactly the interference
+    # this A/B measures) and one long batch-class prompt per replica rides
+    # along (4x plen, distinct prefixes — the longs must not seed the
+    # groups' caches), submitted LAST so batch work lands while the
+    # interactive streams are mid-decode: the disagg-vs-homogeneous A/B
+    # workload.
     groups = n_replicas * 2
+    long_plen = plen * 4
     prompts = []
     for g in range(groups):
         prefix = " ".join(f"g{g}tok{j}" for j in range(plen))
         for i in range(max(1, n_requests // groups)):
-            prompts.append(f"{prefix} q{i}")
+            mt = max_new * 2 if mixed_trace and i % 2 else max_new
+            prompts.append((f"{prefix} q{i}",
+                            "interactive" if mixed_trace else None, mt))
     import random as _random
 
     _random.Random(7).shuffle(prompts)
+    if mixed_trace:
+        prompts += [
+            (" ".join(f"long{g}tok{j}" for j in range(long_plen)),
+             "batch", max_new)
+            for g in range(n_replicas)
+        ]
 
     import urllib.request
 
-    def one(prompt):
+    def one(item):
+        prompt, slo_class, max_tokens = item
+        body = {"prompt": prompt, "max_tokens": max_tokens}
+        if slo_class:
+            body["slo_class"] = slo_class
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/v1/completions",
-            data=json.dumps({"prompt": prompt, "max_tokens": max_new}).encode(),
+            data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"}, method="POST",
         )
         with urllib.request.urlopen(req, timeout=600) as resp:
@@ -726,8 +786,11 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     # Group-length warm prompt (distinct from every group prefix): the
     # paged chunked-prefill programs are keyed by (chunk, ctx-pages)
     # bucket, so a short warm-up would leave the long-prompt buckets to
-    # compile inside the timed region.
+    # compile inside the timed region. Mixed traces additionally warm the
+    # LONG-prompt bucket on every replica that can receive batch work
+    # (hybrid/prefill_heavy — role steering keeps longs off decode_heavy).
     warm_prompt = " ".join(f"warmtok{j}" for j in range(plen))
+    warm_long = " ".join(f"warmlongtok{j}" for j in range(long_plen))
 
     def warm(view):
         # Compile each engine OUTSIDE the timed region by hitting every
@@ -736,24 +799,39 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         # arbitrary homes), leaving cold engines to compile inside the
         # timed section by a policy-dependent amount, which would corrupt
         # the router A/B this bench exists for.
-        req = urllib.request.Request(
-            f"http://{view.address[0]}:{view.address[1]}/v1/completions",
-            data=json.dumps(
-                {"prompt": warm_prompt, "max_tokens": max_new}
-            ).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
-        )
-        with urllib.request.urlopen(req, timeout=600) as resp:
-            resp.read()
+        warms = [warm_prompt]
+        if mixed_trace and view.role != "decode_heavy":
+            warms.append(warm_long)
+        for p in warms:
+            req = urllib.request.Request(
+                f"http://{view.address[0]}:{view.address[1]}/v1/completions",
+                data=json.dumps(
+                    {"prompt": p, "max_tokens": max_new}
+                ).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                resp.read()
 
+    bundles_by_role: dict = {}
+    for role, eng in zip(role_list, engines):
+        bundles_by_role.setdefault(role, []).append(eng._engine.metrics)
     with ThreadPoolExecutor(max_workers=n_replicas * slots) as pool:
         list(pool.map(warm, fleet.views()))
         # Snapshot AFTER warm-up: the gated serving block must cover the
         # timed region only (warm TTFTs are compile seconds, and the warm
-        # prompts' misses would deflate the hit ratio).
+        # prompts' misses would deflate the hit ratio). Per-role snapshots
+        # scope the role sub-blocks identically, and the worst-observation
+        # trackers reset so they too cover only the timed region.
         serving_base = snapshot_serving(
             [eng._engine.metrics for eng in engines]
         )
+        role_base = {
+            role: snapshot_serving(b) for role, b in bundles_by_role.items()
+        }
+        for eng in engines:
+            eng._engine.interference_max_s = 0.0
+            eng._engine.interference_max_by_class = {}
         t0 = time.perf_counter()
         tokens = sum(pool.map(one, prompts))
         dt = time.perf_counter() - t0
@@ -776,7 +854,16 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         }}
         print(f"bench: wrote Chrome-trace JSON to {trace_out} "
               f"(open at https://ui.perfetto.dev)", file=sys.stderr)
-    print(json.dumps({
+    # Worst single interactive interference observation across the fleet
+    # (ISSUE 9): the wall-clock stall an interactive stream actually
+    # absorbed in one tick — the number the disagg acceptance drill grades
+    # strictly. None when no interactive victim was ever co-scheduled.
+    i_max = [
+        eng._engine.interference_max_by_class.get("interactive")
+        for eng in engines
+    ]
+    i_max = [v for v in i_max if v is not None]
+    row = {
         "metric": "fleet decode tokens/sec (%d replica(s) x %d slots, "
                   "router=%s)" % (n_replicas, slots, router),
         **_record_meta(),
@@ -791,13 +878,17 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         # quantiles + the measured prefix-cache hit ratio, flat numeric
         # keys so telemetry/perf_compare.py gates serving regressions the
         # same way it gates train rows (the block is hoisted like
-        # `roofline`).
+        # `roofline`). ISSUE 9 adds the per-class p95 splits (interactive
+        # gated) and the worst interactive stall.
         "serving": {
             "prefill_chunk": prefill_chunk,
             "token_budget": token_budget,
             "page_size": page_size,
             "max_tick_prefill_tokens": max(
                 eng._engine.max_tick_prefill_tokens for eng in engines
+            ),
+            "interactive_interference_max_s": (
+                round(max(i_max), 6) if i_max else None
             ),
             **serving_bench_summary(
                 [eng._engine.metrics for eng in engines],
@@ -806,6 +897,7 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         },
         "gateway": {
             "router": router,
+            "fleet_roles": role_list,
             "affinity_ratio": summary.get("ditl_gateway_affinity_ratio"),
             "retries": summary.get("ditl_gateway_retries", 0),
             "hedges": summary.get("ditl_gateway_hedges", 0),
@@ -815,15 +907,30 @@ def bench_gateway(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
                 if k.startswith("ditl_gateway_replica_")
                 and k.endswith("_routed")
             },
+            # Per-role serving sub-blocks (ISSUE 9 satellite): the same
+            # timed-region summary, scoped to each role's engines — how a
+            # BENCH_r*.json row shows which half of a disaggregated fleet
+            # moved.
+            "serving_by_role": {
+                role: serving_bench_summary(b, since=role_base[role])
+                for role, b in bundles_by_role.items()
+            },
         },
         **trace_extra,
         **_chaos_result(),
-    }))
+    }
     server.shutdown()
     server.server_close()
     fleet.stop_all(drain=True, timeout=10.0)
     for eng in engines:
         eng.close()
+    return row
+
+
+def bench_gateway(*args, **kwargs) -> int:
+    """CLI wrapper over :func:`run_gateway_bench`: one JSON line, like
+    every other bench mode."""
+    print(json.dumps(run_gateway_bench(*args, **kwargs)))
     return 0
 
 
@@ -1350,6 +1457,18 @@ if __name__ == "__main__":
                         help="with --serve-replicas: per-tick token budget "
                         "per replica engine (-1 = slots x decode-chunk + "
                         "prefill-chunk, ON; 0 = unbudgeted scheduler)")
+    parser.add_argument("--serve-roles", default="", metavar="ROLES",
+                        help="with --serve-replicas: heterogeneous fleet "
+                        "roles, comma-separated per replica (ISSUE 9), e.g. "
+                        "'prefill_heavy,decode_heavy,decode_heavy'; shorter "
+                        "specs pad with hybrid, '' = homogeneous. Engine "
+                        "knobs derive from the role (gateway/roles.py)")
+    parser.add_argument("--serve-mixed-trace", action="store_true",
+                        help="with --serve-replicas: add one long batch-"
+                        "class prompt per replica alongside the interactive "
+                        "short streams — the disagg-vs-homogeneous A/B "
+                        "workload; the row gains per-class TTFT/interference "
+                        "p95s (interactive pair perf_compare-gated)")
     args = parser.parse_args()
     if args.chaos:
         from ditl_tpu.chaos import FaultPlane, arm
@@ -1388,6 +1507,8 @@ if __name__ == "__main__":
             trace_out=args.trace_out,
             prefill_chunk=args.serve_prefill_chunk,
             token_budget=args.serve_token_budget,
+            roles=args.serve_roles,
+            mixed_trace=args.serve_mixed_trace,
         ))
     if args.infer:
         sys.exit(bench_infer(

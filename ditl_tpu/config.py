@@ -496,6 +496,22 @@ class GatewayConfig:
     # enforcement at the front door (ISSUE 8). Programmatic per-tenant pins
     # ride TenantAdmission(per_tenant={"name": {"slo_class": ...}}).
     tenant_slo_class: str = ""
+    # Disaggregated prefill/decode fleets (ISSUE 9): comma-separated role
+    # per launcher-spawned replica ("prefill_heavy,decode_heavy,..."), each
+    # of gateway/roles.ROLES; shorter specs pad with "hybrid", "" = a
+    # homogeneous (all-hybrid) fleet. The launcher derives each replica's
+    # engine knobs (slots / prefill chunk / token budget / pages) from its
+    # role via gateway.roles.role_knobs.
+    replica_roles: str = ""
+    # Steer requests by SLO class across replica roles (interactive ->
+    # decode_heavy/hybrid, long-prompt batch/best_effort -> prefill_heavy/
+    # hybrid) before the routing policy picks. A no-op on homogeneous
+    # fleets; False disables steering even on heterogeneous ones.
+    role_routing: bool = True
+    # Whitespace-token threshold above which a batch/best_effort prompt
+    # counts as "long" for prefill-heavy steering; 0 = every batch/
+    # best_effort request steers regardless of prompt size.
+    long_prompt_tokens: int = 0
     # Journal directory for replica lifecycle events
     # (events-gateway.jsonl via telemetry/journal.py); "" = no journal.
     journal_dir: str = ""
@@ -526,6 +542,17 @@ class GatewayConfig:
                     f"{self.tenant_slo_class!r} "
                     f"(one of {SLO_CLASS_NAMES}, or empty for no pin)"
                 )
+        if self.long_prompt_tokens < 0:
+            raise ValueError(
+                f"gateway.long_prompt_tokens must be >= 0, got "
+                f"{self.long_prompt_tokens}"
+            )
+        if self.replica_roles:
+            # Same reject-don't-drop rule: a typo'd role must fail the
+            # launch, not silently serve a hybrid.
+            from ditl_tpu.gateway.roles import parse_roles
+
+            parse_roles(self.replica_roles, self.replicas)
 
 
 @dataclass(frozen=True)

@@ -24,12 +24,19 @@ from ditl_tpu.gateway.replica import (
     SubprocessReplica,
     gateway_journal_path,
 )
+from ditl_tpu.gateway.roles import (
+    ROLES,
+    parse_roles,
+    role_candidates,
+    role_knobs,
+)
 from ditl_tpu.gateway.router import (
     CacheAffinityPolicy,
     LeastOutstandingPolicy,
     RoundRobinPolicy,
     affinity_key,
     make_policy,
+    prompt_token_estimate,
     stable_hash,
 )
 
@@ -41,6 +48,7 @@ __all__ = [
     "GatewayMetrics",
     "InProcessReplica",
     "LeastOutstandingPolicy",
+    "ROLES",
     "ReplicaHandle",
     "ReplicaView",
     "RoundRobinPolicy",
@@ -51,6 +59,10 @@ __all__ = [
     "gateway_journal_path",
     "make_gateway",
     "make_policy",
+    "parse_roles",
+    "prompt_token_estimate",
+    "role_candidates",
+    "role_knobs",
     "sanitize_label",
     "stable_hash",
     "tenant_label",

@@ -192,6 +192,10 @@ class TenantAdmission:
         return st
 
     def acquire(self, tenant: str) -> AdmissionDecision:
+        # Denials carry the tenant's SLO-class pin too: the gateway's
+        # per-class 429 counters must attribute a throttled request to the
+        # class it WOULD have been scheduled under (pin wins), the same
+        # attribution its routed/relayed/saturated counters use.
         with self._lock:
             st = self._state(tenant)
             if st.max_concurrent > 0 and st.active >= st.max_concurrent:
@@ -200,6 +204,7 @@ class TenantAdmission:
                     False, retry_after_s=1.0,
                     reason=f"tenant concurrency cap ({st.max_concurrent}) "
                            "reached",
+                    slo_class=st.slo_class,
                 )
             if st.bucket is not None:
                 wait = st.bucket.try_take(1.0)
@@ -208,6 +213,7 @@ class TenantAdmission:
                     return AdmissionDecision(
                         False, retry_after_s=wait,
                         reason="tenant rate limit exceeded",
+                        slo_class=st.slo_class,
                     )
             st.active += 1
             st.admitted += 1

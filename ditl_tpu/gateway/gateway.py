@@ -47,7 +47,10 @@ from ditl_tpu.gateway.admission import (
     SLO_CLASS_NAMES, TenantAdmission, sanitize_label, tenant_label,
 )
 from ditl_tpu.gateway.replica import Fleet, FleetSupervisor
-from ditl_tpu.gateway.router import affinity_key, make_policy
+from ditl_tpu.gateway.roles import role_candidates
+from ditl_tpu.gateway.router import (
+    affinity_key, make_policy, prompt_token_estimate,
+)
 from ditl_tpu.telemetry.registry import LATENCY_BUCKETS_S, MetricsRegistry
 from ditl_tpu.telemetry.serving import backlog_retry_after
 from ditl_tpu.telemetry.slo import BurnRateMonitor, gateway_slo
@@ -125,6 +128,28 @@ class GatewayMetrics:
             f"{PREFIX}_replica_{sanitize_label(replica_id)}_{kind}",
             f"requests {kind} for replica {sanitize_label(replica_id)}")
 
+    def class_counter(self, kind: str, slo_class: str | None):
+        """Per-SLO-class routed/relayed/429 counters (ISSUE 9 satellite):
+        ``ditl_gateway_<kind>_by_class_<class>`` — class steering is
+        observable from /metrics without reading journals. Attribution is
+        the class the request is SCHEDULED under: a tenant pin wins, else
+        the client's ask; requests with neither land under ``default``
+        (the engine schedules those as interactive). Bounded: 3 known
+        classes + default."""
+        label = sanitize_label(slo_class or "default")
+        return self.registry.counter(
+            f"{PREFIX}_{kind}_by_class_{label}",
+            f"requests {kind} carrying SLO class {label}")
+
+    def role_counter(self, role: str, kind: str):
+        """Per-replica-role routed/spilled counters (ISSUE 9): the
+        disaggregated fleet's steering decisions, aggregated by role
+        rather than replica id. Bounded: 3 roles."""
+        label = sanitize_label(role or "hybrid")
+        return self.registry.counter(
+            f"{PREFIX}_role_{label}_{kind}",
+            f"requests {kind} on {label}-role replicas")
+
     def tenant_counter(self, tenant: str, kind: str):
         label = sanitize_label(tenant)
         if label not in self._tenant_labels:
@@ -150,35 +175,86 @@ class GatewayMetrics:
         if fleet is not None:
             self.replicas_live.set(fleet.live_count())
             self.replicas_draining.set(fleet.draining_count())
-            self._set_cache_gauges(fleet)
+            views = fleet.views()
+            self._set_cache_gauges(views)
+            self._set_role_gauges(views)
         return self.registry.render()
 
-    def _set_cache_gauges(self, fleet: Fleet) -> None:
+    def _set_cache_gauges(self, views) -> None:
         """Per-replica + token-weighted fleet prefix-cache hit ratios
         (ISSUE 8), sourced from each replica's last /health poll (no scrape
         fan-out) and rendered NEXT TO the routing-side affinity hit-rate so
         the router's claim (routed hit => KV reuse) is checkable from one
         exposition: affinity_ratio high while fleet_prefix_cache_hit_ratio
         is ~0 means the router is keying on something the engines cannot
-        reuse (docs/troubleshooting.md §26)."""
+        reuse (docs/troubleshooting.md §26). The lifetime ratio and the
+        windowed recent ratio (ISSUE 9 — per-poll deltas, what the spill
+        walk actually steers on) render side by side so a stale-sticky
+        lifetime number is visible as such."""
         hit = miss = 0
-        for v in fleet.views():
+        r_hit = r_miss = 0
+        for v in views:
+            rid = sanitize_label(v.id)
             ratio = v.cache_hit_ratio
-            if ratio is None:
-                continue
-            hit += v.cache_hit_tokens
-            miss += v.cache_miss_tokens
-            self.registry.gauge(
-                f"{PREFIX}_replica_{sanitize_label(v.id)}_prefix_cache_hit_ratio",
-                f"measured engine prefix-cache hit ratio of replica "
-                f"{sanitize_label(v.id)} (from its last health poll)",
-            ).set(round(ratio, 4))
+            if ratio is not None:
+                hit += v.cache_hit_tokens
+                miss += v.cache_miss_tokens
+                self.registry.gauge(
+                    f"{PREFIX}_replica_{rid}_prefix_cache_hit_ratio",
+                    f"measured engine prefix-cache hit ratio of replica "
+                    f"{rid} (lifetime, from its last health poll)",
+                ).set(round(ratio, 4))
+            recent = v.recent_cache_hit_ratio
+            if recent is not None:
+                r_hit += v.recent_cache_hit_tokens
+                r_miss += v.recent_cache_miss_tokens
+                self.registry.gauge(
+                    f"{PREFIX}_replica_{rid}_recent_prefix_cache_hit_ratio",
+                    f"windowed (last few health polls) prefix-cache hit "
+                    f"ratio of replica {rid} - the spill-steering input",
+                ).set(round(recent, 4))
         if hit + miss:
             self.registry.gauge(
                 f"{PREFIX}_fleet_prefix_cache_hit_ratio",
                 "token-weighted fleet prefix-cache hit ratio - compare "
                 "against the affinity hit-rate counters",
             ).set(round(hit / (hit + miss), 4))
+        if r_hit + r_miss:
+            self.registry.gauge(
+                f"{PREFIX}_fleet_recent_prefix_cache_hit_ratio",
+                "token-weighted fleet prefix-cache hit ratio over the "
+                "recent health-poll window",
+            ).set(round(r_hit / (r_hit + r_miss), 4))
+
+    def _set_role_gauges(self, views) -> None:
+        """Per-role fleet aggregation (ISSUE 9): live replica counts and
+        worst-case (max) TTFT/TPOT p95 across each role's replicas, plus
+        the role's peak slot pressure — the per-role latency view that
+        makes 'which half of the disaggregated fleet is hurting' a single
+        scrape (docs/troubleshooting.md §27)."""
+        by_role: dict[str, list] = {}
+        for v in views:
+            by_role.setdefault(v.role or "hybrid", []).append(v)
+        for role, vs in sorted(by_role.items()):
+            label = sanitize_label(role)
+            self.registry.gauge(
+                f"{PREFIX}_role_{label}_replicas_live",
+                f"live {label}-role replicas",
+            ).set(sum(1 for v in vs if v.live))
+            self.registry.gauge(
+                f"{PREFIX}_role_{label}_slot_pressure",
+                f"max active_slots/capacity across {label}-role replicas",
+            ).set(round(max((v.slot_pressure for v in vs), default=0.0), 4))
+            for key, name in (("ttft_p95_s", "ttft"),
+                              ("tpot_p95_s", "tpot")):
+                vals = [getattr(v, key) for v in vs
+                        if isinstance(getattr(v, key), (int, float))]
+                if vals:
+                    self.registry.gauge(
+                        f"{PREFIX}_role_{label}_{name}_p95_s",
+                        f"worst per-replica {name} p95 across {label}-role "
+                        "replicas (lifetime histograms, health-polled)",
+                    ).set(round(max(vals), 6))
 
     def summary(self) -> dict:
         out = self.registry.summary()
@@ -291,11 +367,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                         "address": list(v.address),
                         "live": v.live,
                         "draining": v.draining,
+                        "role": v.role,
                         "outstanding": v.outstanding,
                         "queue_depth": v.queue_depth,
                         "active_slots": v.active_slots,
                         "capacity": v.capacity,
+                        "slot_pressure": round(v.slot_pressure, 4),
                         "prefix_cache_hit_ratio": v.cache_hit_ratio,
+                        "recent_prefix_cache_hit_ratio":
+                            v.recent_cache_hit_ratio,
+                        "ttft_p95_s": v.ttft_p95_s,
+                        "tpot_p95_s": v.tpot_p95_s,
                     }
                     for v in self.fleet.views()
                 },
@@ -459,6 +541,12 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             if not decision.ok:
                 m.throttled.inc()
                 m.tenant_counter(label, "throttled").inc()
+                # Same attribution as routed/relayed/saturated: the class
+                # the request would have been scheduled under (pin wins).
+                m.class_counter(
+                    "429",
+                    decision.slo_class or self._client_class(payload),
+                ).inc()
                 if span is not None:
                     span.annotate(throttled=True)
                 self._send_json(
@@ -480,12 +568,28 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 self.admission.release(tenant)
             m.e2e.observe(time.time() - t0)
 
+    def _client_class(self, payload: dict) -> str | None:
+        """The SLO class the CLIENT asked for (validated header, else
+        payload) — the metrics/steering view before any tenant pin."""
+        cls = self.headers.get("X-SLO-Class")
+        if cls in SLO_CLASS_NAMES:
+            return cls
+        cls = payload.get("slo_class")
+        return cls if cls in SLO_CLASS_NAMES else None
+
     def _route_and_relay(self, path: str, payload: dict, raw: bytes,
                          record: bool = True, span=None,
                          slo_class: str | None = None) -> None:
         m, cfg = self.gw, self.gwcfg
         stream = bool(payload.get("stream"))
         key = affinity_key(payload, cfg.affinity_prefix_tokens)
+        # The class the REPLICA will schedule under: the tenant pin wins
+        # (it rides X-SLO-Class on every relay, overriding the payload),
+        # else whatever the client asked for. This is also the routing
+        # input for role steering on disaggregated fleets (ISSUE 9).
+        eff_class = slo_class or self._client_class(payload)
+        prompt_toks = prompt_token_estimate(payload) if cfg.role_routing \
+            else 0
         # Deadline propagation (ISSUE 5): the effective budget is the
         # smaller of the client's `deadline_s` and the gateway's own
         # request_timeout_s; each relay attempt forwards the REMAINING
@@ -520,12 +624,35 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             candidates = self.fleet.routable(exclude=tried)
             if not candidates:
                 break
-            view = self.router.pick(key, candidates)
+            # Role/class steering (ISSUE 9): restrict the candidate set by
+            # the request's class before the policy picks. A no-op on
+            # homogeneous fleets; on heterogeneous ones an empty preferred
+            # set falls back to everything — no class is ever unroutable.
+            if cfg.role_routing:
+                candidates = role_candidates(
+                    candidates, eff_class, prompt_toks,
+                    cfg.long_prompt_tokens,
+                )
+            # route_info["spill"]: the affinity policy reports whether the
+            # pick landed away from the key's (role-filtered) home — a
+            # saturation spill, counted per role so the "all prefill-heavy
+            # replicas saturated" signature is scrapable (troubleshooting
+            # §27). Policies without homes never set it.
+            route_info: dict = {}
+            view = self.router.pick(key, candidates, slo_class=eff_class,
+                                    prompt_tokens=prompt_toks,
+                                    info=route_info)
+            spilled = attempt == 0 and bool(route_info.get("spill"))
             if record:
                 if attempt > 0:
                     m.retries.inc()
                     m.replica_counter(view.id, "retried").inc()
                 m.replica_counter(view.id, "routed").inc()
+                m.role_counter(view.role, "routed").inc()
+                if spilled:
+                    m.role_counter(view.role, "spilled").inc()
+                if attempt == 0:
+                    m.class_counter("routed", eff_class).inc()
             elif attempt > 0:
                 m.retries.inc()
             hedge_peers = (
@@ -544,6 +671,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 self.tracer.start_span(
                     "gateway.relay", parent=span, replica=view.id,
                     attempt=attempt, retry=attempt > 0,
+                    # Role-routing decision evidence (ISSUE 9): the trace
+                    # shows WHERE each class landed and whether it spilled.
+                    role=view.role, slo_class=eff_class or "default",
+                    spill=spilled,
                 )
                 if span is not None else None
             )
@@ -569,6 +700,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 if record:
                     self._note_affinity(key, info or view.id)
                     m.completed.inc()
+                    m.class_counter("relayed", eff_class).inc()
                     self._sample_rate()
                 return
             if outcome == "aborted":
@@ -592,6 +724,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 "type": "timeout_error"}})
         elif saw_busy:
             m.saturated.inc()
+            if record:
+                m.class_counter("429", eff_class).inc()
             self._send_json(
                 429,
                 {"error": {"message": "fleet saturated; retry later",
@@ -908,10 +1042,23 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--engine", choices=("lockstep", "continuous"),
                         default="continuous")
     parser.add_argument("--slots", type=int, default=8,
-                        help="decode slots per replica (continuous engine)")
+                        help="decode slots per replica (continuous engine); "
+                        "the BASE value role knobs scale (gateway/roles.py)")
     parser.add_argument("--max-queue", type=int, default=32,
                         help="per-replica admission queue cap (replica "
                         "429s beyond it; the gateway spills/429s in turn)")
+    parser.add_argument("--prefill-chunk", type=int, default=0,
+                        help="base chunked-prefill size per replica "
+                        "(continuous engine; 0 = whole-prompt) — "
+                        "role-scaled for heterogeneous fleets")
+    parser.add_argument("--token-budget", type=int, default=0,
+                        help="base per-tick token budget per replica "
+                        "(continuous engine; 0 = unbudgeted) — role-scaled "
+                        "for heterogeneous fleets")
+    parser.add_argument("--pages", type=int, default=0,
+                        help="base KV page-pool size per replica (paged "
+                        "cache mode; 0 = engine default) — role-scaled "
+                        "for heterogeneous fleets")
     parser.add_argument("--replica-arg", action="append", default=[],
                         metavar="ARG",
                         help="extra argument passed through to every "
@@ -936,23 +1083,53 @@ def main(argv: list[str] | None = None) -> int:
     config = full_config.gateway
     telemetry_cfg = full_config.telemetry
 
-    def build_argv(port: int):
-        cmd = [sys.executable, "-m", "ditl_tpu.infer.server",
-               "--host", "127.0.0.1", "--port", str(port),
-               "--tokenizer", args.tokenizer,
-               "--engine", args.engine]
-        if args.engine == "continuous":
-            cmd += ["--slots", str(args.slots),
-                    "--max-queue", str(args.max_queue)]
-        if args.preset:
-            cmd += ["--preset", args.preset]
-        if args.checkpoint_dir:
-            cmd += ["--checkpoint-dir", args.checkpoint_dir]
-        if args.trace_dir:
-            # Each replica journals its own spans (events-server-<pid>)
-            # into the shared directory; trace_export merges by trace_id.
-            cmd += ["--trace-dir", args.trace_dir]
-        return cmd + list(args.replica_arg)
+    from ditl_tpu.gateway.roles import parse_roles, role_knobs
+
+    roles = parse_roles(config.replica_roles, config.replicas)
+
+    def make_build_argv(role: str):
+        # One closure per replica: the role's engine knobs (roles.py) are
+        # derived from the BASE --slots/--prefill-chunk/--token-budget so a
+        # heterogeneous fleet launches from one command line.
+        knobs = role_knobs(role, n_slots=args.slots,
+                           prefill_chunk=args.prefill_chunk,
+                           token_budget=args.token_budget)
+
+        def build_argv(port: int):
+            cmd = [sys.executable, "-m", "ditl_tpu.infer.server",
+                   "--host", "127.0.0.1", "--port", str(port),
+                   "--tokenizer", args.tokenizer,
+                   "--engine", args.engine,
+                   "--role", role]
+            if args.engine == "continuous":
+                cmd += ["--slots", str(knobs["n_slots"]),
+                        "--max-queue", str(args.max_queue)]
+                if knobs["prefill_chunk"]:
+                    cmd += ["--prefill-chunk", str(knobs["prefill_chunk"])]
+                if knobs["token_budget"]:
+                    cmd += ["--token-budget", str(knobs["token_budget"])]
+                if args.pages:
+                    # --pages is sized for the BASE slot count: scale it by
+                    # the role's slot ratio first (a decode_heavy replica
+                    # running 2x the slots needs 2x the pool just to keep
+                    # per-slot headroom), THEN by the role's extra depth
+                    # (pages_scale) — the same slot-derived-then-scaled
+                    # sizing bench.py uses.
+                    scaled = (args.pages * knobs["n_slots"]
+                              / max(1, args.slots) * knobs["pages_scale"])
+                    cmd += ["--pages", str(max(2, int(scaled)))]
+            if args.preset:
+                cmd += ["--preset", args.preset]
+            if args.checkpoint_dir:
+                cmd += ["--checkpoint-dir", args.checkpoint_dir]
+            if args.trace_dir:
+                # Each replica journals its own spans (events-server-<pid>)
+                # into the shared directory; trace_export merges by
+                # trace_id.
+                cmd += ["--trace-dir", args.trace_dir]
+            return cmd + list(args.replica_arg)
+
+        return build_argv
 
     journal = None
     if config.journal_dir:
@@ -970,7 +1147,7 @@ def main(argv: list[str] | None = None) -> int:
             max_bytes=telemetry_cfg.journal_max_bytes(),
         ))
     handles = [
-        SubprocessReplica(f"r{i}", build_argv)
+        SubprocessReplica(f"r{i}", make_build_argv(roles[i]), role=roles[i])
         for i in range(config.replicas)
     ]
     fleet = Fleet(handles)

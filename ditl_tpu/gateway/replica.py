@@ -26,6 +26,7 @@ without a backend.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import subprocess
@@ -61,10 +62,19 @@ def gateway_journal_path(directory: str) -> str:
 
 
 class ReplicaHandle:
-    """Lifecycle + probe surface every replica kind implements."""
+    """Lifecycle + probe surface every replica kind implements.
 
-    def __init__(self, replica_id: str):
+    ``role`` (ISSUE 9) tags the replica's serving shape in a disaggregated
+    fleet — ``"hybrid"`` (default), ``"prefill_heavy"`` or
+    ``"decode_heavy"`` (gateway/roles.py). The handle's role is what the
+    spawner CONFIGURED; the replica's /health echoes it back so the two
+    can be cross-checked, and the Fleet's routing views prefer the health
+    report when present (a subprocess replica relaunched with different
+    args must not route under a stale tag)."""
+
+    def __init__(self, replica_id: str, role: str = "hybrid"):
         self.id = replica_id
+        self.role = role
 
     # lifecycle ------------------------------------------------------------
     def start(self) -> None:
@@ -117,8 +127,9 @@ class InProcessReplica(ReplicaHandle):
     across restarts ("adopt" semantics: the expensive compiled engine
     outlives the HTTP front that died)."""
 
-    def __init__(self, replica_id: str, server_factory: Callable[[], object]):
-        super().__init__(replica_id)
+    def __init__(self, replica_id: str, server_factory: Callable[[], object],
+                 *, role: str = "hybrid"):
+        super().__init__(replica_id, role=role)
         self._factory = server_factory
         self._server = None
         self._thread: threading.Thread | None = None
@@ -183,8 +194,9 @@ class SubprocessReplica(ReplicaHandle):
         host: str = "127.0.0.1",
         port_factory: Callable[[], int] | None = None,
         env: dict | None = None,
+        role: str = "hybrid",
     ):
-        super().__init__(replica_id)
+        super().__init__(replica_id, role=role)
         self._build_argv = build_argv
         self._host = host
         if port_factory is None:
@@ -264,6 +276,25 @@ class ReplicaView:
     # against. 0/0 on engines without the accounting (lockstep replicas).
     cache_hit_tokens: int = 0
     cache_miss_tokens: int = 0
+    # Windowed hit/miss token deltas over the last few health polls
+    # (ISSUE 9): the lifetime counters above go stale-sticky on long-lived
+    # replicas (an hour of 90% hits pins the ratio near 0.9 no matter what
+    # the replica is doing NOW), so the Fleet keeps per-poll deltas and the
+    # router's spill steering consumes the windowed ratio instead. 0/0 when
+    # the window is empty or the replica has been idle long enough for the
+    # window to age out — recent_cache_hit_ratio is then None ("stale") and
+    # the spill walk falls back to its deterministic ring order.
+    recent_cache_hit_tokens: int = 0
+    recent_cache_miss_tokens: int = 0
+    # Disaggregated-fleet role (ISSUE 9): "hybrid" | "prefill_heavy" |
+    # "decode_heavy" — health-reported when present, else the handle's
+    # configured role.
+    role: str = "hybrid"
+    # Latency snapshot from the replica's last /health poll (lifetime
+    # histogram quantiles): the per-role TTFT/TPOT aggregation on gateway
+    # /metrics reads these; None on replicas that have served nothing.
+    ttft_p95_s: float | None = None
+    tpot_p95_s: float | None = None
 
     @property
     def cache_hit_ratio(self) -> float | None:
@@ -271,6 +302,23 @@ class ReplicaView:
         if total == 0:
             return None
         return self.cache_hit_tokens / total
+
+    @property
+    def recent_cache_hit_ratio(self) -> float | None:
+        """Hit ratio over the last few health-poll windows; None when no
+        prompt tokens moved recently (stale — routers must not steer on
+        it)."""
+        total = self.recent_cache_hit_tokens + self.recent_cache_miss_tokens
+        if total == 0:
+            return None
+        return self.recent_cache_hit_tokens / total
+
+    @property
+    def slot_pressure(self) -> float:
+        """active_slots / capacity in [0, 1] — the load signal the
+        autoscaling roadmap item consumes from the same view (ISSUE 9
+        de-risk hook)."""
+        return self.active_slots / max(1, self.capacity)
 
 
 @dataclasses.dataclass
@@ -283,6 +331,14 @@ class _ReplicaState:
     fails: int = 0
     health: dict = dataclasses.field(default_factory=dict)
     restarts: int = 0
+    # Windowed prefix-cache accounting (ISSUE 9): the last observed
+    # lifetime (hit, miss) counters and a bounded deque of per-poll
+    # deltas. Idle polls append (0, 0), so activity ages out of the window
+    # naturally — that IS the freshness signal.
+    last_cache: tuple[int, int] | None = None
+    cache_window: collections.deque = dataclasses.field(
+        default_factory=collections.deque
+    )
 
 
 class Fleet:
@@ -291,15 +347,27 @@ class Fleet:
     (the supervisor writes it per poll)."""
 
     def __init__(self, handles: Sequence[ReplicaHandle],
-                 default_capacity: int = 8):
+                 default_capacity: int = 8,
+                 cache_window_polls: int = 8):
         if not handles:
             raise ValueError("a fleet needs at least one replica")
         ids = [h.id for h in handles]
         if len(set(ids)) != len(ids):
             raise ValueError(f"duplicate replica ids: {ids}")
+        if cache_window_polls < 1:
+            raise ValueError(
+                f"cache_window_polls must be >= 1, got {cache_window_polls}"
+            )
         self.default_capacity = default_capacity
+        self.cache_window_polls = cache_window_polls
         self._lock = threading.Lock()
-        self._states = {h.id: _ReplicaState(handle=h) for h in handles}
+        self._states = {
+            h.id: _ReplicaState(
+                handle=h,
+                cache_window=collections.deque(maxlen=cache_window_polls),
+            )
+            for h in handles
+        }
 
     @property
     def ids(self) -> list[str]:
@@ -352,11 +420,31 @@ class Fleet:
                 st.fails = 0
                 st.live = True
                 st.health = health
+                self._note_cache_window(st, health)
                 # A replica draining ITSELF (SIGTERM) must fall out of
                 # routing even if the gateway didn't initiate the drain.
                 if health.get("draining"):
                     st.draining = True
         return health is not None
+
+    @staticmethod
+    def _note_cache_window(st: _ReplicaState, health: dict) -> None:
+        """Fold one health poll into the windowed hit/miss deltas
+        (ISSUE 9). The /health counters are lifetime-cumulative, so the
+        recent ratio is built from per-poll differences; a counter that
+        went BACKWARDS means the replica restarted with a fresh engine —
+        the window resets rather than recording a nonsense negative delta.
+        Caller holds the fleet lock."""
+        if "cache_hit_tokens" not in health \
+                and "cache_miss_tokens" not in health:
+            return
+        cur = (int(health.get("cache_hit_tokens", 0)),
+               int(health.get("cache_miss_tokens", 0)))
+        prev, st.last_cache = st.last_cache, cur
+        if prev is None or cur[0] < prev[0] or cur[1] < prev[1]:
+            st.cache_window.clear()
+            return
+        st.cache_window.append((cur[0] - prev[0], cur[1] - prev[1]))
 
     # -- routing-plane accessors -------------------------------------------
 
@@ -366,6 +454,8 @@ class Fleet:
             return None
         h = st.health
         n_slots = int(h.get("n_slots", 0)) or self.default_capacity
+        ttft = h.get("ttft_p95_s")
+        tpot = h.get("tpot_p95_s")
         return ReplicaView(
             id=st.handle.id,
             address=addr,
@@ -377,6 +467,11 @@ class Fleet:
             draining=st.draining,
             cache_hit_tokens=int(h.get("cache_hit_tokens", 0)),
             cache_miss_tokens=int(h.get("cache_miss_tokens", 0)),
+            recent_cache_hit_tokens=sum(d[0] for d in st.cache_window),
+            recent_cache_miss_tokens=sum(d[1] for d in st.cache_window),
+            role=str(h.get("role") or st.handle.role or "hybrid"),
+            ttft_p95_s=float(ttft) if isinstance(ttft, (int, float)) else None,
+            tpot_p95_s=float(tpot) if isinstance(tpot, (int, float)) else None,
         )
 
     def routable(self, exclude: Sequence[str] = ()) -> list[ReplicaView]:

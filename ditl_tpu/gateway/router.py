@@ -15,12 +15,25 @@ request?", and the answer depends on what you optimize:
   ``PageAllocator.match_prefix`` (infer/paged_cache.py) then reuses the
   prefix KV automatically — the SGLang/RadixAttention observation that
   prefix-cache hit rate is a *routing* property at fleet scale. Saturated
-  home replicas spill to the least-loaded peer (correctness first, locality
-  second), and consistent hashing confines the remap blast radius of a
-  dead replica to that replica's own keys.
+  home replicas spill with a MEASURED bias (ISSUE 9): among the unsaturated
+  peers, prefer the one whose windowed prefix-cache hit ratio
+  (``ReplicaView.recent_cache_hit_ratio``, fed by /health-poll deltas) says
+  it is actively reusing prefixes — it most plausibly still holds this one;
+  when every peer's ratio is absent or stale the spill falls back to the
+  deterministic ring walk (correctness first, locality second). Consistent
+  hashing confines the remap blast radius of a dead replica to that
+  replica's own keys.
+
+Role/class steering (disaggregated prefill/decode fleets, ISSUE 9) is a
+candidate-set restriction layered UNDER these policies — see
+``gateway/roles.role_candidates``; every policy then picks within the
+role-filtered subset, so affinity keeps its ring semantics per role.
 
 Policies are pure host code over ``ReplicaView`` snapshots (replica.py);
-no jax, no I/O — unit-testable with plain namedtuples.
+no jax, no I/O — unit-testable with plain namedtuples. ``pick`` accepts
+the request's SLO class and a prompt-size estimate so policies MAY
+specialize; the built-ins ignore them (steering already happened in the
+candidate set).
 """
 
 from __future__ import annotations
@@ -31,7 +44,8 @@ import itertools
 import threading
 
 __all__ = ["CacheAffinityPolicy", "LeastOutstandingPolicy",
-           "RoundRobinPolicy", "affinity_key", "make_policy", "stable_hash"]
+           "RoundRobinPolicy", "affinity_key", "make_policy",
+           "prompt_token_estimate", "stable_hash"]
 
 POLICIES = ("round_robin", "least_outstanding", "affinity")
 
@@ -44,6 +58,20 @@ def stable_hash(s: str) -> int:
     )
 
 
+def _payload_text(payload: dict) -> str:
+    """The routable text of a request body (prompt or concatenated chat
+    messages) — shared by the affinity key and the prompt-size estimate."""
+    if isinstance(payload.get("messages"), list):
+        return "\x1e".join(
+            str(m.get("content", "")) for m in payload["messages"]
+            if isinstance(m, dict)
+        )
+    prompt = payload.get("prompt")
+    if isinstance(prompt, list):
+        prompt = prompt[0] if prompt else ""
+    return prompt if isinstance(prompt, str) else ""
+
+
 def affinity_key(payload: dict, prefix_tokens: int) -> str | None:
     """The request's routing key: an explicit ``session_id`` (or OpenAI
     ``user``) wins; otherwise the first ``prefix_tokens`` whitespace tokens
@@ -53,20 +81,19 @@ def affinity_key(payload: dict, prefix_tokens: int) -> str | None:
     sid = payload.get("session_id") or payload.get("user")
     if sid:
         return f"sid:{sid}"
-    if isinstance(payload.get("messages"), list):
-        text = "\x1e".join(
-            str(m.get("content", "")) for m in payload["messages"]
-            if isinstance(m, dict)
-        )
-    else:
-        prompt = payload.get("prompt")
-        if isinstance(prompt, list):
-            prompt = prompt[0] if prompt else ""
-        text = prompt if isinstance(prompt, str) else ""
-    toks = text.split()
+    toks = _payload_text(payload).split()
     if not toks:
         return None
     return "pfx:" + " ".join(toks[:max(1, prefix_tokens)])
+
+
+def prompt_token_estimate(payload: dict) -> int:
+    """Whitespace-token count of the request's prompt text — the gateway's
+    tokenizer-free prompt-size signal, consumed by the long-prompt
+    steering rule (``gateway/roles.role_candidates``). Same caveat as the
+    affinity key: not model tokens, but any monotone estimate separates
+    long batch prompts from short interactive turns identically."""
+    return len(_payload_text(payload).split())
 
 
 def _load(view) -> tuple:
@@ -81,7 +108,8 @@ class RoundRobinPolicy:
     def __init__(self):
         self._counter = itertools.count()
 
-    def pick(self, key, candidates):
+    def pick(self, key, candidates, slo_class=None, prompt_tokens=0,
+             info=None):
         ordered = sorted(candidates, key=lambda v: v.id)
         return ordered[next(self._counter) % len(ordered)]
 
@@ -89,7 +117,8 @@ class RoundRobinPolicy:
 class LeastOutstandingPolicy:
     name = "least_outstanding"
 
-    def pick(self, key, candidates):
+    def pick(self, key, candidates, slo_class=None, prompt_tokens=0,
+             info=None):
         return min(candidates, key=_load)
 
 
@@ -131,26 +160,60 @@ class CacheAffinityPolicy:
         i = bisect.bisect_left(hashes, stable_hash(key)) % len(rids)
         return by_id[rids[i]]
 
-    def pick(self, key, candidates):
+    def pick(self, key, candidates, slo_class=None, prompt_tokens=0,
+             info=None):
+        """Pick a replica for ``key``. When the caller passes ``info`` (a
+        dict), ``info["spill"]`` is set to whether the pick landed away
+        from the key's home — the gateway's per-role spill counters read
+        it here instead of re-walking the ring."""
+        if info is not None:
+            info["spill"] = False
         if key is None:
             return self._fallback.pick(key, candidates)
         by_id = {v.id: v for v in candidates}
         hashes, rids = self._ring(frozenset(by_id))
         start = bisect.bisect_left(hashes, stable_hash(key))
-        # Walk the ring from the key's position: the first UNSATURATED
-        # replica wins. Walking (rather than jumping straight to
-        # least-loaded) keeps the spill target deterministic per key, so
-        # even spilled traffic builds cache on a consistent secondary.
+        # Walk the ring from the key's position. The first DISTINCT rid is
+        # the key's home: unsaturated, it wins immediately (the common
+        # fast path — no full-ring walk). A saturated home costs the rest
+        # of the walk, collecting the unsaturated peers in walk order —
+        # the deterministic spill ranking, so the same key spills to a
+        # consistent secondary.
         seen: set[str] = set()
+        home_rid: str | None = None
+        walk: list = []
         for j in range(len(rids)):
             rid = rids[(start + j) % len(rids)]
             if rid in seen:
                 continue
             seen.add(rid)
             view = by_id[rid]
-            if view.outstanding + view.queue_depth < max(1, view.capacity):
-                return view
-        return self._fallback.pick(key, candidates)
+            unsaturated = (view.outstanding + view.queue_depth
+                           < max(1, view.capacity))
+            if home_rid is None:
+                home_rid = rid
+                if unsaturated:
+                    return view  # home takes it
+            if unsaturated:
+                walk.append(view)
+        if info is not None:
+            info["spill"] = True  # home saturated: every path below spills
+        if not walk:
+            return self._fallback.pick(key, candidates)
+        # Spill (ISSUE 9): the home is saturated, so locality is already
+        # lost — steer the spill by MEASURED reuse instead of ring position
+        # alone. A peer whose windowed hit ratio (health-poll hit/miss
+        # token deltas, replica.py) is > 0 is demonstrably reusing prefixes
+        # right now — the best available evidence it still holds this one.
+        # Absent/stale ratios (no recent tokens -> None) keep the
+        # deterministic ring-walk target; ties break toward walk order
+        # (max() keeps the first maximal element).
+        rated = [v for v in walk
+                 if (getattr(v, "recent_cache_hit_ratio", None) or 0) > 0]
+        if rated:
+            return max(rated,
+                       key=lambda v: round(v.recent_cache_hit_ratio, 4))
+        return walk[0]
 
 
 def make_policy(name: str):

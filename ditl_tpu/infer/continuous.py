@@ -479,6 +479,10 @@ class ContinuousEngine:
         # wall-clock views of the same stall.
         self.max_tick_prefill_tokens = 0
         self.interference_max_s = 0.0
+        # Per-victim-class split of interference_max_s (ISSUE 9): the
+        # disaggregated-fleet drill is graded on the worst stall an
+        # INTERACTIVE stream absorbed, not the fleet-wide worst.
+        self.interference_max_by_class: dict[str, float] = {}
         self.max_queue = max_queue
         self.mesh = mesh
         self.rules = rules
@@ -2982,6 +2986,11 @@ class ContinuousEngine:
                         # TTFT" from /metrics alone.
                         (m.ttft_cache_hit if req.cache_hit_tokens > 0
                          else m.ttft_cache_miss).observe(ttft)
+                        # Class split (ISSUE 9): the disagg A/B grades
+                        # interactive TTFT specifically.
+                        cls_hist = m.ttft_by_class.get(req.slo_class)
+                        if cls_hist is not None:
+                            cls_hist.observe(ttft)
                 elif req.t_last_emit:
                     # TPOT: this harvest interval amortized over the chunk's
                     # tokens, observed once per token. The first chunk is
@@ -3480,6 +3489,15 @@ class ContinuousEngine:
                 if victim.finished or victim.cancelled:
                     continue
                 self.metrics.tpot_interference.observe(prefill_s)
+                cls_hist = self.metrics.interference_by_class.get(
+                    victim.slo_class
+                )
+                if cls_hist is not None:
+                    cls_hist.observe(prefill_s)
+                self.interference_max_by_class[victim.slo_class] = max(
+                    self.interference_max_by_class.get(victim.slo_class, 0.0),
+                    prefill_s,
+                )
                 victim.interference_s += prefill_s
                 victim.interference_pending.append(
                     (culprit_id, culprit_tokens, prefill_s)
@@ -3608,6 +3626,11 @@ class ContinuousEngine:
             "max_context": self.smax,
             "token_budget": self.token_budget,
             "max_tick_prefill_tokens": self.max_tick_prefill_tokens,
+            "interference_max_s": round(self.interference_max_s, 6),
+            "interference_max_by_class": {
+                cls: round(v, 6)
+                for cls, v in sorted(self.interference_max_by_class.items())
+            },
             "queue_by_class": {
                 cls: sum(1 for r in self._queue if r.slo_class == cls)
                 for cls in SLO_CLASSES

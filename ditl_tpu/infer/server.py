@@ -285,6 +285,9 @@ class _Handler(BaseHTTPRequestHandler):
     # SLO burn-rate monitor (telemetry/slo.py), rendered at /slo and as
     # gauges on /metrics.
     slo: BurnRateMonitor = None
+    # Disaggregated-fleet role tag (ISSUE 9): echoed on /health so the
+    # gateway's role-aware routing reads the replica's OWN claim.
+    role: str = "hybrid"
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
@@ -401,8 +404,23 @@ class _Handler(BaseHTTPRequestHandler):
                 "status": "draining" if draining else "ok",
                 "model": self.model_name,
                 "draining": draining,
+                # Disaggregated-fleet role (ISSUE 9): the gateway's Fleet
+                # prefers this over the handle's configured role so a
+                # relaunch with different args cannot route under a stale
+                # tag.
+                "role": self.role,
             }
             payload.update(self._load_snapshot())
+            # Latency snapshot for the gateway's per-role TTFT/TPOT
+            # aggregation (ISSUE 9): lifetime histogram p95s, present only
+            # once something has been served (absent != zero).
+            m = self.serving_metrics
+            if m is not None:
+                for key, hist in (("ttft_p95_s", m.ttft),
+                                  ("tpot_p95_s", m.decode_token)):
+                    q = hist.quantile(0.95) if hist.count else None
+                    if q is not None:
+                        payload[key] = round(q, 6)
             self._send_json(200, payload)
         elif self.path in ("/v1/stats", "/stats"):
             stats = {"model": self.model_name, "engine": "lockstep",
@@ -1610,6 +1628,7 @@ def make_server(
     tracer: Tracer | None = None,
     slo: BurnRateMonitor | None = None,
     telemetry=None,
+    role: str = "hybrid",
 ) -> DrainableHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
@@ -1619,7 +1638,9 @@ def make_server(
     ``spec_generator`` (Speculative/AutoSpeculativeGenerator) serves greedy
     lock-step requests — streaming and non-streaming — speculatively;
     ``max_pending`` caps concurrent in-flight completion work (429 beyond
-    it) — the lockstep overload control.
+    it) — the lockstep overload control; ``role`` tags the replica's
+    disaggregated-fleet serving shape (gateway/roles.py), echoed on
+    /health for the gateway's role-aware routing.
 
     The returned :class:`DrainableHTTPServer` supports ``drain()`` /
     ``close(drain=True)`` (graceful: /health flips to draining, new work
@@ -1663,6 +1684,7 @@ def make_server(
             "max_pending": max_pending,
             "tracer": tracer,
             "slo": slo,
+            "role": role,
         },
     )
     return DrainableHTTPServer((host, port), handler)
@@ -1814,6 +1836,15 @@ def serve(argv: list[str] | None = None) -> int:
         help="per-slot KV cache cap for --engine continuous; 0 = model "
         "max_seq_len (set this for long-context presets like llama31-8b, "
         "whose 131072-token cache would be ~17 GB per slot)",
+    )
+    parser.add_argument(
+        "--role", choices=("hybrid", "prefill_heavy", "decode_heavy"),
+        default="hybrid",
+        help="disaggregated-fleet role tag (ISSUE 9): echoed on /health so "
+        "a gateway steering by class+role reads the replica's own claim. "
+        "Purely a label — pair it with the matching --slots/--token-budget/"
+        "--prefill-chunk knobs (the launcher's gateway.replica_roles does "
+        "both)",
     )
     parser.add_argument(
         "--trace-dir", default="",
@@ -2106,7 +2137,7 @@ def serve(argv: list[str] | None = None) -> int:
         default_max_tokens=args.max_tokens, threaded_engine=threaded,
         adapter_names=adapter_names, spec_generator=spec,
         max_pending=args.max_pending or None,
-        tracer=tracer, telemetry=telemetry_cfg,
+        tracer=tracer, telemetry=telemetry_cfg, role=args.role,
     )
 
     # SIGTERM = graceful drain (the gateway/orchestrator rolling-restart

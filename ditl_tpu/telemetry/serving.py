@@ -52,8 +52,8 @@ from ditl_tpu.telemetry.registry import (
     TOKEN_LATENCY_BUCKETS_S,
 )
 
-__all__ = ["ServingMetrics", "backlog_retry_after", "merged_histogram",
-           "serving_bench_summary", "snapshot_serving"]
+__all__ = ["SLO_CLASS_NAMES", "ServingMetrics", "backlog_retry_after",
+           "merged_histogram", "serving_bench_summary", "snapshot_serving"]
 
 
 def backlog_retry_after(
@@ -92,6 +92,11 @@ def backlog_retry_after(
     return max(1, floor, min(clamp_s, math.ceil(estimate)))
 
 PREFIX = "ditl_serving"
+
+# Mirror of infer/continuous.SLO_CLASSES' names — duplicated (not imported)
+# so telemetry/ stays jax-free on import, like gateway/admission.py's copy;
+# all three surfaces are pinned equal by test.
+SLO_CLASS_NAMES = ("interactive", "batch", "best_effort")
 
 
 class ServingMetrics:
@@ -182,6 +187,28 @@ class ServingMetrics:
             "TTFT of requests whose prompt missed the prefix cache "
             "entirely", LATENCY_BUCKETS_S,
         )
+        # -- per-SLO-class splits (ISSUE 9) ------------------------------
+        # The disaggregated-serving A/B is graded on INTERACTIVE latency
+        # specifically (batch work is supposed to absorb the prefill
+        # burden), so TTFT and scheduler interference split by the
+        # request's class. The unsplit histograms above remain the
+        # all-traffic aggregate.
+        self.ttft_by_class = {
+            cls: r.histogram(
+                f"{PREFIX}_request_ttft_{cls}_seconds",
+                f"TTFT of {cls}-class requests", LATENCY_BUCKETS_S,
+            )
+            for cls in SLO_CLASS_NAMES
+        }
+        self.interference_by_class = {
+            cls: r.histogram(
+                f"{PREFIX}_tpot_interference_{cls}_seconds",
+                f"per-tick decode delay absorbed by {cls}-class victims "
+                "because the tick also ran another request's prefill",
+                TOKEN_LATENCY_BUCKETS_S,
+            )
+            for cls in SLO_CLASS_NAMES
+        }
 
     def note_prefix_cache(self, hit_tokens: int, miss_tokens: int) -> None:
         """Record one admission's reused-vs-prefilled prompt token split."""
@@ -234,6 +261,10 @@ def merged_histogram(hists: Sequence[Histogram]) -> Histogram:
     return out
 
 
+def _hist_snap(hists: Sequence[Histogram]) -> list:
+    return [(list(h._counts), h.sum, h.count) for h in hists]
+
+
 def snapshot_serving(bundles: Sequence["ServingMetrics"]) -> dict:
     """Cumulative snapshot of the instruments ``serving_bench_summary``
     consumes — taken AFTER warm-up so the gated summary covers only the
@@ -241,14 +272,16 @@ def snapshot_serving(bundles: Sequence["ServingMetrics"]) -> dict:
     misses deflate the hit ratio; both would corrupt the perf_compare
     gate)."""
     return {
-        "interference": [
-            (list(b.tpot_interference._counts), b.tpot_interference.sum,
-             b.tpot_interference.count) for b in bundles
-        ],
-        "ttft": [
-            (list(b.ttft._counts), b.ttft.sum, b.ttft.count)
-            for b in bundles
-        ],
+        "interference": _hist_snap([b.tpot_interference for b in bundles]),
+        "ttft": _hist_snap([b.ttft for b in bundles]),
+        "ttft_by_class": {
+            cls: _hist_snap([b.ttft_by_class[cls] for b in bundles])
+            for cls in SLO_CLASS_NAMES
+        },
+        "interference_by_class": {
+            cls: _hist_snap([b.interference_by_class[cls] for b in bundles])
+            for cls in SLO_CLASS_NAMES
+        },
         "hit": sum(b.prefix_cache_hit_tokens.value for b in bundles),
         "miss": sum(b.prefix_cache_miss_tokens.value for b in bundles),
         "evictions": sum(
@@ -272,15 +305,27 @@ def serving_bench_summary(bundles: Sequence["ServingMetrics"],
     prefix-cache hit ratio, flat numeric keys so
     ``telemetry/perf_compare.py`` can gate them like train metrics.
     ``since`` (a :func:`snapshot_serving` taken after warm-up) restricts
-    every number to the timed region."""
+    every number to the timed region. Per-SLO-class TTFT/interference p95s
+    (ISSUE 9) ride along as ``<class>_ttft_p95_s`` /
+    ``<class>_interference_p95_s`` — the interactive pair is what the
+    disaggregated-fleet A/B is perf_compare-gated on."""
     interference = merged_histogram([b.tpot_interference for b in bundles])
     ttft = merged_histogram([b.ttft for b in bundles])
+    by_class = {
+        cls: (merged_histogram([b.ttft_by_class[cls] for b in bundles]),
+              merged_histogram(
+                  [b.interference_by_class[cls] for b in bundles]))
+        for cls in SLO_CLASS_NAMES
+    }
     hit = sum(b.prefix_cache_hit_tokens.value for b in bundles)
     miss = sum(b.prefix_cache_miss_tokens.value for b in bundles)
     evictions = sum(b.prefix_cache_evictions.value for b in bundles)
     if since is not None:
         _subtract(interference, since["interference"])
         _subtract(ttft, since["ttft"])
+        for cls, (t_h, i_h) in by_class.items():
+            _subtract(t_h, since["ttft_by_class"][cls])
+            _subtract(i_h, since["interference_by_class"][cls])
         hit -= since["hit"]
         miss -= since["miss"]
         evictions -= since["evictions"]
@@ -296,6 +341,13 @@ def serving_bench_summary(bundles: Sequence["ServingMetrics"],
     for q, key in ((0.5, "interference_p50_s"), (0.95, "interference_p95_s")):
         v = interference.quantile(q)
         out[key] = round(v, 6) if v is not None else None
+    for cls, (t_h, i_h) in by_class.items():
+        tv, iv = t_h.quantile(0.95), i_h.quantile(0.95)
+        out[f"{cls}_ttft_p95_s"] = round(tv, 6) if tv is not None else None
+        out[f"{cls}_interference_p95_s"] = (
+            round(iv, 6) if iv is not None else None
+        )
+        out[f"{cls}_interference_count"] = i_h.count
     if hit + miss > 0:
         out["prefix_cache_hit_ratio"] = round(hit / (hit + miss), 4)
     return out
