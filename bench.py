@@ -47,6 +47,28 @@ def _chaos_result() -> dict:
     return {"chaos": summary} if summary is not None else {}
 
 
+def _incidents_now() -> int:
+    """Run-start baseline for `_incident_result` — captured at the top of
+    every bench run so in-process sweep cells never inherit earlier
+    cells' incident counts (incidents_total() is process-cumulative)."""
+    from ditl_tpu.telemetry.incident import incidents_total
+
+    return incidents_total()
+
+
+def _incident_result(since: int = 0) -> dict:
+    """`{"incidents": N}` — bundles assembled by any incident manager in
+    this process during THIS run (delta vs the `since` baseline, ISSUE 10
+    satellite). ALWAYS embedded, zero included: telemetry/perf_compare.py
+    treats new incidents on the new side as a "now fails"-class
+    regression, so a perf PR that wins its numbers by provoking anomaly
+    storms fails the gate — and that needs healthy baselines to carry an
+    explicit 0."""
+    from ditl_tpu.telemetry.incident import incidents_total
+
+    return {"incidents": max(0, incidents_total() - since)}
+
+
 def _record_meta() -> dict:
     """Schema + provenance stamp for every bench JSON row (ISSUE 7
     satellite): records are versioned and name the code revision they were
@@ -299,6 +321,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
     from ditl_tpu.runtime.distributed import enable_compile_cache
 
     enable_compile_cache(compile_cache_dir)
+    _inc0 = _incidents_now()
     platform = jax.devices()[0].platform
     cfg = ModelConfig(
         name="bench-moe" if moe else "bench-350m", vocab_size=32768,
@@ -569,6 +592,7 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
         "generated_tokens": tokens,
         **extra,
         **_chaos_result(),
+        **_incident_result(_inc0),
     }))
     return 0
 
@@ -622,6 +646,7 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     )
 
     enable_compile_cache(compile_cache_dir)
+    _inc0 = _incidents_now()
     platform = jax.devices()[0].platform
     cfg = ModelConfig(
         name="bench-350m", vocab_size=32768, hidden_size=1024,
@@ -918,6 +943,7 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         },
         **trace_extra,
         **_chaos_result(),
+        **_incident_result(_inc0),
     }
     server.shutdown()
     server.server_close()
@@ -1021,6 +1047,7 @@ def run_train_bench(model_name: str = "350m",
         cfg = dataclasses.replace(cfg, max_seq_len=max(cfg.max_seq_len, seq))
     tcfg = TrainConfig(total_steps=1000, warmup_steps=10, optimizer=optimizer)
     mesh = build_mesh(MeshConfig())
+    _inc0 = _incidents_now()
 
     chunk = 20 if platform == "tpu" else 3
     n_windows = 6 if platform == "tpu" else 2
@@ -1149,6 +1176,7 @@ def run_train_bench(model_name: str = "350m",
         # device-blocked decomposition of the p50 the headline divides by.
         "step_anatomy": anatomy.report(),
         **_chaos_result(),
+        **_incident_result(_inc0),
     }
     mem = memwatch.report()
     if mem:
@@ -1241,6 +1269,7 @@ def run_sweep(model_name: str, spec: str, out_path: str,
     )
 
     cells = _parse_sweep_spec(spec)
+    _inc0 = _incidents_now()
     platform = jax.devices()[0].platform
     meta = {"model": model_name, "platform": platform,
             "base_overrides": list(overrides or []),
@@ -1318,6 +1347,7 @@ def run_sweep(model_name: str, spec: str, out_path: str,
         "failed": failed,
         "out": out_path,
         **_chaos_result(),
+        **_incident_result(_inc0),
     }))
     return 0 if failed == 0 else 1
 

@@ -99,6 +99,10 @@ SITES = {
                      "(error = simulated connection failure -> failover)",
     "client.request": "client/llm.py: one remote-LLM HTTP attempt "
                       "(error = simulated transport failure -> retry path)",
+    "incident.dump": "telemetry/incident.py: between writing a bundle's "
+                     "tmp dir and the publishing rename (kill = torn-"
+                     "bundle drill: --list must skip it, the next manager "
+                     "sweeps it)",
 }
 
 

@@ -363,6 +363,13 @@ class TrainConfig:
     # OUT-OF-PROCESS supervisor (launch --supervise, or k8s restartPolicy)
     # can recover from it. 0 => off.
     fault_kill_step: int = 0
+    # Anomaly-plane drill (ISSUE 10): inject NaN into the step's reported
+    # loss metric at this global step (train/step.py), so the non-finite
+    # detector path — flight-ring dump, incident bundle, THEN crash — is
+    # drillable without engineering a real divergence. The injection rides
+    # the compiled metrics (a real device NaN reaching the host flush),
+    # touching only the reported loss, never the gradients. 0 => off.
+    fault_nan_step: int = 0
     # Which process index fault_kill_step applies to: -1 => every process
     # (the single-host drill), >= 0 => only that worker dies — the pod-level
     # drill (runtime/elastic.py), where the SURVIVORS are left wedged in a
@@ -623,6 +630,43 @@ class TelemetryConfig:
     slo_fast_window_s: float = 300.0
     slo_slow_window_s: float = 3600.0
     slo_burn_alert: float = 1.0
+    # -- Flight recorder + anomaly plane (ISSUE 10) ----------------------
+    # Rows each always-on flight ring keeps (telemetry/flight.py): the
+    # black-box horizon an incident bundle dumps. Bounded memory; zero
+    # device syncs; dumped only on trigger.
+    flight_ring_size: int = 512
+    # Incident bundle directory (telemetry/incident.py); "" = anomaly
+    # detectors may still run (journaled anomaly.detected events) but no
+    # bundles are assembled.
+    incident_dir: str = ""
+    # Fingerprint cooldown: triggers for the same anomaly fingerprint
+    # within this window only bump the suppressed counter — a sustained
+    # storm is ONE bundle.
+    incident_cooldown_s: float = 300.0
+    # Bundle-dir retention (oldest-first GC, journal-rotation spirit).
+    incident_max_bundles: int = 16
+    incident_max_mb: float = 64.0
+    # Bundle contents: last-N merged journal events, and the trace-slice
+    # half-window (seconds before the trigger) exported to Chrome-trace.
+    incident_journal_tail: int = 200
+    incident_trace_window_s: float = 30.0
+    # Serving detectors (telemetry/anomaly.py): observe cadence in
+    # scheduler ticks, per-window storm threshold (deadline expiries /
+    # 429s / preemptions / gateway spills), queue-depth growth limit,
+    # latency-jump factor vs the rolling windowed-p95 baseline (with a
+    # minimum sample count), and the prefix-hit-ratio collapse floor.
+    anomaly_check_every_ticks: int = 32
+    anomaly_storm_threshold: int = 8
+    anomaly_queue_depth: int = 64
+    anomaly_latency_factor: float = 3.0
+    anomaly_min_samples: int = 16
+    anomaly_hit_ratio_floor: float = 0.5
+    # Training detectors: rolling window length, spike factor over the
+    # rolling loss median, explosion factor over the rolling grad-norm
+    # median (non-finite loss/grad always fires — not a knob).
+    anomaly_window: int = 32
+    anomaly_loss_spike_factor: float = 4.0
+    anomaly_grad_explosion_factor: float = 10.0
 
     def __post_init__(self):
         if self.journal_max_mb < 0:
@@ -661,6 +705,33 @@ class TelemetryConfig:
                 f"slo_slow_window_s, got {self.slo_fast_window_s} >= "
                 f"{self.slo_slow_window_s}"
             )
+        for name in ("flight_ring_size", "incident_max_bundles",
+                     "anomaly_check_every_ticks", "anomaly_storm_threshold",
+                     "anomaly_queue_depth", "anomaly_min_samples",
+                     "anomaly_window"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"telemetry.{name} must be >= 1, got "
+                    f"{getattr(self, name)}"
+                )
+        for name in ("incident_cooldown_s", "incident_max_mb",
+                     "incident_trace_window_s", "anomaly_latency_factor",
+                     "anomaly_loss_spike_factor",
+                     "anomaly_grad_explosion_factor"):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"telemetry.{name} must be > 0, got {getattr(self, name)}"
+                )
+        if self.incident_journal_tail < 0:
+            raise ValueError(
+                f"telemetry.incident_journal_tail must be >= 0, got "
+                f"{self.incident_journal_tail}"
+            )
+        if not 0.0 < self.anomaly_hit_ratio_floor < 1.0:
+            raise ValueError(
+                "telemetry.anomaly_hit_ratio_floor must be in (0, 1), got "
+                f"{self.anomaly_hit_ratio_floor}"
+            )
 
     def journal_max_bytes(self) -> int | None:
         """The journal rotation cap in bytes (None = unbounded) —
@@ -692,6 +763,37 @@ class TelemetryConfig:
             availability_target=self.slo_availability_target,
             windows=self.slo_windows(),
             burn_alert=self.slo_burn_alert,
+        )
+
+    def incident_kwargs(self) -> dict:
+        """Keyword form of the bundle-hygiene knobs — exactly what
+        ``telemetry.incident.IncidentManager`` takes."""
+        return dict(
+            cooldown_s=self.incident_cooldown_s,
+            max_bundles=self.incident_max_bundles,
+            max_total_mb=self.incident_max_mb,
+            journal_tail=self.incident_journal_tail,
+            trace_window_s=self.incident_trace_window_s,
+        )
+
+    def serving_detector_kwargs(self) -> dict:
+        """Keyword form of the serving detector thresholds
+        (``telemetry.anomaly.ServingDetector``)."""
+        return dict(
+            storm_threshold=self.anomaly_storm_threshold,
+            queue_depth_limit=self.anomaly_queue_depth,
+            latency_factor=self.anomaly_latency_factor,
+            min_samples=self.anomaly_min_samples,
+            hit_ratio_floor=self.anomaly_hit_ratio_floor,
+        )
+
+    def training_detector_kwargs(self) -> dict:
+        """Keyword form of the training detector thresholds
+        (``telemetry.anomaly.TrainingDetector``)."""
+        return dict(
+            window=self.anomaly_window,
+            loss_spike_factor=self.anomaly_loss_spike_factor,
+            grad_explosion_factor=self.anomaly_grad_explosion_factor,
         )
 
 

@@ -51,6 +51,7 @@ from ditl_tpu.gateway.roles import role_candidates
 from ditl_tpu.gateway.router import (
     affinity_key, make_policy, prompt_token_estimate,
 )
+from ditl_tpu.telemetry.flight import ROUTING_RING
 from ditl_tpu.telemetry.registry import LATENCY_BUCKETS_S, MetricsRegistry
 from ditl_tpu.telemetry.serving import backlog_retry_after
 from ditl_tpu.telemetry.slo import BurnRateMonitor, gateway_slo
@@ -100,6 +101,10 @@ class GatewayMetrics:
         self.stream_aborts = r.counter(
             f"{PREFIX}_stream_aborts",
             "streams cut mid-flight by a dying replica (not retryable)")
+        self.replica_deaths = r.counter(
+            f"{PREFIX}_replica_deaths",
+            "replica died->drain->relaunch cycles the supervisor ran "
+            "(the anomaly plane's death-rate input, ISSUE 10)")
         self.affinity_hits = r.counter(
             f"{PREFIX}_affinity_hits",
             "requests routed to the same replica as the previous request "
@@ -292,6 +297,11 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     tracer: Tracer = NULL_TRACER
     # Fleet-level SLO burn-rate monitor (telemetry/slo.py), served at /slo.
     slo: BurnRateMonitor = None
+    # Incident plane (ISSUE 10): the gateway's own bundle manager (served
+    # and aggregated with the replicas' at /incidents) and the routing-
+    # decision flight ring (telemetry/flight.py). Both unarmed by default.
+    incidents = None
+    flight = None
 
     def log_message(self, *args):
         logger.debug("gateway http: " + args[0], *args[1:])
@@ -409,10 +419,68 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                     "no SLO monitor configured"}})
             else:
                 self._send_json(200, self.slo.report())
+        elif path in ("/incidents", "/v1/incidents"):
+            self._incidents()
         elif path in ("/v1/models", "/models"):
             self._proxy_get("/v1/models")
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _incidents(self) -> None:
+        """Fleet incident view (ISSUE 10): the gateway's own bundles plus
+        every routable replica's /incidents listing, aggregated under one
+        endpoint — "did anything fire anywhere" is one GET. Replicas
+        without an armed incident plane answer 404 and are simply absent
+        (absent != zero bundles); a slow/dead replica costs one skipped
+        entry, never a wedged response."""
+        from ditl_tpu.telemetry.incident import list_bundles
+
+        own = (list_bundles(self.incidents.directory)
+               if self.incidents is not None else [])
+        replicas: dict[str, list] = {}
+
+        def fetch(view):
+            with urllib.request.urlopen(
+                f"http://{view.address[0]}:{view.address[1]}/incidents",
+                timeout=self.gwcfg.probe_timeout_s,
+            ) as resp:
+                return json.loads(resp.read())
+
+        # /incidents is hit exactly when replicas are misbehaving, so N
+        # slow replicas must cost ~probe_timeout_s total, not N x that.
+        for view, data in self._fan_out_replicas(self.fleet.routable(),
+                                                 fetch):
+            if isinstance(data, dict) and data.get("incidents"):
+                replicas[view.id] = data["incidents"]
+        self._send_json(200, {
+            "count": len(own) + sum(len(v) for v in replicas.values()),
+            "gateway": own,
+            "replicas": replicas,
+        })
+
+    def _fan_out_replicas(self, views, fetch) -> list:
+        """Concurrent per-replica ``fetch`` with ONE shared deadline
+        (~probe_timeout_s for the whole fan-out): returns ``(view,
+        result)`` pairs for the replicas that answered in time. A slow or
+        dead replica costs one skipped entry, never a wedged response —
+        ``shutdown(wait=False, cancel_futures=True)`` abandons stragglers
+        to die at their own socket timeouts (the PR 7 hardening; shared by
+        the /metrics memory section and /incidents)."""
+        out: list = []
+        if not views:
+            return out
+        pool = ThreadPoolExecutor(max_workers=min(8, len(views)))
+        try:
+            futures = {pool.submit(fetch, v): v for v in views}
+            done, _ = wait(futures, timeout=self.gwcfg.probe_timeout_s)
+            for f in done:
+                try:
+                    out.append((futures[f], f.result()))
+                except (urllib.error.URLError, OSError, ValueError):
+                    continue
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+        return out
 
     def _replica_memory_section(self) -> str:
         """Fleet HBM view (ISSUE 7): each routable replica's
@@ -424,10 +492,6 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         gateway scrape past Prometheus's own timeout); a slow or dead
         replica costs one skipped section, never a wedged scrape. CPU
         replicas contribute nothing (no ditl_memory_* lines to filter)."""
-        views = self.fleet.routable()
-        if not views:
-            return ""
-
         def fetch(view):
             with urllib.request.urlopen(
                 f"http://{view.address[0]}:{view.address[1]}/metrics",
@@ -436,30 +500,16 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 return resp.read().decode("utf-8", "replace")
 
         out: list[str] = []
-        # No context manager: `with` would shutdown(wait=True) and block on
-        # running fetches Future.cancel() cannot stop — a dribbling replica
-        # would wedge the scrape past the deadline anyway. shutdown with
-        # wait=False abandons stragglers (their threads die at their own
-        # socket timeouts) so the SECTION returns at the shared deadline.
-        pool = ThreadPoolExecutor(max_workers=min(8, len(views)))
-        try:
-            futures = {pool.submit(fetch, v): v for v in views}
-            done, _ = wait(futures, timeout=self.gwcfg.probe_timeout_s)
-            for f in done:
-                try:
-                    text = f.result()
-                except (urllib.error.URLError, OSError, ValueError):
-                    continue
-                rid = sanitize_label(futures[f].id)
-                for line in text.splitlines():
-                    # Matches both samples and their # TYPE/# HELP metadata
-                    # (the family name follows the directive keyword).
-                    if "ditl_memory_" in line.split("{", 1)[0]:
-                        out.append(line.replace(
-                            "ditl_memory_", f"ditl_memory_{rid}_"
-                        ))
-        finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+        for view, text in self._fan_out_replicas(self.fleet.routable(),
+                                                 fetch):
+            rid = sanitize_label(view.id)
+            for line in text.splitlines():
+                # Matches both samples and their # TYPE/# HELP metadata
+                # (the family name follows the directive keyword).
+                if "ditl_memory_" in line.split("{", 1)[0]:
+                    out.append(line.replace(
+                        "ditl_memory_", f"ditl_memory_{rid}_"
+                    ))
         return ("\n" + "\n".join(out)) if out else ""
 
     def _proxy_get(self, path: str) -> None:
@@ -643,6 +693,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                                     prompt_tokens=prompt_toks,
                                     info=route_info)
             spilled = attempt == 0 and bool(route_info.get("spill"))
+            if self.flight is not None:
+                # Flight recorder (ISSUE 10): one routing-decision row per
+                # relay attempt — which replica/role a request landed on,
+                # under what class, and whether affinity spilled. Host
+                # state only; dumped only into incident bundles.
+                self.flight.ring(ROUTING_RING).record(
+                    request=self._request_id(), attempt=attempt,
+                    replica=view.id, role=view.role,
+                    slo_class=eff_class or "default", spill=spilled,
+                    stream=stream, candidates=len(candidates),
+                )
             if record:
                 if attempt > 0:
                     m.retries.inc()
@@ -717,6 +778,15 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 tried.append(busy_id)
             else:
                 tried.append(view.id)
+        if self.flight is not None:
+            # Terminal failure row: the ring shows not just where requests
+            # went but which ones the FLEET failed, and how.
+            self.flight.ring(ROUTING_RING).record(
+                request=self._request_id(),
+                outcome=("timeout" if timed_out
+                         else "saturated" if saw_busy else "no_replica"),
+                slo_class=eff_class or "default",
+            )
         if timed_out:
             self._send_json(504, {"error": {
                 "message": "request deadline exhausted before any replica "
@@ -975,6 +1045,8 @@ def make_gateway(
     tracer: Tracer | None = None,
     slo: BurnRateMonitor | None = None,
     telemetry=None,
+    incidents=None,
+    flight=None,
 ) -> GatewayHTTPServer:
     """Build (not start) the gateway server over ``fleet`` — tests drive it
     on a thread, ``main`` drives it with ``serve_forever``. ``router``
@@ -982,7 +1054,11 @@ def make_gateway(
     tenant budgets (None when the config sets no limits — requests are then
     admitted unconditionally). ``tracer`` (telemetry/tracing.py) arms
     request tracing; ``slo`` defaults to a fleet-level burn-rate monitor
-    built from ``telemetry`` (config.TelemetryConfig) or its defaults."""
+    built from ``telemetry`` (config.TelemetryConfig) or its defaults;
+    ``incidents`` (telemetry/incident.IncidentManager) arms the
+    /incidents aggregation endpoint and ``flight``
+    (telemetry/flight.FlightRecorder) the per-request routing ring
+    (ISSUE 10) — both unarmed by default."""
     config = config or GatewayConfig()
     if router is None:
         router = make_policy(config.router)
@@ -1012,6 +1088,8 @@ def make_gateway(
             "affinity_lock": threading.Lock(),
             "tracer": tracer if tracer is not None else NULL_TRACER,
             "slo": slo,
+            "incidents": incidents,
+            "flight": flight,
         },
     )
     return GatewayHTTPServer(
@@ -1070,6 +1148,14 @@ def main(argv: list[str] | None = None) -> int:
                         "into this directory; merge + export with "
                         "python -m ditl_tpu.telemetry.trace_export --dir "
                         "DIR")
+    parser.add_argument("--incident-dir", default="",
+                        help="arm the anomaly/incident plane fleet-wide "
+                        "(ISSUE 10): the gateway watches replica deaths "
+                        "and spill/relay-error storms, each replica "
+                        "watches its own engine (deadline/429 storms, "
+                        "latency jumps), and all bundles aggregate at the "
+                        "gateway's /incidents (each process writes its own "
+                        "subdirectory)")
     parser.add_argument("overrides", nargs="*",
                         help="config overrides like gateway.router=affinity "
                         "gateway.replicas=4 telemetry.slo_ttft_s=0.5")
@@ -1087,7 +1173,7 @@ def main(argv: list[str] | None = None) -> int:
 
     roles = parse_roles(config.replica_roles, config.replicas)
 
-    def make_build_argv(role: str):
+    def make_build_argv(replica_id: str, role: str):
         # One closure per replica: the role's engine knobs (roles.py) are
         # derived from the BASE --slots/--prefill-chunk/--token-budget so a
         # heterogeneous fleet launches from one command line.
@@ -1127,6 +1213,14 @@ def main(argv: list[str] | None = None) -> int:
                 # into the shared directory; trace_export merges by
                 # trace_id.
                 cmd += ["--trace-dir", args.trace_dir]
+            if args.incident_dir:
+                # Per-replica bundle subdirectory: managers never contend
+                # on bundle names, and the gateway's /incidents aggregation
+                # reads each replica's listing over HTTP anyway.
+                import os as _os
+
+                cmd += ["--incident-dir",
+                        _os.path.join(args.incident_dir, replica_id)]
             return cmd + list(args.replica_arg)
 
         return build_argv
@@ -1147,10 +1241,51 @@ def main(argv: list[str] | None = None) -> int:
             max_bytes=telemetry_cfg.journal_max_bytes(),
         ))
     handles = [
-        SubprocessReplica(f"r{i}", make_build_argv(roles[i]), role=roles[i])
+        SubprocessReplica(f"r{i}", make_build_argv(f"r{i}", roles[i]),
+                          role=roles[i])
         for i in range(config.replicas)
     ]
     fleet = Fleet(handles)
+    # Gateway-side anomaly/incident plane (ISSUE 10): replica death-rate +
+    # spill/relay-error storms + fleet SLO burn alerts, bundling the
+    # routing flight ring, gateway metrics, and the journal tail. The
+    # metrics bundle exists regardless (the supervisor's replica_deaths
+    # counter must be honest on unarmed gateways too); only the
+    # detectors/bundles gate on --incident-dir.
+    gw_metrics = GatewayMetrics()
+    flight = incidents = slo = gw_anomaly = None
+    if args.incident_dir:
+        import os as _os
+
+        from ditl_tpu.telemetry import (
+            AnomalyPlane, FlightRecorder, GatewayDetector,
+            GatewayAnomalyMonitor, IncidentManager,
+        )
+
+        flight = FlightRecorder(telemetry_cfg.flight_ring_size)
+        plane_journal = journal if journal is not None else (
+            tracer.journal if tracer is not None else None
+        )
+        incidents = IncidentManager(
+            _os.path.join(args.incident_dir, "gateway"),
+            flight=flight,
+            metrics_render=gw_metrics.registry.render,
+            journal_dir=config.journal_dir or args.trace_dir,
+            registry=gw_metrics.registry,
+            source="gateway",
+            **telemetry_cfg.incident_kwargs(),
+        )
+        plane = AnomalyPlane(incidents=incidents, journal=plane_journal)
+        slo = gateway_slo(
+            gw_metrics, **telemetry_cfg.gateway_slo_kwargs(),
+            journal=plane_journal, on_alert=plane.on_slo_alert,
+        )
+        gw_anomaly = GatewayAnomalyMonitor(
+            plane, gw_metrics,
+            GatewayDetector(
+                storm_threshold=telemetry_cfg.anomaly_storm_threshold),
+            slo=slo, flight=flight,
+        )
     supervisor = None
     server = None
     # One finally covers startup too: a replica that never turns healthy
@@ -1167,10 +1302,13 @@ def main(argv: list[str] | None = None) -> int:
             probe_timeout_s=config.probe_timeout_s,
             restart_timeout_s=config.restart_timeout_s,
             journal=journal,
+            anomaly=gw_anomaly,
+            metrics=gw_metrics,
         )
         supervisor.start()
         server = make_gateway(fleet, config=config, tracer=tracer,
-                              telemetry=telemetry_cfg)
+                              telemetry=telemetry_cfg, metrics=gw_metrics,
+                              slo=slo, incidents=incidents, flight=flight)
         stopping = threading.Event()
 
         def _shutdown(signum, frame):

@@ -554,7 +554,17 @@ class FleetSupervisor:
         max_restarts_per_replica: int = 10,
         journal: EventJournal | None = None,
         log: Callable[[str], None] | None = None,
+        anomaly=None,
+        metrics=None,
     ):
+        """``anomaly``: optional telemetry.anomaly.GatewayAnomalyMonitor —
+        notified of each replica death (the death-rate detector's input,
+        ISSUE 10) and polled once per supervision pass so spill/error
+        storms and fleet SLO burns are evaluated headlessly. ``metrics``:
+        optional GatewayMetrics whose ``replica_deaths`` counter this
+        supervisor increments on every death — unconditionally, not gated
+        on the anomaly plane, so the /metrics family is honest on
+        unarmed gateways too."""
         self.fleet = fleet
         self.interval_s = interval_s
         self.fail_threshold = fail_threshold
@@ -568,6 +578,8 @@ class FleetSupervisor:
         self._thread: threading.Thread | None = None
         self._recoveries: dict[str, threading.Thread] = {}
         self._given_up: set[str] = set()
+        self.anomaly = anomaly
+        self.metrics = metrics
 
     def journal_event(self, event: str, **attrs) -> None:
         if self._journal is not None:
@@ -600,6 +612,12 @@ class FleetSupervisor:
                 self.poll_once()
             except Exception:
                 logger.exception("fleet supervisor poll failed")
+            if self.anomaly is not None:
+                # Headless anomaly cadence (ISSUE 10): the health loop is
+                # the gateway's only periodic thread, so storm detectors
+                # and SLO burn evaluation ride it (the monitor rate-limits
+                # itself and never raises).
+                self.anomaly.poll()
 
     def poll_once(self) -> None:
         for rid in self.fleet.ids:
@@ -640,6 +658,13 @@ class FleetSupervisor:
             self.journal_event("replica.died", replica=rid,
                               fails=st.fails,
                               process_alive=st.handle.alive())
+            if self.metrics is not None:
+                self.metrics.replica_deaths.inc()
+            if self.anomaly is not None:
+                # Death-rate input (ISSUE 10): one crash self-heals; a
+                # crash loop crosses the detector's windowed threshold and
+                # becomes an incident bundle.
+                self.anomaly.note_replica_death(rid)
             self._log(f"replica {rid}: died (failed health checks: "
                       f"{st.fails}); draining routing")
             # Drain: routing already stopped (live=False); anything still

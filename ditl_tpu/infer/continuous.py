@@ -66,6 +66,7 @@ multi-query paged-attention kernel) and int8 KV.
 
 from __future__ import annotations
 
+import collections
 import time
 from dataclasses import dataclass, field
 from typing import Any
@@ -81,6 +82,7 @@ from ditl_tpu.infer.cache import init_cache
 from ditl_tpu.infer.engine import GenerateConfig, _next_pow2
 from ditl_tpu.infer.sampling import sample_logits
 from ditl_tpu.models import llama
+from ditl_tpu.telemetry.flight import TICK_RING, FlightRecorder
 from ditl_tpu.telemetry.serving import ServingMetrics
 from ditl_tpu.telemetry.tracing import NULL_TRACER, Tracer
 from ditl_tpu.utils.logging import get_logger
@@ -361,6 +363,8 @@ class ContinuousEngine:
         thrash_window: int = 32,
         metrics: ServingMetrics | None = None,
         tracer: Tracer | None = None,
+        flight: FlightRecorder | None = None,
+        anomaly=None,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -443,6 +447,15 @@ class ContinuousEngine:
         # host-only bookkeeping and never touches replicated scheduler
         # state, so pod replicas may disagree about it freely.
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # Flight recorder (ISSUE 10): always-on bounded ring of per-tick
+        # scheduler snapshots — budget spend, queue-by-class, slot
+        # occupancy — recorded as one host dict append per tick and read
+        # only when an incident bundle dumps it. ``anomaly`` is an optional
+        # telemetry.anomaly.ServingAnomalyMonitor the tick loop consults
+        # every ``check_every`` ticks (detectors over signals the metrics
+        # bundle already carries; never on the per-request path).
+        self.flight = flight if flight is not None else FlightRecorder()
+        self.anomaly = anomaly
         # Per-tick prefill work [(req_id, tokens, wall_s)] — the
         # interference-attribution input (see step()).
         self._tick_prefills: list[tuple[int, int, float]] = []
@@ -3550,6 +3563,36 @@ class ContinuousEngine:
                 self._finish_tick(prev)
         elif rec is not None:
             self._finish_tick(rec)
+        # Flight recorder (ISSUE 10): one host-dict row per tick into the
+        # bounded ring — the black box an incident bundle dumps. Host state
+        # only (no device sync); counters are the cumulative values the
+        # metrics bundle already holds, so a ring reader can difference
+        # adjacent rows to see exactly which ticks expired/429'd whom.
+        m = self.metrics
+        by_class = collections.Counter(r.slo_class for r in self._queue)
+        self.flight.ring(TICK_RING).record(
+            tick=self.tick_count,
+            queue_depth=len(self._queue),
+            # One O(queue) pass, not one per class — this runs every tick.
+            queue_by_class={cls: by_class.get(cls, 0)
+                            for cls in SLO_CLASSES},
+            slots_busy=sum(r is not None for r in self._slots),
+            prefilling=sum(
+                1 for r in self._slots if r is not None and r.prefilling
+            ),
+            prefill_tokens=self._tick_prefill_spent,
+            budget_left=self._tick_prefill_left,
+            preemptions=int(getattr(self, "preemptions", 0)),
+            deadline_expired=int(m.deadline_expired.value),
+            queue_full=int(m.queue_full.value),
+            completed=int(m.completed.value),
+        )
+        if (self.anomaly is not None
+                and self.tick_count % self.anomaly.check_every == 0):
+            # Detector cadence: every check_every ticks, over the stats
+            # snapshot + metrics bundle (telemetry/anomaly.py). The monitor
+            # never raises into the driver thread.
+            self.anomaly.observe_serving(self.stats(), m)
 
     @property
     def pending(self) -> int:
@@ -3785,6 +3828,12 @@ class ThreadedEngine:
         server derives its own tracer from this so arming the engine arms
         the whole replica with one knob."""
         return self._engine.tracer
+
+    @property
+    def flight(self) -> FlightRecorder:
+        """The engine's flight recorder (telemetry/flight.py) — the tick
+        ring an incident bundle dumps."""
+        return self._engine.flight
 
     @property
     def queue_full(self) -> bool:

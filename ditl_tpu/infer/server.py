@@ -288,6 +288,10 @@ class _Handler(BaseHTTPRequestHandler):
     # Disaggregated-fleet role tag (ISSUE 9): echoed on /health so the
     # gateway's role-aware routing reads the replica's OWN claim.
     role: str = "hybrid"
+    # Incident manager (ISSUE 10, telemetry/incident.py): arms the
+    # /incidents listing endpoint; None => 404 (unarmed is distinguishable
+    # from "no incidents").
+    incidents = None
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
@@ -457,6 +461,23 @@ class _Handler(BaseHTTPRequestHandler):
                     "no SLO monitor configured"}})
             else:
                 self._send_json(200, self.slo.report())
+        elif self.path in ("/incidents", "/v1/incidents"):
+            # Incident bundles (ISSUE 10): list this replica's assembled
+            # bundle manifests. Torn/tmp dirs are skipped by the reader,
+            # never an error; 404 when the incident plane is unarmed so a
+            # fleet aggregator can tell "no incidents" from "not watching".
+            if self.incidents is None:
+                self._send_json(404, {"error": {"message":
+                    "no incident manager configured"}})
+            else:
+                from ditl_tpu.telemetry.incident import list_bundles
+
+                bundles = list_bundles(self.incidents.directory)
+                self._send_json(200, {
+                    "count": len(bundles),
+                    "suppressed": self.incidents.suppressed_total,
+                    "incidents": bundles,
+                })
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -502,21 +523,9 @@ class _Handler(BaseHTTPRequestHandler):
             # for the same fact invites dashboards built on the wrong one.
             reserved = set(self.serving_metrics.registry._metrics)
 
-        def emit(prefix: str, obj) -> None:
-            if isinstance(obj, dict):
-                for k, v in obj.items():
-                    emit(f"{prefix}_{k}" if prefix else str(k), v)
-            elif f"ditl_serving_{prefix}" in reserved:
-                return
-            elif isinstance(obj, bool):
-                lines.append(f"# TYPE ditl_serving_{prefix} gauge")
-                lines.append(f"ditl_serving_{prefix} {int(obj)}")
-            elif isinstance(obj, (int, float)) and obj == obj:  # drop NaN
-                lines.append(f"# TYPE ditl_serving_{prefix} gauge")
-                lines.append(f"ditl_serving_{prefix} {obj}")
-            # strings (engine/cache_mode names) have no gauge form; skip
+        from ditl_tpu.telemetry.serving import flattened_stats_lines
 
-        emit("", stats)
+        lines.extend(flattened_stats_lines(stats, reserved))
         # HBM accounting (telemetry/memwatch.py, ISSUE 7): per-device
         # allocator gauges (bytes in use, high-watermark, limit) sampled at
         # scrape time — absent (not zero) on backends without memory stats.
@@ -1629,6 +1638,8 @@ def make_server(
     slo: BurnRateMonitor | None = None,
     telemetry=None,
     role: str = "hybrid",
+    incidents=None,
+    serving_metrics: ServingMetrics | None = None,
 ) -> DrainableHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
@@ -1647,10 +1658,15 @@ def make_server(
     gets 503, in-flight finishes) and ``kill()`` (abrupt, for failover
     drills)."""
 
-    # One telemetry bundle per server: the continuous engine's own when one
-    # is serving (its scheduler records into it), else a fresh bundle the
-    # lock-step handler path records into. Either way /metrics renders it.
-    serving_metrics = getattr(threaded_engine, "metrics", None)
+    # One telemetry bundle per server: an explicit ``serving_metrics``
+    # (the incident-armed serve() path shares one bundle between the
+    # engine, the incident manager, and this server), else the continuous
+    # engine's own (its scheduler records into it), else a fresh bundle
+    # the lock-step handler path records into. Either way /metrics
+    # renders it. ``incidents`` (telemetry/incident.IncidentManager) arms
+    # the /incidents listing endpoint.
+    if serving_metrics is None:
+        serving_metrics = getattr(threaded_engine, "metrics", None)
     if serving_metrics is None:
         serving_metrics = ServingMetrics()
     # Tracing (ISSUE 6): default to the engine's tracer so one knob
@@ -1685,6 +1701,7 @@ def make_server(
             "tracer": tracer,
             "slo": slo,
             "role": role,
+            "incidents": incidents,
         },
     )
     return DrainableHTTPServer((host, port), handler)
@@ -1860,6 +1877,16 @@ def serve(argv: list[str] | None = None) -> int:
         "or journal_max_mb=64 — tunes the /slo objectives and the trace "
         "journal's rotation cap",
     )
+    parser.add_argument(
+        "--incident-dir", default="",
+        help="arm the flight-recorder/anomaly/incident plane (ISSUE 10): "
+        "the continuous engine's detectors (deadline/429 storms, "
+        "preemption thrash, TTFT/TPOT jumps, hit-ratio collapse) and SLO "
+        "burn-alert transitions assemble fingerprint-deduped incident "
+        "bundles into this directory, listed at /incidents and via "
+        "python -m ditl_tpu.telemetry.incident --dir DIR; detector "
+        "thresholds ride --telemetry-override (anomaly_*/incident_*)",
+    )
     args = parser.parse_args(argv)
 
     from ditl_tpu.config import Config, parse_overrides
@@ -1883,6 +1910,46 @@ def serve(argv: list[str] | None = None) -> int:
             source=f"server-{tag}",
             max_bytes=telemetry_cfg.journal_max_bytes(),
         ))
+
+    # Flight recorder + anomaly plane (ISSUE 10): the engine's tick ring is
+    # always on; --incident-dir additionally arms the serving detectors +
+    # the incident manager, all sharing ONE metrics bundle so the bundle's
+    # metrics.prom snapshot is exactly what /metrics would have answered.
+    serving_metrics = incidents = anomaly_monitor = slo = None
+    if args.incident_dir and jax.process_index() == 0:
+        import os
+
+        from ditl_tpu.telemetry import (  # noqa: F401 (grouped arm imports)
+            AnomalyPlane, FlightRecorder, IncidentManager,
+            ServingAnomalyMonitor, ServingDetector, ServingMetrics,
+        )
+        from ditl_tpu.telemetry.slo import serving_slo
+
+        serving_metrics = ServingMetrics()
+        flight = FlightRecorder(telemetry_cfg.flight_ring_size)
+        journal = tracer.journal if tracer is not None else None
+        incidents = IncidentManager(
+            args.incident_dir,
+            flight=flight,
+            metrics_render=serving_metrics.render,
+            journal_dir=args.trace_dir,
+            registry=serving_metrics.registry,
+            source=f"server-{os.getpid()}",
+            **telemetry_cfg.incident_kwargs(),
+        )
+        plane = AnomalyPlane(incidents=incidents, journal=journal)
+        slo = serving_slo(
+            serving_metrics, **telemetry_cfg.serving_slo_kwargs(),
+            journal=journal, on_alert=plane.on_slo_alert,
+        )
+        anomaly_monitor = ServingAnomalyMonitor(
+            plane,
+            ServingDetector(**telemetry_cfg.serving_detector_kwargs()),
+            slo=slo,
+            check_every=telemetry_cfg.anomaly_check_every_ticks,
+        )
+    else:
+        flight = None
 
     if args.mesh and not args.pod and jax.process_count() > 1:
         parser.error("--mesh on a multi-host pod requires --pod: the mesh "
@@ -2077,6 +2144,11 @@ def serve(argv: list[str] | None = None) -> int:
             admission=args.admission,
             token_budget=args.token_budget,
             tracer=tracer,
+            # Incident plane (ISSUE 10): shared metrics bundle + flight
+            # recorder + detector monitor when --incident-dir armed them.
+            metrics=serving_metrics,
+            flight=flight,
+            anomaly=anomaly_monitor,
         )
 
     if args.pod and jax.process_index() != 0:
@@ -2138,6 +2210,7 @@ def serve(argv: list[str] | None = None) -> int:
         adapter_names=adapter_names, spec_generator=spec,
         max_pending=args.max_pending or None,
         tracer=tracer, telemetry=telemetry_cfg, role=args.role,
+        slo=slo, incidents=incidents, serving_metrics=serving_metrics,
     )
 
     # SIGTERM = graceful drain (the gateway/orchestrator rolling-restart

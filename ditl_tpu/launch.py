@@ -25,6 +25,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 
 from ditl_tpu.config import Config, parse_overrides
@@ -254,6 +255,15 @@ def run_process_supervised(argv: list[str], num_workers: int = 1) -> int:
         # Size control (ISSUE 6 satellite): telemetry.journal_max_mb caps
         # every per-process journal via segment rotation.
         journal_max_bytes=config.telemetry.journal_max_bytes(),
+        # Anomaly/incident plane (ISSUE 10): worker deaths, heartbeat
+        # stalls, and straggler escalations assemble liveness-ring bundles
+        # under a controller-owned subdirectory (the workers' trainer-side
+        # managers write their own).
+        incident_dir=(
+            os.path.join(config.telemetry.incident_dir, "controller")
+            if config.telemetry.incident_dir else ""
+        ),
+        incident_kwargs=config.telemetry.incident_kwargs(),
     )
     result = controller.run()
     if not result.ok:

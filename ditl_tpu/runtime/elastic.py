@@ -42,7 +42,12 @@ from typing import Callable, Sequence
 
 from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.telemetry import (
+    LIVENESS_RING,
+    Anomaly,
+    AnomalyPlane,
     EventJournal,
+    FlightRecorder,
+    IncidentManager,
     controller_journal_path,
     write_pod_timeline,
 )
@@ -200,6 +205,8 @@ class PodController:
         journal_max_bytes: int | None = None,
         straggler_lag_steps: int = 0,
         straggler_relaunch: bool = False,
+        incident_dir: str = "",
+        incident_kwargs: dict | None = None,
     ):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -264,10 +271,34 @@ class PodController:
         self.straggler_lag_steps = straggler_lag_steps
         self.straggler_relaunch = straggler_relaunch
         self._straggler_flagged: set[int] = set()
+        # Flight recorder + anomaly plane (ISSUE 10): every controller
+        # lifecycle event also lands in the always-on liveness ring (the
+        # pod's black box); with ``incident_dir`` set, worker deaths,
+        # heartbeat stalls, and straggler escalations additionally
+        # assemble incident bundles (ring dump + journal tail + config-
+        # free manifest) through the shared manager.
+        self.flight = FlightRecorder()
+        self._incidents: IncidentManager | None = (
+            IncidentManager(
+                incident_dir, flight=self.flight, journal_dir=journal_dir,
+                source="pod-controller", **(incident_kwargs or {}),
+            )
+            if incident_dir else None
+        )
+        self._anomaly = AnomalyPlane(
+            incidents=self._incidents, journal=self._journal,
+        )
 
     def _jevent(self, event: str, **attrs) -> None:
+        self.flight.ring(LIVENESS_RING).record(event=event, **attrs)
         if self._journal is not None:
             self._journal.event(event, **attrs)
+
+    def _trigger(self, kind: str, **detail) -> None:
+        """Route a liveness failure into the anomaly plane (journal +
+        bundle); fingerprinted per kind so a crash-looping pod dedupes
+        into one bundle per cooldown window."""
+        self._anomaly.trigger(Anomaly(kind, detail=detail))
 
     # -- state machine ------------------------------------------------------
 
@@ -483,6 +514,9 @@ class PodController:
                 self._failure_rc = rc
                 self._jevent("pod.worker_died", worker=i, rc=rc,
                              cause=_describe_rc(rc))
+                self._trigger("elastic.worker_death", worker=i, rc=rc,
+                              cause=_describe_rc(rc),
+                              restarts=self.restarts)
             else:
                 stale = self._stale_workers()
                 if stale and any(r == 0 for r in rcs):
@@ -510,6 +544,9 @@ class PodController:
                     self._failure_rc = 1
                     self._jevent("pod.heartbeat_stale", worker=stale[0],
                                  timeout_s=self.heartbeat_timeout_s)
+                    self._trigger("elastic.heartbeat_stale",
+                                  worker=stale[0],
+                                  timeout_s=self.heartbeat_timeout_s)
                 else:
                     stragglers = self._straggler_workers()
                     for i, step_i, lag_i, med in stragglers:
@@ -538,6 +575,8 @@ class PodController:
                         )
                         # A straggler has no exit code either.
                         self._failure_rc = 1
+                        self._trigger("elastic.straggler", worker=i,
+                                      step=step_i, lag=lag_i)
             if failure is None:
                 if timed_out:
                     # Like the stale branch: no worker failed — don't let

@@ -1,0 +1,501 @@
+"""Signal-driven anomaly detectors (ISSUE 10 tentpole leg b).
+
+The repo already *produces* every signal a 3 a.m. incident needs —
+``grad_norm`` is computed on every train step, deadline expiries and 429s
+are counted, TTFT/TPOT land in histograms, SLO burn rates are evaluated —
+but nothing *checks* them: the metrics are passive until a scraper asks.
+This module is the checking layer: small host-side detectors over values
+the callers already hold, producing :class:`Anomaly` records that the
+incident plane (telemetry/incident.py) turns into black-box bundles.
+
+Detector discipline (the registry's rules, inherited):
+
+- **jax-free, zero device syncs**: detectors consume host floats and
+  counter values. The training detector runs inside the MetricsLogger's
+  existing ``log_every`` flush — the ONE place loss/grad_norm are already
+  on the host — so arming it adds no blocking transfer (tier-1-pinned).
+- **cheap when healthy**: one observe is a handful of subtractions and a
+  bounded-window median; serving observes run every
+  ``anomaly_check_every_ticks`` scheduler ticks, not per request.
+- **rolling baselines, not absolute thresholds**: a latency "jump" is
+  measured against the workload's own recent p95 (EMA over windowed
+  histogram deltas), so the same config serves a CPU simulation and a v5e
+  pod without retuning. Storm detectors (deadline expiry, 429s,
+  preemption thrash) are per-window deltas — absolute rates ARE the right
+  shape there.
+- **detectors detect, the incident plane decides**: fingerprint dedupe,
+  cooldown rate-limiting, and bundle assembly all live in
+  ``IncidentManager`` — a detector may fire every window during a sustained
+  storm and still produce exactly one bundle.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import math
+import statistics
+import time
+from typing import Any
+
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "Anomaly",
+    "AnomalyPlane",
+    "GatewayAnomalyMonitor",
+    "GatewayDetector",
+    "NonFiniteMetricError",
+    "ServingAnomalyMonitor",
+    "ServingDetector",
+    "TrainingDetector",
+    "slo_alert_anomaly",
+]
+
+
+class NonFiniteMetricError(RuntimeError):
+    """Raised by the trainer AFTER a fatal non-finite detection has been
+    journaled and bundled — the crash the incident bundle precedes. A
+    RuntimeError on purpose: it rides the same elastic-recovery path a
+    genuine training failure would (launch.run_supervised restart
+    budget), never the client-error path."""
+
+
+def _finite(v: Any) -> bool:
+    return isinstance(v, (int, float)) and math.isfinite(v)
+
+
+@dataclasses.dataclass(frozen=True)
+class Anomaly:
+    """One detected anomaly. ``kind`` is the dotted trigger name
+    (``train.loss_nonfinite``, ``serving.deadline_storm``, ...);
+    ``severity`` is ``"fatal"`` (the run is about to crash — dump NOW)
+    or ``"warning"`` (degradation worth a bundle, run continues).
+    ``detail`` carries the evidence (host scalars only — it is JSON-dumped
+    into the bundle manifest verbatim)."""
+
+    kind: str
+    severity: str = "warning"
+    detail: dict = dataclasses.field(default_factory=dict)
+    ts: float = dataclasses.field(default_factory=time.time)
+
+    def fingerprint(self) -> str:
+        """Stable identity for dedupe: the same KIND of failure maps to the
+        same fingerprint no matter how its evidence varies per occurrence
+        (a deadline storm's expiry count differs every window; it is still
+        one incident). ``detail["fingerprint_key"]`` refines it when one
+        kind covers distinguishable failures (e.g. per-objective SLO
+        alerts)."""
+        key = f"{self.kind}/{self.detail.get('fingerprint_key', '')}"
+        return hashlib.sha256(key.encode()).hexdigest()[:12]
+
+
+# ---------------------------------------------------------------------------
+# Training-side detectors
+# ---------------------------------------------------------------------------
+
+
+class TrainingDetector:
+    """Non-finite loss/grad plus rolling-window loss-spike and grad-norm
+    explosion. Fed from host floats the metrics flush already fetched —
+    never from device arrays."""
+
+    def __init__(self, *, window: int = 32, min_history: int = 8,
+                 loss_spike_factor: float = 4.0,
+                 grad_explosion_factor: float = 10.0):
+        self.min_history = max(2, min_history)
+        self.loss_spike_factor = loss_spike_factor
+        self.grad_explosion_factor = grad_explosion_factor
+        self._losses: collections.deque = collections.deque(maxlen=window)
+        self._grads: collections.deque = collections.deque(maxlen=window)
+
+    def observe_step(self, step: int, loss: Any,
+                     grad_norm: Any = None) -> list[Anomaly]:
+        out: list[Anomaly] = []
+        if loss is not None and not _finite(loss):
+            out.append(Anomaly(
+                "train.loss_nonfinite", severity="fatal",
+                detail={"step": step, "loss": repr(loss)},
+            ))
+        if grad_norm is not None and not _finite(grad_norm):
+            out.append(Anomaly(
+                "train.grad_nonfinite", severity="fatal",
+                detail={"step": step, "grad_norm": repr(grad_norm)},
+            ))
+        if _finite(loss):
+            if len(self._losses) >= self.min_history:
+                base = statistics.median(self._losses)
+                if loss > self.loss_spike_factor * max(base, 1e-8):
+                    out.append(Anomaly("train.loss_spike", detail={
+                        "step": step, "loss": float(loss),
+                        "rolling_median": round(base, 6),
+                        "factor": self.loss_spike_factor,
+                    }))
+            self._losses.append(float(loss))
+        if _finite(grad_norm):
+            if len(self._grads) >= self.min_history:
+                base = statistics.median(self._grads)
+                if grad_norm > self.grad_explosion_factor * max(base, 1e-8):
+                    out.append(Anomaly("train.grad_explosion", detail={
+                        "step": step, "grad_norm": float(grad_norm),
+                        "rolling_median": round(base, 6),
+                        "factor": self.grad_explosion_factor,
+                    }))
+            self._grads.append(float(grad_norm))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Serving-side detectors
+# ---------------------------------------------------------------------------
+
+
+class _HistWindow:
+    """Windowed view over a cumulative fixed-bucket histogram: each
+    ``advance`` returns (observations since last advance, their p95) by
+    diffing bucket-count snapshots — the same counter-delta shape the SLO
+    monitor uses, kept local so detectors never mutate the instrument."""
+
+    def __init__(self):
+        self._prev: list[int] | None = None
+        self._prev_sum = 0.0
+
+    def advance(self, hist) -> tuple[int, float | None]:
+        counts = list(hist._counts)
+        if self._prev is None or len(self._prev) != len(counts):
+            delta = list(counts)
+        else:
+            delta = [c - p for c, p in zip(counts, self._prev)]
+        self._prev = counts
+        n = sum(delta)
+        if n <= 0:
+            return 0, None
+        # Re-use Histogram.quantile over the delta counts via a shell
+        # instance sharing the bucket ladder (no observations re-played).
+        from ditl_tpu.telemetry.registry import Histogram
+
+        shell = Histogram("_window", buckets=hist.buckets)
+        shell._counts = delta
+        shell._count = n
+        return n, shell.quantile(0.95)
+
+
+class ServingDetector:
+    """Detectors over the continuous engine's stats snapshot + metrics
+    bundle, observed once per ``anomaly_check_every_ticks`` ticks:
+
+    - **deadline storm / 429 storm / preemption thrash**: per-window
+      counter deltas >= ``storm_threshold``.
+    - **queue-depth growth**: depth >= ``queue_depth_limit`` AND still
+      growing vs the previous observation (a deep-but-draining queue is
+      backlog, not pathology).
+    - **TTFT/TPOT p95 jump**: windowed histogram p95 >
+      ``latency_factor`` x the EMA of previous windows' p95s (needs
+      ``min_samples`` observations in the window and one prior window).
+    - **prefix-hit-ratio collapse**: windowed hit ratio <
+      ``hit_ratio_floor`` x the EMA baseline, once the baseline is
+      meaningful (>= 0.1) and the window saw >= ``min_hit_tokens``
+      prompt tokens.
+    """
+
+    _EMA_ALPHA = 0.3
+
+    def __init__(self, *, storm_threshold: int = 8,
+                 queue_depth_limit: int = 64,
+                 latency_factor: float = 3.0, min_samples: int = 16,
+                 hit_ratio_floor: float = 0.5, min_hit_tokens: int = 64):
+        self.storm_threshold = max(1, storm_threshold)
+        self.queue_depth_limit = max(1, queue_depth_limit)
+        self.latency_factor = latency_factor
+        self.min_samples = max(1, min_samples)
+        self.hit_ratio_floor = hit_ratio_floor
+        self.min_hit_tokens = max(1, min_hit_tokens)
+        self._prev_counters: dict[str, float] = {}
+        self._prev_queue_depth: int | None = None
+        self._ttft_w = _HistWindow()
+        self._tpot_w = _HistWindow()
+        self._ttft_ema: float | None = None
+        self._tpot_ema: float | None = None
+        self._ratio_ema: float | None = None
+        self._prev_hit = 0.0
+        self._prev_miss = 0.0
+
+    def _delta(self, name: str, value: float) -> float:
+        prev = self._prev_counters.get(name, 0.0)
+        self._prev_counters[name] = value
+        return value - prev
+
+    def observe(self, stats: dict, metrics) -> list[Anomaly]:
+        out: list[Anomaly] = []
+        # -- storms: per-window counter deltas ---------------------------
+        for counter, kind in (
+            (metrics.deadline_expired, "serving.deadline_storm"),
+            (metrics.queue_full, "serving.429_storm"),
+            (metrics.preemptions, "serving.preemption_thrash"),
+        ):
+            d = self._delta(kind, counter.value)
+            if d >= self.storm_threshold:
+                out.append(Anomaly(kind, detail={
+                    "window_count": int(d),
+                    "lifetime_total": int(counter.value),
+                    "threshold": self.storm_threshold,
+                }))
+        # -- queue growth ------------------------------------------------
+        depth = int(stats.get("queue_depth", 0))
+        if (depth >= self.queue_depth_limit
+                and self._prev_queue_depth is not None
+                and depth > self._prev_queue_depth):
+            out.append(Anomaly("serving.queue_growth", detail={
+                "queue_depth": depth,
+                "previous_depth": self._prev_queue_depth,
+                "limit": self.queue_depth_limit,
+                "queue_by_class": stats.get("queue_by_class", {}),
+            }))
+        self._prev_queue_depth = depth
+        # -- latency jumps vs rolling baseline ---------------------------
+        for window, hist, ema_attr, kind in (
+            (self._ttft_w, metrics.ttft, "_ttft_ema", "serving.ttft_jump"),
+            (self._tpot_w, metrics.decode_token, "_tpot_ema",
+             "serving.tpot_jump"),
+        ):
+            n, p95 = window.advance(hist)
+            if n < self.min_samples or p95 is None:
+                continue
+            ema = getattr(self, ema_attr)
+            if ema is not None and p95 > self.latency_factor * ema:
+                out.append(Anomaly(kind, detail={
+                    "window_p95_s": round(p95, 6),
+                    "baseline_p95_s": round(ema, 6),
+                    "factor": self.latency_factor,
+                    "window_samples": n,
+                }))
+            setattr(self, ema_attr,
+                    p95 if ema is None
+                    else ema + self._EMA_ALPHA * (p95 - ema))
+        # -- prefix-hit-ratio collapse -----------------------------------
+        hit = metrics.prefix_cache_hit_tokens.value
+        miss = metrics.prefix_cache_miss_tokens.value
+        d_hit, d_miss = hit - self._prev_hit, miss - self._prev_miss
+        self._prev_hit, self._prev_miss = hit, miss
+        if d_hit + d_miss >= self.min_hit_tokens:
+            ratio = d_hit / (d_hit + d_miss)
+            ema = self._ratio_ema
+            if ema is not None and ema >= 0.1 and (
+                    ratio < self.hit_ratio_floor * ema):
+                out.append(Anomaly("serving.hit_ratio_collapse", detail={
+                    "window_hit_ratio": round(ratio, 4),
+                    "baseline_hit_ratio": round(ema, 4),
+                    "floor": self.hit_ratio_floor,
+                    "window_tokens": int(d_hit + d_miss),
+                }))
+            self._ratio_ema = (
+                ratio if ema is None
+                else ema + self._EMA_ALPHA * (ratio - ema)
+            )
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Gateway-side detectors
+# ---------------------------------------------------------------------------
+
+
+class GatewayDetector:
+    """Fleet-level detectors over the gateway's metrics bundle:
+
+    - **replica death rate**: >= ``death_threshold`` replica deaths inside
+      ``death_window_s`` (the FleetSupervisor reports each death via
+      :meth:`note_death`; a single crash self-heals, a crash LOOP is an
+      incident).
+    - **spill storm**: fleet-saturation 429s + no-live-replica 503s per
+      observe window >= ``storm_threshold``.
+    - **relay-error storm**: retried attempts + mid-stream aborts per
+      window >= ``storm_threshold``.
+    """
+
+    def __init__(self, *, storm_threshold: int = 8,
+                 death_threshold: int = 2, death_window_s: float = 60.0):
+        self.storm_threshold = max(1, storm_threshold)
+        self.death_threshold = max(1, death_threshold)
+        self.death_window_s = death_window_s
+        self._deaths: collections.deque = collections.deque(maxlen=64)
+        self._prev: dict[str, float] = {}
+
+    def note_death(self, replica_id: str,
+                   now: float | None = None) -> list[Anomaly]:
+        now = time.time() if now is None else now
+        self._deaths.append((now, replica_id))
+        recent = [r for t, r in self._deaths
+                  if now - t <= self.death_window_s]
+        if len(recent) >= self.death_threshold:
+            return [Anomaly("gateway.replica_death_storm", detail={
+                "deaths_in_window": len(recent),
+                "window_s": self.death_window_s,
+                "replicas": recent[-8:],
+            })]
+        return []
+
+    def _delta(self, name: str, value: float) -> float:
+        prev = self._prev.get(name, 0.0)
+        self._prev[name] = value
+        return value - prev
+
+    def observe(self, gw_metrics) -> list[Anomaly]:
+        out: list[Anomaly] = []
+        spill = (self._delta("saturated", gw_metrics.saturated.value)
+                 + self._delta("no_replica", gw_metrics.no_replica.value))
+        if spill >= self.storm_threshold:
+            out.append(Anomaly("gateway.spill_storm", detail={
+                "window_count": int(spill),
+                "threshold": self.storm_threshold,
+            }))
+        errors = (self._delta("retries", gw_metrics.retries.value)
+                  + self._delta("aborts", gw_metrics.stream_aborts.value))
+        if errors >= self.storm_threshold:
+            out.append(Anomaly("gateway.relay_error_storm", detail={
+                "window_count": int(errors),
+                "threshold": self.storm_threshold,
+            }))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# The plane: detectors -> journal -> incident bundles
+# ---------------------------------------------------------------------------
+
+
+def slo_alert_anomaly(objective: str, entry: dict) -> Anomaly:
+    """The SLO burn monitor's false->true alert transition as an anomaly —
+    fingerprinted per objective so a TTFT burn and an availability burn are
+    distinct incidents."""
+    return Anomaly("slo.burn_alert", detail={
+        "fingerprint_key": objective,
+        "objective": objective,
+        "target": entry.get("target"),
+        "windows": {
+            w: {"burn_rate": v.get("burn_rate"),
+                "error_rate": v.get("error_rate")}
+            for w, v in entry.get("windows", {}).items()
+        },
+    })
+
+
+class AnomalyPlane:
+    """The sink every leg routes detections through: count, journal, and
+    hand to the incident manager (which dedupes/rate-limits/assembles).
+    ``trigger`` never raises — a broken bundle write must not take down
+    the scheduler or trainer it is observing."""
+
+    def __init__(self, incidents=None, journal=None):
+        self.incidents = incidents
+        self.journal = journal
+        self.detected: dict[str, int] = {}
+
+    def trigger(self, anomaly: Anomaly) -> str | None:
+        """Returns the bundle path when one was assembled (None when
+        deduped/cooled down/unarmed)."""
+        self.detected[anomaly.kind] = self.detected.get(anomaly.kind, 0) + 1
+        try:
+            if self.journal is not None:
+                self.journal.event(
+                    "anomaly.detected", kind=anomaly.kind,
+                    severity=anomaly.severity,
+                    fingerprint=anomaly.fingerprint(), **{
+                        k: v for k, v in anomaly.detail.items()
+                        if isinstance(v, (int, float, str, bool))
+                    },
+                )
+            if self.incidents is not None:
+                return self.incidents.trigger(anomaly)
+        except Exception:  # noqa: BLE001 - observability must not crash work
+            logger.exception("anomaly plane: trigger failed for %s",
+                             anomaly.kind)
+        return None
+
+    def on_slo_alert(self, objective: str, entry: dict) -> None:
+        """The ``BurnRateMonitor(on_alert=...)`` hook shape."""
+        self.trigger(slo_alert_anomaly(objective, entry))
+
+
+class GatewayAnomalyMonitor:
+    """What the fleet supervisor holds: replica-death notes (fired from
+    the supervisor's recovery path) plus per-poll observes over the
+    gateway metrics bundle. With an ``slo`` attached each observe also
+    samples the fleet burn-rate windows, so gateway burn alerts journal
+    and trigger headlessly too."""
+
+    def __init__(self, plane: AnomalyPlane, gw_metrics,
+                 detector: GatewayDetector | None = None,
+                 slo=None, flight=None, check_every: int = 4):
+        self.plane = plane
+        self.gw_metrics = gw_metrics
+        self.detector = detector if detector is not None else GatewayDetector()
+        self.slo = slo
+        self.flight = flight
+        self.check_every = max(1, check_every)
+        self._polls = 0
+        self._broken = False
+
+    def note_replica_death(self, replica_id: str) -> None:
+        """The supervisor increments the ``replica_deaths`` counter itself
+        (unconditionally); this hook only owns the detector + ring side."""
+        try:
+            if self.flight is not None:
+                self.flight.ring("replica_lifecycle").record(
+                    event="replica.died", replica=replica_id,
+                )
+            for anomaly in self.detector.note_death(replica_id):
+                self.plane.trigger(anomaly)
+        except Exception:  # noqa: BLE001 - never break replica recovery
+            logger.exception("gateway anomaly monitor: death note failed")
+
+    def poll(self) -> None:
+        """Called once per supervisor poll; observes every
+        ``check_every``-th call."""
+        self._polls += 1
+        if self._broken or self._polls % self.check_every:
+            return
+        try:
+            if self.slo is not None:
+                self.slo.report()
+            for anomaly in self.detector.observe(self.gw_metrics):
+                self.plane.trigger(anomaly)
+        except Exception:  # noqa: BLE001 - never break the health loop
+            logger.exception("gateway anomaly monitor failed; disarming")
+            self._broken = True
+
+
+class ServingAnomalyMonitor:
+    """What the continuous engine holds: observe cadence + the serving
+    detector + (optionally) the SLO monitor, all feeding one plane. The
+    engine calls :meth:`observe_serving` every ``check_every`` ticks;
+    with an ``slo`` attached each observe also samples the burn-rate
+    windows — so a headless fleet with no Prometheus scraper still
+    evaluates (and journals) burn alerts (ISSUE 10 satellite)."""
+
+    def __init__(self, plane: AnomalyPlane,
+                 detector: ServingDetector | None = None,
+                 slo=None, check_every: int = 32):
+        self.plane = plane
+        self.detector = detector if detector is not None else ServingDetector()
+        self.slo = slo
+        self.check_every = max(1, check_every)
+        self._broken = False
+
+    def observe_serving(self, stats: dict, metrics) -> None:
+        if self._broken:
+            return
+        try:
+            if self.slo is not None:
+                # Headless burn evaluation: report() samples the windows
+                # and fires the monitor's alert-transition hook (slo.py),
+                # which routes back into this plane.
+                self.slo.report()
+            for anomaly in self.detector.observe(stats, metrics):
+                self.plane.trigger(anomaly)
+        except Exception:  # noqa: BLE001 - never kill the engine driver
+            logger.exception("serving anomaly monitor failed; disarming")
+            self._broken = True

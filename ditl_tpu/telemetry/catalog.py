@@ -1,0 +1,311 @@
+"""Generated metrics catalog (ISSUE 10 satellite).
+
+The repo exposes 130+ ``ditl_*`` metric families, and until this module
+they lived only in code — scattered across ServingMetrics, GatewayMetrics,
+the flattened /v1/stats gauges, the SLO burn gauges, memwatch, and the
+incident counters. :data:`CATALOG` is the single source of truth: every
+family's exposed name (with ``<placeholder>`` segments for unbounded
+labels like replica ids), its Prometheus type, and a one-line meaning.
+
+Two artifacts hang off it:
+
+- ``docs/metrics.md`` is GENERATED from this table
+  (``python -m ditl_tpu.telemetry.catalog --write docs/metrics.md``); the
+  drift-guard test asserts the doc matches the table byte-for-byte, so a
+  stale doc fails CI instead of rotting.
+- the drift-guard test (tests/test_metrics_catalog.py) registers the
+  families a live server/gateway/training surface actually creates,
+  normalizes dynamic label segments with :func:`normalize_family`, and
+  asserts live ⊆ catalog AND required-catalog ⊆ live — a new instrument
+  without a catalog row (or a catalog row whose instrument was deleted)
+  fails the build.
+
+Entries marked ``optional`` are absent on some backends/configurations by
+design (memwatch on statless CPU, multi-LoRA gauges without adapters,
+overflow tenant labels) — the absent-not-zero rule; they still must
+normalize onto a catalog row when they DO appear.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+__all__ = [
+    "CATALOG",
+    "CatalogEntry",
+    "catalog_families",
+    "main",
+    "normalize_family",
+    "render_markdown",
+    "required_families",
+]
+
+
+@dataclass(frozen=True)
+class CatalogEntry:
+    family: str  # exposed name (classic text format; counters carry _total)
+    type: str  # counter | gauge | histogram
+    labels: str  # meaning of <placeholder> segments ("" = none)
+    meaning: str
+    optional: bool = False  # absent on some backends/configs (absent != 0)
+
+
+# Dynamic-label normalization: a live family name -> its catalog pattern.
+# Rules are applied first-match; anything untouched must match a catalog
+# row verbatim.
+_NORMALIZE_RULES: tuple[tuple[re.Pattern, str], ...] = (
+    (re.compile(r"^(ditl_gateway_replica_)(?!deaths_total$)(.+?)_"
+                r"(routed_total|retried_total|"
+                r"recent_prefix_cache_hit_ratio|prefix_cache_hit_ratio)$"),
+     r"\1<id>_\3"),
+    (re.compile(r"^(ditl_gateway_tenant_)(.+?)_"
+                r"(admitted_total|throttled_total)$"),
+     r"\1<tenant>_\3"),
+    (re.compile(r"^(ditl_memory_device)\d+_(.+)$"), r"\1<i>_\2"),
+    (re.compile(r"^(ditl_memory_)(.+?)_device\d+_(.+)$"),
+     r"\1<replica>_device<i>_\3"),
+    (re.compile(r"^(ditl_incidents_trigger_).+(_total)$"), r"\1<kind>\2"),
+    (re.compile(r"^(ditl_slo_\w+_burn_rate_w)\d+$"), r"\1<window>"),
+)
+
+
+def normalize_family(name: str) -> str:
+    """Map a live family name onto its catalog pattern (identity for
+    families without dynamic labels)."""
+    for rx, rep in _NORMALIZE_RULES:
+        if rx.match(name):
+            return rx.sub(rep, name)
+    return name
+
+
+# (family, type, labels, meaning[, optional]) — keep sorted by family.
+_ROWS: tuple = (
+    ("ditl_gateway_429_by_class_batch_total", "counter", "", "requests 429 carrying SLO class batch"),
+    ("ditl_gateway_429_by_class_best_effort_total", "counter", "", "requests 429 carrying SLO class best_effort"),
+    ("ditl_gateway_429_by_class_default_total", "counter", "", "requests 429 carrying SLO class default"),
+    ("ditl_gateway_429_by_class_interactive_total", "counter", "", "requests 429 carrying SLO class interactive"),
+    ("ditl_gateway_affinity_hits_total", "counter", "", "requests routed to the same replica as the previous request with the same affinity key"),
+    ("ditl_gateway_affinity_misses_total", "counter", "", "requests whose affinity key landed on a different replica than last time"),
+    ("ditl_gateway_fleet_prefix_cache_hit_ratio", "gauge", "", "token-weighted fleet prefix-cache hit ratio - compare against the affinity hit-rate counters"),
+    ("ditl_gateway_fleet_recent_prefix_cache_hit_ratio", "gauge", "", "token-weighted fleet prefix-cache hit ratio over the recent health-poll window"),
+    ("ditl_gateway_fleet_saturated_total", "counter", "", "requests 429'd because every replica was saturated"),
+    ("ditl_gateway_hedges_total", "counter", "", "hedged duplicate requests fired"),
+    ("ditl_gateway_no_replica_total", "counter", "", "requests failed with no live replica"),
+    ("ditl_gateway_relayed_by_class_batch_total", "counter", "", "requests relayed carrying SLO class batch"),
+    ("ditl_gateway_relayed_by_class_best_effort_total", "counter", "", "requests relayed carrying SLO class best_effort"),
+    ("ditl_gateway_relayed_by_class_default_total", "counter", "", "requests relayed carrying SLO class default"),
+    ("ditl_gateway_relayed_by_class_interactive_total", "counter", "", "requests relayed carrying SLO class interactive"),
+    ("ditl_gateway_replica_<id>_prefix_cache_hit_ratio", "gauge", "replica id", "measured engine prefix-cache hit ratio of replica r0 (lifetime, from its last health poll)"),
+    ("ditl_gateway_replica_<id>_recent_prefix_cache_hit_ratio", "gauge", "replica id", "windowed (last few health polls) prefix-cache hit ratio of replica r0 - the spill-steering input"),
+    ("ditl_gateway_replica_<id>_retried_total", "counter", "replica id", "requests retried for replica r0"),
+    ("ditl_gateway_replica_<id>_routed_total", "counter", "replica id", "requests routed for replica r0"),
+    ("ditl_gateway_replica_deaths_total", "counter", "", "replica died->drain->relaunch cycles the supervisor ran (the anomaly plane's death-rate input, ISSUE 10)"),
+    ("ditl_gateway_replicas_draining", "gauge", "", "replicas currently draining"),
+    ("ditl_gateway_replicas_live", "gauge", "", "replicas currently routable"),
+    ("ditl_gateway_request_e2e_seconds", "histogram", "", "gateway receive -> response relayed"),
+    ("ditl_gateway_requests_completed_total", "counter", "", "requests relayed to completion"),
+    ("ditl_gateway_requests_total", "counter", "", "requests received by the gateway"),
+    ("ditl_gateway_retries_total", "counter", "", "proxy attempts retried on another replica (replica death/busy)"),
+    ("ditl_gateway_role_decode_heavy_routed_total", "counter", "", "requests routed on decode_heavy-role replicas"),
+    ("ditl_gateway_role_decode_heavy_spilled_total", "counter", "", "requests spilled on decode_heavy-role replicas"),
+    ("ditl_gateway_role_hybrid_replicas_live", "gauge", "", "live hybrid-role replicas"),
+    ("ditl_gateway_role_hybrid_routed_total", "counter", "", "requests routed on hybrid-role replicas"),
+    ("ditl_gateway_role_hybrid_slot_pressure", "gauge", "", "max active_slots/capacity across hybrid-role replicas"),
+    ("ditl_gateway_role_hybrid_spilled_total", "counter", "", "requests spilled on hybrid-role replicas"),
+    ("ditl_gateway_role_hybrid_tpot_p95_s", "gauge", "", "worst per-replica tpot p95 across hybrid-role replicas (lifetime histograms, health-polled)"),
+    ("ditl_gateway_role_hybrid_ttft_p95_s", "gauge", "", "worst per-replica ttft p95 across hybrid-role replicas (lifetime histograms, health-polled)"),
+    ("ditl_gateway_role_prefill_heavy_routed_total", "counter", "", "requests routed on prefill_heavy-role replicas"),
+    ("ditl_gateway_role_prefill_heavy_spilled_total", "counter", "", "requests spilled on prefill_heavy-role replicas"),
+    ("ditl_gateway_routed_by_class_batch_total", "counter", "", "requests routed carrying SLO class batch"),
+    ("ditl_gateway_routed_by_class_best_effort_total", "counter", "", "requests routed carrying SLO class best_effort"),
+    ("ditl_gateway_routed_by_class_default_total", "counter", "", "requests routed carrying SLO class default"),
+    ("ditl_gateway_routed_by_class_interactive_total", "counter", "", "requests routed carrying SLO class interactive"),
+    ("ditl_gateway_stream_aborts_total", "counter", "", "streams cut mid-flight by a dying replica (not retryable)"),
+    ("ditl_gateway_tenant_<tenant>_admitted_total", "counter", "tenant label", "requests admitted for tenant t0"),
+    ("ditl_gateway_tenant_<tenant>_throttled_total", "counter", "tenant label", "requests throttled for tenant t0"),
+    ("ditl_gateway_tenant_other_admitted_total", "counter", "overflow label", "admissions for tenants beyond the per-family cap", True),
+    ("ditl_gateway_tenant_other_throttled_total", "counter", "overflow label", "throttles for tenants beyond the per-family cap", True),
+    ("ditl_gateway_throttled_total", "counter", "", "requests rejected by tenant admission"),
+    ("ditl_gateway_up", "gauge", "", "1 when the gateway is scraping"),
+    ("ditl_incidents_suppressed_total", "counter", "", "anomaly triggers deduped/cooled down without a bundle"),
+    ("ditl_incidents_total", "counter", "", "incident bundles assembled"),
+    ("ditl_incidents_trigger_<kind>_total", "counter", "anomaly kind", "incident bundles triggered by serving.deadline_storm"),
+    ("ditl_memory_<replica>_device<i>_bytes_in_use", "gauge", "replica id + device index", "replica HBM in use, re-namespaced on the gateway scrape", True),
+    ("ditl_memory_<replica>_device<i>_bytes_limit", "gauge", "replica id + device index", "replica HBM limit, re-namespaced on the gateway scrape", True),
+    ("ditl_memory_<replica>_device<i>_largest_alloc_size", "gauge", "replica id + device index", "replica largest allocation, re-namespaced on the gateway scrape", True),
+    ("ditl_memory_<replica>_device<i>_peak_bytes_in_use", "gauge", "replica id + device index", "replica HBM high-watermark, re-namespaced on the gateway scrape", True),
+    ("ditl_memory_device<i>_bytes_in_use", "gauge", "device index", "device 0 allocator bytes_in_use (absent on statless backends)", True),
+    ("ditl_memory_device<i>_bytes_limit", "gauge", "device index", "device 0 allocator bytes_limit (absent on statless backends)", True),
+    ("ditl_memory_device<i>_largest_alloc_size", "gauge", "device index", "device 0 allocator largest_alloc_size (absent on statless backends)", True),
+    ("ditl_memory_device<i>_peak_bytes_in_use", "gauge", "device index", "device 0 allocator peak_bytes_in_use (absent on statless backends)", True),
+    ("ditl_serving_adapters", "gauge", "", "LoRA adapters resident (multi-LoRA serving)", True),
+    ("ditl_serving_admission_degrade_windows_total", "counter", "", "tick windows that engaged the anti-thrash admission degrade"),
+    ("ditl_serving_admission_degraded", "gauge", "", "1 while the optimistic-admission anti-thrash degrade is engaged"),
+    ("ditl_serving_admission_degrades", "gauge", "", "lifetime anti-thrash degrade windows (stats mirror)"),
+    ("ditl_serving_client_disconnects_total", "counter", "", "in-flight generations cancelled because the client vanished mid-stream"),
+    ("ditl_serving_deadline_expired_total", "counter", "", "requests evicted from the queue/slots at their deadline (expired work stops consuming engine ticks)"),
+    ("ditl_serving_decode_chunk", "gauge", "", "decode tokens per scheduler tick"),
+    ("ditl_serving_decode_token_seconds", "histogram", "", "per-token decode latency (harvest interval / chunk tokens)"),
+    ("ditl_serving_draining", "gauge", "", "1 while the server is draining (SIGTERM / rolling restart)"),
+    ("ditl_serving_grammar_masked_tokens_total", "counter", "", "generated tokens decoded under an FSM grammar mask"),
+    ("ditl_serving_guided_fsm_capacity", "gauge", "", "grammar FSM table rows available"),
+    ("ditl_serving_guided_fsm_rows_used", "gauge", "", "grammar FSM table rows in use"),
+    ("ditl_serving_guided_grammars_registered", "gauge", "", "distinct grammars registered"),
+    ("ditl_serving_inflight", "gauge", "", "HTTP requests currently in flight"),
+    ("ditl_serving_interference_max_by_class_batch", "gauge", "", "worst interference stall absorbed by a batch victim (s)", True),
+    ("ditl_serving_interference_max_by_class_best_effort", "gauge", "", "worst interference stall absorbed by a best_effort victim (s)", True),
+    ("ditl_serving_interference_max_by_class_interactive", "gauge", "", "worst interference stall absorbed by an interactive victim (s)", True),
+    ("ditl_serving_interference_max_s", "gauge", "", "largest single prefill-interference stall observed (s)"),
+    ("ditl_serving_lockstep_speculative", "gauge", "", "1 when lock-step speculative serving is armed"),
+    ("ditl_serving_lockstep_speculative_acceptance", "gauge", "", "lock-step speculative acceptance EMA"),
+    ("ditl_serving_max_context", "gauge", "", "per-slot KV context cap (tokens)"),
+    ("ditl_serving_max_tick_prefill_tokens", "gauge", "", "largest prefill token spend any single tick made"),
+    ("ditl_serving_n_slots", "gauge", "", "decode slots"),
+    ("ditl_serving_page_size", "gauge", "", "KV page size (tokens)"),
+    ("ditl_serving_pages_cached_evictable", "gauge", "", "published prefix pages reclaimable by LRU"),
+    ("ditl_serving_pages_free", "gauge", "", "free KV pages"),
+    ("ditl_serving_pages_total", "gauge", "", "KV pages in the pool (sentinel excluded)"),
+    ("ditl_serving_pod", "gauge", "", "1 on a pod-serving coordinator (tick-broadcast driver)", True),
+    ("ditl_serving_preemptions_total", "counter", "", "optimistic-admission preemptions (pages reclaimed mid-flight)"),
+    ("ditl_serving_prefix_cache_evictions_total", "counter", "", "published prefix pages reclaimed by LRU eviction under pool pressure"),
+    ("ditl_serving_prefix_cache_hit_ratio", "gauge", "", "measured hit tokens / (hit + miss) tokens — the number the gateway affinity router's score is validated against"),
+    ("ditl_serving_prefix_cache_hit_tokens_total", "counter", "", "prompt tokens whose KV was reused from the prefix cache at slot admission (paged content-hash match or registered prefix)"),
+    ("ditl_serving_prefix_cache_miss_tokens_total", "counter", "", "prompt tokens the engine prefilled because no cached KV covered them"),
+    ("ditl_serving_queue_by_class_batch", "gauge", "", "queued batch-class requests"),
+    ("ditl_serving_queue_by_class_best_effort", "gauge", "", "queued best_effort-class requests"),
+    ("ditl_serving_queue_by_class_interactive", "gauge", "", "queued interactive-class requests"),
+    ("ditl_serving_queue_depth", "gauge", "", "requests waiting for a slot"),
+    ("ditl_serving_queue_full_total", "counter", "", "submissions rejected QueueFull (HTTP 429)"),
+    ("ditl_serving_request_e2e_seconds", "histogram", "", "submit -> request finished"),
+    ("ditl_serving_request_queue_wait_seconds", "histogram", "", "submit -> slot admission"),
+    ("ditl_serving_request_ttft_batch_seconds", "histogram", "", "TTFT of batch-class requests"),
+    ("ditl_serving_request_ttft_best_effort_seconds", "histogram", "", "TTFT of best_effort-class requests"),
+    ("ditl_serving_request_ttft_cache_hit_seconds", "histogram", "", "TTFT of requests whose prompt hit the prefix cache (>= 1 reused token)"),
+    ("ditl_serving_request_ttft_cache_miss_seconds", "histogram", "", "TTFT of requests whose prompt missed the prefix cache entirely"),
+    ("ditl_serving_request_ttft_interactive_seconds", "histogram", "", "TTFT of interactive-class requests"),
+    ("ditl_serving_request_ttft_seconds", "histogram", "", "submit -> first generated token harvested"),
+    ("ditl_serving_requests_admitted_total", "counter", "", "requests admitted into a slot"),
+    ("ditl_serving_requests_completed_total", "counter", "", "requests finished"),
+    ("ditl_serving_requests_total", "counter", "", "requests accepted by submit"),
+    ("ditl_serving_resume_prefill_tokens", "gauge", "", "tokens re-prefilled resuming preempted requests"),
+    ("ditl_serving_slots_busy", "gauge", "", "occupied slots"),
+    ("ditl_serving_slots_prefilling", "gauge", "", "slots running chunked prefill"),
+    ("ditl_serving_spec_accepted_tokens_total", "counter", "", "speculative drafted tokens accepted by verify forwards"),
+    ("ditl_serving_spec_rejected_tokens_total", "counter", "", "speculative drafted tokens rejected by verify forwards"),
+    ("ditl_serving_speculative_acceptance_ema", "gauge", "", "measured speculative acceptance EMA (absent until measured)", True),
+    ("ditl_serving_speculative_k", "gauge", "", "drafted tokens per speculative round"),
+    ("ditl_serving_speculative_plain_step_ms", "gauge", "", "measured plain decode tick cost (absent until measured)", True),
+    ("ditl_serving_speculative_rounds_per_tick", "gauge", "", "verify rounds per speculative tick"),
+    ("ditl_serving_speculative_spec_round_ms", "gauge", "", "measured speculative round cost (absent until measured)", True),
+    ("ditl_serving_speculative_spec_ticks", "gauge", "", "ticks that ran speculatively"),
+    ("ditl_serving_speculative_threshold", "gauge", "", "predicted-acceptance threshold for speculating"),
+    ("ditl_serving_speculative_ticks", "gauge", "", "ticks counted by the speculation decision path"),
+    ("ditl_serving_staged", "gauge", "", "requests staged for the next pod tick broadcast", True),
+    ("ditl_serving_token_budget", "gauge", "", "per-tick token budget (0 = unbudgeted)"),
+    ("ditl_serving_tokens_generated_total", "counter", "", "tokens generated (all requests)"),
+    ("ditl_serving_tpot_interference_batch_seconds", "histogram", "", "per-tick decode delay absorbed by batch-class victims because the tick also ran another request's prefill"),
+    ("ditl_serving_tpot_interference_best_effort_seconds", "histogram", "", "per-tick decode delay absorbed by best_effort-class victims because the tick also ran another request's prefill"),
+    ("ditl_serving_tpot_interference_interactive_seconds", "histogram", "", "per-tick decode delay absorbed by interactive-class victims because the tick also ran another request's prefill"),
+    ("ditl_serving_tpot_interference_seconds", "histogram", "", "per-tick decode delay a victim request absorbed because the tick also ran another request's prefill chunk(s) — the scheduler-interference signal behind chunked-prefill tuning (ISSUE 6)"),
+    ("ditl_serving_up", "gauge", "", "1 when the replica server is scraping"),
+    ("ditl_slo_availability_alerting", "gauge", "", "1 when every window burns availability's budget faster than 1.0x"),
+    ("ditl_slo_availability_burn_rate_w<window>", "gauge", "window seconds", "availability burn rate over 300s (error rate / error budget)"),
+    ("ditl_slo_e2e_alerting", "gauge", "", "1 when every window burns e2e's budget faster than 1.0x"),
+    ("ditl_slo_e2e_burn_rate_w<window>", "gauge", "window seconds", "e2e burn rate over 300s (error rate / error budget)"),
+    ("ditl_slo_tpot_alerting", "gauge", "", "1 when every window burns tpot's budget faster than 1.0x"),
+    ("ditl_slo_tpot_burn_rate_w<window>", "gauge", "window seconds", "tpot burn rate over 300s (error rate / error budget)"),
+    ("ditl_slo_ttft_alerting", "gauge", "", "1 when every window burns ttft's budget faster than 1.0x"),
+    ("ditl_slo_ttft_burn_rate_w<window>", "gauge", "window seconds", "ttft burn rate over 300s (error rate / error budget)"),
+)
+
+CATALOG: tuple[CatalogEntry, ...] = tuple(
+    CatalogEntry(*row) for row in _ROWS
+)
+
+
+def catalog_families() -> dict[str, CatalogEntry]:
+    return {e.family: e for e in CATALOG}
+
+
+def required_families() -> set[str]:
+    """Families the drift guard requires a live run to actually register
+    (everything not marked optional)."""
+    return {e.family for e in CATALOG if not e.optional}
+
+
+def render_markdown() -> str:
+    """docs/metrics.md, generated whole. Regenerate with
+    ``python -m ditl_tpu.telemetry.catalog --write docs/metrics.md``."""
+    lines = [
+        "# Metrics catalog",
+        "",
+        "<!-- GENERATED by `python -m ditl_tpu.telemetry.catalog --write "
+        "docs/metrics.md` — edit telemetry/catalog.py, not this file. -->",
+        "",
+        "Every `ditl_*` Prometheus family the system exposes, across the "
+        "replica server's `/metrics`, the gateway's `/metrics`, and the "
+        "training leg's instruments. `<placeholders>` mark dynamic label "
+        "segments sanitized into the family name (the registry is "
+        "label-free by design). Families marked *optional* are absent on "
+        "some backends or configurations — absent, never zero-valued "
+        "lies. The drift-guard test "
+        "(tests/test_metrics_catalog.py) pins this table against what a "
+        "live run actually registers, in both directions.",
+        "",
+        "| family | type | dynamic labels | meaning |",
+        "|---|---|---|---|",
+    ]
+    for e in CATALOG:
+        meaning = e.meaning + (" *(optional)*" if e.optional else "")
+        lines.append(
+            f"| `{e.family}` | {e.type} | {e.labels or '—'} | {meaning} |"
+        )
+    lines.append("")
+    lines.append(f"{len(CATALOG)} families "
+                 f"({sum(1 for e in CATALOG if not e.optional)} required, "
+                 f"{sum(1 for e in CATALOG if e.optional)} optional).")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ditl_tpu.telemetry.catalog",
+        description="render / check the generated metrics catalog",
+    )
+    parser.add_argument("--write", default="",
+                        help="write the generated markdown to PATH")
+    parser.add_argument("--check", default="",
+                        help="exit 1 unless PATH matches the generated "
+                        "markdown (the drift guard's doc half)")
+    args = parser.parse_args(argv)
+    body = render_markdown()
+    if args.write:
+        with open(args.write, "w") as f:
+            f.write(body)
+        print(f"wrote {len(CATALOG)} families to {args.write}")
+        return 0
+    if args.check:
+        try:
+            with open(args.check) as f:
+                current = f.read()
+        except OSError as e:
+            print(f"error: cannot read {args.check}: {e}")
+            return 1
+        if current != body:
+            print(f"{args.check} is stale — regenerate with "
+                  "python -m ditl_tpu.telemetry.catalog --write "
+                  f"{args.check}")
+            return 1
+        print(f"{args.check} matches the catalog")
+        return 0
+    print(body, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

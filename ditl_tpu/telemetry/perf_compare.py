@@ -85,6 +85,25 @@ def compare_metrics(
     lines: list[str] = []
     regressions: list[str] = []
     old, new = _flat(old), _flat(new)
+    # Incident gating (ISSUE 10 satellite): bench rows embed the run's
+    # assembled-incident count. NEW incidents on the new side are a
+    # "now fails"-class regression — a perf lever that wins throughput by
+    # provoking anomaly storms (deadline expiries, preemption thrash) must
+    # not pass the gate on its throughput numbers. When BOTH sides had
+    # incidents the comparison is reported, not gated (a known-noisy
+    # config's storms are context, not a new regression).
+    old_inc, new_inc = old.get("incidents"), new.get("incidents")
+    if isinstance(new_inc, (int, float)) and new_inc > 0:
+        if not old_inc:
+            msg = (f"{label}incidents: 0 -> {int(new_inc)} (anomaly "
+                   "bundles on the new side; previously clean)")
+            lines.append(f"  {msg} REGRESSION")
+            regressions.append(msg)
+        else:
+            lines.append(
+                f"  {label}incidents: {int(old_inc)} -> {int(new_inc)} "
+                "(both sides had incidents; reported, not gated)"
+            )
     if new.get("error") and not old.get("error"):
         msg = (f"{label}previously measured, now fails: "
                f"{str(new['error'])[:200]}")

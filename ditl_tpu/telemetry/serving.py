@@ -53,7 +53,39 @@ from ditl_tpu.telemetry.registry import (
 )
 
 __all__ = ["SLO_CLASS_NAMES", "ServingMetrics", "backlog_retry_after",
-           "merged_histogram", "serving_bench_summary", "snapshot_serving"]
+           "flattened_stats_lines", "merged_histogram",
+           "serving_bench_summary", "snapshot_serving"]
+
+
+def flattened_stats_lines(stats: dict, reserved: frozenset | set = frozenset(),
+                          prefix: str = "ditl_serving") -> list[str]:
+    """The /v1/stats snapshot flattened to ``<prefix>_<path>`` gauge lines
+    (slot occupancy, queue depth, page pool, acceptance EMA) — point-in-
+    time state, kept as gauges on purpose. ``reserved`` names registry
+    metrics a flattened gauge must not shadow (e.g. the lifetime
+    "preemptions" count, a real ``_total`` counter — exposing both a ``x``
+    gauge and an ``x_total`` counter for the same fact invites dashboards
+    built on the wrong one). Shared by ``infer/server.py``'s /metrics and
+    the metrics-catalog drift guard (telemetry/catalog.py), so the
+    exposition and the catalog cannot diverge silently."""
+    lines: list[str] = []
+
+    def emit(path: str, obj) -> None:
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                emit(f"{path}_{k}" if path else str(k), v)
+        elif f"{prefix}_{path}" in reserved:
+            return
+        elif isinstance(obj, bool):
+            lines.append(f"# TYPE {prefix}_{path} gauge")
+            lines.append(f"{prefix}_{path} {int(obj)}")
+        elif isinstance(obj, (int, float)) and obj == obj:  # drop NaN
+            lines.append(f"# TYPE {prefix}_{path} gauge")
+            lines.append(f"{prefix}_{path} {obj}")
+        # strings (engine/cache_mode names) have no gauge form; skip
+
+    emit("", stats)
+    return lines
 
 
 def backlog_retry_after(

@@ -79,6 +79,8 @@ class BurnRateMonitor:
         burn_alert: float = 1.0,
         registry=None,
         gauge_prefix: str = "ditl_slo",
+        journal=None,
+        on_alert=None,
     ):
         if not objectives:
             raise ValueError("need at least one objective")
@@ -102,6 +104,17 @@ class BurnRateMonitor:
         # numbers /slo renders (dashboards alert off either).
         self._registry = registry
         self._gauge_prefix = gauge_prefix
+        # Alert-transition hooks (ISSUE 10 satellite): burn alerts used to
+        # exist only in the scrape response — a headless fleet never
+        # recorded them. On the false->true transition of "every window
+        # burning" the monitor journals an ``slo.alert`` event (the pod
+        # timeline carries the burn even with no Prometheus anywhere) and
+        # fires ``on_alert(objective_name, entry)`` — the anomaly plane's
+        # trigger hook. Transitions, not levels: a sustained burn journals
+        # once until it clears and re-fires.
+        self._journal = journal
+        self._on_alert = on_alert
+        self._alerting: dict[str, bool] = {}
 
     def sample(self, now: float | None = None) -> None:
         now = time.time() if now is None else now
@@ -184,6 +197,28 @@ class BurnRateMonitor:
             entry["alerting"] = bool(burns) and all(
                 b is not None and b > self.burn_alert for b in burns
             )
+            # Atomic check-and-set under the monitor lock: concurrent
+            # report() callers (a scrape racing the anomaly monitor's
+            # headless cadence) must not BOTH observe the false->true
+            # transition and double-fire the journal/hook.
+            with self._lock:
+                was = self._alerting.get(obj.name, False)
+                self._alerting[obj.name] = entry["alerting"]
+            if entry["alerting"] and not was:
+                if self._journal is not None:
+                    self._journal.event(
+                        "slo.alert", objective=obj.name,
+                        target=obj.target, burn_alert=self.burn_alert,
+                        burn_rates=[
+                            None if b is None else round(b, 4) for b in burns
+                        ],
+                        windows_s=list(self.windows),
+                    )
+                if self._on_alert is not None:
+                    try:
+                        self._on_alert(obj.name, entry)
+                    except Exception:  # noqa: BLE001 - a broken hook must
+                        pass  # not break the scrape that evaluated it
             if self._registry is not None:
                 self._registry.gauge(
                     f"{self._gauge_prefix}_{obj.name}_alerting",
@@ -226,6 +261,8 @@ def serving_slo(
     availability_target: float = 0.999,
     windows: tuple[float, ...] = (300.0, 3600.0),
     burn_alert: float = 1.0,
+    journal=None,
+    on_alert=None,
 ) -> BurnRateMonitor:
     """The replica server's SLO set over its ``ServingMetrics`` bundle:
     TTFT and TPOT latency objectives (the engine's harvest-observed
@@ -259,6 +296,8 @@ def serving_slo(
         windows=windows,
         burn_alert=burn_alert,
         registry=metrics.registry,
+        journal=journal,
+        on_alert=on_alert,
     )
 
 
@@ -270,6 +309,8 @@ def gateway_slo(
     availability_target: float = 0.999,
     windows: tuple[float, ...] = (300.0, 3600.0),
     burn_alert: float = 1.0,
+    journal=None,
+    on_alert=None,
 ) -> BurnRateMonitor:
     """The gateway's fleet-level SLO set: end-to-end relay latency plus
     availability (relayed-to-completion vs fleet-owed failures: saturation
@@ -300,4 +341,6 @@ def gateway_slo(
         windows=windows,
         burn_alert=burn_alert,
         registry=gw_metrics.registry,
+        journal=journal,
+        on_alert=on_alert,
     )

@@ -40,6 +40,7 @@ class MetricsLogger:
         n_chips: int | None = None,
         metrics_file: str = "",
         anatomy=None,
+        on_host_metrics=None,
     ):
         """``metrics_file``: optional coordinator-only JSONL scalar stream
         (one object per STEP WINDOW — every pending entry is written at each
@@ -52,10 +53,20 @@ class MetricsLogger:
         same phase clocks this logger already keeps (ISSUE 7): ``data_wait``
         and ``host_dispatch`` at each end_step, ``device_compute`` at each
         flush sync — the trainer adds the matching wall spans and the
-        checkpoint bucket."""
+        checkpoint bucket.
+
+        ``on_host_metrics``: optional ``(step, host_dict, step_time_s)``
+        callback invoked once per flushed window AFTER the flush's own
+        bookkeeping completes (ISSUE 10) — the one place loss/grad_norm
+        are already host floats, so the anomaly plane's training
+        detectors ride the existing log_every sync and add ZERO blocking
+        transfers (tier-1-pinned). A callback exception (the non-finite
+        crash) propagates only after the pending queue is cleared, so the
+        close() flush never re-syncs."""
         import jax
 
         self.anatomy = anatomy
+        self.on_host_metrics = on_host_metrics
         self.log_every = max(1, log_every)
         self.n_chips = n_chips if n_chips is not None else jax.device_count()
         self.step_times: list[float] = []
@@ -125,10 +136,12 @@ class MetricsLogger:
         if self.anatomy is not None:
             self.anatomy.add("device_compute", sync_s)
         last_i = len(self._pending) - 1
+        flushed: list[tuple[int, dict, float]] = []
         for i, (step, _, n_steps, dt, data_wait_s) in enumerate(self._pending):
             host = {k: float(v) for k, v in host_all[i].items()}
             if dt is None:
                 continue
+            flushed.append((step, host, dt))
             tps_chip = host.get("n_tokens", 0.0) / (dt * n_steps) / self.n_chips
             self.tokens_per_sec_chip.append(tps_chip)
             if i == last_i and is_coordinator():
@@ -156,6 +169,12 @@ class MetricsLogger:
                     row["sync_s"] = round(sync_s, 6)
                 self._metrics_fh.write(json.dumps(row, sort_keys=True) + "\n")
         self._pending.clear()
+        if self.on_host_metrics is not None:
+            # After clear(): a callback that raises (the non-finite-loss
+            # crash, ISSUE 10) must not leave pending rows for close() to
+            # re-flush — that would add a second blocking transfer.
+            for step, host, dt in flushed:
+                self.on_host_metrics(step, host, dt)
 
     def close(self) -> None:
         self.flush()

@@ -165,6 +165,15 @@ def _build_step_fn(
         grad_norm = optax_global_norm(grads)
         new_state = TrainState(step=state.step + 1, params=new_params, opt_state=new_opt)
         metrics = {"loss": loss, "n_tokens": tokens, "grad_norm": grad_norm}
+        if train_cfg.fault_nan_step > 0:
+            # Anomaly-plane drill (ISSUE 10): a real device NaN in the
+            # REPORTED loss at exactly this step — it rides the compiled
+            # metrics to the host flush like a genuine divergence would,
+            # without perturbing gradients or parameters.
+            metrics["loss"] = jnp.where(
+                new_state.step == train_cfg.fault_nan_step,
+                jnp.nan, metrics["loss"],
+            )
         return new_state, metrics
 
     return step

@@ -37,15 +37,22 @@ from ditl_tpu.runtime.distributed import (
 from ditl_tpu.runtime.elastic import emit_heartbeat
 from ditl_tpu.runtime.mesh import build_mesh
 from ditl_tpu.telemetry import (
+    STEP_RING,
+    Anomaly,
+    AnomalyPlane,
     EventJournal,
+    FlightRecorder,
     GoodputTracker,
+    IncidentManager,
     MemoryWatcher,
     StepAnatomy,
     Tracer,
+    TrainingDetector,
     lost_work_from_journal,
     read_journal,
     worker_journal_path,
 )
+from ditl_tpu.telemetry.anomaly import NonFiniteMetricError
 from ditl_tpu.train.checkpoint import CheckpointManager, DataIterState
 from ditl_tpu.train.metrics import MetricsLogger
 from ditl_tpu.train.state import TrainState, create_train_state, state_logical_axes
@@ -364,9 +371,77 @@ def train(config: Config) -> dict[str, Any]:
     memwatch = MemoryWatcher(
         journal=journal, topk=config.telemetry.memory_topk,
     )
+    # Flight recorder + anomaly plane (ISSUE 10): the per-step ring and the
+    # non-finite/spike/explosion detectors ride the EXISTING log_every host
+    # flush (train/metrics.py on_host_metrics) — always on, zero device
+    # syncs beyond the flush the metrics path already pays (tier-1-pinned).
+    # Incident bundles are assembled only when telemetry.incident_dir is
+    # set; a fatal detection (non-finite loss/grad) dumps its bundle and
+    # THEN crashes the run, so the evidence precedes the stack trace.
+    flight = FlightRecorder(config.telemetry.flight_ring_size)
+    incidents: IncidentManager | None = None
+    if config.telemetry.incident_dir:
+        import os as _os
+
+        incidents = IncidentManager(
+            # Per-worker subdirectory: SPMD replicates the loss, so a NaN
+            # fires the fatal detector in EVERY worker at once — each
+            # writes (and GCs, and sweeps tmp dirs) in its own directory
+            # rather than racing peers in a shared one.
+            _os.path.join(config.telemetry.incident_dir,
+                          f"worker-{jax.process_index()}"),
+            flight=flight,
+            metrics_render=memwatch.registry.render,
+            journal_dir=config.train.telemetry_dir,
+            registry=memwatch.registry,
+            config_snapshot=config.to_dict(),
+            memwatch_dump=memwatch.report,
+            source=f"worker-{jax.process_index()}",
+            **config.telemetry.incident_kwargs(),
+        )
+    anomaly_plane = AnomalyPlane(incidents=incidents, journal=journal)
+    train_detector = TrainingDetector(
+        **config.telemetry.training_detector_kwargs()
+    )
+    _fatal: list[Anomaly] = []
+    _in_teardown = [False]
+
+    def _fatal_error() -> NonFiniteMetricError:
+        return NonFiniteMetricError(
+            f"non-finite training metric at step "
+            f"{_fatal[0].detail.get('step', '?')}: "
+            f"{_fatal[0].kind} {_fatal[0].detail}"
+        )
+
+    def _on_host_metrics(step: int, host: dict, dt: float) -> None:
+        flight.ring(STEP_RING).record(
+            step=step,
+            loss=host.get("loss"),
+            grad_norm=host.get("grad_norm"),
+            n_tokens=host.get("n_tokens"),
+            step_time_s=round(dt, 6),
+        )
+        for anomaly in train_detector.observe_step(
+            step, host.get("loss"), host.get("grad_norm")
+        ):
+            anomaly_plane.trigger(anomaly)
+            if anomaly.severity == "fatal":
+                _fatal.append(anomaly)
+        if _fatal and not _in_teardown[0]:
+            # Bundle already assembled above; now crash the run the way a
+            # real divergence would have a few steps later — loudly, with
+            # the black box on disk. NOT raised during teardown: the
+            # catch-up flush inside metrics.close() runs in the finally
+            # block, where raising would skip the rest of teardown (and
+            # the end-of-training barrier) and mask any original
+            # exception — a tail-window detection raises AFTER teardown
+            # instead (below).
+            raise _fatal_error()
+
     metrics = MetricsLogger(
         log_every=config.train.log_every,
         metrics_file=config.train.metrics_file,
+        on_host_metrics=_on_host_metrics,
     )
     profiler = StepProfiler(
         config.train.profile_dir,
@@ -600,8 +675,17 @@ def train(config: Config) -> dict[str, Any]:
             with _ctx.suppress(Exception):
                 memwatch.sample()
                 memwatch.oom_dump(e)
+            # OOM is an anomaly-plane trigger source (ISSUE 10): the bundle
+            # freezes the step ring + the memwatch top-k alongside the
+            # journaled oom_dump, before the teardown releases buffers.
+            anomaly_plane.trigger(Anomaly(
+                "train.oom", severity="fatal",
+                detail={"step": global_step,
+                        "error": f"{type(e).__name__}: {str(e)[:500]}"},
+            ))
         raise
     finally:
+        _in_teardown[0] = True  # tail-window flushes detect but never raise
         metrics.close()
         with tracker.span("profiler"):
             profiler.close()
@@ -611,6 +695,12 @@ def train(config: Config) -> dict[str, Any]:
             journal.event("worker.exit", step=global_step)
             journal.close()
         barrier("end-of-training")
+
+    # A fatal detection surfaced only by the teardown's catch-up flush
+    # (NaN in the final, un-flushed window): teardown completed cleanly
+    # above — crash NOW, with the bundle already on disk.
+    if _fatal:
+        raise _fatal_error()
 
     summary = metrics.summary()
     summary["final_loss"] = (
@@ -630,6 +720,12 @@ def train(config: Config) -> dict[str, Any]:
     # data-wait / host-dispatch / device-compute / checkpoint-overlap,
     # conservation-checked against the measured step-path wall to 5%.
     summary["step_anatomy"] = anatomy.report()
+    # Anomaly-plane accounting (ISSUE 10): what fired and how many bundles
+    # were assembled — a completed-but-noisy run is visible in its summary.
+    if anomaly_plane.detected:
+        summary["anomalies"] = dict(sorted(anomaly_plane.detected.items()))
+    if incidents is not None:
+        summary["incidents"] = incidents.created
     mem = memwatch.report()
     if mem:
         summary["memory"] = mem

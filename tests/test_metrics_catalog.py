@@ -1,0 +1,184 @@
+"""Metrics-catalog drift guard (ISSUE 10 satellite).
+
+130+ ``ditl_*`` families used to live only in code. telemetry/catalog.py
+is now the source of truth and docs/metrics.md is generated from it; this
+module pins both halves:
+
+- every family a LIVE surface registers (serving bundle + SLO gauges, a
+  real continuous engine's flattened stats, gateway metrics with the
+  dynamic per-replica/class/role/tenant counters exercised, memwatch on a
+  stats-bearing device, incident counters) normalizes onto a catalog row
+  — a new instrument without a catalog entry fails here;
+- every REQUIRED catalog row is registered by those surfaces — a catalog
+  row whose instrument was deleted (or a drill gap that stopped
+  exercising it) fails here too;
+- docs/metrics.md matches the generated markdown byte-for-byte, so the
+  doc cannot rot.
+"""
+
+from __future__ import annotations
+
+import os
+import types
+
+import pytest
+
+from ditl_tpu.telemetry.catalog import (
+    catalog_families,
+    normalize_family,
+    render_markdown,
+    required_families,
+)
+
+pytestmark = [pytest.mark.telemetry, pytest.mark.incident]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _families(body: str) -> set[str]:
+    out = set()
+    for line in body.splitlines():
+        if line.startswith("# TYPE "):
+            out.add(line.split()[2])
+    return out
+
+
+def _collect_live() -> set[str]:
+    live: set[str] = set()
+
+    # -- serving bundle + serving-side SLO gauges ------------------------
+    from ditl_tpu.telemetry.serving import ServingMetrics, flattened_stats_lines
+    from ditl_tpu.telemetry.slo import gateway_slo, serving_slo
+
+    m = ServingMetrics()
+    serving_slo(m).report()
+    live |= _families(m.render())
+
+    # -- a real continuous engine's flattened /v1/stats gauges -----------
+    # Paged + optimistic + speculative + guided + budgeted: the maximal
+    # stats surface. Construction only — no tick runs, no compile.
+    import jax
+
+    from ditl_tpu.config import ModelConfig
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.infer.continuous import ContinuousEngine
+    from ditl_tpu.models import llama
+
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_seq_len=128, dtype="float32", param_dtype="float32",
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    eng = ContinuousEngine(
+        params, cfg, ByteTokenizer(), n_slots=2, decode_chunk=8,
+        cache_mode="paged", page_size=16, admission="optimistic",
+        prefill_chunk=16, token_budget=64, speculative=True,
+        fsm_capacity=4, logprobs_k=2,
+    )
+    reserved = set(m.registry._metrics)
+    live |= _families("\n".join(flattened_stats_lines(eng.stats(), reserved)))
+    # Lock-step/pod-only stats keys the handler flattens the same way.
+    live |= _families("\n".join(flattened_stats_lines(
+        {"lockstep_speculative": True, "lockstep_speculative_acceptance": 0.5,
+         "inflight": 0, "draining": False, "pod": True, "staged": 0},
+        reserved,
+    )))
+    # Literal handler appends (infer/server.py _metrics, gateway /metrics).
+    live |= {"ditl_serving_up", "ditl_gateway_up"}
+
+    # -- gateway metrics with dynamic families exercised -----------------
+    from ditl_tpu.gateway.gateway import GatewayMetrics
+
+    g = GatewayMetrics()
+    gateway_slo(g).report()
+    for kind in ("routed", "retried"):
+        g.replica_counter("r0", kind)
+    for kind in ("routed", "relayed", "429"):
+        for cls in ("interactive", "batch", "best_effort", None):
+            g.class_counter(kind, cls)
+    for role in ("hybrid", "prefill_heavy", "decode_heavy"):
+        for kind in ("routed", "spilled"):
+            g.role_counter(role, kind)
+    for kind in ("admitted", "throttled"):
+        g.tenant_counter("t0", kind)
+    view = types.SimpleNamespace(
+        id="r0", role="hybrid", live=True,
+        cache_hit_ratio=0.5, cache_hit_tokens=10, cache_miss_tokens=10,
+        recent_cache_hit_ratio=0.5, recent_cache_hit_tokens=5,
+        recent_cache_miss_tokens=5, slot_pressure=0.5,
+        ttft_p95_s=0.1, tpot_p95_s=0.01,
+    )
+    g._set_cache_gauges([view])
+    g._set_role_gauges([view])
+    live |= _families(g.registry.render())
+
+    # -- memwatch on a stats-bearing (fake) device -----------------------
+    from ditl_tpu.telemetry.memwatch import MemoryWatcher
+
+    class _FakeDevice:
+        def memory_stats(self):
+            return {"bytes_in_use": 10, "peak_bytes_in_use": 20,
+                    "bytes_limit": 100, "largest_alloc_size": 5}
+
+    w = MemoryWatcher()
+    w.sample([_FakeDevice()])
+    live |= _families(w.registry.render())
+
+    return live
+
+
+def test_live_families_are_catalogued_both_ways(tmp_path):
+    from ditl_tpu.telemetry.anomaly import Anomaly
+    from ditl_tpu.telemetry.incident import IncidentManager
+    from ditl_tpu.telemetry.registry import MetricsRegistry
+
+    live = _collect_live()
+    # Incident counters: one bundle + one suppressed trigger registers all
+    # three families (total, suppressed, per-trigger).
+    registry = MetricsRegistry()
+    manager = IncidentManager(str(tmp_path / "incidents"), registry=registry,
+                              cooldown_s=3600.0)
+    assert manager.trigger(Anomaly("serving.deadline_storm")) is not None
+    assert manager.trigger(Anomaly("serving.deadline_storm")) is None
+    live |= _families(registry.render())
+
+    catalog = set(catalog_families())
+    normalized = {normalize_family(name) for name in live}
+    extra = sorted(normalized - catalog)
+    assert not extra, (
+        "families registered by a live run but missing from "
+        f"telemetry/catalog.py: {extra}"
+    )
+    missing = sorted(required_families() - normalized)
+    assert not missing, (
+        "catalog rows no live surface registers (instrument deleted, or "
+        f"this drill stopped exercising it): {missing}"
+    )
+
+
+def test_docs_metrics_md_is_generated_from_catalog():
+    path = os.path.join(REPO_ROOT, "docs", "metrics.md")
+    with open(path) as f:
+        current = f.read()
+    assert current == render_markdown(), (
+        "docs/metrics.md is stale — regenerate with "
+        "python -m ditl_tpu.telemetry.catalog --write docs/metrics.md"
+    )
+
+
+def test_normalize_family_patterns():
+    assert normalize_family("ditl_gateway_replica_r17_routed_total") == \
+        "ditl_gateway_replica_<id>_routed_total"
+    assert normalize_family("ditl_gateway_replica_deaths_total") == \
+        "ditl_gateway_replica_deaths_total"  # not a per-replica family
+    assert normalize_family("ditl_memory_device3_bytes_in_use") == \
+        "ditl_memory_device<i>_bytes_in_use"
+    assert normalize_family("ditl_memory_r2_device0_bytes_limit") == \
+        "ditl_memory_<replica>_device<i>_bytes_limit"
+    assert normalize_family("ditl_incidents_trigger_slo_burn_alert_total") \
+        == "ditl_incidents_trigger_<kind>_total"
+    assert normalize_family("ditl_slo_ttft_burn_rate_w300") == \
+        "ditl_slo_ttft_burn_rate_w<window>"
+    assert normalize_family("ditl_serving_queue_depth") == \
+        "ditl_serving_queue_depth"  # identity for static names

@@ -360,6 +360,10 @@ def test_observability_packages_are_jax_free_on_import():
         "import ditl_tpu.telemetry.tracing\n"
         "import ditl_tpu.telemetry.trace_export\n"
         "import ditl_tpu.telemetry.slo\n"
+        "import ditl_tpu.telemetry.flight\n"
+        "import ditl_tpu.telemetry.anomaly\n"
+        "import ditl_tpu.telemetry.incident\n"
+        "import ditl_tpu.telemetry.catalog\n"
         "import ditl_tpu.gateway\n"
         "import ditl_tpu.gateway.gateway\n"
         "import ditl_tpu.gateway.replica\n"
