@@ -26,6 +26,7 @@ Generator, optionally ``--quantize int8``) — same one-JSON-line contract.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
@@ -69,14 +70,39 @@ def _incident_result(since: int = 0) -> dict:
     return {"incidents": max(0, incidents_total() - since)}
 
 
+_ANALYSIS_CLEAN: bool | None = None
+
+
+def _analysis_clean() -> bool:
+    """True when the invariant lint (`python -m ditl_tpu.analysis`,
+    ISSUE 11) passes over the installed package. Computed once per
+    process — the tree does not change mid-bench — and stamped on every
+    row so `perf_compare` treats a newly-dirty tree as a "now fails"
+    regression, like incidents. An analyzer crash stamps False
+    (conservative: a gate that cannot run must not read as clean)."""
+    global _ANALYSIS_CLEAN
+    if _ANALYSIS_CLEAN is None:
+        try:
+            import ditl_tpu
+            from ditl_tpu.analysis import run as _run_lint
+
+            pkg_dir = os.path.dirname(os.path.abspath(ditl_tpu.__file__))
+            _ANALYSIS_CLEAN = not _run_lint(pkg_dir)
+        except Exception:  # noqa: BLE001 - the stamp must never kill a bench
+            _ANALYSIS_CLEAN = False
+    return _ANALYSIS_CLEAN
+
+
 def _record_meta() -> dict:
     """Schema + provenance stamp for every bench JSON row (ISSUE 7
     satellite): records are versioned and name the code revision they were
     measured at, so `perf_compare` can refuse cross-schema diffs and a row
-    pasted into BASELINE.md stays attributable."""
+    pasted into BASELINE.md stays attributable. `analysis_clean` rides
+    along (ISSUE 11) so perf artifacts also certify the invariant lint."""
     from ditl_tpu.telemetry.perf import SWEEP_SCHEMA, git_rev
 
-    return {"schema": SWEEP_SCHEMA, "git_rev": git_rev()}
+    return {"schema": SWEEP_SCHEMA, "git_rev": git_rev(),
+            "analysis_clean": _analysis_clean()}
 
 # bf16 peak TFLOP/s per chip, EXACT device_kind match (lowercased). A
 # substring table silently mis-scaled MFU when device_kind strings

@@ -1,0 +1,65 @@
+"""Invariant lint plane (ISSUE 11): the repo's hard-won runtime rules as
+machine-checked static passes.
+
+Ten PRs of history keep re-finding the same invariant classes the hard way —
+jax leaking into the provably-jax-free zones, stray device syncs on hot
+paths, lock-guarded state touched outside its lock, name registries
+hand-mirrored in three places drifting apart, chaos sites that silently
+never fire, config knobs nobody documented. Each was pinned after the fact
+by a one-off runtime test. This package makes them *compile-time*
+properties of the tree instead: a self-contained, stdlib-only (ast +
+module-graph) analyzer with a rule registry, file/line diagnostics, a
+pragma escape hatch with a mandatory reason, and a CLI that exits non-zero
+on any violation — the way production stacks wire sanitizers and custom
+lints into CI rather than re-deriving discipline per change.
+
+The analyzer must pass its own rules: nothing here imports jax (the
+import-layering zone covers ``analysis/`` itself), and nothing here spawns
+threads. Rule modules may lazily import other *jax-free* ditl_tpu modules
+(e.g. ``telemetry.catalog``) when a rule checks against a registry that
+already has one canonical home — re-declaring the registry here would be
+exactly the mirror drift the rules exist to kill.
+
+Usage::
+
+    python -m ditl_tpu.analysis              # whole tree, exit 1 on violation
+    python -m ditl_tpu.analysis --rule lock-discipline --json
+    from ditl_tpu.analysis import run        # library entry (tests, bench)
+
+Suppressing a finding (reason MANDATORY — a bare pragma is itself a
+violation)::
+
+    x = float(host_val)  # ditl: allow(blocking-transfer) -- host float, no sync
+"""
+
+from __future__ import annotations
+
+from ditl_tpu.annotations import hot_path
+from ditl_tpu.analysis.core import (
+    RULES,
+    Diagnostic,
+    Project,
+    Settings,
+    rule,
+    run,
+)
+
+# Importing the rule modules registers their rules with the registry.
+from ditl_tpu.analysis import (  # noqa: E402,F401  (registration side effect)
+    rules_config,
+    rules_hotpath,
+    rules_imports,
+    rules_locks,
+    rules_registry,
+    rules_threads,
+)
+
+__all__ = [
+    "Diagnostic",
+    "Project",
+    "RULES",
+    "Settings",
+    "hot_path",
+    "rule",
+    "run",
+]

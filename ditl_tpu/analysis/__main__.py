@@ -1,0 +1,79 @@
+"""CLI: ``python -m ditl_tpu.analysis [--rule R]... [--json]``.
+
+Exit codes: 0 clean, 1 violations, 2 usage/unknown-rule. Runs jax-free
+(tier-1 pins it): the whole pass is ast over source text plus one lazy
+import of the jax-free metric catalog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ditl_tpu.analysis import RULES, run
+
+ANALYSIS_SCHEMA = 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m ditl_tpu.analysis",
+        description="ditl_tpu invariant lint: static passes over the "
+        "package tree (see docs/design.md 'Static analysis & "
+        "invariant lint').",
+    )
+    parser.add_argument(
+        "--rule", action="append", default=None, metavar="RULE",
+        help="run only this rule (repeatable); default: all",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    parser.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="package directory to analyze (default: the installed "
+        "ditl_tpu package)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rule ids and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}: {RULES[rid].doc}")
+        return 0
+
+    root = args.root or os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    ))
+    try:
+        diags = run(root, rules=args.rule)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.json:
+        print(json.dumps({
+            "schema": ANALYSIS_SCHEMA,
+            "root": root,
+            "rules": sorted(args.rule) if args.rule else sorted(RULES),
+            "clean": not diags,
+            "violations": len(diags),
+            "diagnostics": [d.as_dict() for d in diags],
+        }, indent=2, sort_keys=True))
+    else:
+        for d in diags:
+            print(d.format())
+        n_rules = len(args.rule) if args.rule else len(RULES)
+        if diags:
+            print(f"\n{len(diags)} violation(s) across {n_rules} rule(s)")
+        else:
+            print(f"clean: {n_rules} rule(s), 0 violations")
+    return 1 if diags else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,377 @@
+"""Analyzer framework: source loading, pragma grammar, rule registry,
+runner. Stdlib-only (ast + os + re); nothing here imports jax — the
+analyzer passes its own import-layering rule.
+
+Pragma grammar (reason MANDATORY)::
+
+    # ditl: allow(<rule>[, <rule>...]) -- <reason>
+
+A pragma on the violating line suppresses that line; a pragma on its own
+line suppresses the NEXT line (so long call expressions can carry the
+pragma above them). A pragma with an empty reason, an unknown rule id, or
+one that suppresses nothing is itself reported under the ``pragma`` rule —
+the escape hatch is audited, not free.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+__all__ = [
+    "Diagnostic",
+    "Pragma",
+    "Project",
+    "RULES",
+    "Rule",
+    "Settings",
+    "SourceFile",
+    "rule",
+    "run",
+]
+
+PRAGMA_RE = re.compile(
+    r"#\s*ditl:\s*allow\(\s*([^)]*?)\s*\)\s*(?:--\s*(.*?))?\s*$"
+)
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    """One violation: rule id + file/line + human message. ``path`` is
+    package-relative with the package name prefixed (clickable from the
+    repo root)."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path,
+            "line": self.line, "message": self.message,
+        }
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: tuple[str, ...]
+    reason: str
+    own_line: bool  # comment-only line: also covers the next line
+    used: bool = False
+
+    def covers(self, rule_id: str, line: int) -> bool:
+        if rule_id not in self.rules:
+            return False
+        return line == self.line or (self.own_line and line == self.line + 1)
+
+
+class SourceFile:
+    """One parsed module: AST + raw lines + pragmas + dotted module name."""
+
+    def __init__(self, path: str, rel: str, module: str, display: str):
+        self.path = path
+        self.rel = rel  # package-dir-relative, forward slashes
+        self.module = module  # dotted ("ditl_tpu.infer.continuous")
+        self.display = display  # "ditl_tpu/infer/continuous.py"
+        with open(path, encoding="utf-8") as fh:
+            self.text = fh.read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=path)
+        # Pragmas live in real COMMENT tokens only — a docstring or a
+        # diagnostic message QUOTING the grammar must not register one.
+        self.pragmas: list[Pragma] = []
+        for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = PRAGMA_RE.search(tok.string)
+            if m is None:
+                continue
+            rules = tuple(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+            reason = (m.group(2) or "").strip()
+            line, col = tok.start
+            own = self.lines[line - 1][:col].strip() == ""
+            self.pragmas.append(Pragma(line, rules, reason, own))
+
+    def segment(self, node: ast.AST) -> str:
+        return ast.get_source_segment(self.text, node) or ""
+
+
+@dataclass(frozen=True)
+class Settings:
+    """What the rules check against. Defaults describe the real tree;
+    fixture tests construct their own pointing at miniature packages
+    (tests/fixtures/analysis/) so every rule is exercised against a known
+    violation without planting one in the product code."""
+
+    # -- import-layering ---------------------------------------------------
+    # Sub-package prefixes (package-relative) that must stay jax-free on
+    # import, transitively over module-level imports.
+    jax_free_zones: tuple[str, ...] = (
+        "telemetry", "gateway", "chaos", "client", "analysis",
+    )
+    forbidden_imports: tuple[str, ...] = ("jax", "jaxlib")
+    # -- blocking-transfer -------------------------------------------------
+    hot_path_decorator: str = "hot_path"
+    # -- registry-mirror ---------------------------------------------------
+    # (file, variable): the canonical registry and its hand-written mirrors
+    # (mirrors exist on purpose — the jax-free zones cannot import the
+    # canonical module — so EQUALITY is the checked invariant).
+    slo_canonical: tuple[str, str] = ("infer/continuous.py", "SLO_CLASSES")
+    slo_mirrors: tuple[tuple[str, str], ...] = (
+        ("gateway/admission.py", "SLO_CLASS_NAMES"),
+        ("telemetry/serving.py", "SLO_CLASS_NAMES"),
+    )
+    chaos_registry: tuple[str, str] = ("chaos/plane.py", "SITES")
+    chaos_consult_funcs: tuple[str, ...] = ("maybe_inject",)
+    # -- config-drift ------------------------------------------------------
+    config_module: str = "config.py"  # package-relative
+    docs: tuple[str, ...] = ("docs/design.md",)  # repo-root-relative
+    # -- metric-catalog ----------------------------------------------------
+    metric_methods: tuple[str, ...] = ("counter", "gauge", "histogram")
+    # Dotted module exporting normalize_family()/catalog_families(); ""
+    # disables the rule (fixture projects without a catalog).
+    catalog_module: str = "ditl_tpu.telemetry.catalog"
+
+
+class Project:
+    """All parsed sources under one package directory + the settings the
+    rules read. Built once per run; rules are pure functions of it."""
+
+    def __init__(self, package_dir: str, settings: Settings | None = None):
+        self.package_dir = os.path.abspath(package_dir)
+        self.root = os.path.dirname(self.package_dir)
+        self.package = os.path.basename(self.package_dir)
+        self.settings = settings or Settings()
+        self.files: list[SourceFile] = []
+        for dirpath, dirnames, filenames in os.walk(self.package_dir):
+            dirnames[:] = sorted(
+                d for d in dirnames if d != "__pycache__"
+            )
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, self.package_dir).replace(
+                    os.sep, "/"
+                )
+                self.files.append(
+                    SourceFile(
+                        path, rel, self._module_name(rel),
+                        f"{self.package}/{rel}",
+                    )
+                )
+        self.by_rel = {f.rel: f for f in self.files}
+        self.by_module = {f.module: f for f in self.files}
+
+    def _module_name(self, rel: str) -> str:
+        parts = rel[:-3].split("/")  # strip .py
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join([self.package, *parts]) if parts else self.package
+
+    def doc_text(self) -> str:
+        """Concatenated documentation sources (config-drift's 'mentioned
+        in the docs' check). Missing files contribute nothing — the rule
+        then reports every field, which is the right failure mode for a
+        project that deleted its design doc."""
+        chunks = []
+        for rel in self.settings.docs:
+            path = os.path.join(self.root, rel)
+            if os.path.exists(path):
+                with open(path, encoding="utf-8") as fh:
+                    chunks.append(fh.read())
+        return "\n".join(chunks)
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    doc: str
+    fn: object = field(compare=False)
+
+
+RULES: dict[str, Rule] = {}
+
+# The pragma auditor is not a registered pass (it cannot be pragma'd away)
+# but its id participates in diagnostics and --rule filtering.
+PRAGMA_RULE = "pragma"
+
+
+def rule(rule_id: str, doc: str):
+    """Register ``fn(project) -> list[Diagnostic]`` under ``rule_id``."""
+
+    def register(fn):
+        if rule_id in RULES:
+            raise ValueError(f"duplicate rule id {rule_id!r}")
+        RULES[rule_id] = Rule(rule_id, doc, fn)
+        return fn
+
+    return register
+
+
+def _audit_pragmas(project: Project, known: set[str]) -> list[Diagnostic]:
+    out = []
+    for f in project.files:
+        for p in f.pragmas:
+            if not p.rules:
+                out.append(Diagnostic(
+                    PRAGMA_RULE, f.display, p.line,
+                    "pragma names no rule: use "
+                    "`# ditl: allow(<rule>) -- <reason>`",
+                ))
+                continue
+            for rid in p.rules:
+                if rid not in known:
+                    out.append(Diagnostic(
+                        PRAGMA_RULE, f.display, p.line,
+                        f"pragma names unknown rule {rid!r} "
+                        f"(known: {', '.join(sorted(known))})",
+                    ))
+            if not p.reason:
+                out.append(Diagnostic(
+                    PRAGMA_RULE, f.display, p.line,
+                    "pragma without a reason: every suppression must say "
+                    "why (`# ditl: allow(rule) -- <reason>`)",
+                ))
+    return out
+
+
+def run(
+    package_dir: str,
+    rules: list[str] | None = None,
+    settings: Settings | None = None,
+) -> list[Diagnostic]:
+    """Run the selected rules (default: all) over ``package_dir``.
+    Returns pragma-filtered diagnostics sorted by (path, line, rule).
+    Unknown rule ids raise ValueError (exit 2 at the CLI)."""
+    project = Project(package_dir, settings)
+    # dict.fromkeys: a repeated --rule flag must not run the rule twice
+    # (doubled diagnostics and a doubled violation count).
+    selected = sorted(RULES) if rules is None else list(dict.fromkeys(rules))
+    unknown = [r for r in selected if r not in RULES and r != PRAGMA_RULE]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {unknown}; known: {sorted(RULES)}"
+        )
+    diags: list[Diagnostic] = []
+    for rid in selected:
+        if rid == PRAGMA_RULE:
+            continue
+        diags.extend(RULES[rid].fn(project))
+    # Pragma suppression: a reasoned pragma covering (rule, line) eats the
+    # diagnostic. Reason-less pragmas still suppress — the missing reason
+    # is reported separately below, so the tree is never "clean with an
+    # unexplained hole" silently.
+    kept: list[Diagnostic] = []
+    for d in diags:
+        f = _file_for(project, d.path)
+        covered = None
+        if f is not None:
+            for p in f.pragmas:
+                if p.covers(d.rule, d.line):
+                    covered = p
+                    break
+        if covered is None:
+            kept.append(d)
+        else:
+            covered.used = True
+    kept.extend(_audit_pragmas(project, set(RULES) | {PRAGMA_RULE}))
+    # Unused-pragma audit: a pragma that suppressed nothing is stale — it
+    # documents an exception that no longer exists, and its line coverage
+    # would silently eat the NEXT violation introduced there. Only judged
+    # when every rule it names actually ran this invocation (under
+    # --rule filtering a pragma for an unselected rule is merely dormant).
+    ran = set(selected)
+    for f in project.files:
+        for p in f.pragmas:
+            if p.used or not p.reason or not p.rules:
+                continue  # reasonless/empty pragmas are already reported
+            if all(rid in ran for rid in p.rules):
+                kept.append(Diagnostic(
+                    PRAGMA_RULE, f.display, p.line,
+                    f"pragma for {', '.join(p.rules)} suppresses nothing "
+                    "— stale suppressions hide the next real violation "
+                    "on this line; delete it",
+                ))
+    return sorted(kept, key=lambda d: (d.path, d.line, d.rule))
+
+
+def _file_for(project: Project, display: str) -> SourceFile | None:
+    for f in project.files:
+        if f.display == display:
+            return f
+    return None
+
+
+# -- shared AST helpers (used by several rule modules) ----------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Terminal name of the called function: ``jax.device_get(...)`` and
+    ``device_get(...)`` both resolve to ``device_get``."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted spelling of a Name/Attribute chain ('' when the
+    chain bottoms out in something dynamic)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def module_literal(
+    f: SourceFile, name: str
+) -> tuple[tuple, int] | None:
+    """Module-level assignment ``name = <literal>`` as (ordered value
+    tuple, lineno). Dicts contribute their keys (declaration order IS the
+    registry order for rank registries); sets are sorted for a stable
+    comparison. None when absent or not a literal."""
+    for node in f.tree.body:
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target = node.target
+            value = node.value
+        else:
+            continue
+        if not (isinstance(target, ast.Name) and target.id == name):
+            continue
+        # frozenset({...}) / tuple([...]) wrappers: unwrap one call level.
+        if isinstance(value, ast.Call) and len(value.args) == 1:
+            value = value.args[0]
+        try:
+            lit = ast.literal_eval(value)
+        except (ValueError, SyntaxError):
+            return None
+        if isinstance(lit, dict):
+            return tuple(lit.keys()), node.lineno
+        if isinstance(lit, (set, frozenset)):
+            return tuple(sorted(lit)), node.lineno
+        if isinstance(lit, (list, tuple)):
+            return tuple(lit), node.lineno
+        return (lit,), node.lineno
+    return None

@@ -1,0 +1,99 @@
+"""config-drift: every ``*Config`` knob is reachable and documented.
+
+Two drifts this kills:
+
+- **Unreachable sections.** ``parse_overrides`` reaches exactly the
+  dataclass fields of the sections hung off ``Config`` — a new
+  ``FooConfig`` that never becomes a ``Config`` field is dead weight the
+  CLI cannot set (``foo.bar=x`` raises "unknown config section").
+- **Undocumented knobs.** A field that appears in no documentation is a
+  knob operators discover by reading source — ISSUE 11 calls these out
+  as a standing violation class. A field counts as documented when its
+  name appears in docs/design.md (the config reference appendix is the
+  natural home) or when its ``field(metadata={"doc": ...})`` carries the
+  one-liner inline.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ditl_tpu.analysis.core import Diagnostic, Project, rule
+
+
+def _has_doc_metadata(value: ast.AST | None) -> bool:
+    """``field(..., metadata={"doc": "..."} )`` on the default value."""
+    if not isinstance(value, ast.Call):
+        return False
+    fn = value.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else ""
+    )
+    if name != "field":
+        return False
+    for kw in value.keywords:
+        if kw.arg == "metadata" and isinstance(kw.value, ast.Dict):
+            for key in kw.value.keys:
+                if isinstance(key, ast.Constant) and key.value == "doc":
+                    return True
+    return False
+
+
+@rule(
+    "config-drift",
+    "every *Config dataclass must be reachable by the dotted-override "
+    "parser, and every field must be mentioned in the docs or carry "
+    "field metadata doc",
+)
+def check_config_drift(project: Project) -> list[Diagnostic]:
+    s = project.settings
+    f = project.by_rel.get(s.config_module)
+    if f is None:
+        return [Diagnostic(
+            "config-drift", f"{project.package}/{s.config_module}", 1,
+            f"config module {s.config_module!r} not found",
+        )]
+    docs = project.doc_text()
+    out: list[Diagnostic] = []
+    config_classes = [
+        node for node in f.tree.body
+        if isinstance(node, ast.ClassDef) and node.name.endswith("Config")
+    ]
+    root = next(
+        (c for c in config_classes if c.name == "Config"), None
+    )
+    # Section annotations on the root Config: which *Config types the
+    # dotted parser can reach (`section.key=value`).
+    reachable_types: set[str] = set()
+    if root is not None:
+        for item in root.body:
+            if isinstance(item, ast.AnnAssign) and isinstance(
+                item.annotation, ast.Name
+            ):
+                reachable_types.add(item.annotation.id)
+    for cls in config_classes:
+        if cls.name != "Config" and cls.name not in reachable_types:
+            out.append(Diagnostic(
+                "config-drift", f.display, cls.lineno,
+                f"{cls.name} is not a field of Config — no dotted "
+                "override (`section.key=value`) can reach it",
+            ))
+        for item in cls.body:
+            if not (
+                isinstance(item, ast.AnnAssign)
+                and isinstance(item.target, ast.Name)
+            ):
+                continue
+            name = item.target.id
+            if _has_doc_metadata(item.value):
+                continue
+            if re.search(rf"\b{re.escape(name)}\b", docs):
+                continue
+            out.append(Diagnostic(
+                "config-drift", f.display, item.lineno,
+                f"{cls.name}.{name} is not mentioned in "
+                f"{'/'.join(s.docs)} and has no field metadata doc — "
+                "an operator cannot discover this knob",
+            ))
+    return out

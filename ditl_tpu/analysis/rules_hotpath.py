@@ -1,0 +1,136 @@
+"""blocking-transfer: no device syncs inside ``@hot_path`` functions.
+
+The zero-device-sync contract is the repo's most-re-litigated invariant:
+the PR 3 flush fix (one ``float()`` sync per metrics key -> one
+``device_get`` per flush), the PR 10 five-device_get pin (the whole flight
+plane armed adds ZERO blocking transfers), the harvest-batching comment in
+continuous.py ("each separate fetch is a full round trip"). Those pins are
+runtime monkeypatch counters; this rule makes the contract lexical — mark
+the function ``@hot_path`` and every blocking spelling inside it is a
+violation:
+
+- ``jax.device_get(...)`` (any spelling ending in ``device_get``)
+- ``<x>.block_until_ready()`` / ``<x>.item()``
+- ``float(x)`` / ``int(x)`` / ``np.asarray(x)`` where ``x`` is a bare
+  name, attribute, or subscript — the spellings that silently sync when
+  ``x`` is a device array. A cast of a value that is provably host-side
+  (a registry counter, a clock delta held in a local) earns a reasoned
+  pragma; the pragma is the documentation that someone CHECKED.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ditl_tpu.analysis.core import (
+    Diagnostic,
+    Project,
+    SourceFile,
+    call_name,
+    dotted,
+    rule,
+)
+
+_CAST_FUNCS = {"float", "int"}
+_ASARRAY_BASES = {"np", "numpy", "onp"}
+_SYNC_METHODS = {"block_until_ready", "item"}
+
+
+def _is_hot_path(fn: ast.AST, marker: str) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        name = dec.attr if isinstance(dec, ast.Attribute) else (
+            dec.id if isinstance(dec, ast.Name) else ""
+        )
+        if name == marker:
+            return True
+    return False
+
+
+def _variable_like(node: ast.AST) -> bool:
+    """Arguments that could be a device array reference: a name, an
+    attribute chain, or a subscript. Constants and call results of host
+    helpers are not flagged (``int(len(q))``, ``float(time.time())``)."""
+    return isinstance(node, (ast.Name, ast.Attribute, ast.Subscript))
+
+
+def _check_body(
+    f: SourceFile, fn: ast.FunctionDef, qualname: str
+) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name == "device_get":
+            out.append(Diagnostic(
+                "blocking-transfer", f.display, node.lineno,
+                f"jax.device_get inside @hot_path {qualname}: batch the "
+                "fetch outside the hot path (PR 3 flush discipline)",
+            ))
+        elif (
+            name in _SYNC_METHODS
+            and isinstance(node.func, ast.Attribute)
+            and not node.args
+            and not node.keywords
+        ):
+            out.append(Diagnostic(
+                "blocking-transfer", f.display, node.lineno,
+                f".{name}() inside @hot_path {qualname}: blocks until "
+                "the device materializes the value",
+            ))
+        elif (
+            name in _CAST_FUNCS
+            and isinstance(node.func, ast.Name)
+            and len(node.args) == 1
+            and _variable_like(node.args[0])
+        ):
+            arg = dotted(node.args[0]) or "<expr>"
+            out.append(Diagnostic(
+                "blocking-transfer", f.display, node.lineno,
+                f"{name}({arg}) inside @hot_path {qualname}: a device "
+                "array here is a hidden sync; if the value is provably "
+                "host-side, say so with a pragma",
+            ))
+        elif (
+            name == "asarray"
+            and isinstance(node.func, ast.Attribute)
+            and dotted(node.func.value) in _ASARRAY_BASES
+            and node.args
+            and _variable_like(node.args[0])
+        ):
+            arg = dotted(node.args[0]) or "<expr>"
+            out.append(Diagnostic(
+                "blocking-transfer", f.display, node.lineno,
+                f"np.asarray({arg}) inside @hot_path {qualname}: "
+                "device->host copy on the no-sync path",
+            ))
+    return out
+
+
+@rule(
+    "blocking-transfer",
+    "functions marked @hot_path must not contain blocking device-transfer "
+    "spellings (device_get / block_until_ready / item / "
+    "float/int/np.asarray on variables)",
+)
+def check_blocking_transfer(project: Project) -> list[Diagnostic]:
+    marker = project.settings.hot_path_decorator
+    out: list[Diagnostic] = []
+    for f in project.files:
+        # Methods get their class name in the message; everything else is
+        # reported bare.
+        method_ids: set[int] = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    method_ids.add(id(item))
+                    if _is_hot_path(item, marker):
+                        out.extend(_check_body(
+                            f, item, f"{node.name}.{item.name}"
+                        ))
+        for node in ast.walk(f.tree):
+            if _is_hot_path(node, marker) and id(node) not in method_ids:
+                out.extend(_check_body(f, node, node.name))
+    return out

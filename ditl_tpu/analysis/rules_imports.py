@@ -1,0 +1,212 @@
+"""import-layering: the jax-free zones stay jax-free, transitively.
+
+The gateway/telemetry/chaos/client layers (and this analyzer) are jax-free
+on import by design — a gateway is a thin front process, a pragma'd lazy
+import is a deliberate exception, and one stray top-level ``import jax``
+(or an innocent-looking internal import whose TRANSITIVE closure reaches
+jax) silently makes the whole layer un-runnable without an accelerator
+runtime. tests/test_tracing.py pinned this with a subprocess smoke since
+ISSUE 6; this rule proves it over the module-level import graph instead —
+every module, every chain, no interpreter launch — and the smoke stays as
+the belt-and-suspenders check.
+
+Checked per zone module:
+- module-level ``import jax`` / ``from jax import ...`` (direct);
+- module-level internal imports whose transitive module-level closure
+  reaches a forbidden module (the chain is printed);
+- function-level (lazy) forbidden imports — allowed, but only with a
+  reasoned pragma (they are invisible to the import-time smoke, so the
+  exception must be auditable in the source).
+
+``if TYPE_CHECKING:`` blocks are excluded — they never execute.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ditl_tpu.analysis.core import Diagnostic, Project, SourceFile, rule
+
+
+def _is_type_checking(test: ast.AST) -> bool:
+    return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+        isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+    )
+
+
+def _module_level_imports(f: SourceFile):
+    """(node, lineno) for every import executed at module import time:
+    top-level statements, including those under plain if/try at module
+    scope and in class bodies, excluding TYPE_CHECKING guards and
+    function bodies."""
+    out = []
+
+    def walk(stmts):
+        for node in stmts:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                out.append(node)
+            elif isinstance(node, ast.If):
+                if _is_type_checking(node.test):
+                    walk(node.orelse)
+                else:
+                    walk(node.body)
+                    walk(node.orelse)
+            elif isinstance(node, ast.Try):
+                walk(node.body)
+                for h in node.handlers:
+                    walk(h.body)
+                walk(node.orelse)
+                walk(node.finalbody)
+            elif isinstance(node, ast.ClassDef):
+                walk(node.body)
+            elif isinstance(node, (ast.With,)):
+                walk(node.body)
+
+    walk(f.tree.body)
+    return out
+
+
+def _type_checking_imports(f: SourceFile) -> set[int]:
+    """Imports under ``if TYPE_CHECKING:`` anywhere — they never execute,
+    so they are neither module-level nor lazy."""
+    out: set[int] = set()
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.If) and _is_type_checking(node.test):
+            for child in node.body:
+                for sub in ast.walk(child):
+                    if isinstance(sub, (ast.Import, ast.ImportFrom)):
+                        out.add(id(sub))
+    return out
+
+
+def _function_level_imports(f: SourceFile):
+    """Imports NOT in the module-level set (lazy, inside function
+    bodies); TYPE_CHECKING-guarded imports are excluded entirely."""
+    skip = set(map(id, _module_level_imports(f)))
+    skip |= _type_checking_imports(f)
+    return [
+        node
+        for node in ast.walk(f.tree)
+        if isinstance(node, (ast.Import, ast.ImportFrom))
+        and id(node) not in skip
+    ]
+
+
+def _targets(f: SourceFile, node, project: Project) -> list[str]:
+    """Dotted module names one import statement pulls in (absolute)."""
+    if isinstance(node, ast.Import):
+        return [alias.name for alias in node.names]
+    # ImportFrom: resolve relative level against this module's package.
+    base = node.module or ""
+    if node.level:
+        parts = f.module.split(".")
+        if not f.rel.endswith("__init__.py"):
+            parts = parts[:-1]
+        parts = parts[: len(parts) - (node.level - 1)]
+        base = ".".join(parts + ([node.module] if node.module else []))
+    out = [base] if base else []
+    # `from pkg.sub import name` imports pkg.sub.name when it is a module.
+    for alias in node.names:
+        cand = f"{base}.{alias.name}" if base else alias.name
+        if cand in project.by_module:
+            out.append(cand)
+    return out
+
+
+def _build_graph(project: Project):
+    """module -> list[(target, lineno)] over module-level imports, plus
+    implicit parent-package edges (importing a.b.c executes a and a.b)."""
+    graph: dict[str, list[tuple[str, int]]] = {}
+    for f in project.files:
+        edges: list[tuple[str, int]] = []
+        for node in _module_level_imports(f):
+            for target in _targets(f, node, project):
+                edges.append((target, node.lineno))
+        parts = f.module.split(".")
+        for i in range(1, len(parts)):
+            parent = ".".join(parts[:i])
+            if parent in project.by_module:
+                edges.append((parent, 1))
+        graph[f.module] = edges
+    return graph
+
+
+def _forbidden_root(name: str, forbidden: tuple[str, ...]) -> str | None:
+    root = name.split(".")[0]
+    return root if root in forbidden else None
+
+
+def _taint_chains(project: Project, graph) -> dict[str, list[str]]:
+    """module -> shortest chain [module, ..., 'jax'] for every internal
+    module whose module-level closure reaches a forbidden import."""
+    s = project.settings
+    chains: dict[str, list[str]] = {}
+    # Seed: modules with a direct forbidden module-level import.
+    for mod, edges in graph.items():
+        for target, _ in edges:
+            root = _forbidden_root(target, s.forbidden_imports)
+            if root is not None:
+                chains.setdefault(mod, [mod, root])
+    # Propagate backwards over internal edges to a fixpoint (graph is
+    # small; repeated sweeps beat building a reverse index).
+    changed = True
+    while changed:
+        changed = False
+        for mod, edges in graph.items():
+            if mod in chains:
+                continue
+            for target, _ in edges:
+                if target in chains:
+                    chains[mod] = [mod, *chains[target]]
+                    changed = True
+                    break
+    return chains
+
+
+@rule(
+    "import-layering",
+    "jax-free zones (telemetry/gateway/chaos/client/analysis) must not "
+    "reach jax/jaxlib through module-level imports, transitively; lazy "
+    "in-function imports need a reasoned pragma",
+)
+def check_import_layering(project: Project) -> list[Diagnostic]:
+    s = project.settings
+    zones = tuple(
+        f"{project.package}.{z}" for z in s.jax_free_zones
+    )
+    graph = _build_graph(project)
+    chains = _taint_chains(project, graph)
+    out: list[Diagnostic] = []
+    for f in project.files:
+        in_zone = any(
+            f.module == z or f.module.startswith(z + ".") for z in zones
+        )
+        if not in_zone:
+            continue
+        for node in _module_level_imports(f):
+            for target in _targets(f, node, project):
+                root = _forbidden_root(target, s.forbidden_imports)
+                if root is not None:
+                    out.append(Diagnostic(
+                        "import-layering", f.display, node.lineno,
+                        f"module-level import of {root!r} in jax-free "
+                        f"zone module {f.module}",
+                    ))
+                elif target in chains and target != f.module:
+                    chain = " -> ".join(chains[target])
+                    out.append(Diagnostic(
+                        "import-layering", f.display, node.lineno,
+                        f"import of {target!r} pulls a forbidden module "
+                        f"into jax-free zone {f.module}: {chain}",
+                    ))
+        for node in _function_level_imports(f):
+            for target in _targets(f, node, project):
+                root = _forbidden_root(target, s.forbidden_imports)
+                if root is not None:
+                    out.append(Diagnostic(
+                        "import-layering", f.display, node.lineno,
+                        f"lazy {root!r} import inside jax-free zone "
+                        f"module {f.module}: allowed only with "
+                        "`# ditl: allow(import-layering) -- <reason>`",
+                    ))
+    return out

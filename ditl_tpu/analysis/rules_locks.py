@@ -1,0 +1,166 @@
+"""lock-discipline: ``# guarded-by: <lock>`` attributes stay under their
+lock.
+
+The two lock races that reached review (the SLO alert double-fire, the
+Retry-After deque snapshotted against concurrent appends) were both the
+same shape: state with an owning lock touched on one path that forgot the
+``with``. The fix each time was a code change plus a prose comment; this
+rule turns the prose into a checked contract. Annotate the attribute's
+defining assignment::
+
+    self._samples = collections.deque()  # guarded-by: _lock
+
+and every other read/write of ``self._samples`` in that class must sit
+lexically inside ``with self._lock:`` (or ``with self._lock as ...:``,
+or alongside other context managers in one ``with``). Exemptions:
+
+- the defining method itself (construction happens before any thread can
+  see the object);
+- methods named ``*_locked`` — the existing convention for "caller holds
+  the lock" (the suffix already tells a human; now it tells the
+  analyzer);
+- a reasoned pragma, for deliberate unguarded touches (benign racy
+  fast-path reads a la double-checked locking).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ditl_tpu.analysis.core import Diagnostic, Project, SourceFile, rule
+
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _guarded_attrs(f: SourceFile, cls: ast.ClassDef):
+    """{attr: (lock, defining_function_id)} from trailing ``# guarded-by``
+    comments on ``self.X = ...`` (in methods) and on class-body
+    annotations (handler-style classes that declare attributes at class
+    scope)."""
+    guarded: dict[str, tuple[str, int | None]] = {}
+
+    def note(attr: str, lineno: int, fn_id: int | None):
+        if lineno <= len(f.lines):
+            m = GUARDED_RE.search(f.lines[lineno - 1])
+            if m:
+                guarded[attr] = (m.group(1), fn_id)
+
+    for item in cls.body:
+        if isinstance(item, ast.AnnAssign):
+            if isinstance(item.target, ast.Name):
+                note(item.target.id, item.lineno, None)
+        elif isinstance(item, ast.Assign):
+            for t in item.targets:
+                if isinstance(t, ast.Name):
+                    note(t.id, item.lineno, None)
+        elif isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for node in ast.walk(item):
+                attr = None
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        attr = _self_attr(t) or attr
+                elif isinstance(node, ast.AnnAssign):
+                    attr = _self_attr(node.target)
+                elif isinstance(node, ast.AugAssign):
+                    attr = _self_attr(node.target)
+                if attr is not None:
+                    note(attr, node.lineno, id(item))
+    return guarded
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock attribute names this ``with`` acquires (``with self._lock:``,
+    possibly among other items)."""
+    out = set()
+    for item in node.items:
+        attr = _self_attr(item.context_expr)
+        if attr is not None:
+            out.add(attr)
+        # with self._lock.acquire_timeout(...) style: take the base attr.
+        elif isinstance(item.context_expr, ast.Call):
+            base = item.context_expr.func
+            if isinstance(base, ast.Attribute):
+                attr = _self_attr(base.value)
+                if attr is not None:
+                    out.add(attr)
+    return out
+
+
+def _check_method(
+    f: SourceFile,
+    cls: ast.ClassDef,
+    fn: ast.FunctionDef,
+    guarded: dict[str, tuple[str, int | None]],
+) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+
+    def visit(node: ast.AST, held: frozenset[str]):
+        if isinstance(node, ast.With):
+            inner = held | _with_locks(node)
+            for item in node.items:
+                visit(item.context_expr, held)
+            for child in node.body:
+                visit(child, inner)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in guarded:
+            lock, _ = guarded[attr]
+            if lock not in held:
+                out.append(Diagnostic(
+                    "lock-discipline", f.display, node.lineno,
+                    f"{cls.name}.{attr} is guarded-by {lock} but touched "
+                    f"outside `with self.{lock}` (in {fn.name}); hold the "
+                    "lock, rename the method *_locked if the caller "
+                    "holds it, or pragma a deliberate racy read",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in fn.body:
+        visit(stmt, frozenset())
+    return out
+
+
+@rule(
+    "lock-discipline",
+    "attributes annotated `# guarded-by: <lock>` may only be accessed "
+    "inside `with self.<lock>` in their class (methods named *_locked "
+    "are caller-holds-lock by convention)",
+)
+def check_lock_discipline(project: Project) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    for f in project.files:
+        for cls in ast.walk(f.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_attrs(f, cls)
+            if not guarded:
+                continue
+            for fn in cls.body:
+                if not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if fn.name.endswith("_locked"):
+                    continue
+                # The defining method (construction) is exempt for the
+                # attributes it defines; other guarded attrs still apply.
+                scoped = {
+                    attr: spec
+                    for attr, spec in guarded.items()
+                    if spec[1] != id(fn)
+                }
+                if scoped:
+                    out.extend(_check_method(f, cls, fn, scoped))
+    return out

@@ -1,0 +1,223 @@
+"""registry-mirror + metric-catalog: string registries stay single-source.
+
+registry-mirror covers the two registries history shows drifting:
+
+- **SLO classes.** ``infer/continuous.SLO_CLASSES`` is canonical (it IS
+  the scheduler's rank order); ``gateway/admission.SLO_CLASS_NAMES`` and
+  ``telemetry/serving.SLO_CLASS_NAMES`` are deliberate copies — the
+  jax-free zones cannot import the engine module, so the invariant is
+  EQUALITY (names and order), checked here instead of by the three-way
+  runtime mirror test each suite re-declares.
+- **Chaos sites.** ``chaos/plane.SITES`` is canonical. Every literal site
+  passed to ``maybe_inject("<site>")`` anywhere in the tree must be a
+  registered key (a typo'd seam silently never fires — the exact failure
+  ``parse_rules`` learned to reject on the RULE side; this closes the
+  CALL side), and every registered key must be consulted somewhere (a
+  dead registry entry advertises a drill that tests nothing).
+
+metric-catalog statically harvests metric-family literals from
+``registry.counter/gauge/histogram(...)`` calls (resolving module-level
+constant prefixes through f-strings) and asserts each is a family the
+generated catalog (``telemetry/catalog.py``) knows — the no-server-needed
+half of the live two-way drift guard in tests/test_metrics_catalog.py.
+Dynamically-built names (per-replica, per-window) are unresolvable
+statically and are skipped; the live guard still covers them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ditl_tpu.analysis.core import (
+    Diagnostic,
+    Project,
+    SourceFile,
+    call_name,
+    module_literal,
+    rule,
+)
+
+
+def _literal_diag(project: Project, spec, what: str):
+    rel, name = spec
+    f = project.by_rel.get(rel)
+    if f is None:
+        return None, Diagnostic(
+            "registry-mirror", f"{project.package}/{rel}", 1,
+            f"{what} registry file {rel!r} is missing",
+        )
+    lit = module_literal(f, name)
+    if lit is None:
+        return None, Diagnostic(
+            "registry-mirror", f.display, 1,
+            f"{what} registry {name!r} not found as a module-level "
+            f"literal in {rel}",
+        )
+    return (f, lit), None
+
+
+@rule(
+    "registry-mirror",
+    "SLO-class mirrors must equal the canonical engine registry; chaos "
+    "site literals at call sites must be registered in chaos/plane.SITES "
+    "(and every registered site must be consulted)",
+)
+def check_registry_mirror(project: Project) -> list[Diagnostic]:
+    s = project.settings
+    out: list[Diagnostic] = []
+
+    # -- SLO class mirrors -------------------------------------------------
+    canon, err = _literal_diag(project, s.slo_canonical, "canonical SLO")
+    if err is not None:
+        out.append(err)
+    else:
+        (_, (canon_vals, _)) = canon
+        for spec in s.slo_mirrors:
+            mirror, err = _literal_diag(project, spec, "mirror SLO")
+            if err is not None:
+                out.append(err)
+                continue
+            (mf, (vals, lineno)) = mirror
+            if tuple(vals) != tuple(canon_vals):
+                out.append(Diagnostic(
+                    "registry-mirror", mf.display, lineno,
+                    f"{spec[1]} = {tuple(vals)!r} drifted from canonical "
+                    f"{s.slo_canonical[0]}:{s.slo_canonical[1]} = "
+                    f"{tuple(canon_vals)!r} (names AND order are "
+                    "semantic: the tuple is the scheduler rank order)",
+                ))
+
+    # -- chaos sites: call-site literals <-> registry keys, both ways ------
+    reg, err = _literal_diag(project, s.chaos_registry, "chaos-site")
+    if err is not None:
+        out.append(err)
+        return out
+    (reg_file, (site_keys, reg_line)) = reg
+    sites = set(site_keys)
+    consulted: set[str] = set()
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in s.chaos_consult_funcs:
+                continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                continue
+            consulted.add(arg.value)
+            if arg.value not in sites:
+                out.append(Diagnostic(
+                    "registry-mirror", f.display, node.lineno,
+                    f"chaos site {arg.value!r} is not registered in "
+                    f"{s.chaos_registry[0]}:{s.chaos_registry[1]} — the "
+                    "seam would silently never fire",
+                ))
+    for site in site_keys:
+        if site not in consulted:
+            out.append(Diagnostic(
+                "registry-mirror", reg_file.display, reg_line,
+                f"chaos site {site!r} is registered but no "
+                f"{'/'.join(s.chaos_consult_funcs)} call consults it — "
+                "a drill against it tests nothing",
+            ))
+    return out
+
+
+# -- metric-catalog ---------------------------------------------------------
+
+
+def _const_strings(f: SourceFile) -> dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings (f-string prefix
+    resolution)."""
+    out: dict[str, str] = {}
+    for node in f.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _resolve_name_arg(arg: ast.AST, consts: dict[str, str]) -> str | None:
+    """A metric-name argument as a concrete string, or None when it is
+    built dynamically (skipped; the live drift guard covers those)."""
+    if isinstance(arg, ast.Constant):
+        return arg.value if isinstance(arg.value, str) else None
+    if isinstance(arg, ast.Name):
+        return consts.get(arg.id)
+    if isinstance(arg, ast.JoinedStr):
+        parts: list[str] = []
+        for piece in arg.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            elif isinstance(piece, ast.FormattedValue):
+                if (
+                    isinstance(piece.value, ast.Name)
+                    and piece.value.id in consts
+                    and piece.conversion == -1
+                    and piece.format_spec is None
+                ):
+                    parts.append(consts[piece.value.id])
+                else:
+                    return None
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+@rule(
+    "metric-catalog",
+    "metric-family literals registered via counter()/gauge()/histogram() "
+    "must be families the generated catalog (telemetry/catalog.py) knows",
+)
+def check_metric_catalog(project: Project) -> list[Diagnostic]:
+    s = project.settings
+    if not s.catalog_module:
+        return []
+    # Lazy, jax-free import: the catalog is the single canonical family
+    # registry (with its normalize rules); re-declaring it here would be
+    # exactly the mirror drift this module polices.
+    import importlib
+
+    try:
+        catalog = importlib.import_module(s.catalog_module)
+    except ImportError:
+        return [Diagnostic(
+            "metric-catalog", s.catalog_module, 1,
+            f"catalog module {s.catalog_module!r} is not importable",
+        )]
+    families = set(catalog.catalog_families())
+    normalize = catalog.normalize_family
+    out: list[Diagnostic] = []
+    for f in project.files:
+        consts = _const_strings(f)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            method = call_name(node)
+            if method not in s.metric_methods:
+                continue
+            if not isinstance(node.func, ast.Attribute):
+                continue  # bare counter(...) is not a registry call
+            if not node.args:
+                continue
+            name = _resolve_name_arg(node.args[0], consts)
+            if name is None:
+                continue
+            exposed = f"{name}_total" if method == "counter" else name
+            if normalize(exposed) not in families:
+                out.append(Diagnostic(
+                    "metric-catalog", f.display, node.lineno,
+                    f"metric family {exposed!r} is not in the generated "
+                    "catalog (telemetry/catalog.py); add the row and "
+                    "regenerate docs/metrics.md, or the docs drift",
+                ))
+    return out

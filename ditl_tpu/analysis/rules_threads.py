@@ -1,0 +1,121 @@
+"""thread-hygiene: no thread or executor that can wedge process exit.
+
+Every long-lived thread in this tree is ``daemon=True`` plus an explicit
+join/stop path, and both gateway fan-out pools learned (twice, in PR 7
+review rounds) that an executor without ``shutdown`` in a ``finally``
+re-wedges exactly the path it was built to bound. Checked:
+
+- ``threading.Thread(...)`` must pass ``daemon=`` explicitly, or the
+  created thread must have a visible ``.join(`` path in the same file
+  (matched on the variable/attribute it is assigned to). An anonymous
+  non-daemon ``Thread(...).start()`` is always a violation — nothing can
+  ever join it.
+- ``ThreadPoolExecutor``/``ProcessPoolExecutor`` must be used as a
+  context manager (``with``) or have ``<name>.shutdown(`` inside some
+  ``finally`` block of the same file.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ditl_tpu.analysis.core import (
+    Diagnostic,
+    Project,
+    SourceFile,
+    call_name,
+    rule,
+)
+
+_EXECUTORS = {"ThreadPoolExecutor", "ProcessPoolExecutor"}
+
+
+def _assigned_name(f: SourceFile, node: ast.Call) -> str | None:
+    """The simple name/attr a call's result is bound to, found by scanning
+    assignments whose value is (or contains at top level) this call."""
+    for stmt in ast.walk(f.tree):
+        if isinstance(stmt, ast.Assign) and stmt.value is node:
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                return t.id
+            if isinstance(t, ast.Attribute):
+                return t.attr
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is node:
+            if isinstance(stmt.target, ast.Name):
+                return stmt.target.id
+            if isinstance(stmt.target, ast.Attribute):
+                return stmt.target.attr
+    return None
+
+
+def _finally_sources(f: SourceFile) -> str:
+    chunks = []
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.Try):
+            for stmt in node.finalbody:
+                chunks.append(f.segment(stmt))
+    return "\n".join(chunks)
+
+
+def _with_context_ids(f: SourceFile) -> set[int]:
+    out = set()
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                out.add(id(item.context_expr))
+    return out
+
+
+@rule(
+    "thread-hygiene",
+    "threading.Thread needs daemon= or a join path; executors need a "
+    "`with` block or shutdown() in a finally",
+)
+def check_thread_hygiene(project: Project) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    for f in project.files:
+        finally_src = None
+        with_ids = None
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name == "Thread":
+                if any(kw.arg == "daemon" for kw in node.keywords):
+                    continue
+                bound = _assigned_name(f, node)
+                if bound is not None and re.search(
+                    rf"\b{re.escape(bound)}\s*\.\s*join\s*\(", f.text
+                ):
+                    continue
+                what = (
+                    f"thread bound to {bound!r} has no .join( path"
+                    if bound is not None
+                    else "anonymous thread can never be joined"
+                )
+                out.append(Diagnostic(
+                    "thread-hygiene", f.display, node.lineno,
+                    f"threading.Thread without daemon=: {what}; a "
+                    "non-daemon thread here can wedge process exit",
+                ))
+            elif name in _EXECUTORS:
+                if with_ids is None:
+                    with_ids = _with_context_ids(f)
+                if id(node) in with_ids:
+                    continue
+                bound = _assigned_name(f, node)
+                if finally_src is None:
+                    finally_src = _finally_sources(f)
+                if bound is not None and re.search(
+                    rf"\b{re.escape(bound)}\s*\.\s*shutdown\s*\(",
+                    finally_src,
+                ):
+                    continue
+                out.append(Diagnostic(
+                    "thread-hygiene", f.display, node.lineno,
+                    f"{name} is neither a `with` context nor shut down "
+                    "in a finally — a wedged task leaks the pool (the "
+                    "PR 7 gateway fan-out lesson, twice)",
+                ))
+    return out

@@ -288,7 +288,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     gw: GatewayMetrics = None
     gwcfg: GatewayConfig = None
     # key -> replica id that last served it (affinity hit-rate measurement)
-    affinity_last: collections.OrderedDict = None
+    affinity_last: collections.OrderedDict = None  # guarded-by: affinity_lock
     affinity_lock: threading.Lock = None
     # Request tracing (ISSUE 6): the gateway roots (or continues) each
     # request's trace and stamps every relay attempt's span context on the

@@ -75,6 +75,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ditl_tpu.annotations import hot_path
 from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.config import ModelConfig
 from ditl_tpu.data.tokenizer import Tokenizer
@@ -3426,6 +3427,7 @@ class ContinuousEngine:
             and not (self._plain_step_ms and self._spec_round_ms)
         )
 
+    @hot_path
     def step(self) -> None:
         """One scheduler tick: admit queued requests, advance one chunk of
         every in-progress chunked prefill, decode one chunk (speculatively
@@ -3583,9 +3585,11 @@ class ContinuousEngine:
             prefill_tokens=self._tick_prefill_spent,
             budget_left=self._tick_prefill_left,
             preemptions=int(getattr(self, "preemptions", 0)),
-            deadline_expired=int(m.deadline_expired.value),
-            queue_full=int(m.queue_full.value),
-            completed=int(m.completed.value),
+            # Registry counters are plain host floats by the registry's own
+            # zero-device-sync contract; int() here is cosmetic row shape.
+            deadline_expired=int(m.deadline_expired.value),  # ditl: allow(blocking-transfer) -- host-side registry counter, no device sync
+            queue_full=int(m.queue_full.value),  # ditl: allow(blocking-transfer) -- host-side registry counter, no device sync
+            completed=int(m.completed.value),  # ditl: allow(blocking-transfer) -- host-side registry counter, no device sync
         )
         if (self.anomaly is not None
                 and self.tick_count % self.anomaly.check_every == 0):
@@ -3803,10 +3807,10 @@ class ThreadedEngine:
 
         self._engine = engine
         self._cond = threading.Condition()
-        self._results: dict[int, Request] = {}
-        self._cancels: set[int] = set()
-        self._error: BaseException | None = None
-        self._stop = False
+        self._results: dict[int, Request] = {}  # guarded-by: _cond
+        self._cancels: set[int] = set()  # guarded-by: _cond
+        self._error: BaseException | None = None  # guarded-by: _cond
+        self._stop = False  # guarded-by: _cond
         self._thread = threading.Thread(target=self._drive, daemon=True)
         self._thread.start()
 
@@ -3892,7 +3896,7 @@ class ThreadedEngine:
         """True when the engine serves a multi-adapter LoRA stack."""
         return self._engine.multi_lora
 
-    def _wait_one(self, rid: int) -> Request:
+    def _wait_one_locked(self, rid: int) -> Request:
         while rid not in self._results:
             if self._stop:
                 raise RuntimeError(
@@ -3936,7 +3940,7 @@ class ThreadedEngine:
                 trace=trace,
             )
             self._cond.notify_all()
-            req = self._wait_one(rid)
+            req = self._wait_one_locked(rid)
             if req.expired:
                 raise DeadlineExceededError(
                     f"request exceeded its {deadline_s}s deadline "
@@ -3979,7 +3983,7 @@ class ThreadedEngine:
                 trace=trace,
             )
             self._cond.notify_all()
-            req = self._wait_one(rid)
+            req = self._wait_one_locked(rid)
             if req.expired:
                 raise DeadlineExceededError(
                     f"request exceeded its {deadline_s}s deadline "
@@ -4046,7 +4050,7 @@ class ThreadedEngine:
                 self._cond.notify_all()
                 raise
             self._cond.notify_all()
-            return [self._wait_one(rid) for rid in rids]
+            return [self._wait_one_locked(rid) for rid in rids]
 
     def stream_one(
         self,
@@ -4096,10 +4100,15 @@ class ThreadedEngine:
                     try:
                         chunk = stream.get(timeout=1.0)
                     except _queue.Empty:
-                        if self._stop:
+                        # Read _stop/_error as a consistent pair under the
+                        # condition (lock-discipline): once per idle second,
+                        # so the lock costs nothing on a flowing stream.
+                        with self._cond:
+                            stopped, err = self._stop, self._error
+                        if stopped:
                             raise RuntimeError(
                                 "continuous engine stopped mid-stream"
-                            ) from self._error
+                            ) from err
                         continue
                     if chunk is None:
                         return
@@ -4157,10 +4166,13 @@ class ThreadedEngine:
                     try:
                         item = stream.get(timeout=1.0)
                     except _queue.Empty:
-                        if self._stop:
+                        # Same consistent-pair read as stream_one.
+                        with self._cond:
+                            stopped, err = self._stop, self._error
+                        if stopped:
                             raise RuntimeError(
                                 "continuous engine stopped mid-stream"
-                            ) from self._error
+                            ) from err
                         continue
                     if item is None:
                         return

@@ -81,6 +81,15 @@ def normalize_family(name: str) -> str:
 
 # (family, type, labels, meaning[, optional]) — keep sorted by family.
 _ROWS: tuple = (
+    # Client-side counters live in the remote-LLM client's own process
+    # (client_metrics singleton, client/llm.py), never on a server or
+    # gateway scrape surface — optional by construction. Found by the
+    # static metric-catalog pass (ISSUE 11): the live drift guard only
+    # sees scrapeable surfaces, so these had silently escaped the catalog.
+    ("ditl_client_deadline_exhausted_total", "counter", "", "remote-LLM calls aborted by the total_timeout_s wall-clock bound", True),
+    ("ditl_client_requests_total", "counter", "", "remote-LLM logical calls started", True),
+    ("ditl_client_retries_total", "counter", "", "remote-LLM HTTP attempts retried (429/5xx/connection errors)", True),
+    ("ditl_client_retry_exhausted_total", "counter", "", "remote-LLM calls that failed after exhausting max_retries", True),
     ("ditl_gateway_429_by_class_batch_total", "counter", "", "requests 429 carrying SLO class batch"),
     ("ditl_gateway_429_by_class_best_effort_total", "counter", "", "requests 429 carrying SLO class best_effort"),
     ("ditl_gateway_429_by_class_default_total", "counter", "", "requests 429 carrying SLO class default"),

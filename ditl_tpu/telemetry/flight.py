@@ -39,6 +39,8 @@ import collections
 import threading
 import time
 
+from ditl_tpu.annotations import hot_path
+
 __all__ = [
     "FLIGHT_SCHEMA",
     "LIVENESS_RING",
@@ -77,6 +79,7 @@ class FlightRing:
         self.recorded = 0
         self._ring: collections.deque = collections.deque(maxlen=capacity)
 
+    @hot_path
     def record(self, _ts: float | None = None, **row) -> None:
         """Append one row (stamped with the wall clock unless ``_ts``
         overrides it — callers batching rows from an existing host flush
@@ -105,10 +108,11 @@ class FlightRecorder:
         if capacity < 1:
             raise ValueError(f"ring capacity must be >= 1, got {capacity}")
         self.capacity = capacity
-        self._rings: dict[str, FlightRing] = {}
+        self._rings: dict[str, FlightRing] = {}  # guarded-by: _lock
         self._lock = threading.Lock()  # ring creation only, never records
 
     def ring(self, name: str, capacity: int | None = None) -> FlightRing:
+        # ditl: allow(lock-discipline) -- double-checked fast path: a racy dict read returns either the ring or None (GIL-whole), and None falls through to the locked create
         ring = self._rings.get(name)
         if ring is not None:
             return ring

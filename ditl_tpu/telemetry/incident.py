@@ -177,9 +177,9 @@ class IncidentManager:
         self.suppressed_total = 0  # lifetime, never reset (endpoint-read)
         self.paths: list[str] = []
         self._lock = threading.Lock()
-        self._last_fire: dict[str, float] = {}
-        self._suppressed: dict[str, int] = {}
-        self._seq = 0
+        self._last_fire: dict[str, float] = {}  # guarded-by: _lock
+        self._suppressed: dict[str, int] = {}  # guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
         if registry is not None:
             self._total = registry.counter(
                 "ditl_incidents", "incident bundles assembled")

@@ -69,6 +69,7 @@ def live_buffer_topk(k: int = 8) -> dict:
     plus the totals — the "what is actually holding HBM" answer an OOM
     post-mortem starts with. Host-only reads of buffer metadata; the
     arrays' bytes are never touched."""
+    # ditl: allow(import-layering) -- memwatch is jax-free ON IMPORT; this runs only when an armed watcher samples, and jax is already live in that process
     import jax
 
     arrays = [a for a in jax.live_arrays() if not getattr(a, "is_deleted",
@@ -141,6 +142,7 @@ class MemoryWatcher:
         """Read every device's allocator stats and refresh the gauges.
         Returns ``{device_index: stats}`` (empty on statless backends)."""
         if devices is None:
+            # ditl: allow(import-layering) -- lazy by design: sampling implies an armed watcher in a process that already initialized jax
             import jax
 
             devices = jax.local_devices()

@@ -104,6 +104,25 @@ def compare_metrics(
                 f"  {label}incidents: {int(old_inc)} -> {int(new_inc)} "
                 "(both sides had incidents; reported, not gated)"
             )
+    # Invariant-lint gating (ISSUE 11 satellite): rows stamp
+    # `analysis_clean` (bench runs `ditl_tpu.analysis` once per process).
+    # clean -> dirty is a "now fails"-class regression — a perf win that
+    # ships an invariant violation (a stray sync, an unguarded attribute)
+    # must not pass on its numbers. Both-sides-dirty is reported, not
+    # gated; rows predating the stamp (absent) are skipped.
+    old_an, new_an = old.get("analysis_clean"), new.get("analysis_clean")
+    if new_an is False:
+        if old_an is True:
+            msg = (f"{label}analysis_clean: true -> false (invariant "
+                   "lint now fails; run python -m ditl_tpu.analysis)")
+            lines.append(f"  {msg} REGRESSION")
+            regressions.append(msg)
+        else:
+            lines.append(
+                f"  {label}analysis_clean: false on "
+                f"{'both sides' if old_an is False else 'new side only'} "
+                "(reported, not gated)"
+            )
     if new.get("error") and not old.get("error"):
         msg = (f"{label}previously measured, now fails: "
                f"{str(new['error'])[:200]}")

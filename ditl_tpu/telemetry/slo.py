@@ -92,7 +92,7 @@ class BurnRateMonitor:
         self.objectives = list(objectives)
         self.windows = tuple(sorted(float(w) for w in windows))
         self.burn_alert = float(burn_alert)
-        self._samples: collections.deque = collections.deque()
+        self._samples: collections.deque = collections.deque()  # guarded-by: _lock
         # Concurrent scrapes (Prometheus on /metrics while a dashboard hits
         # /slo — both handler threads of the same ThreadingHTTPServer reach
         # the one shared monitor) would otherwise mutate the deque mid-
@@ -114,7 +114,7 @@ class BurnRateMonitor:
         # once until it clears and re-fires.
         self._journal = journal
         self._on_alert = on_alert
-        self._alerting: dict[str, bool] = {}
+        self._alerting: dict[str, bool] = {}  # guarded-by: _lock
 
     def sample(self, now: float | None = None) -> None:
         now = time.time() if now is None else now

@@ -25,6 +25,7 @@ import statistics
 import time
 from typing import Any
 
+from ditl_tpu.annotations import hot_path
 from ditl_tpu.runtime.distributed import is_coordinator
 from ditl_tpu.utils.logging import get_logger
 
@@ -82,9 +83,11 @@ class MetricsLogger:
         if metrics_file and is_coordinator():
             self._metrics_fh = open(metrics_file, "a", buffering=1)
 
+    @hot_path
     def start_step(self) -> None:
         self._last_t = time.perf_counter()
 
+    @hot_path
     def end_step(
         self, step: int, device_metrics: Any, n_steps: int = 1,
         data_wait_s: float = 0.0, excluded_s: float = 0.0,

@@ -1,0 +1,21 @@
+"""Fixture config tree: one undocumented field, one orphan section."""
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class FooConfig:
+    documented_field: int = 1
+    undocumented_field: int = 2  # line 8: not in docs, no metadata
+    metadata_field: int = field(
+        default=3, metadata={"doc": "documented inline"}
+    )
+
+
+@dataclass(frozen=True)
+class OrphanConfig:  # line 15: not a field of Config
+    knob: int = 0  # line 16: also undocumented
+
+
+@dataclass(frozen=True)
+class Config:
+    foo: FooConfig = field(default_factory=FooConfig)

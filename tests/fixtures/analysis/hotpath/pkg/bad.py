@@ -1,0 +1,21 @@
+"""@hot_path functions with every blocking spelling the rule flags."""
+import jax
+import numpy as np
+
+from ditl_tpu.annotations import hot_path
+
+
+class Engine:
+    @hot_path
+    def tick(self, out, arr):
+        fetched = jax.device_get(out)          # line 11: device_get
+        out.block_until_ready()                # line 12: block_until_ready
+        x = float(arr)                         # line 13: float on a name
+        y = np.asarray(out)                    # line 14: np.asarray
+        z = int(self.counter)                  # line 15: int on attribute
+        ok = float(len(arr))                   # host call arg: NOT flagged
+        allowed = float(arr)  # ditl: allow(blocking-transfer) -- fixture: provably host-side
+        return fetched, x, y, z, ok, allowed
+
+    def unmarked(self, out):
+        return jax.device_get(out)  # not @hot_path: never flagged

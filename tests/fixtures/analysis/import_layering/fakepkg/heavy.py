@@ -1,0 +1,2 @@
+"""A module outside the zones that legitimately imports jax."""
+import jax  # noqa: F401

@@ -1,0 +1,2 @@
+"""Zone module with a direct module-level jax import (line 3)."""
+import jax  # noqa: F401

@@ -1,0 +1,2 @@
+"""Zone module reaching jax through an internal import (line 3)."""
+from fakepkg import heavy  # noqa: F401

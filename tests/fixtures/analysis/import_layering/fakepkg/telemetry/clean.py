@@ -1,0 +1,7 @@
+"""Zone module that stays clean (stdlib + typing-only jax)."""
+from typing import TYPE_CHECKING
+
+import os  # noqa: F401
+
+if TYPE_CHECKING:
+    import jax  # noqa: F401

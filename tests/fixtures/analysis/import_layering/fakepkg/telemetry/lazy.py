@@ -1,0 +1,10 @@
+"""Zone module with lazy jax imports: one bare (violation), one pragma'd."""
+
+
+def unsanctioned():
+    import jax  # noqa: F401
+
+
+def sanctioned():
+    # ditl: allow(import-layering) -- fixture: armed-only path, jax already live
+    import jax  # noqa: F401

@@ -1,0 +1,25 @@
+"""guarded-by attribute touched outside its lock."""
+import threading
+
+
+class Monitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}  # guarded-by: _lock
+
+    def good(self):
+        with self._lock:
+            self._state["k"] = 1
+
+    def bad(self):
+        self._state["k"] = 2  # line 15: unlocked write
+
+    def read_bad(self):
+        return len(self._state)  # line 18: unlocked read
+
+    def _peek_locked(self):
+        return dict(self._state)  # *_locked convention: exempt
+
+    def racy_ok(self):
+        # ditl: allow(lock-discipline) -- fixture: benign double-checked read
+        return self._state.get("k")

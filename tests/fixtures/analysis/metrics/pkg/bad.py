@@ -1,0 +1,10 @@
+"""Metric families the catalog does not know."""
+PREFIX = "ditl_serving"
+
+
+class M:
+    def __init__(self, r):
+        self.known = r.counter("ditl_incidents", "a real family")
+        self.bogus = r.counter("ditl_bogus_family", "line 8: unknown")
+        self.fstr = r.gauge(f"{PREFIX}_made_up_gauge", "line 9: unknown")
+        self.skipped = r.histogram(f"{PREFIX}_{self.known}_x", "dynamic: skipped")

@@ -1,0 +1,15 @@
+"""Pragma hygiene: reasonless + unknown-rule pragmas are violations."""
+import threading
+
+
+def leaky():
+    # ditl: allow(thread-hygiene)
+    t = threading.Thread(target=print)  # suppressed, but pragma lacks reason
+    t.start()
+    u = threading.Thread(target=print)  # ditl: allow(no-such-rule) -- bogus id
+    u.start()
+
+
+def stale():
+    x = 1  # ditl: allow(thread-hygiene) -- stale: nothing here violates
+    return x

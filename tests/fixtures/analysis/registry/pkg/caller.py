@@ -1,0 +1,7 @@
+"""Consults one registered site and one unknown site."""
+from pkg.chaos.plane import maybe_inject
+
+
+def work():
+    maybe_inject("engine.tick")
+    maybe_inject("engine.tok")  # line 7: typo'd site, silently never fires

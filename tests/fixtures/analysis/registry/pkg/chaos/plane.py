@@ -1,0 +1,9 @@
+"""Fixture chaos-site registry: 'dead.site' is never consulted."""
+SITES = {
+    "engine.tick": "consulted below",
+    "dead.site": "registered but never consulted (line 2 diag)",
+}
+
+
+def maybe_inject(site, **kwargs):
+    return None

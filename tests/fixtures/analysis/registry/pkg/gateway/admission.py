@@ -1,0 +1,2 @@
+"""Drifted mirror: typo'd name + wrong order (line 3)."""
+SLO_CLASS_NAMES = ("interactiv", "best_effort", "batch")

@@ -1,0 +1,2 @@
+"""Canonical SLO registry (fixture)."""
+SLO_CLASSES = {"interactive": 0, "batch": 1, "best_effort": 2}

@@ -1,0 +1,37 @@
+"""Thread/executor hygiene violations."""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def leaky():
+    w = threading.Thread(target=print)  # line 7: no daemon=, no join
+    w.start()
+    threading.Thread(target=print).start()  # line 9: anonymous
+
+
+def joined():
+    t = threading.Thread(target=print)  # has a join path below: ok
+    t.start()
+    t.join()
+
+
+def daemonic():
+    threading.Thread(target=print, daemon=True).start()  # ok
+
+
+def leaky_pool():
+    pool = ThreadPoolExecutor(max_workers=2)  # line 22: no finally shutdown
+    pool.submit(print)
+
+
+def managed_pool():
+    with ThreadPoolExecutor(max_workers=2) as pool:  # ok: with
+        pool.submit(print)
+
+
+def finally_pool():
+    pool2 = ThreadPoolExecutor(max_workers=2)  # ok: shutdown in finally
+    try:
+        pool2.submit(print)
+    finally:
+        pool2.shutdown(wait=False)

@@ -350,10 +350,26 @@ def test_telemetry_config_validation():
             TelemetryConfig(**bad)
 
 
+def test_jax_free_zones_pass_import_layering_rule():
+    """The jax-free-on-import claim, delegated to the static pass
+    (ISSUE 11): the import-layering rule proves telemetry/ gateway/
+    chaos/ client/ AND analysis/ itself never reach jax through
+    module-level imports — transitively, over EVERY module in the zones,
+    not just the handful a subprocess smoke can afford to list. Lazy
+    in-function jax imports must carry a reasoned pragma."""
+    import ditl_tpu
+    from ditl_tpu.analysis import run
+
+    pkg_dir = os.path.dirname(os.path.abspath(ditl_tpu.__file__))
+    diags = run(pkg_dir, rules=["import-layering"])
+    assert diags == [], "\n".join(d.format() for d in diags)
+
+
 def test_observability_packages_are_jax_free_on_import():
-    """The gateway/chaos/telemetry jax-free claim exists in prose
-    (docstrings since ISSUE 3-5); pin it — a stray top-level jax import
-    would silently make the gateway un-runnable as a thin front process."""
+    """Belt-and-suspenders runtime smoke behind the static rule above:
+    one fresh interpreter actually imports the zone entry points and
+    asserts jax never loads — guarding the cases static analysis cannot
+    see (import-time side effects, meta-path hooks)."""
     code = (
         "import sys\n"
         "import ditl_tpu.telemetry\n"
@@ -369,6 +385,7 @@ def test_observability_packages_are_jax_free_on_import():
         "import ditl_tpu.gateway.replica\n"
         "import ditl_tpu.chaos\n"
         "import ditl_tpu.chaos.plane\n"
+        "import ditl_tpu.analysis\n"
         "assert 'jax' not in sys.modules, 'jax leaked into the import graph'\n"
         "print('jax-free ok')\n"
     )
