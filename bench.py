@@ -979,6 +979,277 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     return row
 
 
+def run_trace_replay_bench(trace_path: str, n_replicas: int = 3,
+                           slots: int = 2, decode_chunk: int = 2,
+                           autoscale: bool = False, speed: float = 1.0,
+                           min_replicas: int = 1,
+                           slo_ttft_s: float = 2.5,
+                           compile_cache_dir: str = "",
+                           _model_overrides: dict | None = None,
+                           _autoscale_overrides: dict | None = None) -> dict:
+    """Traffic-trace replay bench (ISSUE 12): drive a recorded request
+    shape (``gateway --save-trace`` JSONL, or a committed synthetic shape
+    under ``tests/fixtures/traces/``) through an in-process gateway fleet
+    with PRESERVED inter-arrival times, and grade what the fleet COST:
+    the row embeds ``replica_seconds`` (integral of live replicas over the
+    timed region) next to the usual serving latency block, plus the
+    interactive TTFT-SLO violation rate. With ``autoscale=True`` the
+    FleetSupervisor carries an armed Actuator — the on-vs-off pair on the
+    same trace is THE autoscaler A/B, and perf_compare gates it: fewer
+    replica-seconds at no worse TTFT p95 / SLO violation rate.
+
+    ``speed`` compresses the recorded offsets (2.0 = twice as fast);
+    ``min_replicas`` floors ordinary scale-down; ``_model_overrides`` /
+    ``_autoscale_overrides`` shrink the model / tune the planner for
+    tier-1 acceptance drills (a published row must not use them)."""
+    import dataclasses
+    import threading
+
+    import jax
+
+    from ditl_tpu.config import AutoscaleConfig, GatewayConfig, ModelConfig
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.gateway import (
+        Actuator, Fleet, FleetSupervisor, GatewayMetrics, InProcessReplica,
+        load_trace, make_gateway,
+    )
+    from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
+    from ditl_tpu.infer.engine import GenerateConfig, Generator
+    from ditl_tpu.infer.server import make_server
+    from ditl_tpu.models import llama
+    from ditl_tpu.runtime.distributed import enable_compile_cache
+
+    enable_compile_cache(compile_cache_dir)
+    _inc0 = _incidents_now()
+    rows = load_trace(trace_path)
+    if not rows:
+        raise ValueError(f"no replayable rows in {trace_path}")
+    if speed <= 0:
+        raise ValueError(f"speed must be > 0, got {speed}")
+    platform = jax.devices()[0].platform
+    cfg = ModelConfig(
+        name="bench-350m", vocab_size=32768, hidden_size=1024,
+        intermediate_size=2816, num_layers=24, num_heads=16, num_kv_heads=8,
+        head_dim=64, max_seq_len=1024, dtype="bfloat16",
+        param_dtype="float32",
+    )
+    if platform != "tpu":
+        cfg = dataclasses.replace(cfg, num_layers=2, hidden_size=256,
+                                  intermediate_size=688, vocab_size=4096)
+    if _model_overrides:
+        cfg = dataclasses.replace(cfg, **_model_overrides)
+    default_max_new = max(
+        [int(r.get("max_new") or 0) for r in rows] + [8]
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    tok = ByteTokenizer()
+    shared_gen = Generator(params, cfg, tok)  # tokenize/metadata only
+    engines = [
+        ThreadedEngine(ContinuousEngine(
+            params, cfg, tok, n_slots=slots, decode_chunk=decode_chunk,
+            gen=GenerateConfig(max_new_tokens=default_max_new),
+            max_queue=len(rows) + 8,
+        ))
+        for _ in range(n_replicas)
+    ]
+
+    def factory(eng):
+        # In-process replicas adopt their engine across restarts, so the
+        # honest measured cold start is the (tiny) server rebuild — the
+        # subprocess path measures the real jax-import+build one.
+        return lambda: make_server(shared_gen, port=0, threaded_engine=eng,
+                                   default_max_tokens=default_max_new,
+                                   cold_start_s=0.05)
+
+    fleet = Fleet([
+        InProcessReplica(f"r{i}", factory(eng))
+        for i, eng in enumerate(engines)
+    ])
+    fleet.start_all(wait_healthy_s=30.0)
+    gw_metrics = GatewayMetrics()
+    supervisor = FleetSupervisor(
+        fleet, interval_s=0.05, fail_threshold=3,
+        probe_timeout_s=2.0, restart_timeout_s=20.0,
+    )
+    actuator = None
+    if autoscale:
+        as_kwargs = dict(
+            enabled=True, min_replicas=min_replicas,
+            up_hysteresis_polls=1, hysteresis_polls=4,
+            cooldown_s=1.0, drain_wait_s=2.0,
+        )
+        as_kwargs.update(_autoscale_overrides or {})
+        actuator = Actuator(
+            fleet, supervisor, AutoscaleConfig(**as_kwargs),
+            metrics=gw_metrics,
+        )
+        supervisor.autoscaler = actuator
+    gwcfg = GatewayConfig(router="affinity", affinity_prefix_tokens=4)
+    server = make_gateway(fleet, config=gwcfg, metrics=gw_metrics, port=0,
+                          actuator=actuator)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    try:
+        return _run_trace_replay_timed(
+            rows, engines, fleet, supervisor, actuator, port,
+            n_replicas=n_replicas, slots=slots, autoscale=autoscale,
+            speed=speed, min_replicas=min_replicas, slo_ttft_s=slo_ttft_s,
+            default_max_new=default_max_new, trace_path=trace_path,
+            platform=platform, _inc0=_inc0,
+        )
+    finally:
+        # One finally covers the replay too: a failed request (retry
+        # deadline, unexpected status) must not leak the gateway server,
+        # the supervisor, or the engines into the calling process — the
+        # tier-1 A/B drill runs this in-process, where a leaked
+        # supervisor thread would keep probing for the rest of the
+        # pytest session.
+        server.shutdown()
+        server.server_close()
+        fleet.stop_all(drain=True, timeout=10.0)
+        for eng in engines:
+            eng.close()
+
+
+def _run_trace_replay_timed(rows, engines, fleet, supervisor, actuator,
+                            port, *, n_replicas, slots, autoscale,
+                            speed, min_replicas, slo_ttft_s,
+                            default_max_new, trace_path, platform,
+                            _inc0) -> dict:
+    """The warmed+timed half of :func:`run_trace_replay_bench`; the
+    caller owns (and always tears down) the fleet/server/engines."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from ditl_tpu.gateway import ReplicaSecondsSampler
+    from ditl_tpu.telemetry.serving import (
+        serving_bench_summary, snapshot_serving, ttft_slo_violation_rate,
+    )
+
+    def prompt_for(row) -> str:
+        # Tenant digest as the shared token prefix: same-tenant traffic
+        # shares an affinity key (and a reusable prompt prefix), the
+        # regime the recorded shape came from.
+        tenant = str(row.get("tenant") or "anon")
+        n = max(4, int(row.get("prompt_tokens") or 8))
+        return " ".join(f"{tenant}w{j}" for j in range(n))
+
+    import urllib.error
+    import urllib.request
+
+    def one(item):
+        idx, row = item
+        target = t_start + row["t"] / speed
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        body = {"prompt": prompt_for(row),
+                "max_tokens": int(row.get("max_new") or default_max_new)}
+        if row.get("slo_class"):
+            body["slo_class"] = row["slo_class"]
+        deadline = time.monotonic() + 120.0
+        while True:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/completions",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"}, method="POST",
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=120) as resp:
+                    return json.loads(
+                        resp.read())["usage"]["completion_tokens"]
+            except urllib.error.HTTPError as e:
+                # 429 = throttle or scale-to-zero wake promise: honor the
+                # Retry-After like a real client (the wake budget says the
+                # replica will be up by then). Anything else is a failure.
+                e.read()
+                if e.code != 429 or time.monotonic() > deadline:
+                    raise
+                time.sleep(min(5.0, float(e.headers.get("Retry-After", 1))))
+
+    # Warm every PROMPT SHAPE the trace will replay, on every replica (the
+    # run_gateway_bench group-length discipline, stricter: the byte
+    # tokenizer makes prefill shape = byte length, so warm with the EXACT
+    # replay prompts). A shape compiling inside the timed region would
+    # charge ~seconds of compile to whichever leg hit it first —
+    # corrupting exactly the TTFT comparison the A/B exists for.
+    warm_prompts = sorted({prompt_for(r) for r in rows})
+
+    def warm(view):
+        for prompt in warm_prompts:
+            req = urllib.request.Request(
+                f"http://{view.address[0]}:{view.address[1]}"
+                "/v1/completions",
+                data=json.dumps({"prompt": prompt,
+                                 "max_tokens": default_max_new}).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                resp.read()
+
+    bundles = [eng._engine.metrics for eng in engines]
+    sampler = ReplicaSecondsSampler(fleet, interval_s=0.02)
+    # The sampler/supervisor threads stop even when a replay request
+    # fails; the caller's finally owns the server/fleet/engine teardown.
+    try:
+        with ThreadPoolExecutor(max_workers=max(8, len(rows))) as pool:
+            # Compile every engine OUTSIDE the timed region (direct hits,
+            # the run_gateway_bench discipline), then snapshot so the
+            # serving block and the replica-seconds integral cover the
+            # replay only.
+            list(pool.map(warm, fleet.views()))
+            serving_base = snapshot_serving(bundles)
+            supervisor.start()
+            sampler.start()
+            t_start = time.perf_counter()
+            tokens = sum(pool.map(one, enumerate(rows)))
+            dt = time.perf_counter() - t_start
+    finally:
+        replica_seconds = sampler.stop()
+        supervisor.stop()
+    actions: dict[str, int] = {}
+    if actuator is not None:
+        for entry in actuator.recent():
+            key = f"{entry['kind']}_{entry['outcome']}"
+            actions[key] = actions.get(key, 0) + 1
+    row = {
+        "metric": "trace replay (%d replica(s) x %d slots, autoscale=%s)"
+                  % (n_replicas, slots, "on" if autoscale else "off"),
+        **_record_meta(),
+        "value": round(tokens / dt, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+        "vs_baseline_key": "self",
+        "platform": platform,
+        "generated_tokens": tokens,
+        "requests": len(rows),
+        "trace": {"path": trace_path, "rows": len(rows), "speed": speed,
+                  "duration_s": round(dt, 3)},
+        "serving": serving_bench_summary(bundles, since=serving_base),
+        # The autoscaler A/B block (hoisted by perf_compare like
+        # `serving`): replica_seconds regresses when it RISES, the SLO
+        # violation rate when it rises — on-vs-off on the same seeded
+        # trace gates "fewer replica-seconds at no worse interactive SLO".
+        "autoscale": {
+            "enabled": autoscale,
+            "min_replicas": min_replicas,
+            "replica_seconds": round(replica_seconds, 3),
+            "ttft_slo_violation_rate": ttft_slo_violation_rate(
+                bundles, slo_ttft_s, since=serving_base),
+            "actions": actions,
+        },
+        **_chaos_result(),
+        **_incident_result(_inc0),
+    }
+    return row
+
+
+def bench_trace_replay(*args, **kwargs) -> int:
+    """CLI wrapper over :func:`run_trace_replay_bench`: one JSON line."""
+    print(json.dumps(run_trace_replay_bench(*args, **kwargs)))
+    return 0
+
+
 def bench_gateway(*args, **kwargs) -> int:
     """CLI wrapper over :func:`run_gateway_bench`: one JSON line, like
     every other bench mode."""
@@ -1525,6 +1796,25 @@ if __name__ == "__main__":
                         "short streams — the disagg-vs-homogeneous A/B "
                         "workload; the row gains per-class TTFT/interference "
                         "p95s (interactive pair perf_compare-gated)")
+    parser.add_argument("--serve-trace-replay", default="", metavar="PATH",
+                        help="with --infer --serve-replicas: replay a "
+                        "recorded traffic trace (gateway --save-trace "
+                        "JSONL, or tests/fixtures/traces/*.jsonl) through "
+                        "the fleet with preserved inter-arrival times "
+                        "(ISSUE 12); the row embeds replica_seconds + the "
+                        "TTFT-SLO violation rate — the autoscaler A/B "
+                        "surface perf_compare gates")
+    parser.add_argument("--serve-autoscale", action="store_true",
+                        help="with --serve-trace-replay: arm the autoscale "
+                        "actuator (gateway/autoscale.py) on the replay "
+                        "fleet — the ON leg of the on-vs-off A/B")
+    parser.add_argument("--serve-min-replicas", type=int, default=1,
+                        help="with --serve-autoscale: ordinary scale-down "
+                        "floor (autoscale.min_replicas)")
+    parser.add_argument("--trace-speed", type=float, default=1.0,
+                        help="with --serve-trace-replay: compress the "
+                        "recorded inter-arrival offsets by this factor "
+                        "(2.0 = replay twice as fast)")
     args = parser.parse_args()
     if args.chaos:
         from ditl_tpu.chaos import FaultPlane, arm
@@ -1536,7 +1826,8 @@ if __name__ == "__main__":
                   or args.engine != "lockstep" or args.cache != "contiguous"
                   or args.infer_workload != "random" or args.moe
                   or args.prompt_len or args.max_new or args.guided
-                  or args.spec_draft or args.serve_replicas)
+                  or args.spec_draft or args.serve_replicas
+                  or args.serve_trace_replay)
     if infer_only and not args.infer:
         parser.error("serving flags require --infer")
     if args.infer and (args.override or args.batch or args.seq):
@@ -1554,6 +1845,17 @@ if __name__ == "__main__":
     if args.trace_out and not args.serve_replicas:
         parser.error("--trace-out requires --infer --serve-replicas (the "
                      "fleet serving bench is the traced path)")
+    if args.serve_trace_replay and not (args.infer and args.serve_replicas):
+        parser.error("--serve-trace-replay requires --infer "
+                     "--serve-replicas N (the fleet it replays against)")
+    if args.infer and args.serve_trace_replay:
+        sys.exit(bench_trace_replay(
+            args.serve_trace_replay, n_replicas=args.serve_replicas,
+            slots=args.slots, decode_chunk=args.decode_chunk,
+            autoscale=args.serve_autoscale, speed=args.trace_speed,
+            min_replicas=args.serve_min_replicas,
+            compile_cache_dir=args.compile_cache_dir,
+        ))
     if args.infer and args.serve_replicas:
         sys.exit(bench_gateway(
             args.serve_replicas, slots=args.slots,

@@ -103,6 +103,11 @@ SITES = {
                      "tmp dir and the publishing rename (kill = torn-"
                      "bundle drill: --list must skip it, the next manager "
                      "sweeps it)",
+    "supervisor.action": "gateway/autoscale.py: inside the fleet-mutation "
+                         "lock, before an autoscale/remediation action "
+                         "executes (delay = widen the race window against "
+                         "crash recovery / rolling restarts; error = a "
+                         "failed actuation -> action.failed outcome)",
 }
 
 

@@ -34,6 +34,7 @@ __all__ = [
     "TrainConfig",
     "APIConfig",
     "GatewayConfig",
+    "AutoscaleConfig",
     "ChaosConfig",
     "TelemetryConfig",
     "Config",
@@ -563,6 +564,123 @@ class GatewayConfig:
 
 
 @dataclass(frozen=True)
+class AutoscaleConfig:
+    """Actuation plane (ditl_tpu/gateway/autoscale.py, ISSUE 12):
+    demand-driven replica scale-up/down plus detector-triggered remediation
+    over the gateway's FleetSupervisor. Disabled by default — the planner
+    never runs and the fleet behaves exactly as before. Every planned/
+    executed/refused/failed action is journaled (``action.*`` events with
+    the triggering signal snapshot inline), recorded into the ACTION flight
+    ring, span-traced (``gateway.action``), counted on /metrics, and
+    listable at the gateway's ``/actions`` endpoint."""
+
+    enabled: bool = False
+    # Fleet-size bounds for ordinary demand scaling: scale_down never goes
+    # below min_replicas (the idle scale-to-zero path below is the one
+    # exception, and it must be armed separately).
+    min_replicas: int = 1
+    # Demand signals: mean active_slots/capacity across live replicas
+    # above scale_up_pressure (or mean queued+outstanding per live replica
+    # at/above scale_up_queue) asks for one more replica; pressure below
+    # scale_down_pressure with empty queues asks for one fewer.
+    scale_up_pressure: float = 0.75
+    scale_down_pressure: float = 0.25
+    scale_up_queue: float = 2.0
+    # Hysteresis: the up/down signal must hold for this many consecutive
+    # planner polls before an action is planned (asymmetric on purpose —
+    # adding capacity is cheap and urgent, removing it is neither).
+    up_hysteresis_polls: int = 1
+    hysteresis_polls: int = 3
+    # Cooldown after any EXECUTED scale action before the next scale action
+    # may plan (remediation and scale-to-zero wake are exempt: draining a
+    # storm or answering demand must not wait out a scale cooldown).
+    cooldown_s: float = 15.0
+    # How long a scale-down/drain waits for the gateway's own in-flight
+    # proxies to clear before stopping the replica.
+    drain_wait_s: float = 10.0
+    # Scale-to-zero: with every active replica idle (zero pressure, zero
+    # queue) for idle_to_zero_s, deactivate below min_replicas down to 0.
+    # Demand arriving against an empty fleet answers 429 with a measured
+    # wake-up budget as Retry-After and wakes a replica immediately.
+    scale_to_zero: bool = False
+    idle_to_zero_s: float = 60.0
+    # Wake-up budget = wake_budget_factor x the largest MEASURED replica
+    # cold start (time-to-first-ready stamped on /health, compile cache
+    # included); default_cold_start_s is only the bootstrap estimate used
+    # before any replica has ever reported one.
+    default_cold_start_s: float = 30.0
+    wake_budget_factor: float = 2.0
+    # Remediation: a live replica whose health-polled TPOT p95 exceeds
+    # tpot_storm_factor x the median of its peers AND tpot_storm_min_s
+    # (the absolute floor keeps sub-millisecond noise from reading as a
+    # storm) is drained and restarted; a replica that dies
+    # quarantine_deaths times within quarantine_window_s is quarantined
+    # (stopped, excluded from supervision — the crash-loop breaker).
+    # remedy_cooldown_s rate-limits remediation PER REPLICA, so a
+    # sustained storm is one drain, not one per planner poll.
+    tpot_storm_factor: float = 4.0
+    tpot_storm_min_s: float = 0.25
+    quarantine_deaths: int = 3
+    quarantine_window_s: float = 60.0
+    remedy_cooldown_s: float = 300.0
+    # Plan-but-log: actions journal/count/trace as planned and are then
+    # recorded with outcome "dry_run" instead of executing.
+    dry_run: bool = False
+    # Bounded in-memory action log served at the gateway's /actions.
+    action_log: int = 256
+
+    def __post_init__(self):
+        if self.min_replicas < 0:
+            raise ValueError(
+                f"autoscale.min_replicas must be >= 0, got "
+                f"{self.min_replicas}"
+            )
+        for name in ("scale_up_pressure", "scale_down_pressure"):
+            v = getattr(self, name)
+            if not 0.0 < v <= 1.0:
+                raise ValueError(
+                    f"autoscale.{name} must be in (0, 1], got {v}"
+                )
+        if self.scale_down_pressure >= self.scale_up_pressure:
+            raise ValueError(
+                "autoscale.scale_down_pressure must be below "
+                f"scale_up_pressure, got {self.scale_down_pressure} >= "
+                f"{self.scale_up_pressure}"
+            )
+        for name in ("up_hysteresis_polls", "hysteresis_polls",
+                     "quarantine_deaths", "action_log"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"autoscale.{name} must be >= 1, got "
+                    f"{getattr(self, name)}"
+                )
+        if self.scale_up_queue <= 0:
+            # 0 would make the queue signal PERMANENTLY hot (mean queued
+            # >= 0 always holds) — an idle fleet would read as overloaded
+            # and oscillate against the idle scale-down path. There is no
+            # "disable" spelling for this knob; set it high instead.
+            raise ValueError(
+                f"autoscale.scale_up_queue must be > 0, got "
+                f"{self.scale_up_queue}"
+            )
+        for name in ("cooldown_s", "drain_wait_s",
+                     "idle_to_zero_s", "remedy_cooldown_s",
+                     "quarantine_window_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"autoscale.{name} must be >= 0, got "
+                    f"{getattr(self, name)}"
+                )
+        for name in ("default_cold_start_s", "wake_budget_factor",
+                     "tpot_storm_factor", "tpot_storm_min_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"autoscale.{name} must be > 0, got "
+                    f"{getattr(self, name)}"
+                )
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection plane (ditl_tpu/chaos/, ISSUE 5). ``rules`` is the
     compact spec string ``site:action[@k=v,...];...`` (see
@@ -806,6 +924,7 @@ class Config:
     train: TrainConfig = field(default_factory=TrainConfig)
     api: APIConfig = field(default_factory=APIConfig)
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 

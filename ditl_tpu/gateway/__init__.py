@@ -14,6 +14,15 @@ from ditl_tpu.gateway.admission import (
     sanitize_label,
     tenant_label,
 )
+from ditl_tpu.gateway.autoscale import (
+    Action,
+    ActionPlanner,
+    Actuator,
+    FleetSignals,
+    ReplicaSecondsSampler,
+    TrafficRecorder,
+    load_trace,
+)
 from ditl_tpu.gateway.gateway import GatewayMetrics, make_gateway
 from ditl_tpu.gateway.replica import (
     Fleet,
@@ -41,22 +50,29 @@ from ditl_tpu.gateway.router import (
 )
 
 __all__ = [
+    "Action",
+    "ActionPlanner",
+    "Actuator",
     "AdmissionDecision",
     "CacheAffinityPolicy",
     "Fleet",
+    "FleetSignals",
     "FleetSupervisor",
     "GatewayMetrics",
     "InProcessReplica",
     "LeastOutstandingPolicy",
     "ROLES",
     "ReplicaHandle",
+    "ReplicaSecondsSampler",
     "ReplicaView",
     "RoundRobinPolicy",
     "SubprocessReplica",
     "TenantAdmission",
     "TokenBucket",
+    "TrafficRecorder",
     "affinity_key",
     "gateway_journal_path",
+    "load_trace",
     "make_gateway",
     "make_policy",
     "parse_roles",

@@ -120,6 +120,17 @@ class GatewayMetrics:
             f"{PREFIX}_replicas_live", "replicas currently routable")
         self.replicas_draining = r.gauge(
             f"{PREFIX}_replicas_draining", "replicas currently draining")
+        # Actuation plane (ISSUE 12): pool accounting next to liveness —
+        # active = launched minus parked/quarantined (a crashed-but-
+        # recovering replica is still active), so "why are only 2 of my 4
+        # replicas serving" is answerable from one scrape.
+        self.replicas_active = r.gauge(
+            f"{PREFIX}_replicas_active",
+            "replicas participating in serving (not parked by a "
+            "scale-down, not quarantined)")
+        self.replicas_quarantined = r.gauge(
+            f"{PREFIX}_replicas_quarantined",
+            "replicas quarantined by death-storm remediation")
 
     # Each distinct tenant label becomes its own metric family; tenants
     # arrive as arbitrary unauthenticated bearer tokens, so beyond this
@@ -155,6 +166,16 @@ class GatewayMetrics:
             f"{PREFIX}_role_{label}_{kind}",
             f"requests {kind} on {label}-role replicas")
 
+    def action_counter(self, kind: str, outcome: str):
+        """Per action-kind/outcome counters (ISSUE 12):
+        ``ditl_gateway_action_<kind>_<outcome>`` — how often the autoscale
+        planner acted, refused, or failed, scrapeable without reading
+        journals. Bounded: 4 kinds x 4 outcomes."""
+        return self.registry.counter(
+            f"{PREFIX}_action_{sanitize_label(kind)}_{sanitize_label(outcome)}",
+            f"autoscale/remediation actions of kind {sanitize_label(kind)} "
+            f"with outcome {sanitize_label(outcome)}")
+
     def tenant_counter(self, tenant: str, kind: str):
         label = sanitize_label(tenant)
         if label not in self._tenant_labels:
@@ -180,10 +201,28 @@ class GatewayMetrics:
         if fleet is not None:
             self.replicas_live.set(fleet.live_count())
             self.replicas_draining.set(fleet.draining_count())
+            self.replicas_active.set(len(fleet.active_ids()))
+            self.replicas_quarantined.set(len(fleet.quarantined_ids()))
             views = fleet.views()
             self._set_cache_gauges(views)
             self._set_role_gauges(views)
+            self._set_cold_start_gauges(views)
         return self.registry.render()
+
+    def _set_cold_start_gauges(self, views) -> None:
+        """Measured per-replica time-to-first-ready (ISSUE 12), from each
+        replica's /health stamp: the number the scale-to-zero wake budget
+        is derived from, exposed so an operator can see what Retry-After a
+        cold fleet will promise. Absent until a replica reports one."""
+        for v in views:
+            if isinstance(v.cold_start_s, (int, float)):
+                self.registry.gauge(
+                    f"{PREFIX}_replica_{sanitize_label(v.id)}"
+                    "_cold_start_seconds",
+                    "measured time-to-first-ready the replica stamped on "
+                    "/health (process start -> port bound) - the "
+                    "scale-to-zero wake-budget input",
+                ).set(round(v.cold_start_s, 3))
 
     def _set_cache_gauges(self, views) -> None:
         """Per-replica + token-weighted fleet prefix-cache hit ratios
@@ -302,6 +341,11 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     # decision flight ring (telemetry/flight.py). Both unarmed by default.
     incidents = None
     flight = None
+    # Actuation plane (ISSUE 12): the autoscale actuator (serves /actions,
+    # answers scale-to-zero demand with a measured wake budget) and the
+    # traffic recorder (--save-trace). Both unarmed by default.
+    actuator = None
+    recorder = None
 
     def log_message(self, *args):
         logger.debug("gateway http: " + args[0], *args[1:])
@@ -421,6 +465,24 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 self._send_json(200, self.slo.report())
         elif path in ("/incidents", "/v1/incidents"):
             self._incidents()
+        elif path in ("/actions", "/v1/actions"):
+            # Actuation log (ISSUE 12): every planned/executed/refused/
+            # failed action with its triggering signal snapshot and the
+            # incident bundle it produced (the /actions-to-incident
+            # cross-link, troubleshooting §30). 404 when the actuation
+            # plane is unarmed — absent != "no actions taken".
+            if self.actuator is None:
+                self._send_json(404, {"error": {"message":
+                    "no autoscale actuator configured"}})
+            else:
+                actions = self.actuator.recent()
+                self._send_json(200, {
+                    "count": len(actions),
+                    "dry_run": bool(self.actuator.config.dry_run),
+                    "wake_budget_s": round(
+                        self.actuator.wake_budget_s(), 3),
+                    "actions": actions,
+                })
         elif path in ("/v1/models", "/models"):
             self._proxy_get("/v1/models")
         else:
@@ -609,6 +671,24 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 return
             m.tenant_counter(label, "admitted").inc()
             pinned_class = decision.slo_class or None
+        if self.recorder is not None:
+            # Traffic recorder (ISSUE 12 satellite): one row per ADMITTED
+            # request — throttled requests never reach here, so the saved
+            # shape is the demand the fleet actually served, replayable
+            # via bench.py --serve-trace-replay with preserved
+            # inter-arrival times. Tenant rides as the credential-safe
+            # digest, never the bearer token.
+            self.recorder.note(
+                tenant=tenant_label(
+                    tenant,
+                    self.admission.per_tenant if self.admission else ()),
+                slo_class=pinned_class or self._client_class(payload),
+                prompt_tokens=prompt_token_estimate(payload),
+                max_new=int(payload.get("max_tokens") or 0)
+                if isinstance(payload.get("max_tokens"), (int, float))
+                else 0,
+                stream=bool(payload.get("stream")),
+            )
         t0 = time.time()
         try:
             self._route_and_relay(path, payload, raw, span=span,
@@ -803,6 +883,30 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 retry_after=self._fleet_retry_after(floor=busy_hint),
             )
         else:
+            if self.actuator is not None:
+                # Cold-start-aware admission (ISSUE 12): nothing routable
+                # but a scale-down parked capacity we can wake — answer
+                # 429 with the MEASURED wake budget as Retry-After (the
+                # client's backoff lands after the replica is up) and let
+                # the planner's wake action bring it back. A plain 503
+                # would teach clients the fleet is broken when it is
+                # merely asleep.
+                retry = self.actuator.note_demand()
+                if retry is not None:
+                    self.gw.registry.counter(
+                        f"{PREFIX}_cold_start_429",
+                        "requests answered 429 with a wake-up Retry-After "
+                        "while serving capacity was parked (scale-to-zero "
+                        "admission)",
+                    ).inc()
+                    self._send_json(
+                        429,
+                        {"error": {"message":
+                                   "fleet scaled to zero; waking a replica",
+                                   "type": "rate_limit_error"}},
+                        retry_after=retry,
+                    )
+                    return
             m.no_replica.inc()
             self._send_json(503, {"error": {
                 "message": "no live replica available"}})
@@ -1047,6 +1151,8 @@ def make_gateway(
     telemetry=None,
     incidents=None,
     flight=None,
+    actuator=None,
+    recorder=None,
 ) -> GatewayHTTPServer:
     """Build (not start) the gateway server over ``fleet`` — tests drive it
     on a thread, ``main`` drives it with ``serve_forever``. ``router``
@@ -1058,7 +1164,11 @@ def make_gateway(
     ``incidents`` (telemetry/incident.IncidentManager) arms the
     /incidents aggregation endpoint and ``flight``
     (telemetry/flight.FlightRecorder) the per-request routing ring
-    (ISSUE 10) — both unarmed by default."""
+    (ISSUE 10) — both unarmed by default. ``actuator``
+    (gateway.autoscale.Actuator) arms the /actions endpoint and the
+    scale-to-zero wake admission; ``recorder``
+    (gateway.autoscale.TrafficRecorder) appends one JSONL row per
+    admitted request (ISSUE 12) — both unarmed by default."""
     config = config or GatewayConfig()
     if router is None:
         router = make_policy(config.router)
@@ -1090,6 +1200,8 @@ def make_gateway(
             "slo": slo,
             "incidents": incidents,
             "flight": flight,
+            "actuator": actuator,
+            "recorder": recorder,
         },
     )
     return GatewayHTTPServer(
@@ -1156,18 +1268,26 @@ def main(argv: list[str] | None = None) -> int:
                         "latency jumps), and all bundles aggregate at the "
                         "gateway's /incidents (each process writes its own "
                         "subdirectory)")
+    parser.add_argument("--save-trace", default="", metavar="PATH",
+                        help="traffic recorder (ISSUE 12): append one "
+                        "JSONL row per admitted request (arrival offset, "
+                        "tenant digest, class, prompt/max_new token "
+                        "estimates) — the shape bench.py "
+                        "--serve-trace-replay replays")
     parser.add_argument("overrides", nargs="*",
                         help="config overrides like gateway.router=affinity "
-                        "gateway.replicas=4 telemetry.slo_ttft_s=0.5")
+                        "gateway.replicas=4 telemetry.slo_ttft_s=0.5 "
+                        "autoscale.enabled=true")
     args = parser.parse_args(argv)
 
     full_config = parse_overrides(
         Config(),
         [o for o in args.overrides
-         if o.startswith(("gateway.", "telemetry."))],
+         if o.startswith(("gateway.", "telemetry.", "autoscale."))],
     )
     config = full_config.gateway
     telemetry_cfg = full_config.telemetry
+    autoscale_cfg = full_config.autoscale
 
     from ditl_tpu.gateway.roles import parse_roles, role_knobs
 
@@ -1253,7 +1373,7 @@ def main(argv: list[str] | None = None) -> int:
     # counter must be honest on unarmed gateways too); only the
     # detectors/bundles gate on --incident-dir.
     gw_metrics = GatewayMetrics()
-    flight = incidents = slo = gw_anomaly = None
+    flight = incidents = slo = gw_anomaly = plane = None
     if args.incident_dir:
         import os as _os
 
@@ -1286,6 +1406,11 @@ def main(argv: list[str] | None = None) -> int:
                 storm_threshold=telemetry_cfg.anomaly_storm_threshold),
             slo=slo, flight=flight,
         )
+    recorder = None
+    if args.save_trace:
+        from ditl_tpu.gateway.autoscale import TrafficRecorder
+
+        recorder = TrafficRecorder(args.save_trace)
     supervisor = None
     server = None
     # One finally covers startup too: a replica that never turns healthy
@@ -1305,10 +1430,26 @@ def main(argv: list[str] | None = None) -> int:
             anomaly=gw_anomaly,
             metrics=gw_metrics,
         )
+        actuator = None
+        if autoscale_cfg.enabled:
+            # Actuation plane (ISSUE 12): planner + actuator riding the
+            # supervisor's poll loop, sharing its fleet-mutation lock,
+            # journal, and — when --incident-dir armed one — the SAME
+            # anomaly plane the detectors feed, so action bundles and
+            # organic bundles land in one tally and one directory.
+            from ditl_tpu.gateway.autoscale import Actuator
+
+            actuator = Actuator(
+                fleet, supervisor, autoscale_cfg,
+                journal=journal, tracer=tracer, metrics=gw_metrics,
+                flight=flight, plane=plane, slo=slo,
+            )
+            supervisor.autoscaler = actuator
         supervisor.start()
         server = make_gateway(fleet, config=config, tracer=tracer,
                               telemetry=telemetry_cfg, metrics=gw_metrics,
-                              slo=slo, incidents=incidents, flight=flight)
+                              slo=slo, incidents=incidents, flight=flight,
+                              actuator=actuator, recorder=recorder)
         stopping = threading.Event()
 
         def _shutdown(signum, frame):
@@ -1335,6 +1476,8 @@ def main(argv: list[str] | None = None) -> int:
         if server is not None:
             server.server_close()
         fleet.stop_all(drain=True, timeout=config.drain_timeout_s)
+        if recorder is not None:
+            recorder.close()
         if journal is not None:
             journal.close()
         if tracer is not None and tracer.journal is not None:

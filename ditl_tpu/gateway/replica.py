@@ -295,6 +295,11 @@ class ReplicaView:
     # /metrics reads these; None on replicas that have served nothing.
     ttft_p95_s: float | None = None
     tpot_p95_s: float | None = None
+    # Measured time-to-first-ready the replica stamped on /health
+    # (ISSUE 12): process start -> port bound, compile cache included.
+    # The autoscale planner's scale-to-zero wake budget is derived from
+    # this, never from a constant. None until the replica reports one.
+    cold_start_s: float | None = None
 
     @property
     def cache_hit_ratio(self) -> float | None:
@@ -331,6 +336,14 @@ class _ReplicaState:
     fails: int = 0
     health: dict = dataclasses.field(default_factory=dict)
     restarts: int = 0
+    # Actuation-plane states (ISSUE 12). ``deactivated``: parked by a
+    # scale-down — stopped on purpose, excluded from routing AND from the
+    # supervisor's recovery (a parked replica must not be "healed" back
+    # up); a scale-up reverses it. ``quarantined``: the crash-loop
+    # breaker — stopped, excluded from supervision, and NOT reversed by
+    # demand (an operator or a fresh launch clears it).
+    deactivated: bool = False
+    quarantined: bool = False
     # Windowed prefix-cache accounting (ISSUE 9): the last observed
     # lifetime (hit, miss) counters and a bounded deque of per-poll
     # deltas. Idle polls append (0, 0), so activity ages out of the window
@@ -456,6 +469,7 @@ class Fleet:
         n_slots = int(h.get("n_slots", 0)) or self.default_capacity
         ttft = h.get("ttft_p95_s")
         tpot = h.get("tpot_p95_s")
+        cold = h.get("cold_start_s")
         return ReplicaView(
             id=st.handle.id,
             address=addr,
@@ -472,15 +486,18 @@ class Fleet:
             role=str(h.get("role") or st.handle.role or "hybrid"),
             ttft_p95_s=float(ttft) if isinstance(ttft, (int, float)) else None,
             tpot_p95_s=float(tpot) if isinstance(tpot, (int, float)) else None,
+            cold_start_s=float(cold) if isinstance(cold, (int, float))
+            else None,
         )
 
     def routable(self, exclude: Sequence[str] = ()) -> list[ReplicaView]:
-        """Live, non-draining replicas (minus ``exclude`` — the ones this
-        request already failed on)."""
+        """Live, non-draining, non-parked replicas (minus ``exclude`` —
+        the ones this request already failed on)."""
         with self._lock:
             views = [
                 self._view(st) for rid, st in self._states.items()
-                if st.live and not st.draining and rid not in exclude
+                if st.live and not st.draining and not st.deactivated
+                and not st.quarantined and rid not in exclude
             ]
         return [v for v in views if v is not None]
 
@@ -527,6 +544,36 @@ class Fleet:
         with self._lock:
             self._states[replica_id].draining = draining
 
+    # -- actuation-plane state (ISSUE 12) -----------------------------------
+
+    def set_deactivated(self, replica_id: str, deactivated: bool) -> None:
+        with self._lock:
+            self._states[replica_id].deactivated = deactivated
+
+    def set_quarantined(self, replica_id: str, quarantined: bool) -> None:
+        with self._lock:
+            self._states[replica_id].quarantined = quarantined
+
+    def active_ids(self) -> list[str]:
+        """Replicas participating in serving (not parked, not
+        quarantined) — the autoscale planner's fleet-size denominator;
+        liveness is separate (a crashed-but-recovering replica is still
+        active)."""
+        with self._lock:
+            return [rid for rid, st in self._states.items()
+                    if not st.deactivated and not st.quarantined]
+
+    def parked_ids(self) -> list[str]:
+        """Scale-down-parked replicas — the scale-up candidate pool."""
+        with self._lock:
+            return [rid for rid, st in self._states.items()
+                    if st.deactivated and not st.quarantined]
+
+    def quarantined_ids(self) -> list[str]:
+        with self._lock:
+            return [rid for rid, st in self._states.items()
+                    if st.quarantined]
+
     def _state(self, replica_id: str) -> _ReplicaState:
         return self._states[replica_id]
 
@@ -556,6 +603,7 @@ class FleetSupervisor:
         log: Callable[[str], None] | None = None,
         anomaly=None,
         metrics=None,
+        autoscaler=None,
     ):
         """``anomaly``: optional telemetry.anomaly.GatewayAnomalyMonitor —
         notified of each replica death (the death-rate detector's input,
@@ -564,7 +612,11 @@ class FleetSupervisor:
         optional GatewayMetrics whose ``replica_deaths`` counter this
         supervisor increments on every death — unconditionally, not gated
         on the anomaly plane, so the /metrics family is honest on
-        unarmed gateways too."""
+        unarmed gateways too. ``autoscaler``: optional
+        gateway.autoscale.Actuator — notified of each death (the
+        quarantine planner's crash-loop input) and polled once per
+        supervision pass (the planner cadence rides the health loop like
+        the anomaly monitor's, ISSUE 12)."""
         self.fleet = fleet
         self.interval_s = interval_s
         self.fail_threshold = fail_threshold
@@ -580,6 +632,22 @@ class FleetSupervisor:
         self._given_up: set[str] = set()
         self.anomaly = anomaly
         self.metrics = metrics
+        self.autoscaler = autoscaler
+        # THE fleet-mutation lock (ISSUE 12 satellite): crash recovery,
+        # rolling restarts, and autoscale/remediation actuation each
+        # change fleet membership over seconds (drain -> stop -> start ->
+        # await-healthy); before this lock a relaunch racing a concurrent
+        # membership change was only safe by luck of thread timing. Every
+        # mutation cycle — _recover, rolling_restart's per-replica leg,
+        # and gateway.autoscale.Actuator.apply (which shares this very
+        # Lock object) — runs start-to-finish under it. Held across
+        # await-healthy on purpose: a half-started replica is exactly the
+        # state a concurrent mutation must not observe.
+        self.fleet_lock = threading.Lock()
+        # Which replica the current mutation cycle is changing ("" =
+        # none) — the lock-discipline-enforced witness that every
+        # membership mutation path actually holds fleet_lock.
+        self._mutating = ""  # guarded-by: fleet_lock
 
     def journal_event(self, event: str, **attrs) -> None:
         if self._journal is not None:
@@ -618,6 +686,11 @@ class FleetSupervisor:
                 # and SLO burn evaluation ride it (the monitor rate-limits
                 # itself and never raises).
                 self.anomaly.poll()
+            if self.autoscaler is not None:
+                # Actuation cadence (ISSUE 12): plan + apply once per
+                # supervision pass, against the health state this pass
+                # just refreshed. The actuator never raises.
+                self.autoscaler.poll()
 
     def poll_once(self) -> None:
         for rid in self.fleet.ids:
@@ -625,6 +698,12 @@ class FleetSupervisor:
                 return
             st = self.fleet._state(rid)
             if st.restarting or rid in self._given_up:
+                continue
+            if st.deactivated or st.quarantined:
+                # Parked/quarantined replicas are DOWN ON PURPOSE: probing
+                # them would count failures, and recovering them would
+                # undo the action that parked them (the actuator owns
+                # their lifecycle).
                 continue
             self.fleet.probe(rid, timeout=self.probe_timeout_s)
             dead = (not st.handle.alive()) or st.fails >= self.fail_threshold
@@ -645,53 +724,93 @@ class FleetSupervisor:
     def _recover(self, rid: str) -> None:
         """Run one died -> drain -> relaunch -> re-admit cycle. The caller
         (poll_once / tests) sets ``st.restarting`` BEFORE invoking so the
-        poll loop cannot double-recover; this method clears it."""
+        poll loop cannot double-recover; this method clears it. The whole
+        cycle runs under the fleet-mutation lock, serialized against
+        rolling restarts and autoscale actuation."""
         st = self.fleet._state(rid)
         try:
-            if st.restarts >= self.max_restarts_per_replica:
-                self._log(f"replica {rid}: restart budget exhausted "
-                          f"({st.restarts}); leaving dead")
-                st.live = False
-                self._given_up.add(rid)
-                return
-            st.live = False
-            self.journal_event("replica.died", replica=rid,
-                              fails=st.fails,
-                              process_alive=st.handle.alive())
-            if self.metrics is not None:
-                self.metrics.replica_deaths.inc()
-            if self.anomaly is not None:
-                # Death-rate input (ISSUE 10): one crash self-heals; a
-                # crash loop crosses the detector's windowed threshold and
-                # becomes an incident bundle.
-                self.anomaly.note_replica_death(rid)
-            self._log(f"replica {rid}: died (failed health checks: "
-                      f"{st.fails}); draining routing")
-            # Drain: routing already stopped (live=False); anything still
-            # in flight on the gateway side fails over via its retry path.
-            self.fleet.mark_draining(rid, True)
-            self.journal_event("replica.drain", replica=rid)
-            st.restarts += 1
-            self.journal_event("replica.relaunch", replica=rid,
-                              attempt=st.restarts)
-            self._log(f"replica {rid}: relaunching "
-                      f"(attempt {st.restarts})")
-            st.handle.restart()
-            if self._await_healthy(rid):
-                st.fails = 0
-                self.fleet.mark_draining(rid, False)
-                self.journal_event("replica.readmit", replica=rid,
-                                  address=list(st.handle.address or ()))
-                self._log(f"replica {rid}: healthy again; re-admitted")
-            else:
-                self.journal_event("replica.restart_failed", replica=rid,
-                                  attempt=st.restarts)
-                self._log(f"replica {rid}: relaunch did not become healthy "
-                          f"within {self.restart_timeout_s:.0f}s")
-                # fails stays >= threshold: next poll retries recovery.
-                st.fails = max(st.fails, self.fail_threshold)
+            with self.fleet_lock:
+                self._mutating = rid
+                try:
+                    self._recover_cycle_locked(rid, st)
+                finally:
+                    self._mutating = ""
         finally:
             st.restarting = False
+
+    def _recover_cycle_locked(self, rid: str, st: _ReplicaState) -> None:
+        """The recovery cycle proper; caller holds ``fleet_lock``."""
+        if st.deactivated or st.quarantined:
+            # The replica was parked/quarantined while this recovery
+            # waited on the fleet-mutation lock (a scale-down racing a
+            # kill): it is down ON PURPOSE now — relaunching it would
+            # undo the action that won the lock first.
+            self._log(f"replica {rid}: parked/quarantined while awaiting "
+                      "recovery; leaving down")
+            return
+        if st.restarts >= self.max_restarts_per_replica:
+            self._log(f"replica {rid}: restart budget exhausted "
+                      f"({st.restarts}); leaving dead")
+            st.live = False
+            self._given_up.add(rid)
+            return
+        st.live = False
+        self.journal_event("replica.died", replica=rid,
+                          fails=st.fails,
+                          process_alive=st.handle.alive())
+        if self.metrics is not None:
+            self.metrics.replica_deaths.inc()
+        if self.anomaly is not None:
+            # Death-rate input (ISSUE 10): one crash self-heals; a
+            # crash loop crosses the detector's windowed threshold and
+            # becomes an incident bundle.
+            self.anomaly.note_replica_death(rid)
+        if self.autoscaler is not None:
+            # Quarantine input (ISSUE 12): the planner's per-replica death
+            # window — past the threshold it plans the quarantine that
+            # breaks the crash loop this recovery would otherwise feed.
+            self.autoscaler.note_death(rid)
+        self._log(f"replica {rid}: died (failed health checks: "
+                  f"{st.fails}); draining routing")
+        # Drain: routing already stopped (live=False); anything still
+        # in flight on the gateway side fails over via its retry path.
+        self.fleet.mark_draining(rid, True)
+        self.journal_event("replica.drain", replica=rid)
+        st.restarts += 1
+        self.journal_event("replica.relaunch", replica=rid,
+                          attempt=st.restarts)
+        self._log(f"replica {rid}: relaunching "
+                  f"(attempt {st.restarts})")
+        st.handle.restart()
+        if self._await_healthy(rid):
+            st.fails = 0
+            self.fleet.mark_draining(rid, False)
+            self.journal_event("replica.readmit", replica=rid,
+                              address=list(st.handle.address or ()))
+            self._log(f"replica {rid}: healthy again; re-admitted")
+        else:
+            self.journal_event("replica.restart_failed", replica=rid,
+                              attempt=st.restarts)
+            self._log(f"replica {rid}: relaunch did not become healthy "
+                      f"within {self.restart_timeout_s:.0f}s")
+            # fails stays >= threshold: next poll retries recovery.
+            st.fails = max(st.fails, self.fail_threshold)
+
+    def drain_stop_locked(self, rid: str, st: _ReplicaState,
+                          timeout_s: float) -> None:
+        """Graceful stop of one replica whose routing has already been
+        cut (draining/parked): wait for the gateway's own in-flight
+        proxies to clear — the replica-side ``close(drain=True)`` then
+        has nothing (or only direct clients) to wait on — then stop it.
+        Caller holds ``fleet_lock``; the ONE drain-stop spelling shared
+        by rolling restarts and the autoscale actuator's scale-down and
+        drain paths."""
+        deadline = time.monotonic() + timeout_s
+        while (self.fleet.outstanding(rid) > 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        st.handle.stop(drain=True, timeout=timeout_s)
+        st.live = False
 
     def _await_healthy(self, rid: str) -> bool:
         deadline = time.monotonic() + self.restart_timeout_s
@@ -708,42 +827,61 @@ class FleetSupervisor:
         drain (gateway stops routing to it; in-flight work finishes inside
         the replica's own ``close(drain=True)``), relaunch, wait healthy,
         re-admit — then the next replica. Requires >= 2 replicas to be
-        zero-downtime (the rest of the fleet absorbs the traffic)."""
+        zero-downtime (the rest of the fleet absorbs the traffic). Each
+        per-replica leg runs under the fleet-mutation lock, serialized
+        against crash recovery and autoscale actuation (a scale-up landing
+        mid-rolling-restart waits its turn instead of racing the drain)."""
         for rid in self.fleet.ids:
             st = self.fleet._state(rid)
+            if st.deactivated or st.quarantined:
+                # Parked/quarantined replicas are down on purpose; a
+                # rolling restart must not resurrect them.
+                continue
             st.restarting = True  # the poll loop must not double-recover
             try:
-                self.fleet.mark_draining(rid, True)
-                self.journal_event("replica.drain", replica=rid,
-                                  rolling=True)
-                self._log(f"rolling restart: draining {rid}")
-                # Wait for the gateway's own in-flight proxies to clear;
-                # the replica-side close(drain=True) below then has nothing
-                # (or only direct clients) to wait on.
-                deadline = time.monotonic() + drain_timeout_s
-                while (self.fleet.outstanding(rid) > 0
-                       and time.monotonic() < deadline):
-                    time.sleep(0.05)
-                st.handle.stop(drain=True, timeout=drain_timeout_s)
-                st.live = False
-                # A planned restart does NOT consume the crash-restart
-                # budget (max_restarts_per_replica guards crash LOOPS);
-                # nightly rolling restarts must never leave a replica
-                # permanently dead on its first real failure.
-                self.journal_event("replica.relaunch", replica=rid,
-                                  rolling=True)
-                st.handle.start()
-                if not self._await_healthy(rid):
-                    self.journal_event("replica.restart_failed",
-                                      replica=rid, rolling=True)
-                    raise TimeoutError(
-                        f"rolling restart: {rid} not healthy within "
-                        f"{self.restart_timeout_s:.0f}s"
-                    )
-                st.fails = 0
-                self.fleet.mark_draining(rid, False)
-                self.journal_event("replica.readmit", replica=rid,
-                                  rolling=True)
-                self._log(f"rolling restart: {rid} re-admitted")
+                with self.fleet_lock:
+                    self._mutating = rid
+                    try:
+                        self._rolling_one_locked(rid, st, drain_timeout_s)
+                    finally:
+                        self._mutating = ""
             finally:
                 st.restarting = False
+
+    def _rolling_one_locked(self, rid: str, st: _ReplicaState,
+                            drain_timeout_s: float) -> None:
+        """One replica's drain -> restart -> re-admit leg; caller holds
+        ``fleet_lock``."""
+        if st.deactivated or st.quarantined:
+            # Parked/quarantined while this leg WAITED on the lock (an
+            # autoscale action won it first): down on purpose now —
+            # restarting it would leave a running process the fleet
+            # believes is parked. Same re-check _recover_cycle_locked
+            # makes.
+            self._log(f"rolling restart: {rid} parked/quarantined while "
+                      "awaiting the lock; skipping")
+            return
+        self.fleet.mark_draining(rid, True)
+        self.journal_event("replica.drain", replica=rid,
+                          rolling=True)
+        self._log(f"rolling restart: draining {rid}")
+        self.drain_stop_locked(rid, st, drain_timeout_s)
+        # A planned restart does NOT consume the crash-restart
+        # budget (max_restarts_per_replica guards crash LOOPS);
+        # nightly rolling restarts must never leave a replica
+        # permanently dead on its first real failure.
+        self.journal_event("replica.relaunch", replica=rid,
+                          rolling=True)
+        st.handle.start()
+        if not self._await_healthy(rid):
+            self.journal_event("replica.restart_failed",
+                              replica=rid, rolling=True)
+            raise TimeoutError(
+                f"rolling restart: {rid} not healthy within "
+                f"{self.restart_timeout_s:.0f}s"
+            )
+        st.fails = 0
+        self.fleet.mark_draining(rid, False)
+        self.journal_event("replica.readmit", replica=rid,
+                          rolling=True)
+        self._log(f"rolling restart: {rid} re-admitted")

@@ -414,6 +414,14 @@ class _Handler(BaseHTTPRequestHandler):
                 # tag.
                 "role": self.role,
             }
+            # Measured cold start (ISSUE 12): time-to-first-ready stamped
+            # by serve() (process start -> port bound, compile cache
+            # included). The gateway's autoscale planner derives its
+            # scale-to-zero wake budget from this MEASURED value, never a
+            # constant; absent on embedded servers that never stamped one.
+            cold = getattr(self.server, "cold_start_s", None)
+            if isinstance(cold, (int, float)):
+                payload["cold_start_s"] = round(float(cold), 3)
             payload.update(self._load_snapshot())
             # Latency snapshot for the gateway's per-role TTFT/TPOT
             # aggregation (ISSUE 9): lifetime histogram p95s, present only
@@ -1640,6 +1648,7 @@ def make_server(
     role: str = "hybrid",
     incidents=None,
     serving_metrics: ServingMetrics | None = None,
+    cold_start_s: float | None = None,
 ) -> DrainableHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
@@ -1704,10 +1713,20 @@ def make_server(
             "incidents": incidents,
         },
     )
-    return DrainableHTTPServer((host, port), handler)
+    server = DrainableHTTPServer((host, port), handler)
+    if cold_start_s is not None:
+        # Measured time-to-first-ready (ISSUE 12): echoed on /health so
+        # the gateway's scale-to-zero wake budget uses a measured number.
+        server.cold_start_s = float(cold_start_s)
+    return server
 
 
 def serve(argv: list[str] | None = None) -> int:
+    # Cold-start clock (ISSUE 12): time-to-first-ready measured from here
+    # (before the jax import below — that import and the engine build ARE
+    # the cold start; the persistent compile cache is what shrinks it on a
+    # warm start) to the moment the listening server is built.
+    t_serve_start = time.monotonic()
     import jax
 
     from ditl_tpu.data.tokenizer import get_tokenizer
@@ -2211,6 +2230,7 @@ def serve(argv: list[str] | None = None) -> int:
         max_pending=args.max_pending or None,
         tracer=tracer, telemetry=telemetry_cfg, role=args.role,
         slo=slo, incidents=incidents, serving_metrics=serving_metrics,
+        cold_start_s=time.monotonic() - t_serve_start,
     )
 
     # SIGTERM = graceful drain (the gateway/orchestrator rolling-restart

@@ -57,8 +57,12 @@ class CatalogEntry:
 _NORMALIZE_RULES: tuple[tuple[re.Pattern, str], ...] = (
     (re.compile(r"^(ditl_gateway_replica_)(?!deaths_total$)(.+?)_"
                 r"(routed_total|retried_total|"
-                r"recent_prefix_cache_hit_ratio|prefix_cache_hit_ratio)$"),
+                r"recent_prefix_cache_hit_ratio|prefix_cache_hit_ratio|"
+                r"cold_start_seconds)$"),
      r"\1<id>_\3"),
+    (re.compile(r"^(ditl_gateway_action_)(.+?)_"
+                r"(planned|executed|refused|failed|dry_run)(_total)$"),
+     r"\1<kind>_\3\4"),
     (re.compile(r"^(ditl_gateway_tenant_)(.+?)_"
                 r"(admitted_total|throttled_total)$"),
      r"\1<tenant>_\3"),
@@ -94,8 +98,14 @@ _ROWS: tuple = (
     ("ditl_gateway_429_by_class_best_effort_total", "counter", "", "requests 429 carrying SLO class best_effort"),
     ("ditl_gateway_429_by_class_default_total", "counter", "", "requests 429 carrying SLO class default"),
     ("ditl_gateway_429_by_class_interactive_total", "counter", "", "requests 429 carrying SLO class interactive"),
+    ("ditl_gateway_action_<kind>_dry_run_total", "counter", "action kind (scale_up/scale_down/drain/quarantine)", "autoscale/remediation actions planned-but-logged under autoscale.dry_run", True),
+    ("ditl_gateway_action_<kind>_executed_total", "counter", "action kind (scale_up/scale_down/drain/quarantine)", "autoscale/remediation actions executed against the fleet", True),
+    ("ditl_gateway_action_<kind>_failed_total", "counter", "action kind (scale_up/scale_down/drain/quarantine)", "autoscale/remediation actions that failed mid-execution (also incident-bundled)", True),
+    ("ditl_gateway_action_<kind>_planned_total", "counter", "action kind (scale_up/scale_down/drain/quarantine)", "autoscale/remediation actions the planner produced", True),
+    ("ditl_gateway_action_<kind>_refused_total", "counter", "action kind (scale_up/scale_down/drain/quarantine)", "autoscale/remediation actions refused at execute time (bounds/state re-check under the fleet-mutation lock)", True),
     ("ditl_gateway_affinity_hits_total", "counter", "", "requests routed to the same replica as the previous request with the same affinity key"),
     ("ditl_gateway_affinity_misses_total", "counter", "", "requests whose affinity key landed on a different replica than last time"),
+    ("ditl_gateway_cold_start_429_total", "counter", "", "requests answered 429 with a wake-up Retry-After while serving capacity was parked (scale-to-zero admission)", True),
     ("ditl_gateway_fleet_prefix_cache_hit_ratio", "gauge", "", "token-weighted fleet prefix-cache hit ratio - compare against the affinity hit-rate counters"),
     ("ditl_gateway_fleet_recent_prefix_cache_hit_ratio", "gauge", "", "token-weighted fleet prefix-cache hit ratio over the recent health-poll window"),
     ("ditl_gateway_fleet_saturated_total", "counter", "", "requests 429'd because every replica was saturated"),
@@ -105,13 +115,16 @@ _ROWS: tuple = (
     ("ditl_gateway_relayed_by_class_best_effort_total", "counter", "", "requests relayed carrying SLO class best_effort"),
     ("ditl_gateway_relayed_by_class_default_total", "counter", "", "requests relayed carrying SLO class default"),
     ("ditl_gateway_relayed_by_class_interactive_total", "counter", "", "requests relayed carrying SLO class interactive"),
+    ("ditl_gateway_replica_<id>_cold_start_seconds", "gauge", "replica id", "measured time-to-first-ready the replica stamped on /health - the scale-to-zero wake-budget input", True),
     ("ditl_gateway_replica_<id>_prefix_cache_hit_ratio", "gauge", "replica id", "measured engine prefix-cache hit ratio of replica r0 (lifetime, from its last health poll)"),
     ("ditl_gateway_replica_<id>_recent_prefix_cache_hit_ratio", "gauge", "replica id", "windowed (last few health polls) prefix-cache hit ratio of replica r0 - the spill-steering input"),
     ("ditl_gateway_replica_<id>_retried_total", "counter", "replica id", "requests retried for replica r0"),
     ("ditl_gateway_replica_<id>_routed_total", "counter", "replica id", "requests routed for replica r0"),
     ("ditl_gateway_replica_deaths_total", "counter", "", "replica died->drain->relaunch cycles the supervisor ran (the anomaly plane's death-rate input, ISSUE 10)"),
+    ("ditl_gateway_replicas_active", "gauge", "", "replicas participating in serving (not parked by a scale-down, not quarantined)"),
     ("ditl_gateway_replicas_draining", "gauge", "", "replicas currently draining"),
     ("ditl_gateway_replicas_live", "gauge", "", "replicas currently routable"),
+    ("ditl_gateway_replicas_quarantined", "gauge", "", "replicas quarantined by death-storm remediation"),
     ("ditl_gateway_request_e2e_seconds", "histogram", "", "gateway receive -> response relayed"),
     ("ditl_gateway_requests_completed_total", "counter", "", "requests relayed to completion"),
     ("ditl_gateway_requests_total", "counter", "", "requests received by the gateway"),

@@ -42,6 +42,7 @@ import time
 from ditl_tpu.annotations import hot_path
 
 __all__ = [
+    "ACTION_RING",
     "FLIGHT_SCHEMA",
     "LIVENESS_RING",
     "ROUTING_RING",
@@ -59,6 +60,11 @@ TICK_RING = "engine_tick"
 ROUTING_RING = "gateway_routing"
 STEP_RING = "train_step"
 LIVENESS_RING = "pod_liveness"
+# Autoscale/remediation actions (ISSUE 12): one row when an action is
+# planned and one per terminal outcome (executed/refused/failed/dry_run),
+# each carrying the triggering signal snapshot — the black-box record that
+# makes a bad remediation as diagnosable as the failure it chased.
+ACTION_RING = "supervisor_action"
 
 DEFAULT_CAPACITY = 512
 

@@ -59,16 +59,26 @@ COMPARE_KEYS = {
     # gates (batch p95s are reported context, not regressions).
     "interactive_interference_p95_s": -1,
     "interactive_ttft_p95_s": -1,
+    # Autoscaler A/B keys (ISSUE 12, bench --serve-trace-replay rows'
+    # hoisted `autoscale` block): replica_seconds is the resource cost the
+    # autoscaler exists to cut (regresses when it RISES — the on-vs-off
+    # A/B gates it next to the ttft_p95_s already above); the interactive
+    # TTFT-SLO violation rate regresses when it rises (scaling must not
+    # buy replica-seconds with burned SLO budget).
+    "replica_seconds": -1,
+    "ttft_slo_violation_rate": -1,
 }
 
 
 def _flat(rec: dict) -> dict:
     """The comparable view of one record/cell: top-level keys plus the
-    nested ``roofline`` (train rows) and ``serving`` (serve rows) blocks
-    hoisted — without the hoist the gate would silently never compare
-    cost-counted MFU or the serving scheduler metrics."""
+    nested ``roofline`` (train rows), ``serving`` (serve rows), and
+    ``autoscale`` (trace-replay rows) blocks hoisted — without the hoist
+    the gate would silently never compare cost-counted MFU, the serving
+    scheduler metrics, or the replica-seconds the autoscaler A/B is
+    graded on."""
     out = rec
-    for block in ("roofline", "serving"):
+    for block in ("roofline", "serving", "autoscale"):
         nested = rec.get(block)
         if isinstance(nested, dict):
             out = {**nested, **out}

@@ -54,7 +54,8 @@ from ditl_tpu.telemetry.registry import (
 
 __all__ = ["SLO_CLASS_NAMES", "ServingMetrics", "backlog_retry_after",
            "flattened_stats_lines", "merged_histogram",
-           "serving_bench_summary", "snapshot_serving"]
+           "serving_bench_summary", "snapshot_serving",
+           "ttft_slo_violation_rate"]
 
 
 def flattened_stats_lines(stats: dict, reserved: frozenset | set = frozenset(),
@@ -383,3 +384,35 @@ def serving_bench_summary(bundles: Sequence["ServingMetrics"],
     if hit + miss > 0:
         out["prefix_cache_hit_ratio"] = round(hit / (hit + miss), 4)
     return out
+
+
+def ttft_slo_violation_rate(bundles: Sequence["ServingMetrics"],
+                            threshold_s: float,
+                            since: dict | None = None,
+                            slo_class: str = "interactive") -> float | None:
+    """Fraction of timed-region TTFT observations ABOVE ``threshold_s``
+    (the threshold snaps DOWN to the histogram ladder, the /slo
+    convention) — the "interactive SLO burn" number the autoscaler A/B
+    row embeds and perf_compare gates (ISSUE 12): scaling down must not
+    buy replica-seconds with burned TTFT budget. Computed over the
+    ``slo_class`` split by default (unclassed requests schedule — and
+    record — as interactive, so they are covered; batch work has no TTFT
+    SLO and must not mask or trip the gate); pass ``slo_class=None`` for
+    the all-class rate. ``since`` is a :func:`snapshot_serving`
+    restricting to the timed region; None when nothing was observed
+    (absent != 0)."""
+    if slo_class is None:
+        ttft = merged_histogram([b.ttft for b in bundles])
+        if since is not None:
+            _subtract(ttft, since["ttft"])
+    else:
+        ttft = merged_histogram([b.ttft_by_class[slo_class]
+                                 for b in bundles])
+        if since is not None:
+            _subtract(ttft, since["ttft_by_class"][slo_class])
+    if ttft.count <= 0:
+        return None
+    good, effective = ttft.count_le(threshold_s)
+    if effective is None:
+        return None
+    return round(1.0 - good / ttft.count, 4)

@@ -143,6 +143,14 @@ class BurnRateMonitor:
                 break
         return base
 
+    def any_alerting(self) -> bool:
+        """True while ANY objective's multi-window alert is firing, as of
+        the last report(). The autoscale planner reads this (ISSUE 12): a
+        burning fleet must not be scaled down on a momentarily quiet
+        pressure signal."""
+        with self._lock:
+            return any(self._alerting.values())
+
     def report(self, now: float | None = None) -> dict:
         """Sample, then render the full burn-rate evaluation (the ``/slo``
         endpoint's JSON body)."""

@@ -334,6 +334,13 @@ def test_gateway_retries_on_draining_replica_and_relays():
             status, _, out = _post(port, {"prompt": "hi", "max_tokens": 1})
             assert status == 200
             assert out["choices"][0]["text"] == "r1"
+        # The handler increments `completed` AFTER relaying the response
+        # bytes, so the client can observe its completion a scheduler
+        # quantum before the counter moves — poll briefly instead of
+        # racing the handler thread.
+        deadline = time.monotonic() + 5
+        while metrics.completed.value < 4 and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert metrics.completed.value == 4
     finally:
         server.shutdown()
