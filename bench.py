@@ -523,12 +523,16 @@ def bench_infer(engine: str = "lockstep", cache: str = "contiguous",
             if cache == "paged":
                 from ditl_tpu.infer.paged_cache import PageAllocator
 
-                # Keep the eviction counter wired (ISSUE 8): the engine's
-                # constructor hooks it, and a bare replacement would
-                # silently zero evictions in the row's telemetry snapshot.
+                # Keep the eviction callback wired (ISSUE 8/13): the
+                # engine's constructor hooks it, and a bare replacement
+                # would silently zero evictions in the row's telemetry
+                # snapshot (and unhook the host-tier spill path).
                 eng.allocator = PageAllocator(
-                    eng.n_pages,
-                    on_evict=eng.metrics.prefix_cache_evictions.inc,
+                    eng.n_pages, on_evict=eng._on_pages_evicted,
+                    group_payload=lambda eng=eng: (
+                        eng.host_tier is not None
+                        or bool(eng._handoff_pids)
+                    ),
                 )
                 eng._table[:] = 0
                 eng._slot_pages = [[] for _ in range(eng.n_slots)]
@@ -632,6 +636,10 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
                       token_budget: int = -1,
                       roles: str = "",
                       mixed_trace: bool = False,
+                      host_tier_mb: float = 0.0,
+                      kv_handoff: bool = False,
+                      kvtier_overrides: dict | None = None,
+                      journal_dir: str = "",
                       _model_overrides: dict | None = None) -> dict:
     """Fleet-level serving benchmark (ISSUE 4 satellite): N in-process
     continuous-engine replicas behind the gateway, driven over real HTTP
@@ -648,7 +656,19 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     disagg-vs-homogeneous A/B workload; the row then carries per-class
     TTFT/interference p95s (perf_compare-gated on the interactive pair),
     the worst single interactive interference observation, ``fleet_roles``
-    and per-role serving sub-blocks. ``_model_overrides`` shrinks the bench
+    and per-role serving sub-blocks.
+
+    ``host_tier_mb`` (ISSUE 13) arms each engine's host-RAM prefix-cache
+    tier — the on-vs-off pair on a working set sized past the HBM pool is
+    THE tier A/B (the serving block's hit ratio + host_tier_hit_ratio /
+    swap_in_p95_s gate it); ``kv_handoff`` arms the /internal KV endpoints
+    on every replica and the gateway's transfer-cost-model orchestration
+    (``kvtier_overrides`` tunes the KVTierConfig floors; ``journal_dir``
+    records the per-request ``kv.handoff.*`` decision events), and the row
+    gains a schema-stamped ``kv_handoff`` block with the fallback ratio
+    perf_compare gates.
+
+    ``_model_overrides`` shrinks the bench
     model (tier-1 acceptance drills only — a published row must not use
     it)."""
     import dataclasses
@@ -759,6 +779,9 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
             n_pages=int(k["pages_scale"] * (k["n_slots"] * maxp + 1)),
             prefill_chunk=k["prefill_chunk"],
             token_budget=k["token_budget"],
+            host_tier_mb=host_tier_mb,
+            spill_max_pages_per_tick=(kvtier_overrides or {}).get(
+                "spill_max_pages_per_tick", 32),
             tracer=tracers[i],
         ))
         for i, k in enumerate(knob_list)
@@ -768,7 +791,8 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         # make_server derives its tracer from the engine's, so replica
         # server.request spans land in the same per-replica journal.
         return lambda: make_server(shared_gen, port=0, threaded_engine=eng,
-                                   default_max_tokens=max_new, role=role)
+                                   default_max_tokens=max_new, role=role,
+                                   kv_handoff=kv_handoff)
 
     fleet = Fleet([
         InProcessReplica(f"r{i}", factory(eng, role_list[i]),
@@ -781,8 +805,25 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
     # would swallow the unique suffix whenever plen < 32 (the CPU smoke),
     # making every key distinct and the affinity A/B meaningless.
     gwcfg = GatewayConfig(router=router, affinity_prefix_tokens=plen)
+    kvtier_cfg = None
+    gw_journal = None
+    if kv_handoff:
+        from ditl_tpu.config import KVTierConfig
+        from ditl_tpu.telemetry.journal import EventJournal
+
+        kvtier_cfg = KVTierConfig(
+            handoff=True, **(kvtier_overrides or {})
+        )
+        if journal_dir:
+            import os as _os
+
+            gw_journal = EventJournal(
+                _os.path.join(journal_dir, "events-gateway-kv.jsonl"),
+                source="gateway",
+            )
     server = make_gateway(fleet, config=gwcfg, metrics=metrics, port=0,
-                          tracer=gw_tracer)
+                          tracer=gw_tracer, kvtier=kvtier_cfg,
+                          journal=gw_journal)
     import threading
 
     threading.Thread(target=server.serve_forever, daemon=True).start()
@@ -935,6 +976,7 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
             "prefill_chunk": prefill_chunk,
             "token_budget": token_budget,
             "page_size": page_size,
+            "host_tier_mb": host_tier_mb,
             "max_tick_prefill_tokens": max(
                 eng._engine.max_tick_prefill_tokens for eng in engines
             ),
@@ -971,11 +1013,29 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         **_chaos_result(),
         **_incident_result(_inc0),
     }
+    if kv_handoff:
+        # KV handoff block (ISSUE 13), schema-stamped like the PR 8
+        # serving block; perf_compare hoists it and gates the fallback
+        # ratio (shipped prefills failing back to re-prefill burn work).
+        attempted = summary.get("ditl_gateway_handoff_attempted", 0)
+        fallback = summary.get("ditl_gateway_handoff_fallback", 0)
+        row["kv_handoff"] = {
+            "schema": 1,
+            "attempted": attempted,
+            "shipped": summary.get("ditl_gateway_handoff_shipped", 0),
+            "declined": summary.get("ditl_gateway_handoff_declined", 0),
+            "fallback": fallback,
+            "handoff_fallback_ratio": (
+                round(fallback / attempted, 4) if attempted else 0.0
+            ),
+        }
     server.shutdown()
     server.server_close()
     fleet.stop_all(drain=True, timeout=10.0)
     for eng in engines:
         eng.close()
+    if gw_journal is not None:
+        gw_journal.close()
     return row
 
 
@@ -1796,6 +1856,20 @@ if __name__ == "__main__":
                         "short streams — the disagg-vs-homogeneous A/B "
                         "workload; the row gains per-class TTFT/interference "
                         "p95s (interactive pair perf_compare-gated)")
+    parser.add_argument(
+        "--serve-host-tier-mb", type=float, default=0.0,
+        help="arm each replica engine's host-RAM prefix-cache tier "
+        "(ISSUE 13) at this capacity; run the same seeded trace with 0 "
+        "for the off leg of the tier A/B (perf_compare gates the serving "
+        "block's hit ratio + swap_in_p95_s)",
+    )
+    parser.add_argument(
+        "--serve-kv-handoff", action="store_true",
+        help="arm prefill->decode KV handoff (ISSUE 13): replicas serve "
+        "the /internal KV endpoints and the gateway ships eligible "
+        "prefills per its transfer-cost model; the row gains a "
+        "schema-stamped kv_handoff block (fallback ratio gated)",
+    )
     parser.add_argument("--serve-trace-replay", default="", metavar="PATH",
                         help="with --infer --serve-replicas: replay a "
                         "recorded traffic trace (gateway --save-trace "
@@ -1867,6 +1941,8 @@ if __name__ == "__main__":
             token_budget=args.serve_token_budget,
             roles=args.serve_roles,
             mixed_trace=args.serve_mixed_trace,
+            host_tier_mb=args.serve_host_tier_mb,
+            kv_handoff=args.serve_kv_handoff,
         ))
     if args.infer:
         sys.exit(bench_infer(

@@ -72,7 +72,7 @@ ACTIONS = ("delay", "error", "corrupt", "hang", "kill")
 # site supports them; `corrupt` must be APPLIED by the seam (only it knows
 # what "corrupt" means for its data), so a corrupt rule anywhere else would
 # journal an injection that never happened — rejected at parse time.
-CORRUPT_SITES = frozenset({"data.batch", "ckpt.save"})
+CORRUPT_SITES = frozenset({"data.batch", "ckpt.save", "kvtier.swap_in"})
 
 # Seams that consult the plane with a `step=` value. A `step=` trigger
 # anywhere else compares against None and silently never fires — the same
@@ -108,6 +108,19 @@ SITES = {
                          "executes (delay = widen the race window against "
                          "crash recovery / rolling restarts; error = a "
                          "failed actuation -> action.failed outcome)",
+    "kvtier.spill": "infer/continuous.py: before the per-tick host-tier "
+                    "spill batch (error = batch dropped and counted — the "
+                    "pages simply re-prefill on their next miss; kill = a "
+                    "real death mid-spill)",
+    "kvtier.swap_in": "infer/continuous.py: before a host-tier swap-in at "
+                      "admission (corrupt = bit-flip the stored entry — "
+                      "the crc must detect, drop, and count it, never "
+                      "serve it; error = treated as a tier miss, the "
+                      "admission prefills)",
+    "kv.handoff": "gateway/gateway.py: the prefill->decode KV handoff "
+                  "orchestration on the relay leg (error/delay = a lost or "
+                  "slow handoff leg -> fallback to plain relay and "
+                  "re-prefill with zero client-visible failures)",
 }
 
 

@@ -681,6 +681,75 @@ class AutoscaleConfig:
 
 
 @dataclass(frozen=True)
+class KVTierConfig:
+    """KV movement plane (ISSUE 13): the host-RAM prefix-cache tier
+    (infer/host_tier.py — LRU-evicted published pages spill to pinned host
+    memory and swap back in on admission miss) and the prefill->decode KV
+    handoff (infer/kv_transfer.py + the gateway orchestration — a
+    ``prefill_heavy`` replica's finished prefill ships to the decode
+    replica the router already chose, gated by a measured transfer-cost
+    model). Both are off by default: the fleet behaves exactly as before
+    until armed."""
+
+    # Host-RAM tier capacity in MiB per replica engine (0 = off). Sizes
+    # the effective shared-prefix working set BEYOND the HBM page pool —
+    # the knob that used to be a hardware constant.
+    host_tier_mb: int = 0
+    # Per-tick cap on pages moved device->host by the spill batch (bounds
+    # the one batched device_get a tick pays; the remainder carries over).
+    spill_max_pages_per_tick: int = 32
+    # Arm prefill->decode KV handoff on the gateway's relay leg.
+    handoff: bool = False
+    # Cost-model floors. Prompts below handoff_min_prompt_tokens never
+    # handoff (re-prefill wins for short prompts and the model must say
+    # so); the bandwidth/throughput floors seed the model before any
+    # replica has MEASURED device_put MB/s (/health kv_put_mbps) or
+    # prefill tok/s (/health prefill_tok_per_s); handoff_overhead_s is the
+    # per-handoff fixed cost (two intra-host HTTP hops + serialize).
+    handoff_min_prompt_tokens: int = 256
+    put_bw_floor_mbps: float = 100.0
+    prefill_tps_floor: float = 500.0
+    handoff_overhead_s: float = 0.01
+    # The gateway cannot tokenize (it is jax- and tokenizer-free), but the
+    # floors above are denominated in MODEL tokens: its estimate is
+    # max(whitespace words, prompt chars / est_chars_per_token). ~4 fits
+    # BPE-style subword vocabularies; byte-level tokenizers want 1.0 (one
+    # token per char). Calibrate against the decision journal's estimates
+    # vs the replicas' measured /health numbers (troubleshooting §31).
+    est_chars_per_token: float = 4.0
+    # Wall-clock bound on each handoff leg (prefill export + import POST);
+    # past it the gateway falls back to plain relay (re-prefill).
+    handoff_timeout_s: float = 120.0
+
+    def __post_init__(self):
+        if self.host_tier_mb < 0:
+            raise ValueError(
+                f"kvtier.host_tier_mb must be >= 0, got {self.host_tier_mb}"
+            )
+        if self.spill_max_pages_per_tick < 1:
+            raise ValueError(
+                f"kvtier.spill_max_pages_per_tick must be >= 1, got "
+                f"{self.spill_max_pages_per_tick}"
+            )
+        if self.handoff_min_prompt_tokens < 1:
+            raise ValueError(
+                f"kvtier.handoff_min_prompt_tokens must be >= 1, got "
+                f"{self.handoff_min_prompt_tokens}"
+            )
+        for name in ("put_bw_floor_mbps", "prefill_tps_floor",
+                     "handoff_timeout_s", "est_chars_per_token"):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"kvtier.{name} must be > 0, got {getattr(self, name)}"
+                )
+        if self.handoff_overhead_s < 0:
+            raise ValueError(
+                f"kvtier.handoff_overhead_s must be >= 0, got "
+                f"{self.handoff_overhead_s}"
+            )
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection plane (ditl_tpu/chaos/, ISSUE 5). ``rules`` is the
     compact spec string ``site:action[@k=v,...];...`` (see
@@ -925,6 +994,7 @@ class Config:
     api: APIConfig = field(default_factory=APIConfig)
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    kvtier: KVTierConfig = field(default_factory=KVTierConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 

@@ -41,13 +41,13 @@ import urllib.request
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ditl_tpu.chaos import maybe_inject
+from ditl_tpu.chaos import InjectedFault, maybe_inject
 from ditl_tpu.config import GatewayConfig
 from ditl_tpu.gateway.admission import (
     SLO_CLASS_NAMES, TenantAdmission, sanitize_label, tenant_label,
 )
 from ditl_tpu.gateway.replica import Fleet, FleetSupervisor
-from ditl_tpu.gateway.roles import role_candidates
+from ditl_tpu.gateway.roles import handoff_sources, role_candidates
 from ditl_tpu.gateway.router import (
     affinity_key, make_policy, prompt_token_estimate,
 )
@@ -131,6 +131,26 @@ class GatewayMetrics:
         self.replicas_quarantined = r.gauge(
             f"{PREFIX}_replicas_quarantined",
             "replicas quarantined by death-storm remediation")
+        # KV handoff orchestration (ISSUE 13): one counter per cost-model
+        # outcome so the "handoff-fallback storm" signature is scrapable
+        # (troubleshooting §30). attempted = eligible requests the model
+        # evaluated; shipped / declined are its two branches; fallback =
+        # an accepted handoff whose leg failed (the request still serves
+        # via plain relay + re-prefill — zero client-visible failures).
+        self.handoff_attempted = r.counter(
+            f"{PREFIX}_handoff_attempted",
+            "requests evaluated by the KV-handoff transfer-cost model")
+        self.handoff_shipped = r.counter(
+            f"{PREFIX}_handoff_shipped",
+            "prefill->decode KV handoffs shipped to the decode replica")
+        self.handoff_declined = r.counter(
+            f"{PREFIX}_handoff_declined",
+            "handoffs the cost model declined (re-prefill estimated "
+            "cheaper than the transfer)")
+        self.handoff_fallback = r.counter(
+            f"{PREFIX}_handoff_fallback",
+            "accepted handoffs that failed mid-leg and fell back to plain "
+            "relay (the decode replica re-prefills)")
 
     # Each distinct tenant label becomes its own metric family; tenants
     # arrive as arbitrary unauthenticated bearer tokens, so beyond this
@@ -346,6 +366,13 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     # traffic recorder (--save-trace). Both unarmed by default.
     actuator = None
     recorder = None
+    # KV movement plane (ISSUE 13): kvtier (config.KVTierConfig) arms the
+    # prefill->decode handoff orchestration on the relay leg; journal
+    # (telemetry/journal.EventJournal) records the per-request cost-model
+    # decision + both estimates (`kv.handoff.*` events). Unarmed by
+    # default.
+    kvtier = None
+    journal = None
 
     def log_message(self, *args):
         logger.debug("gateway http: " + args[0], *args[1:])
@@ -796,6 +823,19 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                     m.class_counter("routed", eff_class).inc()
             elif attempt > 0:
                 m.retries.inc()
+            if attempt == 0 and record and path.endswith("/completions"):
+                # KV handoff (ISSUE 13): before relaying to the decode
+                # replica the router just chose, maybe prefill the prompt
+                # on a prefill_heavy replica and ship the paged KV over —
+                # the decode replica's admission then prefix-matches the
+                # shipped pages instead of re-prefilling. Best-effort by
+                # construction: every failure path falls back to the plain
+                # relay below (the replica re-prefills; the client never
+                # sees a handoff failure).
+                self._maybe_handoff(
+                    view, payload, span=span,
+                    deadline_left=remaining if propagate_deadline else None,
+                )
             hedge_peers = (
                 [v for v in candidates if v.id != view.id]
                 if cfg.hedge_after_s > 0 and not stream else []
@@ -910,6 +950,152 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             m.no_replica.inc()
             self._send_json(503, {"error": {
                 "message": "no live replica available"}})
+
+    # -- KV handoff orchestration (ISSUE 13) ---------------------------------
+
+    def _handoff_post(self, view, path: str, body: bytes, ctype: str,
+                      timeout: float) -> bytes:
+        """One bounded intra-host handoff hop; non-200 raises (the caller
+        falls back to plain relay)."""
+        conn = http.client.HTTPConnection(
+            view.address[0], view.address[1], timeout=timeout,
+        )
+        try:
+            conn.request("POST", path, body=body, headers={
+                "Content-Type": ctype,
+                "X-Request-Id": self._request_id(),
+            })
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise ValueError(
+                    f"{path} on {view.id} answered {resp.status}"
+                )
+            return data
+        finally:
+            conn.close()
+
+    def _maybe_handoff(self, view, payload: dict, span=None,
+                       deadline_left: float | None = None) -> None:
+        """Prefill->decode KV handoff on the relay leg: when the chosen
+        decode replica would have to prefill a long prompt, have a
+        ``prefill_heavy`` replica prefill it instead, serialize the paged
+        KV (infer/kv_transfer.py), and import it into the decode replica
+        BEFORE the relay — DistServe/Splitwise disaggregation made real
+        rather than routed-around.
+
+        Gated by a measured transfer-cost model: estimated ship time
+        (bytes / the decode replica's measured device_put bandwidth +
+        fixed overhead) against estimated re-prefill time (tokens / its
+        measured prefill tok/s), with configured floors before anything
+        is measured. Re-prefill wins for short prompts and the model must
+        say so — the decision AND both estimates are journaled per
+        request (``kv.handoff.decision``). Chaos site ``kv.handoff``
+        (error/delay) and any transport/HTTP failure — including a
+        SIGKILL'd prefill replica mid-handoff — land in the fallback
+        branch: counted, journaled, and the caller's plain relay proceeds
+        with zero client-visible failures."""
+        kt = self.kvtier
+        if kt is None or not kt.handoff:
+            return
+        if not getattr(view, "kv_handoff", False) \
+                or view.role == "prefill_heavy":
+            return  # a prefill_heavy target prefills locally by design
+        # The request's deadline budget BOUNDS the handoff, it is never
+        # spent past it: with under a second left there is no room for
+        # two hops plus a prefill — relay immediately (the deadline
+        # contract promised a 504 in seconds, not a 120 s stall behind a
+        # wedged prefill replica), and below each leg's socket timeout is
+        # capped at the remaining budget.
+        if deadline_left is not None and deadline_left < 1.0:
+            return
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            return  # chat/messages tokenization is replica-side; skip
+        sources = handoff_sources(self.fleet.routable(), view.id)
+        if not sources:
+            return
+        m = self.gw
+        # Model-token estimate, not a raw word count: the floors and the
+        # cost formulas are denominated in model tokens, and a whitespace
+        # count undercounts subword/byte tokenizers several-fold (a long
+        # code prompt would never clear the min-tokens floor). chars /
+        # est_chars_per_token is the tokenizer-free approximation; the
+        # word count stays as a lower bound.
+        tokens = max(prompt_token_estimate(payload),
+                     int(len(prompt) / kt.est_chars_per_token))
+        m.handoff_attempted.inc()
+        bpt = view.kv_bytes_per_token
+        if not bpt:
+            bpt = next(
+                (v.kv_bytes_per_token for v in sources
+                 if v.kv_bytes_per_token), 0.0,
+            )
+        bw = (view.kv_put_mbps or kt.put_bw_floor_mbps) * 1e6
+        tps = view.prefill_tok_per_s or kt.prefill_tps_floor
+        est_transfer_s = kt.handoff_overhead_s + tokens * (bpt or 0.0) / bw
+        est_prefill_s = tokens / tps
+        ship = (tokens >= kt.handoff_min_prompt_tokens
+                and est_transfer_s < est_prefill_s)
+        source = min(sources, key=lambda v: v.outstanding + v.queue_depth)
+        if self.journal is not None:
+            self.journal.event(
+                "kv.handoff.decision",
+                request=self._request_id(),
+                decision="ship" if ship else "decline",
+                prompt_tokens=tokens,
+                est_transfer_s=round(est_transfer_s, 6),
+                est_prefill_s=round(est_prefill_s, 6),
+                decode_replica=view.id, prefill_replica=source.id,
+            )
+        if not ship:
+            m.handoff_declined.inc()
+            return
+        t_start = time.monotonic()
+
+        def leg_timeout() -> float:
+            t = kt.handoff_timeout_s
+            if deadline_left is not None:
+                t = min(t, max(
+                    0.001, deadline_left - (time.monotonic() - t_start)
+                ))
+            return t
+
+        try:
+            # Chaos seam: `error` = a lost handoff leg, `delay` = a slow
+            # one; both end in the fallback branch below, exactly like a
+            # replica dying mid-handoff does.
+            maybe_inject("kv.handoff")
+            blob = self._handoff_post(
+                source, "/internal/prefill",
+                json.dumps({"prompt": prompt}).encode(),
+                "application/json", leg_timeout(),
+            )
+            self._handoff_post(
+                view, "/internal/kv_handoff", blob,
+                "application/octet-stream", leg_timeout(),
+            )
+        except (InjectedFault, OSError, http.client.HTTPException,
+                ValueError) as e:
+            m.handoff_fallback.inc()
+            if self.journal is not None:
+                self.journal.event(
+                    "kv.handoff.fallback", request=self._request_id(),
+                    error=str(e)[:200],
+                    decode_replica=view.id, prefill_replica=source.id,
+                )
+            if span is not None:
+                span.annotate(handoff="fallback")
+            return
+        m.handoff_shipped.inc()
+        if self.journal is not None:
+            self.journal.event(
+                "kv.handoff.shipped", request=self._request_id(),
+                bytes=len(blob), prompt_tokens=tokens,
+                decode_replica=view.id, prefill_replica=source.id,
+            )
+        if span is not None:
+            span.annotate(handoff="shipped")
 
     # -- relaying -----------------------------------------------------------
 
@@ -1153,6 +1339,8 @@ def make_gateway(
     flight=None,
     actuator=None,
     recorder=None,
+    kvtier=None,
+    journal=None,
 ) -> GatewayHTTPServer:
     """Build (not start) the gateway server over ``fleet`` — tests drive it
     on a thread, ``main`` drives it with ``serve_forever``. ``router``
@@ -1168,7 +1356,11 @@ def make_gateway(
     (gateway.autoscale.Actuator) arms the /actions endpoint and the
     scale-to-zero wake admission; ``recorder``
     (gateway.autoscale.TrafficRecorder) appends one JSONL row per
-    admitted request (ISSUE 12) — both unarmed by default."""
+    admitted request (ISSUE 12) — both unarmed by default. ``kvtier``
+    (config.KVTierConfig with ``handoff=True``) arms the prefill->decode
+    KV handoff orchestration (ISSUE 13); ``journal``
+    (telemetry/journal.EventJournal) records its per-request cost-model
+    decisions."""
     config = config or GatewayConfig()
     if router is None:
         router = make_policy(config.router)
@@ -1202,6 +1394,8 @@ def make_gateway(
             "flight": flight,
             "actuator": actuator,
             "recorder": recorder,
+            "kvtier": kvtier,
+            "journal": journal,
         },
     )
     return GatewayHTTPServer(
@@ -1283,11 +1477,13 @@ def main(argv: list[str] | None = None) -> int:
     full_config = parse_overrides(
         Config(),
         [o for o in args.overrides
-         if o.startswith(("gateway.", "telemetry.", "autoscale."))],
+         if o.startswith(("gateway.", "telemetry.", "autoscale.",
+                          "kvtier."))],
     )
     config = full_config.gateway
     telemetry_cfg = full_config.telemetry
     autoscale_cfg = full_config.autoscale
+    kvtier_cfg = full_config.kvtier
 
     from ditl_tpu.gateway.roles import parse_roles, role_knobs
 
@@ -1324,6 +1520,15 @@ def main(argv: list[str] | None = None) -> int:
                     scaled = (args.pages * knobs["n_slots"]
                               / max(1, args.slots) * knobs["pages_scale"])
                     cmd += ["--pages", str(max(2, int(scaled)))]
+            if args.engine == "continuous" and kvtier_cfg.host_tier_mb:
+                # Requires paged replicas (--replica-arg=--cache-mode
+                # --replica-arg=paged); a mismatch fails the replica
+                # launch loudly rather than silently serving tierless.
+                cmd += ["--host-tier-mb", str(kvtier_cfg.host_tier_mb),
+                        "--spill-max-pages-per-tick",
+                        str(kvtier_cfg.spill_max_pages_per_tick)]
+            if args.engine == "continuous" and kvtier_cfg.handoff:
+                cmd += ["--kv-handoff"]
             if args.preset:
                 cmd += ["--preset", args.preset]
             if args.checkpoint_dir:
@@ -1449,7 +1654,10 @@ def main(argv: list[str] | None = None) -> int:
         server = make_gateway(fleet, config=config, tracer=tracer,
                               telemetry=telemetry_cfg, metrics=gw_metrics,
                               slo=slo, incidents=incidents, flight=flight,
-                              actuator=actuator, recorder=recorder)
+                              actuator=actuator, recorder=recorder,
+                              kvtier=kvtier_cfg if kvtier_cfg.handoff
+                              else None,
+                              journal=journal)
         stopping = threading.Event()
 
         def _shutdown(signum, frame):

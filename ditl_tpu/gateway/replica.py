@@ -300,6 +300,15 @@ class ReplicaView:
     # The autoscale planner's scale-to-zero wake budget is derived from
     # this, never from a constant. None until the replica reports one.
     cold_start_s: float | None = None
+    # KV handoff inputs (ISSUE 13), all health-reported: whether the
+    # replica serves the /internal KV endpoints, its measured device_put
+    # bandwidth (MB/s over imports), its measured prefill tok/s, and its
+    # KV bytes per token — the gateway's transfer-cost model reads these
+    # (None = unmeasured; the model falls back to the configured floors).
+    kv_handoff: bool = False
+    kv_put_mbps: float | None = None
+    prefill_tok_per_s: float | None = None
+    kv_bytes_per_token: float | None = None
 
     @property
     def cache_hit_ratio(self) -> float | None:
@@ -470,6 +479,11 @@ class Fleet:
         ttft = h.get("ttft_p95_s")
         tpot = h.get("tpot_p95_s")
         cold = h.get("cold_start_s")
+
+        def _num(key):
+            v = h.get(key)
+            return float(v) if isinstance(v, (int, float)) else None
+
         return ReplicaView(
             id=st.handle.id,
             address=addr,
@@ -488,6 +502,10 @@ class Fleet:
             tpot_p95_s=float(tpot) if isinstance(tpot, (int, float)) else None,
             cold_start_s=float(cold) if isinstance(cold, (int, float))
             else None,
+            kv_handoff=bool(h.get("kv_handoff", False)),
+            kv_put_mbps=_num("kv_put_mbps"),
+            prefill_tok_per_s=_num("prefill_tok_per_s"),
+            kv_bytes_per_token=_num("kv_bytes_per_token"),
         )
 
     def routable(self, exclude: Sequence[str] = ()) -> list[ReplicaView]:

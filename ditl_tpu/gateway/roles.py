@@ -29,7 +29,8 @@ gateway (which must stay jax-free) and by bench.py/launchers alike.
 
 from __future__ import annotations
 
-__all__ = ["ROLES", "parse_roles", "role_candidates", "role_knobs"]
+__all__ = ["ROLES", "handoff_sources", "parse_roles", "role_candidates",
+           "role_knobs"]
 
 ROLES = ("hybrid", "prefill_heavy", "decode_heavy")
 
@@ -138,3 +139,19 @@ def role_candidates(
     else:
         pref = candidates
     return pref or candidates
+
+
+def handoff_sources(candidates, decode_id: str):
+    """The replicas eligible to run a prefill on the DECODE replica's
+    behalf for a KV handoff (ISSUE 13): live ``prefill_heavy`` views that
+    serve the /internal KV endpoints (``kv_handoff`` health flag), minus
+    the chosen decode replica itself. Empty means the relay leg has
+    nobody to ship from — the gateway's orchestration skips the handoff
+    and the decode replica prefills locally, exactly the hybrid-serving
+    degradation ``role_candidates`` guarantees for routing."""
+    return [
+        v for v in candidates
+        if getattr(v, "role", "hybrid") == "prefill_heavy"
+        and getattr(v, "kv_handoff", False)
+        and v.id != decode_id
+    ]

@@ -76,7 +76,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ditl_tpu.annotations import hot_path
-from ditl_tpu.chaos import maybe_inject
+from ditl_tpu.chaos import InjectedFault, maybe_inject
 from ditl_tpu.config import ModelConfig
 from ditl_tpu.data.tokenizer import Tokenizer
 from ditl_tpu.infer.cache import init_cache
@@ -362,6 +362,8 @@ class ContinuousEngine:
         admission: str = "reserve",
         token_budget: int = 0,
         thrash_window: int = 32,
+        host_tier_mb: float = 0,
+        spill_max_pages_per_tick: int = 32,
         metrics: ServingMetrics | None = None,
         tracer: Tracer | None = None,
         flight: FlightRecorder | None = None,
@@ -603,9 +605,59 @@ class ContinuousEngine:
             else:
                 self.cache = fresh_pools()
             self.allocator = PageAllocator(
-                self.n_pages,
-                on_evict=self.metrics.prefix_cache_evictions.inc,
+                self.n_pages, on_evict=self._on_pages_evicted,
+                # Chain collection costs O(group depth) inside alloc on
+                # the admission path — pay it only when something consumes
+                # the payload (host-tier spills, handoff-pid attribution).
+                group_payload=lambda: (
+                    self.host_tier is not None or bool(self._handoff_pids)
+                ),
             )
+            # Host-RAM prefix-cache tier (ISSUE 13, infer/host_tier.py):
+            # LRU-evicted published pages spill their KV bytes to a
+            # size-capped host store (one batched device_get per tick,
+            # _process_spills) and swap back in on admission miss
+            # (_host_swap_in) — the effective shared-prefix working set
+            # becomes a config knob instead of a hardware constant.
+            per_val = (
+                model_cfg.num_layers * model_cfg.num_kv_heads
+                * page_size * model_cfg.head_dim
+            )
+            if quantized:
+                scale_vals = (
+                    model_cfg.num_layers * model_cfg.num_kv_heads * page_size
+                )
+                self.page_bytes = 2 * per_val + 2 * scale_vals * 4
+            else:
+                self.page_bytes = 2 * per_val * dt.itemsize
+            if host_tier_mb < 0:
+                raise ValueError(
+                    f"host_tier_mb must be >= 0, got {host_tier_mb}"
+                )
+            if spill_max_pages_per_tick < 1:
+                raise ValueError(
+                    f"spill_max_pages_per_tick must be >= 1, got "
+                    f"{spill_max_pages_per_tick}"
+                )
+            if host_tier_mb:
+                from ditl_tpu.infer.host_tier import HostTier
+
+                self.host_tier = HostTier(int(host_tier_mb * 1024 * 1024))
+            else:
+                self.host_tier = None
+            self._spill_max = int(spill_max_pages_per_tick)
+            self._pending_spills: list[tuple[int, dict]] = []
+            self._pending_spill_ids: set[int] = set()
+            self._tier_evictions_seen = 0
+            # KV handoff import state (ISSUE 13, infer/kv_transfer.py):
+            # physical pages installed by import_kv, so admission can
+            # attribute their first reuse to the `handoff` tier label; plus
+            # the measured device_put bandwidth the gateway's transfer-cost
+            # model reads from /health.
+            self._handoff_pids: set[int] = set()
+            self.kv_import_bytes = 0
+            self.kv_import_seconds = 0.0
+            self._install_progs: dict = {}
             self._table = np.zeros((n_slots, self.maxp), np.int32)
             # Device-resident mirror, re-uploaded only when the host table
             # changes (admission / slot free): a per-tick jnp.asarray would
@@ -653,6 +705,12 @@ class ContinuousEngine:
                     "admission='optimistic' requires cache_mode='paged' "
                     "(the contiguous cache has no pages to reclaim)"
                 )
+            if host_tier_mb:
+                raise ValueError(
+                    "host_tier_mb requires cache_mode='paged' (the host "
+                    "tier spills and swaps KV pages)"
+                )
+            self.host_tier = None
             self.admission = admission
             self.preemptions = 0
             self.cache = init_cache(model_cfg, n_slots, self.smax)
@@ -677,6 +735,16 @@ class ContinuousEngine:
                         rules,
                     ),
                 )
+        # Measured prefill throughput (ISSUE 13): accumulated over
+        # page-warming prefills only (register_prefix / export_kv), which
+        # run off the serving hot path and are SYNCED before the clock
+        # closes — ordinary admission prefills are async-dispatched, and
+        # their dispatch time is not device time. /health exposes the
+        # derived tok/s as the re-prefill side of the gateway's KV-handoff
+        # transfer-cost model (absent until something warmed; the model's
+        # floors cover that).
+        self.prefill_tokens_total = 0
+        self.prefill_seconds_total = 0.0
         self.cur = jnp.full((n_slots,), tokenizer.pad_id, jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.temps = jnp.zeros((n_slots,), jnp.float32)
@@ -1902,12 +1970,22 @@ class ContinuousEngine:
         pages = matched + fresh
         d = len(matched) * ps
         s = n_full * ps - d
+        m0 = time.monotonic()
         self._run_paged_prefill(
             tokens[d: d + s], d, s, s,
             ctx_row=np.asarray(pages, np.int32),  # pages[:ctx] = the context
             write_pids=np.asarray(pages[len(matched):], np.int32),
             temp=0.0, top_p=1.0, rng=jax.random.key(0),
         )
+        # The measured prefill tok/s (ISSUE 13) comes from page-warming
+        # prefills ONLY, synced before the clock closes: ordinary
+        # admissions are async-dispatched (pipelining is the point) and
+        # timing their dispatch would feed the cost model a dispatch
+        # rate, not device time — the warm path is off the serving hot
+        # path and IS the work the handoff trades against.
+        jax.block_until_ready(self.cache)
+        self.prefill_tokens_total += s
+        self.prefill_seconds_total += time.monotonic() - m0
         self.allocator.publish_chain(tokens[: n_full * ps], ps, pages)
         for pid in pages:
             self.allocator.release(pid)
@@ -2339,6 +2417,324 @@ class ContinuousEngine:
         — (parent page, exact tokens) — verifies exactly like prompt pages."""
         self._publish_tokens(req.prompt + req.tokens, slot, req.adapter_id)
 
+    # -- host-RAM prefix-cache tier + KV handoff (ISSUE 13) ------------------
+
+    def _on_pages_evicted(self, group) -> None:
+        """Allocator ``on_evict`` hook: count the reclaim (one claimed page
+        per call — the ISSUE 8 eviction-counter semantics are unchanged)
+        and queue the WHOLE evicted group — claimed page plus cascaded
+        descendants — for the host-tier spill. Only lazy device-array
+        slices are captured here (async gather dispatch, no host sync, so
+        the ``@hot_path`` tick stays free of blocking transfers); the one
+        real ``device_get`` happens per tick in ``_process_spills``. The
+        slice must be taken NOW: ``alloc`` hands the claimed page to a
+        prefill that overwrites it this very tick."""
+        self.metrics.prefix_cache_evictions.inc()
+        if self._handoff_pids:
+            # An evicted page's physical id may be recycled for unrelated
+            # content — it must never attribute a later hit to the handoff
+            # tier (the unpublish group is the only path out of the
+            # published set, so this discard is exhaustive).
+            self._handoff_pids.difference_update(p for p, _, _ in group)
+        tier = self.host_tier
+        if tier is None:
+            return
+        for pid, root, blocks in group:
+            nid = tier.intern(root, list(blocks))
+            if tier.has_entry(nid) or nid in self._pending_spill_ids:
+                continue
+            self._pending_spill_ids.add(nid)
+            self._pending_spills.append(
+                (nid, {k: v[:, pid] for k, v in self.cache.items()})
+            )
+
+    def _process_spills(self) -> None:
+        """End-of-tick spill batch: ONE ``jax.device_get`` over every page
+        this tick's evictions queued, stored into the host tier under
+        never-recycled chain-node ids. Bounded by
+        ``spill_max_pages_per_tick`` (the remainder carries over to the
+        next tick). Chaos site ``kvtier.spill``: ``delay`` stalls the
+        batch, ``error`` drops it (counted — correctness never depends on
+        a spill landing; the pages simply re-prefill on their next miss),
+        ``kill`` is a real process death mid-spill."""
+        if not self._pending_spills:
+            return
+        batch = self._pending_spills[: self._spill_max]
+        del self._pending_spills[: len(batch)]
+        for nid, _ in batch:
+            self._pending_spill_ids.discard(nid)
+        m = self.metrics
+        try:
+            maybe_inject("kvtier.spill")
+        except InjectedFault:
+            m.host_tier_dropped_pages.inc(len(batch))
+            return
+        fetched = jax.device_get([parts for _, parts in batch])
+        stored = 0
+        for (nid, _), parts in zip(batch, fetched):
+            if self.host_tier.put(
+                nid, {k: np.asarray(v) for k, v in parts.items()}
+            ):
+                stored += 1
+        m.host_tier_spilled_pages.inc(stored)
+        if stored < len(batch):
+            m.host_tier_dropped_pages.inc(len(batch) - stored)
+        ev = self.host_tier.evictions
+        if ev > self._tier_evictions_seen:
+            m.host_tier_evictions.inc(ev - self._tier_evictions_seen)
+            self._tier_evictions_seen = ev
+
+    def _install_pages(self, pids: list[int], entries: list[dict]) -> None:
+        """Scatter host KV arrays into pool pages — one donated, jitted
+        scatter per pool per pow2 batch bucket (a bare ``.at[].set``
+        outside jit copies the whole pool). Padding rows aim at sentinel
+        page 0, whose content is never read unmasked (the same invariant
+        the per-tick tail flush relies on)."""
+        n = len(pids)
+        bucket = _next_pow2(n, floor=1)
+        idx = np.zeros((bucket,), np.int32)
+        idx[:n] = pids
+        for name in list(self.cache):
+            vals = np.stack([np.asarray(e[name]) for e in entries])
+            if bucket > n:
+                pad = np.zeros((bucket - n,) + vals.shape[1:], vals.dtype)
+                vals = np.concatenate([vals, pad])
+            vals = np.moveaxis(vals, 0, 1)  # (L, bucket, K, ...)
+            key = (name, bucket)
+            prog = self._install_progs.get(key)
+            if prog is None:
+                prog = jax.jit(
+                    lambda pool, i, v: pool.at[:, i].set(v),
+                    donate_argnums=(0,),
+                )
+                self._install_progs[key] = prog
+            self.cache[name] = prog(
+                self.cache[name], jnp.asarray(idx), jnp.asarray(vals)
+            )
+
+    def _host_swap_in(self, req: Request,
+                      matched: list[int]) -> tuple[list[int], int]:
+        """Admission-miss host-tier lookup: extend the HBM ``matched`` run
+        by swapping spilled pages back in (device_put + republish +
+        refcount) instead of re-prefilling them. Returns ``(pages,
+        host-hit tokens)`` — the tokens land under the ``host`` tier label
+        in ``_note_prefix_cache``, never conflated with HBM hits, and the
+        whole operation is timed into the swap-in-latency histogram.
+        Swapped pages end in exactly the state a prefilled-then-published
+        page holds (caller ref + cache ref), so every downstream invariant
+        — publish chains, LRU eviction, re-spill — is untouched. A corrupt
+        entry (crc mismatch) is dropped and counted; the chain cannot
+        extend past it and the remainder re-prefills."""
+        tier = self.host_tier
+        ps = self.page_size
+        prompt = req.prompt
+        usable = (len(prompt) - 1) // ps
+        if tier is None or usable <= len(matched):
+            return matched, 0
+        blocks = [tuple(prompt[i * ps:(i + 1) * ps]) for i in range(usable)]
+        nids = tier.walk(-req.adapter_id, blocks)
+        take: list[tuple[int, int]] = []
+        for i in range(len(matched), usable):
+            nid = nids[i]
+            if nid is None or not tier.has_entry(nid):
+                break
+            take.append((i, nid))
+        if not take:
+            return matched, 0
+        try:
+            fault = maybe_inject("kvtier.swap_in")
+        except InjectedFault:
+            return matched, 0  # injected miss: admission just prefills
+        if fault is not None and fault.action == "corrupt":
+            # The drill's bit flip: the crc check below must catch it.
+            tier.corrupt(take[0][1])
+        t0 = time.monotonic()
+        entries: list[dict] = []
+        for i, nid in take:
+            arrs = tier.fetch(nid)
+            if arrs is None:
+                # crc caught a corrupt entry: dropped + counted, never
+                # served — and the chain past it cannot verify either.
+                self.metrics.host_tier_corrupt_entries.inc()
+                break
+            entries.append(arrs)
+        if not entries:
+            return matched, 0
+        try:
+            pids = self.allocator.alloc(len(entries))
+        except MemoryError:
+            return matched, 0
+        self._install_pages(pids, entries)
+        parent = matched[-1] if matched else -req.adapter_id
+        for pid, (i, _) in zip(pids, take):
+            self.allocator.publish((parent, blocks[i]), pid)
+            parent = pid
+        jax.block_until_ready(self.cache)  # honest swap-in latency
+        self._table_dirty = True
+        self.metrics.host_tier_swap_in.observe(time.monotonic() - t0)
+        self.metrics.host_tier_swapped_pages.inc(len(pids))
+        return matched + pids, len(pids) * ps
+
+    def export_kv(self, prompt: list[int],
+                  adapter_id: int = 0) -> tuple[bytes, int]:
+        """Serialize the FULL pages of ``prompt`` for a prefill->decode
+        handoff (infer/kv_transfer.py): prefill whatever isn't already
+        cached (page warming — no slot is occupied), then ship the page
+        KV with per-page crc32s and the exact token blocks the importer
+        republishes under. Returns ``(blob, shipped_tokens)``. Ships at
+        most the pages ``match_prefix`` would reuse (the always-leave-one-
+        token rule), so the importer-side hit accounting equals the
+        shipped tokens exactly. Must run on the engine driver thread
+        (``ThreadedEngine.call``)."""
+        if self.cache_mode != "paged":
+            raise BadRequestError("KV handoff requires cache_mode='paged'")
+        if adapter_id:
+            raise BadRequestError("KV handoff serves the base adapter only")
+        ps = self.page_size
+        n = (len(prompt) - 1) // ps
+        if n < 1:
+            raise BadRequestError(
+                f"prompt too short to ship ({len(prompt)} tokens, "
+                f"page size {ps})"
+            )
+        self._warm_pages(prompt[: n * ps])
+        matched = self.allocator.match_prefix(prompt, ps)
+        if not matched:
+            raise MemoryError(
+                "page pool cannot hold the prompt's pages (nothing to ship)"
+            )
+        pid_arr = jnp.asarray(np.asarray(matched, np.int32))
+        parts = jax.device_get(
+            {k: v[:, pid_arr] for k, v in self.cache.items()}
+        )
+        for pid in matched:
+            self.allocator.release(pid)
+        tokens = prompt[: len(matched) * ps]
+        meta = {
+            "page_size": ps,
+            "num_layers": self.cfg.num_layers,
+            "num_kv_heads": self.cfg.num_kv_heads,
+            "head_dim": self.cfg.head_dim,
+            "quantized": "ks" in self.cache,
+            "adapter_id": adapter_id,
+            "blocks": [
+                list(tokens[i * ps:(i + 1) * ps])
+                for i in range(len(matched))
+            ],
+        }
+        from ditl_tpu.infer.kv_transfer import serialize_pages
+
+        pages = [
+            {k: np.asarray(v[:, i]) for k, v in parts.items()}
+            for i in range(len(matched))
+        ]
+        return serialize_pages(meta, pages), len(matched) * ps
+
+    def import_kv(self, blob: bytes) -> dict:
+        """Install a shipped prefill's pages into this engine's pool and
+        publish them, so the relayed request's admission prefix-matches
+        them instead of re-prefilling — the decode half of the handoff.
+        Torn/short/crc-failing blobs raise
+        :exc:`~ditl_tpu.infer.kv_transfer.KVTransferError` (reject whole,
+        never partial-install); geometry mismatches are
+        :class:`BadRequestError`. A full pool installs nothing (the relay
+        re-prefills; zero client-visible failure). Must run on the engine
+        driver thread (``ThreadedEngine.call``)."""
+        from ditl_tpu.infer.kv_transfer import deserialize_pages
+
+        if self.cache_mode != "paged":
+            raise BadRequestError("KV handoff requires cache_mode='paged'")
+        meta, pages = deserialize_pages(blob)
+        want = {
+            "page_size": self.page_size,
+            "num_layers": self.cfg.num_layers,
+            "num_kv_heads": self.cfg.num_kv_heads,
+            "head_dim": self.cfg.head_dim,
+            "quantized": "ks" in self.cache,
+        }
+        for k, v in want.items():
+            if meta.get(k) != v:
+                raise BadRequestError(
+                    f"KV blob {k}={meta.get(k)!r} does not match this "
+                    f"engine ({v!r})"
+                )
+        if sorted(meta["parts"]) != sorted(self.cache):
+            raise BadRequestError(
+                f"KV blob pools {meta['parts']} do not match this "
+                f"engine's {sorted(self.cache)}"
+            )
+        for name, pool in self.cache.items():
+            # Pool DTYPE is geometry too: the install scatter would
+            # silently cast a mismatched blob (f32 pages into a bf16
+            # pool) instead of rejecting — outputs would stop being
+            # token-identical to a local prefill with no error signal.
+            got = meta["part_dtypes"].get(name)
+            if got != pool.dtype.name:
+                raise BadRequestError(
+                    f"KV blob pool {name} dtype {got!r} does not match "
+                    f"this engine's {pool.dtype.name!r}"
+                )
+        ps = self.page_size
+        blocks = [tuple(int(t) for t in b) for b in meta["blocks"]]
+        if any(len(b) != ps for b in blocks):
+            raise BadRequestError("KV blob blocks are not page-sized")
+        root = -int(meta.get("adapter_id", 0))
+        # RETAIN the matched prefix chain before any alloc: the walk's
+        # pages may be cache-only (ref 1), and alloc's LRU eviction could
+        # otherwise reclaim — and even hand back as an install target —
+        # the very parent pid the publish chain below runs through,
+        # recording shipped pages under a recycled physical id (the
+        # cross-request corruption the chain keys exist to prevent).
+        matched_pids: list[int] = []
+        parent, idx = root, 0
+        for b in blocks:
+            pid = self.allocator.lookup((parent, b))
+            if pid is None:
+                break
+            self.allocator.retain(pid)
+            matched_pids.append(pid)
+            parent, idx = pid, idx + 1
+        todo = list(range(idx, len(blocks)))
+        installed = 0
+        dt = 0.0
+        if todo:
+            try:
+                pids = self.allocator.alloc(len(todo))
+            except MemoryError:
+                pids = []
+            if pids:
+                t0 = time.monotonic()
+                self._install_pages(pids, [pages[i] for i in todo])
+                for pid, i in zip(pids, todo):
+                    self.allocator.publish((parent, blocks[i]), pid)
+                    parent = pid
+                    # The cache's own reference keeps the page resident
+                    # (and LRU-evictable); the importer holds none.
+                    self.allocator.release(pid)
+                jax.block_until_ready(self.cache)
+                dt = max(time.monotonic() - t0, 1e-9)
+                self._handoff_pids.update(pids)
+                installed = len(pids)
+                # Bandwidth accounting ONLY over real installs, timed over
+                # the device_put region alone: a no-op import (full pool,
+                # all matched) clocking the blob's bytes over microseconds
+                # would inflate the measured kv_put_mbps the gateway's
+                # cost model trusts — and keep shipping prefills into the
+                # very replica that cannot install them.
+                self.kv_import_bytes += installed * self.page_bytes
+                self.kv_import_seconds += dt
+        for pid in matched_pids:
+            self.allocator.release(pid)
+        self.metrics.kv_handoff_imports.inc()
+        self.metrics.kv_handoff_tokens.inc(installed * ps)
+        return {
+            "installed_pages": installed,
+            "matched_pages": idx,
+            "tokens": installed * ps,
+            "shipped_tokens": len(blocks) * ps,
+            "seconds": round(dt, 6),
+        }
+
     def _ctx_pages_bucket(self, d: int) -> int:
         """Gather-bucket (in pages) covering a context of ``d`` tokens."""
         if d <= 0:
@@ -2433,6 +2829,11 @@ class ContinuousEngine:
         matched = self.allocator.match_prefix(
             req.prompt, ps, root=-req.adapter_id
         )  # retained
+        # Host-tier swap-in (ISSUE 13): extend the HBM run from the host
+        # store before deciding how much prefill this admission costs. If
+        # admission then defers (budget/pool), the swapped pages stay
+        # published — the retry rematches them in HBM for free.
+        matched, host_tokens = self._host_swap_in(req, matched)
         d0 = len(matched) * ps
         # Token-budget gate (ISSUE 8): an unchunked admission prefills its
         # whole unmatched prompt THIS tick; defer it when that would bust
@@ -2459,7 +2860,17 @@ class ContinuousEngine:
             return False
         self._queue.pop(0)
         self._note_admitted(req)
-        self._note_prefix_cache(req, d0)
+        # Handoff attribution (ISSUE 13): matched pages installed by
+        # import_kv count under the `handoff` tier label on their first
+        # reuse — the counter the handoff drill pins reused == shipped on.
+        handoff_tokens = 0
+        if self._handoff_pids:
+            hand = [p for p in matched if p in self._handoff_pids]
+            if hand:
+                self._handoff_pids.difference_update(hand)
+                handoff_tokens = len(hand) * ps
+        self._note_prefix_cache(req, d0, host_tokens=host_tokens,
+                                handoff_tokens=handoff_tokens)
         pages = matched + fresh
         self._slot_pages[slot] = pages
         self._table[slot, :] = 0
@@ -2814,20 +3225,27 @@ class ContinuousEngine:
             return True
         return self._tick_prefill_spent == 0 or cost <= self._tick_prefill_left
 
-    def _note_prefix_cache(self, req: Request, hit_tokens: int) -> None:
+    def _note_prefix_cache(self, req: Request, hit_tokens: int,
+                           host_tokens: int = 0,
+                           handoff_tokens: int = 0) -> None:
         """Record a FIRST admission's reused-vs-prefilled prompt split
         (prefix-cache accounting, ISSUE 8). Resume re-prefills never come
         here — their cost is thrash (resume_prefill_tokens), not a cache
         verdict on the prompt. Idempotent: a mid-prefill preemption victim
         is requeued as FRESH (no sampling frontier to capture), and its
         re-admission would otherwise count the prompt twice — with its own
-        just-published pages masquerading as hits."""
+        just-published pages masquerading as hits. ``host_tokens`` /
+        ``handoff_tokens`` (ISSUE 13) split the hit under its tier label —
+        a host swap-in or a shipped handoff page is a real reuse but NOT
+        an HBM hit, and conflating them would hide exactly the churn the
+        tier exists to absorb."""
         if req.cache_hit_tokens or req.cache_miss_tokens:
             return  # re-admission after a mid-prefill preemption
         req.cache_hit_tokens = hit_tokens
         req.cache_miss_tokens = len(req.prompt) - hit_tokens
         self.metrics.note_prefix_cache(
-            req.cache_hit_tokens, req.cache_miss_tokens
+            req.cache_hit_tokens, req.cache_miss_tokens,
+            host_tokens=host_tokens, handoff_tokens=handoff_tokens,
         )
 
     def _record_prefill(self, req: Request, tokens: int, offset: int,
@@ -3565,6 +3983,11 @@ class ContinuousEngine:
                 self._finish_tick(prev)
         elif rec is not None:
             self._finish_tick(rec)
+        if self.host_tier is not None:
+            # Host-tier spill batch (ISSUE 13): the tick's evicted pages
+            # move to host RAM in one batched fetch, AFTER dispatch/harvest
+            # so the transfer overlaps nothing on the dispatch stream.
+            self._process_spills()
         # Flight recorder (ISSUE 10): one host-dict row per tick into the
         # bounded ring — the black box an incident bundle dumps. Host state
         # only (no device sync); counters are the cumulative values the
@@ -3629,6 +4052,12 @@ class ContinuousEngine:
             h.update(self._table.tobytes())
             h.update(self.allocator.n_free.to_bytes(4, "big"))
             h.update(self.allocator.n_evictable.to_bytes(4, "big"))
+            if self.host_tier is not None:
+                # Host-tier occupancy steers swap-in-vs-prefill admission
+                # decisions, so a replica whose tier drifted must
+                # fingerprint differently (spills/swaps are deterministic
+                # functions of replicated scheduler state per tick).
+                h.update(self.host_tier.n_entries.to_bytes(4, "big"))
             # The anti-thrash mode changes admission decisions, so a
             # replica whose switch drifted must fingerprint differently.
             h.update(bytes([self._degraded]))
@@ -3684,6 +4113,14 @@ class ContinuousEngine:
             },
             "prefix_cache": self._prefix_cache_stats(),
         }
+        if self.prefill_seconds_total > 0:
+            # Measured prefill throughput (ISSUE 13): the re-prefill side
+            # of the gateway's KV-handoff transfer-cost model, exposed on
+            # /health via the server's load snapshot. Absent until a
+            # prefill has run (absent != 0).
+            out["prefill_tok_per_s"] = round(
+                self.prefill_tokens_total / self.prefill_seconds_total, 1
+            )
         if self.cache_mode == "paged":
             out.update({
                 "page_size": self.page_size,
@@ -3692,7 +4129,20 @@ class ContinuousEngine:
                 "pages_cached_evictable": self.allocator.n_evictable,
                 "admission": self.admission,
                 "preemptions": self.preemptions,
+                "kv_bytes_per_token": round(
+                    self.page_bytes / self.page_size, 2
+                ),
             })
+            if self.host_tier is not None:
+                out["host_tier"] = self.host_tier.stats()
+            if self.kv_import_seconds > 0:
+                out["kv_transfer"] = {
+                    "put_mbps": round(
+                        self.kv_import_bytes
+                        / self.kv_import_seconds / 1e6, 2
+                    ),
+                    "imported_bytes": self.kv_import_bytes,
+                }
             if self.admission == "optimistic":
                 out["admission_degraded"] = self._degraded
                 out["admission_degrades"] = self.admission_degrades
@@ -3809,6 +4259,7 @@ class ThreadedEngine:
         self._cond = threading.Condition()
         self._results: dict[int, Request] = {}  # guarded-by: _cond
         self._cancels: set[int] = set()  # guarded-by: _cond
+        self._calls: list = []  # guarded-by: _cond
         self._error: BaseException | None = None  # guarded-by: _cond
         self._stop = False  # guarded-by: _cond
         self._thread = threading.Thread(target=self._drive, daemon=True)
@@ -3850,9 +4301,11 @@ class ThreadedEngine:
     def _drive(self) -> None:
         while True:
             with self._cond:
-                while not self._stop and self._engine.pending == 0:
+                while (not self._stop and self._engine.pending == 0
+                       and not self._calls):
                     self._cond.wait(timeout=0.05)
                 if self._stop:
+                    self._cond.notify_all()
                     return
             # Device work runs OUTSIDE the lock: submissions (queue appends,
             # thread-safe deque) land while a chunk decodes and are admitted
@@ -3860,10 +4313,28 @@ class ThreadedEngine:
             # are applied here because only this thread touches engine state.
             with self._cond:
                 cancels, self._cancels = self._cancels, set()
+                calls, self._calls = self._calls, []
             try:
+                # Driver-thread calls (ISSUE 13: KV handoff export/import)
+                # run BEFORE the tick, so a shipped prefill is published
+                # before the relayed request's admission looks for it. A
+                # call's own exception is delivered to its waiter, never
+                # allowed to kill the driver — a torn KV blob must cost one
+                # 400, not the replica.
+                for fn, box in calls:
+                    try:
+                        box["result"] = fn()
+                    except BaseException as e:
+                        box["error"] = e
+                if calls:
+                    with self._cond:
+                        for _, box in calls:
+                            box["done"] = True
+                        self._cond.notify_all()
                 for rid in cancels:
                     self._engine.cancel(rid)
-                self._engine.step()
+                if self._engine.pending:
+                    self._engine.step()
             except BaseException as e:  # device/compile errors must not
                 # wedge the server: fail every waiter loudly and stop.
                 logger.exception("continuous engine driver died")
@@ -3880,6 +4351,31 @@ class ThreadedEngine:
                     if req.stream is None:
                         self._results[req.req_id] = req
                 self._cond.notify_all()
+
+    def call(self, fn):
+        """Run ``fn()`` on the engine driver thread between ticks and
+        return its result (its exception re-raises here). Engine state —
+        page tables, pools, the allocator, the host tier — is
+        single-threaded by design; the KV handoff endpoints (export_kv /
+        import_kv) go through this so HTTP handler threads never touch
+        device state mid-tick."""
+        box: dict = {}
+        with self._cond:
+            if self._stop:
+                raise RuntimeError(
+                    "continuous engine stopped"
+                ) from self._error
+            self._calls.append((fn, box))
+            self._cond.notify_all()
+            while "done" not in box:
+                if self._stop:
+                    raise RuntimeError(
+                        "continuous engine stopped mid-call"
+                    ) from self._error
+                self._cond.wait()
+        if "error" in box:
+            raise box["error"]
+        return box.get("result")
 
     @property
     def logprobs_k(self) -> int:

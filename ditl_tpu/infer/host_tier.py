@@ -1,0 +1,263 @@
+"""Host-RAM prefix-cache tier (ISSUE 13): the level below the HBM page
+pool.
+
+The paged KV cache (infer/paged_cache.py) makes the shared-prefix working
+set content-addressable, but its capacity is HBM pages — the difference
+between caching one system prompt and caching a million users'
+conversation histories. This module is the next level of the hierarchy:
+when the allocator LRU-evicts a published page, the engine spills its KV
+bytes here (one batched ``jax.device_get`` per tick, off the ``@hot_path``
+— infer/continuous.py ``_process_spills``); when a later admission's
+prompt misses in HBM but its block keys match host entries, the engine
+swaps the pages back in (``device_put`` + republish) instead of
+re-prefilling them.
+
+**Keying — the no-hash-collision invariant across the tier boundary.**
+The allocator's content keys chain through *physical* page ids
+(``(parent_pid, exact_tokens)``), which are recycled the moment a page is
+reclaimed — a spilled entry keyed by a physical id would verify against
+whatever content the recycled id holds next (silent cross-request KV
+corruption, the exact failure the chain keys exist to prevent). The tier
+therefore interns its own **chain nodes**: ``(parent_node_id,
+exact_tokens) -> node_id`` where node ids are monotonically assigned and
+NEVER recycled. Equal node ids mean equal full prefixes by the same
+induction the allocator's keys give — exact token comparison at every
+link, zero reliance on hash collision resistance — and the identity
+survives any number of HBM evict/republish cycles because nothing on the
+host side is ever renumbered. Roots are the allocator's non-positive
+adapter roots (``-adapter_id``), so multi-LoRA isolation carries over
+unchanged.
+
+**Integrity.** Every stored page carries a crc32 over its KV bytes,
+verified at swap-in: a corrupt entry (bit rot, a torn write, the
+``kvtier.swap_in:corrupt`` chaos drill) is detected, dropped, and
+counted — never served. Corruption is a per-entry event; the rest of the
+tier stays usable.
+
+**Capacity.** ``capacity_bytes`` caps resident KV bytes; inserting past
+the cap evicts least-recently-used entries first (and an entry larger
+than the whole cap is refused, counted as dropped). Unlike the HBM
+allocator there is NO eviction cascade: node ids are never recycled, so a
+child entry whose parent entry was evicted is still exactly correct — and
+still useful whenever the parent's pages are matched in HBM. Nodes
+without entries, children, or pins are pruned so the chain map stays
+bounded by live structure.
+
+Plain Python + numpy on the host, importable without jax — admission
+policy is not a TPU problem (the same stance as the allocator), and the
+unit tests drive every eviction/corruption edge without a device.
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+__all__ = ["HostTier", "HostTierEntry"]
+
+TokenBlock = tuple[int, ...]
+
+
+@dataclass
+class HostTierEntry:
+    """One spilled page: per-pool KV bytes + shape/dtype to rebuild the
+    arrays, and a crc32 per part verified at swap-in. ``data`` holds
+    bytearrays (not bytes) so the corruption drill can flip a bit in
+    place, exactly like real rot would."""
+
+    node_id: int
+    nbytes: int
+    # (bytes, dtype OBJECT, shape) per pool part: the dtype object round-
+    # trips extension dtypes (ml_dtypes bfloat16's ``.str`` is an opaque
+    # '<V2' that np.dtype() cannot rebuild — a string key would silently
+    # corrupt bf16 pools); nothing here ever leaves the process.
+    parts: dict[str, tuple[bytearray, np.dtype, tuple[int, ...]]] = field(
+        default_factory=dict
+    )
+    crcs: dict[str, int] = field(default_factory=dict)
+
+    def arrays(self) -> dict[str, np.ndarray]:
+        return {
+            name: np.frombuffer(buf, dtype=dt).reshape(shape)
+            for name, (buf, dt, shape) in self.parts.items()
+        }
+
+
+class HostTier:
+    """Size-capped, chain-keyed host store for spilled KV pages."""
+
+    def __init__(self, capacity_bytes: int):
+        if capacity_bytes < 1:
+            raise ValueError(
+                f"capacity_bytes must be >= 1, got {capacity_bytes}"
+            )
+        self.capacity_bytes = int(capacity_bytes)
+        self.bytes_used = 0
+        # Chain nodes: (parent_node_id, tokens) -> node_id. Parent ids are
+        # prior node ids (> 0) or allocator adapter roots (<= 0); node ids
+        # count up from 1 and are never reused.
+        self._nodes: dict[tuple[int, TokenBlock], int] = {}
+        self._node_key: dict[int, tuple[int, TokenBlock]] = {}
+        self._children: dict[int, set[int]] = {}  # node id -> child node ids
+        self._next_id = 1
+        # Entries keyed by node id, insertion/touch-ordered (LRU evicts the
+        # front).
+        self._entries: OrderedDict[int, HostTierEntry] = OrderedDict()
+        # Lifetime accounting (mirrored into ServingMetrics by the engine).
+        self.spilled = 0  # entries stored
+        self.swapped_in = 0  # entries served back to HBM
+        self.dropped = 0  # refused at the cap / oversized
+        self.evictions = 0  # LRU reclaims under the cap
+        self.corrupt_dropped = 0  # crc mismatches detected at fetch
+
+    # -- chain nodes ---------------------------------------------------------
+
+    def intern(self, root: int, blocks: list[TokenBlock]) -> int:
+        """Node id for the chain ``root -> blocks[0] -> ... -> blocks[-1]``,
+        creating missing nodes. ``root`` must be a non-positive allocator
+        adapter root so roots and node ids can never collide."""
+        if root > 0:
+            raise ValueError(f"chain root must be <= 0, got {root}")
+        if not blocks:
+            raise ValueError("a chain needs at least one block")
+        parent = root
+        for block in blocks:
+            key = (parent, tuple(block))
+            nid = self._nodes.get(key)
+            if nid is None:
+                nid = self._next_id
+                self._next_id += 1
+                self._nodes[key] = nid
+                self._node_key[nid] = key
+                if parent > 0:
+                    self._children.setdefault(parent, set()).add(nid)
+            parent = nid
+        return parent
+
+    def walk(self, root: int, blocks: list[TokenBlock]) -> list[int | None]:
+        """Lookup-only chain walk: node id per block, stopping (None-filled)
+        at the first link no spill ever interned."""
+        out: list[int | None] = []
+        parent: int | None = root
+        for block in blocks:
+            nid = (
+                self._nodes.get((parent, tuple(block)))
+                if parent is not None else None
+            )
+            out.append(nid)
+            parent = nid
+        return out
+
+    def _prune(self, nid: int) -> None:
+        """Drop chain nodes that anchor nothing (no entry, no children),
+        walking toward the root — keeps the chain map bounded by live
+        structure instead of by everything ever spilled."""
+        while nid > 0 and nid not in self._entries \
+                and not self._children.get(nid):
+            key = self._node_key.pop(nid, None)
+            if key is None:
+                return
+            del self._nodes[key]
+            self._children.pop(nid, None)
+            parent = key[0]
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.discard(nid)
+            nid = parent
+
+    # -- entries -------------------------------------------------------------
+
+    def has_entry(self, node_id: int) -> bool:
+        return node_id in self._entries
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._entries)
+
+    def put(self, node_id: int, arrays: dict[str, np.ndarray]) -> bool:
+        """Store one page's KV under ``node_id``. Evicts LRU entries to
+        fit; refuses (False, counted dropped) when the page alone exceeds
+        the cap. Re-putting a resident node is a no-op touch. A node id
+        that no longer exists is also a refusal, not an error: a pending
+        spill's node can be PRUNED before its put runs (its descendants'
+        entries were evicted/dropped in the same batch, and pruning walks
+        up through entry-less ancestors) — spills are best-effort by
+        contract and must never raise into the engine driver."""
+        if node_id not in self._node_key:
+            self.dropped += 1
+            return False
+        if node_id in self._entries:
+            self._entries.move_to_end(node_id)
+            return True
+        entry = HostTierEntry(node_id=node_id, nbytes=0)
+        for name, arr in arrays.items():
+            arr = np.ascontiguousarray(arr)
+            buf = bytearray(arr.tobytes())
+            entry.parts[name] = (buf, arr.dtype, arr.shape)
+            entry.crcs[name] = zlib.crc32(buf)
+            entry.nbytes += len(buf)
+        if entry.nbytes > self.capacity_bytes:
+            self.dropped += 1
+            return False
+        while self.bytes_used + entry.nbytes > self.capacity_bytes:
+            self._evict_one()
+        self._entries[node_id] = entry
+        self.bytes_used += entry.nbytes
+        self.spilled += 1
+        return True
+
+    def _evict_one(self) -> None:
+        nid, entry = self._entries.popitem(last=False)
+        self.bytes_used -= entry.nbytes
+        self.evictions += 1
+        self._prune(nid)
+
+    def _drop(self, node_id: int) -> None:
+        entry = self._entries.pop(node_id, None)
+        if entry is not None:
+            self.bytes_used -= entry.nbytes
+            self._prune(node_id)
+
+    def fetch(self, node_id: int) -> dict[str, np.ndarray] | None:
+        """crc-verified arrays for ``node_id`` (LRU touch), or None when
+        absent or corrupt — a corrupt entry is dropped and counted, never
+        served (the integrity contract the chaos drill pins)."""
+        entry = self._entries.get(node_id)
+        if entry is None:
+            return None
+        for name, (buf, _, _) in entry.parts.items():
+            if zlib.crc32(buf) != entry.crcs[name]:
+                self.corrupt_dropped += 1
+                self._drop(node_id)
+                return None
+        self._entries.move_to_end(node_id)
+        self.swapped_in += 1
+        return entry.arrays()
+
+    def corrupt(self, node_id: int, bit: int = 0) -> bool:
+        """Flip one bit of a resident entry IN PLACE (the
+        ``kvtier.swap_in:corrupt`` chaos action and the bit-rot drills) —
+        the next fetch must detect and drop it."""
+        entry = self._entries.get(node_id)
+        if entry is None:
+            return False
+        name = next(iter(entry.parts))
+        buf = entry.parts[name][0]
+        buf[(bit // 8) % len(buf)] ^= 1 << (bit % 8)
+        return True
+
+    def stats(self) -> dict:
+        return {
+            "capacity_bytes": self.capacity_bytes,
+            "bytes_used": self.bytes_used,
+            "entries": len(self._entries),
+            "nodes": len(self._nodes),
+            "spilled": self.spilled,
+            "swapped_in": self.swapped_in,
+            "dropped": self.dropped,
+            "evictions": self.evictions,
+            "corrupt_dropped": self.corrupt_dropped,
+        }

@@ -1,0 +1,200 @@
+"""Paged-KV wire format for prefill->decode handoff (ISSUE 13).
+
+DistServe/Splitwise-style disaggregation needs a finished prefill's KV to
+MOVE: a ``prefill_heavy`` replica serializes the prompt's full pages with
+this module, the gateway ships the blob intra-host, and the decode
+replica's engine imports it (``ContinuousEngine.import_kv``) — publishing
+the pages into its own content cache so the relayed request's admission
+prefix-matches them instead of re-prefilling.
+
+Format (all integers little-endian):
+
+``DKV1`` magic | u16 version | u16 flags (0) | u32 meta length |
+meta JSON (utf-8) | u32 meta crc32 | then per page x per pool part:
+u32 part length | part bytes | u32 part crc32.
+
+The meta block pins everything an importer must refuse to mis-apply:
+page size, layer/head/dim geometry, pool dtype + quantization, adapter
+root, part order, and the exact token blocks the pages hold (the content
+keys republish under — so the no-hash-collision chain invariant survives
+the process boundary: the importer publishes ``(parent_pid,
+exact_tokens)`` keys from these blocks, it never trusts a digest).
+
+Integrity is non-optional: a short read, a truncated tail, a length that
+runs past the buffer, or any crc mismatch raises
+:class:`KVTransferError` — a torn blob is rejected whole, never partially
+installed. The import side maps that to an HTTP 400 and the gateway falls
+back to plain relay (the decode replica re-prefills; zero client-visible
+failures — the ``kv.handoff`` chaos drill pins exactly this path).
+
+numpy + stdlib only: importable by the gateway-side tests without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+import numpy as np
+
+__all__ = ["KVTransferError", "deserialize_pages", "serialize_pages"]
+
+MAGIC = b"DKV1"
+VERSION = 1
+
+
+class KVTransferError(ValueError):
+    """A KV blob failed validation (torn/short read, crc mismatch, version
+    or geometry mismatch) — reject-don't-install."""
+
+
+def serialize_pages(meta: dict, pages: list[dict[str, np.ndarray]]) -> bytes:
+    """Serialize ``pages`` (one dict of per-pool arrays per page, every
+    page holding the same part names) under ``meta`` (JSON-serializable;
+    ``blocks`` must list each page's exact tokens). Part order is pinned in
+    the meta so both sides agree without trusting dict order on the wire."""
+    if not pages:
+        raise ValueError("nothing to serialize: pages is empty")
+    part_names = sorted(pages[0])
+    meta = dict(meta)
+    meta["version"] = VERSION
+    meta["n_pages"] = len(pages)
+    meta["parts"] = part_names
+    meta["part_dtypes"] = {
+        # dtype NAME, not .str: extension dtypes (ml_dtypes bfloat16) have
+        # an opaque '<V2' .str that np.dtype() rebuilds as raw void —
+        # silent KV corruption; the name round-trips via _dtype below.
+        name: np.ascontiguousarray(pages[0][name]).dtype.name
+        for name in part_names
+    }
+    meta["part_shapes"] = {
+        name: list(np.asarray(pages[0][name]).shape) for name in part_names
+    }
+    mbytes = json.dumps(meta, sort_keys=True).encode("utf-8")
+    out = bytearray()
+    out += MAGIC
+    out += struct.pack("<HH", VERSION, 0)
+    out += struct.pack("<I", len(mbytes))
+    out += mbytes
+    out += struct.pack("<I", zlib.crc32(mbytes))
+    for page in pages:
+        if sorted(page) != part_names:
+            raise ValueError(
+                f"page part names differ: {sorted(page)} vs {part_names}"
+            )
+        for name in part_names:
+            part = np.ascontiguousarray(page[name]).tobytes()
+            out += struct.pack("<I", len(part))
+            out += part
+            out += struct.pack("<I", zlib.crc32(part))
+    return bytes(out)
+
+
+def _dtype(name) -> np.dtype:
+    """dtype from its wire NAME, tolerating jax's ml_dtypes extensions
+    (bfloat16 etc. register with numpy only once ml_dtypes is imported).
+    Any failure — including attacker-chosen garbage reaching np.dtype —
+    is a KVTransferError, never a stray TypeError out of the endpoint."""
+    if not isinstance(name, str):
+        raise KVTransferError(f"part dtype is not a string: {name!r}")
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+        except (ImportError, AttributeError, TypeError) as e:
+            raise KVTransferError(
+                f"unknown part dtype {name!r} in KV blob"
+            ) from e
+
+
+def _take(blob: bytes, off: int, n: int, what: str) -> tuple[bytes, int]:
+    if off + n > len(blob):
+        raise KVTransferError(
+            f"torn KV blob: {what} runs past the buffer "
+            f"({off + n} > {len(blob)} bytes)"
+        )
+    return blob[off: off + n], off + n
+
+
+def deserialize_pages(blob: bytes) -> tuple[dict, list[dict[str, np.ndarray]]]:
+    """Parse and VERIFY a :func:`serialize_pages` blob; returns
+    ``(meta, pages)``. Any integrity failure raises
+    :class:`KVTransferError` before a single array is returned."""
+    head, off = _take(blob, 0, 12, "header")
+    if head[:4] != MAGIC:
+        raise KVTransferError(
+            f"bad magic {head[:4]!r} (not a DKV1 KV blob)"
+        )
+    version, flags = struct.unpack("<HH", head[4:8])
+    if version != VERSION:
+        raise KVTransferError(
+            f"unsupported KV blob version {version} (this side speaks "
+            f"{VERSION})"
+        )
+    if flags != 0:
+        raise KVTransferError(f"unsupported KV blob flags {flags:#x}")
+    (mlen,) = struct.unpack("<I", head[8:12])
+    mbytes, off = _take(blob, off, mlen, "meta")
+    crc_raw, off = _take(blob, off, 4, "meta crc")
+    if zlib.crc32(mbytes) != struct.unpack("<I", crc_raw)[0]:
+        raise KVTransferError("meta crc32 mismatch (corrupt KV blob)")
+    try:
+        meta = json.loads(mbytes)
+    except json.JSONDecodeError as e:
+        raise KVTransferError(f"meta is not valid JSON: {e}") from e
+    part_names = meta.get("parts")
+    n_pages = meta.get("n_pages")
+    blocks = meta.get("blocks")
+    if (not isinstance(part_names, list) or not part_names
+            or not isinstance(n_pages, int) or n_pages < 1
+            or not isinstance(blocks, list) or len(blocks) != n_pages):
+        raise KVTransferError("meta missing parts/n_pages/blocks")
+    # The dtype/shape tables are as much attack/skew surface as the bytes:
+    # a crc-valid blob from a patched or fuzzing peer must still fail as a
+    # KVTransferError (the endpoint's 400 contract), never a KeyError.
+    dtypes = meta.get("part_dtypes")
+    shapes = meta.get("part_shapes")
+    if not isinstance(dtypes, dict) or not isinstance(shapes, dict):
+        raise KVTransferError("meta missing part dtype/shape tables")
+    part_meta: dict[str, tuple[np.dtype, tuple[int, ...]]] = {}
+    for name in part_names:
+        if name not in dtypes or name not in shapes:
+            raise KVTransferError(f"meta missing dtype/shape for {name!r}")
+        shape = shapes[name]
+        if (not isinstance(shape, list) or not shape
+                or not all(isinstance(x, int) and x > 0 for x in shape)):
+            raise KVTransferError(
+                f"bad shape for part {name!r}: {shape!r}"
+            )
+        part_meta[name] = (_dtype(dtypes[name]), tuple(shape))
+    pages: list[dict[str, np.ndarray]] = []
+    for p in range(n_pages):
+        page: dict[str, np.ndarray] = {}
+        for name in part_names:
+            lraw, off = _take(blob, off, 4, f"page {p} part {name} length")
+            (plen,) = struct.unpack("<I", lraw)
+            part, off = _take(blob, off, plen, f"page {p} part {name}")
+            craw, off = _take(blob, off, 4, f"page {p} part {name} crc")
+            if zlib.crc32(part) != struct.unpack("<I", craw)[0]:
+                raise KVTransferError(
+                    f"crc32 mismatch on page {p} part {name} "
+                    "(corrupt KV blob)"
+                )
+            dt, shape = part_meta[name]
+            want = int(np.prod(shape)) * dt.itemsize
+            if plen != want:
+                raise KVTransferError(
+                    f"page {p} part {name}: {plen} bytes for shape "
+                    f"{shape} dtype {dt} (want {want})"
+                )
+            page[name] = np.frombuffer(part, dtype=dt).reshape(shape)
+        pages.append(page)
+    if off != len(blob):
+        raise KVTransferError(
+            f"trailing garbage: {len(blob) - off} bytes past the last page"
+        )
+    return meta, pages

@@ -36,9 +36,18 @@ from __future__ import annotations
 
 from collections import OrderedDict, deque
 
-__all__ = ["PageAllocator", "block_keys"]
+__all__ = ["EvictedPage", "PageAllocator", "block_keys"]
 
 PageKey = tuple[int, tuple[int, ...]]
+
+# One page leaving the content cache, as ``on_evict`` reports it: the
+# physical id being reclaimed, the chain ROOT (<= 0 adapter namespace),
+# and the exact token blocks from the root up to and including this page.
+# The blocks — not the physical key — are what survive the tier boundary:
+# host_tier.py re-interns them under never-recycled node ids, so a spilled
+# entry can never verify against a recycled physical id's new content
+# (ISSUE 13).
+EvictedPage = tuple[int, int, tuple[tuple[int, ...], ...]]
 
 
 def block_keys(tokens: list[int], page_size: int, parents: list[int]) -> list[PageKey]:
@@ -56,17 +65,26 @@ def block_keys(tokens: list[int], page_size: int, parents: list[int]) -> list[Pa
 class PageAllocator:
     """Refcounted page pool bookkeeping with content-hash reuse."""
 
-    def __init__(self, n_pages: int, on_evict=None):
+    def __init__(self, n_pages: int, on_evict=None, group_payload=None):
         if n_pages < 2:
             raise ValueError(f"need >= 2 pages (page 0 is reserved), got {n_pages}")
         self.n_pages = n_pages
         # LRU reclaims of published (cache-only) pages. ``on_evict`` is an
-        # optional zero-arg callback fired once per reclaimed page — the
-        # engine wires its ``prefix_cache_evictions`` counter here so pool
-        # pressure that churns the content cache is visible on /metrics
-        # (ISSUE 8), not just as a mysteriously low hit ratio.
+        # optional callback fired once per reclaim with the full evicted
+        # GROUP — the claimed page plus every cascaded descendant, parent
+        # first, each as an :data:`EvictedPage` — BEFORE the pages are
+        # handed back, so the engine can both count the eviction
+        # (``prefix_cache_evictions``, ISSUE 8) and capture the KV for the
+        # host-RAM tier spill (ISSUE 13) while the content is still
+        # addressable. ``group_payload`` (zero-arg predicate, default
+        # always-True) gates that collection: a tier-less, handoff-less
+        # engine consumes only the eviction COUNT, and walking chains /
+        # materializing block tuples inside ``alloc`` on the admission
+        # path would be pure waste there — the callback then receives an
+        # empty tuple.
         self.evictions = 0
         self._on_evict = on_evict
+        self._group_payload = group_payload
         self._free: deque[int] = deque(range(1, n_pages))
         self._ref = [0] * n_pages
         self._key_to_page: dict[PageKey, int] = {}
@@ -79,6 +97,14 @@ class PageAllocator:
         self._children: dict[int, set[PageKey]] = {}
         # Insertion-ordered: oldest published key evicts first.
         self._lru: OrderedDict[PageKey, None] = OrderedDict()
+        # Incrementally-maintained count of published pages whose only
+        # reference is the content cache (ref == 1). The gateway's
+        # freshness window polls every replica's /stats AND /health each
+        # interval, and the old O(published-pages) scan ran on every poll —
+        # at fleet scale that is a per-second full-cache walk (ISSUE 13
+        # satellite). Updated at every ref/publish transition; pinned
+        # equal to the scan by test_kvtier's equivalence drill.
+        self._evictable = 0
 
     # -- capacity ------------------------------------------------------------
 
@@ -88,7 +114,15 @@ class PageAllocator:
 
     @property
     def n_evictable(self) -> int:
-        # list() snapshots atomically under the GIL: /v1/stats reads this
+        """Published pages reclaimable right now (cache-only reference).
+        O(1): an incrementally-updated counter, not a scan — /stats and
+        /health poll this from HTTP threads every gateway interval."""
+        return self._evictable
+
+    def scan_evictable(self) -> int:
+        """The O(published-pages) ground truth ``n_evictable`` used to
+        recompute per call — kept as the equivalence-test oracle."""
+        # list() snapshots atomically under the GIL: callers may read this
         # from HTTP threads while the driver thread publishes/evicts.
         return sum(
             1 for k, p in list(self._key_to_page.items()) if self._ref[p] == 1
@@ -120,12 +154,56 @@ class PageAllocator:
         for key in self._lru:
             pid = self._key_to_page[key]
             if self._ref[pid] == 1:  # only the content cache holds it
+                # Collect the whole group (claimed page + cascaded
+                # descendants, parent first) BEFORE unpublishing: the
+                # chain walk needs the maps intact, and the host-tier
+                # spill needs every page the reclaim is about to make
+                # unmatchable, not just the one the allocator claims.
+                group = ()
+                if self._on_evict is not None and (
+                    self._group_payload is None or self._group_payload()
+                ):
+                    group = self._collect_group(key, pid)
                 self._unpublish(key, pid, claimed=True)
                 self.evictions += 1
                 if self._on_evict is not None:
-                    self._on_evict()
+                    self._on_evict(group)
                 return pid
         return None
+
+    def _chain_blocks(self, pid: int) -> tuple[int, tuple[tuple[int, ...], ...]]:
+        """``(root, token blocks root..pid)`` for a PUBLISHED page — walks
+        parent keys up. Every published page's ancestors are published (the
+        unpublish cascade guarantees it), so the walk always reaches a
+        non-positive root."""
+        blocks: list[tuple[int, ...]] = []
+        cur = pid
+        while cur > 0:
+            key = self._page_key[cur]
+            blocks.append(key[1])
+            cur = key[0]
+        return cur, tuple(reversed(blocks))
+
+    def _collect_group(
+        self, key: PageKey, pid: int,
+        root: int | None = None,
+        blocks: tuple[tuple[int, ...], ...] | None = None,
+    ) -> list[EvictedPage]:
+        """Claimed page + cascaded descendants, parent first. The chain
+        walk runs ONCE for the head; descendants extend the parent's
+        blocks incrementally (token tuples shared by reference) — a
+        per-member walk would make a deep cascade O(depth^2) of tuple
+        materialization inside alloc() on the admission path."""
+        if blocks is None:
+            root, blocks = self._chain_blocks(pid)
+        out: list[EvictedPage] = [(pid, root, blocks)]
+        for child_key in list(self._children.get(pid, ())):
+            child_pid = self._key_to_page.get(child_key)
+            if child_pid is not None:
+                out.extend(self._collect_group(
+                    child_key, child_pid, root, blocks + (child_key[1],)
+                ))
+        return out
 
     def _unpublish(self, key: PageKey, pid: int, *, claimed: bool) -> None:
         """Remove a published key (and cascade through descendants).
@@ -135,6 +213,10 @@ class PageAllocator:
         list. Cascaded descendants are never claimed: dropping the cache's
         reference frees them when nothing else holds them (in-flight users
         keep their refcounts; only matchability and the cache ref go)."""
+        if self._ref[pid] == 1:
+            # Leaving the published set while cache-only: no longer counted
+            # evictable (release() below won't see it published anymore).
+            self._evictable -= 1
         del self._key_to_page[key]
         del self._page_key[pid]
         self._lru.pop(key, None)
@@ -156,6 +238,8 @@ class PageAllocator:
             self.release(pid)  # the cache's own reference
 
     def retain(self, pid: int) -> None:
+        if self._ref[pid] == 1 and pid in self._page_key:
+            self._evictable -= 1  # published cache-only page gains a user
         self._ref[pid] += 1
 
     def release(self, pid: int) -> None:
@@ -164,6 +248,8 @@ class PageAllocator:
         self._ref[pid] -= 1
         if self._ref[pid] < 0:
             raise AssertionError(f"double release of page {pid}")
+        if self._ref[pid] == 1 and pid in self._page_key:
+            self._evictable += 1  # published page dropped to cache-only
         if self._ref[pid] == 0:
             self._free.append(pid)
 
@@ -187,6 +273,12 @@ class PageAllocator:
         self._children.setdefault(key[0], set()).add(key)
         self._lru[key] = None
         self._ref[pid] += 1
+        if self._ref[pid] == 1:
+            # Publishers normally hold their own reference (so ref lands at
+            # >= 2 here); a publish from a bare cache insert — the host-tier
+            # swap-in path releases its alloc ref after publishing — makes
+            # the page immediately evictable.
+            self._evictable += 1
 
     def publish_chain(
         self, tokens: list[int], page_size: int, own_pages: list[int],

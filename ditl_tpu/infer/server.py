@@ -292,6 +292,10 @@ class _Handler(BaseHTTPRequestHandler):
     # /incidents listing endpoint; None => 404 (unarmed is distinguishable
     # from "no incidents").
     incidents = None
+    # KV handoff (ISSUE 13): arms the /internal/prefill + /internal/
+    # kv_handoff endpoints (paged continuous engines only) and the
+    # kv_handoff flag on /health the gateway's orchestration keys on.
+    kv_handoff_enabled: bool = False
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
@@ -339,6 +343,19 @@ class _Handler(BaseHTTPRequestHandler):
             if isinstance(pc, dict):
                 out["cache_hit_tokens"] = int(pc.get("hit_tokens", 0))
                 out["cache_miss_tokens"] = int(pc.get("miss_tokens", 0))
+            # KV-handoff cost-model inputs (ISSUE 13): the gateway's
+            # transfer-vs-re-prefill decision reads these from ordinary
+            # health polls. Measured values only — absent until the engine
+            # has prefilled/imported something (absent != 0; the model
+            # falls back to its configured floors).
+            for key in ("prefill_tok_per_s", "kv_bytes_per_token"):
+                if key in st:
+                    out[key] = st[key]
+            kvt = st.get("kv_transfer")
+            if isinstance(kvt, dict) and "put_mbps" in kvt:
+                out["kv_put_mbps"] = kvt["put_mbps"]
+            if self.kv_handoff_enabled and st.get("cache_mode") == "paged":
+                out["kv_handoff"] = True
             return out
         inflight = int(getattr(self.server, "inflight", 0))
         return {
@@ -558,12 +575,24 @@ class _Handler(BaseHTTPRequestHandler):
         self._rid = None  # fresh id per request on keep-alive connections
         try:
             length = int(self.headers.get("Content-Length", 0))
-            payload = json.loads(self.rfile.read(length) or b"{}")
-        except (ValueError, json.JSONDecodeError) as e:
+            raw = self.rfile.read(length)
+        except (ValueError, OSError) as e:
             self._send_json(400, {"error": {"message": f"bad request: {e}"}})
             return
         path = self.path.rstrip("/")
-        if path.endswith(("/chat/completions", "/completions", "/embeddings")):
+        if path.endswith("/internal/kv_handoff"):
+            # Binary paged-KV blob (infer/kv_transfer.py) — never decoded
+            # as JSON; its own header/crc framing rejects torn payloads.
+            self._kv_handoff(raw or b"")
+            return
+        try:
+            payload = json.loads(raw or b"{}")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": {"message": f"bad request: {e}"}})
+            return
+        if path.endswith("/internal/prefill"):
+            self._internal_prefill(payload)
+        elif path.endswith(("/chat/completions", "/completions", "/embeddings")):
             self._device_work(payload, path)
         elif path.endswith("/tokenize"):
             tok = self.generator.tokenizer
@@ -590,6 +619,90 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {"prompt": tok.decode(ids)})
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    # -- prefill->decode KV handoff (ISSUE 13) -------------------------------
+
+    def _kv_gate(self):
+        """Common gate for the /internal KV endpoints: 404 unless handoff
+        is armed on a paged continuous engine (unarmed is distinguishable
+        from broken); 503 while draining (the rolling-restart protocol —
+        the gateway falls back to plain relay)."""
+        eng = self.threaded_engine
+        if (not self.kv_handoff_enabled or eng is None
+                or getattr(eng, "_engine", None) is None
+                or eng._engine.cache_mode != "paged"):
+            self._send_json(404, {"error": {"message":
+                "KV handoff not armed on this replica "
+                "(--kv-handoff with a paged continuous engine)"}})
+            return None
+        if getattr(self.server, "draining", False):
+            self._send_json(503, {"error": {"message":
+                "server is draining; retry on another replica",
+                "type": "unavailable_error"}})
+            return None
+        return eng
+
+    def _internal_prefill(self, payload: dict) -> None:
+        """Prefill-export half of the handoff: tokenize exactly like
+        /v1/completions does (the shipped pages must match the relayed
+        request's block keys bit-for-bit), prefill whatever isn't cached,
+        and answer the serialized page blob. Runs on the engine driver
+        thread via ThreadedEngine.call — handler threads never touch
+        device state mid-tick."""
+        eng = self._kv_gate()
+        if eng is None:
+            return
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, str) or not prompt:
+            self._send_json(400, {"error": {"message":
+                "internal/prefill wants a non-empty string 'prompt'"}})
+            return
+        from ditl_tpu.infer.continuous import BadRequestError
+
+        tok = self.generator.tokenizer
+        ids = [tok.bos_id] + tok.encode(prompt)
+        try:
+            blob, shipped = eng.call(lambda: eng._engine.export_kv(ids))
+        except BadRequestError as e:
+            self._send_json(400, {"error": {"message": str(e)}})
+            return
+        except MemoryError as e:
+            self._send_json(503, {"error": {"message": str(e)}})
+            return
+        except RuntimeError as e:
+            self._send_json(500, {"error": {"message": str(e)}})
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/octet-stream")
+        self.send_header("X-Request-Id", self._request_id())
+        self.send_header("X-KV-Tokens", str(shipped))
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def _kv_handoff(self, raw: bytes) -> None:
+        """Import half of the handoff: install + publish a shipped
+        prefill's pages so the relayed request's admission prefix-matches
+        them. Torn/crc-failing/mismatched blobs answer 400 (counted on
+        ``kv_handoff_rejected``) — reject-don't-install; the gateway's
+        fallback relay re-prefills."""
+        eng = self._kv_gate()
+        if eng is None:
+            return
+        from ditl_tpu.infer.continuous import BadRequestError
+        from ditl_tpu.infer.kv_transfer import KVTransferError
+
+        try:
+            res = eng.call(lambda: eng._engine.import_kv(raw))
+        except (KVTransferError, BadRequestError, ValueError) as e:
+            if self.serving_metrics is not None:
+                self.serving_metrics.kv_handoff_rejected.inc()
+            self._send_json(400, {"error": {"message": str(e)}})
+            return
+        except RuntimeError as e:
+            self._send_json(500, {"error": {"message": str(e)}})
+            return
+        self._send_json(200, res)
 
     def _device_work(self, payload: dict, path: str) -> None:
         """Admission wrapper for the device-occupying POST routes
@@ -1649,6 +1762,7 @@ def make_server(
     incidents=None,
     serving_metrics: ServingMetrics | None = None,
     cold_start_s: float | None = None,
+    kv_handoff: bool = False,
 ) -> DrainableHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
@@ -1711,6 +1825,7 @@ def make_server(
             "slo": slo,
             "role": role,
             "incidents": incidents,
+            "kv_handoff_enabled": kv_handoff,
         },
     )
     server = DrainableHTTPServer((host, port), handler)
@@ -1759,6 +1874,27 @@ def serve(argv: list[str] | None = None) -> int:
         "prompts cannot stall decode-ready streams (stall-free batching; "
         "pair with --prefill-chunk). Must cover one full decode tick "
         "(slots x decode-chunk); 0 = unbudgeted",
+    )
+    parser.add_argument(
+        "--host-tier-mb", type=float, default=0.0,
+        help="host-RAM prefix-cache tier capacity in MiB (ISSUE 13): "
+        "LRU-evicted published KV pages spill to host memory and swap "
+        "back in on admission miss, so the shared-prefix working set "
+        "stops being bounded by HBM pages. Requires --cache-mode paged; "
+        "0 = off",
+    )
+    parser.add_argument(
+        "--spill-max-pages-per-tick", type=int, default=32,
+        help="per-tick cap on pages the host tier's spill batch moves "
+        "device->host (bounds the one batched device_get a tick pays; "
+        "the remainder carries over)",
+    )
+    parser.add_argument(
+        "--kv-handoff", action="store_true",
+        help="serve the /internal/prefill + /internal/kv_handoff "
+        "endpoints (ISSUE 13): the gateway ships a prefill_heavy "
+        "replica's finished prefill here instead of re-prefilling. "
+        "Requires a paged continuous engine",
     )
     parser.add_argument(
         "--speculative", choices=("off", "on", "auto"), default="off",
@@ -2008,6 +2144,24 @@ def serve(argv: list[str] | None = None) -> int:
                      "tick broadcast does not carry grammar registrations)")
     if args.pipeline_ticks and args.engine != "continuous":
         parser.error("--pipeline-ticks requires --engine continuous")
+    if args.host_tier_mb and (
+        args.engine != "continuous" or args.cache_mode != "paged"
+    ):
+        parser.error("--host-tier-mb requires --engine continuous with "
+                     "--cache-mode paged (the tier spills and swaps KV "
+                     "pages)")
+    if args.host_tier_mb and args.pod:
+        parser.error("--host-tier-mb does not compose with --pod yet "
+                     "(every process would pay the spill fetch, and "
+                     "handoff imports would desync the replicated "
+                     "scheduler)")
+    if args.kv_handoff and (
+        args.engine != "continuous" or args.cache_mode != "paged"
+        or args.pod
+    ):
+        parser.error("--kv-handoff requires a solo paged continuous "
+                     "engine (--engine continuous --cache-mode paged, "
+                     "no --pod)")
     # --pipeline-ticks and --admission optimistic both compose with --pod:
     # the lagged harvest and the preemption decisions (_topup_pages /
     # _pick_victim) are deterministic functions of the replicated scheduler
@@ -2162,6 +2316,8 @@ def serve(argv: list[str] | None = None) -> int:
             pipeline_ticks=args.pipeline_ticks,
             admission=args.admission,
             token_budget=args.token_budget,
+            host_tier_mb=args.host_tier_mb,
+            spill_max_pages_per_tick=args.spill_max_pages_per_tick,
             tracer=tracer,
             # Incident plane (ISSUE 10): shared metrics bundle + flight
             # recorder + detector monitor when --incident-dir armed them.
@@ -2231,6 +2387,7 @@ def serve(argv: list[str] | None = None) -> int:
         tracer=tracer, telemetry=telemetry_cfg, role=args.role,
         slo=slo, incidents=incidents, serving_metrics=serving_metrics,
         cold_start_s=time.monotonic() - t_serve_start,
+        kv_handoff=args.kv_handoff and threaded is not None and pod is None,
     )
 
     # SIGTERM = graceful drain (the gateway/orchestrator rolling-restart

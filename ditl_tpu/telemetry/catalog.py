@@ -109,6 +109,10 @@ _ROWS: tuple = (
     ("ditl_gateway_fleet_prefix_cache_hit_ratio", "gauge", "", "token-weighted fleet prefix-cache hit ratio - compare against the affinity hit-rate counters"),
     ("ditl_gateway_fleet_recent_prefix_cache_hit_ratio", "gauge", "", "token-weighted fleet prefix-cache hit ratio over the recent health-poll window"),
     ("ditl_gateway_fleet_saturated_total", "counter", "", "requests 429'd because every replica was saturated"),
+    ("ditl_gateway_handoff_attempted_total", "counter", "", "requests evaluated by the KV-handoff transfer-cost model"),
+    ("ditl_gateway_handoff_declined_total", "counter", "", "handoffs the cost model declined (re-prefill estimated cheaper than the transfer)"),
+    ("ditl_gateway_handoff_fallback_total", "counter", "", "accepted handoffs that failed mid-leg and fell back to plain relay (the decode replica re-prefills)"),
+    ("ditl_gateway_handoff_shipped_total", "counter", "", "prefill->decode KV handoffs shipped to the decode replica"),
     ("ditl_gateway_hedges_total", "counter", "", "hedged duplicate requests fired"),
     ("ditl_gateway_no_replica_total", "counter", "", "requests failed with no live replica"),
     ("ditl_gateway_relayed_by_class_batch_total", "counter", "", "requests relayed carrying SLO class batch"),
@@ -174,11 +178,31 @@ _ROWS: tuple = (
     ("ditl_serving_guided_fsm_capacity", "gauge", "", "grammar FSM table rows available"),
     ("ditl_serving_guided_fsm_rows_used", "gauge", "", "grammar FSM table rows in use"),
     ("ditl_serving_guided_grammars_registered", "gauge", "", "distinct grammars registered"),
+    ("ditl_serving_host_tier_bytes_used", "gauge", "", "host-RAM tier KV bytes resident", True),
+    ("ditl_serving_host_tier_capacity_bytes", "gauge", "", "host-RAM tier size cap (kvtier.host_tier_mb)", True),
+    ("ditl_serving_host_tier_corrupt_dropped", "gauge", "", "host-tier entries dropped on crc mismatch (stats mirror)", True),
+    ("ditl_serving_host_tier_corrupt_entries_total", "counter", "", "host-tier entries whose crc32 failed at swap-in — detected, dropped, and re-prefilled; never served"),
+    ("ditl_serving_host_tier_dropped", "gauge", "", "host-tier spill pages refused at the cap (stats mirror)", True),
+    ("ditl_serving_host_tier_dropped_pages_total", "counter", "", "spill pages dropped (tier cap, oversized entry, or an injected kvtier.spill fault)"),
+    ("ditl_serving_host_tier_entries", "gauge", "", "host-RAM tier entries resident", True),
+    ("ditl_serving_host_tier_evictions_total", "counter", "", "host-tier entries LRU-evicted under the size cap"),
+    ("ditl_serving_host_tier_nodes", "gauge", "", "host-tier chain nodes interned (the never-recycled key space)", True),
+    ("ditl_serving_host_tier_spilled", "gauge", "", "lifetime pages spilled into the host tier (stats mirror)", True),
+    ("ditl_serving_host_tier_spilled_pages_total", "counter", "", "LRU-evicted published pages spilled into the host-RAM tier"),
+    ("ditl_serving_host_tier_swap_in_seconds", "histogram", "", "host-tier swap-in latency per admission (crc verify + device_put + republish of the matched run)"),
+    ("ditl_serving_host_tier_swapped_in", "gauge", "", "lifetime pages swapped back in from the host tier (stats mirror)", True),
+    ("ditl_serving_host_tier_swapped_pages_total", "counter", "", "host-tier pages swapped back into the device pool on an admission miss"),
     ("ditl_serving_inflight", "gauge", "", "HTTP requests currently in flight"),
     ("ditl_serving_interference_max_by_class_batch", "gauge", "", "worst interference stall absorbed by a batch victim (s)", True),
     ("ditl_serving_interference_max_by_class_best_effort", "gauge", "", "worst interference stall absorbed by a best_effort victim (s)", True),
     ("ditl_serving_interference_max_by_class_interactive", "gauge", "", "worst interference stall absorbed by an interactive victim (s)", True),
     ("ditl_serving_interference_max_s", "gauge", "", "largest single prefill-interference stall observed (s)"),
+    ("ditl_serving_kv_bytes_per_token", "gauge", "", "KV bytes one token occupies in the page pools - the handoff cost model's size input", True),
+    ("ditl_serving_kv_handoff_imports_total", "counter", "", "prefill->decode KV blobs imported by this replica"),
+    ("ditl_serving_kv_handoff_rejected_total", "counter", "", "KV handoff blobs rejected (torn/short read, crc mismatch, or geometry mismatch) — reject-don't-install"),
+    ("ditl_serving_kv_handoff_tokens_total", "counter", "", "prompt tokens installed from shipped prefill-handoff pages"),
+    ("ditl_serving_kv_transfer_imported_bytes", "gauge", "", "lifetime KV handoff bytes imported", True),
+    ("ditl_serving_kv_transfer_put_mbps", "gauge", "", "measured device_put bandwidth over KV imports - the handoff cost model's transfer input", True),
     ("ditl_serving_lockstep_speculative", "gauge", "", "1 when lock-step speculative serving is armed"),
     ("ditl_serving_lockstep_speculative_acceptance", "gauge", "", "lock-step speculative acceptance EMA"),
     ("ditl_serving_max_context", "gauge", "", "per-slot KV context cap (tokens)"),
@@ -190,8 +214,12 @@ _ROWS: tuple = (
     ("ditl_serving_pages_total", "gauge", "", "KV pages in the pool (sentinel excluded)"),
     ("ditl_serving_pod", "gauge", "", "1 on a pod-serving coordinator (tick-broadcast driver)", True),
     ("ditl_serving_preemptions_total", "counter", "", "optimistic-admission preemptions (pages reclaimed mid-flight)"),
+    ("ditl_serving_prefill_tok_per_s", "gauge", "", "measured lifetime prefill throughput - the re-prefill side of the handoff cost model", True),
     ("ditl_serving_prefix_cache_evictions_total", "counter", "", "published prefix pages reclaimed by LRU eviction under pool pressure"),
     ("ditl_serving_prefix_cache_hit_ratio", "gauge", "", "measured hit tokens / (hit + miss) tokens — the number the gateway affinity router's score is validated against"),
+    ("ditl_serving_prefix_cache_hit_tokens_handoff_total", "counter", "", "prompt tokens reused via the handoff tier (pages shipped by a prefill->decode handoff)"),
+    ("ditl_serving_prefix_cache_hit_tokens_hbm_total", "counter", "", "prompt tokens reused via the hbm tier (published pages resident in the device pool)"),
+    ("ditl_serving_prefix_cache_hit_tokens_host_total", "counter", "", "prompt tokens reused via the host tier (pages swapped back in from the host-RAM tier)"),
     ("ditl_serving_prefix_cache_hit_tokens_total", "counter", "", "prompt tokens whose KV was reused from the prefix cache at slot admission (paged content-hash match or registered prefix)"),
     ("ditl_serving_prefix_cache_miss_tokens_total", "counter", "", "prompt tokens the engine prefilled because no cached KV covered them"),
     ("ditl_serving_queue_by_class_batch", "gauge", "", "queued batch-class requests"),

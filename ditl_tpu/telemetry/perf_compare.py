@@ -67,18 +67,30 @@ COMPARE_KEYS = {
     # buy replica-seconds with burned SLO budget).
     "replica_seconds": -1,
     "ttft_slo_violation_rate": -1,
+    # KV movement plane keys (ISSUE 13, the tier/handoff bench blocks —
+    # `schema`-stamped like the PR 8 serving block): the host-tier hit
+    # ratio regresses when it FALLS (the tier stopped absorbing eviction
+    # churn — 0.0 on tier-off rows never gates, the a == 0 rule); swap-in
+    # p95 regresses when it RISES (host hits are only wins while the
+    # device_put stays cheap); the handoff fallback ratio regresses when
+    # it rises (shipped prefills failing back to re-prefill means the
+    # handoff plane is burning work, not saving it).
+    "host_tier_hit_ratio": +1,
+    "swap_in_p95_s": -1,
+    "handoff_fallback_ratio": -1,
 }
 
 
 def _flat(rec: dict) -> dict:
     """The comparable view of one record/cell: top-level keys plus the
-    nested ``roofline`` (train rows), ``serving`` (serve rows), and
-    ``autoscale`` (trace-replay rows) blocks hoisted — without the hoist
-    the gate would silently never compare cost-counted MFU, the serving
-    scheduler metrics, or the replica-seconds the autoscaler A/B is
-    graded on."""
+    nested ``roofline`` (train rows), ``serving`` (serve rows),
+    ``autoscale`` (trace-replay rows), and ``kv_handoff`` (handoff-armed
+    gateway rows, ISSUE 13) blocks hoisted — without the hoist the gate
+    would silently never compare cost-counted MFU, the serving scheduler
+    metrics, the replica-seconds the autoscaler A/B is graded on, or the
+    handoff fallback ratio."""
     out = rec
-    for block in ("roofline", "serving", "autoscale"):
+    for block in ("roofline", "serving", "autoscale", "kv_handoff"):
         nested = rec.get(block)
         if isinstance(nested, dict):
             out = {**nested, **out}
@@ -114,6 +126,20 @@ def compare_metrics(
                 f"  {label}incidents: {int(old_inc)} -> {int(new_inc)} "
                 "(both sides had incidents; reported, not gated)"
             )
+    # Handoff-fallback gating (ISSUE 13): the generic direction loop
+    # below skips keys whose old value is 0 (no relative delta exists),
+    # which would make the fallback-ratio gate vacuous in exactly the
+    # normal case — a previously CLEAN handoff plane (ratio 0.0). Treat
+    # 0 -> >0 like incidents: fallbacks appearing is a regression class
+    # of its own, not a percentage move.
+    old_fb = old.get("handoff_fallback_ratio")
+    new_fb = new.get("handoff_fallback_ratio")
+    if (isinstance(new_fb, (int, float)) and new_fb > 0
+            and isinstance(old_fb, (int, float)) and old_fb == 0):
+        msg = (f"{label}handoff_fallback_ratio: 0 -> {new_fb:g} (shipped "
+               "prefills now failing back to re-prefill; previously clean)")
+        lines.append(f"  {msg} REGRESSION")
+        regressions.append(msg)
     # Invariant-lint gating (ISSUE 11 satellite): rows stamp
     # `analysis_clean` (bench runs `ditl_tpu.analysis` once per process).
     # clean -> dirty is a "now fails"-class regression — a perf win that

@@ -220,6 +220,58 @@ class ServingMetrics:
             "TTFT of requests whose prompt missed the prefix cache "
             "entirely", LATENCY_BUCKETS_S,
         )
+        # -- tiered hits + host tier + KV handoff (ISSUE 13) -------------
+        # The total hit counters above stay the PR 8 aggregate; the tier
+        # split says WHERE the reuse came from — an HBM match, a host-RAM
+        # swap-in, or a shipped prefill-handoff page. Host hits cost a
+        # device_put (the swap-in histogram), so conflating them with HBM
+        # hits would hide exactly the churn the tier absorbs.
+        self.prefix_cache_hit_tokens_by_tier = {
+            tier: r.counter(
+                f"{PREFIX}_prefix_cache_hit_tokens_{tier}",
+                f"prompt tokens reused via the {tier} tier "
+                f"({desc})",
+            )
+            for tier, desc in (
+                ("hbm", "published pages resident in the device pool"),
+                ("host", "pages swapped back in from the host-RAM tier"),
+                ("handoff", "pages shipped by a prefill->decode handoff"),
+            )
+        }
+        self.host_tier_swap_in = r.histogram(
+            f"{PREFIX}_host_tier_swap_in_seconds",
+            "host-tier swap-in latency per admission (crc verify + "
+            "device_put + republish of the matched run)",
+            LATENCY_BUCKETS_S,
+        )
+        self.host_tier_spilled_pages = r.counter(
+            f"{PREFIX}_host_tier_spilled_pages",
+            "LRU-evicted published pages spilled into the host-RAM tier")
+        self.host_tier_swapped_pages = r.counter(
+            f"{PREFIX}_host_tier_swapped_pages",
+            "host-tier pages swapped back into the device pool on an "
+            "admission miss")
+        self.host_tier_dropped_pages = r.counter(
+            f"{PREFIX}_host_tier_dropped_pages",
+            "spill pages dropped (tier cap, oversized entry, or an "
+            "injected kvtier.spill fault)")
+        self.host_tier_corrupt_entries = r.counter(
+            f"{PREFIX}_host_tier_corrupt_entries",
+            "host-tier entries whose crc32 failed at swap-in — detected, "
+            "dropped, and re-prefilled; never served")
+        self.host_tier_evictions = r.counter(
+            f"{PREFIX}_host_tier_evictions",
+            "host-tier entries LRU-evicted under the size cap")
+        self.kv_handoff_imports = r.counter(
+            f"{PREFIX}_kv_handoff_imports",
+            "prefill->decode KV blobs imported by this replica")
+        self.kv_handoff_tokens = r.counter(
+            f"{PREFIX}_kv_handoff_tokens",
+            "prompt tokens installed from shipped prefill-handoff pages")
+        self.kv_handoff_rejected = r.counter(
+            f"{PREFIX}_kv_handoff_rejected",
+            "KV handoff blobs rejected (torn/short read, crc mismatch, or "
+            "geometry mismatch) — reject-don't-install")
         # -- per-SLO-class splits (ISSUE 9) ------------------------------
         # The disaggregated-serving A/B is graded on INTERACTIVE latency
         # specifically (batch work is supposed to absorb the prefill
@@ -243,10 +295,23 @@ class ServingMetrics:
             for cls in SLO_CLASS_NAMES
         }
 
-    def note_prefix_cache(self, hit_tokens: int, miss_tokens: int) -> None:
-        """Record one admission's reused-vs-prefilled prompt token split."""
+    def note_prefix_cache(self, hit_tokens: int, miss_tokens: int,
+                          host_tokens: int = 0,
+                          handoff_tokens: int = 0) -> None:
+        """Record one admission's reused-vs-prefilled prompt token split.
+        ``host_tokens`` / ``handoff_tokens`` attribute part of the hit to
+        the host-RAM tier / a shipped handoff (ISSUE 13); the remainder is
+        an HBM hit. The total counters keep the PR 8 semantics exactly."""
         if hit_tokens > 0:
             self.prefix_cache_hit_tokens.inc(hit_tokens)
+            tiers = self.prefix_cache_hit_tokens_by_tier
+            hbm = hit_tokens - host_tokens - handoff_tokens
+            if hbm > 0:
+                tiers["hbm"].inc(hbm)
+            if host_tokens > 0:
+                tiers["host"].inc(host_tokens)
+            if handoff_tokens > 0:
+                tiers["handoff"].inc(handoff_tokens)
         if miss_tokens > 0:
             self.prefix_cache_miss_tokens.inc(miss_tokens)
 
@@ -320,6 +385,16 @@ def snapshot_serving(bundles: Sequence["ServingMetrics"]) -> dict:
         "evictions": sum(
             b.prefix_cache_evictions.value for b in bundles
         ),
+        # Tiered-hit + swap-in accounting (ISSUE 13): timed-region scoping
+        # for the host-tier block the bench rows embed.
+        "tier_hit": {
+            tier: sum(
+                b.prefix_cache_hit_tokens_by_tier[tier].value
+                for b in bundles
+            )
+            for tier in ("hbm", "host", "handoff")
+        },
+        "swap_in": _hist_snap([b.host_tier_swap_in for b in bundles]),
     }
 
 
@@ -353,6 +428,13 @@ def serving_bench_summary(bundles: Sequence["ServingMetrics"],
     hit = sum(b.prefix_cache_hit_tokens.value for b in bundles)
     miss = sum(b.prefix_cache_miss_tokens.value for b in bundles)
     evictions = sum(b.prefix_cache_evictions.value for b in bundles)
+    tier_hit = {
+        tier: sum(
+            b.prefix_cache_hit_tokens_by_tier[tier].value for b in bundles
+        )
+        for tier in ("hbm", "host", "handoff")
+    }
+    swap_in = merged_histogram([b.host_tier_swap_in for b in bundles])
     if since is not None:
         _subtract(interference, since["interference"])
         _subtract(ttft, since["ttft"])
@@ -362,6 +444,11 @@ def serving_bench_summary(bundles: Sequence["ServingMetrics"],
         hit -= since["hit"]
         miss -= since["miss"]
         evictions -= since["evictions"]
+        # Older snapshots (pre-ISSUE-13 sweep records) carry no tier keys.
+        for tier, v in since.get("tier_hit", {}).items():
+            tier_hit[tier] -= v
+        if "swap_in" in since:
+            _subtract(swap_in, since["swap_in"])
     out = {
         "interference_count": interference.count,
         "interference_total_s": round(interference.sum, 6),
@@ -383,6 +470,18 @@ def serving_bench_summary(bundles: Sequence["ServingMetrics"],
         out[f"{cls}_interference_count"] = i_h.count
     if hit + miss > 0:
         out["prefix_cache_hit_ratio"] = round(hit / (hit + miss), 4)
+        # Host-tier hit ratio (ISSUE 13): host-attributed reuse over ALL
+        # prompt tokens — the fraction of the working set the tier (not
+        # HBM) carried. 0.0 with the tier off, so perf_compare skips it
+        # on an off-leg (a == 0 never gates) and gates it round-over-round
+        # on tier-armed rows.
+        out["host_tier_hit_ratio"] = round(
+            tier_hit["host"] / (hit + miss), 4
+        )
+        out["tier_hit_tokens"] = dict(tier_hit)
+    sq = swap_in.quantile(0.95)
+    out["swap_in_count"] = swap_in.count
+    out["swap_in_p95_s"] = round(sq, 6) if sq is not None else None
     return out
 
 

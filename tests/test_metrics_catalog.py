@@ -74,7 +74,7 @@ def _collect_live() -> set[str]:
         params, cfg, ByteTokenizer(), n_slots=2, decode_chunk=8,
         cache_mode="paged", page_size=16, admission="optimistic",
         prefill_chunk=16, token_budget=64, speculative=True,
-        fsm_capacity=4, logprobs_k=2,
+        fsm_capacity=4, logprobs_k=2, host_tier_mb=1,
     )
     reserved = set(m.registry._metrics)
     live |= _families("\n".join(flattened_stats_lines(eng.stats(), reserved)))

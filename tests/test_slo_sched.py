@@ -98,7 +98,7 @@ def test_gateway_config_validates_tenant_slo_class():
 
 def test_page_allocator_counts_evictions():
     fired = []
-    alloc = PageAllocator(4, on_evict=lambda: fired.append(1))
+    alloc = PageAllocator(4, on_evict=fired.append)
     pages = alloc.alloc(3)  # the whole usable pool
     alloc.publish_chain(list(range(32)), 16, pages[:2])
     for pid in pages:
@@ -107,7 +107,13 @@ def test_page_allocator_counts_evictions():
     got = alloc.alloc(2)  # 1 free + 1 via LRU eviction
     assert len(got) == 2
     assert alloc.evictions == 1
-    assert fired == [1]
+    # The callback now carries the evicted group (ISSUE 13): the claimed
+    # parent plus its cascaded child, parent first, with exact chain blocks.
+    assert len(fired) == 1
+    group = fired[0]
+    assert [pid for pid, _, _ in group] == [pages[0], pages[1]]
+    assert group[0][1] == 0 and group[0][2] == (tuple(range(16)),)
+    assert group[1][2] == (tuple(range(16)), tuple(range(16, 32)))
 
 
 def test_merged_histogram_and_bench_summary_gate():
