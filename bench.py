@@ -890,8 +890,18 @@ def run_gateway_bench(n_replicas: int, slots: int = 4, decode_chunk: int = 8,
         # the policy picks (affinity hashes a handful of prompts to
         # arbitrary homes), leaving cold engines to compile inside the
         # timed section by a policy-dependent amount, which would corrupt
-        # the router A/B this bench exists for.
-        warms = [warm_prompt]
+        # the router A/B this bench exists for. The second warm prompt is
+        # the PREFIX-HIT admission shape: a group's second request
+        # prefix-matches its group's published pages and prefills only the
+        # short suffix — a DIFFERENT program than the whole-prompt warm.
+        # Without it that suffix program compiles inside the timed region
+        # (seconds on CPU) and lands as a fake multi-second interference
+        # observation on whichever decode co-scheduled with it — the
+        # compile-shaped flake the disagg A/B kept tripping. The warm
+        # prefix is distinct from every group prefix, so no group cache is
+        # seeded, and the serving block's post-warm snapshot excludes the
+        # warm-up hit tokens either way.
+        warms = [warm_prompt, f"{warm_prompt} q0"]
         if mixed_trace and view.role != "decode_heavy":
             warms.append(warm_long)
         for p in warms:
@@ -1314,6 +1324,279 @@ def bench_gateway(*args, **kwargs) -> int:
     """CLI wrapper over :func:`run_gateway_bench`: one JSON line, like
     every other bench mode."""
     print(json.dumps(run_gateway_bench(*args, **kwargs)))
+    return 0
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, int(q * len(sorted_vals))))
+    return sorted_vals[idx]
+
+
+def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
+                               clients: int = 3, pool_max_idle: int = -1,
+                               router: str = "round_robin") -> dict:
+    """Gateway data-plane overhead microbench (ISSUE 14): a closed loop
+    of keep-alive HTTP clients driving in-process STUB replicas — first
+    directly, then through the gateway — so the row isolates the
+    gateway's OWN per-request tax (routing, admission, relay, and the
+    upstream connect it used to pay per hop) from any device work. The
+    stubs do zero compute; this is the one serving number that is honest
+    on a CPU-only container.
+
+    The hoisted ``gateway_overhead`` block embeds requests/sec through
+    the gateway, the added latency vs the direct leg (p50/p95), and the
+    upstream pool's hit ratio + accepted-connection count;
+    ``telemetry/perf_compare.py`` gates the first three with direction
+    sense. ``pool_max_idle=0`` is the fresh-connect A/B leg (every
+    upstream hop connects fresh — the pre-pool behavior); the default
+    (-1) takes GatewayConfig's pooled default. The pooled-vs-fresh pair
+    on the same stub fleet is THE A/B this bench exists for.
+
+    Deliberately jax-free: stub replicas, the gateway, and the clients
+    are all stdlib — nothing here can be device noise."""
+    import http.client
+    import socket
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from ditl_tpu.config import GatewayConfig
+    from ditl_tpu.gateway import (
+        Fleet, GatewayMetrics, InProcessReplica, make_gateway,
+    )
+    from ditl_tpu.utils.http11 import KeepAliveHandlerMixin
+
+    _inc0 = _incidents_now()
+    if requests < clients:
+        raise ValueError(f"requests ({requests}) must be >= clients "
+                         f"({clients})")
+
+    stub_body = json.dumps({
+        "object": "text_completion",
+        "choices": [{"index": 0, "text": "stub", "finish_reason": "stop"}],
+        "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                  "total_tokens": 2},
+    }).encode()
+
+    class _StubServer(ThreadingHTTPServer):
+        """Keep-alive-capable replica stand-in with the lifecycle hooks
+        InProcessReplica drives, counting accepted TCP connections — the
+        number the pooled-vs-fresh A/B pins (pooled: ~pool size; fresh:
+        ~one per request)."""
+
+        daemon_threads = True
+        allow_reuse_address = True
+
+        def __init__(self, *args, **kw):
+            self.connections = 0
+            super().__init__(*args, **kw)
+
+        def process_request(self, request, client_address):
+            self.connections += 1
+            super().process_request(request, client_address)
+
+        def close(self, drain=True, timeout=30.0):
+            self.shutdown()
+            self.server_close()
+
+        def kill(self):
+            self.close()
+
+    class _StubHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _json(self, body: bytes):
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self._json(json.dumps({
+                "status": "ok", "draining": False, "queue_depth": 0,
+                "active_slots": 0, "n_slots": 8,
+            }).encode())
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers.get("Content-Length", 0)))
+            self._json(stub_body)
+
+    stubs: list = []
+
+    def factory():
+        server = _StubServer(("127.0.0.1", 0), _StubHandler)
+        stubs.append(server)
+        return server
+
+    fleet = Fleet([InProcessReplica(f"r{i}", factory)
+                   for i in range(n_replicas)])
+    # One try/finally covers startup too: a stub that fails its probe (or
+    # a gateway that fails to build) must not leak already-started stub
+    # serve loops into the calling process — the tier-1 A/B drill runs
+    # this in-process (the run_trace_replay_bench lesson).
+    server = None
+    try:
+        fleet.start_all()
+        for rid in fleet.ids:
+            if not fleet.probe(rid, timeout=5.0):
+                raise RuntimeError(f"stub replica {rid} failed its probe")
+        gwcfg_kwargs = dict(router=router)
+        if pool_max_idle >= 0:
+            gwcfg_kwargs["pool_max_idle_per_replica"] = pool_max_idle
+        gwcfg = GatewayConfig(**gwcfg_kwargs)
+        server = make_gateway(fleet, config=gwcfg,
+                              metrics=GatewayMetrics(), port=0)
+    except BaseException:
+        if server is not None:
+            server.server_close()
+        fleet.stop_all(drain=False)
+        raise
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    gw_port = server.server_address[1]
+    payload = json.dumps({"prompt": "overhead probe",
+                          "max_tokens": 1}).encode()
+    per_client = requests // clients
+    total = per_client * clients
+
+    def drive(port: int, latencies: list) -> None:
+        # One kept-alive client connection per thread (both legs): the
+        # client side is held constant so the pooled-vs-fresh delta is
+        # the UPSTREAM hop alone.
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30.0)
+        try:
+            conn.connect()
+            # The client half of the keep-alive Nagle fix (utils/http11):
+            # without NODELAY every request on a kept-alive connection
+            # stalls ~40 ms behind the peer's delayed ACK.
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            for _ in range(per_client):
+                t0 = time.perf_counter()
+                conn.request("POST", "/v1/completions", body=payload,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.status != 200:
+                    # BEFORE recording the latency: a failed request must
+                    # fail the bench, never sneak into the gated
+                    # percentiles as a "served" sample.
+                    raise RuntimeError(
+                        f"overhead bench got {resp.status}: {data[:200]!r}"
+                    )
+                latencies.append(time.perf_counter() - t0)
+        finally:
+            conn.close()
+
+    def closed_loop(port: int) -> tuple[float, list]:
+        lat_lists = [[] for _ in range(clients)]
+        errors: list = []
+
+        def run(i):
+            try:
+                drive(port, lat_lists[i])
+            except BaseException as e:  # re-raised on the caller below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(i,), daemon=True)
+            for i in range(clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            # The real failure, not an opaque lost-request count.
+            raise errors[0]
+        lats = sorted(x for lst in lat_lists for x in lst)
+        if len(lats) != total:
+            raise RuntimeError(
+                f"overhead bench lost requests: {len(lats)} != {total}"
+            )
+        return dt, lats
+
+    try:
+        # Warm both legs outside the timed region (thread spawn, route
+        # compile — tiny, but the A/B is graded strictly), then snapshot
+        # the pool so its hit ratio covers the timed gateway loop only.
+        direct_addr = fleet.views()[0].address
+        for port in (direct_addr[1], gw_port):
+            warm: list = []
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=30.0)
+            try:
+                for _ in range(4):
+                    conn.request("POST", "/v1/completions", body=payload,
+                                 headers={"Content-Type":
+                                          "application/json"})
+                    resp = conn.getresponse()
+                    warm.append(resp.read())
+            finally:
+                conn.close()
+        direct_dt, direct_lats = closed_loop(direct_addr[1])
+        pool0 = fleet.pool.stats()
+        connects0 = sum(s.connections for s in stubs)
+        gw_dt, gw_lats = closed_loop(gw_port)
+        pool1 = fleet.pool.stats()
+        connects = sum(s.connections for s in stubs) - connects0
+    finally:
+        server.shutdown()
+        server.server_close()
+        fleet.stop_all(drain=False)
+    hits = pool1["hits"] - pool0["hits"]
+    misses = pool1["misses"] - pool0["misses"]
+    gw_rps = total / gw_dt
+    d_p50, d_p95 = _percentile(direct_lats, 0.50), _percentile(direct_lats,
+                                                               0.95)
+    g_p50, g_p95 = _percentile(gw_lats, 0.50), _percentile(gw_lats, 0.95)
+    pooled = fleet.pool.max_idle_per_replica > 0
+    return {
+        "metric": "gateway data-plane overhead (%d stub replica(s), "
+                  "pool=%s)" % (n_replicas, "on" if pooled else "off"),
+        **_record_meta(),
+        "value": round(gw_rps, 1),
+        "unit": "requests/sec",
+        "vs_baseline": 1.0,
+        "vs_baseline_key": "self",
+        # No jax import anywhere on this path — the platform stamp says
+        # so instead of lying with a device name.
+        "platform": "host",
+        "requests": total,
+        "gateway_overhead": {
+            "schema": 1,
+            "pooled": pooled,
+            "pool_max_idle": fleet.pool.max_idle_per_replica,
+            "clients": clients,
+            "router": router,
+            "gateway_rps": round(gw_rps, 1),
+            "direct_rps": round(total / direct_dt, 1),
+            "gateway_p50_s": round(g_p50, 6),
+            "gateway_p95_s": round(g_p95, 6),
+            "direct_p50_s": round(d_p50, 6),
+            "direct_p95_s": round(d_p95, 6),
+            "gateway_added_p50_s": round(g_p50 - d_p50, 6),
+            "gateway_added_p95_s": round(g_p95 - d_p95, 6),
+            "pool_hit_ratio": (
+                round(hits / (hits + misses), 4) if hits + misses else 0.0
+            ),
+            "pool": {"hits": hits, "misses": misses,
+                     "discards": pool1["discards"] - pool0["discards"]},
+            "upstream_connects": connects,
+        },
+        **_chaos_result(),
+        **_incident_result(_inc0),
+    }
+
+
+def bench_gateway_overhead(*args, **kwargs) -> int:
+    """CLI wrapper over :func:`run_gateway_overhead_bench`: one JSON
+    line."""
+    print(json.dumps(run_gateway_overhead_bench(*args, **kwargs)))
     return 0
 
 
@@ -1870,6 +2153,25 @@ if __name__ == "__main__":
         "prefills per its transfer-cost model; the row gains a "
         "schema-stamped kv_handoff block (fallback ratio gated)",
     )
+    parser.add_argument("--serve-gateway-overhead", action="store_true",
+                        help="gateway data-plane overhead microbench "
+                        "(ISSUE 14): closed-loop keep-alive clients vs "
+                        "in-process STUB replicas, direct and through the "
+                        "gateway — device-noise-free by construction (no "
+                        "jax anywhere on the path). The row embeds a "
+                        "hoisted gateway_overhead block (requests/sec, "
+                        "added-latency p50/p95, pool hit ratio) that "
+                        "perf_compare gates; run once with "
+                        "--serve-pool-idle 0 for the fresh-connect A/B "
+                        "leg")
+    parser.add_argument("--serve-pool-idle", type=int, default=-1,
+                        help="with --serve-gateway-overhead: override "
+                        "gateway.pool_max_idle_per_replica (0 = pooling "
+                        "off, every upstream hop connects fresh — the "
+                        "A/B baseline leg; -1 = the config default)")
+    parser.add_argument("--serve-overhead-requests", type=int, default=240,
+                        help="with --serve-gateway-overhead: total "
+                        "closed-loop requests per leg")
     parser.add_argument("--serve-trace-replay", default="", metavar="PATH",
                         help="with --infer --serve-replicas: replay a "
                         "recorded traffic trace (gateway --save-trace "
@@ -1896,6 +2198,14 @@ if __name__ == "__main__":
         arm(FaultPlane(seed=args.chaos_seed, rules=args.chaos))
         print(f"bench: chaos armed ({args.chaos!r}, seed {args.chaos_seed})",
               file=sys.stderr)
+    if args.serve_gateway_overhead:
+        # Host-only (stub replicas, no jax import): dispatched before any
+        # device-flag validation on purpose.
+        sys.exit(bench_gateway_overhead(
+            n_replicas=args.serve_replicas or 2,
+            requests=args.serve_overhead_requests,
+            pool_max_idle=args.serve_pool_idle,
+        ))
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"
                   or args.infer_workload != "random" or args.moe

@@ -520,6 +520,13 @@ class GatewayConfig:
     # counts as "long" for prefill-heavy steering; 0 = every batch/
     # best_effort request steers regardless of prompt size.
     long_prompt_tokens: int = 0
+    # Upstream keep-alive connection pool (gateway/pool.py, ISSUE 14):
+    # how many idle kept-alive connections the gateway parks per replica
+    # (0 disables pooling — every relay/poll/probe connects fresh, the
+    # --serve-gateway-overhead A/B leg), and how old a parked connection
+    # may grow before checkout discards it instead of reusing it.
+    pool_max_idle_per_replica: int = 8
+    pool_max_age_s: float = 30.0
     # Journal directory for replica lifecycle events
     # (events-gateway.jsonl via telemetry/journal.py); "" = no journal.
     journal_dir: str = ""
@@ -554,6 +561,16 @@ class GatewayConfig:
             raise ValueError(
                 f"gateway.long_prompt_tokens must be >= 0, got "
                 f"{self.long_prompt_tokens}"
+            )
+        if self.pool_max_idle_per_replica < 0:
+            raise ValueError(
+                f"gateway.pool_max_idle_per_replica must be >= 0, got "
+                f"{self.pool_max_idle_per_replica}"
+            )
+        if self.pool_max_age_s <= 0:
+            raise ValueError(
+                f"gateway.pool_max_age_s must be > 0, got "
+                f"{self.pool_max_age_s}"
             )
         if self.replica_roles:
             # Same reject-don't-drop rule: a typo'd role must fail the

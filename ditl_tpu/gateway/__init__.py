@@ -24,6 +24,7 @@ from ditl_tpu.gateway.autoscale import (
     load_trace,
 )
 from ditl_tpu.gateway.gateway import GatewayMetrics, make_gateway
+from ditl_tpu.gateway.pool import ConnectionPool
 from ditl_tpu.gateway.replica import (
     Fleet,
     FleetSupervisor,
@@ -55,6 +56,7 @@ __all__ = [
     "Actuator",
     "AdmissionDecision",
     "CacheAffinityPolicy",
+    "ConnectionPool",
     "Fleet",
     "FleetSignals",
     "FleetSupervisor",

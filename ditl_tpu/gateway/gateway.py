@@ -38,7 +38,12 @@ import threading
 import time
 import urllib.error
 import urllib.request
-from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    ThreadPoolExecutor,
+    TimeoutError as FutureTimeoutError,
+    wait,
+)
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ditl_tpu.chaos import InjectedFault, maybe_inject
@@ -62,6 +67,7 @@ from ditl_tpu.telemetry.tracing import (
     parse_traceparent,
     resolve_request_id,
 )
+from ditl_tpu.utils.http11 import KeepAliveHandlerMixin
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -69,6 +75,16 @@ logger = get_logger(__name__)
 __all__ = ["GatewayMetrics", "make_gateway", "main"]
 
 PREFIX = "ditl_gateway"
+
+
+class _HedgeQueueTimeout(OSError):
+    """A relay attempt expired in the hedge executor's queue before its
+    upstream open could start — a GATEWAY-local backlog, not a replica
+    failure. _relay_one retries it like any connection error but must NOT
+    note_failure the replica: a request storm saturating the executor
+    would otherwise bump healthy replicas past the supervisor's
+    fail_threshold and restart them, amplifying the overload exactly when
+    the gateway is the bottleneck."""
 
 
 class GatewayMetrics:
@@ -151,6 +167,28 @@ class GatewayMetrics:
             f"{PREFIX}_handoff_fallback",
             "accepted handoffs that failed mid-leg and fell back to plain "
             "relay (the decode replica re-prefills)")
+        # Upstream connection pool (ISSUE 14): lifetime pool accounting as
+        # stats-mirror gauges (the pool's counters are plain host ints;
+        # render() mirrors them each scrape — the host_tier_spilled
+        # idiom). hits/misses grade reuse, discards flag stale-socket
+        # churn (troubleshooting §32), idle is the parked-socket gauge.
+        self.pool_hits = r.gauge(
+            f"{PREFIX}_pool_hits",
+            "pooled upstream connections reused across relays/polls/"
+            "probes (lifetime, stats mirror)")
+        self.pool_misses = r.gauge(
+            f"{PREFIX}_pool_misses",
+            "upstream hops that had to open a fresh connection "
+            "(lifetime, stats mirror)")
+        self.pool_discards = r.gauge(
+            f"{PREFIX}_pool_discards",
+            "pooled upstream connections discarded (stale socket, age/"
+            "idle cap, mid-request error, or fleet-mutation invalidation; "
+            "lifetime, stats mirror)")
+        self.pool_idle = r.gauge(
+            f"{PREFIX}_pool_idle",
+            "idle kept-alive upstream connections currently parked in "
+            "the pool")
 
     # Each distinct tenant label becomes its own metric family; tenants
     # arrive as arbitrary unauthenticated bearer tokens, so beyond this
@@ -223,6 +261,11 @@ class GatewayMetrics:
             self.replicas_draining.set(fleet.draining_count())
             self.replicas_active.set(len(fleet.active_ids()))
             self.replicas_quarantined.set(len(fleet.quarantined_ids()))
+            pool = fleet.pool.stats()
+            self.pool_hits.set(pool["hits"])
+            self.pool_misses.set(pool["misses"])
+            self.pool_discards.set(pool["discards"])
+            self.pool_idle.set(pool["idle"])
             views = fleet.views()
             self._set_cache_gauges(views)
             self._set_role_gauges(views)
@@ -336,10 +379,30 @@ class GatewayHTTPServer(ThreadingHTTPServer):
         # (timestamp, completed) samples for the fleet-level backlog-aware
         # Retry-After (same derivation the single server satellite uses).
         self._rate_samples: collections.deque = collections.deque(maxlen=64)
+        # Persistent executors (ISSUE 14 satellite): hedged relays used to
+        # build a fresh 2-worker ThreadPoolExecutor PER HEDGED REQUEST and
+        # every /metrics//incidents fan-out built its own pool — thread
+        # construction on the data plane's hot path. One hedge executor
+        # and one fan-out executor per gateway, created here, shut down in
+        # server_close's finally (the PR 11 thread-hygiene contract).
+        # Hedge opens are short (connect + headers) but a primary must
+        # never queue behind other requests' slow opens, so the hedge pool
+        # is sized generously; fan-out probes are probe_timeout-bounded.
+        self._hedge_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="gw-hedge")
+        self._fanout_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="gw-fanout")
         super().__init__(*args, **kwargs)
 
+    def server_close(self):
+        try:
+            super().server_close()
+        finally:
+            self._hedge_pool.shutdown(wait=False, cancel_futures=True)
+            self._fanout_pool.shutdown(wait=False, cancel_futures=True)
 
-class _GatewayHandler(BaseHTTPRequestHandler):
+
+class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     # Injected by make_gateway:
     fleet: Fleet = None
     router = None
@@ -529,11 +592,13 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         replicas: dict[str, list] = {}
 
         def fetch(view):
-            with urllib.request.urlopen(
-                f"http://{view.address[0]}:{view.address[1]}/incidents",
+            # Pooled probe (ISSUE 14): non-200 (404 = unarmed) raises
+            # ValueError, read by the caller as "absent", exactly like the
+            # old urlopen HTTPError.
+            return self.fleet.pool.get_json(
+                view.id, view.address, "/incidents",
                 timeout=self.gwcfg.probe_timeout_s,
-            ) as resp:
-                return json.loads(resp.read())
+            )
 
         # /incidents is hit exactly when replicas are misbehaving, so N
         # slow replicas must cost ~probe_timeout_s total, not N x that.
@@ -552,23 +617,25 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         (~probe_timeout_s for the whole fan-out): returns ``(view,
         result)`` pairs for the replicas that answered in time. A slow or
         dead replica costs one skipped entry, never a wedged response —
-        ``shutdown(wait=False, cancel_futures=True)`` abandons stragglers
-        to die at their own socket timeouts (the PR 7 hardening; shared by
-        the /metrics memory section and /incidents)."""
+        stragglers are abandoned (queued-not-started futures cancelled,
+        running ones die at their own socket timeouts). Runs on the
+        gateway's persistent fan-out executor (ISSUE 14 satellite — no
+        more per-scrape pool construction); shared by the /metrics memory
+        section and /incidents."""
         out: list = []
         if not views:
             return out
-        pool = ThreadPoolExecutor(max_workers=min(8, len(views)))
-        try:
-            futures = {pool.submit(fetch, v): v for v in views}
-            done, _ = wait(futures, timeout=self.gwcfg.probe_timeout_s)
-            for f in done:
-                try:
-                    out.append((futures[f], f.result()))
-                except (urllib.error.URLError, OSError, ValueError):
-                    continue
-        finally:
-            pool.shutdown(wait=False, cancel_futures=True)
+        pool = self.server._fanout_pool
+        futures = {pool.submit(fetch, v): v for v in views}
+        done, not_done = wait(futures, timeout=self.gwcfg.probe_timeout_s)
+        for f in not_done:
+            f.cancel()
+        for f in done:
+            try:
+                out.append((futures[f], f.result()))
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException, ValueError):
+                continue
         return out
 
     def _replica_memory_section(self) -> str:
@@ -582,11 +649,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         replica costs one skipped section, never a wedged scrape. CPU
         replicas contribute nothing (no ditl_memory_* lines to filter)."""
         def fetch(view):
-            with urllib.request.urlopen(
-                f"http://{view.address[0]}:{view.address[1]}/metrics",
+            return self.fleet.pool.get_text(
+                view.id, view.address, "/metrics",
                 timeout=self.gwcfg.probe_timeout_s,
-            ) as resp:
-                return resp.read().decode("utf-8", "replace")
+            )
 
         out: list[str] = []
         for view, text in self._fan_out_replicas(self.fleet.routable(),
@@ -604,13 +670,13 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     def _proxy_get(self, path: str) -> None:
         for view in self.fleet.routable():
             try:
-                with urllib.request.urlopen(
-                    f"http://{view.address[0]}:{view.address[1]}{path}",
+                self._send_json(200, self.fleet.pool.get_json(
+                    view.id, view.address, path,
                     timeout=self.gwcfg.probe_timeout_s,
-                ) as resp:
-                    self._send_json(resp.status, json.loads(resp.read()))
-                    return
-            except (urllib.error.URLError, OSError, ValueError):
+                ))
+                return
+            except (urllib.error.URLError, OSError,
+                    http.client.HTTPException, ValueError):
                 self.fleet.note_failure(view.id)
                 continue
         self._send_json(503, {"error": {"message": "no live replica"}})
@@ -626,6 +692,12 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             if not isinstance(payload, dict):
                 raise ValueError("body must be a JSON object")
         except (ValueError, json.JSONDecodeError) as e:
+            # A malformed Content-Length leaves the body unread; on a
+            # kept-alive connection those bytes would desync the next
+            # request — close after the error response. (Malformed JSON
+            # reached here with the body fully read; closing anyway is
+            # one wasted reconnect, not a correctness cost.)
+            self.close_connection = True
             self._send_json(400, {"error": {"message": f"bad request: {e}"}})
             return
         path = self.path.rstrip("/")
@@ -955,25 +1027,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 
     def _handoff_post(self, view, path: str, body: bytes, ctype: str,
                       timeout: float) -> bytes:
-        """One bounded intra-host handoff hop; non-200 raises (the caller
-        falls back to plain relay)."""
-        conn = http.client.HTTPConnection(
-            view.address[0], view.address[1], timeout=timeout,
-        )
-        try:
-            conn.request("POST", path, body=body, headers={
+        """One bounded intra-host handoff hop over the upstream pool;
+        non-200 raises (the caller falls back to plain relay)."""
+        status, _, data = self.fleet.pool.request(
+            view.id, view.address, "POST", path, body=body, headers={
                 "Content-Type": ctype,
                 "X-Request-Id": self._request_id(),
-            })
-            resp = conn.getresponse()
-            data = resp.read()
-            if resp.status != 200:
-                raise ValueError(
-                    f"{path} on {view.id} answered {resp.status}"
-                )
-            return data
-        finally:
-            conn.close()
+            }, timeout=timeout,
+        )
+        if status != 200:
+            raise ValueError(f"{path} on {view.id} answered {status}")
+        return data
 
     def _maybe_handoff(self, view, payload: dict, span=None,
                        deadline_left: float | None = None) -> None:
@@ -1130,14 +1194,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         if deadline_left is not None:
             timeout = min(timeout, max(0.001, deadline_left))
             headers["X-Request-Deadline-S"] = f"{max(0.001, deadline_left):.3f}"
-        conn = http.client.HTTPConnection(
-            view.address[0], view.address[1], timeout=timeout,
-        )
+        # Pooled upstream hop (ISSUE 14): a kept-alive connection when one
+        # is parked for this replica, else a fresh connect — exactly the
+        # pre-pool behavior. A mid-request failure discards the connection
+        # (closed + counted) and raises into the caller's existing retry
+        # path; full-read-before-relay keeps that idempotent-safe.
+        conn = self.fleet.pool.checkout(view.id, view.address, timeout)
         try:
             conn.request("POST", path, body=raw, headers=headers)
             return conn, conn.getresponse()
         except BaseException:
-            conn.close()
+            self.fleet.pool.discard(conn)
             raise
 
     def _relay_one(self, view, path, raw, stream, hedge_peers,
@@ -1172,9 +1239,18 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             else:
                 conn, resp = self._open(view, path, raw, deadline_left,
                                         trace=span, slo_class=slo_class)
-        except (OSError, http.client.HTTPException):
-            self.fleet.note_failure(view.id)
+        except (OSError, http.client.HTTPException) as e:
+            if not isinstance(e, _HedgeQueueTimeout):
+                # A queue timeout is gateway-local backlog; blaming the
+                # replica would feed the supervisor's fail_threshold.
+                self.fleet.note_failure(view.id)
             return ("retry", None)
+        # The winning connection belongs to whichever replica SERVED (under
+        # hedging that can be the peer); check it back into the pool only
+        # when its response was fully drained and the upstream didn't ask
+        # to close — everything else (SSE relays, torn reads) is a counted
+        # discard (ISSUE 14).
+        reusable = False
         try:
             if resp.status in (429, 503):
                 try:
@@ -1182,9 +1258,12 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 except ValueError:
                     hint = 1
                 resp.read()
+                reusable = True
                 return ("busy", (hint, served))
             ctype = resp.getheader("Content-Type", "application/json")
             if stream and ctype.startswith("text/event-stream"):
+                # SSE responses are close-delimited (the replica sends
+                # Connection: close by design); never pooled.
                 return (self._relay_stream(view, resp, ctype), served)
             try:
                 data = resp.read()
@@ -1192,6 +1271,7 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                 # Full response never arrived: nothing relayed, retryable.
                 self.fleet.note_failure(view.id)
                 return ("retry", None)
+            reusable = True
             self.send_response(resp.status)
             self.send_header("Content-Type", ctype)
             self.send_header("X-Request-Id", self._request_id())
@@ -1200,7 +1280,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self.wfile.write(data)
             return ("done", served)
         finally:
-            conn.close()
+            if reusable:
+                self.fleet.pool.checkin(served, conn, response=resp)
+            else:
+                self.fleet.pool.discard(conn)
 
     def _relay_stream(self, view, resp, ctype) -> str:
         """SSE pass-through: relay chunks as they arrive (read1 returns
@@ -1217,6 +1300,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", ctype)
         self.send_header("X-Request-Id", self._request_id())
         self.send_header("Cache-Control", "no-cache")
+        # The relayed SSE body is close-delimited (no Content-Length), so
+        # the client connection cannot be kept alive — same opt-out the
+        # replica's own SSE responses make (ISSUE 14).
+        self.send_header("Connection", "close")
         self.end_headers()
         try:
             chunk = first
@@ -1240,8 +1327,10 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         waste). Completions are idempotent from the client's perspective,
         so duplicates are safe. A fired hedge gets its OWN relay span as a
         SIBLING of the primary attempt's (both children of ``root``) — the
-        trace shows two overlapping relays and which one won."""
-        pool = ThreadPoolExecutor(max_workers=2)
+        trace shows two overlapping relays and which one won. Runs on the
+        gateway's persistent hedge executor (ISSUE 14 satellite): no more
+        2-worker pool construction per hedged relay."""
+        pool = self.server._hedge_pool
         hspan = None
         try:
             t0 = time.monotonic()
@@ -1250,6 +1339,38 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             done, _ = wait([primary], timeout=self.gwcfg.hedge_after_s)
             if done:
                 conn, resp = primary.result()  # may raise: caller retries
+                return conn, resp, view.id
+            if not primary.running() and not primary.done():
+                # Executor saturated: the primary never STARTED, so the
+                # elapsed hedge_after_s measured queue depth, not a slow
+                # replica — firing a secondary would queue behind the same
+                # backlog and double the load exactly when workers are
+                # short (and count a hedge that never was). Wait the
+                # primary out instead, BOUNDED by the request's remaining
+                # deadline (else the gateway's own timeout): a queued
+                # future has no socket timeout protecting it yet, and a
+                # deadline_s=5 request must not sit tens of seconds in an
+                # executor queue before its first connect.
+                left = (
+                    deadline_left - (time.monotonic() - t0)
+                    if deadline_left is not None
+                    else self.gwcfg.request_timeout_s
+                )
+                try:
+                    conn, resp = primary.result(
+                        timeout=max(0.001, left))
+                except FutureTimeoutError:
+                    # Give up on this attempt; if the open starts later
+                    # anyway, its connection is abandoned through the
+                    # pool's accounting. Raise the caller's retryable
+                    # error class.
+                    primary.cancel()
+                    primary.add_done_callback(
+                        self._abandoned_conn_closer())
+                    raise _HedgeQueueTimeout(
+                        "hedge executor saturated; relay attempt timed "
+                        "out before its upstream open could start"
+                    ) from None
                 return conn, resp, view.id
             peer = min(peers, key=lambda v: v.outstanding + v.queue_depth)
             self.gw.hedges.inc()
@@ -1282,10 +1403,14 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                     # Abandon every loser: the still-pending future AND any
                     # that completed in the same wake-up (both can land in
                     # `done` at once — its connection must close too, not
-                    # leak an FD per hedge).
+                    # leak an FD per hedge). Losers go through the pool's
+                    # discard so the churn counter stays honest (a loser
+                    # was counted at checkout; its close must be counted
+                    # too, troubleshooting §32 reads the ratio).
+                    abandon = self._abandoned_conn_closer()
                     for other in done | pending:
                         if other is not f:
-                            other.add_done_callback(_close_result)
+                            other.add_done_callback(abandon)
                     if hspan is not None:
                         hspan.end(outcome=(
                             "won" if futures[f] == peer.id else "lost"
@@ -1297,7 +1422,20 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         finally:
             if hspan is not None:
                 hspan.end()  # no-op when already ended with an outcome
-            pool.shutdown(wait=False)
+
+    def _abandoned_conn_closer(self):
+        """Done-callback that discards a hedge loser's connection through
+        the pool (mid-flight — never reusable, always counted)."""
+        pool = self.fleet.pool
+
+        def _closer(future) -> None:
+            try:
+                conn, _resp = future.result()
+            except BaseException:
+                return  # the losing open failed; _open already discarded
+            pool.discard(conn)
+
+        return _closer
 
     def _note_affinity(self, key, replica_id: str) -> None:
         if key is None:
@@ -1313,14 +1451,6 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self.affinity_last.move_to_end(key)
             while len(self.affinity_last) > 4096:
                 self.affinity_last.popitem(last=False)
-
-
-def _close_result(future) -> None:
-    try:
-        conn, _resp = future.result()
-        conn.close()
-    except BaseException:
-        pass
 
 
 def make_gateway(
@@ -1362,6 +1492,15 @@ def make_gateway(
     (telemetry/journal.EventJournal) records its per-request cost-model
     decisions."""
     config = config or GatewayConfig()
+    # Upstream keep-alive pool caps (ISSUE 14): the fleet owns the pool
+    # (health polls and fleet-mutation invalidation need it gateway or
+    # not); the gateway applies its config's knobs here.
+    # pool_max_idle_per_replica=0 disables pooling — every upstream hop
+    # connects fresh, the microbench's A/B leg.
+    fleet.pool.configure(
+        max_idle_per_replica=config.pool_max_idle_per_replica,
+        max_age_s=config.pool_max_age_s,
+    )
     if router is None:
         router = make_policy(config.router)
     if admission is None and (

@@ -1,0 +1,333 @@
+"""Upstream keep-alive connection pool (ISSUE 14 tentpole).
+
+Every upstream hop the gateway's data plane makes — relay attempts,
+hedged secondaries, KV-handoff legs, health polls, and the /metrics//
+incidents fan-out probes — used to pay a fresh TCP connect (plus a fresh
+server-side handler thread at the replica). With N replicas health-polled
+every interval and every relay connecting fresh, the steadiest traffic in
+the system was needless SYN/FIN churn. This module is the fix: a
+per-replica bounded pool of kept-alive ``http.client`` connections with
+checkout/checkin semantics.
+
+Contract:
+
+- **Checkout** hands back a parked connection for ``(replica_id,
+  address)`` when a healthy one exists (a *hit*), else a fresh unconnected
+  ``HTTPConnection`` (a *miss* — the connect happens lazily on the first
+  request, exactly like before the pool existed).
+- Parked connections are vetted at checkout: wrong address (the replica
+  relaunched on a new port), past the age cap, or *stale* — readable
+  while idle means the peer closed it (or worse, sent unsolicited bytes);
+  either way it is discarded-and-counted, never handed out. The
+  stale-socket check is the standard zero-timeout ``select`` probe.
+- **Checkin** parks a connection for reuse only when it is provably
+  reusable: the response was fully read and the upstream did not ask to
+  close (``Connection: close`` — SSE relays — or HTTP/1.0 upstreams).
+  Anything else is closed and counted as a discard. The pool never holds
+  more than ``max_idle_per_replica`` parked connections per replica;
+  ``0`` disables pooling entirely (every checkout is a fresh connect —
+  the microbench's fresh-connect A/B leg).
+- A **mid-request error** is the caller's to report via :meth:`discard`:
+  the connection is closed and counted, and the caller's existing retry
+  path engages (full-read-before-relay already makes that
+  idempotent-safe).
+- **Invalidate** closes every parked connection for one replica — wired
+  into supervisor relaunch, rolling restart, scale-down park, and
+  quarantine, so a fleet mutation never leaves sockets parked against a
+  replica the control plane just took down.
+
+Thread-safety: the idle map is lock-protected; the hit/miss/discard
+counters are GIL-cheap int adds (the telemetry-registry idiom — a racing
+pair may lose one update, values never go backwards). A checked-out
+connection belongs to exactly one caller until checked back in.
+
+stdlib-only (no jax): this rides inside ``ditl_tpu/gateway`` and the
+import-layering rule proves it stays that way.
+"""
+
+from __future__ import annotations
+
+import collections
+import http.client
+import json
+import select
+import socket
+import threading
+import time
+
+__all__ = ["ConnectionPool"]
+
+
+class _PooledHTTPConnection(http.client.HTTPConnection):
+    """HTTPConnection with TCP_NODELAY: http.client sends headers and
+    body as separate small segments, and on a kept-alive connection the
+    second one stalls behind the peer's delayed ACK (~40 ms on Linux)
+    unless Nagle is off — the whole point of the pool is to NOT close the
+    connection, so the close-time flush that hid this is gone."""
+
+    def connect(self):
+        super().connect()
+        try:
+            self.sock.setsockopt(
+                socket.IPPROTO_TCP, socket.TCP_NODELAY, 1,
+            )
+        except OSError:
+            pass
+
+
+def _socket_stale(conn: http.client.HTTPConnection) -> bool:
+    """True when a parked connection cannot be reused: no socket at all,
+    or readable while idle (EOF from a closed peer, or protocol garbage —
+    a kept-alive connection with no request in flight must be silent).
+    Probes via poll(), not select(): select raises ValueError for fds >=
+    FD_SETSIZE (1024), which would misjudge EVERY parked connection stale
+    exactly in the high-fd-count regime the pool exists for."""
+    sock = conn.sock
+    if sock is None:
+        return True
+    try:
+        if hasattr(select, "poll"):
+            poller = select.poll()
+            poller.register(
+                sock, select.POLLIN | select.POLLERR | select.POLLHUP,
+            )
+            return bool(poller.poll(0))
+        readable, _, _ = select.select([sock], [], [], 0)
+        return bool(readable)
+    except (OSError, ValueError):
+        return True
+
+
+class ConnectionPool:
+    """Bounded per-replica keep-alive connection pool. One instance per
+    :class:`~ditl_tpu.gateway.replica.Fleet`, shared by the gateway's
+    relay plane and the supervisor's health polls."""
+
+    def __init__(self, max_idle_per_replica: int = 8,
+                 max_age_s: float = 30.0):
+        if max_idle_per_replica < 0:
+            raise ValueError(
+                f"max_idle_per_replica must be >= 0, got "
+                f"{max_idle_per_replica}"
+            )
+        if max_age_s <= 0:
+            raise ValueError(f"max_age_s must be > 0, got {max_age_s}")
+        self.max_idle_per_replica = max_idle_per_replica
+        self.max_age_s = max_age_s
+        self._lock = threading.Lock()
+        # replica_id -> deque of (conn, (host, port), born_monotonic),
+        # newest at the right (LIFO reuse keeps the working set warm and
+        # lets the tail age out).
+        self._idle: dict[str, collections.deque] = {}  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
+        # Lifetime accounting (GIL-cheap adds; rendered as stats-mirror
+        # gauges on the gateway /metrics and embedded in bench rows).
+        self.hits = 0
+        self.misses = 0
+        self.discards = 0
+
+    def configure(self, max_idle_per_replica: int | None = None,
+                  max_age_s: float | None = None) -> None:
+        """Apply config-derived caps (make_gateway wires GatewayConfig's
+        pool knobs through here — the Fleet is usually built first)."""
+        if max_idle_per_replica is not None:
+            if max_idle_per_replica < 0:
+                raise ValueError(
+                    f"max_idle_per_replica must be >= 0, got "
+                    f"{max_idle_per_replica}"
+                )
+            self.max_idle_per_replica = max_idle_per_replica
+            if max_idle_per_replica == 0:
+                self.close_idle()
+        if max_age_s is not None:
+            if max_age_s <= 0:
+                raise ValueError(f"max_age_s must be > 0, got {max_age_s}")
+            self.max_age_s = max_age_s
+
+    # -- checkout / checkin --------------------------------------------------
+
+    def checkout(self, replica_id: str, address: tuple[str, int],
+                 timeout: float) -> http.client.HTTPConnection:
+        """A connection to ``address``, pooled when possible. The caller
+        owns it until :meth:`checkin` or :meth:`discard`; ``timeout``
+        applies to the socket either way."""
+        now = time.monotonic()
+        while True:
+            with self._lock:
+                dq = self._idle.get(replica_id)
+                expired = self._expire_left_locked(dq, now) if dq else []
+                entry = dq.pop() if dq else None
+            for conn, _addr, _born in expired:
+                self._drop(conn)
+            if entry is None:
+                break
+            conn, addr, born = entry
+            if (addr != tuple(address)
+                    or now - born > self.max_age_s
+                    or _socket_stale(conn)):
+                self._drop(conn)
+                continue
+            conn.timeout = timeout
+            try:
+                conn.sock.settimeout(timeout)
+            except OSError:
+                self._drop(conn)
+                continue
+            self.hits += 1
+            return conn
+        self.misses += 1
+        conn = _PooledHTTPConnection(
+            address[0], address[1], timeout=timeout,
+        )
+        conn._ditl_born = now
+        return conn
+
+    def checkin(self, replica_id: str, conn: http.client.HTTPConnection,
+                response=None) -> None:
+        """Park ``conn`` for reuse — or close-and-count it when it is not
+        PROVABLY reusable: the caller must hand over the completed
+        ``response`` (fully read, upstream didn't say close). ``response
+        is None`` means unverified protocol state — a response could still
+        be in flight, and handing that socket to the next caller would
+        cross-wire two requests' payloads — so it is discarded, never
+        parked."""
+        if conn.sock is None:
+            # Never connected (a checkout whose request never fired) —
+            # nothing to pool, nothing to count.
+            return
+        reusable = (
+            response is not None
+            and response.isclosed() and not response.will_close
+        )
+        expired: list = []
+        with self._lock:
+            if (self._closed or self.max_idle_per_replica <= 0
+                    or not reusable):
+                dq = None
+            else:
+                dq = self._idle.setdefault(replica_id, collections.deque())
+                # Age out the OLDEST parked entries here too: LIFO reuse
+                # only ever pops the newest, so without this sweep a
+                # burst's tail would sit past max_age_s forever, each
+                # entry pinning a handler thread at the replica.
+                expired = self._expire_left_locked(dq, time.monotonic())
+                if len(dq) >= self.max_idle_per_replica:
+                    dq = None
+            if dq is not None:
+                dq.append((
+                    conn, (conn.host, conn.port),
+                    getattr(conn, "_ditl_born", time.monotonic()),
+                ))
+                conn = None
+        for old, _addr, _born in expired:
+            self._drop(old)
+        if conn is not None:
+            self._drop(conn)
+
+    def _expire_left_locked(self, dq, now: float) -> list:
+        """Pop over-age entries off the OLD end of one replica's deque;
+        caller holds ``_lock`` and closes the returned connections."""
+        out = []
+        while dq and now - dq[0][2] > self.max_age_s:
+            out.append(dq.popleft())
+        return out
+
+    def discard(self, conn: http.client.HTTPConnection) -> None:
+        """Close a checked-out connection that errored mid-request (or
+        whose response cannot be drained) and count the discard — the
+        caller's retry path takes it from here."""
+        self._drop(conn)
+
+    def _drop(self, conn) -> None:
+        self.discards += 1
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    # -- one-shot request helpers -------------------------------------------
+
+    def request(self, replica_id: str, address: tuple[str, int],
+                method: str, path: str, *, body: bytes | None = None,
+                headers: dict | None = None,
+                timeout: float = 5.0) -> tuple[int, dict, bytes]:
+        """One pooled request, fully read: ``(status, headers, body)``.
+        Transport failures discard the connection and re-raise
+        (``OSError`` / ``http.client.HTTPException``)."""
+        conn = self.checkout(replica_id, address, timeout)
+        try:
+            conn.request(method, path, body=body, headers=headers or {})
+            resp = conn.getresponse()
+            data = resp.read()
+        except BaseException:
+            self.discard(conn)
+            raise
+        self.checkin(replica_id, conn, response=resp)
+        return resp.status, dict(resp.getheaders()), data
+
+    def get_json(self, replica_id: str, address: tuple[str, int],
+                 path: str, timeout: float = 5.0) -> dict:
+        """Pooled GET expecting a 200 JSON object; anything else raises
+        ``ValueError`` (the same "absent, skip it" semantics callers had
+        with ``urlopen`` raising ``HTTPError`` on non-2xx)."""
+        status, _, data = self.request(
+            replica_id, address, "GET", path, timeout=timeout,
+        )
+        if status != 200:
+            raise ValueError(f"{path} answered {status}")
+        return json.loads(data)
+
+    def get_text(self, replica_id: str, address: tuple[str, int],
+                 path: str, timeout: float = 5.0) -> str:
+        status, _, data = self.request(
+            replica_id, address, "GET", path, timeout=timeout,
+        )
+        if status != 200:
+            raise ValueError(f"{path} answered {status}")
+        return data.decode("utf-8", "replace")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def invalidate(self, replica_id: str) -> None:
+        """Close every parked connection for one replica — the fleet
+        mutation hook (relaunch / rolling restart / park / quarantine)."""
+        with self._lock:
+            dq = self._idle.pop(replica_id, None)
+        for conn, _addr, _born in (dq or ()):
+            self._drop(conn)
+
+    def close_idle(self) -> None:
+        """Close every parked connection (all replicas); the pool stays
+        usable — subsequent checkouts connect fresh."""
+        with self._lock:
+            idle, self._idle = self._idle, {}
+        for dq in idle.values():
+            for conn, _addr, _born in dq:
+                self._drop(conn)
+
+    def close(self) -> None:
+        """Terminal: close everything parked and refuse future checkins
+        (checkouts still work — they just always connect fresh)."""
+        with self._lock:
+            self._closed = True
+        self.close_idle()
+
+    # -- accounting ----------------------------------------------------------
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return sum(len(dq) for dq in self._idle.values())
+
+    def hit_ratio(self) -> float | None:
+        total = self.hits + self.misses
+        if total == 0:
+            return None
+        return self.hits / total
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "discards": self.discards,
+            "idle": self.idle_count(),
+        }

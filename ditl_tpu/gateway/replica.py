@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import http.client
 import json
 import subprocess
 import threading
@@ -36,6 +37,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, Sequence
 
+from ditl_tpu.gateway.pool import ConnectionPool
 from ditl_tpu.telemetry.journal import EventJournal
 from ditl_tpu.utils.logging import get_logger
 
@@ -75,6 +77,12 @@ class ReplicaHandle:
     def __init__(self, replica_id: str, role: str = "hybrid"):
         self.id = replica_id
         self.role = role
+        # Optional ConnectionPool the owning Fleet installs (ISSUE 14):
+        # when present, _get rides a kept-alive pooled connection instead
+        # of a fresh urlopen per probe. An attribute (not a fetch_health
+        # parameter) so test fakes overriding the probe methods keep
+        # their signatures.
+        self.pool = None
 
     # lifecycle ------------------------------------------------------------
     def start(self) -> None:
@@ -104,6 +112,17 @@ class ReplicaHandle:
         addr = self.address
         if addr is None:
             return None
+        pool = getattr(self, "pool", None)
+        if pool is not None:
+            # Pooled probe (ISSUE 14): health polls are the steadiest
+            # upstream traffic in the system — N replicas every freshness
+            # interval — and ride the fleet's keep-alive pool instead of a
+            # fresh connect each. Any failure reads as "no answer",
+            # exactly like the urlopen path below.
+            try:
+                return pool.get_json(self.id, addr, path, timeout=timeout)
+            except (OSError, http.client.HTTPException, ValueError):
+                return None
         try:
             with urllib.request.urlopen(
                 f"http://{addr[0]}:{addr[1]}{path}", timeout=timeout
@@ -382,6 +401,11 @@ class Fleet:
             )
         self.default_capacity = default_capacity
         self.cache_window_polls = cache_window_polls
+        # Upstream keep-alive pool (ISSUE 14): shared by the gateway's
+        # relay plane, the supervisor's health polls, and the fan-out
+        # probes — one pool per fleet so lifecycle invalidation has one
+        # place to land. make_gateway applies the config's caps.
+        self.pool = ConnectionPool()
         self._lock = threading.Lock()
         self._states = {
             h.id: _ReplicaState(
@@ -390,6 +414,11 @@ class Fleet:
             )
             for h in handles
         }
+        for h in handles:
+            # Health polls ride the fleet's pool (ISSUE 14) — installed
+            # on the handle so probe-method overrides in tests keep their
+            # signatures.
+            h.pool = self.pool
 
     @property
     def ids(self) -> list[str]:
@@ -420,6 +449,10 @@ class Fleet:
                     )
 
     def stop_all(self, drain: bool = True, timeout: float = 30.0) -> None:
+        # Parked upstream sockets must not hold the replicas' drains open
+        # (an idle kept-alive connection parks a handler thread at the
+        # replica); the pool is terminal after this.
+        self.pool.close()
         for st in self._states.values():
             st.handle.stop(drain=drain, timeout=timeout)
             with self._lock:
@@ -567,10 +600,17 @@ class Fleet:
     def set_deactivated(self, replica_id: str, deactivated: bool) -> None:
         with self._lock:
             self._states[replica_id].deactivated = deactivated
+        if deactivated:
+            # A scale-down park takes the replica's process down; parked
+            # keep-alive sockets to it are dead weight that would read as
+            # a stale-socket storm later (ISSUE 14 lifecycle hook).
+            self.pool.invalidate(replica_id)
 
     def set_quarantined(self, replica_id: str, quarantined: bool) -> None:
         with self._lock:
             self._states[replica_id].quarantined = quarantined
+        if quarantined:
+            self.pool.invalidate(replica_id)
 
     def active_ids(self) -> list[str]:
         """Replicas participating in serving (not parked, not
@@ -773,6 +813,10 @@ class FleetSupervisor:
             self._given_up.add(rid)
             return
         st.live = False
+        # Pooled sockets to a dead replica are all stale; invalidating
+        # here (not lazily at the next checkout) frees them en masse and
+        # makes the discard count an honest death signature (ISSUE 14).
+        self.fleet.pool.invalidate(rid)
         self.journal_event("replica.died", replica=rid,
                           fails=st.fails,
                           process_alive=st.handle.alive())
@@ -827,6 +871,11 @@ class FleetSupervisor:
         while (self.fleet.outstanding(rid) > 0
                and time.monotonic() < deadline):
             time.sleep(0.05)
+        # Idle pooled sockets would wedge the replica-side drain (each
+        # parks a handler thread there) and are useless after the stop
+        # either way — rolling restarts and the actuator's scale-down/
+        # drain paths all come through here (ISSUE 14 lifecycle hook).
+        self.fleet.pool.invalidate(rid)
         st.handle.stop(drain=True, timeout=timeout_s)
         st.live = False
 

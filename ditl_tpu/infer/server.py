@@ -50,6 +50,7 @@ from ditl_tpu.telemetry.tracing import (
     parse_traceparent,
     resolve_request_id,
 )
+from ditl_tpu.utils.http11 import KeepAliveHandlerMixin
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -82,13 +83,19 @@ class DrainableHTTPServer(ThreadingHTTPServer):
         self._inflight = 0
         self._idle = threading.Condition()
         self._conns: set = set()
+        # Keep-alive connections currently parked between requests (the
+        # handler thread blocked waiting for the next request line) —
+        # maintained by KeepAliveHandlerMixin via note_parked. drain()
+        # severs exactly these: without it a draining replica wedges on
+        # the gateway pool's idle sockets (ISSUE 14).
+        self._parked: set = set()  # guarded-by: _conn_lock
         self._conn_lock = threading.Lock()
         # (timestamp, completed-counter) samples for the backlog-aware
         # Retry-After derivation (_Handler._retry_after_s).
         self._rate_samples: collections.deque = collections.deque(maxlen=64)
         super().__init__(*args, **kwargs)
 
-    # -- connection tracking (for kill()) -----------------------------------
+    # -- connection tracking (for kill() and drain()) ------------------------
 
     def process_request(self, request, client_address):
         with self._conn_lock:
@@ -98,7 +105,32 @@ class DrainableHTTPServer(ThreadingHTTPServer):
     def shutdown_request(self, request):
         with self._conn_lock:
             self._conns.discard(request)
+            self._parked.discard(request)
         super().shutdown_request(request)
+
+    def note_parked(self, request, parked: bool) -> None:
+        """KeepAliveHandlerMixin callback: ``request``'s handler thread is
+        (or stopped being) blocked between keep-alive requests."""
+        with self._conn_lock:
+            if parked:
+                self._parked.add(request)
+            else:
+                self._parked.discard(request)
+
+    def sever_parked(self) -> None:
+        """Close every idle kept-alive connection. In-flight requests are
+        untouched (a connection mid-request is not parked)."""
+        with self._conn_lock:
+            parked = list(self._parked)
+        for s in parked:
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
 
     def handle_error(self, request, client_address):
         import sys
@@ -133,8 +165,15 @@ class DrainableHTTPServer(ThreadingHTTPServer):
 
     def drain(self) -> None:
         """Stop accepting new work (503) while in-flight requests finish;
-        /health reports ``draining`` so a router stops sending traffic."""
+        /health reports ``draining`` so a router stops sending traffic.
+        Idle kept-alive connections are severed — parked peers (the
+        gateway's connection pool, lingering pollers) would otherwise pin
+        handler threads through the drain and could relay one more
+        request onto a replica the fleet believes is gone. New
+        connections are still accepted (metadata routes keep working);
+        they just stop being kept alive while draining."""
         self.draining = True
+        self.sever_parked()
 
     def wait_idle(self, timeout: float | None = None) -> bool:
         """Block until no completion work is in flight. Returns False on
@@ -257,7 +296,7 @@ def _chat_prompt(messages: list[dict], tokenizer=None) -> str:
     return "\n".join(parts) + "\nassistant:"
 
 
-class _Handler(BaseHTTPRequestHandler):
+class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     generator: Generator = None  # injected by make_server
     threaded_engine = None  # ContinuousEngine driver; None => lockstep path
     spec_generator = None  # speculative path for greedy lock-step requests
@@ -577,6 +616,10 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length)
         except (ValueError, OSError) as e:
+            # The request body was never (fully) consumed: leftover bytes
+            # would be parsed as the NEXT request line on this kept-alive
+            # connection (desync) — close it after the error response.
+            self.close_connection = True
             self._send_json(400, {"error": {"message": f"bad request: {e}"}})
             return
         path = self.path.rstrip("/")
@@ -804,6 +847,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("X-Request-Id", self._request_id())
         self.send_header("Cache-Control", "no-cache")
+        # SSE opts out of HTTP/1.1 keep-alive by design: the stream has
+        # no Content-Length, so close-delimited framing is the only
+        # correct end-of-body signal — send_header("Connection", "close")
+        # also flips the stdlib's close_connection for us (ISSUE 14).
+        self.send_header("Connection", "close")
         self.end_headers()
         try:
             for event in events:

@@ -115,6 +115,10 @@ _ROWS: tuple = (
     ("ditl_gateway_handoff_shipped_total", "counter", "", "prefill->decode KV handoffs shipped to the decode replica"),
     ("ditl_gateway_hedges_total", "counter", "", "hedged duplicate requests fired"),
     ("ditl_gateway_no_replica_total", "counter", "", "requests failed with no live replica"),
+    ("ditl_gateway_pool_discards", "gauge", "", "pooled upstream connections discarded (stale socket, age/idle cap, mid-request error, or fleet-mutation invalidation; lifetime, stats mirror)"),
+    ("ditl_gateway_pool_hits", "gauge", "", "pooled upstream connections reused across relays/polls/probes (lifetime, stats mirror)"),
+    ("ditl_gateway_pool_idle", "gauge", "", "idle kept-alive upstream connections currently parked in the pool"),
+    ("ditl_gateway_pool_misses", "gauge", "", "upstream hops that had to open a fresh connection (lifetime, stats mirror)"),
     ("ditl_gateway_relayed_by_class_batch_total", "counter", "", "requests relayed carrying SLO class batch"),
     ("ditl_gateway_relayed_by_class_best_effort_total", "counter", "", "requests relayed carrying SLO class best_effort"),
     ("ditl_gateway_relayed_by_class_default_total", "counter", "", "requests relayed carrying SLO class default"),

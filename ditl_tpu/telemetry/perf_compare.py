@@ -78,19 +78,34 @@ COMPARE_KEYS = {
     "host_tier_hit_ratio": +1,
     "swap_in_p95_s": -1,
     "handoff_fallback_ratio": -1,
+    # Gateway data-plane overhead keys (ISSUE 14, bench
+    # --serve-gateway-overhead rows' hoisted `gateway_overhead` block):
+    # the stub-replica closed loop isolates the gateway's OWN per-request
+    # tax from any device work, so these gate host-side regressions the
+    # device benches can't see. Requests/sec through the gateway regresses
+    # when it falls; the added latency vs hitting a replica directly
+    # regresses when it rises (p50 = the steady tax, p95 = the tail the
+    # connect-per-request churn used to own). The pool hit ratio is
+    # reported context, not gated — it is 0.0 by construction on the
+    # fresh-connect A/B leg.
+    "gateway_rps": +1,
+    "gateway_added_p50_s": -1,
+    "gateway_added_p95_s": -1,
 }
 
 
 def _flat(rec: dict) -> dict:
     """The comparable view of one record/cell: top-level keys plus the
     nested ``roofline`` (train rows), ``serving`` (serve rows),
-    ``autoscale`` (trace-replay rows), and ``kv_handoff`` (handoff-armed
-    gateway rows, ISSUE 13) blocks hoisted — without the hoist the gate
+    ``autoscale`` (trace-replay rows), ``kv_handoff`` (handoff-armed
+    gateway rows, ISSUE 13), and ``gateway_overhead`` (stub-fleet
+    overhead rows, ISSUE 14) blocks hoisted — without the hoist the gate
     would silently never compare cost-counted MFU, the serving scheduler
-    metrics, the replica-seconds the autoscaler A/B is graded on, or the
-    handoff fallback ratio."""
+    metrics, the replica-seconds the autoscaler A/B is graded on, the
+    handoff fallback ratio, or the gateway's own per-request tax."""
     out = rec
-    for block in ("roofline", "serving", "autoscale", "kv_handoff"):
+    for block in ("roofline", "serving", "autoscale", "kv_handoff",
+                  "gateway_overhead"):
         nested = rec.get(block)
         if isinstance(nested, dict):
             out = {**nested, **out}

@@ -1,0 +1,79 @@
+"""HTTP/1.1 keep-alive plumbing shared by the replica server and the
+gateway (ISSUE 14).
+
+The stdlib ``BaseHTTPRequestHandler`` defaults to HTTP/1.0, which closes
+the client connection after every response — the keep-alive the gateway's
+per-request-id comments always assumed never actually happened, and every
+upstream hop paid a fresh TCP connect. :class:`KeepAliveHandlerMixin`
+flips a handler to real HTTP/1.1 (every non-streaming response in this
+tree already sends ``Content-Length``; SSE responses opt out with an
+explicit ``Connection: close``) and cooperates with the server's drain
+lifecycle:
+
+- While a connection is *parked* — the handler thread blocked in
+  ``readline`` waiting for the next request on a kept-alive socket — the
+  mixin reports it to the server (``note_parked``), so ``drain()`` can
+  sever exactly the idle connections without touching in-flight requests.
+  Without this, a draining replica wedges: its ``close(drain=True)``
+  completes but the peer's pooled sockets keep handler threads parked
+  forever, and a request relayed onto one post-drain would be served by a
+  replica the fleet believes is gone.
+- Once the server is draining, every response closes its connection
+  (``close_connection``) so no NEW parked connections accumulate.
+
+stdlib-only on purpose: the gateway package (provably jax-free on import)
+and the jax-laden replica server both use it.
+"""
+
+from __future__ import annotations
+
+__all__ = ["KeepAliveHandlerMixin"]
+
+
+class KeepAliveHandlerMixin:
+    """Mix into a ``BaseHTTPRequestHandler`` subclass (FIRST in the MRO)
+    to serve real HTTP/1.1 keep-alive. Handlers must send
+    ``Content-Length`` on every response or an explicit
+    ``Connection: close`` (SSE) — the stdlib honors the latter via
+    ``send_header``."""
+
+    protocol_version = "HTTP/1.1"
+    # Keep-alive makes Nagle's algorithm a per-request tax: the stdlib
+    # writes response headers and body as separate small segments, and on
+    # a kept-alive connection the second segment sits behind the peer's
+    # delayed ACK (~40 ms on Linux) because the connection never closes to
+    # flush it. socketserver honors this flag with TCP_NODELAY at setup.
+    disable_nagle_algorithm = True
+    # Idle cap: a kept-alive connection whose peer goes silent would
+    # otherwise pin a handler thread and an FD FOREVER (HTTP/1.0 closed
+    # per response; the gateway's public listener has no drain/sever
+    # path). socketserver applies this as the socket timeout and the
+    # stdlib's handle_one_request treats the timeout as close-on-idle.
+    # Comfortably above the upstream pool's default max_age_s (30 s) so
+    # the pool rotates connections on its own terms, not the server's.
+    timeout = 120.0
+
+    def handle_one_request(self):
+        # The blocked-on-readline window IS the parked state: mark it for
+        # the server's drain sweep, and clear it the moment a request line
+        # parses (parse_request below) so an in-flight request is never
+        # severed as "idle". Servers without parked tracking (stubs, the
+        # gateway's own listener) simply don't expose note_parked.
+        note = getattr(self.server, "note_parked", None)
+        if note is not None:
+            note(self.connection, True)
+        try:
+            super().handle_one_request()
+        finally:
+            if note is not None:
+                note(self.connection, False)
+            if getattr(self.server, "draining", False):
+                # No new parked connections once draining: the response
+                # that just went out is this connection's last.
+                self.close_connection = True
+
+    def parse_request(self):
+        note = getattr(self.server, "note_parked", None)
+        if note is not None:
+            note(self.connection, False)
+        return super().parse_request()

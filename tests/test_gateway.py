@@ -387,6 +387,14 @@ def test_gateway_tracks_outstanding_inflight():
             time.sleep(0.01)
         t.join(timeout=30)
         assert seen == 1, "in-flight relay not tracked as outstanding"
+        # dec_outstanding runs in the handler's finally AFTER the response
+        # bytes are relayed, so the client can observe its completion a
+        # scheduler quantum before the count drops — poll briefly instead
+        # of racing the handler thread (the completed-counter reasoning in
+        # test_gateway_retries_on_draining_replica_and_relays).
+        deadline = time.monotonic() + 5
+        while fleet.outstanding("r0") and time.monotonic() < deadline:
+            time.sleep(0.01)
         assert fleet.outstanding("r0") == 0
     finally:
         server.shutdown()

@@ -539,3 +539,56 @@ def test_n_lockstep_fallback(setup):
         assert len(out["choices"]) == 2
     finally:
         server.shutdown()
+
+
+def test_http11_keepalive_and_sse_terminates_cleanly(setup):
+    """End-to-end HTTP/1.1 (ISSUE 14): two JSON completions ride ONE
+    client connection (real keep-alive — the HTTP/1.0 default used to
+    close after every response), and an SSE stream on that same kept-
+    alive connection opts out with an explicit Connection: close,
+    delimits at EOF, and terminates cleanly (a fresh connection still
+    serves afterwards)."""
+    import http.client
+
+    params, cfg, tok = setup
+    server, threaded, port = _serve(params, cfg, tok, continuous=True)
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=120)
+        for i in range(2):
+            conn.request(
+                "POST", "/v1/completions",
+                body=json.dumps({"prompt": f"hi{i}",
+                                 "max_tokens": 3}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            resp = conn.getresponse()
+            out = json.loads(resp.read())
+            assert resp.status == 200
+            assert resp.version == 11  # HTTP/1.1 status line
+            assert not resp.will_close  # keep-alive actually happened
+            assert out["usage"]["completion_tokens"] == 3
+        # SSE on the SAME kept-alive connection: the server must close it
+        # (close-delimited body), and the stream must read through [DONE].
+        conn.request(
+            "POST", "/v1/completions",
+            body=json.dumps({"prompt": "hi", "max_tokens": 3,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        assert resp.will_close  # explicit Connection: close on SSE
+        body = resp.read().decode()
+        events = [line for line in body.splitlines()
+                  if line.startswith("data: ")]
+        assert events and events[-1] == "data: [DONE]"
+        conn.close()
+        # The connection died with the stream, not the server.
+        status, out = _post(port, "/v1/completions",
+                            {"prompt": "hi", "max_tokens": 2})
+        assert status == 200
+    finally:
+        server.close(drain=True, timeout=10)
+        if threaded is not None:
+            threaded.close()
