@@ -1337,7 +1337,9 @@ def _percentile(sorted_vals, q: float) -> float:
 
 def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
                                clients: int = 3, pool_max_idle: int = -1,
-                               router: str = "round_robin") -> dict:
+                               router: str = "round_robin",
+                               usage_metering: bool = False,
+                               usage_dir: str | None = None) -> dict:
     """Gateway data-plane overhead microbench (ISSUE 14): a closed loop
     of keep-alive HTTP clients driving in-process STUB replicas — first
     directly, then through the gateway — so the row isolates the
@@ -1345,6 +1347,16 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
     upstream connect it used to pay per hop) from any device work. The
     stubs do zero compute; this is the one serving number that is honest
     on a CPU-only container.
+
+    ``usage_metering=True`` runs a THIRD closed loop through a second
+    gateway over the same stub fleet with the full per-tenant metering
+    plane armed (ISSUE 15): tenant admission accounting, the
+    credential-safe label digest per request, X-Tenant-Label stamping on
+    every relay, per-request routing-ring attribution, and the
+    gateway-edge usage LEDGER (one JSONL row per request into
+    ``usage_dir``). The row then gains a ``usage_metering`` block
+    (``gateway_rps_metered``, ``metering_overhead_ratio``) that
+    perf_compare gates — metering overhead is measured, never assumed.
 
     The hoisted ``gateway_overhead`` block embeds requests/sec through
     the gateway, the added latency vs the direct leg (p50/p95), and the
@@ -1462,10 +1474,14 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
     per_client = requests // clients
     total = per_client * clients
 
-    def drive(port: int, latencies: list) -> None:
-        # One kept-alive client connection per thread (both legs): the
+    def drive(port: int, latencies: list, bearer: str = "") -> None:
+        # One kept-alive client connection per thread (all legs): the
         # client side is held constant so the pooled-vs-fresh delta is
-        # the UPSTREAM hop alone.
+        # the UPSTREAM hop alone. ``bearer`` (metered leg) exercises the
+        # real per-tenant admission/label path per request.
+        headers = {"Content-Type": "application/json"}
+        if bearer:
+            headers["Authorization"] = f"Bearer {bearer}"
         conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30.0)
         try:
             conn.connect()
@@ -1476,7 +1492,7 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
             for _ in range(per_client):
                 t0 = time.perf_counter()
                 conn.request("POST", "/v1/completions", body=payload,
-                             headers={"Content-Type": "application/json"})
+                             headers=headers)
                 resp = conn.getresponse()
                 data = resp.read()
                 if resp.status != 200:
@@ -1490,13 +1506,14 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
         finally:
             conn.close()
 
-    def closed_loop(port: int) -> tuple[float, list]:
+    def closed_loop(port: int, bearer_prefix: str = "") -> tuple[float, list]:
         lat_lists = [[] for _ in range(clients)]
         errors: list = []
 
         def run(i):
             try:
-                drive(port, lat_lists[i])
+                drive(port, lat_lists[i],
+                      bearer=f"{bearer_prefix}-{i}" if bearer_prefix else "")
             except BaseException as e:  # re-raised on the caller below
                 errors.append(e)
 
@@ -1544,6 +1561,51 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
         gw_dt, gw_lats = closed_loop(gw_port)
         pool1 = fleet.pool.stats()
         connects = sum(s.connections for s in stubs) - connects0
+        metered = None
+        if usage_metering:
+            # Metered A/B leg (ISSUE 15): same fleet, second gateway with
+            # the whole per-tenant metering plane armed — admission
+            # accounting + label digests + X-Tenant-Label stamping +
+            # routing-ring tenant attribution + the gateway-edge ledger.
+            import tempfile
+
+            from ditl_tpu.gateway.admission import TenantAdmission
+            from ditl_tpu.telemetry.flight import FlightRecorder
+            from ditl_tpu.telemetry.usage import (
+                UsageLedger, usage_ledger_path,
+            )
+
+            udir = usage_dir or tempfile.mkdtemp(prefix="ditl-usage-bench-")
+            ledger = UsageLedger(
+                usage_ledger_path(udir, "gateway-bench"),
+                source="gateway-bench")
+            server2 = make_gateway(
+                fleet, config=gwcfg, metrics=GatewayMetrics(), port=0,
+                admission=TenantAdmission(),  # no limits: pure accounting
+                usage=ledger, flight=FlightRecorder(),
+            )
+            threading.Thread(target=server2.serve_forever,
+                             daemon=True).start()
+            try:
+                m_port = server2.server_address[1]
+                warm_conn = http.client.HTTPConnection(
+                    "127.0.0.1", m_port, timeout=30.0)
+                try:
+                    for _ in range(4):
+                        warm_conn.request(
+                            "POST", "/v1/completions", body=payload,
+                            headers={"Content-Type": "application/json",
+                                     "Authorization": "Bearer warm-tenant"})
+                        warm_conn.getresponse().read()
+                finally:
+                    warm_conn.close()
+                m_dt, m_lats = closed_loop(m_port,
+                                           bearer_prefix="bench-tenant")
+            finally:
+                server2.shutdown()
+                server2.server_close()
+                ledger.close()
+            metered = (m_dt, m_lats, udir)
     finally:
         server.shutdown()
         server.server_close()
@@ -1555,6 +1617,26 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
                                                                0.95)
     g_p50, g_p95 = _percentile(gw_lats, 0.50), _percentile(gw_lats, 0.95)
     pooled = fleet.pool.max_idle_per_replica > 0
+    usage_block = {}
+    if metered is not None:
+        from ditl_tpu.telemetry.usage import load_usage, rollup
+
+        m_dt, m_lats, udir = metered
+        m_rps = total / m_dt
+        rows = load_usage(udir)
+        usage_block = {"usage_metering": {
+            "schema": 1,
+            "usage_dir": udir,
+            "gateway_rps_metered": round(m_rps, 1),
+            "metered_p50_s": round(_percentile(m_lats, 0.50), 6),
+            "metered_p95_s": round(_percentile(m_lats, 0.95), 6),
+            # Fractional rps cost of arming the ledger vs the unmetered
+            # gateway leg on the same fleet (negative = noise in the
+            # metered leg's favor; gated with direction -1).
+            "metering_overhead_ratio": round(1.0 - m_rps / gw_rps, 4),
+            "ledger_rows": len(rows),
+            "tenants": len(rollup(rows)),
+        }}
     return {
         "metric": "gateway data-plane overhead (%d stub replica(s), "
                   "pool=%s)" % (n_replicas, "on" if pooled else "off"),
@@ -1588,6 +1670,7 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
                      "discards": pool1["discards"] - pool0["discards"]},
             "upstream_connects": connects,
         },
+        **usage_block,
         **_chaos_result(),
         **_incident_result(_inc0),
     }
@@ -2164,6 +2247,14 @@ if __name__ == "__main__":
                         "perf_compare gates; run once with "
                         "--serve-pool-idle 0 for the fresh-connect A/B "
                         "leg")
+    parser.add_argument("--serve-usage-metering", action="store_true",
+                        help="with --serve-gateway-overhead: run a third "
+                        "closed loop through a metering-armed gateway "
+                        "(tenant admission + label digests + "
+                        "X-Tenant-Label + the gateway-edge usage ledger, "
+                        "ISSUE 15); the row gains a usage_metering block "
+                        "(gateway_rps_metered / metering_overhead_ratio) "
+                        "that perf_compare gates")
     parser.add_argument("--serve-pool-idle", type=int, default=-1,
                         help="with --serve-gateway-overhead: override "
                         "gateway.pool_max_idle_per_replica (0 = pooling "
@@ -2205,6 +2296,7 @@ if __name__ == "__main__":
             n_replicas=args.serve_replicas or 2,
             requests=args.serve_overhead_requests,
             pool_max_idle=args.serve_pool_idle,
+            usage_metering=args.serve_usage_metering,
         ))
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"

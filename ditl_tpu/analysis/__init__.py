@@ -51,6 +51,7 @@ from ditl_tpu.analysis import (  # noqa: E402,F401  (registration side effect)
     rules_imports,
     rules_locks,
     rules_registry,
+    rules_tenant,
     rules_threads,
 )
 

@@ -139,6 +139,18 @@ class Settings:
     docs: tuple[str, ...] = ("docs/design.md",)  # repo-root-relative
     # -- metric-catalog ----------------------------------------------------
     metric_methods: tuple[str, ...] = ("counter", "gauge", "histogram")
+    # -- tenant-label-discipline (ISSUE 15) --------------------------------
+    # Telemetry sink call names judged, the identifier spellings treated
+    # as raw tenant identity, and the laundering wrappers that sanction a
+    # mention. Lexical on purpose (the lock-discipline stance).
+    tenant_sink_calls: tuple[str, ...] = (
+        "counter", "gauge", "histogram", "event",
+    )
+    tenant_raw_markers: tuple[str, ...] = (
+        "bearer", "api_key", "apikey", "authorization",
+    )
+    tenant_raw_names: tuple[str, ...] = ("tenant", "raw_tenant")
+    tenant_label_funcs: tuple[str, ...] = ("tenant_label", "sanitize_label")
     # Dotted module exporting normalize_family()/catalog_families(); ""
     # disables the rule (fixture projects without a catalog).
     catalog_module: str = "ditl_tpu.telemetry.catalog"

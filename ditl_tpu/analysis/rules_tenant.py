@@ -1,0 +1,95 @@
+"""tenant-label-discipline: raw tenant identity never reaches telemetry.
+
+Tenants are keyed on raw ``Authorization: Bearer`` credentials
+(gateway/admission.py); everything observable — metric families, /usage
+rollups, journal/ledger rows, incident manifests — must carry only the
+credential-safe label (``tenant_label``'s sha digest or a
+``sanitize_label``-reduced configured name). The runtime halves of that
+invariant exist since ISSUE 4 ("raw API keys never leave this module");
+this pass is the STATIC half (ISSUE 15 satellite): at every telemetry
+sink call — ``counter(...)`` / ``gauge(...)`` / ``histogram(...)`` /
+``<journal>.event(...)`` — no argument expression may mention a
+raw-identity variable (``bearer``/``api_key``/``authorization`` spellings,
+or a bare ``tenant``/``*_tenant`` name) unless that mention sits inside a
+``tenant_label(...)`` or ``sanitize_label(...)`` wrapping call.
+
+Lexical by design, like lock-discipline: the rule judges NAMES, so code
+that launders a credential through an innocently-named variable escapes
+it — the runtime guards still stand behind it. The payoff is the common
+failure: someone threading ``tenant`` (which IS the raw bearer at the
+gateway) straight into a metric family or a journal row.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ditl_tpu.analysis.core import (
+    Diagnostic,
+    Project,
+    call_name,
+    rule,
+)
+
+
+def _suspicious(identifier: str, settings) -> bool:
+    low = identifier.lower()
+    if any(marker in low for marker in settings.tenant_raw_markers):
+        return True
+    return low in settings.tenant_raw_names or low.endswith("_tenant")
+
+
+def _terminal_names(node: ast.AST):
+    """Every Name / Attribute-terminal identifier in a subtree, paired
+    with its node (f-string values included — ast.walk descends into
+    FormattedValue)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            yield sub.id, sub
+        elif isinstance(sub, ast.Attribute):
+            yield sub.attr, sub
+
+
+@rule(
+    "tenant-label-discipline",
+    "raw bearer/tenant identifiers must pass through tenant_label()/"
+    "sanitize_label() before reaching counter()/gauge()/histogram()/"
+    ".event() telemetry sinks (the static half of the ISSUE 4 'raw API "
+    "keys never leave' invariant)",
+)
+def check_tenant_label_discipline(project: Project) -> list[Diagnostic]:
+    s = project.settings
+    out: list[Diagnostic] = []
+    for f in project.files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in s.tenant_sink_calls:
+                continue
+            # Names inside a sanctioning wrapper call anywhere in the
+            # argument subtree are laundered — collect them first so
+            # `counter(f"x_{sanitize_label(tenant)}")` stays clean while
+            # the unwrapped spelling fires.
+            sanctioned: set[int] = set()
+            roots = list(node.args) + [kw.value for kw in node.keywords]
+            for root in roots:
+                for sub in ast.walk(root):
+                    if (isinstance(sub, ast.Call)
+                            and call_name(sub) in s.tenant_label_funcs):
+                        for inner in ast.walk(sub):
+                            sanctioned.add(id(inner))
+            for root in roots:
+                for identifier, name_node in _terminal_names(root):
+                    if id(name_node) in sanctioned:
+                        continue
+                    if not _suspicious(identifier, s):
+                        continue
+                    out.append(Diagnostic(
+                        "tenant-label-discipline", f.display,
+                        name_node.lineno,
+                        f"raw tenant identity {identifier!r} reaches a "
+                        f"{call_name(node)}() telemetry sink — wrap it in "
+                        "tenant_label(...)/sanitize_label(...) (raw API "
+                        "keys must never leave the admission layer)",
+                    ))
+    return out

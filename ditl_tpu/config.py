@@ -767,6 +767,49 @@ class KVTierConfig:
 
 
 @dataclass(frozen=True)
+class UsageConfig:
+    """Per-tenant usage metering & cost attribution (ISSUE 15,
+    telemetry/usage.py): the in-memory per-tenant meter behind the
+    ``/usage`` endpoints + ``ditl_usage_*`` families, the crash-consistent
+    JSONL usage ledger, and the noisy-neighbor conviction thresholds the
+    serving anomaly monitor applies when a TPOT/TTFT storm fires."""
+
+    # Arm the in-memory meter on continuous-engine replicas (per-tenant
+    # rollups at /usage, bounded ditl_usage_* families on /metrics, the
+    # windowed accounting convictions read). Off = the engine keeps zero
+    # per-tenant state — the bench A/B's unmetered leg.
+    metering: bool = True
+    # Directory for the crash-consistent usage ledger ("" = no ledger;
+    # the meter still serves /usage). Each process writes its own
+    # usage-<source>.jsonl, rotated under telemetry.journal_max_mb;
+    # aggregate with python -m ditl_tpu.telemetry.usage --dir DIR.
+    ledger_dir: str = ""
+    # Distinct per-tenant metric-family sets (and rollup/window entries)
+    # before new tenants fold into the "other" label — the bounded-
+    # families rule GatewayMetrics already applies.
+    max_tenant_families: int = 32
+    # Noisy-neighbor conviction: when a TPOT/TTFT storm fires, the tenant
+    # holding at least conviction_share of the window's prefill tokens is
+    # named in the incident bundle — provided the window moved at least
+    # conviction_min_tokens prompt tokens (thin windows convict nobody).
+    # Tuning both is troubleshooting §33.
+    conviction_share: float = 0.6
+    conviction_min_tokens: int = 256
+
+    def __post_init__(self):
+        if not 0.0 < self.conviction_share <= 1.0:
+            raise ValueError(
+                f"usage.conviction_share must be in (0, 1], got "
+                f"{self.conviction_share}"
+            )
+        for name in ("max_tenant_families", "conviction_min_tokens"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"usage.{name} must be >= 1, got {getattr(self, name)}"
+                )
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection plane (ditl_tpu/chaos/, ISSUE 5). ``rules`` is the
     compact spec string ``site:action[@k=v,...];...`` (see
@@ -1012,6 +1055,7 @@ class Config:
     gateway: GatewayConfig = field(default_factory=GatewayConfig)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     kvtier: KVTierConfig = field(default_factory=KVTierConfig)
+    usage: UsageConfig = field(default_factory=UsageConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 

@@ -436,6 +436,12 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     # default.
     kvtier = None
     journal = None
+    # Usage metering (ISSUE 15): a telemetry/usage.UsageLedger recording
+    # one gateway-edge row per admission-controlled request (tenant
+    # digest, class, terminal outcome, e2e) — the edge half of the
+    # attribution story (tenant throttles and fleet-level 429/503/504s
+    # never reach an engine ledger). Unarmed by default.
+    usage = None
 
     def log_message(self, *args):
         logger.debug("gateway http: " + args[0], *args[1:])
@@ -553,6 +559,8 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     "no SLO monitor configured"}})
             else:
                 self._send_json(200, self.slo.report())
+        elif path in ("/usage", "/v1/usage"):
+            self._usage()
         elif path in ("/incidents", "/v1/incidents"):
             self._incidents()
         elif path in ("/actions", "/v1/actions"):
@@ -611,6 +619,37 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             "gateway": own,
             "replicas": replicas,
         })
+
+    def _usage(self) -> None:
+        """Fleet usage view (ISSUE 15): every routable replica's /usage
+        rollups fanned out concurrently (one shared deadline, the
+        /incidents pattern) and merged into one per-tenant fleet rollup,
+        plus the gateway's own admission counters — "what did tenant X
+        consume, fleet-wide" is one GET. Replicas without an armed meter
+        answer 404 and are simply absent (absent != zero usage)."""
+        from ditl_tpu.telemetry.usage import merge_rollups
+
+        def fetch(view):
+            return self.fleet.pool.get_json(
+                view.id, view.address, "/usage",
+                timeout=self.gwcfg.probe_timeout_s,
+            )
+
+        replicas: dict[str, dict] = {}
+        for view, data in self._fan_out_replicas(self.fleet.routable(),
+                                                 fetch):
+            if isinstance(data, dict) and isinstance(
+                    data.get("tenants"), dict):
+                replicas[view.id] = data["tenants"]
+        payload = {
+            "fleet": merge_rollups(list(replicas.values())),
+            "replicas": replicas,
+        }
+        if self.admission is not None:
+            # The gateway-edge view: admissions/throttles per tenant —
+            # requests a throttle rejected never reach any replica meter.
+            payload["gateway_tenants"] = self.admission.snapshot()
+        self._send_json(200, payload)
 
     def _fan_out_replicas(self, views, fetch) -> list:
         """Concurrent per-replica ``fetch`` with ONE shared deadline
@@ -732,6 +771,13 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                          span=None) -> None:
         m = self.gw
         tenant = self._tenant()
+        # Credential-safe label (ISSUE 15): computed ONCE here and used
+        # everywhere downstream — metrics, the traffic recorder, the
+        # routing flight ring, the X-Tenant-Label relay header, and the
+        # gateway usage ledger. The raw bearer keys admission state only.
+        label = tenant_label(
+            tenant,
+            self.admission.per_tenant if self.admission is not None else ())
         # Reject-don't-drop for explicit client classes: a malformed
         # X-SLO-Class must 400 HERE, exactly as the replica would — the
         # relay layer only forwards KNOWN names (header-injection guard),
@@ -747,7 +793,6 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             # Raw Bearer token keys the admission state (per_tenant
             # overrides match on it); metrics get the credential-safe
             # label only (/metrics is unauthenticated).
-            label = tenant_label(tenant, self.admission.per_tenant)
             decision = self.admission.acquire(tenant)
             if not decision.ok:
                 m.throttled.inc()
@@ -767,6 +812,17 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     retry_after=max(1, min(30, math.ceil(
                         decision.retry_after_s))),
                 )
+                if self.usage is not None:
+                    # A throttle is a terminal outcome only the gateway
+                    # can bill — the request never reaches a replica.
+                    self.usage.record(
+                        tenant=label, outcome="429",
+                        slo_class=(decision.slo_class
+                                   or self._client_class(payload)
+                                   or "default"),
+                        prompt_tokens=prompt_token_estimate(payload),
+                        throttled=True,
+                    )
                 return
             m.tenant_counter(label, "admitted").inc()
             pinned_class = decision.slo_class or None
@@ -778,9 +834,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             # inter-arrival times. Tenant rides as the credential-safe
             # digest, never the bearer token.
             self.recorder.note(
-                tenant=tenant_label(
-                    tenant,
-                    self.admission.per_tenant if self.admission else ()),
+                tenant=label,
                 slo_class=pinned_class or self._client_class(payload),
                 prompt_tokens=prompt_token_estimate(payload),
                 max_new=int(payload.get("max_tokens") or 0)
@@ -789,13 +843,27 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 stream=bool(payload.get("stream")),
             )
         t0 = time.time()
+        outcome = "error"
         try:
-            self._route_and_relay(path, payload, raw, span=span,
-                                  slo_class=pinned_class)
+            outcome = self._route_and_relay(path, payload, raw, span=span,
+                                            slo_class=pinned_class,
+                                            tenant=label)
         finally:
             if self.admission is not None:
                 self.admission.release(tenant)
             m.e2e.observe(time.time() - t0)
+            if self.usage is not None:
+                # One gateway-edge usage row per admitted request — the
+                # outcome the CLIENT saw (fleet 429/503/504s included),
+                # next to the engine-side rows the replicas ledger.
+                self.usage.record(
+                    tenant=label, outcome=outcome,
+                    slo_class=(pinned_class or self._client_class(payload)
+                               or "default"),
+                    prompt_tokens=prompt_token_estimate(payload),
+                    stream=bool(payload.get("stream")),
+                    e2e_s=round(time.time() - t0, 6),
+                )
 
     def _client_class(self, payload: dict) -> str | None:
         """The SLO class the CLIENT asked for (validated header, else
@@ -808,7 +876,15 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
 
     def _route_and_relay(self, path: str, payload: dict, raw: bytes,
                          record: bool = True, span=None,
-                         slo_class: str | None = None) -> None:
+                         slo_class: str | None = None,
+                         tenant: str | None = None) -> str:
+        """Route + relay one request; returns the terminal outcome the
+        client saw (``200``/``429``/``503``/``504``/``cancel`` — the
+        usage-ledger vocabulary; ``cancel`` = a stream aborted after
+        bytes moved). ``tenant`` is the CREDENTIAL-SAFE label (never the
+        bearer) — it rides the routing flight ring and the
+        X-Tenant-Label header every relay stamps, which is how the
+        replica's engine attributes its accounting (ISSUE 15)."""
         m, cfg = self.gw, self.gwcfg
         stream = bool(payload.get("stream"))
         key = affinity_key(payload, cfg.affinity_prefix_tokens)
@@ -882,6 +958,9 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     replica=view.id, role=view.role,
                     slo_class=eff_class or "default", spill=spilled,
                     stream=stream, candidates=len(candidates),
+                    # Attribution (ISSUE 15): ring dumps inside incident
+                    # bundles carry WHOSE requests landed where.
+                    tenant=tenant or "anonymous",
                 )
             if record:
                 if attempt > 0:
@@ -938,6 +1017,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     view, path, raw, stream, hedge_peers,
                     deadline_left=remaining if propagate_deadline else None,
                     span=rspan, root=span, slo_class=slo_class,
+                    tenant=tenant,
                 )
             finally:
                 self.fleet.dec_outstanding(view.id)
@@ -955,11 +1035,11 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     m.completed.inc()
                     m.class_counter("relayed", eff_class).inc()
                     self._sample_rate()
-                return
+                return "200"
             if outcome == "aborted":
                 # Bytes already relayed; nothing more the gateway can do.
                 m.stream_aborts.inc()
-                return
+                return "cancel"
             if outcome == "busy":
                 saw_busy = True
                 hint, busy_id = info
@@ -978,12 +1058,14 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 outcome=("timeout" if timed_out
                          else "saturated" if saw_busy else "no_replica"),
                 slo_class=eff_class or "default",
+                tenant=tenant or "anonymous",
             )
         if timed_out:
             self._send_json(504, {"error": {
                 "message": "request deadline exhausted before any replica "
                            "answered",
                 "type": "timeout_error"}})
+            return "504"
         elif saw_busy:
             m.saturated.inc()
             if record:
@@ -994,6 +1076,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                            "type": "rate_limit_error"}},
                 retry_after=self._fleet_retry_after(floor=busy_hint),
             )
+            return "429"
         else:
             if self.actuator is not None:
                 # Cold-start-aware admission (ISSUE 12): nothing routable
@@ -1018,10 +1101,11 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                                    "type": "rate_limit_error"}},
                         retry_after=retry,
                     )
-                    return
+                    return "429"
             m.no_replica.inc()
             self._send_json(503, {"error": {
                 "message": "no live replica available"}})
+            return "503"
 
     # -- KV handoff orchestration (ISSUE 13) ---------------------------------
 
@@ -1165,7 +1249,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
 
     def _open(self, view, path: str, raw: bytes,
               deadline_left: float | None = None, trace=None,
-              slo_class: str | None = None):
+              slo_class: str | None = None, tenant: str | None = None):
         """One upstream request; returns (conn, resp) or raises OSError/
         HTTPException on connection-level failure (retryable — no bytes
         have been relayed to the client yet). ``deadline_left`` (seconds)
@@ -1189,6 +1273,12 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         cls = slo_class or self.headers.get("X-SLO-Class")
         if cls in SLO_CLASS_NAMES:
             headers["X-SLO-Class"] = cls
+        if tenant:
+            # Tenant relay header (ISSUE 15): the admission-layer label
+            # (digest or configured name — NEVER the raw bearer), so the
+            # replica's engine attributes tokens/pages/device time to the
+            # same identity the gateway throttles and meters under.
+            headers["X-Tenant-Label"] = sanitize_label(tenant)
         if trace is not None:
             headers["traceparent"] = format_traceparent(trace.context)
         if deadline_left is not None:
@@ -1209,7 +1299,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
 
     def _relay_one(self, view, path, raw, stream, hedge_peers,
                    deadline_left: float | None = None, span=None, root=None,
-                   slo_class: str | None = None):
+                   slo_class: str | None = None, tenant: str | None = None):
         """Proxy one attempt. Returns (outcome, info):
         ``("done", served_replica_id)`` — response relayed;
         ``("retry", None)`` — connection-level failure, safe to fail over;
@@ -1235,10 +1325,12 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 conn, resp, served = self._hedged_open(
                     view, hedge_peers, path, raw, deadline_left,
                     span=span, root=root, slo_class=slo_class,
+                    tenant=tenant,
                 )
             else:
                 conn, resp = self._open(view, path, raw, deadline_left,
-                                        trace=span, slo_class=slo_class)
+                                        trace=span, slo_class=slo_class,
+                                        tenant=tenant)
         except (OSError, http.client.HTTPException) as e:
             if not isinstance(e, _HedgeQueueTimeout):
                 # A queue timeout is gateway-local backlog; blaming the
@@ -1318,7 +1410,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             return "aborted"
 
     def _hedged_open(self, view, peers, path, raw, deadline_left=None,
-                     span=None, root=None, slo_class=None):
+                     span=None, root=None, slo_class=None, tenant=None):
         """Tail-latency hedging (non-streaming only): if the primary has
         not answered within ``hedge_after_s``, fire the same request at the
         least-loaded peer and take whichever responds first. The loser's
@@ -1335,7 +1427,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         try:
             t0 = time.monotonic()
             primary = pool.submit(self._open, view, path, raw, deadline_left,
-                                  span, slo_class)
+                                  span, slo_class, tenant)
             done, _ = wait([primary], timeout=self.gwcfg.hedge_after_s)
             if done:
                 conn, resp = primary.result()  # may raise: caller retries
@@ -1388,7 +1480,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 if deadline_left is not None else None
             )
             secondary = pool.submit(self._open, peer, path, raw,
-                                    secondary_left, hspan, slo_class)
+                                    secondary_left, hspan, slo_class, tenant)
             futures = {primary: view.id, secondary: peer.id}
             last_exc: BaseException | None = None
             pending = set(futures)
@@ -1471,6 +1563,7 @@ def make_gateway(
     recorder=None,
     kvtier=None,
     journal=None,
+    usage=None,
 ) -> GatewayHTTPServer:
     """Build (not start) the gateway server over ``fleet`` — tests drive it
     on a thread, ``main`` drives it with ``serve_forever``. ``router``
@@ -1490,7 +1583,10 @@ def make_gateway(
     (config.KVTierConfig with ``handoff=True``) arms the prefill->decode
     KV handoff orchestration (ISSUE 13); ``journal``
     (telemetry/journal.EventJournal) records its per-request cost-model
-    decisions."""
+    decisions. ``usage`` (telemetry/usage.UsageLedger) arms the
+    gateway-edge usage ledger: one row per admission-controlled request
+    with the tenant digest, class, and terminal outcome (ISSUE 15) —
+    unarmed by default."""
     config = config or GatewayConfig()
     # Upstream keep-alive pool caps (ISSUE 14): the fleet owns the pool
     # (health polls and fleet-mutation invalidation need it gateway or
@@ -1535,6 +1631,7 @@ def make_gateway(
             "recorder": recorder,
             "kvtier": kvtier,
             "journal": journal,
+            "usage": usage,
         },
     )
     return GatewayHTTPServer(
@@ -1617,12 +1714,13 @@ def main(argv: list[str] | None = None) -> int:
         Config(),
         [o for o in args.overrides
          if o.startswith(("gateway.", "telemetry.", "autoscale.",
-                          "kvtier."))],
+                          "kvtier.", "usage."))],
     )
     config = full_config.gateway
     telemetry_cfg = full_config.telemetry
     autoscale_cfg = full_config.autoscale
     kvtier_cfg = full_config.kvtier
+    usage_cfg = full_config.usage
 
     from ditl_tpu.gateway.roles import parse_roles, role_knobs
 
@@ -1685,6 +1783,21 @@ def main(argv: list[str] | None = None) -> int:
 
                 cmd += ["--incident-dir",
                         _os.path.join(args.incident_dir, replica_id)]
+            if usage_cfg.ledger_dir:
+                # Per-replica ledger subdirectory (ISSUE 15): each process
+                # appends its own usage-*.jsonl; the aggregator CLI reads
+                # any of them, the gateway's /usage fan-out reads the live
+                # meters over HTTP.
+                import os as _os
+
+                cmd += ["--usage-dir",
+                        _os.path.join(usage_cfg.ledger_dir, replica_id)]
+            if not usage_cfg.metering:
+                cmd += ["--no-usage-metering"]
+            for field_name in ("max_tenant_families", "conviction_share",
+                               "conviction_min_tokens"):
+                cmd += ["--usage-override",
+                        f"{field_name}={getattr(usage_cfg, field_name)}"]
             return cmd + list(args.replica_arg)
 
         return build_argv
@@ -1755,6 +1868,15 @@ def main(argv: list[str] | None = None) -> int:
         from ditl_tpu.gateway.autoscale import TrafficRecorder
 
         recorder = TrafficRecorder(args.save_trace)
+    usage_ledger = None
+    if usage_cfg.ledger_dir:
+        from ditl_tpu.telemetry.usage import UsageLedger, usage_ledger_path
+
+        usage_ledger = UsageLedger(
+            usage_ledger_path(usage_cfg.ledger_dir, "gateway"),
+            source="gateway",
+            max_bytes=telemetry_cfg.journal_max_bytes(),
+        )
     supervisor = None
     server = None
     # One finally covers startup too: a replica that never turns healthy
@@ -1796,7 +1918,7 @@ def main(argv: list[str] | None = None) -> int:
                               actuator=actuator, recorder=recorder,
                               kvtier=kvtier_cfg if kvtier_cfg.handoff
                               else None,
-                              journal=journal)
+                              journal=journal, usage=usage_ledger)
         stopping = threading.Event()
 
         def _shutdown(signum, frame):
@@ -1825,6 +1947,8 @@ def main(argv: list[str] | None = None) -> int:
         fleet.stop_all(drain=True, timeout=config.drain_timeout_s)
         if recorder is not None:
             recorder.close()
+        if usage_ledger is not None:
+            usage_ledger.close()
         if journal is not None:
             journal.close()
         if tracer is not None and tracer.journal is not None:

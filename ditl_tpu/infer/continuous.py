@@ -86,6 +86,7 @@ from ditl_tpu.models import llama
 from ditl_tpu.telemetry.flight import TICK_RING, FlightRecorder
 from ditl_tpu.telemetry.serving import ServingMetrics
 from ditl_tpu.telemetry.tracing import NULL_TRACER, Tracer
+from ditl_tpu.telemetry.usage import sanitize_label
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -318,6 +319,34 @@ class Request:
     # (resume_prefill_tokens).
     cache_hit_tokens: int = 0
     cache_miss_tokens: int = 0
+    # Tier split of cache_hit_tokens (ISSUE 13/15): reuse served from the
+    # host-RAM tier / a shipped handoff rather than resident HBM pages —
+    # stored per request so the usage ledger can bill the split, not just
+    # the fleet counters.
+    cache_hit_host_tokens: int = 0
+    cache_hit_handoff_tokens: int = 0
+    # Usage attribution (ISSUE 15): ``tenant`` is the credential-safe
+    # label the gateway/server derived (admission digest or configured
+    # name — NEVER the raw bearer; sanitized again at submit). The
+    # remaining fields are the per-request cost the terminal ledger row
+    # carries: an estimated device-seconds share (prefill dispatch wall +
+    # this request's share of each decode tick it rode — an estimate by
+    # construction, consistent across tenants, documented in
+    # docs/design.md), preemptions absorbed, and resume re-prefill thrash.
+    tenant: str = "anonymous"
+    device_time_est_s: float = 0.0
+    # Monotonic stamp of the LAST prefill dispatch's completion: the first
+    # decode chunk's device-share interval starts here, not at slot
+    # admission — the prefill wall is already billed by _record_prefill,
+    # and measuring the first chunk from t_admitted would double-bill it
+    # (prefill-heavy tenants would be systematically overbilled, exactly
+    # the skew convictions must not have).
+    t_prefill_done: float = 0.0
+    preempt_count: int = 0
+    resume_tokens: int = 0
+    # One terminal usage row per request, no matter how many terminal
+    # paths race (cancel vs lagged harvest completion).
+    usage_noted: bool = False
 
     @property
     def slo_rank(self) -> tuple[int, int]:
@@ -368,6 +397,8 @@ class ContinuousEngine:
         tracer: Tracer | None = None,
         flight: FlightRecorder | None = None,
         anomaly=None,
+        usage=None,
+        usage_ledger=None,
     ):
         """``max_cache_len`` caps the per-slot KV cache below the model's
         ``max_seq_len`` — essential for long-context models (Llama-3.1's
@@ -459,6 +490,18 @@ class ContinuousEngine:
         # bundle already carries; never on the per-request path).
         self.flight = flight if flight is not None else FlightRecorder()
         self.anomaly = anomaly
+        # Per-tenant usage metering (ISSUE 15, telemetry/usage.py):
+        # ``usage`` (UsageMeter) keeps bounded in-memory rollups + the
+        # windowed prefill/device accounting noisy-neighbor convictions
+        # read; ``usage_ledger`` (UsageLedger) writes ONE crash-consistent
+        # JSONL row per terminal request — both fed from host values the
+        # scheduler already holds (zero device syncs), both unarmed by
+        # default. The meter binds the engine's own registry so the
+        # ditl_usage_* families render on the same /metrics.
+        self.usage = usage
+        self.usage_ledger = usage_ledger
+        if usage is not None:
+            usage.bind(self.metrics.registry)
         # Per-tick prefill work [(req_id, tokens, wall_s)] — the
         # interference-attribution input (see step()).
         self._tick_prefills: list[tuple[int, int, float]] = []
@@ -2025,6 +2068,7 @@ class ContinuousEngine:
         deadline_s: float | None = None,
         slo_class: str | None = None,
         trace: Any = None,
+        tenant: str | None = None,
     ) -> int:
         """Queue a request; returns its id (see ``results``/``run``).
         ``stream``: optional ``queue.Queue`` receiving per-chunk token lists
@@ -2046,10 +2090,24 @@ class ContinuousEngine:
         victims under pool pressure (module docstring); never changes a
         request's RESULT, only when it runs. ``trace``: upstream span/
         SpanContext (telemetry/tracing.py) the engine's lifecycle spans
-        chain under when the engine's tracer is armed; ignored otherwise."""
+        chain under when the engine's tracer is armed; ignored otherwise.
+        ``tenant``: credential-safe tenant label (ISSUE 15 — the admission
+        digest or a configured public name, NEVER a raw bearer; sanitized
+        again here) the request's usage accounting attributes to."""
         gen = self.gen
+        tenant = sanitize_label(tenant or "anonymous")
         if self.max_queue is not None and len(self._queue) >= self.max_queue:
             self.metrics.queue_full.inc()
+            # A 429 is a terminal outcome the tenant's bill must carry
+            # (the request consumed admission capacity even though it
+            # never reached a slot) — ledgered here because the engine is
+            # the only place that knows the queue said no.
+            self._note_usage_row({
+                "tenant": tenant, "outcome": "429",
+                "slo_class": slo_class or "interactive",
+                "prompt_tokens": len(prompt_tokens or ()),
+                "generated_tokens": 0,
+            })
             raise QueueFullError(
                 f"admission queue full ({self.max_queue} waiting requests)"
             )
@@ -2137,6 +2195,7 @@ class ContinuousEngine:
                 if deadline_s is not None else None
             ),
             slo_class=slo_class,
+            tenant=tenant,
         )
         self._next_id += 1
         if self.tracer.armed:
@@ -2962,6 +3021,7 @@ class ContinuousEngine:
         # resume does not interleave them across ticks.)
         self._win_resume_tokens += pos - d0  # thrash-guard accounting
         self.resume_prefill_tokens += pos - d0
+        req.resume_tokens += pos - d0  # per-request thrash for the ledger
         step = self.prefill_chunk or s
         d = d0
         w0, m0 = time.time(), time.monotonic()
@@ -3045,6 +3105,7 @@ class ContinuousEngine:
             self._free_slot_pages(slot)
             self._enqueue(req)  # old req_id => front of its class
             self.preemptions += 1
+            req.preempt_count += 1
             self.metrics.preemptions.inc()
             logger.info(
                 "preempted mid-prefill request %d; requeued fresh", req.req_id
@@ -3064,6 +3125,7 @@ class ContinuousEngine:
         self._free_slot_pages(slot)
         self._enqueue(req)  # old req_id => front of its class
         self.preemptions += 1
+        req.preempt_count += 1
         self.metrics.preemptions.inc()
         logger.info(
             "preempted request %d (%d tokens in); pages reclaimed",
@@ -3153,6 +3215,58 @@ class ContinuousEngine:
             req.request_span.end(tokens=len(req.tokens), **attrs)
             req.request_span = None
 
+    def _note_usage_row(self, row: dict) -> None:
+        """One usage-accounting row into both sinks (meter + ledger),
+        whichever is armed. Never raises into the scheduler: billing must
+        not take down serving (the anomaly-plane rule)."""
+        if self.usage is None and self.usage_ledger is None:
+            return
+        try:
+            if self.usage is not None:
+                self.usage.note_terminal(row)
+            if self.usage_ledger is not None:
+                self.usage_ledger.record(**row)
+        except Exception:  # noqa: BLE001 - metering must not crash serving
+            logger.exception("usage metering failed (row dropped)")
+
+    def _note_usage_terminal(self, req: Request, outcome: str) -> None:
+        """Build and record the ONE terminal usage row for ``req`` — the
+        per-request accounting the engine already computed, attributed to
+        the request's tenant (ISSUE 15 tentpole). Written once at end like
+        spans (crash-consistent: a SIGKILL loses at most this row), from
+        every terminal path: completion (200), deadline eviction (504),
+        and cancellation; submit-time 429s write their own thin row.
+        Idempotent via ``usage_noted`` — cancel racing a lagged pipelined
+        harvest must not bill twice."""
+        if req.usage_noted or (self.usage is None
+                               and self.usage_ledger is None):
+            return
+        req.usage_noted = True
+        t_now = time.monotonic()
+        self._note_usage_row({
+            # req.tenant was sanitized at submit; sanitize again so a
+            # directly-constructed Request (tests, embedders) can never
+            # leak an unsanitized identifier into the ledger.
+            "tenant": sanitize_label(req.tenant),
+            "outcome": outcome,
+            "slo_class": req.slo_class,
+            "req_id": req.req_id,
+            "prompt_tokens": len(req.prompt),
+            "generated_tokens": len(req.tokens),
+            "cache_hit_tokens": req.cache_hit_tokens,
+            "cache_hit_host_tokens": req.cache_hit_host_tokens,
+            "cache_hit_handoff_tokens": req.cache_hit_handoff_tokens,
+            "prefilled_tokens": req.cache_miss_tokens,
+            "queue_wait_s": round(req.t_admitted - req.t_submit, 6)
+            if req.t_admitted and req.t_submit else 0.0,
+            "device_time_est_s": round(req.device_time_est_s, 6),
+            "interference_absorbed_s": round(req.interference_s, 6),
+            "preemptions": req.preempt_count,
+            "resume_prefill_tokens": req.resume_tokens,
+            "e2e_s": round(t_now - req.t_submit, 6) if req.t_submit
+            else 0.0,
+        })
+
     def _expire(self, req: Request) -> None:
         """Terminal bookkeeping for a deadline eviction: the request
         completes (with whatever tokens it already produced), waiters see
@@ -3163,6 +3277,7 @@ class ContinuousEngine:
         req.finished = True
         req.cancelled = True  # lagged pipelined harvests must skip it
         self.metrics.deadline_expired.inc()
+        self._note_usage_terminal(req, "504")
         self._close_spans(req, expired=True)
         if req.stream is not None:
             req.stream.put(None)
@@ -3243,6 +3358,11 @@ class ContinuousEngine:
             return  # re-admission after a mid-prefill preemption
         req.cache_hit_tokens = hit_tokens
         req.cache_miss_tokens = len(req.prompt) - hit_tokens
+        # Tier split stored per request too (ISSUE 15): the usage ledger
+        # bills a host swap-in / shipped handoff differently from an HBM
+        # hit, exactly like the fleet counters below do.
+        req.cache_hit_host_tokens = host_tokens
+        req.cache_hit_handoff_tokens = handoff_tokens
         self.metrics.note_prefix_cache(
             req.cache_hit_tokens, req.cache_miss_tokens,
             host_tokens=host_tokens, handoff_tokens=handoff_tokens,
@@ -3261,6 +3381,16 @@ class ContinuousEngine:
         self.max_tick_prefill_tokens = max(
             self.max_tick_prefill_tokens, self._tick_prefill_spent
         )
+        # Usage attribution (ISSUE 15): the dispatch wall of this prefill
+        # is the request's own cost — the prefill half of the
+        # device-time estimate, and the LIVE feed the noisy-neighbor
+        # conviction window reads (a mid-storm batch job must be visible
+        # before it terminates). Host clocks only.
+        req.device_time_est_s += dt
+        req.t_prefill_done = time.monotonic()
+        if self.usage is not None:
+            self.usage.note_prefill(req.tenant, tokens)
+            self.usage.note_device(req.tenant, dt)
         if req.request_span is not None:
             self.tracer.start_span(
                 "engine.prefill", parent=req.request_span, t0=w0,
@@ -3373,6 +3503,19 @@ class ContinuousEngine:
         if snapshot is None:
             snapshot = self._snapshot_slots()
         t_now = time.monotonic()  # one clock read per harvest, shared below
+        # Decode-tick device-time share (ISSUE 15): the slots of one tick
+        # ran ONE device program together, so each live decode slot's
+        # harvest interval is attributed 1/n_share to its request — the
+        # decode half of the per-request device-time estimate (the prefill
+        # half is measured per dispatch in _record_prefill). An estimate
+        # by construction (host wall, pipelined ticks overlap dispatch);
+        # consistent ACROSS tenants, which is what billing shares and
+        # convictions need. Zero device syncs: t_now is already read.
+        n_share = sum(
+            1 for r, was_p in snapshot
+            if r is not None and not was_p
+            and not r.finished and not r.cancelled
+        )
         for slot, (req, was_prefilling) in enumerate(snapshot):
             if req is None or was_prefilling:
                 # A still-prefilling slot is parked: its decode-row output is
@@ -3431,6 +3574,13 @@ class ContinuousEngine:
                     m.decode_token.observe(
                         (t_now - req.t_last_emit) / len(fresh), n=len(fresh)
                     )
+                prev_emit = (req.t_last_emit or req.t_prefill_done
+                             or req.t_admitted or req.t_submit)
+                if prev_emit and n_share:
+                    share = max(0.0, t_now - prev_emit) / n_share
+                    req.device_time_est_s += share
+                    if self.usage is not None:
+                        self.usage.note_device(req.tenant, share)
                 if req.request_span is not None:
                     # One decode span per harvested chunk, covering the
                     # interval a streaming client actually waited for it;
@@ -3476,6 +3626,7 @@ class ContinuousEngine:
                 self.metrics.completed.inc()
                 if req.t_submit:
                     self.metrics.e2e.observe(t_now - req.t_submit)
+                self._note_usage_terminal(req, "200")
                 self._close_spans(
                     req,
                     interference_total_s=round(req.interference_s, 6),
@@ -4211,6 +4362,7 @@ class ContinuousEngine:
                     self._completed.pop(req_id, None)
                     return True
                 req.cancelled = True
+                self._note_usage_terminal(req, "cancel")
                 self._close_spans(req, cancelled=True)
                 if req.stream is not None:
                     req.stream.put(None)
@@ -4219,6 +4371,7 @@ class ContinuousEngine:
             if req is not None and req.req_id == req_id:
                 self._slots[slot] = None
                 req.cancelled = True
+                self._note_usage_terminal(req, "cancel")
                 self._close_spans(req, cancelled=True)
                 if self.cache_mode == "paged":
                     self._free_slot_pages(slot)
@@ -4289,6 +4442,13 @@ class ThreadedEngine:
         """The engine's flight recorder (telemetry/flight.py) — the tick
         ring an incident bundle dumps."""
         return self._engine.flight
+
+    @property
+    def usage(self):
+        """The engine's per-tenant usage meter (telemetry/usage.UsageMeter,
+        ISSUE 15) — the /usage endpoint's source; None when metering is
+        unarmed (absent != zero usage)."""
+        return self._engine.usage
 
     @property
     def queue_full(self) -> bool:
@@ -4414,6 +4574,7 @@ class ThreadedEngine:
         deadline_s: float | None = None,
         slo_class: str | None = None,
         trace: Any = None,
+        tenant: str | None = None,
     ) -> list[int]:
         """Submit one request and block until it completes. Raises if the
         driver has stopped (shutdown or device error) — callers turn that
@@ -4434,6 +4595,7 @@ class ThreadedEngine:
                 deadline_s=deadline_s,
                 slo_class=slo_class,
                 trace=trace,
+                tenant=tenant,
             )
             self._cond.notify_all()
             req = self._wait_one_locked(rid)
@@ -4457,6 +4619,7 @@ class ThreadedEngine:
         deadline_s: float | None = None,
         slo_class: str | None = None,
         trace: Any = None,
+        tenant: str | None = None,
     ) -> tuple[list[int], dict]:
         """``generate_one`` + per-token logprob stats (same dict layout as
         engine.Generator.generate_tokens_with_logprobs: ``token_logprobs``,
@@ -4477,6 +4640,7 @@ class ThreadedEngine:
                 deadline_s=deadline_s,
                 slo_class=slo_class,
                 trace=trace,
+                tenant=tenant,
             )
             self._cond.notify_all()
             req = self._wait_one_locked(rid)
@@ -4505,6 +4669,7 @@ class ThreadedEngine:
         logprobs: int | None = None,
         slo_class: str | None = None,
         trace: Any = None,
+        tenant: str | None = None,
     ) -> list[Request]:
         """Submit ``n`` copies of one prompt (distinct derived seeds) and
         block until all complete; returns the finished Request objects in
@@ -4535,6 +4700,7 @@ class ThreadedEngine:
                         logprobs=logprobs,
                         slo_class=slo_class,
                         trace=trace,
+                        tenant=tenant,
                     ))
             except BaseException:
                 # A mid-loop failure (e.g. QueueFullError on copy k) must
@@ -4561,6 +4727,7 @@ class ThreadedEngine:
         deadline_s: float | None = None,
         slo_class: str | None = None,
         trace: Any = None,
+        tenant: str | None = None,
     ):
         """Submit one request and return an iterator of per-chunk token-id
         lists as they are decoded (SSE streaming). The submit happens
@@ -4587,6 +4754,7 @@ class ThreadedEngine:
                 deadline_s=deadline_s,
                 slo_class=slo_class,
                 trace=trace,
+                tenant=tenant,
             )
             self._cond.notify_all()
 
@@ -4630,6 +4798,7 @@ class ThreadedEngine:
         deadline_s: float | None = None,
         slo_class: str | None = None,
         trace: Any = None,
+        tenant: str | None = None,
     ):
         """``stream_one`` + per-chunk logprob stats: yields
         ``(token_ids, lp_dict)`` pairs where ``lp_dict`` carries the chunk's
@@ -4653,6 +4822,7 @@ class ThreadedEngine:
                 deadline_s=deadline_s,
                 slo_class=slo_class,
                 trace=trace,
+                tenant=tenant,
             )
             self._cond.notify_all()
 

@@ -706,7 +706,13 @@ class PodContinuousDriver:
     def generate_one(self, prompt_tokens, *, max_new_tokens=None,
                      temperature=None, top_p=None, seed=None,
                      adapter_id=None, grammar=None,
-                     deadline_s=None, slo_class=None, trace=None) -> list[int]:
+                     deadline_s=None, slo_class=None, trace=None,
+                     tenant=None) -> list[int]:
+        # ``tenant`` (ISSUE 15) is accepted-and-dropped: the tick
+        # broadcast carries no tenant lane, so pod usage rows attribute
+        # to "anonymous" — the same reduced-feature stance as deadlines
+        # and SLO classes (metering per tenant wants solo replicas
+        # behind the gateway).
         self._reject_deadline(deadline_s)
         self._reject_slo_class(slo_class)
         ticket = self._stage(prompt_tokens, max_new_tokens, temperature,
@@ -717,7 +723,7 @@ class PodContinuousDriver:
     def generate_many(self, prompt_tokens, n, *, max_new_tokens=None,
                       temperature=None, top_p=None, seed=None,
                       adapter_id=None, grammar=None, logprobs=None,
-                      slo_class=None, trace=None):
+                      slo_class=None, trace=None, tenant=None):
         """OpenAI ``n``/``best_of`` over the pod: stage ``n`` copies with
         derived seeds (same 7919-stride rule as ThreadedEngine.generate_many
         so pod and solo serving replay identically for a given seed), then
@@ -772,7 +778,8 @@ class PodContinuousDriver:
 
     def stream_one(self, prompt_tokens, *, max_new_tokens=None,
                    temperature=None, top_p=None, seed=None, adapter_id=None,
-                   grammar=None, deadline_s=None, slo_class=None, trace=None):
+                   grammar=None, deadline_s=None, slo_class=None, trace=None,
+                   tenant=None):
         import queue as _queue
 
         self._reject_deadline(deadline_s)

@@ -44,6 +44,7 @@ from ditl_tpu.infer.continuous import (
 from ditl_tpu.infer.engine import GenerateConfig, Generator
 from ditl_tpu.telemetry.serving import ServingMetrics
 from ditl_tpu.telemetry.slo import BurnRateMonitor, serving_slo
+from ditl_tpu.telemetry.usage import sanitize_label, tenant_label
 from ditl_tpu.telemetry.tracing import (
     NULL_TRACER,
     Tracer,
@@ -335,6 +336,15 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     # kv_handoff endpoints (paged continuous engines only) and the
     # kv_handoff flag on /health the gateway's orchestration keys on.
     kv_handoff_enabled: bool = False
+    # Per-tenant usage metering (ISSUE 15, telemetry/usage.py): ``usage``
+    # (UsageMeter) serves /usage and the ditl_usage_* families; the
+    # continuous engine feeds it on its own terminal paths, the LOCKSTEP
+    # paths feed it here (the engine never sees those requests).
+    # ``usage_ledger`` (UsageLedger) is the lockstep paths' ledger sink
+    # (the continuous engine writes its own rows). Both unarmed by
+    # default — /usage then 404s (absent != zero usage).
+    usage = None
+    usage_ledger = None
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
@@ -440,6 +450,56 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _tenant_label(self) -> str:
+        """This request's credential-safe tenant label (ISSUE 15). The
+        gateway's ``X-Tenant-Label`` relay header wins — it carries the
+        admission-layer identity (configured public name or sha digest;
+        the gateway NEVER forwards the raw bearer spelling of a tenant it
+        admitted). Direct clients fall back to their own Authorization
+        bearer, reduced through ``tenant_label`` (digest — the raw key
+        must never reach the ledger, /usage, or /metrics). TRUST MODEL:
+        the header is honored from whoever can reach this port — the same
+        private-network trust the replica's unauthenticated /metrics,
+        /stats, and /internal endpoints already assume. On a replica
+        exposed beyond the gateway, a client that learns another tenant's
+        label can mis-attribute its OWN traffic onto that bill (billing
+        pollution, not privilege: admission/quota enforcement stays at
+        the gateway) — serve replicas behind the gateway, as everything
+        since ISSUE 4 assumes (docs/design.md)."""
+        hdr = self.headers.get("X-Tenant-Label")
+        if hdr:
+            return sanitize_label(hdr)
+        auth = self.headers.get("Authorization", "")
+        if auth.lower().startswith("bearer "):
+            bearer = auth[7:].strip()
+            if bearer:
+                return tenant_label(bearer)
+        return "anonymous"
+
+    def _note_usage_lockstep(self, tenant: str, n_prompt: int, n_gen: int,
+                             t0: float, outcome: str = "200",
+                             slo_class: str | None = None) -> None:
+        """Terminal usage row for a request the LOCKSTEP path served (the
+        continuous engine ledgers its own). The device lock serializes
+        whole requests, so the request wall doubles as the device-time
+        estimate — exclusive occupancy, not a share."""
+        if self.usage is None and self.usage_ledger is None:
+            return
+        dt = round(time.time() - t0, 6)
+        row = {
+            "tenant": sanitize_label(tenant), "outcome": outcome,
+            "slo_class": slo_class or "interactive",
+            "prompt_tokens": int(n_prompt), "generated_tokens": int(n_gen),
+            "device_time_est_s": dt, "e2e_s": dt,
+        }
+        try:
+            if self.usage is not None:
+                self.usage.note_terminal(row)
+            if self.usage_ledger is not None:
+                self.usage_ledger.record(**row)
+        except Exception:  # noqa: BLE001 - metering must not crash serving
+            logger.exception("lockstep usage metering failed (row dropped)")
+
     def _gate_slo_class(self, slo_class, from_header) -> tuple:
         """This serving path cannot honor a scheduling class (lockstep,
         pod FIFO staging, adapter/logprobs fallbacks): drop a header-
@@ -525,6 +585,19 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     "no SLO monitor configured"}})
             else:
                 self._send_json(200, self.slo.report())
+        elif self.path in ("/usage", "/v1/usage"):
+            # Per-tenant usage rollups (ISSUE 15): the meter's live
+            # in-memory view — what the gateway's /usage fan-out
+            # aggregates fleet-wide. 404 when metering is unarmed so an
+            # aggregator can tell "no usage" from "not metering".
+            if self.usage is None:
+                self._send_json(404, {"error": {"message":
+                    "usage metering is not armed on this replica"}})
+            else:
+                self._send_json(200, {
+                    "requests": self.usage.total_requests,
+                    "tenants": self.usage.snapshot(),
+                })
         elif self.path in ("/incidents", "/v1/incidents"):
             # Incident bundles (ISSUE 10): list this replica's assembled
             # bundle manifests. Torn/tmp dirs are skipped by the reader,
@@ -872,7 +945,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     def _multi_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, n: int,
         best_of: int, adapter_ids=None, stops=None, grammar=None,
-        slo_class=None, slo_from_header=False, trace=None,
+        slo_class=None, slo_from_header=False, trace=None, tenant=None,
     ) -> None:
         """OpenAI ``n``/``best_of``: generate ``best_of`` candidates (the
         continuous engine batches them into shared decode ticks; the
@@ -900,6 +973,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 slo_class=slo_class,
                 logprobs=0 if rank else None,
                 trace=trace,
+                tenant=tenant,
             )
             cands = [(r.tokens, r.lp_token) for r in reqs]
         else:
@@ -976,6 +1050,14 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         if not use_cont:
             # Before the response write — see _complete's lockstep note.
             self._observe_lockstep(t0, total_out)
+            # Usage billing is DEVICE accounting, per candidate: the
+            # lockstep batch genuinely prefills all best_of prompt copies
+            # (no prefix cache on this path), matching the continuous
+            # engine's one-row-per-candidate rows. The API response's
+            # OpenAI `usage` field still reports the prompt once.
+            self._note_usage_lockstep(tenant or "anonymous",
+                                      n_prompt * best_of,
+                                      total_out, t0, slo_class=slo_class)
         self._send_json(200, {
             "id": f"cmpl-{uuid.uuid4().hex[:24]}",
             "object": "chat.completion" if chat else "text_completion",
@@ -1159,7 +1241,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     def _stream_complete(
         self, payload: dict, prompt: str, gen, *, chat: bool, adapter_ids=None,
         stops=None, lp_n=None, grammar=None, deadline_s=None, slo_class=None,
-        trace=None,
+        trace=None, tenant=None,
     ) -> None:
         """OpenAI streaming: real incremental chunks from the continuous
         engine; the lockstep engine generates fully, then emits one chunk.
@@ -1207,6 +1289,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     deadline_s=deadline_s,
                     slo_class=slo_class,
                     trace=trace,
+                    tenant=tenant,
                 )
             else:
                 stream_iter = self.threaded_engine.stream_one(
@@ -1220,6 +1303,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     deadline_s=deadline_s,
                     slo_class=slo_class,
                     trace=trace,
+                    tenant=tenant,
                 )
 
         def events():
@@ -1285,6 +1369,9 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 out = self._lockstep_generate(prompt_ids, gen, adapter_ids)
                 n_gen = len(out)
                 self._observe_lockstep(t_stream0, n_gen)
+                self._note_usage_lockstep(tenant or "anonymous",
+                                          len(prompt_ids), n_gen, t_stream0,
+                                          slo_class=slo_class)
                 text, hit = _apply_stop(tok.decode(out), tracker.stops)
                 if hit:
                     # Fold into the tracker so the finish computation reports
@@ -1323,6 +1410,9 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 prompt = payload.get("prompt") or ""
                 if isinstance(prompt, list):
                     prompt = prompt[0] if prompt else ""
+            # Usage attribution (ISSUE 15): the credential-safe tenant
+            # label every engine/ledger path below bills to.
+            tenant = self._tenant_label()
             # Fresh seed per request unless the client pins one — otherwise
             # every temperature>0 request would replay jax.random.key(0).
             seed = payload.get("seed")
@@ -1481,6 +1571,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     best_of=best_of, adapter_ids=adapter_ids, stops=stops,
                     grammar=grammar, slo_class=slo_class,
                     slo_from_header=slo_from_header, trace=span,
+                    tenant=tenant,
                 )
                 return
             # OpenAI semantics: completions' `logprobs: 0` is a real request
@@ -1516,7 +1607,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                         payload, prompt, gen, chat=chat,
                         adapter_ids=adapter_ids, stops=stops, lp_n=lp_n,
                         grammar=grammar, deadline_s=deadline_s,
-                        slo_class=slo_class, trace=span,
+                        slo_class=slo_class, trace=span, tenant=tenant,
                     )
                 except QueueFullError as e:
                     # The stream's submit is eager (before SSE headers), so
@@ -1571,6 +1662,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                         deadline_s=deadline_s,
                         slo_class=slo_class,
                         trace=span,
+                        tenant=tenant,
                     )
                 elif grammar is not None:
                     # Guided requests never fall back to the lock-step
@@ -1691,6 +1783,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     deadline_s=deadline_s,
                     slo_class=slo_class,
                     trace=span,
+                    tenant=tenant,
                 )
                 n_gen = len(out)
                 text, hit_stop = _apply_stop(tok.decode(out), stops)
@@ -1733,6 +1826,8 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 # moved (the response write itself is not service time —
                 # and recording after it raced exactly that scrape).
                 self._observe_lockstep(t0, n_out)
+                self._note_usage_lockstep(tenant, n_prompt, n_out, t0,
+                                          slo_class=slo_class)
             self._send_json(
                 200,
                 {
@@ -1811,6 +1906,8 @@ def make_server(
     serving_metrics: ServingMetrics | None = None,
     cold_start_s: float | None = None,
     kv_handoff: bool = False,
+    usage=None,
+    usage_ledger=None,
 ) -> DrainableHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
@@ -1846,6 +1943,15 @@ def make_server(
     # same per-process journal and nest under one trace.
     if tracer is None:
         tracer = getattr(threaded_engine, "tracer", None) or NULL_TRACER
+    # Usage metering (ISSUE 15): default to the engine's own meter (the
+    # tracer rule — constructing the engine with one arms the replica
+    # end-to-end); the families render on whatever registry this server's
+    # /metrics serves. bind is idempotent, so an engine-bound meter keeps
+    # its binding.
+    if usage is None:
+        usage = getattr(threaded_engine, "usage", None)
+    if usage is not None:
+        usage.bind(serving_metrics.registry)
     if slo is None:
         # SLO burn-rate monitor over this server's bundle; ``telemetry``
         # (config.TelemetryConfig) overrides the objectives, defaults
@@ -1874,6 +1980,8 @@ def make_server(
             "role": role,
             "incidents": incidents,
             "kv_handoff_enabled": kv_handoff,
+            "usage": usage,
+            "usage_ledger": usage_ledger,
         },
     )
     server = DrainableHTTPServer((host, port), handler)
@@ -2090,13 +2198,37 @@ def serve(argv: list[str] | None = None) -> int:
         "python -m ditl_tpu.telemetry.incident --dir DIR; detector "
         "thresholds ride --telemetry-override (anomaly_*/incident_*)",
     )
+    parser.add_argument(
+        "--usage-dir", default="",
+        help="arm the crash-consistent per-tenant usage ledger (ISSUE 15): "
+        "one JSONL row per terminal request (outcome 200/429/504/cancel, "
+        "prompt/generated tokens, cached-token tiers, queue wait, "
+        "device-time estimate, interference, preemptions) appended to "
+        "{dir}/usage-server-<pid>.jsonl; aggregate with "
+        "python -m ditl_tpu.telemetry.usage --dir DIR",
+    )
+    parser.add_argument(
+        "--no-usage-metering", action="store_true",
+        help="disable the in-memory per-tenant usage meter (/usage, "
+        "ditl_usage_* families, noisy-neighbor conviction windows) — "
+        "the metering-off A/B leg; on by default",
+    )
+    parser.add_argument(
+        "--usage-override", action="append", default=[],
+        metavar="FIELD=VALUE",
+        help="UsageConfig override (repeatable), e.g. "
+        "max_tenant_families=64 or conviction_share=0.5",
+    )
     args = parser.parse_args(argv)
 
     from ditl_tpu.config import Config, parse_overrides
 
-    telemetry_cfg = parse_overrides(
+    _cfg = parse_overrides(
         Config(), [f"telemetry.{o}" for o in args.telemetry_override]
-    ).telemetry
+        + [f"usage.{o}" for o in args.usage_override]
+    )
+    telemetry_cfg = _cfg.telemetry
+    usage_cfg = _cfg.usage
     tracer = None
     if args.trace_dir and jax.process_index() == 0:
         # Process-0-gated like serving itself: pod WORKER replicas replay
@@ -2113,6 +2245,28 @@ def serve(argv: list[str] | None = None) -> int:
             source=f"server-{tag}",
             max_bytes=telemetry_cfg.journal_max_bytes(),
         ))
+
+    # Per-tenant usage metering (ISSUE 15): the meter is on by default on
+    # process 0 (bounded per-tenant state, terminal-path-only updates);
+    # --usage-dir additionally arms the crash-consistent ledger. Both are
+    # handed to the engine (its terminal paths write the rows) and to
+    # make_server (the lockstep paths + /usage).
+    usage_meter = usage_ledger = None
+    if not args.no_usage_metering and jax.process_index() == 0:
+        from ditl_tpu.telemetry.usage import UsageMeter
+
+        usage_meter = UsageMeter(
+            max_tenant_families=usage_cfg.max_tenant_families)
+    if args.usage_dir and jax.process_index() == 0:
+        import os
+
+        from ditl_tpu.telemetry.usage import UsageLedger, usage_ledger_path
+
+        usage_ledger = UsageLedger(
+            usage_ledger_path(args.usage_dir, f"server-{os.getpid()}"),
+            source=f"server-{os.getpid()}",
+            max_bytes=telemetry_cfg.journal_max_bytes(),
+        )
 
     # Flight recorder + anomaly plane (ISSUE 10): the engine's tick ring is
     # always on; --incident-dir additionally arms the serving detectors +
@@ -2150,6 +2304,13 @@ def serve(argv: list[str] | None = None) -> int:
             ServingDetector(**telemetry_cfg.serving_detector_kwargs()),
             slo=slo,
             check_every=telemetry_cfg.anomaly_check_every_ticks,
+            # Noisy-neighbor forensics (ISSUE 15): when a latency storm
+            # fires, the monitor convicts the tenant dominating the
+            # meter's windowed prefill/device share and names it (plus
+            # its usage snapshot) in the incident bundle.
+            usage=usage_meter,
+            conviction_share=usage_cfg.conviction_share,
+            conviction_min_tokens=usage_cfg.conviction_min_tokens,
         )
     else:
         flight = None
@@ -2372,6 +2533,8 @@ def serve(argv: list[str] | None = None) -> int:
             metrics=serving_metrics,
             flight=flight,
             anomaly=anomaly_monitor,
+            usage=usage_meter,
+            usage_ledger=usage_ledger,
         )
 
     if args.pod and jax.process_index() != 0:
@@ -2436,6 +2599,8 @@ def serve(argv: list[str] | None = None) -> int:
         slo=slo, incidents=incidents, serving_metrics=serving_metrics,
         cold_start_s=time.monotonic() - t_serve_start,
         kv_handoff=args.kv_handoff and threaded is not None and pod is None,
+        usage=usage_meter,
+        usage_ledger=usage_ledger,
     )
 
     # SIGTERM = graceful drain (the gateway/orchestrator rolling-restart
@@ -2466,6 +2631,8 @@ def serve(argv: list[str] | None = None) -> int:
         server.shutdown()
         if threaded is not None:
             threaded.close()
+        if usage_ledger is not None:
+            usage_ledger.close()
     return 0
 
 

@@ -8,7 +8,10 @@ analysis, versioned sweep records; perf_compare.py: the regression gate),
 HBM accounting (memwatch.py), and the flight-recorder/anomaly/incident
 plane (flight.py: always-on black-box rings; anomaly.py: signal-driven
 detectors; incident.py: fingerprint-deduped self-contained bundles;
-catalog.py: the generated metrics catalog). Host-only by design —
+catalog.py: the generated metrics catalog), and per-tenant usage
+metering/cost attribution (usage.py: the crash-consistent usage ledger,
+bounded per-tenant meters, and the noisy-neighbor conviction the
+serving anomaly monitor applies — ISSUE 15). Host-only by design —
 importing this package never touches jax (memwatch imports it lazily
 inside functions), and no instrument accepts a device value."""
 
@@ -67,6 +70,14 @@ from ditl_tpu.telemetry.registry import (
     MetricsRegistry,
 )
 from ditl_tpu.telemetry.serving import ServingMetrics
+from ditl_tpu.telemetry.usage import (
+    UsageLedger,
+    UsageMeter,
+    convict_noisy_neighbor,
+    load_usage,
+    rollup,
+    usage_ledger_path,
+)
 from ditl_tpu.telemetry.slo import (
     BurnRateMonitor,
     Objective,
@@ -117,14 +128,18 @@ __all__ = [
     "TOKEN_LATENCY_BUCKETS_S",
     "Tracer",
     "TrainingDetector",
+    "UsageLedger",
+    "UsageMeter",
     "compiled_cost",
     "controller_journal_path",
+    "convict_noisy_neighbor",
     "format_traceparent",
     "gateway_slo",
     "incidents_total",
     "list_bundles",
     "live_buffer_topk",
     "load_sweep_record",
+    "load_usage",
     "lost_work_from_journal",
     "merge_journals",
     "new_request_id",
@@ -133,8 +148,10 @@ __all__ = [
     "read_bundle",
     "read_journal",
     "record_sweep_cell",
+    "rollup",
     "roofline",
     "serving_slo",
+    "usage_ledger_path",
     "worker_journal_path",
     "write_pod_timeline",
 ]

@@ -48,6 +48,7 @@ __all__ = [
     "AnomalyPlane",
     "GatewayAnomalyMonitor",
     "GatewayDetector",
+    "NOISY_NEIGHBOR_KINDS",
     "NonFiniteMetricError",
     "ServingAnomalyMonitor",
     "ServingDetector",
@@ -468,21 +469,43 @@ class GatewayAnomalyMonitor:
             self._broken = True
 
 
+# Anomaly kinds a noisy-neighbor conviction attaches to (ISSUE 15): the
+# latency storms whose usual cause IS one tenant's prefill burden
+# monopolizing the scheduler (interference is what TPOT/TTFT jumps
+# measure). Storm counters (429s, deadline expiries) are fleet-level
+# symptoms with many causes and are deliberately NOT convicted on.
+NOISY_NEIGHBOR_KINDS = ("serving.tpot_jump", "serving.ttft_jump")
+
+
 class ServingAnomalyMonitor:
     """What the continuous engine holds: observe cadence + the serving
     detector + (optionally) the SLO monitor, all feeding one plane. The
     engine calls :meth:`observe_serving` every ``check_every`` ticks;
     with an ``slo`` attached each observe also samples the burn-rate
     windows — so a headless fleet with no Prometheus scraper still
-    evaluates (and journals) burn alerts (ISSUE 10 satellite)."""
+    evaluates (and journals) burn alerts (ISSUE 10 satellite).
+
+    With a ``usage`` meter attached (telemetry/usage.UsageMeter,
+    ISSUE 15), every observe also advances the meter's per-tenant
+    prefill-token/device-time window, and when a TPOT/TTFT storm fires
+    the dominant tenant is CONVICTED — the anomaly's detail gains a
+    ``noisy_neighbor`` block (tenant, window shares, lifetime usage
+    snapshot) that rides verbatim into the incident-bundle manifest,
+    turning "the fleet is slow" into "tenant t_3fa21b's batch job is"
+    (docs/troubleshooting.md §33)."""
 
     def __init__(self, plane: AnomalyPlane,
                  detector: ServingDetector | None = None,
-                 slo=None, check_every: int = 32):
+                 slo=None, check_every: int = 32,
+                 usage=None, conviction_share: float = 0.6,
+                 conviction_min_tokens: int = 256):
         self.plane = plane
         self.detector = detector if detector is not None else ServingDetector()
         self.slo = slo
         self.check_every = max(1, check_every)
+        self.usage = usage
+        self.conviction_share = conviction_share
+        self.conviction_min_tokens = conviction_min_tokens
         self._broken = False
 
     def observe_serving(self, stats: dict, metrics) -> None:
@@ -494,7 +517,30 @@ class ServingAnomalyMonitor:
                 # and fires the monitor's alert-transition hook (slo.py),
                 # which routes back into this plane.
                 self.slo.report()
+            window = (
+                self.usage.advance_window() if self.usage is not None
+                else None
+            )
             for anomaly in self.detector.observe(stats, metrics):
+                if window is not None and anomaly.kind in \
+                        NOISY_NEIGHBOR_KINDS:
+                    from ditl_tpu.telemetry.usage import (
+                        convict_noisy_neighbor,
+                    )
+
+                    verdict = convict_noisy_neighbor(
+                        window, self.conviction_share,
+                        self.conviction_min_tokens,
+                        snapshot=self.usage.snapshot(),
+                    )
+                    if verdict is not None:
+                        # detail is a plain dict on the (frozen) Anomaly;
+                        # enriching it here, BEFORE trigger, is what puts
+                        # the conviction into the journal event and the
+                        # bundle manifest. The fingerprint is unchanged —
+                        # the same storm stays one incident whether or
+                        # not a culprit was nameable.
+                        anomaly.detail["noisy_neighbor"] = verdict
                 self.plane.trigger(anomaly)
         except Exception:  # noqa: BLE001 - never kill the engine driver
             logger.exception("serving anomaly monitor failed; disarming")

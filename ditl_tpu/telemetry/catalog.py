@@ -70,6 +70,10 @@ _NORMALIZE_RULES: tuple[tuple[re.Pattern, str], ...] = (
     (re.compile(r"^(ditl_memory_)(.+?)_device\d+_(.+)$"),
      r"\1<replica>_device<i>_\3"),
     (re.compile(r"^(ditl_incidents_trigger_).+(_total)$"), r"\1<kind>\2"),
+    (re.compile(r"^(ditl_usage_tenant_)(.+?)_(prompt_tokens_total|"
+                r"generated_tokens_total|cached_tokens_saved_total|"
+                r"device_seconds_total)$"),
+     r"\1<tenant>_\3"),
     (re.compile(r"^(ditl_slo_\w+_burn_rate_w)\d+$"), r"\1<window>"),
 )
 
@@ -271,6 +275,17 @@ _ROWS: tuple = (
     ("ditl_slo_tpot_burn_rate_w<window>", "gauge", "window seconds", "tpot burn rate over 300s (error rate / error budget)"),
     ("ditl_slo_ttft_alerting", "gauge", "", "1 when every window burns ttft's budget faster than 1.0x"),
     ("ditl_slo_ttft_burn_rate_w<window>", "gauge", "window seconds", "ttft burn rate over 300s (error rate / error budget)"),
+    ("ditl_usage_requests_200_total", "counter", "", "terminal requests metered with outcome 200", True),
+    ("ditl_usage_requests_429_total", "counter", "", "terminal requests metered with outcome 429", True),
+    ("ditl_usage_requests_503_total", "counter", "", "terminal requests metered with outcome 503", True),
+    ("ditl_usage_requests_504_total", "counter", "", "terminal requests metered with outcome 504", True),
+    ("ditl_usage_requests_cancel_total", "counter", "", "terminal requests metered with outcome cancel", True),
+    ("ditl_usage_requests_other_total", "counter", "", "terminal requests metered with an out-of-vocabulary outcome", True),
+    ("ditl_usage_requests_total", "counter", "", "terminal requests metered by the per-tenant usage meter (ISSUE 15)", True),
+    ("ditl_usage_tenant_<tenant>_cached_tokens_saved_total", "counter", "tenant label (overflow folds into `other`)", "prompt tokens served from cached KV (all tiers) attributed to the tenant", True),
+    ("ditl_usage_tenant_<tenant>_device_seconds_total", "counter", "tenant label (overflow folds into `other`)", "estimated device-seconds (prefill wall + decode-tick share) attributed to the tenant", True),
+    ("ditl_usage_tenant_<tenant>_generated_tokens_total", "counter", "tenant label (overflow folds into `other`)", "generated tokens attributed to the tenant", True),
+    ("ditl_usage_tenant_<tenant>_prompt_tokens_total", "counter", "tenant label (overflow folds into `other`)", "prompt tokens attributed to the tenant", True),
 )
 
 CATALOG: tuple[CatalogEntry, ...] = tuple(

@@ -91,6 +91,14 @@ COMPARE_KEYS = {
     "gateway_rps": +1,
     "gateway_added_p50_s": -1,
     "gateway_added_p95_s": -1,
+    # Usage-metering keys (ISSUE 15, bench --serve-gateway-overhead
+    # --serve-usage-metering rows' hoisted `usage_metering` block): the
+    # metered leg's requests/sec regresses when it falls, and the
+    # fractional rps cost of arming the ledger regresses when it rises —
+    # per-tenant accounting must stay cheap enough that nobody is
+    # tempted to turn billing off under load.
+    "gateway_rps_metered": +1,
+    "metering_overhead_ratio": -1,
 }
 
 
@@ -99,13 +107,14 @@ def _flat(rec: dict) -> dict:
     nested ``roofline`` (train rows), ``serving`` (serve rows),
     ``autoscale`` (trace-replay rows), ``kv_handoff`` (handoff-armed
     gateway rows, ISSUE 13), and ``gateway_overhead`` (stub-fleet
-    overhead rows, ISSUE 14) blocks hoisted — without the hoist the gate
+    overhead rows, ISSUE 14), and ``usage_metering`` (metering-armed
+    overhead rows, ISSUE 15) blocks hoisted — without the hoist the gate
     would silently never compare cost-counted MFU, the serving scheduler
     metrics, the replica-seconds the autoscaler A/B is graded on, the
     handoff fallback ratio, or the gateway's own per-request tax."""
     out = rec
     for block in ("roofline", "serving", "autoscale", "kv_handoff",
-                  "gateway_overhead"):
+                  "gateway_overhead", "usage_metering"):
         nested = rec.get(block)
         if isinstance(nested, dict):
             out = {**nested, **out}

@@ -1,0 +1,507 @@
+"""Per-tenant usage metering & cost attribution (ISSUE 15 tentpole).
+
+The gateway has known WHO a request belongs to since ISSUE 4
+(``gateway/admission.tenant_label`` — a stable sha digest, never the raw
+bearer), and the engine has computed per-request cost since ISSUES 6-13
+(prompt vs generated tokens, reused-vs-prefilled splits per cache tier,
+queue wait, interference absorbed, preemptions) — but the two never met:
+"millions of users" meant millions of indistinguishable requests. This
+module is the meeting point, three pieces:
+
+- :class:`UsageLedger` — the crash-consistent on-disk artifact: ONE JSONL
+  row per terminal request (outcome 200/429/504/cancel), written once at
+  end exactly like spans, riding ``telemetry/journal.py``'s line-buffered
+  append + segment rotation. A SIGKILL loses at most the row mid-write;
+  the aggregator skips the torn tail (the ``load_trace`` rule).
+- :class:`UsageMeter` — the in-memory half: bounded per-tenant rollups
+  (the ``/usage`` endpoints' payload), bounded per-tenant metric families
+  (``ditl_usage_tenant_<t>_*`` — tokens in/out, cached-tokens-saved,
+  device-seconds; tenants beyond ``max_tenant_families`` fold into
+  ``other``, the GatewayMetrics rule), and the WINDOWED per-tenant
+  prefill-token / device-time accounting the noisy-neighbor conviction
+  reads (telemetry/anomaly.py) — fed live from the scheduler (a mid-storm
+  batch job must be convictable before it terminates).
+- the aggregator — ``load_usage``/``rollup`` + the CLI
+  (``python -m ditl_tpu.telemetry.usage --dir D``): ledger files -> one
+  deterministic per-tenant rollup (byte-identical across runs over the
+  same directory, pinned by test).
+
+Tenant identity discipline: every identifier entering this module is
+expected to ALREADY be a credential-safe label (the admission digest or a
+configured public name); :func:`sanitize_label` is applied again on every
+path anyway — defense in depth, and the static half lives in the
+``tenant-label-discipline`` analysis rule (ISSUE 15 satellite). jax-free
+and zero-device-sync like everything in telemetry/: every number is a host
+float the scheduler already held.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import re
+import threading
+
+from ditl_tpu.telemetry.journal import EventJournal, read_journal
+
+__all__ = [
+    "LEDGER_EVENT",
+    "OUTCOMES",
+    "USAGE_SCHEMA",
+    "UsageLedger",
+    "UsageMeter",
+    "convict_noisy_neighbor",
+    "load_usage",
+    "main",
+    "merge_rollups",
+    "read_ledger",
+    "rollup",
+    "sanitize_label",
+    "tenant_label",
+    "usage_ledger_path",
+]
+
+PREFIX = "ditl_usage"
+USAGE_SCHEMA = 1
+# The journal event name every ledger row carries; readers filter on it so
+# a usage file that shares a directory with span journals stays parseable.
+LEDGER_EVENT = "usage.request"
+# Terminal outcomes a row may carry. Fixed vocabulary on purpose: outcome
+# counters become metric families, and families must be bounded. Engine
+# rows use 200/429/504/cancel; gateway-edge rows additionally use 503
+# (no live replica) — anything else folds into "other".
+OUTCOMES = ("200", "429", "503", "504", "cancel")
+
+# Numeric row fields the rollup sums per tenant (absent fields count 0, so
+# gateway-side rows — which carry only estimates — aggregate next to
+# engine rows without special casing).
+_SUM_FIELDS = (
+    "prompt_tokens",
+    "generated_tokens",
+    "cache_hit_tokens",
+    "cache_hit_host_tokens",
+    "cache_hit_handoff_tokens",
+    "prefilled_tokens",
+    "queue_wait_s",
+    "device_time_est_s",
+    "interference_absorbed_s",
+    "preemptions",
+    "resume_prefill_tokens",
+)
+
+
+def sanitize_label(s: str) -> str:
+    """Metric-name-safe tenant label — a deliberate copy of
+    ``gateway/admission.sanitize_label`` (telemetry/ must not import the
+    gateway package: its ``__init__`` pulls the whole gateway in, and the
+    dependency already points the other way). Pinned equal by test, the
+    SLO_CLASS_NAMES mirror rule."""
+    out = re.sub(r"[^A-Za-z0-9_]", "_", s or "")[:48]
+    return out or "anonymous"
+
+
+def tenant_label(tenant: str, known=()) -> str:
+    """Credential-safe tenant identifier — the same deliberate mirror of
+    ``gateway/admission.tenant_label`` as :func:`sanitize_label` above
+    (pinned equal by test): configured public names in ``known`` and the
+    ``anonymous`` tenant stay readable, every other value (usually a raw
+    bearer) reduces to the stable ``t_<sha256[:12]>`` digest. Lets
+    infer/server.py digest a direct client's bearer without growing an
+    infer -> gateway import edge."""
+    if tenant == "anonymous" or tenant in known:
+        return sanitize_label(tenant)
+    digest = hashlib.sha256(
+        tenant.encode("utf-8", "surrogatepass")
+    ).hexdigest()[:12]
+    return f"t_{digest}"
+
+
+def usage_ledger_path(directory: str, source: str) -> str:
+    """``usage-<source>.jsonl`` — deliberately OUTSIDE the ``events-*``
+    glob ``merge_journals`` consumes, so billing rows never interleave
+    into pod timelines or incident journal tails; rotation segments
+    (``usage-x.rNNNN.jsonl``) still match :func:`load_usage`'s glob."""
+    return os.path.join(directory, f"usage-{sanitize_label(source)}.jsonl")
+
+
+class UsageLedger:
+    """Crash-consistent per-request usage ledger for ONE process: an
+    :class:`EventJournal` under the hood (lock-serialized line-buffered
+    appends, max-bytes segment rotation), one :data:`LEDGER_EVENT` row per
+    terminal request."""
+
+    def __init__(self, path: str, source: str = "",
+                 max_bytes: int | None = None):
+        self.journal = EventJournal(path, source=source or "usage",
+                                    max_bytes=max_bytes)
+        self.rows = 0
+
+    @property
+    def path(self) -> str:
+        return self.journal.path
+
+    def record(self, **row) -> None:
+        row.setdefault("schema", USAGE_SCHEMA)
+        self.journal.event(LEDGER_EVENT, **row)
+        self.rows += 1
+
+    def close(self) -> None:
+        self.journal.close()
+
+
+class UsageMeter:
+    """In-memory per-tenant accounting for one engine (or gateway).
+
+    Three consumers, one object:
+
+    - ``snapshot()`` — the ``/usage`` endpoint's per-tenant rollups.
+    - the registry families — ``ditl_usage_tenant_<t>_{prompt_tokens,
+      generated_tokens,cached_tokens_saved,device_seconds}`` plus the
+      aggregate ``ditl_usage_requests[_<outcome>]`` counters, created
+      lazily against the registry :meth:`bind` attached (the engine binds
+      its own ServingMetrics registry so /metrics renders them).
+    - ``advance_window()`` — per-tenant prefill-token / device-second
+      DELTAS since the last call, the detector-cadence input
+      :func:`convict_noisy_neighbor` judges.
+
+    Bounded by construction: tenants beyond ``max_tenant_families`` fold
+    into the ``other`` label everywhere (families, rollups, windows) — a
+    client cycling random bearer tokens grows nothing without bound.
+    Thread-safe: terminal notes arrive from the engine driver AND from
+    HTTP handler threads (submit-time 429s)."""
+
+    def __init__(self, registry=None, max_tenant_families: int = 32):
+        self.registry = registry
+        self.max_tenant_families = max(1, int(max_tenant_families))
+        self._lock = threading.Lock()
+        self._labels: set[str] = set()  # guarded-by: _lock
+        self._rollups: dict[str, dict] = {}  # guarded-by: _lock
+        self._window: dict[str, list] = {}  # guarded-by: _lock
+        # Lifetime live accounting [prefill_tokens, device_s] fed at
+        # DISPATCH time (not terminal) — a tenant whose storm is still in
+        # flight must already have a snapshot entry when a conviction
+        # needs its bill (meter-only: offline ledger rollups carry the
+        # terminal fields instead).
+        self._live: dict[str, list] = {}  # guarded-by: _lock
+        self.total_requests = 0
+
+    def bind(self, registry) -> None:
+        """Attach the registry the per-tenant families render into
+        (idempotent; the engine calls this at construction so the meter
+        shares the bundle /metrics already renders)."""
+        if self.registry is None:
+            self.registry = registry
+
+    # -- label bounding ----------------------------------------------------
+
+    def _label_locked(self, tenant) -> str:
+        """Sanitized-and-bounded label (caller holds ``_lock``)."""
+        label = sanitize_label(str(tenant or "anonymous"))
+        if label in self._labels:
+            return label
+        if len(self._labels) >= self.max_tenant_families:
+            return "other"
+        self._labels.add(label)
+        return label
+
+    def _tenant_counter(self, label: str, kind: str, help_: str):
+        return self.registry.counter(
+            f"{PREFIX}_tenant_{label}_{kind}",
+            f"{help_} attributed to tenant {label}")
+
+    # -- live feeds (engine driver thread) ---------------------------------
+
+    def note_prefill(self, tenant, tokens: int) -> None:
+        """One prefill dispatch's token count — fed from the scheduler at
+        dispatch time (NOT at terminal) so a mid-flight batch storm is
+        visible in the conviction window while it is happening."""
+        if tokens <= 0:
+            return
+        with self._lock:
+            label = self._label_locked(tenant)
+            w = self._window.setdefault(label, [0, 0.0])
+            w[0] += int(tokens)
+            self._live.setdefault(label, [0, 0.0])[0] += int(tokens)
+
+    def note_device(self, tenant, seconds: float) -> None:
+        """One request's share of a tick's device-time estimate (host wall
+        attribution, infer/continuous.py) — same live-window rationale as
+        :meth:`note_prefill`."""
+        if seconds <= 0:
+            return
+        with self._lock:
+            label = self._label_locked(tenant)
+            w = self._window.setdefault(label, [0, 0.0])
+            w[1] += float(seconds)
+            self._live.setdefault(label, [0, 0.0])[1] += float(seconds)
+
+    # -- terminal rows -----------------------------------------------------
+
+    def note_terminal(self, row: dict) -> None:
+        """Fold one terminal ledger row into the rollups + families. The
+        row is the same dict the ledger records — one spelling of the
+        accounting, two sinks."""
+        outcome = str(row.get("outcome", "200"))
+        if outcome not in OUTCOMES:
+            outcome = "other"
+        with self._lock:
+            label = self._label_locked(row.get("tenant"))
+            r = self._rollups.setdefault(label, {
+                "requests": 0,
+                "by_outcome": {},
+                **{k: 0 for k in _SUM_FIELDS},
+            })
+            r["requests"] += 1
+            r["by_outcome"][outcome] = r["by_outcome"].get(outcome, 0) + 1
+            for k in _SUM_FIELDS:
+                v = row.get(k)
+                if isinstance(v, (int, float)) and v == v:
+                    r[k] = round(r[k] + v, 6) if isinstance(v, float) \
+                        else r[k] + v
+            self.total_requests += 1
+        if self.registry is not None:
+            self.registry.counter(
+                f"{PREFIX}_requests", "terminal requests metered").inc()
+            self.registry.counter(
+                f"{PREFIX}_requests_{sanitize_label(outcome)}",
+                f"terminal requests metered with outcome {outcome}").inc()
+            self._tenant_counter(
+                label, "prompt_tokens", "prompt tokens").inc(
+                float(row.get("prompt_tokens") or 0))
+            self._tenant_counter(
+                label, "generated_tokens", "generated tokens").inc(
+                float(row.get("generated_tokens") or 0))
+            self._tenant_counter(
+                label, "cached_tokens_saved",
+                "prompt tokens served from cached KV (all tiers)").inc(
+                float(row.get("cache_hit_tokens") or 0))
+            self._tenant_counter(
+                label, "device_seconds",
+                "estimated device-seconds (prefill wall + decode-tick "
+                "share)").inc(
+                max(0.0, float(row.get("device_time_est_s") or 0.0)))
+
+    # -- read side ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Deterministic per-tenant rollups (sorted keys, rounded
+        floats) — the ``/usage`` endpoint body. Tenants with ONLY
+        in-flight work so far still appear (terminal fields zero), with
+        ``live_prefill_tokens``/``live_device_s`` carrying the
+        dispatch-time accounting — the convictable-before-terminal
+        contract."""
+        with self._lock:
+            out: dict[str, dict] = {}
+            for label in sorted(set(self._rollups) | set(self._live)):
+                r = self._rollups.get(label) or {
+                    "requests": 0, "by_outcome": {},
+                    **{k: 0 for k in _SUM_FIELDS},
+                }
+                entry = {
+                    **{k: (round(v, 6) if isinstance(v, float) else v)
+                       for k, v in r.items() if k != "by_outcome"},
+                    "by_outcome": dict(sorted(r["by_outcome"].items())),
+                }
+                live = self._live.get(label)
+                if live is not None:
+                    entry["live_prefill_tokens"] = int(live[0])
+                    entry["live_device_s"] = round(live[1], 6)
+                out[label] = entry
+            return out
+
+    def advance_window(self) -> dict:
+        """Per-tenant prefill-token / device-second deltas since the last
+        call, then reset — the detector-cadence counterpart of
+        ``ServingDetector``'s counter-delta windows."""
+        with self._lock:
+            window, self._window = self._window, {}
+        tenants = {
+            label: {"prefill_tokens": int(p), "device_s": round(d, 6)}
+            for label, (p, d) in sorted(window.items())
+        }
+        return {
+            "tenants": tenants,
+            "prefill_tokens_total": sum(
+                t["prefill_tokens"] for t in tenants.values()),
+            "device_s_total": round(sum(
+                t["device_s"] for t in tenants.values()), 6),
+        }
+
+
+def convict_noisy_neighbor(window: dict, share_threshold: float,
+                           min_tokens: int,
+                           snapshot: dict | None = None) -> dict | None:
+    """Judge one :meth:`UsageMeter.advance_window` result: the tenant with
+    the dominant prefill-token share is convicted when its share clears
+    ``share_threshold`` AND the window moved at least ``min_tokens``
+    prompt tokens (thin windows convict nobody — a single small prefill is
+    not a storm). The verdict carries both the prefill-token and the
+    device-time share plus the tenant's lifetime usage ``snapshot`` row, so
+    the incident manifest names the culprit WITH its bill attached."""
+    tenants = window.get("tenants") or {}
+    total_p = window.get("prefill_tokens_total") or 0
+    if not tenants or total_p < max(1, min_tokens):
+        return None
+    label, top = max(tenants.items(),
+                     key=lambda kv: kv[1]["prefill_tokens"])
+    share = top["prefill_tokens"] / total_p
+    if share < share_threshold:
+        return None
+    total_d = window.get("device_s_total") or 0.0
+    verdict = {
+        "tenant": label,
+        "window_prefill_tokens": top["prefill_tokens"],
+        "window_prefill_share": round(share, 4),
+        "window_device_s": top["device_s"],
+        "window_device_share": (
+            round(top["device_s"] / total_d, 4) if total_d > 0 else None
+        ),
+        "window_total_prefill_tokens": total_p,
+    }
+    if snapshot is not None:
+        verdict["usage"] = snapshot.get(label, {})
+    return verdict
+
+
+# ---------------------------------------------------------------------------
+# Aggregator (ledger files -> rollups) + CLI
+# ---------------------------------------------------------------------------
+
+
+def read_ledger(path: str) -> list[dict]:
+    """One ledger file's usage rows. Torn/corrupt lines — the tail a
+    SIGKILL mid-write leaves — are skipped, never fatal (the journal
+    reader's rule); non-usage events in a shared file are filtered out."""
+    return [rec for rec in read_journal(path)
+            if rec.get("event") == LEDGER_EVENT]
+
+
+def load_usage(directory: str) -> list[dict]:
+    """Every ``usage-*.jsonl`` row under ``directory``, RECURSIVELY
+    (rotated segments match the same glob): the gateway launcher writes
+    its edge ledger at the top of ``usage.ledger_dir`` and gives each
+    replica its own subdirectory, and one ``--dir`` over the root must
+    see the whole fleet. Deterministic order (path, then file order) so
+    two aggregator runs over the same directory produce byte-identical
+    rollups. Note rows keep their journal ``source`` — a request served
+    through the gateway appears TWICE (one engine row with the real
+    token/device accounting, one gateway edge row with estimates); see
+    the CLI's ``--source`` filter and docs/troubleshooting.md §33."""
+    rows: list[dict] = []
+    pattern = os.path.join(directory, "**", "usage-*.jsonl")
+    for path in sorted(glob.glob(pattern, recursive=True)):
+        rows.extend(read_ledger(path))
+    return rows
+
+
+def rollup(rows: list[dict]) -> dict:
+    """Per-tenant aggregation of ledger rows — the same shape
+    :meth:`UsageMeter.snapshot` serves live, rebuilt from disk. Purely a
+    fold over the input order-insensitively (sums and counts), so the
+    result depends only on the row SET: byte-identical across runs."""
+    meter = UsageMeter(registry=None, max_tenant_families=2 ** 30)
+    for row in rows:
+        meter.note_terminal(row)
+    return meter.snapshot()
+
+
+def merge_rollups(parts: list[dict]) -> dict:
+    """Sum a list of per-tenant rollups (the gateway's /usage fan-out:
+    one part per replica) into one fleet rollup. Numeric leaves add;
+    ``by_outcome`` maps add key-wise."""
+    out: dict[str, dict] = {}
+    for part in parts:
+        if not isinstance(part, dict):
+            continue
+        for tenant, r in part.items():
+            if not isinstance(r, dict):
+                continue
+            dst = out.setdefault(tenant, {"requests": 0, "by_outcome": {},
+                                          **{k: 0 for k in _SUM_FIELDS}})
+            for k, v in r.items():
+                if k == "by_outcome" and isinstance(v, dict):
+                    for o, n in v.items():
+                        if isinstance(n, (int, float)):
+                            dst["by_outcome"][o] = (
+                                dst["by_outcome"].get(o, 0) + n
+                            )
+                elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                    dst[k] = round(dst.get(k, 0) + v, 6) \
+                        if isinstance(v, float) or isinstance(
+                            dst.get(k, 0), float) else dst.get(k, 0) + v
+    return {
+        t: {**{k: v for k, v in sorted(r.items()) if k != "by_outcome"},
+            "by_outcome": dict(sorted(r["by_outcome"].items()))}
+        for t, r in sorted(out.items())
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ditl_tpu.telemetry.usage",
+        description="aggregate per-tenant usage ledgers (ISSUE 15)",
+    )
+    parser.add_argument("--dir", required=True,
+                        help="directory holding usage-*.jsonl ledger files")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable rollup (sorted keys — "
+                        "byte-identical across runs over the same ledger)")
+    parser.add_argument("--tenant", default="",
+                        help="restrict the output to one tenant label")
+    parser.add_argument("--source", default="",
+                        help="restrict to ledger rows whose journal "
+                        "source contains this substring (e.g. 'server' "
+                        "for engine rows, 'gateway' for edge rows) — a "
+                        "gateway-relayed request appears in BOTH, so the "
+                        "unfiltered union double-counts its prompt "
+                        "tokens (troubleshooting §33)")
+    args = parser.parse_args(argv)
+
+    rows = load_usage(args.dir)
+    if args.source:
+        rows = [r for r in rows
+                if args.source in str(r.get("source", ""))]
+    sources = sorted({str(r.get("source", "")) for r in rows})
+    agg = rollup(rows)
+    if args.tenant:
+        label = sanitize_label(args.tenant)
+        agg = {label: agg[label]} if label in agg else {}
+    mixed = (not args.source and any("gateway" in s_ for s_ in sources)
+             and any("gateway" not in s_ for s_ in sources))
+    if args.json:
+        print(json.dumps({"schema": USAGE_SCHEMA, "rows": len(rows),
+                          "sources": sources, "tenants": agg},
+                         sort_keys=True))
+        return 0
+    if not agg:
+        print(f"no usage rows in {args.dir}"
+              + (f" for tenant {args.tenant!r}" if args.tenant else ""))
+        return 0
+    print(f"{len(rows)} usage row(s), {len(agg)} tenant(s)"
+          + (f" from {len(sources)} source(s)" if len(sources) > 1 else ""))
+    if mixed:
+        print("  note: gateway edge rows AND engine rows present — a "
+              "relayed request is counted in both; filter with "
+              "--source server / --source gateway for an unduplicated "
+              "view")
+    for tenant, r in agg.items():
+        outcomes = " ".join(
+            f"{k}={v}" for k, v in r["by_outcome"].items())
+        print(f"  {tenant}: requests={r['requests']} ({outcomes}) "
+              f"tokens_in={r['prompt_tokens']} "
+              f"tokens_out={r['generated_tokens']} "
+              f"cached={r['cache_hit_tokens']} "
+              f"device_s={r['device_time_est_s']} "
+              f"queue_wait_s={r['queue_wait_s']} "
+              f"preemptions={r['preemptions']}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""Raw tenant identity reaching telemetry sinks (tenant-label-discipline)."""
+
+
+def tenant_label(t):
+    return f"t_{hash(t)}"
+
+
+def sanitize_label(t):
+    return str(t)
+
+
+class M:
+    def note(self, registry, journal, bearer_token, tenant):
+        registry.counter(f"x_{bearer_token}_total", "line 14: raw bearer")
+        journal.event("usage.request", tenant=tenant)  # line 15: raw tenant
+        registry.gauge(f"x_{sanitize_label(tenant)}", "wrapped: silent")
+        journal.event("usage.request", tenant=tenant_label(tenant))  # silent
+        registry.counter("x_static_total", "no identity at all: silent")

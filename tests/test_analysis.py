@@ -191,6 +191,17 @@ def test_metric_catalog_fixture():
     # the real family and the dynamically-built name are silent.
 
 
+def test_tenant_label_discipline_fixture():
+    diags = run(fixture("tenant"), rules=["tenant-label-discipline"])
+    assert ids(diags) == [
+        ("tenant-label-discipline", 14),  # raw bearer in a counter family
+        ("tenant-label-discipline", 15),  # raw tenant in a journal event
+    ]
+    assert "bearer_token" in diags[0].message
+    assert "tenant" in diags[1].message
+    # the wrapped spellings (sanitize_label/tenant_label) stay silent.
+
+
 def test_every_rule_has_a_violating_fixture():
     """Acceptance: the analyzer exits non-zero on every fixture violation
     class — each registered rule fires on its fixture."""
@@ -209,6 +220,7 @@ def test_every_rule_has_a_violating_fixture():
         "registry-mirror": (fixture("registry"), registry_settings),
         "config-drift": (fixture("configdoc"), configdoc_settings),
         "metric-catalog": (fixture("metrics"), None),
+        "tenant-label-discipline": (fixture("tenant"), None),
     }
     assert set(per_rule) == set(RULES), (
         "new rule registered without a violating fixture — add one under "

@@ -113,6 +113,19 @@ def _collect_live() -> set[str]:
     g._set_role_gauges([view])
     live |= _families(g.registry.render())
 
+    # -- per-tenant usage meter (ISSUE 15): every outcome + tenant and
+    # overflow families, normalized onto the <tenant> catalog rows ------
+    from ditl_tpu.telemetry.usage import OUTCOMES, UsageMeter
+
+    um = UsageMeter(registry=m.registry, max_tenant_families=1)
+    for outcome in OUTCOMES + ("teapot",):  # teapot -> the "other" row
+        um.note_terminal({"tenant": "t_3fa21bdeadbe", "outcome": outcome,
+                          "prompt_tokens": 1, "generated_tokens": 1,
+                          "cache_hit_tokens": 1,
+                          "device_time_est_s": 0.1})
+    um.note_terminal({"tenant": "t_overflow", "outcome": "200"})
+    live |= _families(m.registry.render())
+
     # -- memwatch on a stats-bearing (fake) device -----------------------
     from ditl_tpu.telemetry.memwatch import MemoryWatcher
 
