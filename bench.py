@@ -1683,6 +1683,171 @@ def bench_gateway_overhead(*args, **kwargs) -> int:
     return 0
 
 
+def run_multi_lora_bench(n_adapters: int = 4, slots: int = 4,
+                         decode_chunk: int = 8, prompt_len: int = 0,
+                         max_new: int = 0, swaps: int = 6,
+                         compile_cache_dir: str = "",
+                         _model_overrides: dict | None = None) -> dict:
+    """Multi-LoRA serving overhead A/B (ISSUE 16 satellite): the SAME
+    model, workload, and engine knobs run twice — once as a plain base
+    engine, once with a stacked adapter pool of ``n_adapters`` rows and
+    requests spread round-robin across them. The pool rows are all-zeros
+    adapters, so leg B's outputs are bitwise the base model's while every
+    decode tick still pays the full per-row gather + LoRA matmuls — the
+    delta is exactly the price of ARMING the adapter plane, which is
+    what ``adapter_gather_overhead_ratio`` records (fraction of base
+    tokens/sec lost; perf_compare gates it with direction -1).
+
+    The pool leg then runs a hot-swap drill: an adapter-only checkpoint
+    (train/adapter_export layout, crc manifest and all) is repeatedly
+    re-published into the live registry (infer/adapters.py) —
+    verify -> load-to-spare-row -> flip -> drain-old-row per swap, timed
+    end to end from the caller's seat. ``adapter_swap_p95_s`` is the
+    second gated number: a regression here means hot publication stopped
+    being cheap enough to run against a serving fleet.
+
+    ``_model_overrides`` shrinks the bench model (tier-1 acceptance
+    drills only — a published row must not use it)."""
+    import dataclasses
+    import tempfile
+
+    import jax
+
+    from ditl_tpu.config import ModelConfig
+    from ditl_tpu.data.tokenizer import ByteTokenizer
+    from ditl_tpu.infer.adapters import AdapterRegistry
+    from ditl_tpu.infer.continuous import ContinuousEngine
+    from ditl_tpu.infer.engine import GenerateConfig
+    from ditl_tpu.models import llama
+    from ditl_tpu.models.lora import stack_adapters, zeros_adapter
+    from ditl_tpu.runtime.distributed import enable_compile_cache
+    from ditl_tpu.train.adapter_export import export_adapter
+
+    if n_adapters < 2:
+        # The swap drill re-publishes into a SPARE row while the old one
+        # drains — a 1-row pool has no spare (and is not "multi" anyway).
+        raise ValueError(f"n_adapters ({n_adapters}) must be >= 2")
+    enable_compile_cache(compile_cache_dir)
+    _inc0 = _incidents_now()
+    platform = jax.devices()[0].platform
+    cfg = ModelConfig(
+        name="bench-350m", vocab_size=32768, hidden_size=1024,
+        intermediate_size=2816, num_layers=24, num_heads=16, num_kv_heads=8,
+        head_dim=64, max_seq_len=1024, dtype="bfloat16",
+        param_dtype="float32", lora_rank=8,
+    )
+    max_new = max_new or (128 if platform == "tpu" else 8)
+    plen = prompt_len or (64 if platform == "tpu" else 24)
+    if platform != "tpu":
+        cfg = dataclasses.replace(cfg, num_layers=2, hidden_size=256,
+                                  intermediate_size=688, vocab_size=4096,
+                                  lora_rank=4)
+    if _model_overrides:
+        cfg = dataclasses.replace(cfg, **_model_overrides)
+    base_cfg = dataclasses.replace(cfg, lora_rank=0)
+    params = llama.init_params(jax.random.key(0), base_cfg)
+    params_m = llama.num_params(params) / 1e6
+    tok = ByteTokenizer()
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    n_requests = slots * 2
+    prompts = [
+        [1] + rng.integers(4, min(4096, cfg.vocab_size),
+                           size=plen - 1).tolist()
+        for _ in range(n_requests)
+    ]
+
+    def timed_leg(eng, adapter_ids):
+        def run_once():
+            for i, p in enumerate(prompts):
+                eng.submit(list(p), max_new_tokens=max_new, seed=i,
+                           adapter_id=adapter_ids[i] or None)
+            out = eng.run()
+            return sum(len(v) for v in out.values())
+
+        run_once()  # compile every program in the path
+        times, tokens = [], 0
+        for _ in range(5):
+            t = time.perf_counter()
+            tokens = run_once()
+            times.append(time.perf_counter() - t)
+        return tokens / statistics.median(times)
+
+    # Leg A: plain base engine — no stacked leaves, no gather anywhere.
+    base_eng = ContinuousEngine(
+        params, base_cfg, tok, n_slots=slots, decode_chunk=decode_chunk,
+        gen=GenerateConfig(max_new_tokens=max_new),
+    )
+    base_tps = timed_leg(base_eng, [0] * n_requests)
+
+    # Leg B: identical base weights under a stacked pool of n_adapters
+    # zeros rows (+ base row 0), requests spread round-robin across the
+    # rows — different adapters SHARING decode ticks, the multi-tenant
+    # serving regime the per-row gather exists for.
+    lparams = {**params, "layers": {**params["layers"], "lora":
+               stack_adapters([zeros_adapter(cfg)] * (n_adapters + 1))}}
+    pool_eng = ContinuousEngine(
+        lparams, cfg, tok, n_slots=slots, decode_chunk=decode_chunk,
+        gen=GenerateConfig(max_new_tokens=max_new),
+    )
+    spread = [1 + i % n_adapters for i in range(n_requests)]
+    pool_tps = timed_leg(pool_eng, spread)
+
+    # Hot-swap drill on the (now idle) pool engine: attached AFTER the
+    # timed loops so registry billing bookkeeping cannot touch leg B's
+    # throughput number.
+    registry = AdapterRegistry(pool_eng)
+    adir = tempfile.mkdtemp(prefix="ditl-mlora-bench-")
+    version = export_adapter(
+        adir, "bench-ft", 1, {"layers": {"lora": zeros_adapter(cfg)}}, cfg)
+    swap_times = []
+    for _ in range(max(1, swaps)):
+        # Re-publication to a live name each round after the first:
+        # verify -> spare row -> flip -> drain-old — the full publish hop
+        # a replica runs, timed from the caller's seat.
+        t0 = time.perf_counter()
+        registry.load("bench-ft", version)
+        swap_times.append(time.perf_counter() - t0)
+    swap_times.sort()
+
+    overhead = 1.0 - pool_tps / base_tps
+    return {
+        "metric": "multi-LoRA serving tokens/sec (%d zero-delta adapter "
+                  "rows, rank %d, batch %d, ctx %d+%d)"
+                  % (n_adapters, cfg.lora_rank, n_requests, plen, max_new),
+        **_record_meta(),
+        "value": round(pool_tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": 1.0,
+        "vs_baseline_key": "self",
+        "params_m": round(params_m, 1),
+        "platform": platform,
+        "adapters": {
+            "schema": 1,
+            "n_adapters": n_adapters,
+            "lora_rank": cfg.lora_rank,
+            "requests": n_requests,
+            "base_tokens_per_sec": round(base_tps, 1),
+            "pool_tokens_per_sec": round(pool_tps, 1),
+            # Fraction of base-engine tokens/sec the armed pool costs
+            # (negative = noise in the pool leg's favor; gated -1).
+            "adapter_gather_overhead_ratio": round(overhead, 4),
+            "swaps": len(swap_times),
+            "adapter_swap_p50_s": round(_percentile(swap_times, 0.50), 6),
+            "adapter_swap_p95_s": round(_percentile(swap_times, 0.95), 6),
+        },
+        **_chaos_result(),
+        **_incident_result(_inc0),
+    }
+
+
+def bench_multi_lora(*args, **kwargs) -> int:
+    """CLI wrapper over :func:`run_multi_lora_bench`: one JSON line."""
+    print(json.dumps(run_multi_lora_bench(*args, **kwargs)))
+    return 0
+
+
 def _effective_bwd_impls(cfg, batch: int, seq: int, mesh=None) -> dict[str, str]:
     """Which backward implementation will actually run for this config —
     delegates to the SAME predicates the dispatch uses (ops/mlp.py,
@@ -2255,6 +2420,16 @@ if __name__ == "__main__":
                         "ISSUE 15); the row gains a usage_metering block "
                         "(gateway_rps_metered / metering_overhead_ratio) "
                         "that perf_compare gates")
+    parser.add_argument("--serve-multi-lora", type=int, default=0,
+                        metavar="N",
+                        help="multi-LoRA serving A/B (--infer, ISSUE 16): "
+                        "the same engine/workload run base-only and then "
+                        "with a stacked pool of N zero-delta adapter rows "
+                        "(zeros rows still pay the per-row gather), plus a "
+                        "hot re-publication swap drill through the adapter "
+                        "registry; the row embeds a hoisted adapters block "
+                        "(adapter_gather_overhead_ratio / adapter_swap_"
+                        "p95_s) that perf_compare gates")
     parser.add_argument("--serve-pool-idle", type=int, default=-1,
                         help="with --serve-gateway-overhead: override "
                         "gateway.pool_max_idle_per_replica (0 = pooling "
@@ -2303,7 +2478,7 @@ if __name__ == "__main__":
                   or args.infer_workload != "random" or args.moe
                   or args.prompt_len or args.max_new or args.guided
                   or args.spec_draft or args.serve_replicas
-                  or args.serve_trace_replay)
+                  or args.serve_trace_replay or args.serve_multi_lora)
     if infer_only and not args.infer:
         parser.error("serving flags require --infer")
     if args.infer and (args.override or args.batch or args.seq):
@@ -2324,6 +2499,13 @@ if __name__ == "__main__":
     if args.serve_trace_replay and not (args.infer and args.serve_replicas):
         parser.error("--serve-trace-replay requires --infer "
                      "--serve-replicas N (the fleet it replays against)")
+    if args.infer and args.serve_multi_lora:
+        sys.exit(bench_multi_lora(
+            n_adapters=args.serve_multi_lora, slots=args.slots,
+            decode_chunk=args.decode_chunk, prompt_len=args.prompt_len,
+            max_new=args.max_new,
+            compile_cache_dir=args.compile_cache_dir,
+        ))
     if args.infer and args.serve_trace_replay:
         sys.exit(bench_trace_replay(
             args.serve_trace_replay, n_replicas=args.serve_replicas,

@@ -72,7 +72,9 @@ ACTIONS = ("delay", "error", "corrupt", "hang", "kill")
 # site supports them; `corrupt` must be APPLIED by the seam (only it knows
 # what "corrupt" means for its data), so a corrupt rule anywhere else would
 # journal an injection that never happened — rejected at parse time.
-CORRUPT_SITES = frozenset({"data.batch", "ckpt.save", "kvtier.swap_in"})
+CORRUPT_SITES = frozenset({
+    "data.batch", "ckpt.save", "kvtier.swap_in", "adapter.load",
+})
 
 # Seams that consult the plane with a `step=` value. A `step=` trigger
 # anywhere else compares against None and silently never fires — the same
@@ -121,6 +123,17 @@ SITES = {
                   "orchestration on the relay leg (error/delay = a lost or "
                   "slow handoff leg -> fallback to plain relay and "
                   "re-prefill with zero client-visible failures)",
+    "adapter.load": "infer/adapters.py: a hot adapter load, after the disk "
+                    "read and before the crc verify (corrupt = bit-flip "
+                    "the adapter bytes — the manifest crc must refuse the "
+                    "load cleanly, nothing reaches the device; error = a "
+                    "failed load -> counted, journaled, base keeps "
+                    "serving)",
+    "adapter.publish": "gateway/publish.py: one per-replica hop of a "
+                       "fleet-wide adapter publication (error = the hop "
+                       "dies mid-publish -> that replica keeps its old "
+                       "verified adapter, the fallback is counted and the "
+                       "journal chain shows which replicas flipped)",
 }
 
 

@@ -35,6 +35,7 @@ __all__ = [
     "APIConfig",
     "GatewayConfig",
     "AutoscaleConfig",
+    "AdapterConfig",
     "ChaosConfig",
     "TelemetryConfig",
     "Config",
@@ -810,6 +811,44 @@ class UsageConfig:
 
 
 @dataclass(frozen=True)
+class AdapterConfig:
+    """Adapter plane (ISSUE 16, infer/adapters.py + gateway/publish.py):
+    per-tenant multi-LoRA serving with hot load/evict and live
+    train->serve weight publication. Disarmed by default — a server
+    without a stacked adapter pool pays nothing."""
+
+    # Spare all-zeros rows appended to the serving stack at launch
+    # (infer/server.py --adapter-pool): the free rows hot loads and
+    # publications land in. 0 = the stack holds exactly the launch-time
+    # adapters and nothing can be hot-loaded.
+    pool: int = 0
+    # Trainer-side publication (train/adapter_export.py): every
+    # publish_every optimizer steps the train loop commits an
+    # adapter-only checkpoint (npz + crc manifest + atomic LATEST
+    # pointer) under publish_dir/<publish_name>/. publish_every=0 or an
+    # empty publish_dir = no exports.
+    publish_dir: str = ""
+    publish_every: int = 0
+    publish_name: str = "adapter"
+    # How long an evict/publish waits for in-flight requests on the old
+    # row to drain before freeing it (the row never frees under traffic —
+    # a timeout fails the evict, it does not tear the row).
+    drain_timeout_s: float = 30.0
+
+    def __post_init__(self):
+        if self.pool < 0:
+            raise ValueError(f"adapter.pool must be >= 0, got {self.pool}")
+        if self.publish_every < 0:
+            raise ValueError(
+                f"adapter.publish_every must be >= 0, got "
+                f"{self.publish_every}")
+        if self.drain_timeout_s <= 0:
+            raise ValueError(
+                f"adapter.drain_timeout_s must be > 0, got "
+                f"{self.drain_timeout_s}")
+
+
+@dataclass(frozen=True)
 class ChaosConfig:
     """Fault-injection plane (ditl_tpu/chaos/, ISSUE 5). ``rules`` is the
     compact spec string ``site:action[@k=v,...];...`` (see
@@ -1056,6 +1095,7 @@ class Config:
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     kvtier: KVTierConfig = field(default_factory=KVTierConfig)
     usage: UsageConfig = field(default_factory=UsageConfig)
+    adapter: AdapterConfig = field(default_factory=AdapterConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 

@@ -102,6 +102,14 @@ class AdmissionDecision:
     # replica — a tenant cannot escape its pin by claiming interactive in
     # the request body (ISSUE 8).
     slo_class: str = ""
+    # Adapter this tenant is pinned to ("" = no pin; ISSUE 16): stamped as
+    # X-Adapter-Name on every relay, which OVERRIDES the payload's model
+    # field at the replica — the tenant's traffic serves through its own
+    # fine-tune regardless of what the request claims. Names are LIVE
+    # registry state, so no static validation here: a pin naming an
+    # evicted/unknown adapter 404s at the replica with a reason
+    # (reject-don't-drop, never a silent fall-through to base).
+    adapter: str = ""
 
 
 @dataclasses.dataclass
@@ -109,6 +117,7 @@ class _TenantState:
     bucket: TokenBucket | None
     max_concurrent: int
     slo_class: str = ""
+    adapter: str = ""
     active: int = 0
     admitted: int = 0
     throttled: int = 0
@@ -173,6 +182,7 @@ class TenantAdmission:
                 slo_class=str(
                     cfg.get("slo_class", self.default_slo_class) or ""
                 ),
+                adapter=str(cfg.get("adapter", "") or ""),
             )
             self._tenants[tenant] = st
             # Tenants arrive as arbitrary unauthenticated bearer tokens:
@@ -205,6 +215,7 @@ class TenantAdmission:
                     reason=f"tenant concurrency cap ({st.max_concurrent}) "
                            "reached",
                     slo_class=st.slo_class,
+                    adapter=st.adapter,
                 )
             if st.bucket is not None:
                 wait = st.bucket.try_take(1.0)
@@ -214,10 +225,12 @@ class TenantAdmission:
                         False, retry_after_s=wait,
                         reason="tenant rate limit exceeded",
                         slo_class=st.slo_class,
+                        adapter=st.adapter,
                     )
             st.active += 1
             st.admitted += 1
-            return AdmissionDecision(True, slo_class=st.slo_class)
+            return AdmissionDecision(True, slo_class=st.slo_class,
+                                     adapter=st.adapter)
 
     def release(self, tenant: str) -> None:
         with self._lock:

@@ -442,6 +442,11 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     # attribution story (tenant throttles and fleet-level 429/503/504s
     # never reach an engine ledger). Unarmed by default.
     usage = None
+    # Adapter publication coordinator (ISSUE 16): a
+    # gateway/publish.AdapterPublisher driving fleet-wide
+    # verify -> per-replica swap walks for /v1/adapters/{load,evict,
+    # publish}. make_gateway always arms one (it needs only the fleet).
+    publisher = None
 
     def log_message(self, *args):
         logger.debug("gateway http: " + args[0], *args[1:])
@@ -583,6 +588,8 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 })
         elif path in ("/v1/models", "/models"):
             self._proxy_get("/v1/models")
+        elif path in ("/v1/adapters", "/adapters"):
+            self._adapters_get()
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -619,6 +626,27 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             "gateway": own,
             "replicas": replicas,
         })
+
+    def _adapters_get(self) -> None:
+        """Fleet adapter view (ISSUE 16): every routable replica's
+        /v1/adapters listing, fanned out concurrently with one shared
+        deadline (the /incidents pattern). Replicas without an armed
+        adapter plane answer 404 and are simply absent (absent != "zero
+        adapters") — on a converged fleet every replica shows the same
+        name->generation map; a mid-publication snapshot shows exactly
+        which replicas have flipped."""
+        def fetch(view):
+            return self.fleet.pool.get_json(
+                view.id, view.address, "/v1/adapters",
+                timeout=self.gwcfg.probe_timeout_s,
+            )
+
+        replicas: dict[str, dict] = {}
+        for view, data in self._fan_out_replicas(self.fleet.routable(),
+                                                 fetch):
+            if isinstance(data, dict) and "adapters" in data:
+                replicas[view.id] = data
+        self._send_json(200, {"replicas": replicas})
 
     def _usage(self) -> None:
         """Fleet usage view (ISSUE 15): every routable replica's /usage
@@ -724,6 +752,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
 
     def do_POST(self):
         self._rid = None  # fresh id per request on keep-alive connections
+        self._adapter_pin = None  # set per-request by _admit_and_route
         try:
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length) or b"{}"
@@ -764,8 +793,37 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             # affinity hit-rate the router A/B records.
             self.gw.requests.inc()
             self._route_and_relay(path, payload, raw, record=False)
+        elif path.endswith(("/adapters/load", "/adapters/evict",
+                            "/adapters/publish")):
+            # Adapter control plane (ISSUE 16): fleet-wide publication —
+            # verify-at-edge, then a journaled per-replica walk. Not
+            # admission-controlled (operator/trainer traffic, like the
+            # actuation plane), and kept out of the serving instruments.
+            self._adapter_admin(payload, path.rsplit("/", 1)[1])
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _adapter_admin(self, payload: dict, op: str) -> None:
+        if self.publisher is None:
+            self._send_json(404, {"error": {"message":
+                "no adapter publisher configured"}})
+            return
+        owner = str(payload.get("owner") or "")
+        if not owner:
+            # Default attribution: the caller's credential-safe label —
+            # same identity the replicas' per-tenant ledgers bill under.
+            owner = tenant_label(
+                self._tenant(),
+                self.admission.per_tenant
+                if self.admission is not None else ())
+        status, answer = self.publisher.run(
+            op,
+            str(payload.get("name") or ""),
+            directory=str(payload.get("dir")
+                          or payload.get("directory") or ""),
+            owner=owner,
+        )
+        self._send_json(status, answer)
 
     def _admit_and_route(self, path: str, payload: dict, raw: bytes,
                          span=None) -> None:
@@ -826,6 +884,11 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 return
             m.tenant_counter(label, "admitted").inc()
             pinned_class = decision.slo_class or None
+            # Adapter pin (ISSUE 16): rides X-Adapter-Name on every relay
+            # attempt of THIS request (stashed on the handler instance,
+            # which serves one request at a time — the _rid pattern), and
+            # OVERRIDES the payload's model field at the replica.
+            self._adapter_pin = decision.adapter or None
         if self.recorder is not None:
             # Traffic recorder (ISSUE 12 satellite): one row per ADMITTED
             # request — throttled requests never reach here, so the saved
@@ -1273,6 +1336,15 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
         cls = slo_class or self.headers.get("X-SLO-Class")
         if cls in SLO_CLASS_NAMES:
             headers["X-SLO-Class"] = cls
+        # Adapter pin (ISSUE 16): same precedence shape as the SLO class —
+        # a tenant pin from admission wins, else the client's own header
+        # is relayed. The header OVERRIDES the payload's model field at
+        # the replica; an evicted/unknown name 404s there with a reason
+        # (reject-don't-drop), so no validation is needed at this hop.
+        adapter = getattr(self, "_adapter_pin", None) \
+            or self.headers.get("X-Adapter-Name")
+        if adapter:
+            headers["X-Adapter-Name"] = adapter
         if tenant:
             # Tenant relay header (ISSUE 15): the admission-layer label
             # (digest or configured name — NEVER the raw bearer), so the
@@ -1612,6 +1684,14 @@ def make_gateway(
     if slo is None:
         kw = telemetry.gateway_slo_kwargs() if telemetry is not None else {}
         slo = gateway_slo(gw_metrics, **kw)
+    # Adapter publication coordinator (ISSUE 16): always armed — it needs
+    # only the fleet; replicas without an adapter plane answer its hops
+    # with 404s, which the walk reports per-replica instead of hiding.
+    from ditl_tpu.gateway.publish import AdapterPublisher
+    publisher = AdapterPublisher(
+        fleet, journal=journal, registry=gw_metrics.registry,
+        timeout_s=config.request_timeout_s,
+    )
     handler = type(
         "BoundGatewayHandler",
         (_GatewayHandler,),
@@ -1632,6 +1712,7 @@ def make_gateway(
             "kvtier": kvtier,
             "journal": journal,
             "usage": usage,
+            "publisher": publisher,
         },
     )
     return GatewayHTTPServer(

@@ -1,0 +1,214 @@
+"""Fleet-wide adapter publication (ISSUE 16 tentpole, gateway half).
+
+The replica half (infer/adapters.py) can hot-swap ONE engine's adapter
+row; this module is the coordinator that makes a trainer's adapter-only
+checkpoint reach EVERY replica of a live fleet without a restart:
+
+- **Verify at the edge first.** The checkpoint dir's manifest/crc is
+  checked at the gateway before any replica is touched (the PR 5
+  torn-save rule, via utils/adapterfmt — stdlib-only, so the gateway
+  package stays provably jax-free). A torn or corrupt checkpoint is
+  refused in one place with one reason; replicas re-verify the exact
+  bytes themselves on their own load path anyway (defense in depth —
+  the gateway and a replica reading different bytes is precisely the
+  failure the double check catches).
+- **Per-replica walk, crash-equivalent aborts.** Replicas are walked in
+  a deterministic order; each hop POSTs the replica's own
+  /v1/adapters/{publish,load,evict} endpoint, which does
+  verify -> load-to-spare-row -> flip-name-pointer -> drain-old-row
+  locally. The ``adapter.publish`` chaos site is consulted BEFORE each
+  hop: an injected fault aborts the walk exactly where a SIGKILL of the
+  coordinating gateway would — every replica already flipped serves the
+  NEW adapter, every replica not yet reached keeps serving the OLD one,
+  and no replica anywhere serves a torn one (the row flip is atomic
+  under each registry's lock). Re-running the publication converges the
+  stragglers; a rolling restart with baked weights stays the full-weights
+  fallback.
+- **Every outcome journaled.** ``adapter.publish.start`` -> one
+  ``.hop``/``.hop_failed``/``.hop_lost`` per replica ->
+  ``adapter.publish.done`` with the per-replica outcome map, in the
+  gateway's own journal — `merge_journals` over the fleet's journal dirs
+  reads as one causally-ordered chain next to each replica's own
+  ``adapter.loaded``/``adapter.published`` events.
+
+jax-free like the rest of gateway/ (the import-layering analysis rule).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+from ditl_tpu.chaos.plane import InjectedFault, maybe_inject
+from ditl_tpu.utils import adapterfmt
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["AdapterPublisher"]
+
+PREFIX = "ditl_adapter"
+_OPS = ("load", "evict", "publish")
+
+
+class AdapterPublisher:
+    """Coordinates one adapter lifecycle operation across a Fleet.
+
+    ``fleet`` is a gateway Fleet (pooled per-replica HTTP + liveness
+    views); ``registry`` a telemetry MetricsRegistry for the
+    ``ditl_adapter_publish*`` families; ``journal`` an EventJournal."""
+
+    def __init__(self, fleet, *, journal=None, registry=None,
+                 timeout_s: float = 60.0):
+        self.fleet = fleet
+        self.journal = journal
+        self.timeout_s = float(timeout_s)
+        # One publication at a time: two concurrent walks interleaving
+        # their flips could leave replicas on different generations with
+        # BOTH walks reporting success.
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._m_publishes = self._m_hops_failed = self._m_fallbacks = None
+        if registry is not None:
+            self._m_publishes = registry.counter(
+                f"{PREFIX}_publishes",
+                "fleet-wide adapter publications coordinated (any outcome)")
+            self._m_hops_failed = registry.counter(
+                f"{PREFIX}_publish_hops_failed",
+                "per-replica publication hops that failed (replica kept "
+                "its previous adapter)")
+            self._m_fallbacks = registry.counter(
+                f"{PREFIX}_publish_fallbacks",
+                "publications aborted mid-walk (chaos/crash): stragglers "
+                "keep the old adapter until a re-publish converges them")
+
+    def run(self, op: str, name: str, directory: str = "",
+            owner: str = "") -> tuple[int, dict]:
+        """Walk every routable replica with one lifecycle op; returns
+        ``(http_status, payload)`` for the gateway handler to relay.
+        200 = every replica converged; 502 = partial (the payload says
+        exactly which replicas are on which side); 503 = no live
+        replica; 4xx = refused before any replica was touched."""
+        if op not in _OPS:
+            return 400, {"error": {"message": f"unknown adapter op {op!r}"}}
+        if not name:
+            return 400, {"error": {"message":
+                f"adapter {op} wants a non-empty 'name'"}}
+        step = -1
+        if op != "evict":
+            if not directory:
+                return 400, {"error": {"message":
+                    f"adapter {op} wants 'dir' (a manifest-carrying "
+                    f"adapter checkpoint directory)"}}
+            # Edge verification: manifest+crc over the exact on-disk bytes
+            # BEFORE any replica hop — a torn trainer save is refused here
+            # with one reason instead of N per-replica 422s.
+            try:
+                directory = adapterfmt.resolve_latest(directory)
+                state, why = adapterfmt.verify_dir(directory)
+            except OSError as e:
+                state, why = "corrupt", str(e)
+            if state != "verified":
+                self._journal("adapter.publish.refused", op=op, name=name,
+                              checkpoint=directory, why=why)
+                return 422, {"error": {"message":
+                    f"adapter checkpoint {directory} failed verification "
+                    f"at the gateway: {why}"}}
+            try:
+                step = int(adapterfmt.read_meta(directory).get("step", -1))
+            except (OSError, ValueError):
+                step = -1
+        with self._lock:
+            return self._walk(op, name, directory, owner, step)
+
+    def _walk(self, op: str, name: str, directory: str, owner: str,
+              step: int) -> tuple[int, dict]:
+        if self._m_publishes is not None:
+            self._m_publishes.inc()
+        self._seq += 1
+        pub_id = f"pub-{self._seq:04d}"
+        views = sorted(self.fleet.routable(), key=lambda v: v.id)
+        self._journal("adapter.publish.start", pub_id=pub_id, op=op,
+                      name=name, checkpoint=directory, step=step,
+                      replicas=[v.id for v in views])
+        if not views:
+            self._journal("adapter.publish.done", pub_id=pub_id, op=op,
+                          name=name, ok=[], failed=[], aborted=False)
+            return 503, {"error": {"message": "no live replica"}}
+        body = json.dumps({
+            "name": name,
+            **({"dir": directory, "owner": owner} if op != "evict" else {}),
+        }).encode()
+        ok: list[dict] = []
+        failed: list[dict] = []
+        aborted = False
+        for view in views:
+            # Chaos seam (the SIGKILL-mid-publish drill): a fault here is
+            # the coordinator dying BETWEEN hops — the walk aborts, every
+            # not-yet-reached replica keeps its old verified adapter, and
+            # the journal shows exactly which replicas flipped.
+            try:
+                maybe_inject("adapter.publish")
+            except InjectedFault:
+                aborted = True
+                if self._m_fallbacks is not None:
+                    self._m_fallbacks.inc()
+                self._journal("adapter.publish.hop_lost", pub_id=pub_id,
+                              replica=view.id, chaos=True)
+                break
+            try:
+                status, _, data = self.fleet.pool.request(
+                    view.id, view.address, "POST", f"/v1/adapters/{op}",
+                    body=body,
+                    headers={"Content-Type": "application/json"},
+                    timeout=self.timeout_s,
+                )
+                answer = json.loads(data) if data else {}
+            except (OSError, ValueError) as e:
+                self.fleet.note_failure(view.id)
+                failed.append({"replica": view.id, "error": str(e)})
+                if self._m_hops_failed is not None:
+                    self._m_hops_failed.inc()
+                self._journal("adapter.publish.hop_failed", pub_id=pub_id,
+                              replica=view.id, error=str(e))
+                continue
+            if status == 200:
+                hop = {"replica": view.id,
+                       "generation": answer.get("generation"),
+                       "row": answer.get("row")}
+                ok.append(hop)
+                self._journal("adapter.publish.hop", pub_id=pub_id,
+                              replica=view.id, name=name,
+                              generation=answer.get("generation"),
+                              row=answer.get("row"))
+            else:
+                msg = (answer.get("error") or {}).get("message", str(status))
+                failed.append({"replica": view.id, "status": status,
+                               "error": msg})
+                if self._m_hops_failed is not None:
+                    self._m_hops_failed.inc()
+                self._journal("adapter.publish.hop_failed", pub_id=pub_id,
+                              replica=view.id, status=status, error=msg)
+        self._journal("adapter.publish.done", pub_id=pub_id, op=op,
+                      name=name, step=step,
+                      ok=[h["replica"] for h in ok],
+                      failed=[f["replica"] for f in failed],
+                      aborted=aborted)
+        complete = not aborted and not failed and len(ok) == len(views)
+        payload = {
+            "op": op, "name": name, "pub_id": pub_id, "step": step,
+            "complete": complete, "aborted": aborted,
+            "replicas_total": len(views), "ok": ok, "failed": failed,
+        }
+        if aborted:
+            # Everything from the lost hop onward never saw the new bytes.
+            payload["skipped"] = [v.id
+                                  for v in views[len(ok) + len(failed):]]
+        return (200 if complete else 502), payload
+
+    def _journal(self, event: str, **attrs) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.event(event, **attrs)
+            except Exception:  # noqa: BLE001 - journaling never blocks a swap
+                logger.exception("publish journal write failed")

@@ -1,0 +1,550 @@
+"""Adapter registry: per-tenant multi-LoRA lifecycle (ISSUE 16 tentpole).
+
+The engine has had the multi-adapter GATHER since r3 (models/lora.py
+``stack_adapters`` -> per-slot ``adapter_ids`` inside every compiled
+program) but no lifecycle around it: the stack was frozen at launch, names
+were a frozen dict in the HTTP handler, and changing a single adapter
+meant a full-weights rolling restart. This module is the lifecycle:
+
+- **Rows, not restarts.** The stacked pool's rows 1..K-1 (row 0 is the
+  base model by convention) are a tiny allocator: free rows accept hot
+  loads, live rows serve, evicted rows drain then free. Every mutation of
+  engine/device state goes through the driver-thread-only
+  ``ThreadedEngine.call`` seam — a swap lands BETWEEN ticks, and an
+  in-flight request keeps its slot's adapter id pointing at the old,
+  still-intact row until the drain frees it: nothing ever samples a
+  half-swapped adapter.
+- **Verify before HBM.** A load reads a manifest-carrying adapter
+  checkpoint dir (utils/adapterfmt.py, the PR 5 torn-save rule), crcs the
+  EXACT bytes it decoded, and validates the geometry against the serving
+  model — corrupt bytes are refused on the host; they never reach the
+  device. The ``adapter.load`` chaos site (corrupt action) drills this.
+- **Generations.** Every (name -> row) binding carries a monotonically
+  increasing generation; a publication loads the new version into a SPARE
+  row, then flips the name pointer under the registry lock (journaled),
+  then drains and frees the old row. Clients see responses stamped
+  ``adapter:<name>@g<gen>`` flip at one journaled boundary.
+- **Billing.** Residency (HBM row-seconds) and per-request gather cost
+  accrue against the adapter's OWNING tenant and flush as dedicated
+  ``outcome="adapter"`` ledger rows (telemetry/usage.py) — the requester
+  pays for tokens, the owner pays for the pool row.
+
+Lock discipline: ``_lock`` guards the row/name tables only and is NEVER
+held across an engine call — the driver thread takes the same lock in
+``bill_request`` (terminal usage rows), so holding it while waiting on
+the driver would deadlock the replica.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ditl_tpu.chaos.plane import InjectedFault, maybe_inject
+from ditl_tpu.telemetry.usage import sanitize_label
+from ditl_tpu.utils import adapterfmt
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "AdapterBusy",
+    "AdapterError",
+    "AdapterNotFound",
+    "AdapterPoolFull",
+    "AdapterRegistry",
+    "AdapterVerifyError",
+]
+
+PREFIX = "ditl_adapter"
+# Swap latencies are host-dominated (npz decode + one .at[].set dispatch).
+SWAP_BUCKETS_S = (0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0)
+
+
+class AdapterError(Exception):
+    """Base adapter-plane error; ``status`` is the HTTP mapping the server
+    uses (reject-don't-drop: every refusal names its reason)."""
+
+    status = 400
+
+
+class AdapterNotFound(AdapterError):
+    status = 404
+
+    def __init__(self, name: str, *, evicted: bool = False):
+        self.name, self.evicted = name, evicted
+        super().__init__(
+            f"adapter {name!r} was evicted and no longer serves"
+            if evicted else f"unknown adapter {name!r}")
+
+
+class AdapterVerifyError(AdapterError):
+    """Checkpoint failed manifest/crc/geometry verification — refused
+    before any bytes reached the device."""
+
+    status = 422
+
+
+class AdapterPoolFull(AdapterError):
+    status = 409
+
+
+class AdapterBusy(AdapterError):
+    status = 409
+
+
+@dataclass
+class _Row:
+    """One stacked-pool row's lifecycle record. guarded-by: registry _lock
+    (every field; the installed weights themselves live in the engine's
+    params tree and move only on the driver thread)."""
+
+    row: int
+    state: str = "free"  # free | loading | live | evicting
+    name: str = ""
+    owner: str = ""  # sanitized owning-tenant label ("" = unowned)
+    generation: int = 0
+    step: int = -1
+    source: str = ""  # checkpoint dir ("" = launch-time/static install)
+    loaded_at: float = 0.0  # clock() at flip-to-live
+    residency_mark: float = 0.0  # last billing flush (clock())
+
+
+class AdapterRegistry:
+    """Lifecycle manager for one engine's stacked adapter pool.
+
+    ``engine`` is a ``ThreadedEngine`` (production: mutations ride
+    ``call`` onto the driver thread) or a bare ``ContinuousEngine``
+    (tests driving ticks synchronously — calls run inline)."""
+
+    def __init__(self, engine, *, journal=None, usage_ledger=None,
+                 drain_timeout_s: float = 30.0, clock=time.monotonic):
+        inner = getattr(engine, "_engine", engine)
+        if not getattr(inner, "multi_lora", False):
+            raise ValueError(
+                "adapter registry needs an engine serving a stacked "
+                "multi-adapter pool (serve with --adapter/--adapter-pool)")
+        self._engine = engine
+        self._inner = inner
+        self.journal = journal
+        self.usage_ledger = usage_ledger
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._clock = clock
+        self.n_rows = int(inner.n_adapters)
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._rows: list[_Row] = [_Row(row=i) for i in range(self.n_rows)]
+        self._rows[0] = _Row(row=0, state="live", name="", owner="",
+                             generation=0)  # base model; never allocated
+        self._names: dict[str, int] = {}  # live name -> row
+        self._tombstones: set[str] = set()  # evicted names (404, not base)
+        self._generation = 0
+        # Owner bills: sanitized owner label -> [gather_s, requests].
+        self._bills: dict[str, list] = {}  # guarded-by: _lock
+        # Per-token gather cost share: the adapter gather's FLOPs relative
+        # to the base forward (both per token) — scales each request's
+        # device-time estimate into the slice the gather added. A model,
+        # not a measurement; consistent across tenants, which is what
+        # billing shares need.
+        self._gather_frac = self._gather_cost_frac(inner.cfg)
+        r = inner.metrics.registry
+        self._m_live = r.gauge(
+            f"{PREFIX}_rows_live", "stacked pool rows serving an adapter")
+        self._m_total = r.gauge(
+            f"{PREFIX}_rows",
+            "stacked pool rows managed (excluding base row 0)")
+        self._m_loads = r.counter(
+            f"{PREFIX}_loads", "adapter hot loads committed")
+        self._m_load_failures = r.counter(
+            f"{PREFIX}_load_failures",
+            "adapter loads refused (verification/geometry/pool)")
+        self._m_evictions = r.counter(
+            f"{PREFIX}_evictions", "adapter rows evicted and freed")
+        self._m_swap = r.histogram(
+            f"{PREFIX}_swap_seconds",
+            "hot load/publish swap latency (verify -> row live)",
+            SWAP_BUCKETS_S)
+        self._m_total.set(max(0, self.n_rows - 1))
+        self._m_live.set(0)
+        inner.adapter_registry = self
+
+    # -- engine seam ---------------------------------------------------------
+
+    def _call(self, fn):
+        call = getattr(self._engine, "call", None)
+        return call(fn) if call is not None else fn()
+
+    @staticmethod
+    def _gather_cost_frac(cfg) -> float:
+        """lora-gather FLOPs / base-forward FLOPs, per token (host-side
+        constant). Targets are attention q/v (models/lora.LORA_TARGETS);
+        the base per-layer cost counts the attention projections + MLP."""
+        d, r = cfg.hidden_size, max(1, cfg.lora_rank)
+        q_out = cfg.num_heads * cfg.head_dim
+        kv_out = cfg.num_kv_heads * cfg.head_dim
+        lora = (d * r + r * q_out) + (d * r + r * kv_out)
+        base = d * (2 * q_out + 2 * kv_out) + 3 * d * cfg.intermediate_size
+        return lora / max(1, base)
+
+    # -- read side (HTTP handler threads) ------------------------------------
+
+    def resolve(self, name: str) -> tuple[int, int]:
+        """(row, generation) serving ``name`` right now. Raises
+        :class:`AdapterNotFound` for unknown names and — with
+        ``evicted=True`` — for tombstoned ones: an evicted adapter must
+        404, never silently serve base (the frozen-dict bug this
+        registry replaces)."""
+        with self._lock:
+            row_id = self._names.get(name)
+            if row_id is None:
+                raise AdapterNotFound(name, evicted=name in self._tombstones)
+            row = self._rows[row_id]
+            return row_id, row.generation
+
+    def list(self) -> dict:
+        """The /v1/adapters body: pool occupancy + every named binding."""
+        with self._lock:
+            adapters = [
+                {
+                    "name": row.name,
+                    "row": row.row,
+                    "generation": row.generation,
+                    "step": row.step,
+                    "owner": row.owner,
+                    "state": row.state,
+                    "source": row.source,
+                }
+                for row in self._rows[1:]
+                if row.state in ("live", "evicting") and row.name
+            ]
+            adapters.sort(key=lambda a: a["name"])
+            return {
+                "pool_rows": max(0, self.n_rows - 1),
+                "free_rows": sum(
+                    1 for row in self._rows[1:] if row.state == "free"),
+                "adapters": adapters,
+                "evicted": sorted(self._tombstones),
+            }
+
+    def names(self) -> dict[str, int]:
+        """Live name -> row map (one locked snapshot; the /v1/models
+        path)."""
+        with self._lock:
+            return dict(self._names)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def seed(self, name: str, row: int, *, owner: str = "",
+             step: int = -1, source: str = "") -> None:
+        """Adopt a launch-time-installed adapter (the legacy ``--adapter``
+        CLI path stacks them before the engine builds): marks ``row``
+        live under ``name`` without loading anything."""
+        with self._lock:
+            binding = self._bind_locked(name, row, owner=owner, step=step,
+                                        source=source)
+        self._journal("adapter.loaded", name=name, row=row,
+                      generation=binding["generation"], step=step,
+                      checkpoint=source or "launch")
+
+    def load(self, name: str, directory: str, *, owner: str = "") -> dict:
+        """Hot-load a manifest-verified adapter checkpoint into a free
+        row and bind ``name`` to it (new name or re-publication — the
+        binding flips atomically either way). Returns the new binding."""
+        t0 = self._clock()
+        directory = adapterfmt.resolve_latest(directory)
+        try:
+            # An `error` rule raises InjectedFault (RuntimeError) from
+            # inside the consult — it must ride the infrastructure-failure
+            # path (a 5xx), never become a client error; only `corrupt` is
+            # returned for this seam to apply.
+            fault = maybe_inject("adapter.load")
+        except InjectedFault:
+            self._m_load_failures.inc()
+            self._journal("adapter.load_failed", name=name,
+                          checkpoint=directory, chaos=True)
+            raise
+        try:
+            tree, meta = self._verify_host_side(
+                directory, flip_byte=fault is not None
+                and fault.action == "corrupt")
+        except AdapterError:
+            self._m_load_failures.inc()
+            self._journal("adapter.load_failed", name=name,
+                          checkpoint=directory)
+            raise
+        row_id = self._reserve_row(name)
+        try:
+            self._call(lambda: self._inner.install_adapter(row_id, tree))
+        except BaseException:
+            with self._lock:
+                self._rows[row_id] = _Row(row=row_id)  # back to free
+            self._m_load_failures.inc()
+            self._journal("adapter.load_failed", name=name, row=row_id,
+                          checkpoint=directory)
+            raise
+        with self._lock:
+            binding = self._bind_locked(
+                name, row_id, owner=owner, step=int(meta.get("step", -1)),
+                source=directory)
+        self._m_loads.inc()
+        self._m_swap.observe(self._clock() - t0)
+        self._journal("adapter.loaded", name=name, row=row_id,
+                      generation=binding["generation"],
+                      step=binding["step"], checkpoint=directory)
+        # A re-publication left the PREVIOUS row bound to nothing: drain
+        # and free it so the pool does not leak a row per publish. The
+        # flip already happened — a drain timeout here must not fail the
+        # load, so a still-busy old row is left `evicting` (journaled)
+        # and reaped on the next lifecycle call.
+        old_row = binding.pop("_replaced_row", None)
+        if old_row is not None:
+            try:
+                self._drain_and_free(old_row)
+            except AdapterBusy:
+                self._journal("adapter.drain_pending", name=name,
+                              row=old_row)
+        self._reap()
+        return binding
+
+    def evict(self, name: str) -> dict:
+        """Unbind ``name`` (immediately — no new request resolves it),
+        drain in-flight users of its row, purge the row's published
+        prefix pages, zero the weights, and free the row. The name
+        tombstones: its next resolution is a 404-with-reason, never a
+        silent fall-through to base."""
+        with self._lock:
+            row_id = self._names.pop(name, None)
+            if row_id is None:
+                raise AdapterNotFound(name, evicted=name in self._tombstones)
+            self._tombstones.add(name)
+            row = self._rows[row_id]
+            row.state = "evicting"
+            self._flush_row_residency_locked(row)
+            generation = row.generation
+        try:
+            self._drain_and_free(row_id)
+        except AdapterBusy:
+            # Drain timed out: restore the binding — reject-don't-drop, a
+            # busy row must fail the evict, not tear it.
+            with self._lock:
+                self._names[name] = row_id
+                self._tombstones.discard(name)
+                self._rows[row_id].state = "live"
+            raise
+        self._m_evictions.inc()
+        self._refresh_gauges()
+        self._journal("adapter.evicted", name=name, row=row_id,
+                      generation=generation)
+        return {"name": name, "row": row_id, "evicted": True}
+
+    def publish(self, name: str, directory: str, *, owner: str = "") -> dict:
+        """One replica's half of the publication protocol: verify ->
+        load-to-spare-row -> flip the name pointer (generation bump,
+        journaled) -> drain + free the old row. Exactly :meth:`load` —
+        named separately so the journal reads as a publication."""
+        binding = self.load(name, directory, owner=owner)
+        self._journal("adapter.published", name=name,
+                      row=binding["row"], generation=binding["generation"],
+                      step=binding["step"])
+        return binding
+
+    # -- internals -----------------------------------------------------------
+
+    def _verify_host_side(self, directory: str,
+                          *, flip_byte: bool) -> tuple[dict, dict]:
+        """Manifest+crc verify and decode ON THE HOST, then geometry-check
+        against the serving model. Raises AdapterVerifyError; nothing
+        reaches the device on any failure path."""
+        try:
+            arrays = adapterfmt.verify_and_read(directory,
+                                                flip_byte=flip_byte)
+            meta = adapterfmt.read_meta(directory)
+        except (OSError, ValueError, KeyError) as e:
+            raise AdapterVerifyError(str(e)) from e
+        cfg = self._inner.cfg
+        if int(meta.get("lora_rank", -1)) != cfg.lora_rank:
+            raise AdapterVerifyError(
+                f"adapter rank {meta.get('lora_rank')} != serving rank "
+                f"{cfg.lora_rank}")
+        if int(meta.get("num_layers", -1)) != cfg.num_layers:
+            raise AdapterVerifyError(
+                f"adapter layers {meta.get('num_layers')} != serving "
+                f"layers {cfg.num_layers}")
+        if int(meta.get("hidden_size", -1)) != cfg.hidden_size:
+            raise AdapterVerifyError(
+                f"adapter hidden {meta.get('hidden_size')} != serving "
+                f"hidden {cfg.hidden_size}")
+        tree: dict = {}
+        for key, arr in arrays.items():
+            target, _, leaf = key.partition(".")
+            tree.setdefault(target, {})[leaf] = arr
+        want = set(self._inner.params["layers"]["lora"])
+        if set(tree) != want:
+            raise AdapterVerifyError(
+                f"adapter targets {sorted(tree)} != serving targets "
+                f"{sorted(want)}")
+        return tree, meta
+
+    def _reap(self) -> None:
+        """Free `evicting` rows whose name binding already moved on (a
+        drain that timed out during a publish) once their in-flight users
+        are gone — opportunistic, called from lifecycle entry points."""
+        with self._lock:
+            stale = [row.row for row in self._rows[1:]
+                     if row.state == "evicting"
+                     and self._names.get(row.name) != row.row]
+        for row_id in stale:
+            if self._call(
+                    lambda r=row_id: self._inner.adapter_row_in_use(r)) == 0:
+                def _scrub(r=row_id):
+                    self._inner.purge_adapter_pages(r)
+                    self._inner.clear_adapter(r)
+                self._call(_scrub)
+                with self._lock:
+                    self._rows[row_id] = _Row(row=row_id)
+                    self._refresh_gauges_locked()
+
+    def _reserve_row(self, name: str) -> int:
+        with self._lock:
+            for row in self._rows[1:]:
+                if row.state == "free":
+                    row.state = "loading"
+                    row.name = name
+                    return row.row
+        raise AdapterPoolFull(
+            f"no free adapter rows (pool {self.n_rows - 1}, all "
+            f"live/loading); evict one or serve with a larger "
+            f"--adapter-pool")
+
+    def _bind_locked(self, name: str, row_id: int, *, owner: str,
+                     step: int, source: str) -> dict:
+        """Flip ``name`` to ``row_id`` (caller holds ``_lock``): the one
+        atomic visibility point — resolve() sees either the old complete
+        row or the new complete row, generation strictly increasing."""
+        if not 1 <= row_id < self.n_rows:
+            raise ValueError(f"adapter row {row_id} out of range")
+        self._generation += 1
+        now = self._clock()
+        old_row = self._names.get(name)
+        row = self._rows[row_id]
+        row.state = "live"
+        row.name = name
+        row.owner = sanitize_label(owner) if owner else ""
+        row.generation = self._generation
+        row.step = step
+        row.source = source
+        row.loaded_at = now
+        row.residency_mark = now
+        self._names[name] = row_id
+        self._tombstones.discard(name)
+        self._refresh_gauges_locked()
+        binding = {"name": name, "row": row_id,
+                   "generation": row.generation, "step": step,
+                   "owner": row.owner}
+        if old_row is not None and old_row != row_id:
+            self._rows[old_row].state = "evicting"
+            self._flush_row_residency_locked(self._rows[old_row])
+            binding["_replaced_row"] = old_row
+        return binding
+
+    def _drain_and_free(self, row_id: int) -> None:
+        """Wait until nothing in flight references ``row_id`` (slots +
+        queue, checked on the driver thread), then purge its published
+        prefix pages, zero the weights, and free it."""
+        deadline = self._clock() + self.drain_timeout_s
+        while self._call(
+                lambda: self._inner.adapter_row_in_use(row_id)) > 0:
+            if self._clock() > deadline:
+                raise AdapterBusy(
+                    f"adapter row {row_id} still serving in-flight "
+                    f"requests after {self.drain_timeout_s:.1f}s drain")
+            time.sleep(0.005)
+
+        def _scrub():
+            self._inner.purge_adapter_pages(row_id)
+            self._inner.clear_adapter(row_id)
+
+        self._call(_scrub)
+        with self._lock:
+            self._rows[row_id] = _Row(row=row_id)
+            self._refresh_gauges_locked()
+
+    def _refresh_gauges(self) -> None:
+        with self._lock:
+            self._refresh_gauges_locked()
+
+    def _refresh_gauges_locked(self) -> None:
+        self._m_live.set(sum(
+            1 for row in self._rows[1:] if row.state == "live"))
+
+    def _journal(self, event: str, **attrs) -> None:
+        if self.journal is not None:
+            try:
+                self.journal.event(event, **attrs)
+            except Exception:  # noqa: BLE001 - journaling never kills serving
+                logger.exception("adapter journal write failed")
+
+    # -- billing (ISSUE 16 usage satellite) ----------------------------------
+
+    def bill_request(self, row_id: int, usage_row: dict) -> None:
+        """Annotate one terminal usage row (driver thread, from
+        ``_note_usage_terminal``) and accrue the gather cost against the
+        adapter's OWNER — the requester's row carries the adapter name
+        for visibility, but the gather seconds land on the owner's bill
+        (flushed by :meth:`flush_billing`), never on the requester's."""
+        with self._lock:
+            if not 0 <= row_id < self.n_rows:
+                return
+            row = self._rows[row_id]
+            if row.state not in ("live", "evicting") or not row.name:
+                return
+            usage_row["adapter"] = row.name
+            usage_row["adapter_generation"] = row.generation
+            if row.owner:
+                gather = self._gather_frac * max(
+                    0.0, float(usage_row.get("device_time_est_s") or 0.0))
+                bill = self._bills.setdefault(row.owner, [0.0, 0, 0.0])
+                bill[0] += gather
+                bill[1] += 1
+
+    def _flush_row_residency_locked(self, row: _Row) -> None:
+        """Accrue (now - mark) HBM residency-seconds against the row
+        owner's bill; caller holds ``_lock``."""
+        if row.owner and row.residency_mark:
+            now = self._clock()
+            dt = max(0.0, now - row.residency_mark)
+            row.residency_mark = now
+            self._bills.setdefault(row.owner, [0.0, 0, 0.0])[2] += dt
+
+    def flush_billing(self) -> list[dict]:
+        """Flush accrued owner bills as dedicated ``outcome="adapter"``
+        ledger rows (one per owner): residency-seconds for every owned
+        live row plus the accumulated per-request gather estimate.
+        Called by the server's /usage path and at evict/close — billing
+        is additive across flushes (each row carries deltas only)."""
+        with self._lock:
+            for row in self._rows[1:]:
+                if row.state == "live":
+                    self._flush_row_residency_locked(row)
+            bills, self._bills = self._bills, {}
+        rows_out = []
+        for owner, bill in sorted(bills.items()):
+            gather = round(bill[0], 9)
+            residency = round(bill[2], 6)
+            if gather <= 0 and residency <= 0:
+                continue
+            out = {
+                "tenant": owner,
+                "outcome": "adapter",
+                "adapter_gather_est_s": gather,
+                "adapter_residency_s": residency,
+                "adapter_requests": int(bill[1]),
+            }
+            rows_out.append(out)
+            if self.usage_ledger is not None:
+                try:
+                    self.usage_ledger.record(**out)
+                except Exception:  # noqa: BLE001 - billing must not crash
+                    logger.exception("adapter bill flush failed")
+        return rows_out

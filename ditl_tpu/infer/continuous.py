@@ -803,6 +803,11 @@ class ContinuousEngine:
             next(iter(lora.values()))["a"].shape[1] if self.multi_lora else 0
         )
         self.adapters = jnp.zeros((n_slots,), jnp.int32)
+        # Adapter lifecycle plane (ISSUE 16, infer/adapters.py): attached
+        # by AdapterRegistry.bind_engine; annotates terminal usage rows
+        # with the adapter name and bills the gather cost to the OWNING
+        # tenant. None = static stack (or no stack) — zero overhead.
+        self.adapter_registry = None
         # One PRNG stream per slot: per-request seeds stay reproducible no
         # matter which other requests share the batch.
         self.keys = jax.vmap(jax.random.key)(jnp.arange(n_slots, dtype=jnp.uint32))
@@ -3239,11 +3244,15 @@ class ContinuousEngine:
         Idempotent via ``usage_noted`` — cancel racing a lagged pipelined
         harvest must not bill twice."""
         if req.usage_noted or (self.usage is None
-                               and self.usage_ledger is None):
+                               and self.usage_ledger is None
+                               and self.adapter_registry is None):
+            # With ONLY the adapter plane armed the row still gets built:
+            # the owner's gather bill accrues in the registry even when
+            # this replica writes no per-request ledger of its own.
             return
         req.usage_noted = True
         t_now = time.monotonic()
-        self._note_usage_row({
+        row = {
             # req.tenant was sanitized at submit; sanitize again so a
             # directly-constructed Request (tests, embedders) can never
             # leak an unsanitized identifier into the ledger.
@@ -3265,7 +3274,83 @@ class ContinuousEngine:
             "resume_prefill_tokens": req.resume_tokens,
             "e2e_s": round(t_now - req.t_submit, 6) if req.t_submit
             else 0.0,
+        }
+        if req.adapter_id and self.adapter_registry is not None:
+            # Adapter attribution (ISSUE 16): stamp the serving adapter's
+            # name/generation on the requester's row and accumulate the
+            # per-request gather cost against the adapter's OWNER (flushed
+            # as the owner's own ledger rows by the registry) — the
+            # requester pays for tokens, the owner pays for the gather.
+            try:
+                self.adapter_registry.bill_request(req.adapter_id, row)
+            except Exception:  # noqa: BLE001 - billing must not kill serving
+                logger.exception("adapter billing failed (annotation lost)")
+        self._note_usage_row(row)
+
+    # -- adapter hot load/evict seams (ISSUE 16, infer/adapters.py) ----------
+    # Driver-thread-only, like every other mutation of engine/device state:
+    # the registry reaches them through ThreadedEngine.call, so a row swap
+    # lands BETWEEN ticks — an in-flight request never samples a
+    # half-swapped adapter (its slot's adapter id keeps pointing at the
+    # old, still-intact row until the registry's drain frees it).
+
+    def install_adapter(self, row: int, tree: dict) -> None:
+        """Overwrite pool row ``row`` of the stacked adapter leaves with
+        ``tree`` (a single-adapter {target: {a, b}} host tree). Purely a
+        functional ``.at[:, row].set`` per leaf — params are never donated
+        to the compiled programs, so the next tick simply reads the new
+        arrays; no recompile (shapes unchanged), no restart."""
+        if not self.multi_lora:
+            raise ValueError("engine does not serve a multi-adapter stack")
+        if not 1 <= row < self.n_adapters:
+            raise ValueError(
+                f"adapter row {row} out of range [1, {self.n_adapters})"
+                " (row 0 is the base model)")
+        lora = self.params["layers"]["lora"]
+        new = {}
+        for target, leaves in lora.items():
+            if target not in tree:
+                raise ValueError(f"adapter tree missing target {target!r}")
+            new[target] = {}
+            for leaf, stacked in leaves.items():
+                arr = jnp.asarray(tree[target][leaf], stacked.dtype)
+                if arr.shape != stacked.shape[:1] + stacked.shape[2:]:
+                    raise ValueError(
+                        f"adapter leaf {target}.{leaf} shape {arr.shape} "
+                        f"!= pool row shape "
+                        f"{stacked.shape[:1] + stacked.shape[2:]}")
+                new[target][leaf] = stacked.at[:, row].set(arr)
+        self.params["layers"]["lora"] = new
+
+    def clear_adapter(self, row: int) -> None:
+        """Zero pool row ``row`` (== the base model's delta): an evicted
+        row must not keep serving stale weights if a future bug ever lets
+        an id reach it without an install."""
+        self.install_adapter(row, {
+            target: {leaf: jnp.zeros(
+                stacked.shape[:1] + stacked.shape[2:], stacked.dtype)
+                for leaf, stacked in leaves.items()}
+            for target, leaves in self.params["layers"]["lora"].items()
         })
+
+    def adapter_row_in_use(self, row: int) -> int:
+        """How many in-flight requests (slots + admission queue) reference
+        pool row ``row`` — the registry's drain predicate before a row is
+        freed or reused."""
+        n = sum(1 for r in self._slots
+                if r is not None and r.adapter_id == row
+                and not (r.finished or r.cancelled))
+        n += sum(1 for r in self._queue if r.adapter_id == row)
+        return n
+
+    def purge_adapter_pages(self, row: int) -> int:
+        """Drop every published prefix-cache page namespaced under pool
+        row ``row`` (paged mode publishes under ``root=-adapter_id``):
+        after an evict/reinstall, stale KV computed under the old weights
+        must never prefix-match a request on the row's next occupant."""
+        if self.cache_mode == "paged":
+            return self.allocator.purge_root(-row)
+        return 0
 
     def _expire(self, req: Request) -> None:
         """Terminal bookkeeping for a deadline eviction: the request
@@ -4551,6 +4636,18 @@ class ThreadedEngine:
     def multi_lora(self) -> bool:
         """True when the engine serves a multi-adapter LoRA stack."""
         return self._engine.multi_lora
+
+    @property
+    def n_adapters(self) -> int:
+        """Rows in the stacked adapter pool (0 = no stack; row 0 is the
+        base model) — the capacity the adapter registry manages."""
+        return self._engine.n_adapters
+
+    @property
+    def adapter_registry(self):
+        """The attached adapter lifecycle registry (infer/adapters.py,
+        ISSUE 16); None until AdapterRegistry.bind_engine."""
+        return self._engine.adapter_registry
 
     def _wait_one_locked(self, rid: int) -> Request:
         while rid not in self._results:

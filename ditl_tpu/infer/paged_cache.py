@@ -237,6 +237,23 @@ class PageAllocator:
         else:
             self.release(pid)  # the cache's own reference
 
+    def purge_root(self, root: int) -> int:
+        """Unpublish every chain published under content root ``root``
+        (non-positive adapter namespace, see ``publish_chain``) — the
+        adapter-evict seam (ISSUE 16): a freed pool row's published pages
+        would otherwise prefix-match a future adapter installed into the
+        same row and serve KV computed under the OLD weights. In-flight
+        users keep their refcounts (only matchability and the cache ref
+        go — the registry drains the row before calling this anyway).
+        Returns the number of first-level chains purged."""
+        purged = 0
+        for key in list(self._children.get(root, ())):
+            pid = self._key_to_page.get(key)
+            if pid is not None:
+                self._unpublish(key, pid, claimed=False)
+                purged += 1
+        return purged
+
     def retain(self, pid: int) -> None:
         if self._ref[pid] == 1 and pid in self._page_key:
             self._evictable -= 1  # published cache-only page gains a user

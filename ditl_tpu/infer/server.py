@@ -345,6 +345,13 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     # default — /usage then 404s (absent != zero usage).
     usage = None
     usage_ledger = None
+    # Adapter plane (ISSUE 16, infer/adapters.py): the LIVE registry —
+    # /v1/adapters lifecycle endpoints, live /v1/models, name->row
+    # resolution under the registry lock (an evicted name 404s with a
+    # reason, never a silent fall-through to base — the launch-frozen
+    # adapter_names dict this replaces could not say "gone"), and the
+    # owner-billing flush on /usage. None => legacy static routing.
+    adapter_registry = None
 
     def log_message(self, *args):  # route through our logger, not stderr
         logger.debug("http: " + args[0], *args[1:])
@@ -516,6 +523,84 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             "--logprobs-k)"}})
         return False, None
 
+    # -- adapter plane (ISSUE 16, infer/adapters.py) -------------------------
+
+    def _resolve_adapter(self, payload: dict):
+        """This request's adapter ids (``[row]``, or None = base).
+
+        The gateway's ``X-Adapter-Name`` pin (tenant->adapter pinning,
+        gateway/admission ``per_tenant``) wins over the payload's model
+        field — the X-SLO-Class precedence. With the registry armed the
+        name resolves against LIVE state and an unknown/evicted name
+        raises :class:`AdapterNotFound` (404 with a reason — never a
+        silent fall-through to base); without it the legacy launch-frozen
+        ``adapter_names`` dict routes and unknown names keep serving base
+        (OpenAI compat: the model field stays advisory on adapters-less
+        servers). Stamps ``self._adapter_fp`` (``adapter:<name>@g<gen>``)
+        for the response's ``system_fingerprint`` — a client diffing two
+        responses can SEE the publication boundary."""
+        self._adapter_fp = None
+        pin = self.headers.get("X-Adapter-Name")
+        name = str(pin or payload.get("model") or "")
+        reg = self.adapter_registry
+        if reg is None:
+            aid = self.adapter_names.get(name)
+            return [aid] if aid is not None else None
+        if not name or name == self.model_name:
+            return None
+        row, generation = reg.resolve(name)  # raises AdapterNotFound
+        self._adapter_fp = f"adapter:{name}@g{generation}"
+        return [row]
+
+    def _adapter_admin(self, payload: dict, op: str) -> None:
+        """POST /v1/adapters/{load,evict,publish}: the hot-lifecycle
+        endpoints. Every refusal maps an :class:`AdapterError` status
+        (404 unknown/evicted, 409 pool-full/busy, 422 failed
+        verification) — reject-don't-drop, with the reason in the body."""
+        from ditl_tpu.infer.adapters import AdapterError
+
+        reg = self.adapter_registry
+        if reg is None:
+            self._send_json(404, {"error": {"message":
+                "adapter plane not armed on this replica (serve a "
+                "multi-LoRA continuous engine: --adapter and/or "
+                "--adapter-pool)"}})
+            return
+        name = str(payload.get("name") or "")
+        if not name:
+            self._send_json(400, {"error": {"message":
+                f"adapter {op} wants a non-empty 'name'"}})
+            return
+        if name == self.model_name:
+            self._send_json(400, {"error": {"message":
+                f"{name!r} is the base model name; an adapter cannot "
+                f"shadow it"}})
+            return
+        try:
+            if op == "evict":
+                out = reg.evict(name)
+            else:
+                directory = str(payload.get("dir")
+                                or payload.get("directory") or "")
+                if not directory:
+                    self._send_json(400, {"error": {"message":
+                        f"adapter {op} wants 'dir' (a manifest-carrying "
+                        f"adapter checkpoint directory or its parent "
+                        f"with a LATEST pointer)"}})
+                    return
+                # The OWNER the row bills to: an explicit payload owner
+                # (the gateway's publication fan-out forwards the
+                # publisher's label) else the caller's own tenant label.
+                owner = str(payload.get("owner") or "") or self._tenant_label()
+                fn = reg.publish if op == "publish" else reg.load
+                out = fn(name, directory, owner=owner)
+            self._send_json(200, out)
+        except AdapterError as e:
+            self._send_json(e.status, {"error": {"message": str(e)}})
+        except Exception as e:  # noqa: BLE001 - admin errors become JSON
+            logger.exception("adapter %s %r failed", op, name)
+            self._send_json(500, {"error": {"message": str(e)}})
+
     def do_GET(self):
         self._rid = None  # fresh id per request on keep-alive connections
         if self.path in ("/health", "/v1/health"):
@@ -569,11 +654,31 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     stats["speculative_acceptance"] = round(acc, 3)
             self._send_json(200, stats)
         elif self.path in ("/v1/models", "/models"):
+            # With the adapter plane armed, the list is the REGISTRY's
+            # live state (one locked snapshot) — a hot-loaded adapter
+            # appears, an evicted one disappears; the launch-frozen
+            # adapter_names dict routes only on adapters-less servers.
+            if self.adapter_registry is not None:
+                names = sorted(self.adapter_registry.names())
+            else:
+                names = list(self.adapter_names)
             models = [{"id": self.model_name, "object": "model"}] + [
                 {"id": name, "object": "model", "parent": self.model_name}
-                for name in self.adapter_names
+                for name in names
             ]
             self._send_json(200, {"object": "list", "data": models})
+        elif self.path in ("/v1/adapters", "/adapters"):
+            # Adapter-plane listing (ISSUE 16): pool occupancy + every
+            # live binding (name/row/generation/step/owner) + evicted
+            # tombstones. 404 when unarmed — distinguishable from an
+            # armed, empty pool.
+            if self.adapter_registry is None:
+                self._send_json(404, {"error": {"message":
+                    "adapter plane not armed on this replica (serve a "
+                    "multi-LoRA continuous engine: --adapter and/or "
+                    "--adapter-pool)"}})
+            else:
+                self._send_json(200, self.adapter_registry.list())
         elif self.path == "/metrics":
             self._metrics()
         elif self.path in ("/slo", "/v1/slo"):
@@ -590,6 +695,12 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             # in-memory view — what the gateway's /usage fan-out
             # aggregates fleet-wide. 404 when metering is unarmed so an
             # aggregator can tell "no usage" from "not metering".
+            if self.adapter_registry is not None and self.usage is not None:
+                # Flush accrued adapter owner bills (HBM residency +
+                # gather attribution, ISSUE 16) so the rollup below
+                # carries them; the same rows land in the ledger sink.
+                for row in self.adapter_registry.flush_billing():
+                    self.usage.note_terminal(row)
             if self.usage is None:
                 self._send_json(404, {"error": {"message":
                     "usage metering is not armed on this replica"}})
@@ -685,6 +796,7 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
 
     def do_POST(self):
         self._rid = None  # fresh id per request on keep-alive connections
+        self._adapter_fp = None  # set by _resolve_adapter per request
         try:
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length)
@@ -708,6 +820,9 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             return
         if path.endswith("/internal/prefill"):
             self._internal_prefill(payload)
+        elif path.endswith(("/adapters/load", "/adapters/evict",
+                            "/adapters/publish")):
+            self._adapter_admin(payload, path.rsplit("/", 1)[1])
         elif path.endswith(("/chat/completions", "/completions", "/embeddings")):
             self._device_work(payload, path)
         elif path.endswith("/tokenize"):
@@ -1063,6 +1178,10 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             "object": "chat.completion" if chat else "text_completion",
             "created": int(t0),
             "model": payload.get("model") or self.model_name,
+            # Which adapter GENERATION served (adapter plane, ISSUE 16):
+            # a publication's flip is visible as this value changing.
+            **({"system_fingerprint": self._adapter_fp}
+               if getattr(self, "_adapter_fp", None) else {}),
             "choices": choices,
             "usage": {
                 "prompt_tokens": n_prompt,
@@ -1266,8 +1385,11 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 choice = {"index": 0, "text": text, "finish_reason": finish}
             if logprobs is not None:
                 choice["logprobs"] = logprobs
-            return {"id": cmpl_id, "object": kind, "created": created,
-                    "model": model, "choices": [choice]}
+            out = {"id": cmpl_id, "object": kind, "created": created,
+                   "model": model, "choices": [choice]}
+            if getattr(self, "_adapter_fp", None):
+                out["system_fingerprint"] = self._adapter_fp
+            return out
 
         # Submit eagerly, BEFORE the SSE headers go out: stream_one reserves
         # the queue slot here, so QueueFullError still becomes an HTTP 429
@@ -1495,10 +1617,20 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             except ValueError as e:
                 self._send_json(400, {"error": {"message": str(e)}})
                 return
-            # Multi-LoRA routing: the OpenAI "model" field selects an
-            # adapter by name; unknown/absent names serve the base (slot 0).
-            aid = self.adapter_names.get(str(payload.get("model") or ""))
-            adapter_ids = [aid] if aid is not None else None
+            # Multi-LoRA routing: the OpenAI "model" field (or the
+            # gateway's X-Adapter-Name tenant pin) selects an adapter by
+            # name. Registry-armed servers resolve LIVE (unknown/evicted
+            # names 404 with a reason); legacy static servers keep serving
+            # base for unknown names.
+            from ditl_tpu.infer.adapters import AdapterNotFound
+
+            try:
+                adapter_ids = self._resolve_adapter(payload)
+            except AdapterNotFound as e:
+                self._send_json(e.status, {"error": {
+                    "message": str(e), "type": "invalid_request_error",
+                    "param": "model", "code": "model_not_found"}})
+                return
             try:
                 grammar = self._resolve_grammar(payload)
             except ValueError as e:
@@ -1835,6 +1967,10 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     "object": kind,
                     "created": int(t0),
                     "model": payload.get("model") or self.model_name,
+                    # Which adapter GENERATION served (ISSUE 16): a
+                    # publication's flip is visible as this changing.
+                    **({"system_fingerprint": self._adapter_fp}
+                       if getattr(self, "_adapter_fp", None) else {}),
                     "choices": [choice],
                     "usage": {
                         "prompt_tokens": n_prompt,
@@ -1908,6 +2044,8 @@ def make_server(
     kv_handoff: bool = False,
     usage=None,
     usage_ledger=None,
+    adapter_registry=None,
+    adapter_drain_timeout_s: float = 30.0,
 ) -> DrainableHTTPServer:
     """Build (not start) the HTTP server — tests drive it on a thread.
     Pass ``threaded_engine`` (infer/continuous.ThreadedEngine) to serve with
@@ -1959,6 +2097,27 @@ def make_server(
         # scrapes, so an unscraped server pays nothing.
         kw = telemetry.serving_slo_kwargs() if telemetry is not None else {}
         slo = serving_slo(serving_metrics, **kw)
+    # Adapter plane (ISSUE 16): auto-arm the registry whenever a
+    # multi-LoRA THREADED continuous engine serves (hasattr call = the
+    # driver-thread seam exists; the pod driver is excluded on purpose —
+    # a hot install on process 0 alone would desync the replicated
+    # schedulers, so pod fleets keep the rolling-restart path). Launch
+    # adapters seed the registry so /v1/adapters and eviction cover them.
+    if (adapter_registry is None and threaded_engine is not None
+            and getattr(threaded_engine, "multi_lora", False)
+            and hasattr(threaded_engine, "call")):
+        from ditl_tpu.infer.adapters import AdapterRegistry
+
+        inner = getattr(threaded_engine, "_engine", threaded_engine)
+        adapter_registry = AdapterRegistry(
+            threaded_engine,
+            journal=getattr(tracer, "journal", None),
+            usage_ledger=usage_ledger
+            or getattr(inner, "usage_ledger", None),
+            drain_timeout_s=adapter_drain_timeout_s,
+        )
+        for name, row in (adapter_names or {}).items():
+            adapter_registry.seed(name, row)
     handler = type(
         "BoundHandler",
         (_Handler,),
@@ -1982,6 +2141,7 @@ def make_server(
             "kv_handoff_enabled": kv_handoff,
             "usage": usage,
             "usage_ledger": usage_ledger,
+            "adapter_registry": adapter_registry,
         },
     )
     server = DrainableHTTPServer((host, port), handler)
@@ -2148,6 +2308,16 @@ def serve(argv: list[str] | None = None) -> int:
         help="multi-LoRA serving (repeatable): load the LoRA adapters from "
         "an Orbax checkpoint dir; requests with \"model\": NAME use that "
         "adapter, any other model name serves the base weights",
+    )
+    parser.add_argument(
+        "--adapter-pool", type=int, default=0,
+        help="adapter plane (ISSUE 16, --engine continuous): reserve this "
+        "many EXTRA zeroed rows in the stacked adapter pool for hot "
+        "loads — POST /v1/adapters/load installs manifest-verified "
+        "adapter checkpoints into free rows at runtime (no restart), "
+        "/v1/adapters/evict drains and frees them. Composes with "
+        "--adapter (launch adapters seed the registry); without it, "
+        "needs a LoRA-capable config (model.lora_rank > 0)",
     )
     parser.add_argument(
         "--mesh", default="",
@@ -2421,10 +2591,19 @@ def serve(argv: list[str] | None = None) -> int:
             logger.info("restored params from %s", args.checkpoint_dir)
         ckpt.close()
     adapter_names: dict[str, int] = {}
-    if args.adapter:
+    if args.adapter_pool < 0:
+        parser.error("--adapter-pool must be >= 0")
+    if args.adapter_pool and (args.engine != "continuous" or args.pod):
+        # Hot loads ride the ThreadedEngine.call driver seam; the lockstep
+        # path has no driver thread and a pod install on process 0 alone
+        # would desync the replicated schedulers.
+        parser.error("--adapter-pool requires --engine continuous without "
+                     "--pod (hot loads ride the driver-thread seam)")
+    if args.adapter or args.adapter_pool:
         if cfg.lora_rank <= 0:
-            parser.error("--adapter needs a LoRA-capable config (a preset/"
-                         "checkpoint with model.lora_rank > 0)")
+            parser.error("--adapter/--adapter-pool need a LoRA-capable "
+                         "config (a preset/checkpoint with "
+                         "model.lora_rank > 0)")
         if args.quantize == "int8":
             parser.error("--adapter does not compose with --quantize "
                          "(adapters stay float; merge instead to quantize)")
@@ -2461,6 +2640,11 @@ def serve(argv: list[str] | None = None) -> int:
                 parser.error(f"--adapter {name}: checkpoint has no LoRA tree")
             stacks.append(adapter)
             adapter_names[name] = len(stacks) - 1
+        # Hot-load pool (ISSUE 16): extra zeroed rows the adapter
+        # registry fills at runtime — a zeros row serves exactly base
+        # until /v1/adapters/load installs something into it.
+        for _ in range(args.adapter_pool):
+            stacks.append(lora_mod.zeros_adapter(cfg))
         params = {
             **params,
             "layers": {
@@ -2469,8 +2653,10 @@ def serve(argv: list[str] | None = None) -> int:
             },
         }
         logger.info(
-            "multi-LoRA serving: base + %d adapters (%s)",
-            len(adapter_names), ", ".join(adapter_names),
+            "multi-LoRA serving: base + %d adapters (%s)%s",
+            len(adapter_names), ", ".join(adapter_names) or "-",
+            f" + {args.adapter_pool} free pool rows"
+            if args.adapter_pool else "",
         )
     if args.quantize == "int8":
         from ditl_tpu.ops.quant import quantize_weights

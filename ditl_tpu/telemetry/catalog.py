@@ -94,6 +94,18 @@ _ROWS: tuple = (
     # gateway scrape surface — optional by construction. Found by the
     # static metric-catalog pass (ISSUE 11): the live drift guard only
     # sees scrapeable surfaces, so these had silently escaped the catalog.
+    # Adapter plane (ISSUE 16): registry families live on multi-LoRA
+    # serving replicas (infer/adapters.py), publish families on the
+    # gateway (gateway/publish.py) — optional on every other surface.
+    ("ditl_adapter_evictions_total", "counter", "", "adapter rows evicted, drained, and freed back to the pool", True),
+    ("ditl_adapter_load_failures_total", "counter", "", "adapter loads refused (verification/geometry/pool exhaustion) or lost to injected faults", True),
+    ("ditl_adapter_loads_total", "counter", "", "adapter hot loads committed into stacked pool rows (publications included)", True),
+    ("ditl_adapter_publish_fallbacks_total", "counter", "", "fleet publications aborted mid-walk (chaos/crash) - straggler replicas keep the old adapter until a re-publish converges them", True),
+    ("ditl_adapter_publish_hops_failed_total", "counter", "", "per-replica publication hops that failed (the replica kept its previous adapter)", True),
+    ("ditl_adapter_publishes_total", "counter", "", "fleet-wide adapter publications the gateway coordinated (any outcome)", True),
+    ("ditl_adapter_rows", "gauge", "", "stacked pool rows the registry manages (excluding base row 0)", True),
+    ("ditl_adapter_rows_live", "gauge", "", "stacked pool rows currently serving a named adapter", True),
+    ("ditl_adapter_swap_seconds", "histogram", "", "hot load/publish swap latency (verify -> install -> row live)", True),
     ("ditl_client_deadline_exhausted_total", "counter", "", "remote-LLM calls aborted by the total_timeout_s wall-clock bound", True),
     ("ditl_client_requests_total", "counter", "", "remote-LLM logical calls started", True),
     ("ditl_client_retries_total", "counter", "", "remote-LLM HTTP attempts retried (429/5xx/connection errors)", True),
@@ -279,6 +291,7 @@ _ROWS: tuple = (
     ("ditl_usage_requests_429_total", "counter", "", "terminal requests metered with outcome 429", True),
     ("ditl_usage_requests_503_total", "counter", "", "terminal requests metered with outcome 503", True),
     ("ditl_usage_requests_504_total", "counter", "", "terminal requests metered with outcome 504", True),
+    ("ditl_usage_requests_adapter_total", "counter", "", "adapter-plane owner-billing flush rows (HBM residency + gather attribution; no client request behind them)", True),
     ("ditl_usage_requests_cancel_total", "counter", "", "terminal requests metered with outcome cancel", True),
     ("ditl_usage_requests_other_total", "counter", "", "terminal requests metered with an out-of-vocabulary outcome", True),
     ("ditl_usage_requests_total", "counter", "", "terminal requests metered by the per-tenant usage meter (ISSUE 15)", True),

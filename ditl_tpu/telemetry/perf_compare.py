@@ -99,6 +99,15 @@ COMPARE_KEYS = {
     # tempted to turn billing off under load.
     "gateway_rps_metered": +1,
     "metering_overhead_ratio": -1,
+    # Adapter plane keys (ISSUE 16, bench --serve-multi-lora rows' hoisted
+    # `adapters` block): the fractional throughput cost of serving through
+    # the stacked adapter gather (vs the base-only A/B leg) regresses when
+    # it rises — multi-tenant LoRA is only viable while the per-request
+    # gather tax stays a few percent; and the p95 hot-swap wall (verify ->
+    # install -> flip) regresses when it rises — a slow swap stretches the
+    # window where a publication holds a spare row.
+    "adapter_gather_overhead_ratio": -1,
+    "adapter_swap_p95_s": -1,
 }
 
 
@@ -107,14 +116,15 @@ def _flat(rec: dict) -> dict:
     nested ``roofline`` (train rows), ``serving`` (serve rows),
     ``autoscale`` (trace-replay rows), ``kv_handoff`` (handoff-armed
     gateway rows, ISSUE 13), and ``gateway_overhead`` (stub-fleet
-    overhead rows, ISSUE 14), and ``usage_metering`` (metering-armed
-    overhead rows, ISSUE 15) blocks hoisted — without the hoist the gate
+    overhead rows, ISSUE 14), ``usage_metering`` (metering-armed
+    overhead rows, ISSUE 15), and ``adapters`` (multi-LoRA serving rows,
+    ISSUE 16) blocks hoisted — without the hoist the gate
     would silently never compare cost-counted MFU, the serving scheduler
     metrics, the replica-seconds the autoscaler A/B is graded on, the
     handoff fallback ratio, or the gateway's own per-request tax."""
     out = rec
     for block in ("roofline", "serving", "autoscale", "kv_handoff",
-                  "gateway_overhead", "usage_metering"):
+                  "gateway_overhead", "usage_metering", "adapters"):
         nested = rec.get(block)
         if isinstance(nested, dict):
             out = {**nested, **out}

@@ -71,8 +71,10 @@ LEDGER_EVENT = "usage.request"
 # Terminal outcomes a row may carry. Fixed vocabulary on purpose: outcome
 # counters become metric families, and families must be bounded. Engine
 # rows use 200/429/504/cancel; gateway-edge rows additionally use 503
-# (no live replica) — anything else folds into "other".
-OUTCOMES = ("200", "429", "503", "504", "cancel")
+# (no live replica); "adapter" rows are the adapter plane's owner-billing
+# flushes (infer/adapters.py — HBM residency + gather attribution, no
+# request behind them) — anything else folds into "other".
+OUTCOMES = ("200", "429", "503", "504", "cancel", "adapter")
 
 # Numeric row fields the rollup sums per tenant (absent fields count 0, so
 # gateway-side rows — which carry only estimates — aggregate next to
@@ -89,6 +91,12 @@ _SUM_FIELDS = (
     "interference_absorbed_s",
     "preemptions",
     "resume_prefill_tokens",
+    # Adapter-plane owner billing (outcome="adapter" flush rows,
+    # infer/adapters.py): estimated gather device-seconds + HBM pool-row
+    # residency-seconds + the request count behind the gather estimate.
+    "adapter_gather_est_s",
+    "adapter_residency_s",
+    "adapter_requests",
 )
 
 

@@ -1,0 +1,79 @@
+"""Adapter-only checkpoint export (ISSUE 16): the trainer half of the
+train->serve publication protocol.
+
+A LoRA fine-tune's publishable state is just the adapter leaves — a few MB
+next to the frozen base — so publication does NOT ride the full orbax
+checkpoint: :func:`export_adapter` device_gets only the ``lora`` subtree
+and commits it in the :mod:`ditl_tpu.utils.adapterfmt` layout (npz + meta
++ PR 5-style crc manifest, manifest last, atomic ``LATEST`` pointer). A
+gateway publisher polling ``<publish_dir>/<name>/LATEST`` then verifies
+and fans the version out to a live fleet (gateway/publish.py) with no
+restart and no torn reads: a SIGKILL mid-export leaves either the old
+LATEST or a complete new version.
+
+Wired into the train loop via ``adapter.publish_dir`` /
+``adapter.publish_every`` (config.AdapterConfig); callable directly for
+offline export of any params tree that carries a lora subtree.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+
+from ditl_tpu.utils import adapterfmt
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["export_adapter", "lora_host_arrays"]
+
+
+def lora_host_arrays(params: dict[str, Any]) -> dict[str, Any]:
+    """The flat ``target.leaf`` -> host ndarray view of a params tree's
+    adapter leaves (single-adapter (L, d, r) trees only — a stacked
+    serving pool is not a publishable training artifact)."""
+    lora = (params.get("layers") or {}).get("lora")
+    if not lora:
+        raise ValueError("params tree carries no layers/lora subtree")
+    flat: dict[str, Any] = {}
+    for target in sorted(lora):
+        for leaf in sorted(lora[target]):
+            arr = lora[target][leaf]
+            if getattr(arr, "ndim", 0) != 3:
+                raise ValueError(
+                    f"lora leaf {target}.{leaf} has ndim "
+                    f"{getattr(arr, 'ndim', None)}, want 3 (L, ., .) — "
+                    f"stacked multi-adapter trees are a serving artifact, "
+                    f"not an exportable adapter")
+            flat[f"{target}.{leaf}"] = arr
+    import numpy as np
+
+    return {k: np.asarray(v) for k, v in
+            zip(flat, jax.device_get(list(flat.values())))}
+
+
+def export_adapter(publish_dir: str, name: str, step: int,
+                   params: dict[str, Any], cfg) -> str:
+    """Commit ``params``' adapter leaves as version
+    ``<publish_dir>/<name>/step_<N>`` and flip the ``LATEST`` pointer.
+    Returns the committed version dir."""
+    arrays = lora_host_arrays(params)
+    root = os.path.join(publish_dir, name)
+    version = os.path.join(root, f"step_{int(step):08d}")
+    adapterfmt.write_adapter_dir(
+        version, name=name, step=step, arrays=arrays,
+        meta={
+            "lora_rank": cfg.lora_rank,
+            "lora_alpha": cfg.lora_alpha,
+            "targets": sorted({k.split(".", 1)[0] for k in arrays}),
+            "hidden_size": cfg.hidden_size,
+            "num_layers": cfg.num_layers,
+            "dtype": str(cfg.param_dtype),
+        },
+    )
+    adapterfmt.write_latest(root, version)
+    logger.info("exported adapter %s step %d -> %s", name, step, version)
+    return version

@@ -192,6 +192,21 @@ def train(config: Config) -> dict[str, Any]:
     )
     mesh = build_mesh(config.mesh)
     model_cfg = config.model  # preset resolution happens in launch.build_config
+    # Adapter publication (ISSUE 16): misconfiguration fails HERE, before
+    # any compile — a publish cadence with nowhere to write (or no LoRA to
+    # slice out) would otherwise surface as a mid-run crash at the first
+    # cadence crossing.
+    if config.adapter.publish_every > 0:
+        if not config.adapter.publish_dir:
+            raise ValueError(
+                "adapter.publish_every is set but adapter.publish_dir is "
+                "empty: the trainer has nowhere to commit adapter "
+                "checkpoints")
+        if model_cfg.lora_rank <= 0:
+            raise ValueError(
+                "adapter.publish_every needs model.lora_rank > 0: "
+                "adapter-only publication exports the LoRA slice of the "
+                "params, and a full fine-tune has none")
 
     tokenizer = get_tokenizer(config.data.tokenizer)
     if model_cfg.vocab_size < tokenizer.vocab_size:
@@ -583,6 +598,28 @@ def train(config: Config) -> dict[str, Any]:
                     if journal is not None:
                         journal.event("checkpoint.save", step=global_step)
                     last_saved = global_step
+                if is_coordinator() and _crossed(
+                    global_step, len(window), config.adapter.publish_every
+                ):
+                    # Live train->serve publication (ISSUE 16): commit the
+                    # LoRA-only slice as a manifest-verified adapter
+                    # checkpoint (npz + crc manifest written LAST + atomic
+                    # LATEST flip) — the unit gateway/publish.py verifies
+                    # and walks onto a serving fleet. LoRA leaves are tiny
+                    # and replicated, so only the coordinator writes; the
+                    # wall rides the checkpoint_save goodput bucket.
+                    from ditl_tpu.train.adapter_export import export_adapter
+
+                    with tracker.span("checkpoint_save"):
+                        vdir = export_adapter(
+                            config.adapter.publish_dir,
+                            config.adapter.publish_name,
+                            global_step, state.params, model_cfg,
+                        )
+                    if journal is not None:
+                        journal.event("adapter.export", step=global_step,
+                                      directory=vdir)
+                    logger.info("published adapter checkpoint %s", vdir)
                 if val_batches is not None and _crossed(
                     global_step, len(window), config.train.val_every
                 ):

@@ -1,0 +1,195 @@
+"""Adapter-only checkpoint format (ISSUE 16): the on-disk contract between
+the trainer (writer, train/adapter_export.py), the serving registry
+(loader, infer/adapters.py), and the gateway publisher (verifier,
+gateway/publish.py).
+
+One directory per published adapter version:
+
+    <dir>/adapter.npz        flat ``<target>.a`` / ``<target>.b`` arrays,
+                             each (L, d, r) / (L, r, f) — the SAME leaf
+                             shapes ``models/lora.init_lora_params`` emits
+    <dir>/adapter_meta.json  name/step/geometry (rank, alpha, targets,
+                             hidden/layer dims, dtype) — verified against
+                             the serving model BEFORE any bytes reach HBM
+    <dir>/ditl_manifest.json the PR 5 checkpoint manifest ({"step": N,
+                             "files": {rel: {size, crc32}}}), written
+                             LAST via tmp+rename: its presence commits
+                             the version, its absence (or any size/crc
+                             mismatch) marks it torn
+
+and an atomic ``LATEST`` pointer file next to the version dirs so a
+publisher polling ``<root>/<name>/LATEST`` never reads a half-written
+step directory.
+
+Deliberately stdlib+numpy only (no jax anywhere): the gateway publisher
+verifies checkpoints from inside a jax-free zone (the import-layering
+analysis rule), and the loader wants to crc the EXACT bytes it will ship
+to the device, which means hashing host buffers, not traced arrays.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import zlib
+
+__all__ = [
+    "ADAPTER_FILE",
+    "LATEST_NAME",
+    "MANIFEST_NAME",
+    "META_NAME",
+    "file_crc32",
+    "read_meta",
+    "resolve_latest",
+    "verify_and_read",
+    "verify_dir",
+    "write_adapter_dir",
+    "write_latest",
+]
+
+# Mirrors train/checkpoint.MANIFEST_NAME (that module imports orbax/jax at
+# module level; this one must stay importable from the jax-free zones).
+MANIFEST_NAME = "ditl_manifest.json"
+META_NAME = "adapter_meta.json"
+ADAPTER_FILE = "adapter.npz"
+LATEST_NAME = "LATEST"
+
+
+def file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def write_adapter_dir(directory: str, *, name: str, step: int,
+                      arrays: dict, meta: dict) -> str:
+    """Commit one adapter version: npz + meta, then the manifest LAST
+    (tmp+rename) — a crash at any point leaves either a complete verified
+    version or one :func:`verify_dir` rejects. ``arrays`` maps flat
+    ``target.leaf`` keys to numpy arrays; ``meta`` carries the geometry
+    (merged over name/step here)."""
+    import numpy as np
+
+    os.makedirs(directory, exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    _atomic_write(os.path.join(directory, ADAPTER_FILE), buf.getvalue())
+    meta_bytes = json.dumps(
+        {**meta, "name": name, "step": int(step)},
+        indent=2, sort_keys=True,
+    ).encode()
+    _atomic_write(os.path.join(directory, META_NAME), meta_bytes)
+    files = {
+        rel: {
+            "size": os.path.getsize(os.path.join(directory, rel)),
+            "crc32": file_crc32(os.path.join(directory, rel)),
+        }
+        for rel in (ADAPTER_FILE, META_NAME)
+    }
+    _atomic_write(
+        os.path.join(directory, MANIFEST_NAME),
+        json.dumps({"step": int(step), "files": files},
+                   indent=2, sort_keys=True).encode(),
+    )
+    return directory
+
+
+def write_latest(root: str, version_dir: str) -> None:
+    """Atomically point ``<root>/LATEST`` at ``version_dir`` (stored
+    relative when possible so the tree can be moved/mounted elsewhere)."""
+    rel = os.path.relpath(version_dir, root)
+    target = version_dir if rel.startswith("..") else rel
+    _atomic_write(os.path.join(root, LATEST_NAME),
+                  (target + "\n").encode())
+
+
+def resolve_latest(path: str) -> str:
+    """Follow a ``LATEST`` pointer if ``path`` carries one; otherwise
+    ``path`` itself is the version dir."""
+    latest = os.path.join(path, LATEST_NAME)
+    if os.path.isfile(latest):
+        with open(latest) as f:
+            target = f.read().strip()
+        if target:
+            return target if os.path.isabs(target) \
+                else os.path.join(path, target)
+    return path
+
+
+def read_meta(directory: str) -> dict:
+    with open(os.path.join(directory, META_NAME)) as f:
+        meta = json.load(f)
+    if not isinstance(meta, dict):
+        raise ValueError(f"adapter meta is not an object: {directory}")
+    return meta
+
+
+def verify_dir(directory: str) -> tuple[str, str]:
+    """``("verified", "")`` when the manifest exists and every listed file
+    matches its recorded size AND crc32; otherwise ``("corrupt", why)``
+    (missing manifest counts as corrupt: an adapter version is only
+    committed once its manifest lands — the PR 5 torn-save rule)."""
+    manifest_path = os.path.join(directory, MANIFEST_NAME)
+    if not os.path.isfile(manifest_path):
+        return "corrupt", f"no {MANIFEST_NAME} (torn or foreign dir)"
+    try:
+        with open(manifest_path) as f:
+            manifest = json.load(f)
+        files = manifest["files"]
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return "corrupt", f"unreadable manifest: {e}"
+    for rel, want in sorted(files.items()):
+        path = os.path.join(directory, rel)
+        if not os.path.isfile(path):
+            return "corrupt", f"missing {rel}"
+        size = os.path.getsize(path)
+        if size != want.get("size"):
+            return "corrupt", (
+                f"{rel}: size {size} != manifest {want.get('size')}")
+        crc = file_crc32(path)
+        if crc != want.get("crc32"):
+            return "corrupt", (
+                f"{rel}: crc32 {crc:#010x} != manifest "
+                f"{int(want.get('crc32', 0)):#010x}")
+    return "verified", ""
+
+
+def verify_and_read(directory: str, *, flip_byte: bool = False) -> dict:
+    """Manifest-verify ``directory`` and return its npz arrays as a dict —
+    crc'd over the EXACT bytes that will be decoded, read once. Raises
+    ``ValueError`` on any mismatch (the caller maps that to a clean load
+    refusal; corrupt bytes must never reach the device). ``flip_byte``
+    is the chaos ``adapter.load:corrupt`` hook: one bit of the adapter
+    payload flips AFTER the disk read, exactly the torn-transfer the crc
+    exists to catch."""
+    import numpy as np
+
+    status, why = verify_dir(directory)
+    if status != "verified":
+        raise ValueError(f"adapter checkpoint {directory}: {why}")
+    with open(os.path.join(directory, MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    want = manifest["files"][ADAPTER_FILE]
+    with open(os.path.join(directory, ADAPTER_FILE), "rb") as f:
+        raw = f.read()
+    if flip_byte and raw:
+        mid = len(raw) // 2
+        raw = raw[:mid] + bytes([raw[mid] ^ 0x40]) + raw[mid + 1:]
+    if len(raw) != want["size"] or zlib.crc32(raw) != want["crc32"]:
+        raise ValueError(
+            f"adapter checkpoint {directory}: {ADAPTER_FILE} bytes do not "
+            f"match the manifest crc (torn write or corrupt transfer)")
+    with np.load(io.BytesIO(raw)) as z:
+        return {k: z[k] for k in z.files}

@@ -1,0 +1,777 @@
+"""Adapter plane (ISSUE 16): per-tenant multi-LoRA serving with hot
+load/evict and live train->serve weight publication.
+
+Three tiers of coverage in one file:
+
+- format/registry units: the adapter checkpoint layout (npz + meta + crc
+  manifest + atomic LATEST), torn-save refusal, and the registry's hot
+  load / re-publication / evict lifecycle over one real stacked-pool
+  engine — including the chaos torn-bytes load and owner-only billing;
+- server endpoints: /v1/adapters lifecycle + live /v1/models on a
+  registry-armed replica, and the reject-don't-drop fallback gating on
+  engines that cannot carry adapter routing (lockstep, pod);
+- THE publication drill (acceptance): a trainer-written adapter-only
+  checkpoint published through the gateway to a live 2-replica fleet
+  UNDER client load — zero client-visible failures, responses flip
+  old->new at a journaled generation boundary, a SIGKILL-equivalent
+  chaos abort mid-publish leaves every replica on a verified adapter
+  (counted fallback, causally-ordered journal chain), and a re-publish
+  converges the straggler.
+
+Engines are module-scoped (compiled once); registries and HTTP fronts
+rebuild per test, so no test depends on another's pool state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import types
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from ditl_tpu.chaos.plane import FaultPlane, arm, disarm
+from ditl_tpu.config import (
+    AdapterConfig,
+    Config,
+    DataConfig,
+    GatewayConfig,
+    ModelConfig,
+    TrainConfig,
+)
+from ditl_tpu.data.tokenizer import ByteTokenizer
+from ditl_tpu.gateway import (
+    Fleet,
+    GatewayMetrics,
+    InProcessReplica,
+    TenantAdmission,
+    make_gateway,
+)
+from ditl_tpu.infer.adapters import (
+    AdapterNotFound,
+    AdapterRegistry,
+    AdapterVerifyError,
+)
+from ditl_tpu.infer.continuous import ContinuousEngine, ThreadedEngine
+from ditl_tpu.infer.engine import GenerateConfig, Generator
+from ditl_tpu.infer.server import make_server
+from ditl_tpu.models import llama
+from ditl_tpu.models.lora import init_lora_params, stack_adapters, zeros_adapter
+from ditl_tpu.telemetry.journal import EventJournal, merge_journals
+from ditl_tpu.train.adapter_export import export_adapter, lora_host_arrays
+from ditl_tpu.utils import adapterfmt
+
+pytestmark = pytest.mark.adapters
+
+
+@pytest.fixture(scope="module")
+def model_setup():
+    cfg = ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        max_seq_len=128, dtype="float32", param_dtype="float32",
+        lora_rank=4,
+    )
+    params = llama.init_params(jax.random.key(0), cfg)
+    return params, cfg, ByteTokenizer()
+
+
+@pytest.fixture(scope="module")
+def adapter_tree(model_setup):
+    """A non-trivial single-adapter tree + the single-adapter params it
+    belongs to — the reference model every routed output is diffed
+    against."""
+    params, cfg, _ = model_setup
+    ad = init_lora_params(jax.random.key(10), cfg)
+    ad = {
+        n: {"a": p["a"],
+            "b": jax.random.normal(jax.random.key(11), p["b"].shape) * 0.05}
+        for n, p in ad.items()
+    }
+    single = {**params, "layers": {**params["layers"], "lora": ad}}
+    return ad, single
+
+
+def _stacked(params, cfg, rows=3):
+    """Base + (rows-1) zeroed pool rows — the serving-side params tree."""
+    return {**params, "layers": {**params["layers"],
+            "lora": stack_adapters([zeros_adapter(cfg)] * rows)}}
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint format (utils/adapterfmt + train/adapter_export)
+# ---------------------------------------------------------------------------
+
+
+def test_export_round_trip_and_latest(tmp_path, model_setup, adapter_tree):
+    _, cfg, _ = model_setup
+    _, single = adapter_tree
+    v3 = export_adapter(str(tmp_path), "ft", 3, single, cfg)
+    v7 = export_adapter(str(tmp_path), "ft", 7, single, cfg)
+    root = str(tmp_path / "ft")
+    # LATEST resolves atomically to the newest committed version; a
+    # version dir resolves to itself.
+    assert adapterfmt.resolve_latest(root) == v7
+    assert adapterfmt.resolve_latest(v3) == v3
+    state, why = adapterfmt.verify_dir(v7)
+    assert state == "verified", why
+    meta = adapterfmt.read_meta(v7)
+    assert meta["step"] == 7 and meta["lora_rank"] == cfg.lora_rank
+    arrays = adapterfmt.verify_and_read(v7)
+    import numpy as np
+
+    want = lora_host_arrays(single)
+    assert set(arrays) == set(want)
+    for key, arr in want.items():
+        np.testing.assert_array_equal(np.asarray(arrays[key]), arr)
+
+
+def test_torn_save_refused(tmp_path, model_setup, adapter_tree):
+    _, cfg, _ = model_setup
+    _, single = adapter_tree
+    vd = export_adapter(str(tmp_path), "ft", 1, single, cfg)
+    # Bit-flip the payload: the manifest crc must catch it.
+    npz = os.path.join(vd, adapterfmt.ADAPTER_FILE)
+    with open(npz, "r+b") as f:
+        f.seek(12)
+        byte = f.read(1)
+        f.seek(12)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    state, why = adapterfmt.verify_dir(vd)
+    assert state == "corrupt" and adapterfmt.ADAPTER_FILE in why
+    # A version with no manifest is a TORN save (killed before the
+    # manifest-last rename) — refused, never half-loaded.
+    torn = str(tmp_path / "ft" / "step_00000002")
+    shutil.copytree(vd, torn)
+    os.remove(os.path.join(torn, adapterfmt.MANIFEST_NAME))
+    state, why = adapterfmt.verify_dir(torn)
+    assert state == "corrupt" and "manifest" in why
+
+
+def test_export_rejects_stacked_tree(model_setup):
+    params, cfg, _ = model_setup
+    with pytest.raises(ValueError, match="stacked"):
+        lora_host_arrays(_stacked(params, cfg))
+
+
+def test_trainer_validates_publish_config():
+    bad = Config(
+        model=ModelConfig(vocab_size=512, hidden_size=64,
+                          intermediate_size=128, num_layers=2, num_heads=4,
+                          num_kv_heads=2, head_dim=16, max_seq_len=64),
+        data=DataConfig(synthetic=True, synthetic_examples=32, batch_size=8,
+                        seq_len=32),
+        train=TrainConfig(total_steps=2, warmup_steps=1),
+        adapter=AdapterConfig(publish_dir="/tmp/x", publish_every=2),
+    )
+    from ditl_tpu.train.trainer import train
+
+    # publish_every without a LoRA-capable model must fail BEFORE compile.
+    with pytest.raises(ValueError, match="lora_rank"):
+        train(bad)
+
+
+# ---------------------------------------------------------------------------
+# Registry lifecycle over one real stacked-pool engine
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pool_engine(model_setup):
+    params, cfg, tok = model_setup
+    eng = ContinuousEngine(_stacked(params, cfg), cfg, tok, n_slots=2,
+                           decode_chunk=4)
+    return eng
+
+
+def test_registry_requires_multi_lora_engine():
+    with pytest.raises(ValueError, match="stacked"):
+        AdapterRegistry(types.SimpleNamespace(multi_lora=False))
+
+
+def test_registry_lifecycle_and_owner_only_billing(
+        tmp_path, model_setup, adapter_tree, pool_engine):
+    params, cfg, tok = model_setup
+    _, single = adapter_tree
+    eng = pool_engine
+    from ditl_tpu.telemetry.usage import (
+        UsageLedger, load_usage, rollup, usage_ledger_path,
+    )
+
+    ledger = UsageLedger(usage_ledger_path(str(tmp_path), "replica"),
+                         source="replica")
+    reg = AdapterRegistry(eng, usage_ledger=ledger)
+    assert reg.list()["free_rows"] == 2
+
+    vd = export_adapter(str(tmp_path), "ad1", 7, single, cfg)
+    binding = reg.load("ad1", str(tmp_path / "ad1"), owner="acme")
+    row, generation = reg.resolve("ad1")
+    assert (row, generation) == (binding["row"], binding["generation"])
+
+    # Hot-loaded output matches the single-adapter reference model.
+    prompt = [tok.bos_id] + tok.encode("hello there")
+    rid = eng.submit(list(prompt), max_new_tokens=8, temperature=0.0,
+                     adapter_id=row)
+    got = eng.run()[rid]
+    ref = Generator(single, cfg, tok).generate_tokens(
+        [prompt], GenerateConfig(max_new_tokens=8))[0]
+    assert got == ref
+
+    # Owner-only billing: the gather estimate and HBM residency accrue to
+    # the adapter's OWNER — the requester's terminal row is annotated
+    # with the adapter name but billed nothing.
+    requester_row = {"tenant": "t_requester", "outcome": "200",
+                     "device_time_est_s": 0.5}
+    reg.bill_request(row, requester_row)
+    assert requester_row["adapter"] == "ad1"
+    assert "adapter_gather_est_s" not in requester_row
+    bills = reg.flush_billing()
+    assert [b["tenant"] for b in bills] == ["acme"]
+    assert bills[0]["adapter_gather_est_s"] > 0
+    assert bills[0]["adapter_residency_s"] > 0
+    assert bills[0]["adapter_requests"] == 2  # engine request + billed row
+    ledger.close()
+    agg = rollup(load_usage(str(tmp_path)))
+    assert agg["acme"]["adapter_gather_est_s"] > 0
+    assert agg["acme"]["adapter_residency_s"] > 0
+    assert "t_requester" not in agg  # never hit the ledger sink
+
+    # Re-publication: new bytes into a SPARE row, generation bumps, the
+    # old row drains and frees — the pool never leaks a row per publish.
+    export_adapter(str(tmp_path), "ad1", 8, single, cfg)
+    b2 = reg.publish("ad1", str(tmp_path / "ad1"), owner="acme")
+    assert b2["generation"] > binding["generation"]
+    assert b2["row"] != binding["row"]
+    assert reg.list()["free_rows"] == 1
+    assert reg.resolve("ad1") == (b2["row"], b2["generation"])
+
+    # Evict -> tombstone: the name 404s, never silently serves base.
+    reg.evict("ad1")
+    with pytest.raises(AdapterNotFound, match="evicted") as exc:
+        reg.resolve("ad1")
+    assert exc.value.evicted
+    assert reg.list()["free_rows"] == 2
+
+    # The evicted row's weights are scrubbed: it serves exactly base.
+    rid = eng.submit(list(prompt), max_new_tokens=8, temperature=0.0,
+                     adapter_id=b2["row"])
+    got = eng.run()[rid]
+    base_ref = Generator(_stacked(params, cfg), cfg, tok).generate_tokens(
+        [prompt], GenerateConfig(max_new_tokens=8), adapter_ids=[0])[0]
+    assert got == base_ref
+
+
+def test_registry_refuses_corrupt_and_chaos_torn_load(
+        tmp_path, model_setup, adapter_tree, pool_engine):
+    _, cfg, _ = model_setup
+    _, single = adapter_tree
+    reg = AdapterRegistry(pool_engine)
+    vd = export_adapter(str(tmp_path), "ad2", 1, single, cfg)
+
+    # Chaos torn-bytes drill (adapter.load is a CORRUPT_SITE): the seam
+    # bit-flips the bytes AFTER the disk read — the crc verify must
+    # refuse cleanly, nothing reaches the device, base keeps serving.
+    arm(FaultPlane(seed=3, rules="adapter.load:corrupt@call=1,max=1"))
+    try:
+        with pytest.raises(AdapterVerifyError):
+            reg.load("ad2", vd, owner="acme")
+    finally:
+        disarm()
+    assert reg.list()["free_rows"] == 2
+
+    # An on-disk corruption is refused the same way.
+    man = os.path.join(vd, adapterfmt.MANIFEST_NAME)
+    with open(man) as f:
+        manifest = json.load(f)
+    manifest["files"][adapterfmt.ADAPTER_FILE]["crc32"] ^= 1
+    with open(man, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(AdapterVerifyError):
+        reg.load("ad2", vd, owner="acme")
+    assert reg.list()["free_rows"] == 2
+    # The clean load afterwards still works: the registry is not wedged.
+    vd2 = export_adapter(str(tmp_path), "ad3", 1, single, cfg)
+    reg.load("ad3", vd2, owner="acme")
+    reg.evict("ad3")
+
+
+# ---------------------------------------------------------------------------
+# Server endpoints + fallback gating (satellite: no silent base serving)
+# ---------------------------------------------------------------------------
+
+
+def _req(port, method, path, body=None, headers=None, timeout=60):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method,
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_server_hot_lifecycle_and_live_models(
+        tmp_path, model_setup, adapter_tree):
+    params, cfg, tok = model_setup
+    _, single = adapter_tree
+    te = ThreadedEngine(ContinuousEngine(_stacked(params, cfg), cfg, tok,
+                                         n_slots=2, decode_chunk=4))
+    server = make_server(Generator(_stacked(params, cfg), cfg, tok), port=0,
+                         default_max_tokens=6, model_name="base",
+                         threaded_engine=te)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    try:
+        st, body = _req(port, "GET", "/v1/adapters")
+        assert st == 200 and body["free_rows"] == 2
+
+        # /v1/models is the LIVE registry view, not a launch-frozen dict.
+        st, body = _req(port, "GET", "/v1/models")
+        assert [m["id"] for m in body["data"]] == ["base"]
+        export_adapter(str(tmp_path), "tenant-a-ft", 3, single, cfg)
+        st, body = _req(port, "POST", "/v1/adapters/load",
+                        {"name": "tenant-a-ft",
+                         "dir": str(tmp_path / "tenant-a-ft"),
+                         "owner": "acme"})
+        assert st == 200 and body["generation"] == 1, body
+        st, body = _req(port, "GET", "/v1/models")
+        assert [m["id"] for m in body["data"]] == ["base", "tenant-a-ft"]
+
+        # model field routes; the response names the serving generation.
+        ref = Generator(single, cfg, tok).generate(
+            ["route me"], GenerateConfig(max_new_tokens=6))[0]
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "route me", "max_tokens": 6,
+                         "model": "tenant-a-ft"})
+        assert st == 200 and body["choices"][0]["text"] == ref
+        assert body["system_fingerprint"] == "adapter:tenant-a-ft@g1"
+
+        # The gateway's X-Adapter-Name pin wins over the model field.
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "route me", "max_tokens": 6,
+                         "model": "base"},
+                        headers={"X-Adapter-Name": "tenant-a-ft"})
+        assert st == 200 and body["choices"][0]["text"] == ref
+
+        # Unknown name -> 404 model_not_found (reject, don't serve base).
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "x", "max_tokens": 2, "model": "nope"})
+        assert st == 404 and body["error"]["code"] == "model_not_found"
+
+        # Evict -> the name 404s WITH the eviction reason; base still
+        # serves; a second evict of the same name 404s too.
+        st, body = _req(port, "POST", "/v1/adapters/evict",
+                        {"name": "tenant-a-ft"})
+        assert st == 200 and body["evicted"]
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "x", "max_tokens": 2,
+                         "model": "tenant-a-ft"})
+        assert st == 404 and "evicted" in body["error"]["message"]
+        st, _ = _req(port, "POST", "/v1/adapters/evict",
+                     {"name": "tenant-a-ft"})
+        assert st == 404
+        st, body = _req(port, "GET", "/v1/adapters")
+        assert body["evicted"] == ["tenant-a-ft"] and body["free_rows"] == 2
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "x", "max_tokens": 2, "model": "base"})
+        assert st == 200 and "system_fingerprint" not in body
+
+        # Bad dir -> 422; pool exhaustion -> 409 (reject-don't-drop).
+        st, _ = _req(port, "POST", "/v1/adapters/load",
+                     {"name": "z", "dir": str(tmp_path / "nonexistent")})
+        assert st == 422
+        for name in ("a1", "a2", "a3"):
+            export_adapter(str(tmp_path), name, 1, single, cfg)
+        for name in ("a1", "a2"):
+            st, _ = _req(port, "POST", "/v1/adapters/load",
+                         {"name": name, "dir": str(tmp_path / name)})
+            assert st == 200
+        st, body = _req(port, "POST", "/v1/adapters/load",
+                        {"name": "a3", "dir": str(tmp_path / "a3")})
+        assert st == 409 and "no free adapter rows" in body["error"]["message"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        te.close()
+
+
+def test_lockstep_adapter_fallback_gating(model_setup, adapter_tree):
+    """Adapter requests on a server WITHOUT a multi-LoRA continuous
+    engine serve via the lockstep generator — every feature that path
+    cannot carry is rejected with a reason, never silently dropped."""
+    params, cfg, tok = model_setup
+    server = make_server(Generator(_stacked(params, cfg), cfg, tok), port=0,
+                         default_max_tokens=4, model_name="base",
+                         adapter_names={"ft": 1})
+    assert server.RequestHandlerClass.adapter_registry is None
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    try:
+        # The lockstep fallback itself serves.
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "x", "max_tokens": 2, "model": "ft"})
+        assert st == 200 and "system_fingerprint" not in body
+
+        # Explicit non-default slo_class -> 400 (no class scheduler on
+        # this path); the gateway's best-effort HEADER hint is dropped.
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "x", "max_tokens": 2, "model": "ft",
+                         "slo_class": "batch"})
+        assert st == 400 and "slo_class" in body["error"]["message"]
+        st, _ = _req(port, "POST", "/v1/completions",
+                     {"prompt": "x", "max_tokens": 2, "model": "ft"},
+                     headers={"X-SLO-Class": "batch"})
+        assert st == 200
+
+        # Explicit deadline_s -> 400 (no deadline enforcement here).
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "x", "max_tokens": 2, "model": "ft",
+                         "deadline_s": 1.0})
+        assert st == 400 and "deadline_s" in body["error"]["message"]
+
+        # Streaming logprobs with adapter routing -> 400.
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "x", "max_tokens": 2, "model": "ft",
+                         "stream": True, "logprobs": 1})
+        assert st == 400 and "adapter" in body["error"]["message"]
+
+        # No registry -> the hot-lifecycle endpoints say so (404), they
+        # do not pretend to load.
+        st, body = _req(port, "POST", "/v1/adapters/load",
+                        {"name": "z", "dir": "/tmp/none"})
+        assert st == 404 and "not armed" in body["error"]["message"]
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_pod_driver_excluded_from_hot_plane(model_setup):
+    """The pod driver has no driver-thread `call` seam (a hot install on
+    process 0 alone would desync the replicated schedulers) — make_server
+    must NOT auto-arm the registry for it."""
+    params, cfg, tok = model_setup
+    gen = Generator(params, cfg, tok)
+    pod_like = types.SimpleNamespace(multi_lora=True)  # no .call
+    server = make_server(gen, port=0, threaded_engine=pod_like)
+    try:
+        assert server.RequestHandlerClass.adapter_registry is None
+    finally:
+        server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: live 2-replica fleet, train -> publish -> serve
+# ---------------------------------------------------------------------------
+
+N_REPLICAS = 2
+
+
+@pytest.fixture(scope="module")
+def engine_pool(model_setup):
+    params, cfg, tok = model_setup
+    engines = [
+        ThreadedEngine(ContinuousEngine(
+            _stacked(params, cfg), cfg, tok, n_slots=2, decode_chunk=4,
+            gen=GenerateConfig(max_new_tokens=8), max_queue=64,
+        ))
+        for _ in range(N_REPLICAS)
+    ]
+    yield engines
+    for eng in engines:
+        eng.close()
+
+
+@pytest.fixture()
+def adapter_fleet(tmp_path, model_setup, engine_pool):
+    """2 replicas with journaled registries + a journaled gateway: one
+    directory of events-*.jsonl files merge_journals reads as a single
+    causally-ordered chain."""
+    params, cfg, tok = model_setup
+    jdir = str(tmp_path / "journals")
+    shared_gen = Generator(params, cfg, tok)  # tokenize/metadata only
+    journals = []
+
+    def factory(i):
+        def build():
+            journal = EventJournal(
+                os.path.join(jdir, f"events-r{i}.jsonl"), source=f"r{i}")
+            journals.append(journal)
+            registry = AdapterRegistry(engine_pool[i], journal=journal)
+            return make_server(shared_gen, port=0,
+                               threaded_engine=engine_pool[i],
+                               default_max_tokens=6, model_name="base",
+                               adapter_registry=registry)
+        return build
+
+    fleet = Fleet([InProcessReplica(f"r{i}", factory(i))
+                   for i in range(N_REPLICAS)])
+    fleet.start_all()
+    for rid in fleet.ids:
+        assert fleet.probe(rid, timeout=5.0)
+    gw_journal = EventJournal(os.path.join(jdir, "events-gateway.jsonl"),
+                              source="gateway")
+    journals.append(gw_journal)
+    metrics = GatewayMetrics()
+    server = make_gateway(
+        fleet, config=GatewayConfig(router="round_robin", port=0),
+        metrics=metrics,
+        admission=TenantAdmission(per_tenant={
+            "acme-key": {"adapter": "tenant-a-ft"}}),
+        journal=gw_journal,
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield server.server_address[1], metrics, jdir
+    server.shutdown()
+    server.server_close()
+    fleet.stop_all(drain=False)
+    for journal in journals:
+        journal.close()
+
+
+def _live_generations(port):
+    _, body = _req(port, "GET", "/v1/adapters")
+    return {
+        rid: [r["generation"] for r in snap["adapters"]
+              if r["state"] == "live"]
+        for rid, snap in body["replicas"].items()
+    }
+
+
+def test_fleet_publish_routing_and_tenant_pin(
+        tmp_path, model_setup, adapter_tree, adapter_fleet):
+    _, cfg, tok = model_setup
+    _, single = adapter_tree
+    port, _, _ = adapter_fleet
+    export_adapter(str(tmp_path), "tenant-a-ft", 3, single, cfg)
+
+    st, body = _req(port, "POST", "/v1/adapters/publish",
+                    {"name": "tenant-a-ft",
+                     "dir": str(tmp_path / "tenant-a-ft"), "owner": "acme"})
+    assert st == 200 and body["complete"] and len(body["ok"]) == 2, body
+    assert all(h["generation"] == 1 for h in body["ok"])
+    st, body = _req(port, "GET", "/v1/adapters")
+    assert set(body["replicas"]) == {"r0", "r1"}
+
+    # Round-robin hits both replicas: every routed completion matches the
+    # single-adapter reference and names the serving generation.
+    ref = Generator(single, cfg, tok).generate(
+        ["route me"], GenerateConfig(max_new_tokens=6))[0]
+    for _ in range(4):
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "route me", "max_tokens": 6,
+                         "model": "tenant-a-ft"}, timeout=120)
+        assert st == 200 and body["choices"][0]["text"] == ref
+        assert body["system_fingerprint"] == "adapter:tenant-a-ft@g1"
+
+    # Tenant->adapter pinning: acme-key's bearer rides X-Adapter-Name on
+    # the relay and overrides the payload's model field.
+    st, body = _req(port, "POST", "/v1/completions",
+                    {"prompt": "route me", "max_tokens": 6, "model": "base"},
+                    headers={"Authorization": "Bearer acme-key"},
+                    timeout=120)
+    assert st == 200 and body["choices"][0]["text"] == ref
+    assert body["system_fingerprint"] == "adapter:tenant-a-ft@g1"
+
+    # Fleet-wide evict: the name 404s through the gateway afterwards.
+    st, body = _req(port, "POST", "/v1/adapters/evict",
+                    {"name": "tenant-a-ft"})
+    assert st == 200 and body["complete"]
+    st, _ = _req(port, "POST", "/v1/completions",
+                 {"prompt": "x", "max_tokens": 2, "model": "tenant-a-ft"})
+    assert st == 404
+
+
+@pytest.fixture(scope="module")
+def trained_checkpoints(tmp_path_factory, model_setup):
+    """A REAL train run writing adapter-only checkpoints on its publish
+    cadence — the producer half of the drill."""
+    import dataclasses
+
+    from ditl_tpu.train.trainer import train
+
+    _, cfg, _ = model_setup
+    out = str(tmp_path_factory.mktemp("publish"))
+    config = Config(
+        model=dataclasses.replace(cfg, max_seq_len=64),
+        data=DataConfig(synthetic=True, synthetic_examples=128, batch_size=8,
+                        seq_len=32, num_epochs=4),
+        train=TrainConfig(total_steps=4, warmup_steps=1, log_every=100),
+        adapter=AdapterConfig(publish_dir=out, publish_every=2,
+                              publish_name="night-ft"),
+    )
+    train(config)
+    root = os.path.join(out, "night-ft")
+    versions = sorted(v for v in os.listdir(root) if v.startswith("step_"))
+    assert versions == ["step_00000002", "step_00000004"]
+    assert adapterfmt.resolve_latest(root).endswith("step_00000004")
+    return root
+
+
+def test_publication_drill_under_load(
+        adapter_fleet, trained_checkpoints):
+    """THE acceptance drill: the trainer's checkpoint reaches a live
+    2-replica fleet under client load with zero client-visible failures;
+    responses flip old->new at a journaled generation boundary."""
+    port, _, jdir = adapter_fleet
+    root = trained_checkpoints
+
+    # Old version live fleet-wide first.
+    st, body = _req(port, "POST", "/v1/adapters/publish",
+                    {"name": "night-ft",
+                     "dir": os.path.join(root, "step_00000002"),
+                     "owner": "acme"})
+    assert st == 200 and body["complete"] and body["step"] == 2, body
+
+    results: list[tuple] = []
+    failures: list = []
+    stop = threading.Event()
+
+    def client(idx):
+        i = 0
+        while not stop.is_set() or i < 6:  # keep load across the swap
+            i += 1
+            try:
+                st, body = _req(port, "POST", "/v1/completions",
+                                {"prompt": f"drill {idx}-{i}",
+                                 "max_tokens": 2, "model": "night-ft"},
+                                timeout=120)
+            except Exception as e:  # noqa: BLE001 - recorded, fails below
+                failures.append(repr(e))
+                return
+            results.append((st, body.get("system_fingerprint"),
+                            body.get("error")))
+            if i >= 40:
+                return
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    # Publish LATEST (step 4) mid-load: verify -> spare row -> flip ->
+    # drain-old on each replica while requests stream through it.
+    st, body = _req(port, "POST", "/v1/adapters/publish",
+                    {"name": "night-ft", "dir": root, "owner": "acme"},
+                    timeout=120)
+    stop.set()
+    for t in threads:
+        t.join(timeout=120)
+    assert st == 200 and body["complete"] and body["step"] == 4, body
+
+    # Zero client-visible failures; every response named a VERIFIED
+    # generation — old or new, never torn, never base-by-accident.
+    assert not failures, failures
+    assert results
+    bad = [r for r in results if r[0] != 200]
+    assert not bad, bad
+    fps = {r[1] for r in results}
+    assert fps <= {"adapter:night-ft@g1", "adapter:night-ft@g2"}, fps
+
+    # The fleet converged on generation 2; subsequent responses serve it.
+    assert _live_generations(port) == {"r0": [2], "r1": [2]}
+    st, body = _req(port, "POST", "/v1/completions",
+                    {"prompt": "after", "max_tokens": 2,
+                     "model": "night-ft"}, timeout=120)
+    assert st == 200
+    assert body["system_fingerprint"] == "adapter:night-ft@g2"
+
+    # Journaled boundary, causally ordered across sources: the gateway's
+    # publish.start(step 4) precedes each replica's OWN adapter.loaded
+    # (gen 2, step 4) in its journal, which precedes publish.done.
+    merged = merge_journals(jdir)
+
+    def _at(source, event, **match):
+        return next(i for i, r in enumerate(merged)
+                    if r["source"] == source and r["event"] == event
+                    and all(r.get(k) == v for k, v in match.items()))
+
+    start = _at("gateway", "adapter.publish.start", step=4)
+    done = _at("gateway", "adapter.publish.done", step=4)
+    for rid in ("r0", "r1"):
+        loaded = _at(rid, "adapter.loaded", generation=2)
+        assert merged[loaded]["step"] == 4
+        assert start < loaded < done, (start, loaded, done)
+    hops = [r for r in merged if r["event"] == "adapter.publish.hop"
+            and r.get("generation") == 2]
+    assert sorted(h["replica"] for h in hops) == ["r0", "r1"]
+
+
+def test_chaos_abort_mid_publish_converges(
+        tmp_path, model_setup, adapter_tree, adapter_fleet):
+    """SIGKILL-equivalent abort BETWEEN hops: r0 flips, r1 keeps the old
+    verified adapter, nobody serves torn bytes, the fallback is counted
+    and journaled — and a re-publish converges the straggler."""
+    _, cfg, _ = model_setup
+    _, single = adapter_tree
+    port, metrics, jdir = adapter_fleet
+    export_adapter(str(tmp_path), "tenant-a-ft", 3, single, cfg)
+    root = str(tmp_path / "tenant-a-ft")
+    st, body = _req(port, "POST", "/v1/adapters/publish",
+                    {"name": "tenant-a-ft", "dir": root, "owner": "acme"})
+    assert st == 200 and body["complete"], body
+
+    export_adapter(str(tmp_path), "tenant-a-ft", 4, single, cfg)
+    arm(FaultPlane(seed=1, rules="adapter.publish:error@call=2,max=1"))
+    try:
+        st, body = _req(port, "POST", "/v1/adapters/publish",
+                        {"name": "tenant-a-ft", "dir": root,
+                         "owner": "acme"}, timeout=120)
+    finally:
+        disarm()
+    assert st == 502 and body["aborted"], body
+    assert [h["replica"] for h in body["ok"]] == ["r0"]
+    assert body["skipped"] == ["r1"]
+    assert _live_generations(port) == {"r0": [2], "r1": [1]}
+    assert metrics.registry.render().count(
+        "ditl_adapter_publish_fallbacks_total 1") == 1
+
+    # Both sides still serve verified weights: zero client failures.
+    for _ in range(4):
+        st, body = _req(port, "POST", "/v1/completions",
+                        {"prompt": "still up", "max_tokens": 2,
+                         "model": "tenant-a-ft"}, timeout=120)
+        assert st == 200
+        assert body["system_fingerprint"] in (
+            "adapter:tenant-a-ft@g1", "adapter:tenant-a-ft@g2")
+
+    # Re-publication converges the straggler.
+    st, body = _req(port, "POST", "/v1/adapters/publish",
+                    {"name": "tenant-a-ft", "dir": root, "owner": "acme"},
+                    timeout=120)
+    assert st == 200 and body["complete"], body
+    assert _live_generations(port) == {"r0": [3], "r1": [2]}
+
+    # One causally-ordered chain: the lost hop is in the gateway journal
+    # between its publication's start and done.
+    events = [r["event"] for r in merge_journals(jdir)
+              if r.get("source") == "gateway"]
+    lost = events.index("adapter.publish.hop_lost")
+    assert events[:lost].count("adapter.publish.start") == 2
+    assert "adapter.publish.done" in events[lost:]
+
+
+def test_corrupt_checkpoint_refused_at_gateway_edge(
+        tmp_path, model_setup, adapter_tree, adapter_fleet):
+    _, cfg, _ = model_setup
+    _, single = adapter_tree
+    port, _, jdir = adapter_fleet
+    vd = export_adapter(str(tmp_path), "bad-ft", 1, single, cfg)
+    with open(os.path.join(vd, adapterfmt.ADAPTER_FILE), "r+b") as f:
+        f.seek(10)
+        byte = f.read(1)
+        f.seek(10)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    st, body = _req(port, "POST", "/v1/adapters/publish",
+                    {"name": "bad-ft", "dir": vd, "owner": "acme"})
+    assert st == 422 and "verification" in body["error"]["message"]
+    # Refused at the EDGE: no replica hop happened, nothing is live.
+    assert _live_generations(port) == {"r0": [], "r1": []}
+    events = [r["event"] for r in merge_journals(jdir)]
+    assert "adapter.publish.refused" in events
+    assert "adapter.publish.start" not in events

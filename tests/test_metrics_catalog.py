@@ -78,6 +78,22 @@ def _collect_live() -> set[str]:
     )
     reserved = set(m.registry._metrics)
     live |= _families("\n".join(flattened_stats_lines(eng.stats(), reserved)))
+
+    # -- adapter plane (ISSUE 16): a registry over a multi-LoRA engine
+    # registers the ditl_adapter_* lifecycle families ------------------
+    import dataclasses
+
+    from ditl_tpu.infer.adapters import AdapterRegistry
+    from ditl_tpu.models.lora import stack_adapters, zeros_adapter
+
+    lcfg = dataclasses.replace(cfg, lora_rank=4)
+    lparams = llama.init_params(jax.random.key(1), lcfg)
+    lparams = {**lparams, "layers": {**lparams["layers"],
+               "lora": stack_adapters([zeros_adapter(lcfg)] * 2)}}
+    leng = ContinuousEngine(lparams, lcfg, ByteTokenizer(), n_slots=2,
+                            decode_chunk=8)
+    AdapterRegistry(leng)
+    live |= _families(leng.metrics.render())
     # Lock-step/pod-only stats keys the handler flattens the same way.
     live |= _families("\n".join(flattened_stats_lines(
         {"lockstep_speculative": True, "lockstep_speculative_acceptance": 0.5,
@@ -111,6 +127,11 @@ def _collect_live() -> set[str]:
     )
     g._set_cache_gauges([view])
     g._set_role_gauges([view])
+    # Adapter publication coordinator (ISSUE 16): construction registers
+    # the gateway-side ditl_adapter_publish* families.
+    from ditl_tpu.gateway.publish import AdapterPublisher
+
+    AdapterPublisher(None, registry=g.registry)
     live |= _families(g.registry.render())
 
     # -- per-tenant usage meter (ISSUE 15): every outcome + tenant and

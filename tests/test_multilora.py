@@ -8,6 +8,7 @@ exactly what a model carrying adapter j alone produces (f32); adapter id 0
 
 import json
 import threading
+import urllib.error
 import urllib.request
 
 import jax
@@ -308,7 +309,15 @@ def test_server_routes_adapter_through_continuous_engine(lora_setup):
             ["route me"], GenerateConfig(max_new_tokens=6)
         )[0]
         assert ask("ad2") == ref_ad2
-        assert ask("unknown-model") == ref_base  # base weights via slot 0
+        assert ask("base") == ref_base  # the base model name: slot 0
+        # Registry-armed server (ISSUE 16: a multi-LoRA ThreadedEngine
+        # auto-arms the adapter plane): an unknown model name is a 404
+        # with a reason, never a silent fall-through to base weights.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            ask("unknown-model")
+        assert ei.value.code == 404
+        body = json.loads(ei.value.read())
+        assert "unknown adapter" in body["error"]["message"]
     finally:
         server.shutdown()
         te.close()
