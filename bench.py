@@ -1335,11 +1335,409 @@ def _percentile(sorted_vals, q: float) -> float:
     return sorted_vals[idx]
 
 
+class _SelectorSSEStub:
+    """Selector-based SSE replica stand-in (ISSUE 17): answers ``GET
+    /health`` with the usual JSON and every POST with an SSE first chunk,
+    then HOLDS the stream open — no thread per connection on the replica
+    either, so a 10k-stream hold doesn't smuggle 10k *stub* threads into
+    the row it exists to pin. Implements the InProcessReplica lifecycle
+    contract (``serve_forever`` / ``close`` / ``kill`` /
+    ``server_address``); ``finish_streams()`` completes every held
+    stream (``data: [DONE]`` + close) — the drain drill's "some streams
+    finish" lever."""
+
+    _HEALTH = json.dumps({
+        "status": "ok", "draining": False, "queue_depth": 0,
+        "active_slots": 0, "n_slots": 8,
+    }).encode()
+
+    def __init__(self, address=("127.0.0.1", 0)):
+        import selectors
+        import socket
+        import threading
+
+        self._sel = selectors.DefaultSelector()
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(address)
+        self._lsock.listen(1024)
+        self._lsock.setblocking(False)
+        self.server_address = self._lsock.getsockname()[:2]
+        self._rsock, self._wsock = socket.socketpair()
+        self._rsock.setblocking(False)
+        self._wsock.setblocking(False)
+        self._cmds: list = []  # append/pop(0) are atomic; wake byte signals
+        self._bufs: dict = {}  # parsing sockets -> request bytearray
+        self._held: list = []  # sockets with an open SSE stream
+        self.streams_opened = 0
+        self._stopped = threading.Event()
+        self._stopped.set()
+
+    def _wake(self, cmd: str) -> None:
+        self._cmds.append(cmd)
+        try:
+            self._wsock.send(b"\x00")
+        except OSError:
+            pass
+
+    def serve_forever(self, poll_interval: float = 0.5) -> None:
+        import selectors
+
+        self._stopped.clear()
+        self._sel.register(self._lsock, selectors.EVENT_READ, "accept")
+        self._sel.register(self._rsock, selectors.EVENT_READ, "wake")
+        try:
+            while True:
+                for key, _ in self._sel.select(poll_interval):
+                    if key.data == "accept":
+                        self._accept()
+                    elif key.data == "wake":
+                        self._drain_wake()
+                    else:
+                        self._client(key.fileobj)
+                while self._cmds:
+                    if self._cmds.pop(0) == "finish":
+                        self._finish_all()
+                    else:  # "stop"
+                        return
+        finally:
+            for sock in [*self._bufs, *self._held]:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._bufs.clear()
+            self._held.clear()
+            for sock in (self._lsock, self._rsock, self._wsock):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._sel.close()
+            self._stopped.set()
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._rsock.recv(4096):
+                pass
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        import selectors
+        import socket
+
+        for _ in range(128):
+            try:
+                sock, _ = self._lsock.accept()
+            except OSError:
+                return
+            sock.setblocking(False)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._bufs[sock] = bytearray()
+            try:
+                self._sel.register(sock, selectors.EVENT_READ, "client")
+            except (KeyError, ValueError, OSError):
+                sock.close()
+                del self._bufs[sock]
+
+    def _drop(self, sock) -> None:
+        try:
+            self._sel.unregister(sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        self._bufs.pop(sock, None)
+        try:
+            self._held.remove(sock)
+        except ValueError:
+            pass
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def _client(self, sock) -> None:
+        try:
+            data = sock.recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(sock)
+            return
+        if not data:
+            self._drop(sock)
+            return
+        buf = self._bufs.get(sock)
+        if buf is None:
+            return  # bytes on a held stream: ignore
+        buf += data
+        end = buf.find(b"\r\n\r\n")
+        if end < 0:
+            return
+        head = bytes(buf[:end])
+        length = 0
+        for line in head.split(b"\r\n")[1:]:
+            if line[:15].lower() == b"content-length:":
+                try:
+                    length = int(line[15:])
+                except ValueError:
+                    length = 0
+        if len(buf) < end + 4 + length:
+            return  # body still arriving
+        self._respond(sock, head)
+
+    def _respond(self, sock, head: bytes) -> None:
+        del self._bufs[sock]
+        try:
+            if head.startswith(b"GET"):
+                body = self._HEALTH
+                sock.sendall(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " + str(len(body)).encode() +
+                    b"\r\nConnection: close\r\n\r\n" + body)
+                self._drop(sock)
+                return
+            sock.sendall(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/event-stream\r\n"
+                b"Cache-Control: no-cache\r\n"
+                b"Connection: close\r\n\r\n"
+                b'data: {"choices": [{"index": 0, "text": "s"}]}\n\n')
+        except OSError:
+            self._drop(sock)
+            return
+        self._held.append(sock)
+        self.streams_opened += 1
+
+    def _finish_all(self) -> None:
+        for sock in list(self._held):
+            try:
+                sock.sendall(b"data: [DONE]\n\n")
+            except OSError:
+                pass
+            self._drop(sock)
+
+    def finish_streams(self) -> None:
+        """Complete every held stream: terminal SSE event, then close
+        (SSE is close-delimited — this is a clean upstream EOF)."""
+        self._wake("finish")
+
+    def close(self, drain: bool = True, timeout: float = 10.0) -> None:
+        self._wake("stop")
+        self._stopped.wait(timeout)
+
+    def kill(self) -> None:
+        self.close(drain=False)
+
+
+def gateway_thread_count() -> int:
+    """Resident gateway threads right now: every thread the gateway
+    owns carries a ``gw-`` name (``gw-loop`` / ``gw-offload`` /
+    ``gw-hedge`` / ``gw-fanout``) — the number the 10k-stream hold row
+    pins ≤ 16 where thread-per-stream would read ~N."""
+    import threading
+
+    return sum(1 for t in threading.enumerate()
+               if t.name.startswith("gw-"))
+
+
+def hold_open_sse_streams(port: int, n: int, *, batch: int = 256,
+                          timeout_s: float = 180.0,
+                          sample=None) -> tuple[list, int]:
+    """Open-loop SSE client (ISSUE 17): open ``n`` streams against the
+    gateway and hold them, all from THE CALLING THREAD — one selector,
+    no client thread per stream (the whole point is that neither side
+    of the hold pays a thread). A stream counts as open once its first
+    SSE chunk arrives (headers + ``data:``). Connects ride in waves of
+    ``batch`` so the gateway's accept backlog never overflows. Returns
+    ``(sockets, opened)`` — the caller owns closing the sockets;
+    ``sample`` (optional callable) runs once per loop pass (thread-count
+    sampling during the ramp, when the offload pool is busiest)."""
+    import selectors
+    import socket
+
+    payload = json.dumps({"prompt": "hold", "max_tokens": 4,
+                          "stream": True}).encode()
+    request = (b"POST /v1/completions HTTP/1.1\r\n"
+               b"Host: gw\r\nContent-Type: application/json\r\n"
+               b"Content-Length: " + str(len(payload)).encode() +
+               b"\r\n\r\n" + payload)
+    sel = selectors.DefaultSelector()
+    socks: list = []
+    states: dict = {}  # sock -> [sent_offset, recv_buf, opened]
+    opened = dead = 0
+    remaining = n
+    inflight = 0
+    deadline = time.monotonic() + timeout_s
+
+    def launch():
+        nonlocal remaining, inflight
+        while remaining and inflight < batch:
+            remaining -= 1
+            inflight += 1
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setblocking(False)
+            try:
+                s.connect_ex(("127.0.0.1", port))
+                sel.register(s, selectors.EVENT_WRITE, None)
+            except OSError:
+                settle(s, ok=False)
+                continue
+            socks.append(s)
+            states[s] = [0, bytearray(), False]
+
+    def settle(s, ok: bool):
+        nonlocal opened, dead, inflight
+        inflight -= 1
+        if ok:
+            opened += 1
+        else:
+            dead += 1
+        try:
+            sel.unregister(s)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    launch()
+    while opened + dead < n and time.monotonic() < deadline:
+        events = sel.select(1.0)
+        if sample is not None:
+            sample()
+        for key, ev in events:
+            s = key.fileobj
+            st = states[s]
+            if ev & selectors.EVENT_WRITE:
+                try:
+                    sent = s.send(request[st[0]:])
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError:
+                    settle(s, ok=False)
+                    continue
+                st[0] += sent
+                if st[0] >= len(request):
+                    sel.modify(s, selectors.EVENT_READ, None)
+                continue
+            try:
+                data = s.recv(65536)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                settle(s, ok=False)
+                continue
+            if not data:
+                settle(s, ok=False)
+                continue
+            st[1] += data
+            if not st[2] and b"data:" in st[1]:
+                st[2] = True
+                # Held: no further events needed — the stream just
+                # stays open (the stub never sends more).
+                settle(s, ok=True)
+        launch()
+    sel.close()
+    return socks, opened
+
+
+def run_gateway_stream_hold(concurrency: int, n_replicas: int = 2) -> dict:
+    """The ``--serve-concurrency N`` axis (ISSUE 17): hold N idle SSE
+    streams through an evloop gateway over selector-based SSE stubs and
+    record the gateway's max resident thread count — the number that
+    reads ~N on thread-per-stream and must stay ≤ loop + offload pool
+    (~13) on the event loop.
+
+    Every stream costs 4 fds in this one process (client↔gateway and
+    gateway↔stub pairs), so the held count is clamped to the
+    RLIMIT_NOFILE budget — LOUDLY, and recorded in the row
+    (``requested`` vs ``open_streams``, ``fd_limit``, ``clamped``):
+    a clamp is an environment property, never a silent cap."""
+    import os
+    import resource
+    import threading
+
+    from ditl_tpu.config import GatewayConfig
+    from ditl_tpu.gateway import (
+        Fleet, GatewayMetrics, InProcessReplica, make_gateway,
+    )
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+        soft = hard
+    fds_open = len(os.listdir("/proc/self/fd")) if os.path.isdir(
+        "/proc/self/fd") else 64
+    budget = max(16, (soft - fds_open - 256) // 4)
+    target = min(concurrency, budget)
+    clamped = target < concurrency
+    if clamped:
+        print(f"bench: stream hold clamped {concurrency} -> {target} "
+              f"(RLIMIT_NOFILE {soft}, 4 fds/stream in one process)",
+              file=sys.stderr)
+
+    fleet = Fleet([InProcessReplica(f"s{i}", _SelectorSSEStub)
+                   for i in range(n_replicas)])
+    server = None
+    try:
+        fleet.start_all()
+        for rid in fleet.ids:
+            if not fleet.probe(rid, timeout=5.0):
+                raise RuntimeError(f"SSE stub {rid} failed its probe")
+        gwcfg = GatewayConfig()  # data_plane="evloop" is the default
+        server = make_gateway(fleet, config=gwcfg,
+                              metrics=GatewayMetrics(), port=0)
+    except BaseException:
+        if server is not None:
+            server.server_close()
+        fleet.stop_all(drain=False)
+        raise
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="gw-loop").start()
+    max_threads = gateway_thread_count()
+    socks: list = []
+    try:
+        def sample():
+            nonlocal max_threads
+            max_threads = max(max_threads, gateway_thread_count())
+
+        t0 = time.perf_counter()
+        socks, opened = hold_open_sse_streams(
+            server.server_address[1], target, sample=sample)
+        ramp_s = time.perf_counter() - t0
+        # Steady-state hold: the loop is idle now — sample again so the
+        # row pins the resident count, not just the ramp burst.
+        for _ in range(10):
+            time.sleep(0.05)
+            sample()
+        if opened < target:
+            raise RuntimeError(
+                f"stream hold opened {opened}/{target} streams")
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        server.shutdown()
+        server.server_close()
+        fleet.stop_all(drain=False)
+    return {
+        "requested": concurrency,
+        "open_streams": opened,
+        "clamped": clamped,
+        "fd_limit": soft,
+        "data_plane": "evloop",
+        "ramp_s": round(ramp_s, 3),
+        "gateway_max_resident_threads": max_threads,
+    }
+
+
 def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
                                clients: int = 3, pool_max_idle: int = -1,
                                router: str = "round_robin",
                                usage_metering: bool = False,
-                               usage_dir: str | None = None) -> dict:
+                               usage_dir: str | None = None,
+                               serve_concurrency: int = 0) -> dict:
     """Gateway data-plane overhead microbench (ISSUE 14): a closed loop
     of keep-alive HTTP clients driving in-process STUB replicas — first
     directly, then through the gateway — so the row isolates the
@@ -1467,14 +1865,16 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
             server.server_close()
         fleet.stop_all(drain=False)
         raise
-    threading.Thread(target=server.serve_forever, daemon=True).start()
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="gw-loop").start()
     gw_port = server.server_address[1]
     payload = json.dumps({"prompt": "overhead probe",
                           "max_tokens": 1}).encode()
     per_client = requests // clients
     total = per_client * clients
 
-    def drive(port: int, latencies: list, bearer: str = "") -> None:
+    def drive(port: int, latencies: list, bearer: str = "",
+              n: int | None = None) -> None:
         # One kept-alive client connection per thread (all legs): the
         # client side is held constant so the pooled-vs-fresh delta is
         # the UPSTREAM hop alone. ``bearer`` (metered leg) exercises the
@@ -1489,7 +1889,7 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
             # without NODELAY every request on a kept-alive connection
             # stalls ~40 ms behind the peer's delayed ACK.
             conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            for _ in range(per_client):
+            for _ in range(per_client if n is None else n):
                 t0 = time.perf_counter()
                 conn.request("POST", "/v1/completions", body=payload,
                              headers=headers)
@@ -1506,14 +1906,18 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
         finally:
             conn.close()
 
-    def closed_loop(port: int, bearer_prefix: str = "") -> tuple[float, list]:
+    def closed_loop(port: int, bearer_prefix: str = "",
+                    n_per_client: int | None = None) -> tuple[float, list]:
+        expected = (per_client if n_per_client is None
+                    else n_per_client) * clients
         lat_lists = [[] for _ in range(clients)]
         errors: list = []
 
         def run(i):
             try:
                 drive(port, lat_lists[i],
-                      bearer=f"{bearer_prefix}-{i}" if bearer_prefix else "")
+                      bearer=f"{bearer_prefix}-{i}" if bearer_prefix else "",
+                      n=n_per_client)
             except BaseException as e:  # re-raised on the caller below
                 errors.append(e)
 
@@ -1531,9 +1935,9 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
             # The real failure, not an opaque lost-request count.
             raise errors[0]
         lats = sorted(x for lst in lat_lists for x in lst)
-        if len(lats) != total:
+        if len(lats) != expected:
             raise RuntimeError(
-                f"overhead bench lost requests: {len(lats)} != {total}"
+                f"overhead bench lost requests: {len(lats)} != {expected}"
             )
         return dt, lats
 
@@ -1556,11 +1960,87 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
             finally:
                 conn.close()
         direct_dt, direct_lats = closed_loop(direct_addr[1])
-        pool0 = fleet.pool.stats()
-        connects0 = sum(s.connections for s in stubs)
-        gw_dt, gw_lats = closed_loop(gw_port)
-        pool1 = fleet.pool.stats()
-        connects = sum(s.connections for s in stubs) - connects0
+        # Back-to-back legacy leg (ISSUE 17): the SAME fleet and the
+        # same closed loop through a thread-per-connection gateway, so
+        # the evloop-vs-threaded ratio at the legacy concurrency point
+        # is measured in the row — a data-plane regression cannot hide
+        # behind the new concurrency axis. The threaded leg runs as two
+        # HALVES bracketing the evloop leg (A/B/A): this box's
+        # throughput drifts over a bench's lifetime, and a sequential
+        # A-then-B hands whichever plane runs last a free ~10% — the
+        # bracket cancels the drift to first order. The pool/connect
+        # snapshots still enclose only the evloop window (both gateways
+        # share the fleet's pool).
+        server_t = make_gateway(
+            fleet,
+            config=GatewayConfig(**{**gwcfg_kwargs,
+                                    "data_plane": "threaded"}),
+            metrics=GatewayMetrics(), port=0)
+        threading.Thread(target=server_t.serve_forever, daemon=True,
+                         name="gw-threaded").start()
+        try:
+            t_port = server_t.server_address[1]
+            warm_conn = http.client.HTTPConnection("127.0.0.1", t_port,
+                                                   timeout=30.0)
+            try:
+                for _ in range(4):
+                    warm_conn.request(
+                        "POST", "/v1/completions", body=payload,
+                        headers={"Content-Type": "application/json"})
+                    warm_conn.getresponse().read()
+            finally:
+                warm_conn.close()
+            n_slices = 4 if per_client >= 4 else 1
+            # Every requested request runs: the last slice absorbs the
+            # remainder (within a pair both planes still drive the same
+            # count, so the per-pair ratio stays fair).
+            slice_sizes = [per_client // n_slices] * n_slices
+            slice_sizes[-1] += per_client % n_slices
+            gw_dt = thr_dt = 0.0
+            gw_lats = []
+            thr_lats = []
+            pair_ratios = []
+            pool_delta = {"hits": 0, "misses": 0, "discards": 0}
+            connects = 0
+            for i, slice_n in enumerate(slice_sizes):
+                # Palindromic pair order (TE ET TE ET): both planes'
+                # slices share the same mean position in time, so a
+                # linear drift contributes identically to each.
+                order = ((t_port, gw_port) if i % 2 == 0
+                         else (gw_port, t_port))
+                pair_dt = {}
+                for port in order:
+                    if port == gw_port:
+                        p0 = fleet.pool.stats()
+                        c0 = sum(s.connections for s in stubs)
+                        dt, lats = closed_loop(port, n_per_client=slice_n)
+                        p1 = fleet.pool.stats()
+                        for k in pool_delta:
+                            pool_delta[k] += p1[k] - p0[k]
+                        connects += sum(
+                            s.connections for s in stubs) - c0
+                        gw_dt += dt
+                        gw_lats.extend(lats)
+                    else:
+                        dt, lats = closed_loop(port, n_per_client=slice_n)
+                        thr_dt += dt
+                        thr_lats.extend(lats)
+                    pair_dt[port] = dt
+                # Same request count both halves of the pair, run
+                # back-to-back: the rps ratio is the inverse dt ratio,
+                # and drift within one ~0.5 s pair is negligible.
+                pair_ratios.append(pair_dt[t_port] / pair_dt[gw_port])
+            gw_lats.sort()
+            thr_lats.sort()
+            gw_total = thr_total = sum(slice_sizes) * clients
+            # Median of the paired ratios: pairing cancels drift, the
+            # median sheds transient spikes (GC, a neighbor container's
+            # burst) — the gated number must measure the data plane, not
+            # the box's mood during one unlucky slice.
+            ratio_evloop_vs_threaded = statistics.median(pair_ratios)
+        finally:
+            server_t.shutdown()
+            server_t.server_close()
         metered = None
         if usage_metering:
             # Metered A/B leg (ISSUE 15): same fleet, second gateway with
@@ -1610,9 +2090,19 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
         server.shutdown()
         server.server_close()
         fleet.stop_all(drain=False)
-    hits = pool1["hits"] - pool0["hits"]
-    misses = pool1["misses"] - pool0["misses"]
-    gw_rps = total / gw_dt
+    stream_hold = None
+    if serve_concurrency > 0:
+        # Only after the closed-loop gateways are fully torn down: the
+        # hold row's resident-thread count must see the hold gateway's
+        # threads ALONE. Retired offload workers exit promptly after
+        # shutdown(wait=False) — wait for them, bounded.
+        deadline = time.monotonic() + 10.0
+        while gateway_thread_count() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        stream_hold = run_gateway_stream_hold(serve_concurrency)
+    hits = pool_delta["hits"]
+    misses = pool_delta["misses"]
+    gw_rps = gw_total / gw_dt
     d_p50, d_p95 = _percentile(direct_lats, 0.50), _percentile(direct_lats,
                                                                0.95)
     g_p50, g_p95 = _percentile(gw_lats, 0.50), _percentile(gw_lats, 0.95)
@@ -1655,6 +2145,21 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
             "pool_max_idle": fleet.pool.max_idle_per_replica,
             "clients": clients,
             "router": router,
+            "data_plane": gwcfg.data_plane,
+            # Legacy thread-per-connection leg on the same fleet + the
+            # gated ratio: evloop must hold >= threaded req/s at the
+            # legacy concurrency point (direction +1 in perf_compare).
+            "threaded": {
+                "gateway_rps": round(thr_total / thr_dt, 1),
+                "gateway_p50_s": round(_percentile(thr_lats, 0.50), 6),
+                "gateway_p95_s": round(_percentile(thr_lats, 0.95), 6),
+            },
+            "evloop_vs_threaded_rps_ratio": round(
+                ratio_evloop_vs_threaded, 4),
+            **({"stream_hold": stream_hold,
+                "gateway_max_resident_threads":
+                    stream_hold["gateway_max_resident_threads"]}
+               if stream_hold else {}),
             "gateway_rps": round(gw_rps, 1),
             "direct_rps": round(total / direct_dt, 1),
             "gateway_p50_s": round(g_p50, 6),
@@ -1667,7 +2172,7 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
                 round(hits / (hits + misses), 4) if hits + misses else 0.0
             ),
             "pool": {"hits": hits, "misses": misses,
-                     "discards": pool1["discards"] - pool0["discards"]},
+                     "discards": pool_delta["discards"]},
             "upstream_connects": connects,
         },
         **usage_block,
@@ -2438,6 +2943,16 @@ if __name__ == "__main__":
     parser.add_argument("--serve-overhead-requests", type=int, default=240,
                         help="with --serve-gateway-overhead: total "
                         "closed-loop requests per leg")
+    parser.add_argument("--serve-concurrency", type=int, default=0,
+                        metavar="N",
+                        help="with --serve-gateway-overhead: hold N idle "
+                        "SSE streams through the evloop gateway from an "
+                        "open-loop selector client (no thread per stream "
+                        "on either side, ISSUE 17) and record the "
+                        "gateway's max resident thread count in the row; "
+                        "the held count is clamped to the RLIMIT_NOFILE "
+                        "budget (4 fds/stream in-process) and the clamp "
+                        "is recorded, never silent")
     parser.add_argument("--serve-trace-replay", default="", metavar="PATH",
                         help="with --infer --serve-replicas: replay a "
                         "recorded traffic trace (gateway --save-trace "
@@ -2472,6 +2987,7 @@ if __name__ == "__main__":
             requests=args.serve_overhead_requests,
             pool_max_idle=args.serve_pool_idle,
             usage_metering=args.serve_usage_metering,
+            serve_concurrency=args.serve_concurrency,
         ))
     infer_only = (args.quantize or args.kv_quant or args.speculative
                   or args.engine != "lockstep" or args.cache != "contiguous"

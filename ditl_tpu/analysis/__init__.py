@@ -34,7 +34,7 @@ violation)::
 
 from __future__ import annotations
 
-from ditl_tpu.annotations import hot_path
+from ditl_tpu.annotations import event_loop, hot_path
 from ditl_tpu.analysis.core import (
     RULES,
     Diagnostic,
@@ -47,6 +47,7 @@ from ditl_tpu.analysis.core import (
 # Importing the rule modules registers their rules with the registry.
 from ditl_tpu.analysis import (  # noqa: E402,F401  (registration side effect)
     rules_config,
+    rules_evloop,
     rules_hotpath,
     rules_imports,
     rules_locks,
@@ -60,6 +61,7 @@ __all__ = [
     "Project",
     "RULES",
     "Settings",
+    "event_loop",
     "hot_path",
     "rule",
     "run",

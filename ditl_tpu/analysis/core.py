@@ -123,6 +123,8 @@ class Settings:
     forbidden_imports: tuple[str, ...] = ("jax", "jaxlib")
     # -- blocking-transfer -------------------------------------------------
     hot_path_decorator: str = "hot_path"
+    # -- event-loop-hygiene (ISSUE 17) -------------------------------------
+    event_loop_decorator: str = "event_loop"
     # -- registry-mirror ---------------------------------------------------
     # (file, variable): the canonical registry and its hand-written mirrors
     # (mirrors exist on purpose — the jax-free zones cannot import the

@@ -1,0 +1,137 @@
+"""event-loop-hygiene: nothing that blocks may run on the event loop.
+
+The gateway's evloop data plane (ISSUE 17) multiplexes every client
+connection and every detached SSE stream onto ONE thread — a single
+blocking call there is not one slow request, it is a full-gateway stall
+(every open stream stops moving bytes at once; troubleshooting §35 is
+the runtime signature, ``ditl_gateway_loop_tick_p95_s`` spiking). Mark a
+function ``@event_loop`` (ditl_tpu/annotations.py) and every blocking
+spelling inside it is a violation:
+
+- ``sleep(...)`` in any spelling (``time.sleep``, a bare ``sleep``) —
+  the loop sleeps only inside ``selector.select``;
+- ``<x>.sendall(...)`` — blocks (or raises ``BlockingIOError`` mid-write,
+  tearing the stream) regardless of socket mode; loop code uses ``send``
+  with explicit partial-write buffering;
+- ``<x>.join(...)`` — waiting for a thread/future on the loop deadlocks
+  the moment that thread needs the loop to make progress;
+- ``with self.<lock>:`` where the attribute looks lock-like (contains
+  ``lock`` or ``cond``) and the line carries no ``# guarded-by:``
+  witness — an uncontended lock is cheap, but a lock shared with worker
+  threads is an unbounded wait; the witness comment is the claim that
+  someone CHECKED the hold times on the other side. Cross-thread
+  handoff in loop code uses ``collections.deque`` (atomic
+  append/popleft) plus a wakeup byte instead.
+
+Deliberately NOT flagged: ``.recv(`` / ``.send(`` / ``.accept(`` —
+loop-owned sockets are non-blocking by construction
+(``setblocking(False)`` at accept/detach), so these return immediately;
+flagging them would force a pragma onto every legitimate readiness-driven
+read. The flagged spellings block no matter what mode the fd is in.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ditl_tpu.analysis.core import (
+    Diagnostic,
+    Project,
+    SourceFile,
+    call_name,
+    rule,
+)
+from ditl_tpu.analysis.rules_locks import GUARDED_RE, _self_attr
+
+_BLOCKING_METHODS = {"sendall", "join"}
+
+
+def _is_event_loop(fn: ast.AST, marker: str) -> bool:
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    for dec in fn.decorator_list:
+        name = dec.attr if isinstance(dec, ast.Attribute) else (
+            dec.id if isinstance(dec, ast.Name) else ""
+        )
+        if name == marker:
+            return True
+    return False
+
+
+def _lockish(attr: str) -> bool:
+    low = attr.lower()
+    return "lock" in low or "cond" in low
+
+
+def _check_body(
+    f: SourceFile, fn: ast.FunctionDef, qualname: str
+) -> list[Diagnostic]:
+    out: list[Diagnostic] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name == "sleep":
+                out.append(Diagnostic(
+                    "event-loop-hygiene", f.display, node.lineno,
+                    f"sleep inside @event_loop {qualname}: the loop may "
+                    "only wait inside selector.select — a sleep here "
+                    "stalls every open connection and stream",
+                ))
+            elif (
+                name in _BLOCKING_METHODS
+                and isinstance(node.func, ast.Attribute)
+            ):
+                hint = (
+                    "use send with partial-write buffering"
+                    if name == "sendall"
+                    else "hand the wait to a worker, never the loop"
+                )
+                out.append(Diagnostic(
+                    "event-loop-hygiene", f.display, node.lineno,
+                    f".{name}() inside @event_loop {qualname}: blocks "
+                    f"the loop regardless of socket mode; {hint}",
+                ))
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                attr = _self_attr(item.context_expr)
+                if attr is None or not _lockish(attr):
+                    continue
+                line = f.lines[node.lineno - 1] \
+                    if node.lineno <= len(f.lines) else ""
+                if GUARDED_RE.search(line):
+                    # A witness names the guarded state: someone checked
+                    # the other side's hold times (lock-discipline's own
+                    # grammar, reused as the sanction here).
+                    continue
+                out.append(Diagnostic(
+                    "event-loop-hygiene", f.display, node.lineno,
+                    f"with self.{attr} inside @event_loop {qualname}: a "
+                    "lock shared with workers is an unbounded wait on "
+                    "the loop; prefer a deque handoff, or witness the "
+                    "bounded hold with `# guarded-by: <state>`",
+                ))
+    return out
+
+
+@rule(
+    "event-loop-hygiene",
+    "functions marked @event_loop must not contain blocking spellings "
+    "(sleep / .sendall / .join / un-witnessed lock waits)",
+)
+def check_event_loop_hygiene(project: Project) -> list[Diagnostic]:
+    marker = project.settings.event_loop_decorator
+    out: list[Diagnostic] = []
+    for f in project.files:
+        method_ids: set[int] = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    method_ids.add(id(item))
+                    if _is_event_loop(item, marker):
+                        out.extend(_check_body(
+                            f, item, f"{node.name}.{item.name}"
+                        ))
+        for node in ast.walk(f.tree):
+            if _is_event_loop(node, marker) and id(node) not in method_ids:
+                out.extend(_check_body(f, node, node.name))
+    return out

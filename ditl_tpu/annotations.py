@@ -19,6 +19,18 @@ obtain a no-op decorator.
     spellings inside any function carrying this decorator; a genuinely
     host-side cast gets a reasoned pragma, never an unmark.
 
+``@event_loop``
+    Marks a function as running ON the gateway's single-threaded
+    selectors loop (ISSUE 17): one blocking call there stalls every open
+    connection and stream at once, not just one request. The
+    ``event-loop-hygiene`` rule flags blocking spellings inside any
+    function carrying this decorator — ``sleep``, ``.sendall(``,
+    ``.join(``, and lock waits without a ``# guarded-by:`` witness.
+    Plain ``.recv(`` is deliberately NOT flagged: loop-owned sockets are
+    non-blocking by construction (``setblocking(False)`` at accept/
+    detach), so recv returns immediately; the flagged spellings block
+    (or raise mid-write, for sendall) regardless of socket mode.
+
 ``# guarded-by: <lock>`` (trailing comment on the attribute's defining
     assignment)
     Declares that an attribute may only be read or written inside a
@@ -30,7 +42,7 @@ obtain a no-op decorator.
 
 from __future__ import annotations
 
-__all__ = ["hot_path"]
+__all__ = ["event_loop", "hot_path"]
 
 
 def hot_path(fn):
@@ -39,4 +51,14 @@ def hot_path(fn):
     ``blocking-transfer`` rule (ditl_tpu/analysis/rules_hotpath.py); the
     attribute below is for runtime introspection and tests."""
     fn.__ditl_hot_path__ = True
+    return fn
+
+
+def event_loop(fn):
+    """No-op marker decorator: the decorated function runs on the
+    gateway's selectors event loop and promises never to block it.
+    Enforced statically by the ``event-loop-hygiene`` rule
+    (ditl_tpu/analysis/rules_evloop.py); the attribute below is for
+    runtime introspection and tests."""
+    fn.__ditl_event_loop__ = True
     return fn

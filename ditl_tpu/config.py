@@ -531,8 +531,53 @@ class GatewayConfig:
     # Journal directory for replica lifecycle events
     # (events-gateway.jsonl via telemetry/journal.py); "" = no journal.
     journal_dir: str = ""
+    # Data plane (ISSUE 17): "evloop" (default) serves client I/O from a
+    # single-threaded selectors event loop — SSE relays fan through the
+    # loop without a parked thread, so open-stream concurrency is bounded
+    # by fds, not thread stacks. "threaded" keeps the legacy
+    # thread-per-connection ThreadingHTTPServer for one release as the
+    # fallback. Control-plane semantics are identical on both.
+    data_plane: str = "evloop"
+    # Evloop dispatch pool (gateway/evloop.py): control-plane handling
+    # (admission, routing, retries, hedging, non-stream relays) runs on
+    # this many worker threads; streams detach back to the loop after
+    # their first upstream chunk. The default keeps the whole data plane
+    # (loop + workers) comfortably under the 16-thread pin the bench
+    # records. Non-stream relays park a worker for the upstream duration,
+    # so this also caps concurrent non-stream relays.
+    evloop_offload_workers: int = 12
+    # Idle keep-alive client connections are closed after this long with
+    # no request (parity with the threaded handler's 120 s socket
+    # timeout). Streams are exempt — their bound is the upstream read
+    # timeout.
+    evloop_idle_timeout_s: float = 120.0
+    # Accept cap: beyond this many open client connections the loop
+    # accepts-and-closes (counted as ditl_gateway_loop_accept_backlog
+    # _drops) instead of growing without bound. 0 = unlimited (the
+    # process fd limit is then the only cap).
+    evloop_max_connections: int = 0
 
     def __post_init__(self):
+        if self.data_plane not in ("threaded", "evloop"):
+            raise ValueError(
+                f"unknown gateway.data_plane {self.data_plane!r} "
+                "(threaded|evloop)"
+            )
+        if self.evloop_offload_workers < 1:
+            raise ValueError(
+                f"gateway.evloop_offload_workers must be >= 1, got "
+                f"{self.evloop_offload_workers}"
+            )
+        if self.evloop_idle_timeout_s <= 0:
+            raise ValueError(
+                f"gateway.evloop_idle_timeout_s must be > 0, got "
+                f"{self.evloop_idle_timeout_s}"
+            )
+        if self.evloop_max_connections < 0:
+            raise ValueError(
+                f"gateway.evloop_max_connections must be >= 0, got "
+                f"{self.evloop_max_connections}"
+            )
         if self.router not in ("round_robin", "least_outstanding",
                                "affinity"):
             raise ValueError(

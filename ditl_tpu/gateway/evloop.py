@@ -1,0 +1,968 @@
+"""Event-driven gateway data plane (ISSUE 17): a single-threaded
+``selectors`` loop that holds every client connection and every live SSE
+stream without a parked thread.
+
+``ThreadingHTTPServer`` spends one handler thread (+~8 MB stack) per open
+connection, so open-stream concurrency dies at a few hundred no matter
+how cheap PR 14 made each request. This module replaces the TRANSPORT
+only — the control plane (admission, routing, retries, hedging, KV
+handoff, usage, tracing) is the same battle-tested ``_GatewayHandler``
+code, run verbatim against an in-memory request/response pair on a small
+bounded offload pool. Division of labor:
+
+- **The loop** (thread name irrelevant; runs wherever ``serve_forever``
+  is called, like ``ThreadingHTTPServer``): non-blocking accept, HTTP/1.1
+  request framing (request line + headers split at CRLFCRLF, body by
+  Content-Length), response write-out with partial-write buffering,
+  keep-alive / pipelining, idle sweep, and — the point of the exercise —
+  every detached SSE relay, both fds readiness-driven.
+- **Offload workers** (``gw-offload``): one ``handle_one_request`` per
+  framed request over a ``BytesIO`` pair. Non-streaming relays park a
+  worker for the upstream duration (so the pool size caps concurrent
+  non-stream relays); streams park a worker only until the FIRST upstream
+  chunk, then detach: the handler returns, and the loop relays
+  upstream→client from the raw sockets until EOF (SSE is close-delimited
+  — no chunk decoding needed).
+
+Detached streams carry deferred terminal state (``_evloop_detached`` in
+gateway.py): admission release, e2e/usage rows, span ends, and the
+counted pool discard all run at STREAM end, not handler return, so the
+books read exactly as they do on the threaded path.
+
+Functions that run on the loop are marked ``@event_loop`` and checked by
+the ``event-loop-hygiene`` rule (analysis/rules_evloop.py): no sleep, no
+sendall, no join, no un-witnessed lock wait. Cross-thread input arrives
+through a lock-free ``deque.append`` plus a wakeup byte on a socketpair.
+
+Stdlib-only, like everything under ditl_tpu/gateway (the import-layering
+rule keeps this tree provably jax-free).
+"""
+
+from __future__ import annotations
+
+import collections
+import io
+import select
+import selectors
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ditl_tpu.annotations import event_loop
+from ditl_tpu.config import GatewayConfig
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = ["EventLoopGateway"]
+
+# Framing caps: headers beyond this never parse (400 + close); bodies are
+# bounded so a lying Content-Length cannot balloon the inbuf.
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 64 * 1024 * 1024
+_READ_CHUNK = 65536
+# Client write backpressure: past this many buffered-but-unsent bytes the
+# stream's upstream fd leaves the selector until the client drains — a
+# slow consumer stalls ITS stream, never the loop or the replica pool.
+_OUTBUF_PAUSE = 1 << 20
+
+# Sticky fast path: after fully sending a keep-alive response, the
+# offload worker camps on the (quiet) client socket this long for the
+# next request before handing the connection back to the loop. Keeps a
+# request-per-response closed loop entirely on one worker — the exact
+# blocking pattern the threaded path wins with at low concurrency —
+# while the guard in _run_handler stops camping the moment workers get
+# scarce, so high fan-in still degrades to pure event-driven dispatch.
+_STICK_S = 0.01
+
+_RESP_400 = (b"HTTP/1.1 400 Bad Request\r\nContent-Type: application/json\r\n"
+             b"Content-Length: 26\r\nConnection: close\r\n\r\n"
+             b'{"error": "bad request"}\r\n')
+
+
+class _BadRequest(Exception):
+    """Client bytes that cannot frame (malformed/oversized Content-Length,
+    header block past the cap)."""
+
+
+def _frame_request(buf: bytearray) -> int | None:
+    """Length of the first complete request in ``buf`` (request line +
+    headers + Content-Length body), ``None`` if more bytes are needed.
+    Raises :class:`_BadRequest` on a malformed or oversized frame."""
+    idx = buf.find(b"\r\n\r\n")
+    if idx < 0:
+        if len(buf) > _MAX_HEADER_BYTES:
+            raise _BadRequest("header block exceeds cap")
+        return None
+    content_length = 0
+    for line in bytes(buf[:idx]).split(b"\r\n")[1:]:
+        if line[:15].lower() == b"content-length:":
+            try:
+                content_length = int(line[15:])
+            except ValueError:
+                raise _BadRequest("malformed Content-Length") from None
+    if content_length < 0 or content_length > _MAX_BODY_BYTES:
+        raise _BadRequest("Content-Length out of range")
+    total = idx + 4 + content_length
+    return total if len(buf) >= total else None
+
+
+def _run_stream_terminal(det: dict, ok: bool, blame: bool) -> None:
+    """Deferred terminal accounting for a detached SSE stream — the exact
+    bookkeeping the threaded path runs inline when ``_relay_stream``
+    returns (route-level complete/abort counters, relay + root span ends,
+    admission release, e2e observation, usage row, counted pool discard).
+    Runs on an offload worker (it writes ledgers), inline only during
+    ``server_close`` teardown. ``blame`` distinguishes the replica dying
+    mid-stream (note_failure feeds the supervisor, threaded parity) from
+    a client-side abort or a drain sever — severing a healthy stream must
+    not push a healthy replica toward fail_threshold."""
+    h = det["handler"]
+    view = det["view"]
+    try:
+        if blame:
+            h.fleet.note_failure(view.id)
+            logger.warning("replica %s died mid-stream", view.id)
+        det["complete"](ok)
+        rspan = det.get("rspan")
+        if rspan is not None:
+            rspan.end(outcome="done" if ok else "aborted")
+        det["finish"]("200" if ok else "cancel")
+        root = det.get("root")
+        if root is not None:
+            root.end()
+    except Exception:
+        logger.exception("evloop: deferred stream accounting failed")
+    finally:
+        # Counted discard (ISSUE 14 parity), then release the fd: for a
+        # Connection: close response the socket belongs to the RESPONSE
+        # (conn.sock is already None), so the discard alone would leak it.
+        try:
+            h.fleet.pool.discard(det["conn"])
+        except OSError:
+            pass
+        try:
+            det["resp"].close()
+        except OSError:
+            pass
+
+
+def _stream_socket(upstream, resp):
+    """The live socket under a detached SSE response. http.client nulls
+    ``conn.sock`` in ``getresponse()`` for Connection: close responses
+    ("the connection passes to the response") — the fd stays open through
+    the response's buffered reader (``resp.fp``, a BufferedReader over
+    SocketIO), so recover the socket object from there."""
+    if getattr(upstream, "sock", None) is not None:
+        return upstream.sock
+    raw = getattr(getattr(resp, "fp", None), "raw", None)
+    return getattr(raw, "_sock", None)
+
+
+class _Conn:
+    """One client connection's state machine. States:
+
+    ``idle``        reading/awaiting a request (keep-alive included)
+    ``dispatched``  a worker is running the handler for its request
+    ``writing``     flushing a buffered response
+    ``streaming``   an SSE relay owns it (``stream`` is set)
+    ``closed``      socket gone (terminal)
+    """
+
+    __slots__ = ("sock", "fd", "addr", "inbuf", "outbuf", "out_off",
+                 "out_bytes", "state", "close_after", "last_activity",
+                 "stream", "mask", "defer_close")
+
+    def __init__(self, sock, addr):
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.addr = addr
+        self.inbuf = bytearray()
+        self.outbuf: collections.deque = collections.deque()
+        self.out_off = 0
+        self.out_bytes = 0
+        self.state = "idle"
+        self.close_after = False
+        self.last_activity = time.monotonic()
+        self.stream = None
+        self.mask = 0
+        # Close arrived while a worker may be mid-optimistic-send on this
+        # fd: the actual sock.close() is deferred to _on_handled so the
+        # OS can never reuse the fd number under the worker's send.
+        self.defer_close = False
+
+
+class _Stream:
+    """One detached SSE relay: upstream raw socket → client outbuf."""
+
+    __slots__ = ("conn", "det", "usock", "timeout_s",
+                 "last_upstream", "eof", "paused", "registered")
+
+    def __init__(self, conn: _Conn, det: dict, usock, timeout_s: float):
+        self.conn = conn
+        self.det = det
+        self.usock = usock
+        self.timeout_s = timeout_s
+        self.last_upstream = time.monotonic()
+        self.eof = False
+        self.paused = False
+        self.registered = False
+
+
+class EventLoopGateway:
+    """Drop-in transport for :class:`GatewayHTTPServer`: same four-method
+    surface (``serve_forever``/``shutdown``/``server_close``/
+    ``server_address``) plus ``drain(timeout_s)``, same handler-visible
+    server attributes (``_rate_samples``, ``_hedge_pool``,
+    ``_fanout_pool``, ``draining``). ``make_gateway`` picks it when
+    ``gateway.data_plane = "evloop"`` (the default)."""
+
+    allow_reuse_address = True
+
+    def __init__(self, server_address, RequestHandlerClass, *,
+                 config: GatewayConfig | None = None, metrics=None):
+        self.RequestHandlerClass = RequestHandlerClass
+        self.gwcfg = config if config is not None else GatewayConfig()
+        self.gw = metrics  # GatewayMetrics (loop_* instruments) or None
+        self.draining = False
+        # Handler-visible attributes (GatewayHTTPServer parity; the bound
+        # handler reads these off `self.server`).
+        self._rate_samples: collections.deque = collections.deque(maxlen=64)
+        self._hedge_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="gw-hedge")
+        self._fanout_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="gw-fanout")
+        self._offload = ThreadPoolExecutor(
+            max_workers=self.gwcfg.evloop_offload_workers,
+            thread_name_prefix="gw-offload")
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        try:
+            self._listener.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._listener.bind(server_address)
+            self._listener.listen(512)
+            self._listener.setblocking(False)
+        except BaseException:
+            self._listener.close()
+            self._hedge_pool.shutdown(wait=False)
+            self._fanout_pool.shutdown(wait=False)
+            self._offload.shutdown(wait=False)
+            raise
+        self.server_address = self._listener.getsockname()[:2]
+        self._selector = selectors.DefaultSelector()
+        # Cross-thread wakeup: worker callbacks append to the mailbox
+        # (deque.append is atomic) and poke the socketpair so a sleeping
+        # select returns immediately.
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._mailbox: collections.deque = collections.deque()
+        # Dispatches framed during a tick; submitted to the offload pool
+        # just before the loop parks in select (see serve_forever).
+        self._submits: list = []
+        self._in_select = False
+        self._conns: dict[int, _Conn] = {}
+        self._streams: set[_Stream] = set()
+        self._dispatched = 0
+        self._shutdown_request = threading.Event()
+        self._stopped = threading.Event()
+        self._stopped.set()  # not serving yet: shutdown() must not block
+        self._closed = False
+        self._drain_done: threading.Event | None = None
+        self._drain_deadline = 0.0
+        self._ticks: collections.deque = collections.deque(maxlen=512)
+        self._tick_count = 0
+
+    # ------------------------------------------------------------------
+    # lifecycle (ThreadingHTTPServer-parity surface)
+
+    def serve_forever(self, poll_interval: float = 0.5):
+        """Run the event loop on the calling thread until ``shutdown()``."""
+        self._stopped.clear()
+        interval = min(max(poll_interval, 0.01), 0.5)
+        self._selector.register(
+            self._listener, selectors.EVENT_READ, ("accept", None))
+        self._selector.register(
+            self._wake_r, selectors.EVENT_READ, ("wake", None))
+        last_sweep = time.monotonic()
+        try:
+            while not self._shutdown_request.is_set():
+                if self._submits:
+                    # Submit LAST, right before the loop parks: on a
+                    # busy box the worker can only run once this thread
+                    # releases the GIL inside select — submitting any
+                    # earlier in the tick just lengthens the handoff
+                    # (measured ~200us p50 at 3 kept-alive clients on
+                    # one core when submitted mid-tick, ~15us here).
+                    submits, self._submits = self._submits, []
+                    for raw, carry, conn in submits:
+                        future = self._offload.submit(
+                            self._run_handler, raw, carry, conn)
+                        future.add_done_callback(
+                            lambda f, c=conn: self._post(("handled", c, f)))
+                self._in_select = True
+                # A mailbox item that raced the end of the previous tick
+                # must not wait out a parked select: skip the park.
+                events = () if self._mailbox \
+                    else self._selector.select(interval)
+                self._in_select = False
+                t0 = time.perf_counter()
+                self._tick(events)
+                now = time.monotonic()
+                if now - last_sweep >= 1.0:
+                    last_sweep = now
+                    self._sweep(now)
+                if self._drain_done is not None:
+                    self._check_drain(now)
+                self._observe_tick(time.perf_counter() - t0, len(events))
+        finally:
+            for key in (self._listener, self._wake_r):
+                try:
+                    self._selector.unregister(key)
+                except (KeyError, ValueError):
+                    pass
+            self._shutdown_request.clear()
+            self._stopped.set()
+
+    def shutdown(self):
+        """Stop the loop and block until it exits (``BaseServer.shutdown``
+        parity). Open connections/streams are torn down by
+        ``server_close``, as on the threaded path."""
+        self._shutdown_request.set()
+        self._wake()
+        self._stopped.wait()
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Graceful drain: stop accepting, close idle keep-alives, let
+        in-flight requests and live SSE streams finish; after
+        ``timeout_s`` sever what remains — every severed stream runs its
+        deferred accounting as an abort (counted ``stream_aborts``), so
+        completed + aborted always equals opened: zero silent drops.
+        Callable from any thread; returns when the drain settles."""
+        self.draining = True
+        if self._stopped.is_set():
+            return  # loop not running: nothing in flight to wait on
+        done = threading.Event()
+        self._post(("drain", done, float(timeout_s)))
+        done.wait(float(timeout_s) + 10.0)
+
+    def server_close(self):
+        """Tear down sockets and executors. Safe without ``serve_forever``
+        ever having run; call ``shutdown()`` first when it has (the same
+        contract ``ThreadingHTTPServer`` imposes). Live streams still
+        open here run their deferred accounting inline as aborts."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            for stream in list(self._streams):
+                self._streams.discard(stream)
+                try:
+                    _run_stream_terminal(stream.det, ok=False, blame=False)
+                except Exception:
+                    logger.exception("evloop: teardown accounting failed")
+            for conn in list(self._conns.values()):
+                dispatched = conn.state == "dispatched"
+                conn.state = "closed"
+                if dispatched:
+                    # A worker may still be mid-optimistic-send here;
+                    # leave the fd to the socket object's finalizer
+                    # rather than risk fd reuse under the send.
+                    conn.defer_close = True
+                    continue
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            for sock in (self._listener, self._wake_r, self._wake_w):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._selector.close()
+        finally:
+            self._offload.shutdown(wait=False, cancel_futures=True)
+            self._hedge_pool.shutdown(wait=False, cancel_futures=True)
+            self._fanout_pool.shutdown(wait=False, cancel_futures=True)
+
+    # ------------------------------------------------------------------
+    # cross-thread mailbox
+
+    def _post(self, item) -> None:
+        """Any-thread → loop handoff: atomic append, plus a wakeup byte
+        only when the loop may be parked in select. A mid-tick append
+        needs no wake — the tick drains the mailbox on its way out, and
+        the pre-select mailbox check in serve_forever closes the race
+        between that drain and the park."""
+        self._mailbox.append(item)
+        if self._in_select:
+            self._wake()
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # wakeup already pending (buffer full) or torn down
+
+    # ------------------------------------------------------------------
+    # the loop
+
+    @event_loop
+    def _tick(self, events) -> None:
+        for key, mask in events:
+            kind, obj = key.data
+            if kind == "client":
+                if obj.state != "closed":
+                    self._client_ready(obj, mask)
+            elif kind == "upstream":
+                if obj.conn.stream is obj:
+                    self._upstream_ready(obj)
+            elif kind == "accept":
+                self._accept_ready()
+            else:  # wake
+                self._drain_wakeups()
+        while True:
+            try:
+                item = self._mailbox.popleft()
+            except IndexError:
+                break
+            if item[0] == "handled":
+                self._on_handled(item[1], item[2])
+            elif item[0] == "drain":
+                self._on_drain(item[1], item[2])
+
+    @event_loop
+    def _drain_wakeups(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    @event_loop
+    def _accept_ready(self) -> None:
+        cap = self.gwcfg.evloop_max_connections
+        for _ in range(128):
+            try:
+                sock, addr = self._listener.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            if self.draining:
+                sock.close()
+                continue
+            if cap and len(self._conns) >= cap:
+                if self.gw is not None:
+                    self.gw.loop_accept_backlog_drops.inc()
+                sock.close()
+                continue
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _Conn(sock, addr)
+            self._conns[conn.fd] = conn
+            self._selector.register(
+                sock, selectors.EVENT_READ, ("client", conn))
+            conn.mask = selectors.EVENT_READ
+
+    @event_loop
+    def _client_ready(self, conn: _Conn, mask: int) -> None:
+        if mask & selectors.EVENT_WRITE:
+            self._flush_client(conn)
+        if conn.state != "closed" and mask & selectors.EVENT_READ:
+            self._read_client(conn)
+
+    @event_loop
+    def _read_client(self, conn: _Conn) -> None:
+        for _ in range(8):
+            try:
+                data = conn.sock.recv(_READ_CHUNK)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._client_gone(conn)
+                return
+            if not data:
+                self._client_gone(conn)
+                return
+            conn.last_activity = time.monotonic()
+            if conn.state == "streaming":
+                continue  # one-way fan-through: drop client chatter
+            conn.inbuf += data
+            if conn.state != "idle" and len(conn.inbuf) > _MAX_HEADER_BYTES:
+                # Flooding ahead of its own response: abusive, close.
+                self._client_gone(conn)
+                return
+            if len(data) < _READ_CHUNK:
+                break
+        if conn.state == "idle":
+            self._maybe_dispatch(conn)
+
+    @event_loop
+    def _client_gone(self, conn: _Conn) -> None:
+        """EOF or socket error from the client. A streaming conn aborts
+        its relay (client-side cancel: counted, never blamed on the
+        replica); a dispatched conn closes now — ``_on_handled`` finds it
+        closed and routes any detach state straight to an abort."""
+        stream, conn.stream = conn.stream, None
+        self._close_client(conn)
+        if stream is not None:
+            self._streams.discard(stream)
+            self._unregister_upstream(stream)
+            self._finalize(stream.det, ok=False, blame=False)
+
+    @event_loop
+    def _close_client(self, conn: _Conn) -> None:
+        if conn.state == "closed":
+            return
+        deferred = conn.state == "dispatched"
+        conn.state = "closed"
+        self._conns.pop(conn.fd, None)
+        try:
+            self._selector.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        if deferred:
+            # A worker may be about to optimistic-send the response on
+            # this fd; closing now could hand the fd number to a fresh
+            # socket and misdeliver the bytes. The conn is already
+            # invisible to the loop (out of _conns, unregistered) —
+            # _on_handled performs the real close.
+            conn.defer_close = True
+            return
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    @event_loop
+    def _maybe_dispatch(self, conn: _Conn) -> None:
+        if conn.state != "idle":
+            return
+        try:
+            total = _frame_request(conn.inbuf)
+        except _BadRequest:
+            conn.outbuf.append(memoryview(_RESP_400))
+            conn.out_bytes += len(_RESP_400)
+            conn.close_after = True
+            conn.state = "writing"
+            self._flush_client(conn)
+            return
+        if total is None:
+            self._update_interest(conn)
+            return
+        raw = bytes(conn.inbuf[:total])
+        carry = bytes(conn.inbuf[total:])
+        conn.inbuf.clear()
+        conn.state = "dispatched"
+        self._dispatched += 1
+        # The worker owns the socket exclusively while dispatched — it
+        # may read the next pipelined/sticky request straight off the fd
+        # — so the loop must stop watching it (two concurrent readers
+        # would interleave frames). mask == 0 records "unregistered";
+        # _update_interest re-registers on the way back. Bytes already
+        # read past the framed request travel with the dispatch (carry)
+        # and come back via the result's leftover.
+        try:
+            self._selector.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        conn.mask = 0
+        # Queued, not submitted: serve_forever flushes this right before
+        # it parks in select so the offload worker starts the moment the
+        # loop releases the GIL, not after the rest of the tick.
+        self._submits.append((raw, carry, conn))
+
+    def _run_handler(self, raw: bytes, carry: bytes, conn: _Conn):
+        """Offload worker: run the bound gateway handler against an
+        in-memory request/response pair (the 'pseudo-handler' — same
+        class, same ``handle_one_request``, same control plane as the
+        threaded path; only the transport differs). Returns
+        ``(response_bytes, close_connection, detach_state, sent,
+        leftover)``.
+
+        ``sent`` is the optimistic DIRECT send: while this connection is
+        dispatched the worker owns its socket outright (the loop has
+        unregistered the fd, never writes it, and defers any close to
+        _on_handled), so the worker pushes the response bytes itself —
+        no mailbox-wakeup loop round-trip on the latency path. The
+        socket is non-blocking; whatever doesn't fit is flushed by the
+        loop. ``sent == -1`` means the client vanished under the send.
+
+        After a FULLY sent keep-alive response the worker goes sticky:
+        it camps on the socket up to ``_STICK_S`` for the client's next
+        request and handles it in place — request N+1 never touches the
+        loop while the conversation stays hot. ``leftover`` is whatever
+        trailing bytes the worker read past the last request it framed;
+        they go back into the conn's inbuf."""
+        handler_cls = self.RequestHandlerClass
+        buf = bytearray(carry)
+        while True:
+            h = handler_cls.__new__(handler_cls)
+            h.server = self
+            h.client_address = conn.addr
+            h.connection = None
+            h.request = None
+            h.rfile = io.BytesIO(raw)
+            h.wfile = io.BytesIO()
+            h.close_connection = True
+            try:
+                h.handle_one_request()
+            except Exception:
+                # Threaded parity: an exploding handler thread drops the
+                # connection; here the worker survives and the loop
+                # closes it.
+                logger.exception("evloop: handler raised")
+                return b"", True, None, 0, bytes(buf)
+            det = getattr(h, "_evloop_detached", None)
+            body = h.wfile.getvalue()
+            sent = 0
+            if body and not conn.defer_close:
+                try:
+                    sent = conn.sock.send(body)
+                except (BlockingIOError, InterruptedError):
+                    sent = 0
+                except OSError:
+                    sent = -1
+            if (det is not None or h.close_connection or not body
+                    or sent != len(body) or conn.defer_close
+                    or self.draining):
+                return body, h.close_connection, det, sent, bytes(buf)
+            nxt = self._next_request(conn, buf)
+            if nxt is None:
+                return body, False, None, sent, bytes(buf)
+            raw = nxt
+
+    def _next_request(self, conn: _Conn, buf: bytearray) -> bytes | None:
+        """Sticky read (offload worker, never the loop): frame the next
+        request from ``buf``/the socket, waiting up to ``_STICK_S`` for
+        it to arrive. ``None`` hands the connection back to the loop —
+        on timeout, worker scarcity, EOF, error, or a frame the loop
+        should 400 itself (bad bytes stay in ``buf`` for the loop's own
+        framing to reject, so the 400-and-close path stays in one
+        place)."""
+        deadline = time.monotonic() + _STICK_S
+        while True:
+            try:
+                total = _frame_request(buf)
+            except _BadRequest:
+                return None
+            if total is not None:
+                raw = bytes(buf[:total])
+                del buf[:total]
+                return raw
+            # Scarcity guard: camping is only free while most workers
+            # are idle. _dispatched is loop-owned; a stale read just
+            # ends one stick early/late — never corrupts state.
+            if (self.draining or conn.defer_close
+                    or self._dispatched * 2 >
+                    self.gwcfg.evloop_offload_workers):
+                return None
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                ready, _, _ = select.select([conn.sock], [], [], remaining)
+            except (OSError, ValueError):
+                return None
+            if not ready:
+                return None
+            try:
+                data = conn.sock.recv(_READ_CHUNK)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError:
+                return None  # loop's re-registered READ will see the error
+            if not data:
+                return None  # EOF: ditto, recv()==b"" on the loop side
+            buf += data
+
+    @event_loop
+    def _on_handled(self, conn: _Conn, future) -> None:
+        self._dispatched -= 1
+        try:
+            body, close_conn, det, sent, leftover = future.result()
+        except Exception:
+            logger.exception("evloop: offload dispatch failed")
+            body, close_conn, det, sent, leftover = b"", True, None, 0, b""
+        if conn.state == "closed":
+            # Client went away mid-dispatch: nothing to deliver; a
+            # detached stream aborts with its accounting intact.
+            if det is not None:
+                self._finalize(det, ok=False, blame=False)
+            if conn.defer_close:
+                conn.defer_close = False
+                try:
+                    conn.sock.close()
+                except OSError:
+                    pass
+            return
+        if leftover:
+            conn.inbuf += leftover
+        if sent < 0 or not body:
+            if det is not None:
+                self._finalize(det, ok=False, blame=False)
+            self._close_client(conn)
+            return
+        if sent < len(body):
+            conn.outbuf.append(memoryview(body)[sent:])
+            conn.out_bytes += len(body) - sent
+        conn.last_activity = time.monotonic()
+        if det is not None:
+            self._start_stream(conn, det)
+        else:
+            conn.close_after = bool(close_conn) or self.draining
+            conn.state = "writing"
+        if conn.state != "closed":
+            self._flush_client(conn)
+
+    @event_loop
+    def _start_stream(self, conn: _Conn, det: dict) -> None:
+        """Take ownership of a detached SSE relay: flip the upstream
+        socket non-blocking, drain any bytes http.client buffered past
+        the worker's first-chunk read, then relay readiness-driven until
+        upstream EOF (SSE is close-delimited)."""
+        upstream = det["conn"]
+        timeout_s = getattr(upstream, "timeout", None) \
+            or self.gwcfg.request_timeout_s
+        usock = _stream_socket(upstream, det.get("resp"))
+        stream = _Stream(conn, det, usock, float(timeout_s))
+        try:
+            usock.setblocking(False)
+        except (OSError, AttributeError):
+            conn.state = "streaming"
+            conn.stream = stream
+            self._streams.add(stream)
+            self._end_stream(stream, ok=False, blame=True)
+            return
+        # Residue sweep: the worker's read1(64 KiB) drains http.client's
+        # 8 KiB BufferedReader, but be robust to buffering changes — pull
+        # whatever is still buffered before handing the raw fd to the
+        # selector. A falsy chunk here is AMBIGUOUS (on a non-blocking
+        # raw, read1 returns b"" for "no data yet" as well as for EOF),
+        # so never infer upstream close from it: register the raw socket
+        # and let recv() == b"" — which is unambiguous — end the stream.
+        fp = getattr(det.get("resp"), "fp", None)
+        while fp is not None:
+            try:
+                chunk = fp.read1(_READ_CHUNK)
+            except (BlockingIOError, ValueError, OSError):
+                break
+            if not chunk:
+                break
+            conn.outbuf.append(memoryview(chunk))
+            conn.out_bytes += len(chunk)
+        conn.state = "streaming"
+        conn.stream = stream
+        self._streams.add(stream)
+        self._register_upstream(stream)
+        self._update_interest(conn)
+
+    @event_loop
+    def _register_upstream(self, stream: _Stream) -> None:
+        if stream.registered:
+            return
+        try:
+            self._selector.register(
+                stream.usock, selectors.EVENT_READ, ("upstream", stream))
+            stream.registered = True
+        except (KeyError, ValueError, OSError):
+            self._end_stream(stream, ok=False, blame=True)
+
+    @event_loop
+    def _unregister_upstream(self, stream: _Stream) -> None:
+        if not stream.registered:
+            return
+        stream.registered = False
+        try:
+            self._selector.unregister(stream.usock)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    @event_loop
+    def _upstream_ready(self, stream: _Stream) -> None:
+        conn = stream.conn
+        for _ in range(8):
+            try:
+                data = stream.usock.recv(_READ_CHUNK)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._end_stream(stream, ok=False, blame=True)
+                return
+            if not data:
+                stream.eof = True
+                self._unregister_upstream(stream)
+                if not conn.outbuf:
+                    self._end_stream(stream, ok=True, blame=False)
+                else:
+                    self._flush_client(conn)  # finish once drained
+                return
+            stream.last_upstream = time.monotonic()
+            conn.outbuf.append(memoryview(data))
+            conn.out_bytes += len(data)
+            if len(data) < _READ_CHUNK:
+                break
+        if conn.out_bytes > _OUTBUF_PAUSE and not stream.paused:
+            # Slow client: park the upstream fd until the outbuf drains.
+            stream.paused = True
+            self._unregister_upstream(stream)
+        self._flush_client(conn)
+
+    @event_loop
+    def _flush_client(self, conn: _Conn) -> None:
+        if conn.state == "closed":
+            return
+        while conn.outbuf:
+            buf = conn.outbuf[0]
+            view = buf[conn.out_off:] if conn.out_off else buf
+            try:
+                sent = conn.sock.send(view)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError:
+                self._client_gone(conn)
+                return
+            conn.out_bytes -= sent
+            conn.last_activity = time.monotonic()
+            if conn.out_off + sent == len(buf):
+                conn.outbuf.popleft()
+                conn.out_off = 0
+            else:
+                conn.out_off += sent
+                break
+        if not conn.outbuf:
+            self._outbuf_drained(conn)
+        if conn.state != "closed":
+            self._update_interest(conn)
+
+    @event_loop
+    def _outbuf_drained(self, conn: _Conn) -> None:
+        if conn.state == "writing":
+            if conn.close_after or self.draining:
+                self._close_client(conn)
+            else:
+                conn.state = "idle"
+                self._maybe_dispatch(conn)  # pipelined next request
+        elif conn.state == "streaming":
+            stream = conn.stream
+            if stream is None:
+                return
+            if stream.eof:
+                self._end_stream(stream, ok=True, blame=False)
+            elif stream.paused:
+                stream.paused = False
+                self._register_upstream(stream)
+
+    @event_loop
+    def _end_stream(self, stream: _Stream, ok: bool, blame: bool) -> None:
+        conn = stream.conn
+        if conn.stream is not stream:
+            return  # already ended
+        conn.stream = None
+        self._streams.discard(stream)
+        self._unregister_upstream(stream)
+        self._close_client(conn)  # SSE is close-delimited: EOF = done
+        self._finalize(stream.det, ok=ok, blame=blame)
+
+    def _finalize(self, det: dict, ok: bool, blame: bool) -> None:
+        """Hand the deferred terminal accounting to a worker (it writes
+        usage ledgers — not loop work); inline only if the executor is
+        already torn down."""
+        try:
+            self._offload.submit(_run_stream_terminal, det, ok, blame)
+        except RuntimeError:
+            _run_stream_terminal(det, ok, blame)
+
+    # ------------------------------------------------------------------
+    # housekeeping
+
+    @event_loop
+    def _update_interest(self, conn: _Conn) -> None:
+        if conn.state in ("dispatched", "closed"):
+            # A dispatched conn's fd belongs to its worker (and a closed
+            # one is gone): _flush_client's tail reaches here after
+            # _outbuf_drained may have re-dispatched a pipelined request
+            # — re-registering now would put two readers on one socket.
+            return
+        mask = selectors.EVENT_READ
+        if conn.outbuf:
+            mask |= selectors.EVENT_WRITE
+        if mask == conn.mask:
+            return
+        prev, conn.mask = conn.mask, mask
+        try:
+            if prev:
+                self._selector.modify(conn.sock, mask, ("client", conn))
+            else:
+                # mask 0 = unregistered (the dispatch window, where the
+                # worker owns the fd): coming back means register anew.
+                self._selector.register(conn.sock, mask, ("client", conn))
+        except (KeyError, ValueError, OSError):
+            self._close_client(conn)
+
+    @event_loop
+    def _sweep(self, now: float) -> None:
+        """Close idle keep-alives past the idle cap (threaded parity:
+        KeepAliveHandlerMixin.timeout) and abort streams whose upstream
+        went silent past its per-read timeout (threaded parity: the
+        pooled socket's settimeout → OSError → aborted)."""
+        idle_cap = self.gwcfg.evloop_idle_timeout_s
+        for conn in list(self._conns.values()):
+            if (conn.state == "idle" and not conn.outbuf
+                    and now - conn.last_activity > idle_cap):
+                self._close_client(conn)
+        for stream in list(self._streams):
+            if now - stream.last_upstream > stream.timeout_s:
+                self._end_stream(stream, ok=False, blame=True)
+
+    @event_loop
+    def _on_drain(self, done: threading.Event, timeout_s: float) -> None:
+        self.draining = True
+        self._drain_done = done
+        self._drain_deadline = time.monotonic() + timeout_s
+        for conn in list(self._conns.values()):
+            if conn.state == "idle" and not conn.inbuf and not conn.outbuf:
+                self._close_client(conn)
+
+    @event_loop
+    def _check_drain(self, now: float) -> None:
+        if not self._dispatched and not self._streams \
+                and not any(c.outbuf for c in self._conns.values()):
+            done, self._drain_done = self._drain_done, None
+            done.set()
+            return
+        if now < self._drain_deadline:
+            return
+        # Deadline: sever survivors. Streams run their deferred
+        # accounting as aborts (counted stream_aborts — no silent drops);
+        # dispatched requests finish on their workers and find the
+        # connection closed.
+        for stream in list(self._streams):
+            self._end_stream(stream, ok=False, blame=False)
+        for conn in list(self._conns.values()):
+            self._close_client(conn)
+        if not self._dispatched:
+            done, self._drain_done = self._drain_done, None
+            done.set()
+
+    @event_loop
+    def _observe_tick(self, duration: float, n_ready: int) -> None:
+        gw = self.gw
+        if gw is None:
+            return
+        self._ticks.append(duration)
+        self._tick_count += 1
+        gw.loop_tick.observe(duration)
+        gw.loop_ready_queue_depth.set(float(n_ready))
+        gw.loop_open_connections.set(float(len(self._conns)))
+        gw.loop_open_sse_streams.set(float(len(self._streams)))
+        if self._tick_count % 128 == 0:
+            ordered = sorted(self._ticks)
+            gw.loop_tick_p95.set(
+                ordered[int(0.95 * (len(ordered) - 1))])

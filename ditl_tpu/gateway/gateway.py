@@ -76,6 +76,14 @@ __all__ = ["GatewayMetrics", "make_gateway", "main"]
 
 PREFIX = "ditl_gateway"
 
+# Loop ticks are sub-millisecond when healthy; the serving-latency
+# buckets (5ms floor) would put every healthy tick in the first bucket
+# and hide a 10x regression. A tick in the right tail means something
+# blocked the loop (troubleshooting §35).
+LOOP_TICK_BUCKETS_S = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
 
 class _HedgeQueueTimeout(OSError):
     """A relay attempt expired in the hedge executor's queue before its
@@ -189,6 +197,37 @@ class GatewayMetrics:
             f"{PREFIX}_pool_idle",
             "idle kept-alive upstream connections currently parked in "
             "the pool")
+        # Event-loop data plane (ISSUE 17): the loop's own health family.
+        # All zero on the threaded fallback. Tick time is PROCESSING time
+        # per loop iteration (select return -> work drained), not the
+        # select wait; the p95 gauge is maintained by the loop itself over
+        # its recent tick window so a scrape never reads the histogram's
+        # buckets cross-thread mid-update.
+        self.loop_open_connections = r.gauge(
+            f"{PREFIX}_loop_open_connections",
+            "client connections currently held by the event-loop data "
+            "plane (0 on the threaded fallback)")
+        self.loop_open_sse_streams = r.gauge(
+            f"{PREFIX}_loop_open_sse_streams",
+            "SSE relays currently fanned through the event loop without "
+            "a parked thread")
+        self.loop_tick = r.histogram(
+            f"{PREFIX}_loop_tick_seconds",
+            "event-loop tick processing time (select return -> work "
+            "drained; a stalled loop shows here first)",
+            LOOP_TICK_BUCKETS_S)
+        self.loop_tick_p95 = r.gauge(
+            f"{PREFIX}_loop_tick_p95_s",
+            "p95 loop-tick processing time over the loop's recent tick "
+            "window (loop-maintained mirror; troubleshooting §35)")
+        self.loop_ready_queue_depth = r.gauge(
+            f"{PREFIX}_loop_ready_queue_depth",
+            "file descriptors the last selector poll returned ready "
+            "(sustained high depth = the loop is the bottleneck)")
+        self.loop_accept_backlog_drops = r.counter(
+            f"{PREFIX}_loop_accept_backlog_drops",
+            "accepted client connections dropped at the "
+            "gateway.evloop_max_connections cap")
 
     # Each distinct tenant label becomes its own metric family; tenants
     # arrive as arbitrary unauthenticated bearer tokens, so beyond this
@@ -784,7 +823,14 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             try:
                 self._admit_and_route(path, payload, raw, span=span)
             finally:
-                span.end()
+                det = getattr(self, "_evloop_detached", None)
+                if det is None:
+                    span.end()
+                else:
+                    # Evloop SSE detach (ISSUE 17): the stream outlives
+                    # this handler invocation — the loop ends the root
+                    # span at stream end, after the relay span.
+                    det["root"] = span
         elif path.endswith(("/tokenize", "/detokenize")):
             # Metadata routes: cheap, not admission-controlled, and kept
             # OUT of the serving instruments (record=False) — a stream of
@@ -912,21 +958,47 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                                             slo_class=pinned_class,
                                             tenant=label)
         finally:
-            if self.admission is not None:
-                self.admission.release(tenant)
-            m.e2e.observe(time.time() - t0)
-            if self.usage is not None:
-                # One gateway-edge usage row per admitted request — the
-                # outcome the CLIENT saw (fleet 429/503/504s included),
-                # next to the engine-side rows the replicas ledger.
-                self.usage.record(
-                    tenant=label, outcome=outcome,
-                    slo_class=(pinned_class or self._client_class(payload)
-                               or "default"),
-                    prompt_tokens=prompt_token_estimate(payload),
-                    stream=bool(payload.get("stream")),
-                    e2e_s=round(time.time() - t0, 6),
-                )
+            det = (getattr(self, "_evloop_detached", None)
+                   if outcome == "detached" else None)
+            if det is not None:
+                # Evloop SSE detach (ISSUE 17): the request is still in
+                # flight — it holds its admission slot and its e2e clock
+                # until the loop sees the stream end. Everything below
+                # runs then, via this closure, with the outcome the
+                # CLIENT actually saw.
+                def _finish(final_outcome: str) -> None:
+                    if self.admission is not None:
+                        self.admission.release(tenant)
+                    m.e2e.observe(time.time() - t0)
+                    if self.usage is not None:
+                        self.usage.record(
+                            tenant=label, outcome=final_outcome,
+                            slo_class=(pinned_class
+                                       or self._client_class(payload)
+                                       or "default"),
+                            prompt_tokens=prompt_token_estimate(payload),
+                            stream=True,
+                            e2e_s=round(time.time() - t0, 6),
+                        )
+                det["finish"] = _finish
+            else:
+                if self.admission is not None:
+                    self.admission.release(tenant)
+                m.e2e.observe(time.time() - t0)
+                if self.usage is not None:
+                    # One gateway-edge usage row per admitted request —
+                    # the outcome the CLIENT saw (fleet 429/503/504s
+                    # included), next to the engine-side rows the
+                    # replicas ledger.
+                    self.usage.record(
+                        tenant=label, outcome=outcome,
+                        slo_class=(pinned_class
+                                   or self._client_class(payload)
+                                   or "default"),
+                        prompt_tokens=prompt_token_estimate(payload),
+                        stream=bool(payload.get("stream")),
+                        e2e_s=round(time.time() - t0, 6),
+                    )
 
     def _client_class(self, payload: dict) -> str | None:
         """The SLO class the CLIENT asked for (validated header, else
@@ -1083,15 +1155,23 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     tenant=tenant,
                 )
             finally:
-                self.fleet.dec_outstanding(view.id)
-                if rspan is not None:
-                    if outcome == "done" and info and info != view.id:
-                        # A hedged peer served: THIS attempt lost — its
-                        # span must not read as the one that answered (the
-                        # winner's hedge span carries outcome="won").
-                        rspan.end(outcome="lost", served_by=info)
-                    else:
-                        rspan.end(outcome=outcome)
+                if outcome == "detached":
+                    # Evloop SSE detach (ISSUE 17): the stream is still
+                    # live — it stays outstanding (it IS load on the
+                    # replica) and its relay span stays open; the loop
+                    # runs both at stream end via the closure below.
+                    pass
+                else:
+                    self.fleet.dec_outstanding(view.id)
+                    if rspan is not None:
+                        if outcome == "done" and info and info != view.id:
+                            # A hedged peer served: THIS attempt lost —
+                            # its span must not read as the one that
+                            # answered (the winner's hedge span carries
+                            # outcome="won").
+                            rspan.end(outcome="lost", served_by=info)
+                        else:
+                            rspan.end(outcome=outcome)
             if outcome == "done":
                 if record:
                     self._note_affinity(key, info or view.id)
@@ -1099,6 +1179,27 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     m.class_counter("relayed", eff_class).inc()
                     self._sample_rate()
                 return "200"
+            if outcome == "detached":
+                # The loop owns both sockets now; the deferred half of
+                # the "done"/"aborted" bookkeeping above runs when it
+                # sees the stream end.
+                det = self._evloop_detached
+                served_id = info or view.id
+
+                def _complete(ok: bool) -> None:
+                    if ok:
+                        if record:
+                            self._note_affinity(key, served_id)
+                            m.completed.inc()
+                            m.class_counter("relayed", eff_class).inc()
+                            self._sample_rate()
+                    else:
+                        # Bytes already relayed; nothing more the
+                        # gateway can do (same terminal as "aborted").
+                        m.stream_aborts.inc()
+                    self.fleet.dec_outstanding(view.id)
+                det["complete"] = _complete
+                return "detached"
             if outcome == "aborted":
                 # Bytes already relayed; nothing more the gateway can do.
                 m.stream_aborts.inc()
@@ -1428,7 +1529,17 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             if stream and ctype.startswith("text/event-stream"):
                 # SSE responses are close-delimited (the replica sends
                 # Connection: close by design); never pooled.
-                return (self._relay_stream(view, resp, ctype), served)
+                out = self._relay_stream(view, resp, ctype)
+                if out == "detached":
+                    # Evloop data plane (ISSUE 17): the loop takes the
+                    # upstream socket — the finally below must NOT
+                    # discard the live connection; the loop discards it
+                    # (counted, as on the threaded path) at stream end.
+                    self._evloop_detached.update(
+                        conn=conn, served=served, rspan=span, handler=self,
+                    )
+                    conn = None
+                return (out, served)
             try:
                 data = resp.read()
             except (OSError, http.client.HTTPException):
@@ -1444,7 +1555,9 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             self.wfile.write(data)
             return ("done", served)
         finally:
-            if reusable:
+            if conn is None:
+                pass  # detached: the event loop owns the socket now
+            elif reusable:
                 self.fleet.pool.checkin(served, conn, response=resp)
             else:
                 self.fleet.pool.discard(conn)
@@ -1617,6 +1730,49 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 self.affinity_last.popitem(last=False)
 
 
+class _EvloopGatewayHandler(_GatewayHandler):
+    """The handler the event-loop data plane (gateway/evloop.py, ISSUE 17)
+    runs on its offload workers: identical control plane, one override —
+    an SSE relay reads its FIRST upstream chunk here (preserving the
+    retry-on-dead-start contract), then DETACHES instead of looping: the
+    event loop takes both raw sockets and fans chunks through without
+    this worker parked for the stream's lifetime. ``_evloop_detached``
+    carries the deferred terminal state (span ends, admission release,
+    usage row, pool discard) the loop runs at stream end."""
+
+    # Per-request detach state. The loop builds one handler instance per
+    # request (gateway/evloop.py _run_handler), so instance state here is
+    # exactly as private as _rid/_adapter_pin on the threaded path.
+    _evloop_detached: dict | None = None
+
+    def _relay_stream(self, view, resp, ctype) -> str:
+        # First chunk on the worker, blocking — a replica dying at stream
+        # start stays retryable, exactly like the threaded path. This
+        # read also drains http.client's internal BufferedReader (8 KiB,
+        # < the 64 KiB ask), so after detach the raw socket is the only
+        # byte source left (evloop.py re-checks for residue anyway).
+        try:
+            first = resp.read1(65536)
+        except (OSError, http.client.HTTPException):
+            self.fleet.note_failure(view.id)
+            return "retry"
+        self.send_response(resp.status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("X-Request-Id", self._request_id())
+        self.send_header("Cache-Control", "no-cache")
+        # Close-delimited, as on the threaded path (ISSUE 14).
+        self.send_header("Connection", "close")
+        self.end_headers()
+        if not first:
+            # Upstream closed with an empty body: headers-only relay,
+            # terminal here (threaded parity) — nothing to detach.
+            return "done"
+        self.wfile.write(first)
+        self.close_connection = True
+        self._evloop_detached = {"view": view, "resp": resp}
+        return "detached"
+
+
 def make_gateway(
     fleet: Fleet,
     *,
@@ -1636,7 +1792,7 @@ def make_gateway(
     kvtier=None,
     journal=None,
     usage=None,
-) -> GatewayHTTPServer:
+):
     """Build (not start) the gateway server over ``fleet`` — tests drive it
     on a thread, ``main`` drives it with ``serve_forever``. ``router``
     defaults to the config's policy; ``admission`` defaults to the config's
@@ -1658,7 +1814,11 @@ def make_gateway(
     decisions. ``usage`` (telemetry/usage.UsageLedger) arms the
     gateway-edge usage ledger: one row per admission-controlled request
     with the tenant digest, class, and terminal outcome (ISSUE 15) —
-    unarmed by default."""
+    unarmed by default. ``config.data_plane`` picks the transport
+    (ISSUE 17): the selectors event loop (gateway/evloop.py, the
+    default) or the legacy thread-per-connection ``GatewayHTTPServer`` —
+    both expose the same serve_forever/shutdown/server_close/
+    server_address surface, so callers never branch."""
     config = config or GatewayConfig()
     # Upstream keep-alive pool caps (ISSUE 14): the fleet owns the pool
     # (health polls and fleet-mutation invalidation need it gateway or
@@ -1692,9 +1852,11 @@ def make_gateway(
         fleet, journal=journal, registry=gw_metrics.registry,
         timeout_s=config.request_timeout_s,
     )
+    base = (_EvloopGatewayHandler if config.data_plane == "evloop"
+            else _GatewayHandler)
     handler = type(
         "BoundGatewayHandler",
-        (_GatewayHandler,),
+        (base,),
         {
             "fleet": fleet,
             "router": router,
@@ -1715,11 +1877,16 @@ def make_gateway(
             "publisher": publisher,
         },
     )
-    return GatewayHTTPServer(
-        (host if host is not None else config.host,
-         port if port is not None else config.port),
-        handler,
-    )
+    address = (host if host is not None else config.host,
+               port if port is not None else config.port)
+    if config.data_plane == "evloop":
+        # Event-loop data plane (ISSUE 17): same bound handler (run on
+        # offload workers), same 4-method server surface
+        # (serve_forever/shutdown/server_close/server_address).
+        from ditl_tpu.gateway.evloop import EventLoopGateway
+        return EventLoopGateway(address, handler, config=config,
+                                metrics=gw_metrics)
+    return GatewayHTTPServer(address, handler)
 
 
 def main(argv: list[str] | None = None) -> int:

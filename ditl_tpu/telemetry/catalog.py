@@ -130,6 +130,12 @@ _ROWS: tuple = (
     ("ditl_gateway_handoff_fallback_total", "counter", "", "accepted handoffs that failed mid-leg and fell back to plain relay (the decode replica re-prefills)"),
     ("ditl_gateway_handoff_shipped_total", "counter", "", "prefill->decode KV handoffs shipped to the decode replica"),
     ("ditl_gateway_hedges_total", "counter", "", "hedged duplicate requests fired"),
+    ("ditl_gateway_loop_accept_backlog_drops_total", "counter", "", "client connects refused at accept because gateway.evloop_max_connections was reached (evloop data plane)"),
+    ("ditl_gateway_loop_open_connections", "gauge", "", "client connections currently owned by the evloop data plane (any state)"),
+    ("ditl_gateway_loop_open_sse_streams", "gauge", "", "detached SSE relays the event loop is currently pumping (no thread parked per stream)"),
+    ("ditl_gateway_loop_ready_queue_depth", "gauge", "", "fds the last selector wakeup reported ready - sustained depth means the loop is the bottleneck"),
+    ("ditl_gateway_loop_tick_p95_s", "gauge", "", "p95 event-loop tick over the last 512 ticks - the loop-stall early-warning signal (troubleshooting 35)"),
+    ("ditl_gateway_loop_tick_seconds", "histogram", "", "one selector wakeup: dispatch every ready fd + drain the worker mailbox"),
     ("ditl_gateway_no_replica_total", "counter", "", "requests failed with no live replica"),
     ("ditl_gateway_pool_discards", "gauge", "", "pooled upstream connections discarded (stale socket, age/idle cap, mid-request error, or fleet-mutation invalidation; lifetime, stats mirror)"),
     ("ditl_gateway_pool_hits", "gauge", "", "pooled upstream connections reused across relays/polls/probes (lifetime, stats mirror)"),

@@ -94,10 +94,13 @@ class BurnRateMonitor:
         self.burn_alert = float(burn_alert)
         self._samples: collections.deque = collections.deque()  # guarded-by: _lock
         # Concurrent scrapes (Prometheus on /metrics while a dashboard hits
-        # /slo — both handler threads of the same ThreadingHTTPServer reach
-        # the one shared monitor) would otherwise mutate the deque mid-
-        # iteration in report(); sampling is scrape-path only, so a plain
-        # lock costs nothing on the serving hot path.
+        # /slo) reach the one shared monitor from different threads — the
+        # handler threads of a ThreadingHTTPServer on replicas and the
+        # threaded gateway, the offload-pool workers ("gw-offload") on the
+        # evloop gateway, where the loop thread itself never runs handler
+        # code. Either way two scrapes can overlap and would mutate the
+        # deque mid-iteration in report(); sampling is scrape-path only,
+        # so a plain lock costs nothing on the serving hot path.
         self._lock = threading.Lock()
         # Optional Prometheus surface: burn-rate gauges set at report()
         # time into the caller's registry, so /metrics carries the same

@@ -1,0 +1,22 @@
+"""@event_loop functions with every blocking spelling the rule flags."""
+import time
+
+from ditl_tpu.annotations import event_loop
+
+
+class Loop:
+    @event_loop
+    def tick(self, sock, worker):
+        time.sleep(0.1)                        # line 10: sleep
+        sock.sendall(b"x")                     # line 11: .sendall
+        worker.join()                          # line 12: .join
+        with self._lock:                       # line 13: un-witnessed lock
+            self.n += 1
+        with self._lock:  # guarded-by: n
+            self.n += 1                        # witnessed: silent
+        time.sleep(0)  # ditl: allow(event-loop-hygiene) -- fixture: loop warm-up shim
+        sock.send(b"y")                        # .send: never flagged
+        return sock.recv(1)                    # .recv: never flagged
+
+    def unmarked(self, sock):
+        time.sleep(1)  # not @event_loop: never flagged

@@ -202,6 +202,20 @@ def test_tenant_label_discipline_fixture():
     # the wrapped spellings (sanitize_label/tenant_label) stay silent.
 
 
+def test_event_loop_hygiene_fixture():
+    diags = run(fixture("evloop"), rules=["event-loop-hygiene"])
+    assert ids(diags) == [
+        ("event-loop-hygiene", 10),  # sleep
+        ("event-loop-hygiene", 11),  # .sendall
+        ("event-loop-hygiene", 12),  # .join
+        ("event-loop-hygiene", 13),  # un-witnessed with self._lock
+    ]
+    assert all("Loop.tick" in d.message for d in diags)
+    # .send/.recv (non-blocking by construction on loop-owned sockets),
+    # the guarded-by-witnessed lock, the pragma'd sleep, and the unmarked
+    # method all stay silent.
+
+
 def test_every_rule_has_a_violating_fixture():
     """Acceptance: the analyzer exits non-zero on every fixture violation
     class — each registered rule fires on its fixture."""
@@ -221,6 +235,7 @@ def test_every_rule_has_a_violating_fixture():
         "config-drift": (fixture("configdoc"), configdoc_settings),
         "metric-catalog": (fixture("metrics"), None),
         "tenant-label-discipline": (fixture("tenant"), None),
+        "event-loop-hygiene": (fixture("evloop"), None),
     }
     assert set(per_rule) == set(RULES), (
         "new rule registered without a violating fixture — add one under "
