@@ -1756,6 +1756,13 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
     (``gateway_rps_metered``, ``metering_overhead_ratio``) that
     perf_compare gates — metering overhead is measured, never assumed.
 
+    A profiler-on leg always runs (ISSUE 18): a second evloop gateway
+    with the continuous sampling profiler and the loop-lag watchdog
+    armed drives the same closed loop, and the row gains a
+    ``profiler_overhead`` block whose ``prof_vs_off_rps_ratio``
+    perf_compare gates inside the same-box noise floor — the sampler
+    stays always-on only while this number says it is free.
+
     The hoisted ``gateway_overhead`` block embeds requests/sec through
     the gateway, the added latency vs the direct leg (p50/p95), and the
     upstream pool's hit ratio + accepted-connection count;
@@ -2041,6 +2048,61 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
         finally:
             server_t.shutdown()
             server_t.server_close()
+        # Profiler-on A/B leg (ISSUE 18): a second evloop gateway over the
+        # same fleet with the continuous sampler AND the loop-lag watchdog
+        # armed — the measured price of leaving "what code was running"
+        # observability on in production. Gated via prof_vs_off_rps_ratio
+        # (profiler-on rps / profiler-off rps, direction +1) inside the
+        # same-box noise floor: the sampler is cheap enough to stay on, or
+        # this gate says it is not.
+        from ditl_tpu.config import TelemetryConfig
+        prof_hz = 97.0
+        server_p = make_gateway(
+            fleet, config=gwcfg, metrics=GatewayMetrics(), port=0,
+            telemetry=TelemetryConfig(prof_hz=prof_hz,
+                                      loop_stall_threshold_s=0.25),
+        )
+        threading.Thread(target=server_p.serve_forever, daemon=True,
+                         name="gw-prof").start()
+        try:
+            p_port = server_p.server_address[1]
+            warm_conn = http.client.HTTPConnection("127.0.0.1", p_port,
+                                                   timeout=30.0)
+            try:
+                for _ in range(4):
+                    warm_conn.request(
+                        "POST", "/v1/completions", body=payload,
+                        headers={"Content-Type": "application/json"})
+                    warm_conn.getresponse().read()
+            finally:
+                warm_conn.close()
+            # Palindromic pairing against the still-live profiler-off
+            # gateway (the same estimator the threaded leg uses): both
+            # sides share the same mean position in time, so box drift
+            # cancels to first order and the median sheds spikes.
+            n_slices_p = 4 if per_client >= 4 else 1
+            sizes_p = [per_client // n_slices_p] * n_slices_p
+            sizes_p[-1] += per_client % n_slices_p
+            p_dt = 0.0
+            p_lats = []
+            p_pair_ratios = []
+            for i, slice_n in enumerate(sizes_p):
+                order = ((gw_port, p_port) if i % 2 == 0
+                         else (p_port, gw_port))
+                pair_dt = {}
+                for port in order:
+                    dt, lats = closed_loop(port, n_per_client=slice_n)
+                    pair_dt[port] = dt
+                    if port == p_port:
+                        p_dt += dt
+                        p_lats.extend(lats)
+                p_pair_ratios.append(pair_dt[gw_port] / pair_dt[p_port])
+            ratio_prof_vs_off = statistics.median(p_pair_ratios)
+            p_samples = server_p.profiler.samples
+            p_stalls = server_p.watchdog.stalls
+        finally:
+            server_p.shutdown()
+            server_p.server_close()
         metered = None
         if usage_metering:
             # Metered A/B leg (ISSUE 15): same fleet, second gateway with
@@ -2127,6 +2189,20 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
             "ledger_rows": len(rows),
             "tenants": len(rollup(rows)),
         }}
+    p_rps = total / p_dt
+    prof_block = {"profiler_overhead": {
+        "schema": 1,
+        "prof_hz": prof_hz,
+        "gateway_rps_profiled": round(p_rps, 1),
+        "profiled_p50_s": round(_percentile(p_lats, 0.50), 6),
+        "profiled_p95_s": round(_percentile(p_lats, 0.95), 6),
+        # Samples actually taken while the leg ran (zero would mean the
+        # gate compared a dead sampler) and stalls the armed watchdog
+        # convicted (anything non-zero on a clean bench is itself news).
+        "prof_samples": int(p_samples),
+        "loop_stalls": int(p_stalls),
+        "prof_vs_off_rps_ratio": round(ratio_prof_vs_off, 4),
+    }}
     return {
         "metric": "gateway data-plane overhead (%d stub replica(s), "
                   "pool=%s)" % (n_replicas, "on" if pooled else "off"),
@@ -2175,6 +2251,7 @@ def run_gateway_overhead_bench(n_replicas: int = 2, requests: int = 240,
                      "discards": pool_delta["discards"]},
             "upstream_connects": connects,
         },
+        **prof_block,
         **usage_block,
         **_chaos_result(),
         **_incident_result(_inc0),

@@ -28,6 +28,19 @@ loop-owned sockets are non-blocking by construction
 (``setblocking(False)`` at accept/detach), so these return immediately;
 flagging them would force a pragma onto every legitimate readiness-driven
 read. The flagged spellings block no matter what mode the fd is in.
+
+Registered callbacks (ISSUE 18): the marker is not the only way onto the
+loop. A callable handed to a registration-shaped call
+(``.add_done_callback(cb)``, ``.call_soon(cb)``, ``.add_reader(fd, cb)``,
+…) runs in loop context without any decorator — exactly where the
+runtime watchdog keeps convicting stalls the static pass missed. The
+rule resolves same-file targets (a module function by name, a
+``self.<method>`` of the enclosing class, an inline ``lambda``) and
+holds their bodies to the same blocking-spelling standard. Targets it
+cannot see (imported callables, call results) stay silent — runtime
+conviction, not this rule, is their guard. ``@event_loop``-marked
+targets are skipped (already checked once); the pragma escape stays
+reason-mandatory as everywhere else.
 """
 
 from __future__ import annotations
@@ -44,6 +57,15 @@ from ditl_tpu.analysis.core import (
 from ditl_tpu.analysis.rules_locks import GUARDED_RE, _self_attr
 
 _BLOCKING_METHODS = {"sendall", "join"}
+
+# Registration-shaped method names whose callable arguments run in loop
+# context (concurrent.futures / asyncio / selector-loop idioms).
+# Deliberately NOT ``register``: selector.register takes opaque data, and
+# atexit.register callbacks never touch the loop.
+_REGISTRATION_METHODS = {
+    "add_done_callback", "add_callback", "call_soon", "call_later",
+    "call_at", "add_reader", "add_writer",
+}
 
 
 def _is_event_loop(fn: ast.AST, marker: str) -> bool:
@@ -64,7 +86,8 @@ def _lockish(attr: str) -> bool:
 
 
 def _check_body(
-    f: SourceFile, fn: ast.FunctionDef, qualname: str
+    f: SourceFile, fn: ast.AST, qualname: str,
+    kind: str = "@event_loop",
 ) -> list[Diagnostic]:
     out: list[Diagnostic] = []
     for node in ast.walk(fn):
@@ -73,7 +96,7 @@ def _check_body(
             if name == "sleep":
                 out.append(Diagnostic(
                     "event-loop-hygiene", f.display, node.lineno,
-                    f"sleep inside @event_loop {qualname}: the loop may "
+                    f"sleep inside {kind} {qualname}: the loop may "
                     "only wait inside selector.select — a sleep here "
                     "stalls every open connection and stream",
                 ))
@@ -88,7 +111,7 @@ def _check_body(
                 )
                 out.append(Diagnostic(
                     "event-loop-hygiene", f.display, node.lineno,
-                    f".{name}() inside @event_loop {qualname}: blocks "
+                    f".{name}() inside {kind} {qualname}: blocks "
                     f"the loop regardless of socket mode; {hint}",
                 ))
         elif isinstance(node, ast.With):
@@ -105,7 +128,7 @@ def _check_body(
                     continue
                 out.append(Diagnostic(
                     "event-loop-hygiene", f.display, node.lineno,
-                    f"with self.{attr} inside @event_loop {qualname}: a "
+                    f"with self.{attr} inside {kind} {qualname}: a "
                     "lock shared with workers is an unbounded wait on "
                     "the loop; prefer a deque handoff, or witness the "
                     "bounded hold with `# guarded-by: <state>`",
@@ -113,9 +136,80 @@ def _check_body(
     return out
 
 
+def _check_registered_callbacks(
+    f: SourceFile, marker: str
+) -> list[Diagnostic]:
+    """ISSUE 18: hold callables *registered* as loop callbacks to the
+    blocking-spelling standard, decorator or not. Resolution is same-file
+    only — a module function by name, a ``self.<method>`` of the
+    enclosing class, or an inline lambda; anything else is invisible to a
+    single-file pass and left to the runtime watchdog."""
+    module_fns = {
+        n.name: n for n in f.tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    class_methods: dict[str, dict[str, ast.AST]] = {}
+    for node in ast.walk(f.tree):
+        if isinstance(node, ast.ClassDef):
+            class_methods[node.name] = {
+                item.name: item for item in node.body
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+
+    out: list[Diagnostic] = []
+    seen: set[int] = set()
+
+    def visit(node: ast.AST, cls: str | None) -> None:
+        if isinstance(node, ast.ClassDef):
+            for child in ast.iter_child_nodes(node):
+                visit(child, node.name)
+            return
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _REGISTRATION_METHODS
+        ):
+            reg = node.func.attr
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in args:
+                if isinstance(arg, ast.Lambda):
+                    out.extend(_check_body(
+                        f, arg, f"<lambda> passed to .{reg}()",
+                        kind="loop callback",
+                    ))
+                    continue
+                target, qualname = None, ""
+                if isinstance(arg, ast.Name):
+                    target = module_fns.get(arg.id)
+                    qualname = arg.id
+                elif (
+                    isinstance(arg, ast.Attribute)
+                    and isinstance(arg.value, ast.Name)
+                    and arg.value.id == "self"
+                    and cls is not None
+                ):
+                    target = class_methods.get(cls, {}).get(arg.attr)
+                    qualname = f"{cls}.{arg.attr}"
+                if target is None or id(target) in seen:
+                    continue
+                seen.add(id(target))
+                if _is_event_loop(target, marker):
+                    continue  # already held by the decorator pass
+                out.extend(_check_body(
+                    f, target, f"{qualname} (registered via .{reg}())",
+                    kind="loop callback",
+                ))
+        for child in ast.iter_child_nodes(node):
+            visit(child, cls)
+
+    visit(f.tree, None)
+    return out
+
+
 @rule(
     "event-loop-hygiene",
-    "functions marked @event_loop must not contain blocking spellings "
+    "functions marked @event_loop — and callables registered as loop "
+    "callbacks — must not contain blocking spellings "
     "(sleep / .sendall / .join / un-witnessed lock waits)",
 )
 def check_event_loop_hygiene(project: Project) -> list[Diagnostic]:
@@ -134,4 +228,5 @@ def check_event_loop_hygiene(project: Project) -> list[Diagnostic]:
         for node in ast.walk(f.tree):
             if _is_event_loop(node, marker) and id(node) not in method_ids:
                 out.extend(_check_body(f, node, node.name))
+        out.extend(_check_registered_callbacks(f, marker))
     return out

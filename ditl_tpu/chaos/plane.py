@@ -134,6 +134,11 @@ SITES = {
                        "dies mid-publish -> that replica keeps its old "
                        "verified adapter, the fallback is counted and the "
                        "journal chain shows which replicas flipped)",
+    "loop.block": "gateway/evloop.py: inside the event loop's tick "
+                  "callback (delay = a REAL single-threaded loop stall — "
+                  "every connected stream freezes; the stall drill "
+                  "expects the lag watchdog to convict this exact "
+                  "file:line in the loop.stall incident bundle)",
 }
 
 

@@ -998,6 +998,23 @@ class TelemetryConfig:
     anomaly_window: int = 32
     anomaly_loss_spike_factor: float = 4.0
     anomaly_grad_explosion_factor: float = 10.0
+    # -- Continuous profiling & stall attribution (ISSUE 18) -------------
+    # Wall-clock sampling profiler hertz (telemetry/prof.py): 0 disarms;
+    # > 0 arms a continuous sampler across the trainer's step loop and on
+    # a profiling-armed gateway (the bench A/B leg gates its overhead
+    # inside the perf_compare noise floor, so leaving it on is priced).
+    prof_hz: float = 0.0
+    # Distinct collapsed stacks the sampler holds before oldest-first
+    # eviction — the profiler's hard memory cap.
+    prof_max_stacks: int = 2048
+    # Event-loop lag watchdog (evloop data plane only): busy heartbeat
+    # age past this threshold is a stall — burst-sampled into a
+    # convicting stack, journaled as loop.stall, and fed to the incident
+    # plane. 0 disarms the watchdog.
+    loop_stall_threshold_s: float = 0.0
+    # Burst-sampling rate while a stall is in progress (high on purpose:
+    # the burst lasts only for the stall's duration).
+    loop_stall_burst_hz: float = 200.0
 
     def __post_init__(self):
         if self.journal_max_mb < 0:
@@ -1063,6 +1080,22 @@ class TelemetryConfig:
                 "telemetry.anomaly_hit_ratio_floor must be in (0, 1), got "
                 f"{self.anomaly_hit_ratio_floor}"
             )
+        for name in ("prof_hz", "loop_stall_threshold_s"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"telemetry.{name} must be >= 0 (0 = disarmed), got "
+                    f"{getattr(self, name)}"
+                )
+        if self.prof_max_stacks < 1:
+            raise ValueError(
+                f"telemetry.prof_max_stacks must be >= 1, got "
+                f"{self.prof_max_stacks}"
+            )
+        if self.loop_stall_burst_hz <= 0:
+            raise ValueError(
+                f"telemetry.loop_stall_burst_hz must be > 0, got "
+                f"{self.loop_stall_burst_hz}"
+            )
 
     def journal_max_bytes(self) -> int | None:
         """The journal rotation cap in bytes (None = unbounded) —
@@ -1105,6 +1138,16 @@ class TelemetryConfig:
             max_total_mb=self.incident_max_mb,
             journal_tail=self.incident_journal_tail,
             trace_window_s=self.incident_trace_window_s,
+        )
+
+    def watchdog_kwargs(self) -> dict:
+        """Keyword form of the loop-stall watchdog knobs — exactly what
+        ``telemetry.prof.LoopWatchdog`` takes. Callers gate on
+        ``loop_stall_threshold_s > 0`` before building one (0 =
+        disarmed, and the watchdog itself rejects it)."""
+        return dict(
+            threshold_s=self.loop_stall_threshold_s,
+            burst_hz=self.loop_stall_burst_hz,
         )
 
     def serving_detector_kwargs(self) -> dict:

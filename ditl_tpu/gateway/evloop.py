@@ -50,7 +50,9 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 
 from ditl_tpu.annotations import event_loop
+from ditl_tpu.chaos import maybe_inject
 from ditl_tpu.config import GatewayConfig
+from ditl_tpu.telemetry.prof import LoopHeartbeat, OffloadPoolMonitor
 from ditl_tpu.utils.logging import get_logger
 
 logger = get_logger(__name__)
@@ -72,7 +74,7 @@ _OUTBUF_PAUSE = 1 << 20
 # next request before handing the connection back to the loop. Keeps a
 # request-per-response closed loop entirely on one worker — the exact
 # blocking pattern the threaded path wins with at low concurrency —
-# while the guard in _run_handler stops camping the moment workers get
+# while the guard in _handle_dispatch stops camping the moment workers get
 # scarce, so high fan-in still degrades to pure event-driven dispatch.
 _STICK_S = 0.01
 
@@ -273,6 +275,20 @@ class EventLoopGateway:
         self._drain_deadline = 0.0
         self._ticks: collections.deque = collections.deque(maxlen=512)
         self._tick_count = 0
+        # Stall attribution (ISSUE 18): the loop stamps this heartbeat
+        # every iteration; a LoopWatchdog (attached by make_gateway when
+        # telemetry.loop_stall_threshold_s > 0) converts busy age into
+        # lag and convicts the blocking frame. Offload-pool accounting
+        # distinguishes "pool starved" from "loop blocked".
+        self.heartbeat = LoopHeartbeat()
+        self.watchdog = None  # telemetry.prof.LoopWatchdog | None
+        self.profiler = None  # telemetry.prof.SamplingProfiler | None
+        self._pool_monitor = (
+            OffloadPoolMonitor(
+                self.gw.loop_offload_queue, self.gw.loop_offload_busy,
+                self.gw.loop_offload_workers,
+                self.gwcfg.evloop_offload_workers)
+            if self.gw is not None else None)
 
     # ------------------------------------------------------------------
     # lifecycle (ThreadingHTTPServer-parity surface)
@@ -285,6 +301,9 @@ class EventLoopGateway:
             self._listener, selectors.EVENT_READ, ("accept", None))
         self._selector.register(
             self._wake_r, selectors.EVENT_READ, ("wake", None))
+        self.heartbeat.attach()
+        if self.watchdog is not None:
+            self.watchdog.start()
         last_sweep = time.monotonic()
         try:
             while not self._shutdown_request.is_set():
@@ -296,17 +315,23 @@ class EventLoopGateway:
                     # (measured ~200us p50 at 3 kept-alive clients on
                     # one core when submitted mid-tick, ~15us here).
                     submits, self._submits = self._submits, []
-                    for raw, carry, conn in submits:
+                    for raw, carry, conn, queued_ts in submits:
                         future = self._offload.submit(
-                            self._run_handler, raw, carry, conn)
+                            self._run_handler, raw, carry, conn, queued_ts)
                         future.add_done_callback(
                             lambda f, c=conn: self._post(("handled", c, f)))
+                # Heartbeat (ISSUE 18): idle while parked in select (a
+                # parked loop is healthy — only BUSY age is lag), busy
+                # the moment the tick starts processing. One tuple write
+                # each: @hot_path-cheap, read lock-free by the watchdog.
+                self.heartbeat.idle()
                 self._in_select = True
                 # A mailbox item that raced the end of the previous tick
                 # must not wait out a parked select: skip the park.
                 events = () if self._mailbox \
                     else self._selector.select(interval)
                 self._in_select = False
+                self.heartbeat.busy()
                 t0 = time.perf_counter()
                 self._tick(events)
                 now = time.monotonic()
@@ -317,6 +342,11 @@ class EventLoopGateway:
                     self._check_drain(now)
                 self._observe_tick(time.perf_counter() - t0, len(events))
         finally:
+            # A dead loop is not a stalled loop: park the heartbeat so
+            # the watchdog never convicts the exit path, then stop it.
+            self.heartbeat.idle()
+            if self.watchdog is not None:
+                self.watchdog.stop()
             for key in (self._listener, self._wake_r):
                 try:
                     self._selector.unregister(key)
@@ -355,6 +385,10 @@ class EventLoopGateway:
         if self._closed:
             return
         self._closed = True
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.profiler is not None:
+            self.profiler.stop()
         try:
             for stream in list(self._streams):
                 self._streams.discard(stream)
@@ -411,6 +445,10 @@ class EventLoopGateway:
 
     @event_loop
     def _tick(self, events) -> None:
+        # Chaos seam for THE stall drill: ``loop.block:delay@...`` turns
+        # this into a real single-threaded loop stall (every connected
+        # stream freezes) that the watchdog must convict at this line.
+        maybe_inject("loop.block")
         for key, mask in events:
             kind, obj = key.data
             if kind == "client":
@@ -576,9 +614,24 @@ class EventLoopGateway:
         # Queued, not submitted: serve_forever flushes this right before
         # it parks in select so the offload worker starts the moment the
         # loop releases the GIL, not after the rest of the tick.
-        self._submits.append((raw, carry, conn))
+        self._submits.append((raw, carry, conn, time.monotonic()))
 
-    def _run_handler(self, raw: bytes, carry: bytes, conn: _Conn):
+    def _run_handler(self, raw: bytes, carry: bytes, conn: _Conn,
+                     queued_ts: float = 0.0):
+        """Offload-pool accounting shim around :meth:`_handle_dispatch`:
+        observes queue-wait (submit → worker pickup) and worker
+        occupancy so "loop is fine, pool is starved" is distinguishable
+        from a blocked loop (ISSUE 18)."""
+        mon = self._pool_monitor
+        if mon is not None:
+            mon.job_started(queued_ts)
+        try:
+            return self._handle_dispatch(raw, carry, conn)
+        finally:
+            if mon is not None:
+                mon.job_finished()
+
+    def _handle_dispatch(self, raw: bytes, carry: bytes, conn: _Conn):
         """Offload worker: run the bound gateway handler against an
         in-memory request/response pair (the 'pseudo-handler' — same
         class, same ``handle_one_request``, same control plane as the

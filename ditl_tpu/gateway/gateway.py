@@ -228,6 +228,22 @@ class GatewayMetrics:
             f"{PREFIX}_loop_accept_backlog_drops",
             "accepted client connections dropped at the "
             "gateway.evloop_max_connections cap")
+        # Offload-pool saturation accounting (ISSUE 18): queue-wait plus
+        # worker occupancy so "loop is fine, pool is starved" is
+        # distinguishable from a blocked loop (troubleshooting §36).
+        self.loop_offload_queue = r.histogram(
+            f"{PREFIX}_loop_offload_queue_seconds",
+            "handler offload queue wait (loop submit -> worker pickup; "
+            "grows when the pool, not the loop, is the bottleneck)",
+            LOOP_TICK_BUCKETS_S)
+        self.loop_offload_busy = r.gauge(
+            f"{PREFIX}_loop_offload_busy_workers",
+            "offload-pool workers currently running a handler (pinned at "
+            "pool size + queue wait growing = pool starvation)")
+        self.loop_offload_workers = r.gauge(
+            f"{PREFIX}_loop_offload_workers",
+            "configured offload-pool size (gateway.evloop_offload_workers"
+            "; denominator for occupancy)")
 
     # Each distinct tenant label becomes its own metric family; tenants
     # arrive as arbitrary unauthenticated bearer tokens, so beyond this
@@ -544,15 +560,24 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
 
     def do_GET(self):
         self._rid = None  # fresh id per request on keep-alive connections
-        path = self.path.rstrip("/") or "/"
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/") or "/"
         if path in ("/health", "/v1/health"):
             live = self.fleet.live_count()
-            self._send_json(200 if live else 503, {
+            payload = {
                 "status": "ok" if live else "no_live_replicas",
                 "replicas_live": live,
                 "replicas_draining": self.fleet.draining_count(),
                 "replicas_total": len(self.fleet.ids),
-            })
+            }
+            # Loop-lag p95 from the evloop watchdog, absent != 0: only
+            # reported when the watchdog is armed AND has observations
+            # (same discipline as the replica role p95s).
+            wd = getattr(self.server, "watchdog", None)
+            lag = wd.lag_p95() if wd is not None else None
+            if lag is not None:
+                payload["loop_lag_p95_s"] = round(lag, 6)
+            self._send_json(200 if live else 503, payload)
         elif path in ("/stats", "/v1/stats"):
             payload = {
                 "router": getattr(self.router, "name", "unknown"),
@@ -572,6 +597,7 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                             v.recent_cache_hit_ratio,
                         "ttft_p95_s": v.ttft_p95_s,
                         "tpot_p95_s": v.tpot_p95_s,
+                        "loop_lag_p95_s": v.loop_lag_p95_s,
                     }
                     for v in self.fleet.views()
                 },
@@ -629,8 +655,35 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             self._proxy_get("/v1/models")
         elif path in ("/v1/adapters", "/adapters"):
             self._adapters_get()
+        elif path in ("/profile", "/v1/profile"):
+            self._profile(query)
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _profile(self, query: str) -> None:
+        """On-demand wall-clock profile (ISSUE 18): sample every thread
+        for ``?seconds=N`` (clamped) and return flamegraph-ready
+        collapsed stacks as text/plain. Stdlib sampler, no lock on the
+        sample path — safe to hit on a loaded gateway."""
+        from ditl_tpu.telemetry.prof import profile_for
+
+        seconds = 2.0
+        for part in query.split("&"):
+            if part.startswith("seconds="):
+                try:
+                    seconds = float(part.split("=", 1)[1])
+                except ValueError:
+                    self._send_json(400, {"error": {
+                        "message": "seconds must be a number"}})
+                    return
+        seconds = min(max(seconds, 0.1), 60.0)
+        body = profile_for(seconds).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("X-Request-Id", self._request_id())
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _incidents(self) -> None:
         """Fleet incident view (ISSUE 10): the gateway's own bundles plus
@@ -1884,8 +1937,33 @@ def make_gateway(
         # offload workers), same 4-method server surface
         # (serve_forever/shutdown/server_close/server_address).
         from ditl_tpu.gateway.evloop import EventLoopGateway
-        return EventLoopGateway(address, handler, config=config,
-                                metrics=gw_metrics)
+        server = EventLoopGateway(address, handler, config=config,
+                                  metrics=gw_metrics)
+        # Stall-attribution plane (ISSUE 18): when armed, the watchdog
+        # converts heartbeat age into ditl_loop_lag_seconds and, on a
+        # stall, burst-samples the loop thread into a convicting stack
+        # fed to the anomaly->incident path. Disarmed by default
+        # (loop_stall_threshold_s == 0): zero extra threads.
+        if telemetry is not None and telemetry.loop_stall_threshold_s > 0:
+            from ditl_tpu.telemetry.anomaly import AnomalyPlane
+            from ditl_tpu.telemetry.prof import LoopWatchdog
+            server.watchdog = LoopWatchdog(
+                server.heartbeat,
+                registry=gw_metrics.registry,
+                plane=AnomalyPlane(incidents=incidents, journal=journal),
+                journal=journal,
+                source="gateway",
+                **telemetry.watchdog_kwargs(),
+            )
+        if telemetry is not None and telemetry.prof_hz > 0:
+            from ditl_tpu.telemetry.prof import SamplingProfiler
+            server.profiler = SamplingProfiler(
+                hz=telemetry.prof_hz,
+                max_stacks=telemetry.prof_max_stacks,
+                registry=gw_metrics.registry,
+            )
+            server.profiler.start()
+        return server
     return GatewayHTTPServer(address, handler)
 
 

@@ -328,6 +328,11 @@ class ReplicaView:
     kv_put_mbps: float | None = None
     prefill_tok_per_s: float | None = None
     kv_bytes_per_token: float | None = None
+    # Event-loop lag p95 from the replica's watchdog (ISSUE 18): how long
+    # its loop sits busy per iteration. None when the watchdog is unarmed
+    # or has no observations yet (absent != 0) — a degrading loop is
+    # visible to the planner before TPOT storms are.
+    loop_lag_p95_s: float | None = None
 
     @property
     def cache_hit_ratio(self) -> float | None:
@@ -539,6 +544,7 @@ class Fleet:
             kv_put_mbps=_num("kv_put_mbps"),
             prefill_tok_per_s=_num("prefill_tok_per_s"),
             kv_bytes_per_token=_num("kv_bytes_per_token"),
+            loop_lag_p95_s=_num("loop_lag_p95_s"),
         )
 
     def routable(self, exclude: Sequence[str] = ()) -> list[ReplicaView]:

@@ -726,8 +726,36 @@ class _Handler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                     "suppressed": self.incidents.suppressed_total,
                     "incidents": bundles,
                 })
+        elif self.path.partition("?")[0].rstrip("/") in ("/profile",
+                                                         "/v1/profile"):
+            self._profile(self.path.partition("?")[2])
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
+
+    def _profile(self, query: str) -> None:
+        """On-demand wall-clock profile (ISSUE 18): sample every thread
+        for ``?seconds=N`` (clamped) and return flamegraph-ready
+        collapsed stacks as text/plain — "what code is this replica
+        running right now" without attaching a debugger. Stdlib sampler,
+        no lock on the sample path: safe under live decode."""
+        from ditl_tpu.telemetry.prof import profile_for
+
+        seconds = 2.0
+        for part in query.split("&"):
+            if part.startswith("seconds="):
+                try:
+                    seconds = float(part.split("=", 1)[1])
+                except ValueError:
+                    self._send_json(400, {"error": {
+                        "message": "seconds must be a number"}})
+                    return
+        seconds = min(max(seconds, 0.1), 60.0)
+        body = profile_for(seconds).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _metrics(self) -> None:
         """Prometheus text exposition (no device sync), two sections:

@@ -131,6 +131,9 @@ _ROWS: tuple = (
     ("ditl_gateway_handoff_shipped_total", "counter", "", "prefill->decode KV handoffs shipped to the decode replica"),
     ("ditl_gateway_hedges_total", "counter", "", "hedged duplicate requests fired"),
     ("ditl_gateway_loop_accept_backlog_drops_total", "counter", "", "client connects refused at accept because gateway.evloop_max_connections was reached (evloop data plane)"),
+    ("ditl_gateway_loop_offload_busy_workers", "gauge", "", "offload-pool workers currently running a handler - pinned at pool size while queue wait grows = pool starvation, not a blocked loop"),
+    ("ditl_gateway_loop_offload_queue_seconds", "histogram", "", "handler offload queue wait (loop submit -> worker pickup) - grows when the pool, not the loop, is the bottleneck"),
+    ("ditl_gateway_loop_offload_workers", "gauge", "", "configured offload-pool size (gateway.evloop_offload_workers; occupancy denominator)"),
     ("ditl_gateway_loop_open_connections", "gauge", "", "client connections currently owned by the evloop data plane (any state)"),
     ("ditl_gateway_loop_open_sse_streams", "gauge", "", "detached SSE relays the event loop is currently pumping (no thread parked per stream)"),
     ("ditl_gateway_loop_ready_queue_depth", "gauge", "", "fds the last selector wakeup reported ready - sustained depth means the loop is the bottleneck"),
@@ -183,6 +186,8 @@ _ROWS: tuple = (
     ("ditl_incidents_suppressed_total", "counter", "", "anomaly triggers deduped/cooled down without a bundle"),
     ("ditl_incidents_total", "counter", "", "incident bundles assembled"),
     ("ditl_incidents_trigger_<kind>_total", "counter", "anomaly kind", "incident bundles triggered by serving.deadline_storm"),
+    ("ditl_loop_lag_seconds", "histogram", "", "event-loop heartbeat age while busy, watchdog-sampled - how long the loop has been stuck inside one iteration (armed by telemetry.loop_stall_threshold_s)", True),
+    ("ditl_loop_stalls_total", "counter", "", "loop stalls the watchdog convicted (lag crossed telemetry.loop_stall_threshold_s; each journals loop.stall with the convicting stack)", True),
     ("ditl_memory_<replica>_device<i>_bytes_in_use", "gauge", "replica id + device index", "replica HBM in use, re-namespaced on the gateway scrape", True),
     ("ditl_memory_<replica>_device<i>_bytes_limit", "gauge", "replica id + device index", "replica HBM limit, re-namespaced on the gateway scrape", True),
     ("ditl_memory_<replica>_device<i>_largest_alloc_size", "gauge", "replica id + device index", "replica largest allocation, re-namespaced on the gateway scrape", True),
@@ -191,6 +196,9 @@ _ROWS: tuple = (
     ("ditl_memory_device<i>_bytes_limit", "gauge", "device index", "device 0 allocator bytes_limit (absent on statless backends)", True),
     ("ditl_memory_device<i>_largest_alloc_size", "gauge", "device index", "device 0 allocator largest_alloc_size (absent on statless backends)", True),
     ("ditl_memory_device<i>_peak_bytes_in_use", "gauge", "device index", "device 0 allocator peak_bytes_in_use (absent on statless backends)", True),
+    ("ditl_prof_samples_total", "counter", "", "wall-clock stack samples the sampling profiler took across all threads (armed by telemetry.prof_hz or /profile)", True),
+    ("ditl_prof_stacks", "gauge", "", "distinct collapsed stacks currently held by the sampling profiler (bounded by telemetry.prof_max_stacks)", True),
+    ("ditl_prof_stacks_evicted_total", "counter", "", "collapsed stacks evicted oldest-first at the telemetry.prof_max_stacks cap - non-zero means the flame graph has a truncated tail", True),
     ("ditl_serving_adapters", "gauge", "", "LoRA adapters resident (multi-LoRA serving)", True),
     ("ditl_serving_admission_degrade_windows_total", "counter", "", "tick windows that engaged the anti-thrash admission degrade"),
     ("ditl_serving_admission_degraded", "gauge", "", "1 while the optimistic-admission anti-thrash degrade is engaged"),

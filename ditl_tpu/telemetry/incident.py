@@ -327,6 +327,20 @@ class IncidentManager:
                 dump = self.memwatch_dump()
                 if dump:
                     write_json("memwatch.json", dump)
+        # Collapsed-stack profile (ISSUE 18): when a sampling profiler is
+        # armed in this process, the bundle carries what every thread was
+        # running around the trigger — the "what code was it" evidence
+        # next to the "what happened" rings.
+        with contextlib.suppress(Exception):
+            from ditl_tpu.telemetry.prof import active_profiler
+
+            prof = active_profiler()
+            if prof is not None:
+                text = prof.collapsed()
+                if text:
+                    with open(os.path.join(tmp, "profile.txt"), "w") as f:
+                        f.write(text if text.endswith("\n") else text + "\n")
+                    files.append("profile.txt")
         # Chaos attribution: when the fault plane is armed AND has fired,
         # the injected-fault summary rides the manifest — a chaos-forced
         # storm must read as injected, not organic.

@@ -117,6 +117,12 @@ COMPARE_KEYS = {
     # window where a publication holds a spare row.
     "adapter_gather_overhead_ratio": -1,
     "adapter_swap_p95_s": -1,
+    # Continuous-profiling keys (ISSUE 18, bench --serve-gateway-overhead
+    # rows' hoisted `profiler_overhead` block): the profiler-on vs
+    # profiler-off req/s ratio regresses when it falls — the always-on
+    # sampler + loop-lag watchdog are only "always-on" while they cost
+    # within the same-box noise floor of running dark.
+    "prof_vs_off_rps_ratio": +1,
 }
 
 # Per-key noise floors: gated keys whose honest run-to-run spread on a
@@ -128,7 +134,11 @@ COMPARE_KEYS = {
 # per-request slowdown while two honest parity rows compare clean.
 # The effective threshold is max(--threshold, floor): a caller asking
 # for a LOOSER gate than the floor gets what they asked for.
-KEY_THRESHOLDS = {"evloop_vs_threaded_rps_ratio": 0.15}
+KEY_THRESHOLDS = {
+    "evloop_vs_threaded_rps_ratio": 0.15,
+    # Same estimator shape, same box: a quotient of two closed loops.
+    "prof_vs_off_rps_ratio": 0.15,
+}
 
 
 def _flat(rec: dict) -> dict:
@@ -144,7 +154,8 @@ def _flat(rec: dict) -> dict:
     handoff fallback ratio, or the gateway's own per-request tax."""
     out = rec
     for block in ("roofline", "serving", "autoscale", "kv_handoff",
-                  "gateway_overhead", "usage_metering", "adapters"):
+                  "gateway_overhead", "usage_metering", "adapters",
+                  "profiler_overhead"):
         nested = rec.get(block)
         if isinstance(nested, dict):
             out = {**nested, **out}

@@ -1,0 +1,631 @@
+"""Continuous profiling & stall attribution (ISSUE 18 tentpole).
+
+Every observability layer so far — metrics (ISSUE 3), tracing (ISSUE 6),
+the perf observatory (ISSUE 7), the flight-recorder/incident plane
+(ISSUE 10) — answers *what* went slow. None answers *what code was
+running when it did*. The single-threaded evloop data plane (ISSUE 17)
+made that gap existential: one blocking call in a loop callback stalls
+every open connection and stream at once, and the static
+``event-loop-hygiene`` rule cannot see runtime behavior. This module is
+the runtime half of that guard:
+
+- :class:`SamplingProfiler` — a wall-clock sampling profiler: a daemon
+  thread reads ``sys._current_frames()`` at a configurable hertz and
+  folds each thread's stack into bounded collapsed-stack counters. No
+  lock on the sample path (the sampler is the only writer; readers take
+  GIL-atomic snapshots), registry-style get-or-create per stack,
+  memory-capped with oldest-first eviction. Exports flamegraph-ready
+  collapsed text (``collapsed()``) and a Chrome-trace section riding the
+  existing ``trace_export`` machinery (``chrome_trace()``).
+- :class:`LoopHeartbeat` — the evloop stamps a monotonic heartbeat once
+  per iteration: one tuple write (``@hot_path``-cheap), flagged busy
+  while the tick processes work and idle while the loop is parked in
+  ``selector.select`` (a parked loop is HEALTHY — only busy age counts
+  as lag, which is what makes the idle-at-threshold false-positive pin
+  hold).
+- :class:`LoopWatchdog` — a daemon thread converts heartbeat age into a
+  ``ditl_loop_lag_seconds`` histogram; when busy lag crosses the
+  threshold it burst-samples the loop thread's stack at high frequency
+  for the stall's duration, aggregates the samples into a **convicting
+  stack** (modal top frame + file:line), journals ``loop.stall``, and
+  feeds the ISSUE 10 anomaly->incident path (fingerprint-deduped,
+  cooldown-rate-limited, chaos-attributed like every other trigger).
+- :class:`OffloadPoolMonitor` — queue-wait and worker-occupancy for the
+  evloop's handler pool, so "the loop is fine but the pool is starved"
+  is distinguishable from a blocked loop (troubleshooting §36).
+
+Stdlib-only and jax-free on import, like the rest of ditl_tpu/telemetry
+(held by the import-layering rule and the runtime subprocess pin).
+
+CLI: ``python -m ditl_tpu.telemetry.prof --collapse profile.txt
+[--top N] [--chrome out.json]`` post-processes a collapsed-stack file
+(e.g. a bundle's ``profile.txt`` or a ``/profile`` response saved to
+disk).
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+import threading
+import time
+
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "LoopHeartbeat",
+    "LoopWatchdog",
+    "OffloadPoolMonitor",
+    "SamplingProfiler",
+    "active_profiler",
+    "collapsed_to_chrome",
+    "main",
+    "profile_for",
+    "top_frames",
+]
+
+_PREFIX = "ditl_prof"
+
+# Default sampling rate for transient /profile captures. A prime, so the
+# sampler cannot phase-lock with millisecond-periodic work and sample the
+# same frame forever (the classic aliasing failure of round-hertz
+# profilers).
+DEFAULT_HZ = 97.0
+
+# Frames deeper than this are truncated root-side: the leaf frames carry
+# the conviction; an unbounded recursion must not grow a stack key
+# without bound.
+_MAX_DEPTH = 64
+
+
+def _fold(frame, depth: int = _MAX_DEPTH) -> str:
+    """Collapse a frame chain into one ``root;...;leaf`` key, each frame
+    ``func (file.py:line)`` with only the basename (full paths differ per
+    checkout; basenames keep keys stable and the flamegraph readable)."""
+    parts: list[str] = []
+    while frame is not None and len(parts) < depth:
+        code = frame.f_code
+        fname = code.co_filename.rsplit("/", 1)[-1]
+        parts.append(f"{code.co_name} ({fname}:{frame.f_lineno})")
+        frame = frame.f_back
+    parts.reverse()
+    return ";".join(parts)
+
+
+class SamplingProfiler:
+    """Wall-clock sampling profiler over ``sys._current_frames()``.
+
+    One daemon thread samples every live thread (its own excluded) at
+    ``hz`` into an insertion-ordered map of
+    ``"thread;frame;...;leaf" -> count``. The sample thread is the only
+    writer and takes no lock: per-key re-hits use ``move_to_end`` /
+    item assignment (GIL-atomic on an OrderedDict), and readers snapshot
+    with ``dict(...)``. The map is capped at ``max_stacks`` distinct
+    stacks; overflow evicts oldest-first (recency order, so a stack that
+    keeps firing is never the one dropped) and counts the eviction —
+    bounded memory is a hard invariant, not a hope.
+
+    ``phase_thread``/``set_phase`` add coarse phase attribution for ONE
+    designated thread (the trainer's step loop): while a phase is set,
+    that thread's samples are also folded into a per-phase counter, so
+    ``StepAnatomy``'s ``host_dispatch`` bucket can name actual frames in
+    the run summary instead of only a duration.
+    """
+
+    def __init__(self, hz: float = DEFAULT_HZ, max_stacks: int = 2048,
+                 only_thread: int | None = None, registry=None):
+        if hz <= 0:
+            raise ValueError(f"prof hz must be > 0, got {hz}")
+        if max_stacks < 1:
+            raise ValueError(f"prof max_stacks must be >= 1, got {max_stacks}")
+        self.hz = float(hz)
+        self.max_stacks = int(max_stacks)
+        self.only_thread = only_thread  # restrict to one ident (burst mode)
+        self.samples = 0
+        self.evicted = 0
+        # Optional /metrics mirror (instruments are lock-free; updated
+        # from the sample thread only, once per sweep — never per frame).
+        self._samples_c = self._stacks_g = self._evicted_c = None
+        if registry is not None:
+            self._samples_c = registry.counter(
+                f"{_PREFIX}_samples",
+                "stack samples the continuous profiler has taken")
+            self._stacks_g = registry.gauge(
+                f"{_PREFIX}_stacks",
+                "distinct collapsed stacks currently held (capped at "
+                "telemetry.prof_max_stacks)")
+            self._evicted_c = registry.counter(
+                f"{_PREFIX}_stacks_evicted",
+                "collapsed stacks evicted oldest-first at the "
+                "prof_max_stacks memory cap")
+        self.started_at: float | None = None
+        self.stopped_at: float | None = None
+        self._stacks: collections.OrderedDict[str, int] = \
+            collections.OrderedDict()
+        self._phase: str | None = None
+        self._phase_thread: int | None = None
+        self._phase_stacks: dict[str, collections.OrderedDict] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SamplingProfiler":
+        if self._thread is not None:
+            return self
+        self.started_at = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ditl-prof-sampler", daemon=True)
+        self._thread.start()
+        _register(self)
+        return self
+
+    def stop(self) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=5.0)
+        self._thread = None
+        self.stopped_at = time.monotonic()
+        _unregister(self)
+
+    # -- the sample path (no locks) ---------------------------------------
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        interval = 1.0 / self.hz
+        while not self._stop.wait(interval):
+            try:
+                frames = sys._current_frames()
+            except Exception:  # noqa: BLE001 - sampling must never crash
+                continue
+            names = {t.ident: t.name for t in threading.enumerate()}
+            phase = self._phase
+            for ident, frame in frames.items():
+                if ident == me:
+                    continue
+                if self.only_thread is not None and ident != self.only_thread:
+                    continue
+                stack = _fold(frame)
+                if not stack:
+                    continue
+                thread = names.get(ident, f"thread-{ident}")
+                self._note(self._stacks, f"{thread};{stack}")
+                if phase is not None and ident == self._phase_thread:
+                    bucket = self._phase_stacks.get(phase)
+                    if bucket is None:
+                        bucket = collections.OrderedDict()
+                        self._phase_stacks[phase] = bucket
+                    self._note(bucket, stack)
+                self.samples += 1
+            if self._samples_c is not None:
+                self._samples_c.inc(self.samples - self._samples_c.value)
+                self._stacks_g.set(float(len(self._stacks)))
+                if self.evicted > self._evicted_c.value:
+                    self._evicted_c.inc(self.evicted - self._evicted_c.value)
+
+    def _note(self, stacks: collections.OrderedDict, key: str) -> None:
+        """One sample into one bounded counter map. Re-hit moves the key
+        to the recent end, so eviction (popitem(last=False)) always drops
+        the stack that has gone longest without firing."""
+        if key in stacks:
+            stacks[key] += 1
+            stacks.move_to_end(key)
+            return
+        while len(stacks) >= self.max_stacks:
+            stacks.popitem(last=False)
+            self.evicted += 1
+        stacks[key] = 1
+
+    # -- phase attribution (trainer) --------------------------------------
+
+    def arm_phases(self, thread_ident: int | None = None) -> None:
+        """Designate the thread whose samples get per-phase attribution
+        (the caller's thread by default — the trainer's step loop)."""
+        self._phase_thread = (thread_ident if thread_ident is not None
+                              else threading.get_ident())
+
+    def set_phase(self, phase: str | None) -> None:
+        """One attribute write — cheap enough for the step loop."""
+        self._phase = phase
+
+    def phase_top(self, phase: str, n: int = 5) -> list[dict]:
+        """Top leaf frames sampled while ``phase`` was set on the armed
+        thread: ``[{"frame": ..., "samples": ...}, ...]``, most first."""
+        bucket = self._phase_stacks.get(phase)
+        if not bucket:
+            return []
+        leaves: collections.Counter = collections.Counter()
+        for stack, count in dict(bucket).items():
+            leaves[stack.rsplit(";", 1)[-1]] += count
+        return [{"frame": frame, "samples": count}
+                for frame, count in leaves.most_common(n)]
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict[str, int]:
+        return dict(self._stacks)
+
+    def collapsed(self) -> str:
+        """Flamegraph-ready collapsed-stack text: one ``stack count``
+        line per distinct stack (``flamegraph.pl``/speedscope input)."""
+        return "\n".join(f"{stack} {count}"
+                         for stack, count in self.snapshot().items())
+
+    def top(self, n: int = 10) -> list[dict]:
+        return top_frames(self.snapshot(), n)
+
+    def chrome_trace(self) -> dict:
+        """The aggregated profile as a Chrome-trace section, riding the
+        existing ``trace_export`` machinery (one lane per thread, each
+        stack a span whose duration is its sampled share of the capture
+        window)."""
+        return collapsed_to_chrome(self.snapshot(), self.hz)
+
+
+# ---------------------------------------------------------------------------
+# active-profiler registry (incident bundles read the newest armed one)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: list[SamplingProfiler] = []
+_ACTIVE_LOCK = threading.Lock()
+
+
+def _register(p: SamplingProfiler) -> None:
+    with _ACTIVE_LOCK:
+        _ACTIVE.append(p)
+
+
+def _unregister(p: SamplingProfiler) -> None:
+    with _ACTIVE_LOCK:
+        if p in _ACTIVE:
+            _ACTIVE.remove(p)
+
+
+def active_profiler() -> SamplingProfiler | None:
+    """The newest armed profiler, or None. Incident bundles embed its
+    collapsed stacks as ``profile.txt`` when one is running — the "what
+    was executing" page of the black box."""
+    with _ACTIVE_LOCK:
+        return _ACTIVE[-1] if _ACTIVE else None
+
+
+def profile_for(seconds: float, hz: float = DEFAULT_HZ,
+                max_stacks: int = 2048) -> str:
+    """Run a transient sampler for ``seconds`` and return collapsed
+    stacks — the ``/profile?seconds=N`` endpoint body. Blocks the
+    calling thread (a handler/offload worker, never the loop)."""
+    p = SamplingProfiler(hz=hz, max_stacks=max_stacks).start()
+    try:
+        time.sleep(max(0.0, seconds))
+    finally:
+        p.stop()
+    return p.collapsed()
+
+
+# ---------------------------------------------------------------------------
+# collapsed-stack post-processing (shared by exports, CLI, tests)
+# ---------------------------------------------------------------------------
+
+
+def parse_collapsed(text: str) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, count = line.rpartition(" ")
+        try:
+            out[stack] = out.get(stack, 0) + int(count)
+        except ValueError:
+            continue
+    return out
+
+
+def top_frames(stacks: dict[str, int], n: int = 10) -> list[dict]:
+    """Top LEAF frames by inclusive sample count — where the time
+    actually went, flamegraph-tip view."""
+    leaves: collections.Counter = collections.Counter()
+    for stack, count in stacks.items():
+        leaves[stack.rsplit(";", 1)[-1]] += count
+    return [{"frame": frame, "samples": count}
+            for frame, count in leaves.most_common(n)]
+
+
+def collapsed_to_chrome(stacks: dict[str, int], hz: float) -> dict:
+    """Convert aggregated collapsed stacks into journal-shaped span
+    records and hand them to ``trace_export.to_chrome_trace`` — the
+    profile opens in the same viewer as every other trace artifact. Each
+    thread is a source (its own process lane); each stack becomes one
+    span whose duration is ``count / hz`` (its sampled share of the
+    wall), laid end to end."""
+    from ditl_tpu.telemetry.trace_export import to_chrome_trace
+
+    cursors: dict[str, float] = {}
+    records: list[dict] = []
+    for stack, count in stacks.items():
+        thread, _, frames = stack.partition(";")
+        dur = count / max(hz, 1e-9)
+        t0 = cursors.get(thread, 0.0)
+        cursors[thread] = t0 + dur
+        records.append({
+            "event": "trace.span",
+            "ts": t0,
+            "dur_s": dur,
+            "name": frames.rsplit(";", 1)[-1] or stack,
+            "source": f"prof:{thread}",
+            "trace": "",
+            "stack": frames,
+            "samples": count,
+        })
+    return to_chrome_trace(records)
+
+
+# ---------------------------------------------------------------------------
+# event-loop heartbeat + lag watchdog
+# ---------------------------------------------------------------------------
+
+
+class LoopHeartbeat:
+    """One tuple write per loop iteration. ``busy()`` as the tick starts
+    processing (select returned), ``idle()`` right before the loop parks
+    in select. The watchdog reads ``(ts, busy)`` in one GIL-atomic load;
+    only BUSY age is lag — a loop parked in select for its full poll
+    interval is healthy, not stalled."""
+
+    __slots__ = ("_stamp", "thread_ident")
+
+    def __init__(self):
+        self._stamp = (time.monotonic(), False)
+        self.thread_ident: int | None = None
+
+    def attach(self) -> None:
+        """Record the loop thread's ident (called once, from the loop)."""
+        self.thread_ident = threading.get_ident()
+        self._stamp = (time.monotonic(), False)
+
+    def busy(self) -> None:
+        self._stamp = (time.monotonic(), True)
+
+    def idle(self) -> None:
+        self._stamp = (time.monotonic(), False)
+
+    def read(self) -> tuple[float, bool]:
+        return self._stamp
+
+
+class LoopWatchdog:
+    """Heartbeat-age watchdog for ONE event loop.
+
+    A daemon thread checks the heartbeat every ``threshold_s / 4``
+    (floored at 5 ms): while the loop is busy, the instantaneous age
+    lands in ``ditl_loop_lag_seconds``; when it crosses ``threshold_s``
+    the watchdog burst-samples the loop thread at ``burst_hz`` until the
+    heartbeat advances (or ``max_stall_s`` gives up on a wedged loop),
+    then aggregates the burst into a convicting stack — the modal
+    deepest frame with its file:line — journals ``loop.stall``, bumps
+    ``ditl_loop_stalls_total``, and triggers a ``loop.stall`` anomaly
+    through the ISSUE 10 plane (so the bundle carries flight rings, the
+    metrics snapshot, chaos attribution, and the profile, exactly like
+    every other trigger). One sustained stall is ONE stall event: the
+    burst spans it, and the incident plane's fingerprint cooldown
+    dedupes repeats.
+    """
+
+    def __init__(self, heartbeat: LoopHeartbeat, *,
+                 threshold_s: float, burst_hz: float = 200.0,
+                 registry=None, plane=None, journal=None,
+                 source: str = "evloop", max_stall_s: float = 10.0):
+        if threshold_s <= 0:
+            raise ValueError(
+                f"watchdog threshold_s must be > 0, got {threshold_s}")
+        self.heartbeat = heartbeat
+        self.threshold_s = float(threshold_s)
+        self.burst_hz = max(1.0, float(burst_hz))
+        self.plane = plane
+        self.journal = journal
+        self.source = source
+        self.max_stall_s = max_stall_s
+        self.stalls = 0
+        self.last_stall: dict | None = None
+        self._lag_hist = None
+        self._stall_counter = None
+        if registry is not None:
+            from ditl_tpu.telemetry.registry import LATENCY_BUCKETS_S
+
+            self._lag_hist = registry.histogram(
+                "ditl_loop_lag_seconds",
+                "event-loop heartbeat age while busy (watchdog-sampled; "
+                "the excursion a loop.stall convicts)",
+                LATENCY_BUCKETS_S)
+            self._stall_counter = registry.counter(
+                "ditl_loop_stalls",
+                "event-loop stalls past telemetry.loop_stall_threshold_s "
+                "(each journaled as loop.stall with a convicting stack)")
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "LoopWatchdog":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="ditl-loop-watchdog", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join(timeout=5.0)
+        self._thread = None
+
+    def lag_p95(self) -> float | None:
+        """p95 busy-lag from the histogram; None before any busy sample
+        (absent != 0 — the same discipline as the role p95s on
+        /health)."""
+        h = self._lag_hist
+        if h is None or not h.count:
+            return None
+        return h.quantile(0.95)
+
+    # -- the watchdog thread ----------------------------------------------
+
+    def _run(self) -> None:
+        interval = max(0.005, self.threshold_s / 4.0)
+        while not self._stop.wait(interval):
+            ts, busy = self.heartbeat.read()
+            if not busy:
+                continue
+            lag = time.monotonic() - ts
+            if self._lag_hist is not None:
+                self._lag_hist.observe(lag)
+            if lag >= self.threshold_s:
+                try:
+                    self._convict(ts, lag)
+                except Exception:  # noqa: BLE001 - diagnosis never kills
+                    logger.exception("loop watchdog: conviction failed")
+
+    def _convict(self, stall_ts: float, lag: float) -> None:
+        """Burst-sample the loop thread for the stall's remaining
+        duration, then aggregate and report."""
+        ident = self.heartbeat.thread_ident
+        interval = 1.0 / self.burst_hz
+        counts: collections.Counter = collections.Counter()
+        deadline = time.monotonic() + self.max_stall_s
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            ts, busy = self.heartbeat.read()
+            if ts != stall_ts or not busy:
+                break  # heartbeat advanced: the stall is over
+            frame = (sys._current_frames().get(ident)
+                     if ident is not None else None)
+            if frame is not None:
+                counts[_fold(frame)] += 1
+            if self._stop.wait(interval):
+                break
+        duration = time.monotonic() - stall_ts
+        self.stalls += 1
+        if self._stall_counter is not None:
+            self._stall_counter.inc()
+        if counts:
+            stack, hits = counts.most_common(1)[0]
+            frame = stack.rsplit(";", 1)[-1]
+        else:  # stall ended before the first burst sample landed
+            stack, hits, frame = "", 0, "unsampled"
+        detail = {
+            "duration_s": round(duration, 4),
+            "lag_at_detection_s": round(lag, 4),
+            "frame": frame,
+            "stack": stack,
+            "burst_samples": int(sum(counts.values())),
+            "modal_samples": int(hits),
+            "source": self.source,
+            # One fingerprint per convicting frame: a storm of stalls at
+            # the same blocking call is ONE incident (cooldown), while
+            # stalls at two different call sites are two.
+            "fingerprint_key": frame,
+        }
+        self.last_stall = detail
+        logger.warning("loop stall: %.0f ms on %s", duration * 1000, frame)
+        if self.journal is not None:
+            try:
+                self.journal.event("loop.stall", **detail)
+            except Exception:  # noqa: BLE001
+                logger.exception("loop watchdog: journal write failed")
+        if self.plane is not None:
+            from ditl_tpu.telemetry.anomaly import Anomaly
+
+            self.plane.trigger(Anomaly(
+                "loop.stall", severity="warning", detail=dict(detail)))
+
+
+# ---------------------------------------------------------------------------
+# offload-pool saturation accounting
+# ---------------------------------------------------------------------------
+
+
+class OffloadPoolMonitor:
+    """Queue-wait + occupancy for the evloop's handler pool, written from
+    the WORKER side only (never the loop): the loop stamps a monotonic t0
+    when it frames a dispatch, the worker observes the wait when it picks
+    the job up and holds the busy gauge for the handler's duration.
+    Sustained queue-wait with a healthy loop-lag histogram reads "pool
+    starved, loop fine" — the signature troubleshooting §36 separates
+    from a blocked loop."""
+
+    def __init__(self, queue_hist, busy_gauge, size_gauge, workers: int):
+        self.queue_hist = queue_hist
+        self.busy_gauge = busy_gauge
+        self.size_gauge = size_gauge
+        self._busy = 0
+        if size_gauge is not None:
+            size_gauge.set(float(workers))
+
+    def job_started(self, queued_ts: float) -> None:
+        if self.queue_hist is not None:
+            self.queue_hist.observe(
+                max(0.0, time.monotonic() - queued_ts))
+        self._busy += 1
+        if self.busy_gauge is not None:
+            self.busy_gauge.set(float(self._busy))
+
+    def job_finished(self) -> None:
+        self._busy = max(0, self._busy - 1)
+        if self.busy_gauge is not None:
+            self.busy_gauge.set(float(self._busy))
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ditl_tpu.telemetry.prof",
+        description="Post-process a collapsed-stack profile (a bundle's "
+                    "profile.txt or a /profile?seconds=N response).")
+    ap.add_argument("--collapse", required=True,
+                    help="collapsed-stack file ('stack count' lines)")
+    ap.add_argument("--top", type=int, default=0, metavar="N",
+                    help="print the top N leaf frames by samples")
+    ap.add_argument("--chrome", default="", metavar="OUT",
+                    help="write a Chrome-trace JSON rendering to OUT")
+    ap.add_argument("--hz", type=float, default=DEFAULT_HZ,
+                    help="sample rate the profile was captured at "
+                         "(scales Chrome-trace span durations)")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.collapse) as f:
+            stacks = parse_collapsed(f.read())
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not stacks:
+        print("error: no collapsed stacks in input", file=sys.stderr)
+        return 2
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(collapsed_to_chrome(stacks, args.hz), f)
+        print(f"wrote {args.chrome}")
+    if args.top or not args.chrome:
+        n = args.top or 10
+        total = sum(stacks.values())
+        print(f"{total} samples, {len(stacks)} distinct stacks")
+        for row in top_frames(stacks, n):
+            share = row["samples"] / total
+            print(f"{row['samples']:8d}  {share:6.1%}  {row['frame']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -415,6 +415,21 @@ def train(config: Config) -> dict[str, Any]:
             **config.telemetry.incident_kwargs(),
         )
     anomaly_plane = AnomalyPlane(incidents=incidents, journal=journal)
+    # Continuous sampling profiler (ISSUE 18): armed by telemetry.prof_hz,
+    # off by default. Phase-tagged across the step loop so StepAnatomy's
+    # host_dispatch bucket gains stack attribution in the summary, and
+    # incident bundles embed the collapsed profile (profile.txt).
+    sampler = None
+    if config.telemetry.prof_hz > 0:
+        from ditl_tpu.telemetry.prof import SamplingProfiler
+
+        sampler = SamplingProfiler(
+            hz=config.telemetry.prof_hz,
+            max_stacks=config.telemetry.prof_max_stacks,
+            registry=memwatch.registry,
+        )
+        sampler.arm_phases()  # this (the step-loop) thread
+        sampler.start()
     train_detector = TrainingDetector(
         **config.telemetry.training_detector_kwargs()
     )
@@ -515,6 +530,11 @@ def train(config: Config) -> dict[str, Any]:
                 # compile/productive_step and break conservation.
                 profiler.maybe_start(global_step)
                 prof_s = time.perf_counter() - t_window0
+                if sampler is not None:
+                    # Tag the dispatch window: samples landing here
+                    # attribute StepAnatomy's host_dispatch bucket to
+                    # real frames in the summary (one attribute write).
+                    sampler.set_phase("host_dispatch")
                 with profiler.annotate(global_step):
                     if train_multi is not None and len(window) == spc:
                         # One device program runs the whole window: zero host
@@ -556,6 +576,8 @@ def train(config: Config) -> dict[str, Any]:
                     # conservation invariant.
                     excluded_s=prof_s,
                 )
+                if sampler is not None:
+                    sampler.set_phase(None)
                 # Window wall (dispatch + any flush sync inside end_step;
                 # data wait happened before the window body, profiler work
                 # is subtracted — both have their own buckets): the FIRST
@@ -723,6 +745,8 @@ def train(config: Config) -> dict[str, Any]:
         raise
     finally:
         _in_teardown[0] = True  # tail-window flushes detect but never raise
+        if sampler is not None:
+            sampler.stop()
         metrics.close()
         with tracker.span("profiler"):
             profiler.close()
@@ -757,6 +781,19 @@ def train(config: Config) -> dict[str, Any]:
     # data-wait / host-dispatch / device-compute / checkpoint-overlap,
     # conservation-checked against the measured step-path wall to 5%.
     summary["step_anatomy"] = anatomy.report()
+    # Stack attribution (ISSUE 18): when the sampling profiler was armed,
+    # the anatomy's host_dispatch bucket names its hot frames — "dispatch
+    # is slow" becomes "dispatch is slow IN THIS FUNCTION".
+    if sampler is not None:
+        frames = sampler.phase_top("host_dispatch", 5)
+        if frames:
+            summary["step_anatomy"]["host_dispatch_frames"] = frames
+        summary["profile"] = {
+            "samples": sampler.samples,
+            "distinct_stacks": len(sampler.snapshot()),
+            "evicted": sampler.evicted,
+            "hz": sampler.hz,
+        }
     # Anomaly-plane accounting (ISSUE 10): what fired and how many bundles
     # were assembled — a completed-but-noisy run is visible in its summary.
     if anomaly_plane.detected:

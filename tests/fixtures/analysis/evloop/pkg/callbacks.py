@@ -1,0 +1,27 @@
+"""Blocking callables *registered* as loop callbacks (ISSUE 18): no
+``@event_loop`` marker anywhere — the rule must resolve the registration
+target (module function / self-method / lambda) and still fire."""
+import time
+
+
+def flush_on_done(fut):
+    time.sleep(0.05)  # violation: registered below via add_done_callback
+
+
+def never_registered(fut):
+    time.sleep(0.05)  # silent: not a callback, not marked
+
+
+class Relay:
+    def on_done(self, fut):
+        self.sock.sendall(b"bye")  # violation: self-method registered
+
+    def post_result(self, fut):
+        self.mailbox.append(fut)  # silent: registered but non-blocking
+
+    def wire(self, fut, loop):
+        fut.add_done_callback(flush_on_done)
+        fut.add_done_callback(self.on_done)
+        fut.add_done_callback(self.post_result)
+        loop.call_soon(lambda: time.sleep(1))  # violation: inline lambda
+        fut.add_done_callback(self.imported_helper)  # silent: unresolvable

@@ -205,15 +205,26 @@ def test_tenant_label_discipline_fixture():
 def test_event_loop_hygiene_fixture():
     diags = run(fixture("evloop"), rules=["event-loop-hygiene"])
     assert ids(diags) == [
-        ("event-loop-hygiene", 10),  # sleep
-        ("event-loop-hygiene", 11),  # .sendall
-        ("event-loop-hygiene", 12),  # .join
-        ("event-loop-hygiene", 13),  # un-witnessed with self._lock
+        ("event-loop-hygiene", 10),  # bad.py: sleep
+        ("event-loop-hygiene", 11),  # bad.py: .sendall
+        ("event-loop-hygiene", 12),  # bad.py: .join
+        ("event-loop-hygiene", 13),  # bad.py: un-witnessed with self._lock
+        ("event-loop-hygiene", 8),   # callbacks.py: sleep in registered fn
+        ("event-loop-hygiene", 17),  # callbacks.py: .sendall in self-method
+        ("event-loop-hygiene", 26),  # callbacks.py: sleep in lambda
     ]
-    assert all("Loop.tick" in d.message for d in diags)
+    marked = [d for d in diags if d.path.endswith("bad.py")]
+    assert all("Loop.tick" in d.message for d in marked)
+    # Registered-callback resolution (ISSUE 18): no @event_loop marker in
+    # callbacks.py — the rule resolved the registration targets.
+    registered = [d for d in diags if d.path.endswith("callbacks.py")]
+    assert all("loop callback" in d.message for d in registered)
+    assert any("add_done_callback" in d.message for d in registered)
+    assert any("<lambda>" in d.message for d in registered)
     # .send/.recv (non-blocking by construction on loop-owned sockets),
-    # the guarded-by-witnessed lock, the pragma'd sleep, and the unmarked
-    # method all stay silent.
+    # the guarded-by-witnessed lock, the pragma'd sleep, the unmarked
+    # method, the blocking-but-never-registered function, and the
+    # unresolvable registration target all stay silent.
 
 
 def test_every_rule_has_a_violating_fixture():

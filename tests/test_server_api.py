@@ -386,6 +386,34 @@ def test_tokenize_detokenize_endpoints(setup):
         server.shutdown()
 
 
+@pytest.mark.prof
+def test_profile_endpoint_returns_collapsed_stacks(setup):
+    """/profile?seconds=N (ISSUE 18) on the replica server: a transient
+    sampler capture comes back as non-empty parseable collapsed stacks;
+    a malformed seconds value is a 400, not a stack trace."""
+    from ditl_tpu.telemetry.prof import parse_collapsed
+
+    params, cfg, tok = setup
+    server, _, port = _serve(params, cfg, tok)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/profile?seconds=0.3", timeout=60
+        ) as resp:
+            assert resp.status == 200
+            text = resp.read().decode()
+        stacks = parse_collapsed(text)
+        assert stacks, "profile endpoint returned no stacks"
+        # the serving threads themselves are among the sampled stacks
+        assert any("serve_forever" in s or "select" in s or "poll" in s
+                   for s in stacks)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/profile?seconds=nope", timeout=60)
+        assert err.value.code == 400
+    finally:
+        server.shutdown()
+
+
 def test_chat_template_used_when_tokenizer_has_one(setup):
     from ditl_tpu.infer.server import _chat_prompt
 

@@ -380,6 +380,7 @@ def test_observability_packages_are_jax_free_on_import():
         "import ditl_tpu.telemetry.anomaly\n"
         "import ditl_tpu.telemetry.incident\n"
         "import ditl_tpu.telemetry.catalog\n"
+        "import ditl_tpu.telemetry.prof\n"
         "import ditl_tpu.gateway\n"
         "import ditl_tpu.gateway.gateway\n"
         "import ditl_tpu.gateway.replica\n"
