@@ -1055,6 +1055,7 @@ def run_trace_replay_bench(trace_path: str, n_replicas: int = 3,
                            min_replicas: int = 1,
                            slo_ttft_s: float = 2.5,
                            compile_cache_dir: str = "",
+                           bulk_backlog: int = 0,
                            _model_overrides: dict | None = None,
                            _autoscale_overrides: dict | None = None) -> dict:
     """Traffic-trace replay bench (ISSUE 12): drive a recorded request
@@ -1069,9 +1070,16 @@ def run_trace_replay_bench(trace_path: str, n_replicas: int = 3,
     replica-seconds at no worse TTFT p95 / SLO violation rate.
 
     ``speed`` compresses the recorded offsets (2.0 = twice as fast);
-    ``min_replicas`` floors ordinary scale-down; ``_model_overrides`` /
-    ``_autoscale_overrides`` shrink the model / tune the planner for
-    tier-1 acceptance drills (a published row must not use them)."""
+    ``min_replicas`` floors ordinary scale-down; ``bulk_backlog`` > 0
+    arms the offline bulk lane (ISSUE 19): an N-item job is submitted
+    through the real ``POST /v1/bulk/jobs`` endpoint before the timed
+    region and soaks spare decode capacity through ``best_effort``
+    relays while the interactive trace replays — the row grows a
+    ``bulk`` block (lane tokens/sec + the interactive TTFT p95 measured
+    WITH the backlog running) that perf_compare gates;
+    ``_model_overrides`` / ``_autoscale_overrides`` shrink the model /
+    tune the planner for tier-1 acceptance drills (a published row must
+    not use them)."""
     import dataclasses
     import threading
 
@@ -1141,6 +1149,24 @@ def run_trace_replay_bench(trace_path: str, n_replicas: int = 3,
         fleet, interval_s=0.05, fail_threshold=3,
         probe_timeout_s=2.0, restart_timeout_s=20.0,
     )
+    bulk_manager = None
+    bulk_dir = ""
+    if bulk_backlog > 0:
+        import shutil
+        import tempfile
+
+        from ditl_tpu.config import BulkConfig
+        from ditl_tpu.gateway.bulk import BulkJobManager
+
+        # One in-flight slot per replica: the lane soaks spare decode
+        # slots without queueing deeper than the fleet can absorb, and
+        # a mid-run death re-dispatches at most that window.
+        bulk_dir = tempfile.mkdtemp(prefix="ditl-bulk-bench-")
+        bulk_manager = BulkJobManager(
+            bulk_dir,
+            BulkConfig(dir=bulk_dir, max_in_flight=max(1, n_replicas)),
+            registry=gw_metrics.registry,
+        )
     actuator = None
     if autoscale:
         as_kwargs = dict(
@@ -1151,12 +1177,12 @@ def run_trace_replay_bench(trace_path: str, n_replicas: int = 3,
         as_kwargs.update(_autoscale_overrides or {})
         actuator = Actuator(
             fleet, supervisor, AutoscaleConfig(**as_kwargs),
-            metrics=gw_metrics,
+            metrics=gw_metrics, bulk=bulk_manager,
         )
         supervisor.autoscaler = actuator
     gwcfg = GatewayConfig(router="affinity", affinity_prefix_tokens=4)
     server = make_gateway(fleet, config=gwcfg, metrics=gw_metrics, port=0,
-                          actuator=actuator)
+                          actuator=actuator, bulk=bulk_manager)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     port = server.server_address[1]
     try:
@@ -1166,6 +1192,7 @@ def run_trace_replay_bench(trace_path: str, n_replicas: int = 3,
             speed=speed, min_replicas=min_replicas, slo_ttft_s=slo_ttft_s,
             default_max_new=default_max_new, trace_path=trace_path,
             platform=platform, _inc0=_inc0,
+            bulk=bulk_manager, bulk_backlog=bulk_backlog,
         )
     finally:
         # One finally covers the replay too: a failed request (retry
@@ -1173,19 +1200,24 @@ def run_trace_replay_bench(trace_path: str, n_replicas: int = 3,
         # the supervisor, or the engines into the calling process — the
         # tier-1 A/B drill runs this in-process, where a leaked
         # supervisor thread would keep probing for the rest of the
-        # pytest session.
+        # pytest session. The bulk manager stops FIRST so its dispatch
+        # threads quit issuing relays before the fleet drains.
+        if bulk_manager is not None:
+            bulk_manager.close()
         server.shutdown()
         server.server_close()
         fleet.stop_all(drain=True, timeout=10.0)
         for eng in engines:
             eng.close()
+        if bulk_manager is not None:
+            shutil.rmtree(bulk_dir, ignore_errors=True)
 
 
 def _run_trace_replay_timed(rows, engines, fleet, supervisor, actuator,
                             port, *, n_replicas, slots, autoscale,
                             speed, min_replicas, slo_ttft_s,
                             default_max_new, trace_path, platform,
-                            _inc0) -> dict:
+                            _inc0, bulk=None, bulk_backlog=0) -> dict:
     """The warmed+timed half of :func:`run_trace_replay_bench`; the
     caller owns (and always tears down) the fleet/server/engines."""
     from concurrent.futures import ThreadPoolExecutor
@@ -1269,8 +1301,28 @@ def _run_trace_replay_timed(rows, engines, fleet, supervisor, actuator,
             # replay only.
             list(pool.map(warm, fleet.views()))
             serving_base = snapshot_serving(bundles)
+            bulk_job_id, bulk_tok0 = "", 0
+            if bulk is not None and bulk_backlog > 0:
+                # Submit through the REAL endpoint so the row exercises
+                # the whole lane (parse -> quota -> journal -> relay).
+                # Prompts cycle the already-warmed shapes: a bulk item
+                # compiling inside the timed region would charge its
+                # compile seconds to the interactive TTFT comparison.
+                bulk_prompts = [warm_prompts[i % len(warm_prompts)]
+                                for i in range(bulk_backlog)]
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}/v1/bulk/jobs",
+                    data=json.dumps({"prompts": bulk_prompts,
+                                     "max_new": default_max_new}).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    bulk_job_id = json.loads(resp.read())["id"]
             supervisor.start()
             sampler.start()
+            if bulk is not None:
+                bulk_tok0 = bulk.tokens_total()
             t_start = time.perf_counter()
             tokens = sum(pool.map(one, enumerate(rows)))
             dt = time.perf_counter() - t_start
@@ -1282,9 +1334,34 @@ def _run_trace_replay_timed(rows, engines, fleet, supervisor, actuator,
         for entry in actuator.recent():
             key = f"{entry['kind']}_{entry['outcome']}"
             actions[key] = actions.get(key, 0) + 1
+    # Summarize the timed region BEFORE draining the bulk tail — the
+    # post-replay drain would otherwise leak its (idle-fleet) TTFTs into
+    # the serving block the interference comparison reads.
+    serving_summary = serving_bench_summary(bundles, since=serving_base)
+    bulk_block = None
+    if bulk is not None and bulk_backlog > 0:
+        bulk_tokens = bulk.tokens_total() - bulk_tok0
+        drained = bulk.drain(timeout_s=120.0)
+        rec = bulk.status(bulk_job_id) or {}
+        # The interference number the lane is graded on: interactive
+        # TTFT p95 measured WITH the backlog running. Class-split when
+        # the trace carries SLO classes, fleet-wide otherwise.
+        ttft = serving_summary.get("interactive_ttft_p95_s")
+        if ttft is None:
+            ttft = serving_summary.get("ttft_p95_s")
+        bulk_block = {
+            "backlog": bulk_backlog,
+            "bulk_tokens_per_s": (round(bulk_tokens / dt, 1)
+                                  if dt > 0 else 0.0),
+            "bulk_interactive_ttft_p95_s": ttft,
+            "drained": drained,
+            "items_completed": int(rec.get("n_done") or 0),
+            "items_retried": int(rec.get("n_retried") or 0),
+        }
     row = {
-        "metric": "trace replay (%d replica(s) x %d slots, autoscale=%s)"
-                  % (n_replicas, slots, "on" if autoscale else "off"),
+        "metric": "trace replay (%d replica(s) x %d slots, autoscale=%s%s)"
+                  % (n_replicas, slots, "on" if autoscale else "off",
+                     ", bulk=%d" % bulk_backlog if bulk_backlog else ""),
         **_record_meta(),
         "value": round(tokens / dt, 1),
         "unit": "tokens/sec",
@@ -1295,7 +1372,7 @@ def _run_trace_replay_timed(rows, engines, fleet, supervisor, actuator,
         "requests": len(rows),
         "trace": {"path": trace_path, "rows": len(rows), "speed": speed,
                   "duration_s": round(dt, 3)},
-        "serving": serving_bench_summary(bundles, since=serving_base),
+        "serving": serving_summary,
         # The autoscaler A/B block (hoisted by perf_compare like
         # `serving`): replica_seconds regresses when it RISES, the SLO
         # violation rate when it rises — on-vs-off on the same seeded
@@ -1311,6 +1388,8 @@ def _run_trace_replay_timed(rows, engines, fleet, supervisor, actuator,
         **_chaos_result(),
         **_incident_result(_inc0),
     }
+    if bulk_block is not None:
+        row["bulk"] = bulk_block
     return row
 
 
@@ -3049,6 +3128,15 @@ if __name__ == "__main__":
                         help="with --serve-trace-replay: compress the "
                         "recorded inter-arrival offsets by this factor "
                         "(2.0 = replay twice as fast)")
+    parser.add_argument("--serve-bulk-backlog", type=int, default=0,
+                        metavar="N",
+                        help="with --serve-trace-replay: submit an N-item "
+                        "offline bulk job (POST /v1/bulk/jobs) before the "
+                        "timed replay and soak it through the best_effort "
+                        "lane while the interactive trace runs (ISSUE 19); "
+                        "the row grows a `bulk` block — lane tokens/sec "
+                        "plus the interactive TTFT p95 measured WITH the "
+                        "backlog running — that perf_compare gates")
     args = parser.parse_args()
     if args.chaos:
         from ditl_tpu.chaos import FaultPlane, arm
@@ -3092,6 +3180,9 @@ if __name__ == "__main__":
     if args.serve_trace_replay and not (args.infer and args.serve_replicas):
         parser.error("--serve-trace-replay requires --infer "
                      "--serve-replicas N (the fleet it replays against)")
+    if args.serve_bulk_backlog and not args.serve_trace_replay:
+        parser.error("--serve-bulk-backlog requires --serve-trace-replay "
+                     "(the interactive load the lane must not burn)")
     if args.infer and args.serve_multi_lora:
         sys.exit(bench_multi_lora(
             n_adapters=args.serve_multi_lora, slots=args.slots,
@@ -3106,6 +3197,7 @@ if __name__ == "__main__":
             autoscale=args.serve_autoscale, speed=args.trace_speed,
             min_replicas=args.serve_min_replicas,
             compile_cache_dir=args.compile_cache_dir,
+            bulk_backlog=args.serve_bulk_backlog,
         ))
     if args.infer and args.serve_replicas:
         sys.exit(bench_gateway(

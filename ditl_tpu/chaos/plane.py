@@ -139,6 +139,13 @@ SITES = {
                   "every connected stream freezes; the stall drill "
                   "expects the lag watchdog to convict this exact "
                   "file:line in the loop.stall incident bundle)",
+    "bulk.dispatch": "gateway/bulk.py: one bulk work-item dispatch "
+                     "attempt, after its bulk.dispatch journal row and "
+                     "before the relay (kill = the mid-job gateway death "
+                     "the resume drill injects — a restarted manager must "
+                     "re-dispatch at most the in-flight window; error = a "
+                     "transport fault riding the item's ordinary retry "
+                     "path; the call= trigger picks which item dies)",
 }
 
 

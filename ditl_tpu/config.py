@@ -37,6 +37,7 @@ __all__ = [
     "AutoscaleConfig",
     "AdapterConfig",
     "ChaosConfig",
+    "BulkConfig",
     "TelemetryConfig",
     "Config",
     "parse_overrides",
@@ -691,6 +692,13 @@ class AutoscaleConfig:
     dry_run: bool = False
     # Bounded in-memory action log served at the gateway's /actions.
     action_log: int = 256
+    # Bulk-lane coupling (ISSUE 19): pending bulk work items at/above this
+    # depth count as a scale-up signal (soak the backlog with more decode
+    # capacity), and ANY bulk backlog vetoes the idle scale-down/
+    # scale-to-zero paths — the lane exists to fill valleys, so an "idle"
+    # fleet with bulk work pending is not idle. 0 disables the coupling
+    # entirely: bulk never asks for capacity and never blocks parking.
+    bulk_scale_up_backlog: int = 0
 
     def __post_init__(self):
         if self.min_replicas < 0:
@@ -741,6 +749,11 @@ class AutoscaleConfig:
                     f"autoscale.{name} must be > 0, got "
                     f"{getattr(self, name)}"
                 )
+        if self.bulk_scale_up_backlog < 0:
+            raise ValueError(
+                f"autoscale.bulk_scale_up_backlog must be >= 0 (0 = "
+                f"decoupled), got {self.bulk_scale_up_backlog}"
+            )
 
 
 @dataclass(frozen=True)
@@ -918,6 +931,70 @@ class ChaosConfig:
             from ditl_tpu.chaos.plane import parse_rules
 
             parse_rules(self.rules)
+
+
+@dataclass(frozen=True)
+class BulkConfig:
+    """Offline bulk-inference lane (ditl_tpu/gateway/bulk.py, ISSUE 19):
+    a crash-consistent job manager behind the gateway's ``/v1/bulk/jobs``
+    endpoints, decomposing each job into per-prompt work items dispatched
+    through the ordinary relay path pinned to ``best_effort`` — so
+    interactive and batch traffic preempt bulk token-by-token at the
+    engine and the interactive stall bound (ISSUE 8) holds unchanged.
+    Disarmed by default: with ``dir`` empty the gateway serves no bulk
+    endpoints and behaves exactly as before."""
+
+    # The lane's durable state directory: job specs, per-job item/result
+    # JSONL files, and the segment-rotated ``bulk-<source>.jsonl``
+    # journal the resume scan replays. "" = lane disarmed.
+    dir: str = ""
+    # Per-JOB in-flight dispatch window: how many items one job may have
+    # riding the relay at once. Also the crash-loss bound — a SIGKILLed
+    # gateway re-dispatches at most this many already-attempted items on
+    # resume (their terminal journal rows had not landed yet).
+    max_in_flight: int = 4
+    # Per-tenant quotas enforced by TenantAdmission at submit with typed
+    # 429s (0 = unlimited): concurrently queued/running jobs, and total
+    # not-yet-terminal items across those jobs.
+    max_jobs_per_tenant: int = 4
+    max_queued_items_per_tenant: int = 10000
+    # Per-job item cap — a submit above it is a 400, not a quota 429
+    # (reject-don't-drop: the job is malformed, not merely early).
+    max_items_per_job: int = 10000
+    # Decode budget per item when the job spec does not set max_new.
+    default_max_new: int = 64
+    # Outer retry budget per item for transient outcomes (429/503/504/
+    # transport error) ON TOP of the relay's own idempotent-safe
+    # in-attempt retries; exhausting it marks the item failed.
+    retry_limit: int = 8
+    # Backlog-stall detector: with a non-empty backlog, NO item reaching
+    # a terminal outcome for this long while the fleet's live replicas
+    # sit idle raises the ``bulk.backlog_stall`` anomaly (one incident
+    # bundle via the fingerprint cooldown).
+    stall_after_s: float = 30.0
+    # Dispatch-loop poll cadence (cancel checks, stall checks, gauge
+    # refresh) — the latency floor for noticing a cancel, not a
+    # throughput knob.
+    poll_interval_s: float = 0.5
+
+    def __post_init__(self):
+        for name in ("max_in_flight", "max_items_per_job",
+                     "default_max_new", "retry_limit"):
+            if getattr(self, name) < 1:
+                raise ValueError(
+                    f"bulk.{name} must be >= 1, got {getattr(self, name)}"
+                )
+        for name in ("max_jobs_per_tenant", "max_queued_items_per_tenant"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"bulk.{name} must be >= 0 (0 = unlimited), got "
+                    f"{getattr(self, name)}"
+                )
+        for name in ("stall_after_s", "poll_interval_s"):
+            if getattr(self, name) <= 0:
+                raise ValueError(
+                    f"bulk.{name} must be > 0, got {getattr(self, name)}"
+                )
 
 
 @dataclass(frozen=True)
@@ -1185,6 +1262,7 @@ class Config:
     usage: UsageConfig = field(default_factory=UsageConfig)
     adapter: AdapterConfig = field(default_factory=AdapterConfig)
     chaos: ChaosConfig = field(default_factory=ChaosConfig)
+    bulk: BulkConfig = field(default_factory=BulkConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
 
     def to_dict(self) -> dict[str, Any]:

@@ -121,6 +121,15 @@ class _TenantState:
     active: int = 0
     admitted: int = 0
     throttled: int = 0
+    # Bulk-lane quota state (ISSUE 19): concurrently queued/running bulk
+    # jobs and their not-yet-terminal items, checked by acquire_bulk at
+    # submit and returned by the manager when a job reaches a terminal
+    # state. Limits resolve per tenant like every other knob here.
+    bulk_max_jobs: int = 0
+    bulk_max_items: int = 0
+    bulk_jobs: int = 0
+    bulk_items: int = 0
+    bulk_throttled: int = 0
 
 
 class TenantAdmission:
@@ -141,10 +150,16 @@ class TenantAdmission:
         per_tenant: dict[str, dict] | None = None,
         max_tenants: int = 4096,
         slo_class: str = "",
+        bulk_max_jobs: int = 0,
+        bulk_max_queued_items: int = 0,
     ):
         self.default_rate = float(rate)
         self.default_burst = float(burst) if burst else max(1.0, float(rate))
         self.default_max_concurrent = int(max_concurrent)
+        # Bulk-lane defaults (0 = unlimited); per_tenant "bulk_max_jobs" /
+        # "bulk_max_queued_items" overrides win, same resolution as rate.
+        self.default_bulk_max_jobs = int(bulk_max_jobs)
+        self.default_bulk_max_items = int(bulk_max_queued_items)
         self.per_tenant = dict(per_tenant or {})
         self.max_tenants = int(max_tenants)
         # Default SLO-class pin for every tenant ("" = none); a per-tenant
@@ -183,6 +198,13 @@ class TenantAdmission:
                     cfg.get("slo_class", self.default_slo_class) or ""
                 ),
                 adapter=str(cfg.get("adapter", "") or ""),
+                bulk_max_jobs=int(
+                    cfg.get("bulk_max_jobs", self.default_bulk_max_jobs)
+                ),
+                bulk_max_items=int(
+                    cfg.get("bulk_max_queued_items",
+                            self.default_bulk_max_items)
+                ),
             )
             self._tenants[tenant] = st
             # Tenants arrive as arbitrary unauthenticated bearer tokens:
@@ -195,7 +217,11 @@ class TenantAdmission:
                 for key in list(self._tenants):
                     if len(self._tenants) <= self.max_tenants:
                         break
-                    if key != tenant and self._tenants[key].active == 0:
+                    other = self._tenants[key]
+                    # "Inactive" includes the bulk lane: evicting a tenant
+                    # with live bulk jobs would forget its quota footprint.
+                    if (key != tenant and other.active == 0
+                            and other.bulk_jobs == 0):
                         del self._tenants[key]
         else:
             self._tenants.move_to_end(tenant)
@@ -238,6 +264,56 @@ class TenantAdmission:
             if st is not None and st.active > 0:
                 st.active -= 1
 
+    def acquire_bulk(self, tenant: str, n_items: int) -> AdmissionDecision:
+        """Admit one bulk job of ``n_items`` work items against the
+        tenant's bulk quotas (ISSUE 19). Distinct from :meth:`acquire` on
+        purpose: a bulk submit is one control-plane request carrying hours
+        of decode work — it is gated on standing footprint (jobs, queued
+        items), not on the interactive token bucket. Denials carry typed
+        reasons so the gateway's 429 body says WHICH quota tripped. Paired
+        with :meth:`release_bulk` when the job reaches a terminal state."""
+        with self._lock:
+            st = self._state(tenant)
+            if st.bulk_max_jobs > 0 and st.bulk_jobs >= st.bulk_max_jobs:
+                st.bulk_throttled += 1
+                return AdmissionDecision(
+                    False, retry_after_s=1.0,
+                    reason=f"tenant bulk job quota ({st.bulk_max_jobs} "
+                           "concurrent jobs) reached",
+                    slo_class=st.slo_class, adapter=st.adapter,
+                )
+            if (st.bulk_max_items > 0
+                    and st.bulk_items + n_items > st.bulk_max_items):
+                st.bulk_throttled += 1
+                return AdmissionDecision(
+                    False, retry_after_s=1.0,
+                    reason=f"tenant bulk item quota ({st.bulk_max_items} "
+                           f"queued items) would be exceeded by "
+                           f"{n_items} more",
+                    slo_class=st.slo_class, adapter=st.adapter,
+                )
+            st.bulk_jobs += 1
+            st.bulk_items += n_items
+            return AdmissionDecision(True, slo_class=st.slo_class,
+                                     adapter=st.adapter)
+
+    def reacquire_bulk(self, tenant: str, n_items: int) -> None:
+        """Re-register an ALREADY-ADMITTED job's footprint after a gateway
+        restart (quota state is in-memory and died with the old process).
+        Unconditional: resumed work was accepted by a past incarnation and
+        must not bounce off its own quota — only NEW submissions contend."""
+        with self._lock:
+            st = self._state(tenant)
+            st.bulk_jobs += 1
+            st.bulk_items += max(0, int(n_items))
+
+    def release_bulk(self, tenant: str, n_items: int) -> None:
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is not None and st.bulk_jobs > 0:
+                st.bulk_jobs -= 1
+                st.bulk_items = max(0, st.bulk_items - max(0, int(n_items)))
+
     def snapshot(self) -> dict:
         """Per-tenant counters for /stats and the per-tenant metric names
         (keys reduced via :func:`tenant_label` — raw API keys never leave
@@ -248,6 +324,9 @@ class TenantAdmission:
                     "active": st.active,
                     "admitted": st.admitted,
                     "throttled": st.throttled,
+                    "bulk_jobs": st.bulk_jobs,
+                    "bulk_items": st.bulk_items,
+                    "bulk_throttled": st.bulk_throttled,
                 }
                 for t, st in self._tenants.items()
             }

@@ -128,6 +128,11 @@ class FleetSignals:
     queue_per_replica: float  # mean queued+outstanding per live view
     slo_alerting: bool = False
     cold_start_s: float = 0.0  # wake budget input (measured, or default)
+    # Pending bulk-lane work items (ISSUE 19): a standing demand signal
+    # the instantaneous pressure/queue reads cannot see — bulk dispatches
+    # best_effort and is preempted first, so a busy fleet shows ZERO bulk
+    # in its queues while hours of work wait in the lane.
+    bulk_backlog: int = 0
 
     def snapshot(self) -> dict:
         """The journal/bundle form: small, flat-ish, host scalars only."""
@@ -140,6 +145,7 @@ class FleetSignals:
             "quarantined": len(self.quarantined),
             "slo_alerting": self.slo_alerting,
             "cold_start_s": round(self.cold_start_s, 3),
+            "bulk_backlog": self.bulk_backlog,
             "tpot_p95_s": {
                 v.id: round(v.tpot_p95_s, 6) for v in self.views
                 if isinstance(v.tpot_p95_s, (int, float))
@@ -253,9 +259,17 @@ class ActionPlanner:
             self._idle_since = None
             return out
         cooled = now - self._last_scale >= cfg.cooldown_s
+        # Bulk-lane coupling (armed only when bulk_scale_up_backlog > 0):
+        # a deep offline backlog is demand even when every queue reads
+        # empty — bulk is preempted first, so it never shows up there.
+        bulk_coupled = cfg.bulk_scale_up_backlog > 0
+        bulk_hot = (bulk_coupled
+                    and signals.bulk_backlog >= cfg.bulk_scale_up_backlog)
+        bulk_pending = bulk_coupled and signals.bulk_backlog > 0
         # -- scale up -------------------------------------------------------
         hot = (signals.pressure >= cfg.scale_up_pressure
-               or signals.queue_per_replica >= cfg.scale_up_queue)
+               or signals.queue_per_replica >= cfg.scale_up_queue
+               or bulk_hot)
         if hot:
             if self._up_streak == 0:
                 self._signal("pressure_high", signals)
@@ -267,15 +281,21 @@ class ActionPlanner:
             out.append(Action(
                 "scale_up", sorted(signals.parked)[0],
                 f"pressure {signals.pressure:.2f} / queue "
-                f"{signals.queue_per_replica:.2f} over "
+                f"{signals.queue_per_replica:.2f} / bulk backlog "
+                f"{signals.bulk_backlog} over "
                 f"{self._up_streak} poll(s)",
                 signals.snapshot(), now,
             ))
             return out
         # -- scale down -----------------------------------------------------
+        # A pending bulk backlog vetoes parking: the lane exists to soak
+        # exactly the capacity a scale-down would remove. Drain the
+        # backlog first; THEN the fleet may shrink.
         idle = (signals.pressure <= cfg.scale_down_pressure
-                and signals.queue_per_replica == 0)
+                and signals.queue_per_replica == 0
+                and not bulk_pending)
         all_idle = signals.pressure == 0 and signals.queue_per_replica == 0 \
+            and not bulk_pending \
             and all(v.outstanding == 0 for v in signals.views)
         if idle:
             if self._down_streak == 0:
@@ -398,13 +418,15 @@ class Actuator:
         flight=None,
         plane=None,
         slo=None,
+        bulk=None,
     ):
         """``journal``: EventJournal for ``action.*`` events; ``metrics``:
         GatewayMetrics (per-kind/outcome counters); ``flight``:
         FlightRecorder (ACTION ring); ``plane``: AnomalyPlane — executed
         remediation and failed actions become incident bundles through it;
         ``slo``: BurnRateMonitor whose ``any_alerting()`` pins the fleet
-        size while burning."""
+        size while burning; ``bulk``: BulkJobManager whose ``backlog()``
+        feeds the bulk demand signal (ISSUE 19) — None reads as zero."""
         self.fleet = fleet
         self.supervisor = supervisor
         self.config = config
@@ -419,6 +441,7 @@ class Actuator:
         self.flight = flight
         self.plane = plane
         self.slo = slo
+        self.bulk = bulk
         # THE fleet-mutation lock — the same Lock object the supervisor's
         # crash recovery and rolling restarts hold (replica.py); sharing
         # the object is what serializes a scale event against a relaunch.
@@ -509,6 +532,12 @@ class Actuator:
                 alerting = bool(self.slo.any_alerting())
             except Exception:  # noqa: BLE001 - a broken monitor reads calm
                 alerting = False
+        bulk_backlog = 0
+        if self.bulk is not None:
+            try:
+                bulk_backlog = int(self.bulk.backlog())
+            except Exception:  # noqa: BLE001 - a broken lane reads empty
+                bulk_backlog = 0
         return FleetSignals(
             now=now,
             views=tuple(views),
@@ -519,6 +548,7 @@ class Actuator:
             queue_per_replica=queue,
             slo_alerting=alerting,
             cold_start_s=self.wake_budget_s() / self.config.wake_budget_factor,
+            bulk_backlog=bulk_backlog,
         )
 
     def poll(self) -> list[dict]:

@@ -34,6 +34,7 @@ import collections
 import http.client
 import json
 import math
+import re
 import threading
 import time
 import urllib.error
@@ -502,6 +503,11 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     # verify -> per-replica swap walks for /v1/adapters/{load,evict,
     # publish}. make_gateway always arms one (it needs only the fleet).
     publisher = None
+    # Offline bulk-inference lane (ISSUE 19): a gateway/bulk.BulkJobManager
+    # serving /v1/bulk/jobs — journaled crash-consistent jobs dispatching
+    # per-prompt items through _route_and_relay pinned best_effort.
+    # Unarmed by default (bulk.dir empty -> the routes 404).
+    bulk = None
 
     def log_message(self, *args):
         logger.debug("gateway http: " + args[0], *args[1:])
@@ -543,17 +549,32 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             (time.time(), self.gw.completed.value)
         )
 
-    def _fleet_retry_after(self, floor: int = 1) -> int:
+    def _fleet_retry_after(self, floor: int = 1,
+                           slo_class: str = "") -> int:
         """Backlog-aware Retry-After for fleet-level 429s: total backlog
         (queue + active across live replicas) over the gateway's recent
         completion rate — the same telemetry.serving.backlog_retry_after
-        derivation the single server uses per replica."""
+        derivation the single server uses per replica.
+
+        For ``best_effort`` callers on a bulk-armed gateway (ISSUE 19)
+        the derivation switches inputs entirely: backlog = the bulk
+        lane's pending work items, rate = the lane's own item-completion
+        samples. A bulk submitter bounced off a deep offline backlog
+        must come back when the BACKLOG has moved, not on the
+        interactive service-rate clamp — the class hint also relaxes
+        the clamp inside backlog_retry_after."""
+        if slo_class == "best_effort" and self.bulk is not None:
+            return backlog_retry_after(
+                self.bulk.rate_samples, self.bulk.backlog(), floor=floor,
+                slo_class=slo_class,
+            )
         backlog = sum(
             v.queue_depth + v.active_slots + v.outstanding
             for v in self.fleet.views() if v.live
         )
         return backlog_retry_after(
-            self.server._rate_samples, backlog, floor=floor
+            self.server._rate_samples, backlog, floor=floor,
+            slo_class=slo_class,
         )
 
     # -- GET ----------------------------------------------------------------
@@ -657,6 +678,8 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             self._adapters_get()
         elif path in ("/profile", "/v1/profile"):
             self._profile(query)
+        elif path.startswith("/v1/bulk/jobs") or path.startswith("/bulk/jobs"):
+            self._bulk_get(path, query)
         else:
             self._send_json(404, {"error": {"message": f"no route {self.path}"}})
 
@@ -845,6 +868,13 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
     def do_POST(self):
         self._rid = None  # fresh id per request on keep-alive connections
         self._adapter_pin = None  # set per-request by _admit_and_route
+        bulk_path = self.path.partition("?")[0].rstrip("/")
+        if (bulk_path.startswith("/v1/bulk/jobs")
+                or bulk_path.startswith("/bulk/jobs")):
+            # Bulk routes parse their own body (a submit may be a JSONL
+            # prompt upload, which the JSON-object gate below would 400).
+            self._bulk_post(bulk_path)
+            return
         try:
             length = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(length) or b"{}"
@@ -923,6 +953,188 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
             owner=owner,
         )
         self._send_json(status, answer)
+
+    # -- bulk lane (ISSUE 19) ------------------------------------------------
+
+    def _bulk_label(self) -> str:
+        """Credential-safe tenant label — the only identity the bulk lane
+        ever persists (job files, journal rows, usage rows). Raw bearers
+        stay in admission state, exactly the ISSUE 15 discipline."""
+        return tenant_label(
+            self._tenant(),
+            self.admission.per_tenant if self.admission is not None else ())
+
+    @staticmethod
+    def _bulk_parts(path: str) -> list[str]:
+        parts = [p for p in path.split("/") if p]
+        if parts and parts[0] == "v1":
+            parts = parts[1:]
+        return parts
+
+    def _bulk_get(self, path: str, query: str) -> None:
+        if self.bulk is None:
+            self._send_json(404, {"error": {"message":
+                "bulk lane not configured (set bulk.dir)"}})
+            return
+        parts = self._bulk_parts(path)
+        if parts == ["bulk", "jobs"]:
+            jobs = self.bulk.jobs()
+            self._send_json(200, {"count": len(jobs), "jobs": jobs})
+        elif len(parts) == 3 and parts[:2] == ["bulk", "jobs"]:
+            st = self.bulk.status(parts[2])
+            if st is None:
+                self._send_json(404, {"error": {"message":
+                    f"no bulk job {parts[2]!r}"}})
+            else:
+                self._send_json(200, st)
+        elif (len(parts) == 4 and parts[:2] == ["bulk", "jobs"]
+                and parts[3] == "results"):
+            self._bulk_results(parts[2], query)
+        else:
+            self._send_json(404, {"error": {"message":
+                f"no route {self.path}"}})
+
+    def _bulk_results(self, job_id: str, query: str) -> None:
+        """Ordered results JSONL. Range-resumable: ``Range: bytes=N-``
+        (or ``?offset=N``) answers 206 with the suffix — a client that
+        died mid-download (or is polling a running job) resumes from its
+        last byte, and the contiguous-prefix flush guarantees every byte
+        it already holds is final."""
+        if self.bulk.status(job_id) is None:
+            self._send_json(404, {"error": {"message":
+                f"no bulk job {job_id!r}"}})
+            return
+        try:
+            with open(self.bulk.results_path(job_id), "rb") as f:
+                data = f.read()
+        except OSError:
+            data = b""
+        start = 0
+        m = re.match(r"^bytes=(\d+)-$", self.headers.get("Range") or "")
+        if m:
+            start = int(m.group(1))
+        else:
+            m = re.search(r"(?:^|&)offset=(\d+)", query or "")
+            if m:
+                start = int(m.group(1))
+        start = min(start, len(data))
+        body = data[start:]
+        self.send_response(206 if start else 200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("X-Request-Id", self._request_id())
+        self.send_header("Accept-Ranges", "bytes")
+        if start:
+            self.send_header(
+                "Content-Range",
+                f"bytes {start}-{max(start, len(data) - 1)}/{len(data)}")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _bulk_post(self, path: str) -> None:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(length) if length > 0 else b""
+        if self.bulk is None:
+            self._send_json(404, {"error": {"message":
+                "bulk lane not configured (set bulk.dir)"}})
+            return
+        parts = self._bulk_parts(path)
+        if parts == ["bulk", "jobs"]:
+            self._bulk_submit(raw)
+        elif (len(parts) == 4 and parts[:2] == ["bulk", "jobs"]
+                and parts[3] == "cancel"):
+            if self.bulk.cancel(parts[2]):
+                self._send_json(200, {"id": parts[2],
+                                      "cancel_requested": True})
+            else:
+                self._send_json(404, {"error": {"message":
+                    f"no bulk job {parts[2]!r}"}})
+        else:
+            self._send_json(404, {"error": {"message":
+                f"no route {self.path}"}})
+
+    def _bulk_submit(self, raw: bytes) -> None:
+        """POST /v1/bulk/jobs: inline JSON (``{"prompts": [...], adapter,
+        max_new, sampling}``) or an uploaded JSONL body (one
+        ``{"prompt": ...}`` — or bare string — per line; params via
+        ``?adapter=&max_new=`` query). Quota-gated per tenant with typed
+        429s; the accepted job is durable before this returns 200."""
+        query = self.path.partition("?")[2]
+        try:
+            prompts, params = self._bulk_parse_submit(raw, query)
+        except ValueError as e:
+            self.close_connection = True
+            self._send_json(400, {"error": {"message": f"bad request: {e}"}})
+            return
+        label = self._bulk_label()
+        if self.admission is not None:
+            decision = self.admission.acquire_bulk(label, len(prompts))
+            if not decision.ok:
+                self.gw.class_counter("429", "best_effort").inc()
+                self._send_json(
+                    429,
+                    {"error": {"message": decision.reason,
+                               "type": "bulk_quota_exceeded"}},
+                    retry_after=max(
+                        1, int(decision.retry_after_s + 0.999),
+                        self._fleet_retry_after(slo_class="best_effort")),
+                )
+                return
+        try:
+            st = self.bulk.submit(label, prompts, params)
+        except ValueError as e:
+            if self.admission is not None:
+                self.admission.release_bulk(label, len(prompts))
+            self._send_json(400, {"error": {"message": f"bad request: {e}"}})
+            return
+        self._send_json(200, st)
+
+    @staticmethod
+    def _bulk_parse_submit(raw: bytes, query: str) -> tuple[list, dict]:
+        text = (raw or b"").decode("utf-8", "replace").strip()
+        if not text:
+            raise ValueError("empty bulk submit body")
+        params: dict = {}
+        if text.startswith("{"):
+            try:
+                payload = json.loads(text)
+                if not isinstance(payload, dict):
+                    raise ValueError
+            except ValueError:
+                payload = None
+            if payload is not None and "prompts" in payload:
+                prompts = payload.get("prompts")
+                if not isinstance(prompts, list):
+                    raise ValueError("prompts must be a list")
+                for k in ("adapter", "max_new", "sampling"):
+                    if k in payload:
+                        params[k] = payload[k]
+                return prompts, params
+        # JSONL upload: one prompt per line ({"prompt": ...} or a bare
+        # JSON string); per-job params ride the query string.
+        prompts = []
+        for n, line in enumerate(text.splitlines(), start=1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"bad JSONL at line {n}: {e}") from None
+            if isinstance(rec, str):
+                prompts.append(rec)
+            elif isinstance(rec, dict) and isinstance(
+                    rec.get("prompt"), str):
+                prompts.append(rec["prompt"])
+            else:
+                raise ValueError(
+                    f"JSONL line {n} must be a string or hold a "
+                    "string 'prompt'")
+        for m in re.finditer(r"(?:^|&)(adapter|max_new)=([^&]*)",
+                             query or ""):
+            k, v = m.group(1), m.group(2)
+            params[k] = int(v) if k == "max_new" else v
+        return prompts, params
 
     def _admit_and_route(self, path: str, payload: dict, raw: bytes,
                          span=None) -> None:
@@ -1291,7 +1503,8 @@ class _GatewayHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
                 429,
                 {"error": {"message": "fleet saturated; retry later",
                            "type": "rate_limit_error"}},
-                retry_after=self._fleet_retry_after(floor=busy_hint),
+                retry_after=self._fleet_retry_after(
+                    floor=busy_hint, slo_class=eff_class or ""),
             )
             return "429"
         else:
@@ -1845,6 +2058,7 @@ def make_gateway(
     kvtier=None,
     journal=None,
     usage=None,
+    bulk=None,
 ):
     """Build (not start) the gateway server over ``fleet`` — tests drive it
     on a thread, ``main`` drives it with ``serve_forever``. ``router``
@@ -1867,7 +2081,13 @@ def make_gateway(
     decisions. ``usage`` (telemetry/usage.UsageLedger) arms the
     gateway-edge usage ledger: one row per admission-controlled request
     with the tenant digest, class, and terminal outcome (ISSUE 15) —
-    unarmed by default. ``config.data_plane`` picks the transport
+    unarmed by default. ``bulk`` (gateway.bulk.BulkJobManager) arms the
+    /v1/bulk/jobs endpoints (ISSUE 19): make_gateway binds the manager's
+    dispatch path to this gateway's relay (pinned ``best_effort``, stable
+    per-item request ids so retries ride the idempotent-safe relay) plus
+    an idle-fleet probe for the backlog-stall detector, and calls
+    ``start()`` so incomplete jobs resume before the first request
+    lands. ``config.data_plane`` picks the transport
     (ISSUE 17): the selectors event loop (gateway/evloop.py, the
     default) or the legacy thread-per-connection ``GatewayHTTPServer`` —
     both expose the same serve_forever/shutdown/server_close/
@@ -1884,15 +2104,28 @@ def make_gateway(
     )
     if router is None:
         router = make_policy(config.router)
+    # Bulk quotas (ISSUE 19) live in the SAME admission object as the
+    # interactive budgets — one fairness layer, one per-tenant state map,
+    # one snapshot at /stats. A bulk-armed gateway therefore always has
+    # admission, even when the config sets no interactive limits.
+    bulk_cfg = bulk.config if bulk is not None else None
     if admission is None and (
         config.tenant_rate > 0 or config.tenant_max_concurrent > 0
-        or config.tenant_slo_class
+        or config.tenant_slo_class or bulk_cfg is not None
     ):
         admission = TenantAdmission(
             rate=config.tenant_rate, burst=config.tenant_burst,
             max_concurrent=config.tenant_max_concurrent,
             slo_class=config.tenant_slo_class,
+            bulk_max_jobs=(bulk_cfg.max_jobs_per_tenant
+                           if bulk_cfg is not None else 0),
+            bulk_max_queued_items=(bulk_cfg.max_queued_items_per_tenant
+                                   if bulk_cfg is not None else 0),
         )
+    if bulk is not None and bulk.admission is None:
+        # The manager releases a job's quota footprint at terminal state
+        # and re-registers resumed jobs — it needs the live object.
+        bulk.admission = admission
     gw_metrics = metrics if metrics is not None else GatewayMetrics()
     if slo is None:
         kw = telemetry.gateway_slo_kwargs() if telemetry is not None else {}
@@ -1928,6 +2161,7 @@ def make_gateway(
             "journal": journal,
             "usage": usage,
             "publisher": publisher,
+            "bulk": bulk,
         },
     )
     address = (host if host is not None else config.host,
@@ -1963,8 +2197,88 @@ def make_gateway(
                 registry=gw_metrics.registry,
             )
             server.profiler.start()
-        return server
-    return GatewayHTTPServer(address, handler)
+    else:
+        server = GatewayHTTPServer(address, handler)
+    if bulk is not None:
+        _bind_bulk(bulk, server, handler, fleet)
+    return server
+
+
+def _bind_bulk(bulk, server, handler_cls, fleet) -> None:
+    """Wire a BulkJobManager (ISSUE 19) to THIS gateway: its dispatch
+    path becomes a pseudo-handler run of ``_route_and_relay`` — the
+    evloop offload idiom, so bulk items traverse the IDENTICAL routing/
+    retry/hedging/KV-handoff/usage machinery a socket request would,
+    pinned ``best_effort`` with a stable per-item request id (replica-
+    death retries ride the idempotent-safe relay). Also binds the
+    idle-fleet probe the backlog-stall detector needs, then starts the
+    manager (resuming any incomplete journaled jobs)."""
+    import io
+
+    def dispatch(item: dict) -> dict:
+        h = handler_cls.__new__(handler_cls)
+        h.server = server
+        h.client_address = ("bulk", 0)
+        h.connection = None
+        h.request = None
+        h.rfile = io.BytesIO(b"")
+        h.wfile = io.BytesIO()
+        h.close_connection = True
+        h.requestline = "POST /v1/completions HTTP/1.1"
+        h.request_version = "HTTP/1.1"
+        h.command = "POST"
+        h.path = "/v1/completions"
+        h.headers = {}
+        # Stable id: the SAME item re-dispatched (outer retry, or resume
+        # after a kill) carries the same X-Request-Id — the join key
+        # across gateway spans, replica logs, and the bulk journal.
+        h._rid = str(item.get("rid") or "")
+        h._adapter_pin = item.get("adapter") or None
+        payload = {
+            "prompt": item.get("prompt") or "",
+            "max_tokens": int(item.get("max_new") or 0),
+            "stream": False,
+            **dict(item.get("sampling") or {}),
+        }
+        if not payload["max_tokens"]:
+            del payload["max_tokens"]
+        raw = json.dumps(payload).encode()
+        try:
+            outcome = h._route_and_relay(
+                "/v1/completions", payload, raw, record=True,
+                slo_class="best_effort",
+                tenant=item.get("tenant") or "anonymous",
+            )
+        except Exception:  # noqa: BLE001 - a relay bug reads as transient
+            logger.exception("bulk: pseudo-handler relay failed")
+            return {"outcome": "error"}
+        resp = h.wfile.getvalue()
+        head, _, body = resp.partition(b"\r\n\r\n")
+        out: dict = {"outcome": str(outcome), "text": "",
+                     "completion_tokens": 0}
+        if outcome == "200":
+            try:
+                ans = json.loads(body)
+                choice = (ans.get("choices") or [{}])[0]
+                out["text"] = str(choice.get("text") or "")
+                out["completion_tokens"] = int(
+                    (ans.get("usage") or {}).get("completion_tokens") or 0)
+            except (ValueError, AttributeError, IndexError, TypeError):
+                out["outcome"] = "error"
+        elif outcome == "429":
+            m = re.search(rb"(?im)^Retry-After:\s*(\d+)", head)
+            if m:
+                out["retry_after_s"] = float(m.group(1))
+        return out
+
+    def idle_fn() -> bool:
+        views = [v for v in fleet.views() if v.live]
+        return bool(views) and all(
+            v.active_slots == 0 and v.queue_depth == 0
+            and v.outstanding == 0 for v in views)
+
+    bulk.bind(dispatch, idle_fn=idle_fn)
+    bulk.start()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -2040,13 +2354,14 @@ def main(argv: list[str] | None = None) -> int:
         Config(),
         [o for o in args.overrides
          if o.startswith(("gateway.", "telemetry.", "autoscale.",
-                          "kvtier.", "usage."))],
+                          "kvtier.", "usage.", "bulk."))],
     )
     config = full_config.gateway
     telemetry_cfg = full_config.telemetry
     autoscale_cfg = full_config.autoscale
     kvtier_cfg = full_config.kvtier
     usage_cfg = full_config.usage
+    bulk_cfg = full_config.bulk
 
     from ditl_tpu.gateway.roles import parse_roles, role_knobs
 
@@ -2203,6 +2518,21 @@ def main(argv: list[str] | None = None) -> int:
             source="gateway",
             max_bytes=telemetry_cfg.journal_max_bytes(),
         )
+    bulk_manager = None
+    if bulk_cfg.dir:
+        # Offline bulk lane (ISSUE 19): the manager is built here (durable
+        # state + journal) and wired to the relay inside make_gateway,
+        # which also resumes any jobs a previous incarnation left
+        # incomplete.
+        from ditl_tpu.gateway.bulk import BulkJobManager
+
+        bulk_manager = BulkJobManager(
+            bulk_cfg.dir, bulk_cfg,
+            registry=gw_metrics.registry,
+            flight=flight, plane=plane, usage=usage_ledger,
+            source="gateway",
+            max_bytes=telemetry_cfg.journal_max_bytes(),
+        )
     supervisor = None
     server = None
     # One finally covers startup too: a replica that never turns healthy
@@ -2234,7 +2564,7 @@ def main(argv: list[str] | None = None) -> int:
             actuator = Actuator(
                 fleet, supervisor, autoscale_cfg,
                 journal=journal, tracer=tracer, metrics=gw_metrics,
-                flight=flight, plane=plane, slo=slo,
+                flight=flight, plane=plane, slo=slo, bulk=bulk_manager,
             )
             supervisor.autoscaler = actuator
         supervisor.start()
@@ -2244,7 +2574,8 @@ def main(argv: list[str] | None = None) -> int:
                               actuator=actuator, recorder=recorder,
                               kvtier=kvtier_cfg if kvtier_cfg.handoff
                               else None,
-                              journal=journal, usage=usage_ledger)
+                              journal=journal, usage=usage_ledger,
+                              bulk=bulk_manager)
         stopping = threading.Event()
 
         def _shutdown(signum, frame):
@@ -2271,6 +2602,10 @@ def main(argv: list[str] | None = None) -> int:
         if server is not None:
             server.server_close()
         fleet.stop_all(drain=True, timeout=config.drain_timeout_s)
+        if bulk_manager is not None:
+            # In-flight items are abandoned WITHOUT terminal rows; jobs
+            # stay "running" on disk — the next gateway resumes them.
+            bulk_manager.close()
         if recorder is not None:
             recorder.close()
         if usage_ledger is not None:

@@ -106,6 +106,23 @@ _ROWS: tuple = (
     ("ditl_adapter_rows", "gauge", "", "stacked pool rows the registry manages (excluding base row 0)", True),
     ("ditl_adapter_rows_live", "gauge", "", "stacked pool rows currently serving a named adapter", True),
     ("ditl_adapter_swap_seconds", "histogram", "", "hot load/publish swap latency (verify -> install -> row live)", True),
+    # Bulk lane (ISSUE 19): families live on a bulk-armed gateway only
+    # (gateway/bulk.py registers them on the gateway registry when
+    # bulk.dir is set) — optional on every other surface.
+    ("ditl_bulk_backlog_items", "gauge", "", "bulk work items not yet terminal across non-terminal jobs (the autoscale planner's scale-up signal)", True),
+    ("ditl_bulk_completion_tokens_total", "counter", "", "completion tokens generated by the bulk lane", True),
+    ("ditl_bulk_items_completed_total", "counter", "", "bulk work items that reached a terminal journal row", True),
+    ("ditl_bulk_items_dispatched_total", "counter", "", "bulk work items dispatched through the relay path (attempts, so retries count again)", True),
+    ("ditl_bulk_items_failed_total", "counter", "", "bulk work items terminally failed after exhausting retries", True),
+    ("ditl_bulk_items_preempted_total", "counter", "", "bulk dispatch attempts bounced by fleet saturation (429) - the lane yielding to interactive load, working as designed", True),
+    ("ditl_bulk_items_retried_total", "counter", "", "bulk dispatch attempts retried after a transient outcome", True),
+    ("ditl_bulk_jobs_active", "gauge", "", "bulk jobs currently queued or running", True),
+    ("ditl_bulk_jobs_cancelled_total", "counter", "", "bulk jobs cancelled by a client", True),
+    ("ditl_bulk_jobs_completed_total", "counter", "", "bulk jobs that ran to completion", True),
+    ("ditl_bulk_jobs_failed_total", "counter", "", "bulk jobs terminal with at least one permanently failed item", True),
+    ("ditl_bulk_jobs_resumed_total", "counter", "", "incomplete bulk jobs resumed from the journal after a gateway restart", True),
+    ("ditl_bulk_jobs_submitted_total", "counter", "", "bulk jobs accepted at submit", True),
+    ("ditl_bulk_tokens_per_s", "gauge", "", "recent bulk-lane completion tokens/sec (windowed; 0 when the lane is idle)", True),
     ("ditl_client_deadline_exhausted_total", "counter", "", "remote-LLM calls aborted by the total_timeout_s wall-clock bound", True),
     ("ditl_client_requests_total", "counter", "", "remote-LLM logical calls started", True),
     ("ditl_client_retries_total", "counter", "", "remote-LLM HTTP attempts retried (429/5xx/connection errors)", True),

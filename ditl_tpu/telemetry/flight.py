@@ -43,6 +43,7 @@ from ditl_tpu.annotations import hot_path
 
 __all__ = [
     "ACTION_RING",
+    "BULK_RING",
     "FLIGHT_SCHEMA",
     "LIVENESS_RING",
     "ROUTING_RING",
@@ -65,6 +66,11 @@ LIVENESS_RING = "pod_liveness"
 # each carrying the triggering signal snapshot — the black-box record that
 # makes a bad remediation as diagnosable as the failure it chased.
 ACTION_RING = "supervisor_action"
+# Bulk-lane dispatch decisions (ISSUE 19): one row per work-item dispatch
+# attempt (job, idx, attempt, outcome, tenant) — the ROUTING-ring
+# discipline applied to the offline lane, so an incident bundle shows
+# exactly which items the lane pushed and what the fleet answered.
+BULK_RING = "bulk_dispatch"
 
 DEFAULT_CAPACITY = 512
 

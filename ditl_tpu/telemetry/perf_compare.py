@@ -123,6 +123,15 @@ COMPARE_KEYS = {
     # sampler + loop-lag watchdog are only "always-on" while they cost
     # within the same-box noise floor of running dark.
     "prof_vs_off_rps_ratio": +1,
+    # Bulk-lane goodput keys (ISSUE 19, bench --serve-bulk-backlog rows'
+    # hoisted `bulk` block): the lane's tokens/sec regresses when it
+    # falls — spare decode capacity the offline backlog stopped soaking
+    # is throughput thrown away; and the interactive TTFT p95 measured
+    # WITH the backlog running regresses when it rises — the lane's
+    # whole contract is zero interactive SLO burn, so bulk-induced
+    # interference is a regression of the lane, not of the fleet.
+    "bulk_tokens_per_s": +1,
+    "bulk_interactive_ttft_p95_s": -1,
 }
 
 # Per-key noise floors: gated keys whose honest run-to-run spread on a
@@ -155,7 +164,7 @@ def _flat(rec: dict) -> dict:
     out = rec
     for block in ("roofline", "serving", "autoscale", "kv_handoff",
                   "gateway_overhead", "usage_metering", "adapters",
-                  "profiler_overhead"):
+                  "profiler_overhead", "bulk"):
         nested = rec.get(block)
         if isinstance(nested, dict):
             out = {**nested, **out}

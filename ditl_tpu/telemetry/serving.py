@@ -97,6 +97,7 @@ def backlog_retry_after(
     now: float | None = None,
     max_age_s: float = 60.0,
     clamp_s: int = 30,
+    slo_class: str = "",
 ) -> int:
     """Backlog-aware ``Retry-After``: seconds until ``backlog`` requests
     clear at the recently measured service rate, clamped to
@@ -109,8 +110,19 @@ def backlog_retry_after(
     backlog-proportional, so client herds honoring Retry-After
     (client/llm.py) space out instead of synchronizing. Shared by
     ``infer/server.py`` (per-replica 429s) and ``gateway/gateway.py``
-    (fleet-level 429s); jax-free like everything in telemetry/."""
+    (fleet-level 429s); jax-free like everything in telemetry/.
+
+    ``slo_class`` is the ISSUE 19 class hint: for ``best_effort`` the
+    clamp relaxes 4x and the floor's urgency is dropped. The interactive
+    clamp exists so a latency-sensitive client retries soon after a
+    transient spike — but a bulk submitter bounced off a deep offline
+    backlog should come back when the backlog has actually moved, not
+    hammer the fleet every ``clamp_s`` seconds. The estimate itself is
+    unchanged: callers pass bulk-lane samples/backlog for bulk 429s."""
     now = time.time() if now is None else now
+    if slo_class == "best_effort":
+        clamp_s = clamp_s * 4
+        floor = 1
     # Callers pass a LIVE deque that other handler threads append to
     # mid-overload (exactly when 429s fire); tuple() snapshots it in one
     # C-level pass, where iterating directly would raise "deque mutated

@@ -1,0 +1,147 @@
+"""Subprocess driver for the bulk-lane SIGKILL resume drill (ISSUE 19).
+
+One process = one gateway incarnation: a 2-replica stub fleet (no jax),
+a real gateway with the bulk lane armed on a shared state directory, and
+a per-incarnation usage ledger. Phase 1 arms chaos
+``bulk.dispatch:kill@call=K,max=1`` with persisted fire counts and dies
+by SIGKILL mid-job; phase 2 reruns the SAME command line against the
+same state directory — the persisted fire count keeps the kill from
+re-firing, the manager resumes the journaled job, and a JSON summary
+line is printed for the test to assert on.
+
+Usage: python tests/bulk_drill.py STATE_DIR N_ITEMS KILL_AT
+
+KILL_AT is the 1-based chaos site consultation (= dispatch attempt) that
+dies; 0 runs without chaos.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from ditl_tpu.chaos import arm_chaos  # noqa: E402
+from ditl_tpu.config import BulkConfig, ChaosConfig, GatewayConfig  # noqa: E402
+from ditl_tpu.gateway import (  # noqa: E402
+    Fleet,
+    GatewayMetrics,
+    InProcessReplica,
+    make_gateway,
+)
+from ditl_tpu.gateway.bulk import BulkJobManager, load_jobs  # noqa: E402
+from ditl_tpu.telemetry.usage import UsageLedger  # noqa: E402
+
+WINDOW = 4  # max_in_flight: the drill's re-dispatch bound
+
+
+class _StubServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    label = "stub"
+
+    def close(self, drain=True, timeout=30.0):
+        self.shutdown()
+        self.server_close()
+
+    def kill(self):
+        self.close()
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, status, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        self._json(200, {"status": "ok", "model": "stub", "draining": False,
+                         "queue_depth": 0, "active_slots": 0, "n_slots": 2})
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", 0) or 0))
+        self._json(200, {
+            "object": "text_completion",
+            "choices": [{"index": 0, "text": self.server.label,
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                      "total_tokens": 2},
+        })
+
+
+def _replica(rid):
+    def factory():
+        server = _StubServer(("127.0.0.1", 0), _StubHandler)
+        server.label = rid
+        return server
+
+    return InProcessReplica(rid, factory)
+
+
+def main() -> int:
+    state_dir, n_items, kill_at = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
+    bulk_dir = os.path.join(state_dir, "bulk")
+    os.makedirs(bulk_dir, exist_ok=True)
+    if kill_at > 0:
+        # Persisted fire counts (chaos-state-0.json under journal_dir):
+        # phase 2 arms the SAME rule but max=1 has already fired.
+        arm_chaos(ChaosConfig(
+            rules=f"bulk.dispatch:kill@call={kill_at},max=1",
+            journal_dir=os.path.join(state_dir, "chaos")))
+    # Pre-existing non-terminal jobs => this is the resume incarnation.
+    resumable = [r for r in load_jobs(bulk_dir)
+                 if r.get("state") in ("queued", "running")]
+    run_n = len(glob.glob(os.path.join(state_dir, "usage-r*.jsonl")))
+    ledger = UsageLedger(os.path.join(state_dir, f"usage-r{run_n}.jsonl"),
+                         source=f"drill-{run_n}")
+    manager = BulkJobManager(
+        bulk_dir, BulkConfig(dir=bulk_dir, max_in_flight=WINDOW),
+        usage=ledger)
+    fleet = Fleet([_replica("r0"), _replica("r1")])
+    fleet.start_all()
+    for rid in fleet.ids:
+        assert fleet.probe(rid, timeout=5.0)
+    server = make_gateway(fleet, config=GatewayConfig(),
+                          metrics=GatewayMetrics(), port=0, bulk=manager)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    port = server.server_address[1]
+    if not resumable:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/bulk/jobs",
+            data=json.dumps({
+                "prompts": [f"bulk item {i}" for i in range(n_items)],
+                "max_new": 4,
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            json.loads(resp.read())
+    drained = manager.drain(timeout_s=120.0)
+    print(json.dumps({
+        "drained": drained,
+        "resumed": len(resumable),
+        "jobs": manager.jobs(),
+    }))
+    manager.close()
+    ledger.close()
+    server.shutdown()
+    server.server_close()
+    fleet.stop_all(drain=False)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
