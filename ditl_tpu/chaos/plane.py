@@ -146,6 +146,13 @@ SITES = {
                      "re-dispatch at most the in-flight window; error = a "
                      "transport fault riding the item's ordinary retry "
                      "path; the call= trigger picks which item dies)",
+    "gateway.crash": "gateway/replica.py: the supervisor loop, once per "
+                     "supervision pass (kill = SIGKILL the GATEWAY process "
+                     "itself — the crash-recovery drill: the crash row is "
+                     "journaled line-buffered before the kill lands, and a "
+                     "--recover relaunch must adopt every still-alive "
+                     "replica instead of restarting it; the call= trigger "
+                     "picks which pass dies)",
 }
 
 

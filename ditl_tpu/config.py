@@ -557,6 +557,18 @@ class GatewayConfig:
     # _drops) instead of growing without bound. 0 = unlimited (the
     # process fd limit is then the only cap).
     evloop_max_connections: int = 0
+    # Crash recovery (gateway/recovery.py, ISSUE 20). A recovering
+    # gateway reclaims its predecessor's port while kernel TIME_WAIT
+    # entries from severed connections linger: bind EADDRINUSE is
+    # retried up to recovery_bind_retries times, recovery_bind_wait_s
+    # apart (0 retries = fail fast, the pre-recovery behavior).
+    recovery_bind_retries: int = 5
+    recovery_bind_wait_s: float = 0.5
+    # How long the --recover path waits for an adopted replica's /health
+    # cross-check before giving up on adoption and relaunching it on a
+    # fresh port (pid liveness alone never adopts — a recycled pid or a
+    # rebound port must not alias).
+    recovery_adopt_timeout_s: float = 5.0
 
     def __post_init__(self):
         if self.data_plane not in ("threaded", "evloop"):
@@ -578,6 +590,21 @@ class GatewayConfig:
             raise ValueError(
                 f"gateway.evloop_max_connections must be >= 0, got "
                 f"{self.evloop_max_connections}"
+            )
+        if self.recovery_bind_retries < 0:
+            raise ValueError(
+                f"gateway.recovery_bind_retries must be >= 0, got "
+                f"{self.recovery_bind_retries}"
+            )
+        if self.recovery_bind_wait_s <= 0:
+            raise ValueError(
+                f"gateway.recovery_bind_wait_s must be > 0, got "
+                f"{self.recovery_bind_wait_s}"
+            )
+        if self.recovery_adopt_timeout_s <= 0:
+            raise ValueError(
+                f"gateway.recovery_adopt_timeout_s must be > 0, got "
+                f"{self.recovery_adopt_timeout_s}"
             )
         if self.router not in ("round_robin", "least_outstanding",
                                "affinity"):

@@ -25,6 +25,14 @@ from ditl_tpu.gateway.autoscale import (
 )
 from ditl_tpu.gateway.gateway import GatewayMetrics, make_gateway
 from ditl_tpu.gateway.pool import ConnectionPool
+from ditl_tpu.gateway.recovery import (
+    FleetManifest,
+    load_manifest,
+    manifest_path,
+    reconcile_adapters,
+    recover_fleet,
+    replay_action_tail,
+)
 from ditl_tpu.gateway.replica import (
     Fleet,
     FleetSupervisor,
@@ -58,6 +66,7 @@ __all__ = [
     "CacheAffinityPolicy",
     "ConnectionPool",
     "Fleet",
+    "FleetManifest",
     "FleetSignals",
     "FleetSupervisor",
     "GatewayMetrics",
@@ -74,11 +83,16 @@ __all__ = [
     "TrafficRecorder",
     "affinity_key",
     "gateway_journal_path",
+    "load_manifest",
     "load_trace",
     "make_gateway",
     "make_policy",
+    "manifest_path",
     "parse_roles",
     "prompt_token_estimate",
+    "reconcile_adapters",
+    "recover_fleet",
+    "replay_action_tail",
     "role_candidates",
     "role_knobs",
     "sanitize_label",

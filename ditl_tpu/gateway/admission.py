@@ -86,6 +86,29 @@ class TokenBucket:
                 return 0.0
             return (n - self._tokens) / self.rate
 
+    def level(self) -> float:
+        """Current token level, refilled to now — what an admission
+        snapshot persists (ISSUE 20). Read-only: takes nothing."""
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t_last) * self.rate
+            )
+            self._t_last = now
+            return self._tokens
+
+    def restore(self, tokens: float, age_s: float = 0.0) -> None:
+        """Re-warm the bucket from a persisted level (ISSUE 20 restart
+        amnesty fix). ``age_s`` is how long ago the level was snapshotted
+        on the WALL clock — monotonic clocks do not survive a process
+        restart, so the refill earned while the gateway was down is
+        credited explicitly, then clamped to burst as usual."""
+        with self._lock:
+            self._tokens = max(0.0, min(
+                self.burst, float(tokens) + max(0.0, age_s) * self.rate
+            ))
+            self._t_last = time.monotonic()
+
 
 # Mirror of infer/continuous.SLO_CLASSES — duplicated (not imported) so the
 # gateway package stays provably jax-free on import; pinned equal by test.
@@ -179,6 +202,70 @@ class TenantAdmission:
         self._tenants: collections.OrderedDict[str, _TenantState] = \
             collections.OrderedDict()
         self._lock = threading.Lock()
+        # Restart re-warm state (ISSUE 20): label -> persisted bucket
+        # level from the previous incarnation's manifest. None = not a
+        # recovery (fresh buckets start full, no amnesty accounting).
+        # Keyed on tenant_label digests, NEVER raw bearers — the manifest
+        # is a world-readable file.
+        self._rewarm: dict[str, dict] | None = None
+        self._on_amnesty = None
+
+    def rewarm(self, levels: dict | None, on_amnesty=None) -> None:
+        """Arm restart re-warming (ISSUE 20): tenants seen after this
+        call get their token bucket restored from ``levels`` (a
+        :meth:`bucket_snapshot` read back from the manifest, keyed on
+        tenant labels) instead of restarting full. A recovering tenant
+        with NO persisted level falls back to a full bucket — the old
+        amnesty behavior — but now counted via ``on_amnesty`` (the
+        ``ditl_gateway_admission_amnesty_total`` hook), so silent
+        rate-limit resets are visible. Pass ``levels=None``/empty on a
+        manifest without an admission section: every rate-limited
+        tenant then counts one amnesty."""
+        with self._lock:
+            self._rewarm = {
+                str(label): rec for label, rec in (levels or {}).items()
+                if isinstance(rec, dict)
+            }
+            self._on_amnesty = on_amnesty
+
+    def _maybe_rewarm(self, tenant: str, st: _TenantState) -> None:
+        """Restore a just-created tenant's bucket level from the armed
+        re-warm map. Caller holds the lock. Tenants without a bucket
+        (rate unlimited) have no level to restore and never count
+        amnesty."""
+        if self._rewarm is None or st.bucket is None:
+            return
+        rec = self._rewarm.pop(tenant_label(tenant, self.per_tenant), None)
+        if rec is None:
+            if self._on_amnesty is not None:
+                try:
+                    self._on_amnesty()
+                except Exception:  # noqa: BLE001 - accounting only
+                    pass
+            return
+        try:
+            tokens = float(rec.get("tokens", st.bucket.burst))
+            age_s = max(0.0, time.time() - float(rec.get("ts", 0.0)))
+        except (TypeError, ValueError):
+            return
+        st.bucket.restore(tokens, age_s=age_s)
+
+    def bucket_snapshot(self) -> dict:
+        """Per-tenant token-bucket levels for the crash-recovery
+        manifest (ISSUE 20), keyed on :func:`tenant_label` — raw API
+        keys never leave this module. ``ts`` is the WALL clock (the only
+        clock that survives a restart); the restore side credits the
+        downtime refill from it."""
+        now = time.time()
+        with self._lock:
+            return {
+                tenant_label(t, self.per_tenant): {
+                    "tokens": round(st.bucket.level(), 6),
+                    "ts": now,
+                }
+                for t, st in self._tenants.items()
+                if st.bucket is not None
+            }
 
     def _state(self, tenant: str) -> _TenantState:
         st = self._tenants.get(tenant)
@@ -207,6 +294,10 @@ class TenantAdmission:
                 ),
             )
             self._tenants[tenant] = st
+            # Restart re-warm (ISSUE 20): first sight of a tenant after
+            # --recover restores its persisted bucket level (or counts
+            # an amnesty when none survived).
+            self._maybe_rewarm(tenant, st)
             # Tenants arrive as arbitrary unauthenticated bearer tokens:
             # without a cap, a client cycling random keys grows this map
             # (and the per-tenant metric families downstream) without

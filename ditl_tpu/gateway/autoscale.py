@@ -222,6 +222,21 @@ class ActionPlanner:
                 with self._lock:
                     self._deaths.pop(action.target, None)
 
+    def note_replayed(self, kind: str, target: str, ts: float) -> None:
+        """Journal-tail replay after a gateway crash (ISSUE 20): restamp
+        the cooldown a previous incarnation's executed action started,
+        WITHOUT re-executing anything. Only recency is rebuilt — streaks,
+        death windows and wake state are detection state that the new
+        incarnation re-observes live; a recovered planner that forgot
+        its cooldowns would immediately re-plan an action whose window
+        had not expired when the old gateway died. Stamps keep the max
+        (the tail may replay out of order across rotated segments)."""
+        if kind in ("scale_up", "scale_down"):
+            self._last_scale = max(self._last_scale, ts)
+        if kind in REMEDIATION_KINDS and target:
+            prior = self._remedy_last.get(target, float("-inf"))
+            self._remedy_last[target] = max(prior, ts)
+
     # -- planning -----------------------------------------------------------
 
     def _signal(self, name: str, signals: FleetSignals) -> None:

@@ -130,6 +130,34 @@ class GatewayMetrics:
             f"{PREFIX}_replica_deaths",
             "replica died->drain->relaunch cycles the supervisor ran "
             "(the anomaly plane's death-rate input, ISSUE 10)")
+        # Crash recovery (ISSUE 20): the --recover path's outcome
+        # accounting. adopted + relaunched partition the non-parked,
+        # non-quarantined roster of each recovery pass; a nonzero
+        # relaunched count on a drill that expected pure adoption is the
+        # stale-manifest signature (troubleshooting §38).
+        self.recovery_runs = r.counter(
+            f"{PREFIX}_recovery_runs",
+            "gateway crash-recovery passes run (--recover startups that "
+            "found a fleet manifest)")
+        self.recovery_adopted = r.counter(
+            f"{PREFIX}_recovery_adopted",
+            "still-alive replica processes adopted by a recovering "
+            "gateway (pid liveness + /health cross-check both passed; "
+            "zero restarts paid)")
+        self.recovery_relaunched = r.counter(
+            f"{PREFIX}_recovery_relaunched",
+            "manifest replicas a recovering gateway had to relaunch "
+            "fresh (dead pid, recycled pid, or no /health answer on the "
+            "recorded port)")
+        # Restart amnesty accounting (ISSUE 20 satellite): tenants whose
+        # token bucket restarted FULL because no persisted level covered
+        # them — the pre-recovery behavior, now visible instead of a
+        # silent rate-limit reset on every gateway bounce.
+        self.admission_amnesty = r.counter(
+            f"{PREFIX}_admission_amnesty",
+            "rate-limited tenants whose token bucket restarted full "
+            "after --recover because the manifest held no admission "
+            "snapshot for them")
         self.affinity_hits = r.counter(
             f"{PREFIX}_affinity_hits",
             "requests routed to the same replica as the previous request "
@@ -2059,6 +2087,7 @@ def make_gateway(
     journal=None,
     usage=None,
     bulk=None,
+    recover_manifest=None,
 ):
     """Build (not start) the gateway server over ``fleet`` — tests drive it
     on a thread, ``main`` drives it with ``serve_forever``. ``router``
@@ -2091,7 +2120,13 @@ def make_gateway(
     (ISSUE 17): the selectors event loop (gateway/evloop.py, the
     default) or the legacy thread-per-connection ``GatewayHTTPServer`` —
     both expose the same serve_forever/shutdown/server_close/
-    server_address surface, so callers never branch."""
+    server_address surface, so callers never branch.
+    ``recover_manifest`` (a dict from recovery.load_manifest, ISSUE 20)
+    marks this gateway a --recover incarnation: admission token buckets
+    re-warm from the manifest's persisted levels (amnesty counted when
+    absent) and adapter generations reconcile against each replica's
+    live GET /v1/adapters — both BEFORE the bulk manager resumes, so
+    resumed jobs meet re-warmed budgets."""
     config = config or GatewayConfig()
     # Upstream keep-alive pool caps (ISSUE 14): the fleet owns the pool
     # (health polls and fleet-mutation invalidation need it gateway or
@@ -2126,6 +2161,16 @@ def make_gateway(
         # The manager releases a job's quota footprint at terminal state
         # and re-registers resumed jobs — it needs the live object.
         bulk.admission = admission
+    if fleet.manifest is not None and admission is not None:
+        # Crash-recovery manifest (ISSUE 20): admission bucket levels
+        # ride every manifest record from here on (keyed on tenant
+        # labels inside admission.bucket_snapshot — raw bearers never
+        # reach the file). Re-record immediately: a crash between here
+        # and the next fleet mutation / 2s supervisor refresh must find
+        # an admission section (empty != absent), not the pre-wiring
+        # snapshot.
+        fleet.manifest.admission = admission
+        fleet.manifest.record()
     gw_metrics = metrics if metrics is not None else GatewayMetrics()
     if slo is None:
         kw = telemetry.gateway_slo_kwargs() if telemetry is not None else {}
@@ -2136,8 +2181,23 @@ def make_gateway(
     from ditl_tpu.gateway.publish import AdapterPublisher
     publisher = AdapterPublisher(
         fleet, journal=journal, registry=gw_metrics.registry,
-        timeout_s=config.request_timeout_s,
+        timeout_s=config.request_timeout_s, manifest=fleet.manifest,
     )
+    if recover_manifest is not None:
+        from ditl_tpu.gateway.recovery import reconcile_adapters
+
+        if admission is not None:
+            # Restart amnesty fix (ISSUE 20 satellite): armed before the
+            # bulk manager resumes below, so even the first tenants back
+            # (resumed bulk jobs re-registering quota) re-warm instead
+            # of silently restarting full.
+            admission.rewarm(
+                recover_manifest.get("admission") or {},
+                on_amnesty=gw_metrics.admission_amnesty.inc,
+            )
+        reconcile_adapters(fleet, recover_manifest, publisher,
+                           journal=journal,
+                           timeout_s=config.recovery_adopt_timeout_s)
     base = (_EvloopGatewayHandler if config.data_plane == "evloop"
             else _GatewayHandler)
     handler = type(
@@ -2171,8 +2231,10 @@ def make_gateway(
         # offload workers), same 4-method server surface
         # (serve_forever/shutdown/server_close/server_address).
         from ditl_tpu.gateway.evloop import EventLoopGateway
-        server = EventLoopGateway(address, handler, config=config,
-                                  metrics=gw_metrics)
+        server = _bind_with_retry(
+            lambda: EventLoopGateway(address, handler, config=config,
+                                     metrics=gw_metrics),
+            config)
         # Stall-attribution plane (ISSUE 18): when armed, the watchdog
         # converts heartbeat age into ditl_loop_lag_seconds and, on a
         # stall, burst-samples the loop thread into a convicting stack
@@ -2198,10 +2260,37 @@ def make_gateway(
             )
             server.profiler.start()
     else:
-        server = GatewayHTTPServer(address, handler)
+        server = _bind_with_retry(
+            lambda: GatewayHTTPServer(address, handler), config)
     if bulk is not None:
         _bind_bulk(bulk, server, handler, fleet)
     return server
+
+
+def _bind_with_retry(build, config):
+    """Construct a data-plane server, retrying a bounded number of
+    EADDRINUSE bind failures (ISSUE 20 fast-restart satellite): a
+    recovering gateway reclaims its predecessor's FIXED port while
+    kernel TIME_WAIT entries from severed connections linger. Both
+    planes set SO_REUSEADDR on their listeners (which clears ordinary
+    TIME_WAIT) and tear down cleanly on a failed construction, so
+    re-invoking ``build`` is always safe. Any other OSError — and
+    EADDRINUSE past the budget — propagates unchanged."""
+    import errno
+
+    attempts = max(0, int(config.recovery_bind_retries))
+    for remaining in range(attempts, -1, -1):
+        try:
+            return build()
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE or remaining == 0:
+                raise
+            logger.warning(
+                "gateway bind EADDRINUSE; retrying in %.1fs "
+                "(%d attempts left)",
+                config.recovery_bind_wait_s, remaining)
+            time.sleep(config.recovery_bind_wait_s)
+    raise AssertionError("unreachable")
 
 
 def _bind_bulk(bulk, server, handler_cls, fleet) -> None:
@@ -2344,6 +2433,17 @@ def main(argv: list[str] | None = None) -> int:
                         "tenant digest, class, prompt/max_new token "
                         "estimates) — the shape bench.py "
                         "--serve-trace-replay replays")
+    parser.add_argument("--recover", default="", metavar="DIR",
+                        help="crash recovery (ISSUE 20): adopt the fleet a "
+                        "SIGKILLed gateway left behind from DIR's "
+                        "gateway-manifest.json — still-alive replicas are "
+                        "adopted (zero restarts), parked/quarantined state "
+                        "is restored, planner cooldowns replay from the "
+                        "journal tail, admission buckets re-warm, adapter "
+                        "generations reconcile, and journaled bulk jobs "
+                        "resume. DIR doubles as gateway.journal_dir when "
+                        "that is unset. A missing manifest cold-starts "
+                        "with a warning")
     parser.add_argument("overrides", nargs="*",
                         help="config overrides like gateway.router=affinity "
                         "gateway.replicas=4 telemetry.slo_ttft_s=0.5 "
@@ -2443,10 +2543,14 @@ def main(argv: list[str] | None = None) -> int:
 
         return build_argv
 
+    # The recovery state directory doubles as the journal directory: the
+    # manifest, the action journal tail, and the crash/recovery events
+    # must all live where the NEXT incarnation's --recover will look.
+    journal_dir = config.journal_dir or args.recover
     journal = None
-    if config.journal_dir:
+    if journal_dir:
         journal = EventJournal(
-            gateway_journal_path(config.journal_dir), source="gateway",
+            gateway_journal_path(journal_dir), source="gateway",
             max_bytes=telemetry_cfg.journal_max_bytes(),
         )
     tracer = None
@@ -2464,6 +2568,23 @@ def main(argv: list[str] | None = None) -> int:
         for i in range(config.replicas)
     ]
     fleet = Fleet(handles)
+    # Crash-recovery manifest (ISSUE 20): armed whenever a journal
+    # directory exists — crash consistency costs one small atomic JSON
+    # write per fleet mutation. The PRIOR incarnation's manifest (if
+    # --recover) is loaded before this incarnation's first record can
+    # replace it.
+    prior_manifest = None
+    if journal_dir:
+        from ditl_tpu.gateway.recovery import FleetManifest, load_manifest
+        from ditl_tpu.gateway.recovery import manifest_path as _mpath
+
+        if args.recover:
+            prior_manifest = load_manifest(args.recover)
+            if prior_manifest is None:
+                logger.warning(
+                    "--recover %s: no fleet manifest found; cold-starting",
+                    args.recover)
+        fleet.manifest = FleetManifest(_mpath(journal_dir))
     # Gateway-side anomaly/incident plane (ISSUE 10): replica death-rate +
     # spill/relay-error storms + fleet SLO burn alerts, bundling the
     # routing flight ring, gateway metrics, and the journal tail. The
@@ -2488,7 +2609,7 @@ def main(argv: list[str] | None = None) -> int:
             _os.path.join(args.incident_dir, "gateway"),
             flight=flight,
             metrics_render=gw_metrics.registry.render,
-            journal_dir=config.journal_dir or args.trace_dir,
+            journal_dir=journal_dir or args.trace_dir,
             registry=gw_metrics.registry,
             source="gateway",
             **telemetry_cfg.incident_kwargs(),
@@ -2540,6 +2661,19 @@ def main(argv: list[str] | None = None) -> int:
     # other N-1 subprocess replicas must not be left orphaned holding
     # ports and devices.
     try:
+        if prior_manifest is not None:
+            # Adopt-or-relaunch BEFORE start_all: adopted replicas are
+            # already alive and parked/quarantined replicas are restored
+            # down-on-purpose, so start_all only launches what genuinely
+            # needs launching.
+            from ditl_tpu.gateway.recovery import recover_fleet
+
+            recover_fleet(
+                fleet, prior_manifest, journal=journal,
+                metrics=gw_metrics,
+                probe_timeout_s=config.recovery_adopt_timeout_s,
+            )
+            fleet.manifest.seed_adapters(prior_manifest.get("adapters"))
         logger.info("starting %d replica(s)...", config.replicas)
         fleet.start_all(wait_healthy_s=config.restart_timeout_s)
         supervisor = FleetSupervisor(
@@ -2567,6 +2701,15 @@ def main(argv: list[str] | None = None) -> int:
                 flight=flight, plane=plane, slo=slo, bulk=bulk_manager,
             )
             supervisor.autoscaler = actuator
+            if prior_manifest is not None and journal_dir:
+                # Cooldown replay (ISSUE 20): re-stamp the planner's
+                # scale/remediation recency from the action.executed
+                # tail so the recovered gateway does not immediately
+                # re-plan inside a window the old incarnation opened.
+                from ditl_tpu.gateway.recovery import replay_action_tail
+
+                replay_action_tail(journal_dir, actuator.planner,
+                                   journal=journal)
         supervisor.start()
         server = make_gateway(fleet, config=config, tracer=tracer,
                               telemetry=telemetry_cfg, metrics=gw_metrics,
@@ -2575,7 +2718,8 @@ def main(argv: list[str] | None = None) -> int:
                               kvtier=kvtier_cfg if kvtier_cfg.handoff
                               else None,
                               journal=journal, usage=usage_ledger,
-                              bulk=bulk_manager)
+                              bulk=bulk_manager,
+                              recover_manifest=prior_manifest)
         stopping = threading.Event()
 
         def _shutdown(signum, frame):

@@ -59,9 +59,14 @@ class AdapterPublisher:
     ``ditl_adapter_publish*`` families; ``journal`` an EventJournal."""
 
     def __init__(self, fleet, *, journal=None, registry=None,
-                 timeout_s: float = 60.0):
+                 timeout_s: float = 60.0, manifest=None):
         self.fleet = fleet
         self.journal = journal
+        # Optional crash-recovery FleetManifest (ISSUE 20): publications
+        # are recorded there (name -> checkpoint dir/owner) so a
+        # --recover incarnation can converge straggler replicas through
+        # this very re-publish path after adopting the fleet.
+        self.manifest = manifest
         self.timeout_s = float(timeout_s)
         # One publication at a time: two concurrent walks interleaving
         # their flips could leave replicas on different generations with
@@ -195,6 +200,16 @@ class AdapterPublisher:
                       failed=[f["replica"] for f in failed],
                       aborted=aborted)
         complete = not aborted and not failed and len(ok) == len(views)
+        if self.manifest is not None:
+            # Crash-recovery record (ISSUE 20): any walk that flipped at
+            # least one replica is worth remembering — the dir/owner here
+            # is exactly what recovery's reconcile pass needs to converge
+            # stragglers (and a PARTIAL walk is the case with stragglers
+            # to converge). A complete evict forgets the name.
+            if op in ("publish", "load") and ok:
+                self.manifest.note_adapter(name, directory, owner, step)
+            elif op == "evict" and complete:
+                self.manifest.forget_adapter(name)
         payload = {
             "op": op, "name": name, "pub_id": pub_id, "step": step,
             "complete": complete, "aborted": aborted,

@@ -1,0 +1,381 @@
+"""Gateway crash recovery: fleet manifest + adoption replay (ISSUE 20).
+
+Every plane below the gateway is crash-consistent — manifested
+checkpoints, journaled bulk jobs, append-only usage ledgers, atomic
+adapter publication — but the gateway process itself was the last
+unprotected state: kill -9 it and the fleet roster, parked/quarantined
+autoscale decisions, adapter generation map and tenant admission levels
+all vanished, orphaning healthy subprocess replicas that then had to be
+cold-restarted. This module makes a gateway restart a non-event:
+
+- :class:`FleetManifest` — a crash-consistent JSON snapshot
+  (``gateway-manifest.json``) rewritten atomically (tmp + ``os.replace``,
+  the checkpoint/bulk idiom) on every fleet mutation: spawn, park,
+  quarantine, drain, relaunch, adapter publish. It records each
+  replica's pid/port/role/state, the admission plane's token-bucket
+  levels (keyed on credential-safe tenant labels — raw bearers never
+  leave admission.py, the ISSUE 15 discipline), and the adapter
+  publication map.
+- :func:`recover_fleet` — on ``--recover DIR`` the new incarnation
+  **adopts** still-running subprocess replicas (pid liveness via signal
+  0 AND a live /health answer on the recorded port — a recycled pid or
+  a stranger on the port fails the cross-check and the replica is
+  relaunched on a fresh port instead; stale state never aliases, the
+  same vetting rule the connection pool applies to its sockets) and
+  restores parked/quarantined flags BEFORE anything starts, so the
+  supervisor keeps treating down-on-purpose replicas as down on purpose.
+- :func:`replay_action_tail` — rebuilds the ActionPlanner's cooldown
+  stamps (``_last_scale``, per-target remediation recency) from the
+  ``action.executed`` tail of the previous incarnation's journal, so a
+  recovered gateway does not immediately re-plan an action whose
+  cooldown had not expired when the old gateway died.
+- :func:`reconcile_adapters` — reads every routable replica's live
+  ``GET /v1/adapters`` (the replicas, not the manifest, are the source
+  of truth for what is actually loaded), takes the fleet view as the max
+  generation per name, and converges stragglers through the existing
+  re-publish path (AdapterPublisher.run is idempotent per ISSUE 16).
+
+Everything here is stdlib-only (no jax), like the rest of ``gateway/``
+— the import-layering analysis rule.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from ditl_tpu.telemetry.journal import merge_journals
+from ditl_tpu.utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "FleetManifest",
+    "load_manifest",
+    "manifest_path",
+    "recover_fleet",
+    "reconcile_adapters",
+    "replay_action_tail",
+]
+
+MANIFEST_VERSION = 1
+MANIFEST_FILENAME = "gateway-manifest.json"
+
+
+def manifest_path(directory: str) -> str:
+    return os.path.join(directory, MANIFEST_FILENAME)
+
+
+def load_manifest(directory: str) -> dict | None:
+    """Parse the manifest in ``directory``. Returns None when absent or
+    unreadable (a torn write cannot exist — writes are atomic — so a
+    parse failure means no manifest was ever completed there)."""
+    try:
+        with open(manifest_path(directory)) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or "replicas" not in data:
+        return None
+    return data
+
+
+class FleetManifest:
+    """Crash-consistent fleet state snapshot, rewritten whole on every
+    mutation.
+
+    The owner wires ``fleet`` (a gateway Fleet) and optionally
+    ``admission`` (a TenantAdmission) after construction; ``record()``
+    then reads both and writes one atomic JSON file. Adapter
+    publications are pushed in via :meth:`note_adapter` /
+    :meth:`forget_adapter` (the publisher calls them on a converged
+    walk). A periodic :meth:`maybe_refresh` keeps the admission bucket
+    levels from going stale between fleet mutations — bucket levels
+    drain per request and journaling per request would be far too hot.
+    """
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.fleet = None
+        self.admission = None
+        self._adapters: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._last_write = float("-inf")
+
+    # -- adapter map (pushed by AdapterPublisher) ---------------------------
+
+    def note_adapter(self, name: str, directory: str, owner: str = "",
+                     step: int = -1) -> None:
+        with self._lock:
+            self._adapters[name] = {
+                "dir": directory, "owner": owner, "step": step,
+            }
+        self.record()
+
+    def forget_adapter(self, name: str) -> None:
+        with self._lock:
+            self._adapters.pop(name, None)
+        self.record()
+
+    def seed_adapters(self, adapters: dict) -> None:
+        """Carry the previous incarnation's publication map forward into
+        this manifest (recovery path) without triggering a write."""
+        with self._lock:
+            for name, rec in (adapters or {}).items():
+                if isinstance(rec, dict):
+                    self._adapters.setdefault(name, dict(rec))
+
+    # -- writing ------------------------------------------------------------
+
+    def record(self) -> None:
+        """Snapshot fleet + admission + adapters and atomically replace
+        the on-disk manifest. Never raises: the manifest is a recovery
+        aid, and a full disk must not take down the serving path."""
+        fleet = self.fleet
+        if fleet is None:
+            return
+        try:
+            replicas = fleet.manifest_snapshot()
+        except Exception:  # noqa: BLE001 - recovery aid, never fatal
+            logger.exception("manifest fleet snapshot failed")
+            return
+        admission = None
+        if self.admission is not None:
+            try:
+                admission = self.admission.bucket_snapshot()
+            except Exception:  # noqa: BLE001
+                logger.exception("manifest admission snapshot failed")
+        with self._lock:
+            data = {
+                "version": MANIFEST_VERSION,
+                "gateway_pid": os.getpid(),
+                "ts": time.time(),
+                "replicas": replicas,
+                "admission": admission,
+                "adapters": dict(self._adapters),
+            }
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump(data, f, sort_keys=True)
+                os.replace(tmp, self.path)
+            except OSError:
+                logger.exception("manifest write failed: %s", self.path)
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                return
+            self._last_write = time.monotonic()
+
+    def maybe_refresh(self, min_interval_s: float = 2.0) -> None:
+        """Periodic refresh (the supervisor loop calls this once per
+        poll): rewrite at most every ``min_interval_s`` so admission
+        bucket levels in the manifest are bounded-stale without turning
+        every request into a disk write."""
+        if time.monotonic() - self._last_write >= min_interval_s:
+            self.record()
+
+
+# -- recovery orchestration (the --recover path) ---------------------------
+
+
+def recover_fleet(fleet, manifest: dict, *, journal=None, metrics=None,
+                  probe_timeout_s: float = 2.0, log=None) -> dict:
+    """Adopt still-alive replicas and restore parked/quarantined flags
+    from a previous incarnation's manifest. Call BEFORE ``start_all``
+    and BEFORE the supervisor starts: ``start_all`` skips replicas that
+    are already alive (adopted) or down on purpose (restored flags), and
+    the supervisor must never observe a half-restored roster.
+
+    Returns a report dict: ``{"adopted": [...], "relaunched": [...],
+    "parked": [...], "quarantined": [...]}``. Every decision is
+    journaled (``recovery.start`` -> per-replica ``recovery.adopted`` /
+    ``recovery.relaunched`` / ``recovery.restored`` ->
+    ``recovery.done``) so the merged timeline reads
+    ``gateway.crash -> recovery.start -> recovery.adopted x N ->
+    recovery.done`` in causal order."""
+    log = log or (lambda msg: logger.info("%s", msg))
+    records = manifest.get("replicas") or {}
+    _journal(journal, "recovery.start",
+             manifest_pid=manifest.get("gateway_pid"),
+             manifest_ts=manifest.get("ts"),
+             replicas=sorted(records))
+    if metrics is not None:
+        metrics.recovery_runs.inc()
+    report = {"adopted": [], "relaunched": [], "parked": [],
+              "quarantined": []}
+    for rid in fleet.ids:
+        rec = records.get(rid)
+        if not isinstance(rec, dict):
+            # Unknown to the previous incarnation (fleet grew):
+            # start_all launches it fresh, nothing to restore.
+            continue
+        if rec.get("quarantined"):
+            # Down on purpose — the crash-loop breaker is NOT reversed
+            # by a gateway restart (only an operator clears it). Restore
+            # the flag before the supervisor can try to "heal" it. Never
+            # adopt: even a live pid under a quarantined id stays
+            # excluded.
+            fleet.set_quarantined(rid, True)
+            report["quarantined"].append(rid)
+            _journal(journal, "recovery.restored", replica=rid,
+                     state="quarantined")
+            log(f"recovery: {rid} restored quarantined (stays excluded)")
+            continue
+        if rec.get("deactivated"):
+            fleet.set_deactivated(rid, True)
+            report["parked"].append(rid)
+            _journal(journal, "recovery.restored", replica=rid,
+                     state="parked")
+            log(f"recovery: {rid} restored parked (stays parked)")
+            continue
+        why = _try_adopt(fleet, rid, rec, probe_timeout_s)
+        if why is None:
+            report["adopted"].append(rid)
+            if metrics is not None:
+                metrics.recovery_adopted.inc()
+            _journal(journal, "recovery.adopted", replica=rid,
+                     pid=rec.get("pid"), port=rec.get("port"))
+            log(f"recovery: adopted {rid} "
+                f"(pid {rec.get('pid')}, port {rec.get('port')})")
+        else:
+            report["relaunched"].append(rid)
+            if metrics is not None:
+                metrics.recovery_relaunched.inc()
+            _journal(journal, "recovery.relaunched", replica=rid,
+                     pid=rec.get("pid"), port=rec.get("port"), why=why)
+            log(f"recovery: {rid} not adoptable ({why}); relaunching")
+    _journal(journal, "recovery.done", **{k: sorted(v)
+                                          for k, v in report.items()})
+    return report
+
+
+def _try_adopt(fleet, rid: str, rec: dict,
+               probe_timeout_s: float) -> str | None:
+    """Adopt one replica from its manifest record. Returns None on
+    success, else the reason the record is stale. The stale-manifest
+    signature is exactly this pair of checks failing:
+
+    - pid liveness (signal 0) — the process the old gateway spawned is
+      gone; and/or
+    - a /health answer on the recorded port — a pid alone proves
+      nothing (pids recycle), and a listener alone proves nothing (the
+      port may have been rebound by a stranger). Only both together
+      adopt; anything less relaunches on a FRESH port, so a stale
+      record can never alias live traffic onto the wrong process — the
+      same never-alias rule the connection pool applies at checkout."""
+    handle = fleet.handle(rid)
+    adopt = getattr(handle, "adopt", None)
+    if adopt is None:
+        return "handle has no adopt support"
+    if not adopt(rec.get("pid"), rec.get("port")):
+        return "recorded pid not alive"
+    if not fleet.probe(rid, timeout=probe_timeout_s):
+        # Pid exists but nothing answers /health on the recorded port:
+        # recycled pid, wedged process, or rebound port. Abandon WITHOUT
+        # signaling — the pid may belong to an innocent stranger.
+        handle.abandon_adoption()
+        return "no /health answer on recorded port"
+    return None
+
+
+def replay_action_tail(journal_dir: str, planner, *,
+                       journal=None) -> int:
+    """Rebuild the ActionPlanner's cooldown stamps from the previous
+    incarnation's ``action.executed`` journal tail. Only cooldown
+    recency is replayed (when did the last scale land, when was each
+    target last remediated) — parked/quarantined MEMBERSHIP comes from
+    the manifest, which is authoritative for state, while the journal
+    is authoritative for timing. Returns the number of rows replayed."""
+    replayed = 0
+    for rec in merge_journals(journal_dir):
+        if rec.get("event") != "action.executed":
+            continue
+        kind = rec.get("kind")
+        if not kind:
+            continue
+        planner.note_replayed(str(kind), str(rec.get("target") or ""),
+                              float(rec["ts"]))
+        replayed += 1
+    if replayed:
+        _journal(journal, "recovery.actions_replayed", rows=replayed)
+    return replayed
+
+
+def reconcile_adapters(fleet, manifest: dict, publisher, *,
+                       journal=None, timeout_s: float = 5.0) -> dict:
+    """Rebuild the fleet adapter view from each routable replica's live
+    ``GET /v1/adapters`` and converge stragglers via re-publish.
+
+    The replicas — not the dead gateway's manifest — are the source of
+    truth for what is actually loaded; the manifest contributes only
+    the checkpoint directory/owner needed to re-run a publication. The
+    fleet view per name is the MAX generation any replica reports;
+    replicas missing the name or behind on generation are stragglers,
+    and one idempotent ``publisher.run("publish", ...)`` walk converges
+    them (ISSUE 16's crash-equivalent abort semantics make re-running
+    always safe). Returns ``{name: {"generation": max_gen,
+    "stragglers": [...], "republished": bool}}``."""
+    known = {name: rec for name, rec in
+             (manifest.get("adapters") or {}).items()
+             if isinstance(rec, dict)}
+    views = sorted(fleet.routable(), key=lambda v: v.id)
+    per_replica: dict[str, dict[str, int]] = {}
+    for view in views:
+        try:
+            listing = fleet.pool.get_json(
+                view.id, view.address, "/v1/adapters", timeout=timeout_s)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(listing, dict):
+            continue
+        per_replica[view.id] = {
+            str(a.get("name")): int(a.get("generation") or 0)
+            for a in listing.get("adapters") or []
+            if a.get("name")
+        }
+    names = set(known)
+    for gens in per_replica.values():
+        names.update(gens)
+    out: dict[str, dict] = {}
+    for name in sorted(names):
+        fleet_gen = max((gens.get(name, 0)
+                         for gens in per_replica.values()), default=0)
+        stragglers = sorted(
+            rid for rid, gens in per_replica.items()
+            if gens.get(name, 0) < fleet_gen or name not in gens
+        )
+        republished = False
+        rec = known.get(name)
+        if stragglers and rec and rec.get("dir"):
+            # The existing re-publish path: verify at the edge, walk
+            # every routable replica, journal every hop. Failure is
+            # non-fatal here — the operator re-runs the publication.
+            try:
+                status, _ = publisher.run(
+                    "publish", name, rec.get("dir", ""),
+                    rec.get("owner", ""))
+                republished = status == 200
+            except Exception:  # noqa: BLE001 - recovery must finish
+                logger.exception("adapter re-publish failed: %s", name)
+        out[name] = {"generation": fleet_gen, "stragglers": stragglers,
+                     "republished": republished}
+    if names:
+        _journal(journal, "recovery.adapters",
+                 fleet_view={n: out[n]["generation"] for n in out},
+                 stragglers={n: out[n]["stragglers"]
+                             for n in out if out[n]["stragglers"]})
+    return out
+
+
+def _journal(journal, event: str, **attrs) -> None:
+    if journal is None:
+        return
+    try:
+        journal.event(event, **attrs)
+    except Exception:  # noqa: BLE001 - journaling never blocks recovery
+        logger.exception("recovery journal write failed")

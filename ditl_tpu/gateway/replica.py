@@ -30,6 +30,8 @@ import collections
 import dataclasses
 import http.client
 import json
+import os
+import signal
 import subprocess
 import threading
 import time
@@ -37,6 +39,7 @@ import urllib.error
 import urllib.request
 from typing import Callable, Sequence
 
+from ditl_tpu.chaos.plane import maybe_inject
 from ditl_tpu.gateway.pool import ConnectionPool
 from ditl_tpu.telemetry.journal import EventJournal
 from ditl_tpu.utils.logging import get_logger
@@ -58,8 +61,6 @@ def gateway_journal_path(directory: str) -> str:
     """The gateway's journal file — an ``events-*.jsonl`` sibling of the
     elastic controller's, so ``merge_journals`` folds serving and training
     events into one pod timeline when they share a directory."""
-    import os
-
     return os.path.join(directory, "events-gateway.jsonl")
 
 
@@ -203,7 +204,18 @@ class SubprocessReplica(ReplicaHandle):
     (re)launch binds a fresh port (a SIGKILLed listener can linger in
     TIME_WAIT — the same reason runtime/elastic.py bumps its coordinator
     port per generation). ``stop(drain=True)`` sends SIGTERM, which the
-    server satellite turns into a graceful drain."""
+    server satellite turns into a graceful drain.
+
+    **Adoption (ISSUE 20).** A SIGKILLed gateway orphans its replica
+    subprocesses — they reparent to init and keep serving. A recovering
+    gateway calls :meth:`adopt` with the pid/port its predecessor's
+    manifest recorded instead of relaunching: the handle then tracks the
+    process by pid (signal 0 for liveness, SIGTERM/SIGKILL for stops —
+    ``Popen.wait`` is impossible on a non-child, so stops poll for pid
+    death). ``adopt`` only verifies pid liveness; the caller MUST
+    cross-check with a /health probe on the recorded port before routing
+    (a recycled pid or a rebound port must never alias — see
+    gateway/recovery.py)."""
 
     def __init__(
         self,
@@ -226,14 +238,91 @@ class SubprocessReplica(ReplicaHandle):
         self._env = env
         self._proc: subprocess.Popen | None = None
         self._port: int | None = None
+        # Adoption state (ISSUE 20): a pid inherited from a previous
+        # gateway incarnation's manifest. Mutually exclusive with _proc
+        # (a handle either spawned its process or adopted it).
+        self._adopted_pid: int | None = None
 
     def start(self) -> None:
+        self._adopted_pid = None
         self._port = self._port_factory()
         self._proc = subprocess.Popen(
             list(self._build_argv(self._port)), env=self._env
         )
 
+    # -- adoption (ISSUE 20) ------------------------------------------------
+
+    def adopt(self, pid, port) -> bool:
+        """Take ownership of a still-running replica process from a
+        previous gateway incarnation. Verifies pid liveness (signal 0)
+        only — the caller cross-checks with a /health probe on the port
+        before routing anything. Returns False (and adopts nothing) on
+        a dead/invalid pid."""
+        try:
+            pid = int(pid)
+            port = int(port)
+        except (TypeError, ValueError):
+            return False
+        if pid <= 0 or port <= 0:
+            return False
+        try:
+            os.kill(pid, 0)
+        except OSError:
+            return False
+        self._proc = None
+        self._adopted_pid = pid
+        self._port = port
+        return True
+
+    def abandon_adoption(self) -> None:
+        """Forget an adoption that failed its health cross-check WITHOUT
+        signaling the pid (it may belong to an innocent recycled-pid
+        stranger). The next ``start()`` relaunches on a fresh port."""
+        self._adopted_pid = None
+        self._port = None
+
+    @property
+    def pid(self) -> int | None:
+        """The replica process id — spawned or adopted — for the fleet
+        manifest. None when not running."""
+        if self._proc is not None:
+            return self._proc.pid
+        return self._adopted_pid
+
+    def _adopted_wait(self, timeout: float) -> bool:
+        """Poll an adopted (non-child, un-``wait``-able) pid for death;
+        True once it is gone."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                os.kill(self._adopted_pid, 0)
+            except OSError:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(0.05)
+
+    def _stop_adopted(self, drain: bool, timeout: float) -> None:
+        try:
+            if drain:
+                os.kill(self._adopted_pid, signal.SIGTERM)
+                if self._adopted_wait(timeout):
+                    self._adopted_pid = None
+                    return
+                logger.warning(
+                    "adopted replica %s did not drain in %.1fs; killing",
+                    self.id, timeout,
+                )
+            os.kill(self._adopted_pid, signal.SIGKILL)
+            self._adopted_wait(10.0)
+        except OSError:
+            pass
+        self._adopted_pid = None
+
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
+        if self._adopted_pid is not None:
+            self._stop_adopted(drain, timeout)
+            return
         proc, self._proc = self._proc, None
         if proc is None or proc.poll() is not None:
             return
@@ -254,6 +343,9 @@ class SubprocessReplica(ReplicaHandle):
             pass
 
     def kill(self) -> None:
+        if self._adopted_pid is not None:
+            self._stop_adopted(drain=False, timeout=0.0)
+            return
         proc, self._proc = self._proc, None
         if proc is not None and proc.poll() is None:
             try:
@@ -263,7 +355,15 @@ class SubprocessReplica(ReplicaHandle):
                 pass
 
     def alive(self) -> bool:
-        return self._proc is not None and self._proc.poll() is None
+        if self._proc is not None:
+            return self._proc.poll() is None
+        if self._adopted_pid is not None:
+            try:
+                os.kill(self._adopted_pid, 0)
+                return True
+            except OSError:
+                return False
+        return False
 
     @property
     def address(self) -> tuple[str, int] | None:
@@ -406,6 +506,12 @@ class Fleet:
             )
         self.default_capacity = default_capacity
         self.cache_window_polls = cache_window_polls
+        # Optional FleetManifest (gateway/recovery.py, ISSUE 20): when
+        # installed, every fleet mutation below re-records the
+        # crash-consistent on-disk snapshot a --recover incarnation
+        # adopts from. None on manifest-less fleets (tests, ephemeral
+        # gateways) — zero overhead then.
+        self._manifest = None
         # Upstream keep-alive pool (ISSUE 14): shared by the gateway's
         # relay plane, the supervisor's health polls, and the fan-out
         # probes — one pool per fleet so lifecycle invalidation has one
@@ -426,6 +532,20 @@ class Fleet:
             h.pool = self.pool
 
     @property
+    def manifest(self):
+        return self._manifest
+
+    @manifest.setter
+    def manifest(self, manifest) -> None:
+        """Installing a manifest wires its fleet back-reference in the
+        same breath — record() reads ``manifest.fleet``, and a manifest
+        installed without the back-reference would silently no-op on
+        every mutation (exactly the bug this setter exists to prevent)."""
+        self._manifest = manifest
+        if manifest is not None:
+            manifest.fleet = self
+
+    @property
     def ids(self) -> list[str]:
         return list(self._states)
 
@@ -437,12 +557,25 @@ class Fleet:
     def start_all(self, wait_healthy_s: float = 0.0) -> None:
         """Start every replica; optionally block until each answers
         /health (subprocess replicas pay a jax import + engine build before
-        the port even opens)."""
+        the port even opens).
+
+        Recovery-aware (ISSUE 20): replicas that are already alive
+        (adopted from a previous incarnation) are not restarted, and
+        replicas restored as parked/quarantined are down on purpose —
+        both are skipped. On a fresh fleet neither condition holds and
+        every replica starts, as before."""
         for st in self._states.values():
+            if st.deactivated or st.quarantined:
+                continue
+            if st.handle.alive():
+                continue
             st.handle.start()
         if wait_healthy_s > 0:
             deadline = time.monotonic() + wait_healthy_s
             for rid in self.ids:
+                st = self._states[rid]
+                if st.deactivated or st.quarantined:
+                    continue
                 while time.monotonic() < deadline:
                     if self.probe(rid):
                         break
@@ -452,6 +585,7 @@ class Fleet:
                         f"replica {rid} not healthy after "
                         f"{wait_healthy_s:.0f}s"
                     )
+        self._record_manifest()
 
     def stop_all(self, drain: bool = True, timeout: float = 30.0) -> None:
         # Parked upstream sockets must not hold the replicas' drains open
@@ -462,6 +596,7 @@ class Fleet:
             st.handle.stop(drain=drain, timeout=timeout)
             with self._lock:
                 st.live = False
+        self._record_manifest()
 
     def probe(self, replica_id: str, timeout: float = 2.0) -> bool:
         """One health poll, folded into the routing state. Returns True if
@@ -600,6 +735,7 @@ class Fleet:
     def mark_draining(self, replica_id: str, draining: bool) -> None:
         with self._lock:
             self._states[replica_id].draining = draining
+        self._record_manifest()
 
     # -- actuation-plane state (ISSUE 12) -----------------------------------
 
@@ -611,12 +747,14 @@ class Fleet:
             # keep-alive sockets to it are dead weight that would read as
             # a stale-socket storm later (ISSUE 14 lifecycle hook).
             self.pool.invalidate(replica_id)
+        self._record_manifest()
 
     def set_quarantined(self, replica_id: str, quarantined: bool) -> None:
         with self._lock:
             self._states[replica_id].quarantined = quarantined
         if quarantined:
             self.pool.invalidate(replica_id)
+        self._record_manifest()
 
     def active_ids(self) -> list[str]:
         """Replicas participating in serving (not parked, not
@@ -640,6 +778,38 @@ class Fleet:
 
     def _state(self, replica_id: str) -> _ReplicaState:
         return self._states[replica_id]
+
+    # -- crash-recovery manifest (ISSUE 20) ----------------------------------
+
+    def manifest_snapshot(self) -> dict:
+        """One locked snapshot of every replica's recoverable identity:
+        pid (None on handle kinds that cannot be adopted), address, role
+        and the down-on-purpose flags — the per-replica records a
+        FleetManifest writes."""
+        with self._lock:
+            out = {}
+            for rid, st in self._states.items():
+                addr = st.handle.address
+                out[rid] = {
+                    "pid": getattr(st.handle, "pid", None),
+                    "host": addr[0] if addr else None,
+                    "port": addr[1] if addr else None,
+                    "role": st.handle.role,
+                    "live": st.live,
+                    "draining": st.draining,
+                    "deactivated": st.deactivated,
+                    "quarantined": st.quarantined,
+                    "restarts": st.restarts,
+                }
+            return out
+
+    def _record_manifest(self) -> None:
+        """Re-record the crash-recovery manifest after a fleet mutation.
+        Called OUTSIDE the fleet state lock (record() re-enters it via
+        manifest_snapshot). No-op on manifest-less fleets."""
+        manifest = self.manifest
+        if manifest is not None:
+            manifest.record()
 
 
 class FleetSupervisor:
@@ -740,10 +910,28 @@ class FleetSupervisor:
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
+            # Chaos seam (ISSUE 20): the gateway-process SIGKILL the
+            # crash-recovery drill injects. The kill is orchestrated
+            # here — journaled FIRST (line-buffered, so the crash row
+            # survives the kill and the merged timeline reads
+            # gateway.crash -> recovery.start in causal order with
+            # chaos attribution) — then executed, uncatchable.
+            fault = maybe_inject("gateway.crash", handles=("kill",))
+            if fault is not None and fault.action == "kill":
+                self.journal_event("gateway.crash", chaos=True,
+                                   site=fault.site)
+                fault.kill_now()
             try:
                 self.poll_once()
             except Exception:
                 logger.exception("fleet supervisor poll failed")
+            manifest = self.fleet.manifest
+            if manifest is not None:
+                # Bounded-staleness refresh: keeps the slow-moving
+                # non-mutation parts of the manifest (admission bucket
+                # levels, liveness bits) at most a couple of seconds
+                # stale without a write per request.
+                manifest.maybe_refresh()
             if self.anomaly is not None:
                 # Headless anomaly cadence (ISSUE 10): the health loop is
                 # the gateway's only periodic thread, so storm detectors

@@ -136,6 +136,7 @@ _ROWS: tuple = (
     ("ditl_gateway_action_<kind>_failed_total", "counter", "action kind (scale_up/scale_down/drain/quarantine)", "autoscale/remediation actions that failed mid-execution (also incident-bundled)", True),
     ("ditl_gateway_action_<kind>_planned_total", "counter", "action kind (scale_up/scale_down/drain/quarantine)", "autoscale/remediation actions the planner produced", True),
     ("ditl_gateway_action_<kind>_refused_total", "counter", "action kind (scale_up/scale_down/drain/quarantine)", "autoscale/remediation actions refused at execute time (bounds/state re-check under the fleet-mutation lock)", True),
+    ("ditl_gateway_admission_amnesty_total", "counter", "", "tenants admitted with a fresh (full) token bucket after a gateway restart because the recovery manifest had no snapshot for them (ISSUE 20: the counted restart-amnesty fallback)"),
     ("ditl_gateway_affinity_hits_total", "counter", "", "requests routed to the same replica as the previous request with the same affinity key"),
     ("ditl_gateway_affinity_misses_total", "counter", "", "requests whose affinity key landed on a different replica than last time"),
     ("ditl_gateway_cold_start_429_total", "counter", "", "requests answered 429 with a wake-up Retry-After while serving capacity was parked (scale-to-zero admission)", True),
@@ -161,6 +162,9 @@ _ROWS: tuple = (
     ("ditl_gateway_pool_hits", "gauge", "", "pooled upstream connections reused across relays/polls/probes (lifetime, stats mirror)"),
     ("ditl_gateway_pool_idle", "gauge", "", "idle kept-alive upstream connections currently parked in the pool"),
     ("ditl_gateway_pool_misses", "gauge", "", "upstream hops that had to open a fresh connection (lifetime, stats mirror)"),
+    ("ditl_gateway_recovery_adopted_total", "counter", "", "still-alive replica subprocesses adopted (pid + /health vetted) by a --recover incarnation instead of being restarted (ISSUE 20)"),
+    ("ditl_gateway_recovery_relaunched_total", "counter", "", "manifest replicas a --recover incarnation could NOT adopt (dead pid or no /health answer) and left for a fresh-port relaunch (ISSUE 20; nonzero on an up-to-date manifest means replicas died with the gateway)"),
+    ("ditl_gateway_recovery_runs_total", "counter", "", "gateway crash-recovery passes executed at startup (--recover with a readable manifest, ISSUE 20)"),
     ("ditl_gateway_relayed_by_class_batch_total", "counter", "", "requests relayed carrying SLO class batch"),
     ("ditl_gateway_relayed_by_class_best_effort_total", "counter", "", "requests relayed carrying SLO class best_effort"),
     ("ditl_gateway_relayed_by_class_default_total", "counter", "", "requests relayed carrying SLO class default"),

@@ -1,0 +1,240 @@
+"""Subprocess driver for the gateway crash-recovery drill (ISSUE 20).
+
+One process = one GATEWAY incarnation over four real subprocess stub
+replicas (spawned via this file's ``--stub-replica`` self-exec mode, no
+jax). Phase 1 starts fresh on an empty state dir: r0/r1 serving, r2
+parked, r3 quarantined, a bulk backlog draining, chaos
+``gateway.crash:kill@call=K,max=1`` armed with persisted fire counts —
+the supervisor loop SIGKILLs the gateway process itself mid-load, and
+the orphaned replica subprocesses keep serving. Phase 2 reruns the SAME
+command line: the manifest exists, so the incarnation recovers — adopts
+r0/r1 by pid+/health, restores r2 parked and r3 quarantined, re-warms
+admission, resumes the bulk job from its journal, drains it, and prints
+one JSON summary line for the test to assert on (pids across
+incarnations, adopt/relaunch report, bulk completion).
+
+Usage:
+  python tests/gateway_crash_drill.py STATE_DIR GW_PORT N_ITEMS KILL_AT
+  python tests/gateway_crash_drill.py --stub-replica PORT RID
+
+KILL_AT is the 1-based ``gateway.crash`` chaos consultation (= supervisor
+pass) that dies; 0 runs chaos-free to completion (the control run).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from ditl_tpu.chaos import arm_chaos  # noqa: E402
+from ditl_tpu.config import BulkConfig, ChaosConfig, GatewayConfig  # noqa: E402
+from ditl_tpu.gateway import (  # noqa: E402
+    Fleet,
+    FleetManifest,
+    FleetSupervisor,
+    GatewayMetrics,
+    SubprocessReplica,
+    gateway_journal_path,
+    load_manifest,
+    make_gateway,
+    manifest_path,
+    recover_fleet,
+)
+from ditl_tpu.gateway.bulk import BulkJobManager, load_jobs  # noqa: E402
+from ditl_tpu.telemetry.journal import EventJournal  # noqa: E402
+from ditl_tpu.telemetry.usage import UsageLedger  # noqa: E402
+
+N_REPLICAS = 4  # r0/r1 serving, r2 parked, r3 quarantined
+WINDOW = 4  # bulk max_in_flight: the re-dispatch bound across the kill
+
+
+# ---------------------------------------------------------------------------
+# Stub replica (self-exec mode) — survives the gateway's SIGKILL
+# ---------------------------------------------------------------------------
+
+
+class _StubHandler(BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, status, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.startswith("/v1/adapters"):
+            self._json(200, {"pool_rows": 0, "free_rows": 0,
+                             "adapters": [], "evicted": []})
+            return
+        self._json(200, {"status": "ok", "model": self.server.label,
+                         "draining": False, "queue_depth": 0,
+                         "active_slots": 0, "n_slots": 4})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        try:
+            req = json.loads(self.rfile.read(length) or b"{}")
+        except ValueError:
+            req = {}
+        if req.get("stream"):
+            # SSE: a few spaced chunks, then [DONE] — long enough that
+            # the chaos kill lands mid-stream on some client.
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            for i in range(4):
+                chunk = {"object": "text_completion", "choices": [{
+                    "index": 0, "text": f"tok{i}",
+                    "finish_reason": "stop" if i == 3 else None}]}
+                self.wfile.write(f"data: {json.dumps(chunk)}\n\n".encode())
+                self.wfile.flush()
+                time.sleep(0.1)
+            self.wfile.write(b"data: [DONE]\n\n")
+            return
+        time.sleep(0.05)  # keep a bulk backlog alive across the kill
+        self._json(200, {
+            "object": "text_completion",
+            "choices": [{"index": 0, "text": self.server.label,
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 1, "completion_tokens": 1,
+                      "total_tokens": 2},
+        })
+
+
+def stub_main(port: int, rid: str) -> int:
+    server = ThreadingHTTPServer(("127.0.0.1", port), _StubHandler)
+    server.daemon_threads = True
+    server.label = rid
+    server.serve_forever()
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# One gateway incarnation
+# ---------------------------------------------------------------------------
+
+
+def _build_argv(rid: str):
+    def build(port: int):
+        return [sys.executable, os.path.abspath(__file__),
+                "--stub-replica", str(port), rid]
+
+    return build
+
+
+def run_incarnation(state_dir: str, gw_port: int, n_items: int,
+                    kill_at: int) -> int:
+    bulk_dir = os.path.join(state_dir, "bulk")
+    os.makedirs(bulk_dir, exist_ok=True)
+    prior = load_manifest(state_dir)
+    recovering = prior is not None
+    if kill_at > 0:
+        # Persisted fire counts (chaos-state-0.json under state_dir):
+        # phase 2 arms the SAME rule but max=1 has already fired, and the
+        # chaos journal lands next to the gateway's for one merged chain.
+        arm_chaos(ChaosConfig(
+            rules=f"gateway.crash:kill@call={kill_at},max=1",
+            journal_dir=state_dir))
+    journal = EventJournal(gateway_journal_path(state_dir),
+                           source="gateway")
+    fleet = Fleet([SubprocessReplica(f"r{i}", _build_argv(f"r{i}"))
+                   for i in range(N_REPLICAS)])
+    fleet.manifest = FleetManifest(manifest_path(state_dir))
+    gw_metrics = GatewayMetrics()
+    config = GatewayConfig(tenant_rate=200.0, tenant_burst=400.0,
+                           health_interval_s=0.2)
+    report = None
+    if recovering:
+        report = recover_fleet(fleet, prior, journal=journal,
+                               metrics=gw_metrics,
+                               probe_timeout_s=config.recovery_adopt_timeout_s)
+        fleet.manifest.seed_adapters(prior.get("adapters"))
+    else:
+        # The mid-load fleet shape THE drill demands: one replica parked
+        # by a "scale-down" and one quarantined by "remediation" before
+        # any traffic — both down on purpose, both only flags + manifest.
+        fleet.set_deactivated("r2", True)
+        fleet.set_quarantined("r3", True)
+    fleet.start_all(wait_healthy_s=60.0)
+    supervisor = FleetSupervisor(fleet, interval_s=0.2, fail_threshold=3,
+                                 journal=journal, metrics=gw_metrics)
+    # Pre-existing non-terminal jobs => this is the resume incarnation.
+    resumable = [r for r in load_jobs(bulk_dir)
+                 if r.get("state") in ("queued", "running")]
+    run_n = len(glob.glob(os.path.join(state_dir, "usage-r*.jsonl")))
+    ledger = UsageLedger(os.path.join(state_dir, f"usage-r{run_n}.jsonl"),
+                         source=f"drill-{run_n}")
+    manager = BulkJobManager(
+        bulk_dir, BulkConfig(dir=bulk_dir, max_in_flight=WINDOW),
+        usage=ledger)
+    server = make_gateway(fleet, config=config, metrics=gw_metrics,
+                          port=gw_port, journal=journal, bulk=manager,
+                          recover_manifest=prior)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    if not resumable:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{gw_port}/v1/bulk/jobs",
+            data=json.dumps({
+                "prompts": [f"bulk item {i}" for i in range(n_items)],
+                "max_new": 4,
+            }).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": "Bearer drill-tenant"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            json.loads(resp.read())
+    # The chaos countdown starts here: kill_at supervisor passes from now.
+    supervisor.start()
+    if kill_at > 0 and not recovering:
+        # Phase 1: serve until the supervisor loop's gateway.crash fault
+        # SIGKILLs this process. The watchdog bound means a chaos bug
+        # exits 3 instead of hanging the test harness.
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            time.sleep(0.2)
+        return 3
+    drained = manager.drain(timeout_s=180.0)
+    snapshot = fleet.manifest_snapshot()
+    print(json.dumps({
+        "recovering": recovering,
+        "report": report,
+        "pids": {rid: rec["pid"] for rid, rec in snapshot.items()},
+        "parked": sorted(fleet.parked_ids()),
+        "quarantined": sorted(fleet.quarantined_ids()),
+        "resumed": len(resumable),
+        "drained": drained,
+        "jobs": manager.jobs(),
+    }))
+    supervisor.stop()
+    server.shutdown()
+    server.server_close()
+    manager.close()
+    ledger.close()
+    fleet.stop_all(drain=False)
+    return 0
+
+
+def main() -> int:
+    if sys.argv[1] == "--stub-replica":
+        return stub_main(int(sys.argv[2]), sys.argv[3])
+    state_dir, gw_port, n_items, kill_at = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    return run_incarnation(state_dir, gw_port, n_items, kill_at)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

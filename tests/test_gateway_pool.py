@@ -210,6 +210,52 @@ def test_pool_detects_stale_socket_from_dead_peer():
     fleet.stop_all(drain=False)
 
 
+@pytest.mark.recovery
+def test_adopted_replica_same_port_discards_pre_crash_sockets():
+    """Crash-recovery aliasing pin (ISSUE 20): adoption and same-port
+    relaunch keep the SAME (host, port), so the pool's address check
+    alone can NOT invalidate sockets parked before a crash — only the
+    checkout staleness probe stands between a pre-crash half-open socket
+    and a cross-wired request. Kill the listener a parked socket points
+    at, rebind the SAME port with a different incarnation: checkout must
+    discard the stale socket (counted) and serve from the reborn
+    listener, never write the request down the dead peer's socket."""
+    old = _KAStubServer(("127.0.0.1", 0), _KAStubHandler)
+    old.label = "old-incarnation"
+    threading.Thread(target=old.serve_forever, daemon=True).start()
+    addr = ("127.0.0.1", old.server_address[1])
+    pool = ConnectionPool()
+    body = json.dumps({"prompt": "x", "max_tokens": 1}).encode()
+    hdrs = {"Content-Type": "application/json"}
+    status, _, data = pool.request("r0", addr, "POST", "/v1/completions",
+                                   body=body, headers=hdrs)
+    assert status == 200
+    assert json.loads(data)["choices"][0]["text"] == "old-incarnation"
+    assert pool.idle_count() == 1  # parked socket to the doomed peer
+    old.kill()
+    old.shutdown()
+    old.server_close()
+    # Rebind the SAME port (SO_REUSEADDR — exactly what a recovery
+    # relaunch or an adopted replica's address looks like to the pool).
+    reborn = _KAStubServer(addr, _KAStubHandler)
+    reborn.label = "reborn"
+    threading.Thread(target=reborn.serve_forever, daemon=True).start()
+    try:
+        time.sleep(0.05)
+        d0 = pool.discards
+        status, _, data = pool.request("r0", addr, "POST",
+                                       "/v1/completions", body=body,
+                                       headers=hdrs)
+        assert status == 200
+        assert json.loads(data)["choices"][0]["text"] == "reborn"
+        assert pool.discards == d0 + 1  # the pre-crash socket, discarded
+    finally:
+        reborn.kill()
+        reborn.shutdown()
+        reborn.server_close()
+        pool.close()
+
+
 def test_fleet_health_polls_reuse_pooled_connections():
     servers: list = []
     fleet = _fleet(_stub_replica("r0", servers))

@@ -1,0 +1,624 @@
+"""Gateway crash recovery (ISSUE 20, gateway/recovery.py).
+
+Units pin the crash-consistent manifest (atomic write, parse-or-None),
+the adoption vetting rule (pid liveness AND /health cross-check — a
+recycled pid or a silent port never aliases, an innocent stranger is
+never signaled), the planner cooldown replay, the admission bucket
+re-warm + counted amnesty, and the bounded EADDRINUSE rebind retry.
+
+THE acceptance drill (tests/gateway_crash_drill.py subprocesses):
+SIGKILL the gateway mid-load — open SSE streams, a bulk backlog, one
+parked and one quarantined replica — then rerun the identical command
+line. The --recover incarnation must adopt every live replica with ZERO
+replica restarts (same pids across incarnations), keep parked parked
+and quarantined excluded, drain the bulk backlog gap-free with
+exactly-once billing, and retrying clients must see no non-retryable
+failure. The merged journal reads ``gateway.crash -> recovery.start ->
+recovery.adopted x N -> recovery.done`` in causal order with chaos
+attribution; the chaos-free control run journals zero recovery events.
+"""
+
+from __future__ import annotations
+
+import collections
+import errno
+import glob
+import http.client
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+
+import pytest
+
+from ditl_tpu.config import AutoscaleConfig, GatewayConfig
+from ditl_tpu.gateway import (
+    Fleet,
+    FleetManifest,
+    InProcessReplica,
+    SubprocessReplica,
+    TenantAdmission,
+    TokenBucket,
+    load_manifest,
+    manifest_path,
+    recover_fleet,
+    replay_action_tail,
+    tenant_label,
+)
+from ditl_tpu.gateway.autoscale import ActionPlanner
+from ditl_tpu.gateway.gateway import _bind_with_retry
+from ditl_tpu.gateway.recovery import reconcile_adapters
+from ditl_tpu.infer.server import DrainableHTTPServer
+from ditl_tpu.runtime.elastic import free_port
+from ditl_tpu.telemetry.journal import (
+    EventJournal,
+    merge_journals,
+    read_journal,
+)
+from ditl_tpu.utils.http11 import KeepAliveHandlerMixin
+
+pytestmark = [pytest.mark.gateway, pytest.mark.chaos, pytest.mark.recovery]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRILL = os.path.join(REPO_ROOT, "tests", "gateway_crash_drill.py")
+
+
+# ---------------------------------------------------------------------------
+# In-process stub replicas (manifest/reconcile units)
+# ---------------------------------------------------------------------------
+
+
+class _StubServer(DrainableHTTPServer):
+    label = "stub"
+    adapters: list = []
+
+
+class _StubHandler(KeepAliveHandlerMixin, BaseHTTPRequestHandler):
+    def log_message(self, *args):
+        pass
+
+    def _json(self, status, payload):
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        if self.path.startswith("/v1/adapters"):
+            self._json(200, {"pool_rows": 2, "free_rows": 1,
+                             "adapters": self.server.adapters,
+                             "evicted": []})
+            return
+        self._json(200, {"status": "ok", "model": "stub",
+                         "draining": False, "queue_depth": 0,
+                         "active_slots": 0, "n_slots": 4})
+
+
+def _stub_replica(rid, adapters=None):
+    def factory():
+        server = _StubServer(("127.0.0.1", 0), _StubHandler)
+        server.label = rid
+        server.adapters = adapters or []
+        return server
+
+    return InProcessReplica(rid, factory)
+
+
+# ---------------------------------------------------------------------------
+# Manifest units
+# ---------------------------------------------------------------------------
+
+
+def test_manifest_atomic_roundtrip(tmp_path):
+    """One record() captures replicas + admission + adapters; the file is
+    whole-or-previous (tmp+replace, no tmp leftovers) and loads back."""
+    fleet = Fleet([_stub_replica("r0"), _stub_replica("r1")])
+    fleet.start_all()
+    try:
+        manifest = FleetManifest(manifest_path(str(tmp_path)))
+        fleet.manifest = manifest
+        assert manifest.fleet is fleet  # the setter wires the backref
+        admission = TenantAdmission(rate=2.0, burst=8.0)
+        manifest.admission = admission
+        assert admission.acquire("tenant-a").ok
+        manifest.note_adapter("chat-v2", "/ckpt/chat-v2", owner="t-a",
+                              step=7)
+        fleet.set_deactivated("r1", True)  # mutation -> record
+        data = load_manifest(str(tmp_path))
+        assert data is not None and data["version"] == 1
+        assert data["gateway_pid"] == os.getpid()
+        assert set(data["replicas"]) == {"r0", "r1"}
+        assert data["replicas"]["r1"]["deactivated"] is True
+        assert data["replicas"]["r0"]["port"] == \
+            fleet.handle("r0").address[1]
+        # Credential-safe: the bearer is digested, never stored raw.
+        label = tenant_label("tenant-a")
+        assert label in data["admission"]
+        assert "tenant-a" not in json.dumps(data)
+        assert 0.0 <= data["admission"][label]["tokens"] <= 8.0
+        assert data["adapters"]["chat-v2"] == {
+            "dir": "/ckpt/chat-v2", "owner": "t-a", "step": 7}
+        assert not glob.glob(str(tmp_path / "*.tmp.*"))
+        manifest.forget_adapter("chat-v2")
+        assert load_manifest(str(tmp_path))["adapters"] == {}
+    finally:
+        fleet.stop_all(drain=False)
+
+
+def test_load_manifest_rejects_garbage(tmp_path):
+    assert load_manifest(str(tmp_path)) is None  # absent
+    path = manifest_path(str(tmp_path))
+    with open(path, "w") as f:
+        f.write("{ torn")
+    assert load_manifest(str(tmp_path)) is None  # unparseable
+    with open(path, "w") as f:
+        json.dump({"version": 1}, f)  # no replicas section
+    assert load_manifest(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# Adoption vetting units
+# ---------------------------------------------------------------------------
+
+
+def test_subprocess_adopt_pid_vetting():
+    handle = SubprocessReplica("r0", lambda port: ["true"])
+    # Garbage and non-positive identities adopt nothing.
+    assert handle.adopt(None, 80) is False
+    assert handle.adopt("x", 80) is False
+    assert handle.adopt(0, 80) is False
+    assert handle.adopt(os.getpid(), 0) is False
+    # A dead pid adopts nothing (reap a real child to get one).
+    child = subprocess.Popen(["sleep", "0"])
+    child.wait(timeout=10)
+    assert handle.adopt(child.pid, 8080) is False
+    assert handle.pid is None and handle.address is None
+    # A live pid adopts; abandon clears WITHOUT signaling it.
+    sleeper = subprocess.Popen(["sleep", "30"])
+    try:
+        assert handle.adopt(sleeper.pid, 8080) is True
+        assert handle.pid == sleeper.pid
+        assert handle.alive() is True
+        assert handle.address == ("127.0.0.1", 8080)
+        handle.abandon_adoption()
+        assert handle.pid is None and handle.address is None
+        assert sleeper.poll() is None  # never signaled
+        # Re-adopt, then stop(): SIGTERM path actually takes it down.
+        assert handle.adopt(sleeper.pid, 8080) is True
+        handle.stop(drain=True, timeout=5.0)
+        assert handle.alive() is False
+        assert sleeper.wait(timeout=10) is not None
+    finally:
+        if sleeper.poll() is None:
+            sleeper.kill()
+
+
+def test_recover_fleet_adopts_restores_and_relaunches(tmp_path):
+    """The three recovery outcomes in one fleet: r0 adopts (live pid AND
+    live /health), r1 relaunches (live pid, NO listener — the recycled-
+    pid/stale-port case; the stranger is not signaled), r2 restores
+    quarantined (never adopted, even though its recorded pid is live),
+    r3 restores parked. start_all then launches only r1."""
+    port0 = free_port()
+    stub0 = subprocess.Popen(
+        [sys.executable, DRILL, "--stub-replica", str(port0), "r0"])
+    stranger = subprocess.Popen(["sleep", "60"])
+    handles = [SubprocessReplica(
+        f"r{i}",
+        lambda port, i=i: [sys.executable, DRILL, "--stub-replica",
+                           str(port), f"r{i}"])
+        for i in range(4)]
+    fleet = Fleet(handles)
+    journal = EventJournal(str(tmp_path / "events-gateway.jsonl"),
+                           source="gateway")
+    manifest = {
+        "version": 1, "gateway_pid": 99999, "ts": time.time(),
+        "replicas": {
+            "r0": {"pid": stub0.pid, "host": "127.0.0.1", "port": port0,
+                   "live": True, "draining": False, "deactivated": False,
+                   "quarantined": False},
+            "r1": {"pid": stranger.pid, "host": "127.0.0.1",
+                   "port": free_port(), "live": True, "draining": False,
+                   "deactivated": False, "quarantined": False},
+            "r2": {"pid": stranger.pid, "host": "127.0.0.1", "port": 1,
+                   "live": True, "draining": False, "deactivated": False,
+                   "quarantined": True},
+            "r3": {"pid": None, "host": None, "port": None, "live": False,
+                   "draining": False, "deactivated": True,
+                   "quarantined": False},
+        },
+    }
+    try:
+        deadline = time.monotonic() + 20
+        while not fleet.probe("r0") and time.monotonic() < deadline:
+            time.sleep(0.05)
+        report = recover_fleet(fleet, manifest, journal=journal,
+                               probe_timeout_s=2.0)
+        assert report == {"adopted": ["r0"], "relaunched": ["r1"],
+                          "parked": ["r3"], "quarantined": ["r2"]}
+        assert fleet.handle("r0").pid == stub0.pid
+        assert stranger.poll() is None  # vetting never signals strangers
+        assert fleet.quarantined_ids() == ["r2"]
+        assert fleet.parked_ids() == ["r3"]
+        fleet.start_all(wait_healthy_s=30.0)
+        # Adopted r0 kept its pid (not restarted); r1 launched fresh on a
+        # fresh port; r2/r3 stayed down on purpose.
+        assert fleet.handle("r0").pid == stub0.pid
+        assert fleet.handle("r1").pid not in (None, stranger.pid)
+        assert fleet.handle("r1").address[1] != \
+            manifest["replicas"]["r1"]["port"]
+        assert fleet.probe("r1", timeout=5.0)
+        assert not fleet.handle("r2").alive()
+        assert not fleet.handle("r3").alive()
+        events = [r["event"] for r in
+                  read_journal(str(tmp_path / "events-gateway.jsonl"))]
+        assert events[0] == "recovery.start"
+        assert events[-1] == "recovery.done"
+        assert events.count("recovery.adopted") == 1
+        assert events.count("recovery.relaunched") == 1
+        assert events.count("recovery.restored") == 2
+        relaunch = next(
+            r for r in
+            read_journal(str(tmp_path / "events-gateway.jsonl"))
+            if r["event"] == "recovery.relaunched")
+        assert "no /health answer" in relaunch["why"]
+    finally:
+        fleet.stop_all(drain=False)
+        for p in (stub0, stranger):
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Planner cooldown replay
+# ---------------------------------------------------------------------------
+
+
+def test_replay_action_tail_restamps_cooldowns(tmp_path):
+    journal = EventJournal(str(tmp_path / "events-gateway.jsonl"),
+                           source="gateway")
+    t0 = time.time()
+    rows = [
+        ("action.planned", dict(kind="scale_up", target="")),  # ignored
+        ("action.executed", dict(kind="scale_up", target="")),
+        ("action.executed", dict(kind="drain", target="r1")),
+        ("action.executed", dict(kind="quarantine", target="r2")),
+        ("action.refused", dict(kind="scale_down", target="")),  # ignored
+    ]
+    for event, attrs in rows:
+        journal.event(event, **attrs)
+    planner = ActionPlanner(AutoscaleConfig())
+    replayed = replay_action_tail(str(tmp_path), planner, journal=journal)
+    assert replayed == 3
+    assert planner._last_scale >= t0
+    assert planner._remedy_last["r1"] >= t0
+    assert planner._remedy_last["r2"] >= t0
+    # Out-of-order replay (rotated segments) keeps the NEWEST stamp.
+    newest = planner._remedy_last["r1"]
+    planner.note_replayed("drain", "r1", newest - 100.0)
+    assert planner._remedy_last["r1"] == newest
+    planner.note_replayed("scale_down", "", planner._last_scale - 50.0)
+    assert planner._last_scale >= t0
+    # The replay itself is journaled for the recovery timeline.
+    events = [r["event"] for r in
+              read_journal(str(tmp_path / "events-gateway.jsonl"))]
+    assert events[-1] == "recovery.actions_replayed"
+
+
+# ---------------------------------------------------------------------------
+# Admission re-warm + counted amnesty
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_level_and_restore():
+    bucket = TokenBucket(rate=1.0, burst=10.0)
+    assert bucket.try_take(4.0) == 0.0
+    assert 5.9 < bucket.level() < 6.2
+    # Restore credits the downtime refill and clamps to burst.
+    bucket.restore(2.0, age_s=3.0)
+    assert 4.9 < bucket.level() < 5.2
+    bucket.restore(8.0, age_s=1e6)
+    assert bucket.level() == 10.0
+    bucket.restore(-5.0)
+    assert bucket.level() < 0.01  # clamped at empty, modulo clock refill
+
+
+def test_admission_rewarm_and_counted_amnesty():
+    old = TenantAdmission(rate=0.001, burst=10.0)
+    for _ in range(7):
+        assert old.acquire("tenant-a").ok
+    snapshot = old.bucket_snapshot()
+    label = tenant_label("tenant-a")
+    assert 2.9 < snapshot[label]["tokens"] < 3.2
+    amnesty = []
+    fresh = TenantAdmission(rate=0.001, burst=10.0)
+    fresh.rewarm(snapshot, on_amnesty=lambda: amnesty.append(1))
+    # Known tenant: bucket resumes at its pre-crash level (3 tokens, not
+    # a fresh burst of 10) — a restart is not a rate-limit reset.
+    for _ in range(3):
+        assert fresh.acquire("tenant-a").ok
+    assert not fresh.acquire("tenant-a").ok
+    assert amnesty == []
+    # Unknown tenant: full bucket, but COUNTED.
+    assert fresh.acquire("tenant-b").ok
+    assert amnesty == [1]
+    assert fresh.acquire("tenant-b").ok  # counted once, not per req
+    assert amnesty == [1]
+
+
+def test_rewarm_unarmed_is_free():
+    adm = TenantAdmission(rate=1.0, burst=2.0)
+    assert adm.acquire("t").ok  # no rewarm armed: no amnesty path
+    assert adm.bucket_snapshot()  # snapshot works without rewarm
+
+
+# ---------------------------------------------------------------------------
+# Bind retry (fast-restart satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_bind_with_retry_bounded_eaddrinuse():
+    config = GatewayConfig(recovery_bind_retries=3,
+                           recovery_bind_wait_s=0.01)
+    calls = []
+
+    def flaky(fail_n):
+        def build():
+            calls.append(1)
+            if len(calls) <= fail_n:
+                raise OSError(errno.EADDRINUSE, "in use")
+            return "server"
+
+        return build
+
+    assert _bind_with_retry(flaky(2), config) == "server"
+    assert len(calls) == 3
+    # Budget exhausted: the EADDRINUSE propagates.
+    calls.clear()
+    with pytest.raises(OSError) as e:
+        _bind_with_retry(flaky(99), config)
+    assert e.value.errno == errno.EADDRINUSE
+    assert len(calls) == 4  # 1 + 3 retries
+    # Non-EADDRINUSE errors propagate immediately, no retry.
+    calls.clear()
+
+    def eperm():
+        calls.append(1)
+        raise OSError(errno.EACCES, "nope")
+
+    with pytest.raises(OSError):
+        _bind_with_retry(eperm, config)
+    assert len(calls) == 1
+    # retries=0 fails fast on the first EADDRINUSE.
+    calls.clear()
+    with pytest.raises(OSError):
+        _bind_with_retry(flaky(99),
+                         GatewayConfig(recovery_bind_retries=0))
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------------------------
+# Adapter reconcile
+# ---------------------------------------------------------------------------
+
+
+def test_reconcile_adapters_converges_stragglers(tmp_path):
+    """Fleet view = max generation per name from live listings; replicas
+    missing/behind are stragglers; one re-publish through the manifest's
+    recorded dir converges them. Names the manifest forgot but replicas
+    still serve are reported too (generation without a republish)."""
+    ahead = [{"name": "chat", "row": 0, "generation": 3, "step": 9,
+              "owner": "t-a", "state": "ready", "source": "d"},
+             {"name": "extra", "row": 1, "generation": 1, "step": 2,
+              "owner": "t-b", "state": "ready", "source": "d"}]
+    behind = [{"name": "chat", "row": 0, "generation": 1, "step": 4,
+               "owner": "t-a", "state": "ready", "source": "d"}]
+    fleet = Fleet([_stub_replica("r0", ahead), _stub_replica("r1", behind)])
+    fleet.start_all()
+    calls = []
+
+    class _Publisher:
+        def run(self, op, name, directory, owner):
+            calls.append((op, name, directory, owner))
+            return 200, {"complete": True}
+
+    journal = EventJournal(str(tmp_path / "events-gateway.jsonl"),
+                           source="gateway")
+    try:
+        for rid in fleet.ids:
+            assert fleet.probe(rid, timeout=5.0)
+        manifest = {"replicas": {}, "adapters": {
+            "chat": {"dir": "/ckpt/chat", "owner": "t-a", "step": 9}}}
+        out = reconcile_adapters(fleet, manifest, _Publisher(),
+                                 journal=journal)
+        assert out["chat"] == {"generation": 3, "stragglers": ["r1"],
+                               "republished": True}
+        assert calls == [("publish", "chat", "/ckpt/chat", "t-a")]
+        # "extra" is live on r0 only but the manifest has no dir for it:
+        # reported, not republished (the operator re-publishes by hand).
+        assert out["extra"]["stragglers"] == ["r1"]
+        assert out["extra"]["republished"] is False
+        rec = next(r for r in
+                   read_journal(str(tmp_path / "events-gateway.jsonl"))
+                   if r["event"] == "recovery.adapters")
+        assert rec["fleet_view"]["chat"] == 3
+        assert rec["stragglers"]["chat"] == ["r1"]
+    finally:
+        fleet.stop_all(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance drill
+# ---------------------------------------------------------------------------
+
+
+def _retrying_client(port, stop, out, stream=False):
+    """A client that treats connection errors / 5xx / 429 as retryable —
+    the crash-recovery contract is that it NEVER sees anything else."""
+    body = json.dumps({"prompt": "ping", "max_tokens": 2,
+                       "stream": stream}).encode()
+    while not stop.is_set():
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=15) as resp:
+                payload = resp.read().decode()
+                if not stream or payload.rstrip().endswith("[DONE]"):
+                    out["ok"] += 1
+                    out["last_ok"] = time.time()
+        except urllib.error.HTTPError as e:
+            if e.code < 500 and e.code != 429:
+                out["bad"].append(e.code)
+        except (OSError, http.client.HTTPException, ValueError):
+            pass  # severed mid-crash: retryable by definition
+        time.sleep(0.05)
+
+
+def _kill_manifest_pids(state):
+    data = load_manifest(state) or {"replicas": {}}
+    for rec in data["replicas"].values():
+        pid = rec.get("pid")
+        if pid:
+            try:
+                os.kill(int(pid), 9)
+            except (OSError, ValueError):
+                pass
+
+
+@pytest.mark.multiproc
+def test_crash_recovery_drill(tmp_path):
+    """SIGKILL the gateway mid-load; the --recover rerun adopts the
+    fleet. Asserts the full ISSUE 20 acceptance list."""
+    state = str(tmp_path / "state")
+    os.makedirs(state)
+    port = free_port()
+    cmd = [sys.executable, DRILL, state, str(port), "300", "12"]
+    stop = threading.Event()
+    clients = [{"ok": 0, "bad": [], "last_ok": 0.0} for _ in range(3)]
+    threads = []
+    p1 = subprocess.Popen(cmd, cwd=REPO_ROOT, stderr=subprocess.PIPE)
+    try:
+        deadline = time.monotonic() + 90
+        up = False
+        while time.monotonic() < deadline and p1.poll() is None:
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/stats", timeout=2):
+                    up = True
+                    break
+            except OSError:
+                time.sleep(0.1)
+        assert up, p1.stderr.read().decode() if p1.poll() is not None \
+            else "gateway never answered /stats"
+        # Load through the crash: two plain retry clients + one SSE.
+        for i, out in enumerate(clients):
+            t = threading.Thread(target=_retrying_client,
+                                 args=(port, stop, out, i == 2),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        assert p1.wait(timeout=120) == -9  # the chaos SIGKILL, nothing else
+        # Phase 1's last manifest: the pids phase 2 must adopt verbatim.
+        before = load_manifest(state)
+        pids1 = {rid: rec["pid"]
+                 for rid, rec in before["replicas"].items() if rec["pid"]}
+        assert set(pids1) == {"r0", "r1"}
+        assert before["replicas"]["r2"]["deactivated"] is True
+        assert before["replicas"]["r3"]["quarantined"] is True
+        # The bulk tenant's bucket made it into the admission snapshot
+        # (2s-bounded staleness; the kill lands after the first refresh).
+        assert before["admission"], "admission snapshot missing"
+        phase2_t0 = time.time()
+        p2 = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
+                            timeout=240)
+        assert p2.returncode == 0, p2.stderr.decode()
+        summary = json.loads(p2.stdout.decode().strip().splitlines()[-1])
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if p1.poll() is None:
+            p1.kill()
+        _kill_manifest_pids(state)
+    # Adoption: every live replica adopted, zero relaunches, SAME pids.
+    assert summary["recovering"] is True
+    assert summary["report"]["adopted"] == ["r0", "r1"]
+    assert summary["report"]["relaunched"] == []
+    assert {r: summary["pids"][r] for r in pids1} == pids1
+    # Parked stays parked, quarantined stays excluded.
+    assert summary["parked"] == ["r2"]
+    assert summary["quarantined"] == ["r3"]
+    assert summary["report"]["parked"] == ["r2"]
+    assert summary["report"]["quarantined"] == ["r3"]
+    # Bulk: resumed from the journal, drained gap-free, exactly-once
+    # billing across the per-incarnation ledgers.
+    assert summary["resumed"] == 1 and summary["drained"] is True
+    (job,) = summary["jobs"]
+    assert job["state"] == "completed"
+    assert job["n_done"] == 300 and job["n_failed"] == 0
+    (results_path,) = glob.glob(
+        os.path.join(state, "bulk", "bulk-results-*.jsonl"))
+    with open(results_path) as f:
+        assert [json.loads(ln)["idx"] for ln in f] == list(range(300))
+    billed = collections.Counter()
+    for p in glob.glob(os.path.join(state, "usage-r*.jsonl")):
+        for r in read_journal(p):
+            if r.get("event") == "usage.request":
+                billed[r["item"]] += 1
+    assert set(billed) == set(range(300))
+    assert all(c == 1 for c in billed.values())
+    # Clients: zero non-retryable failures, service observed on BOTH
+    # sides of the crash (successes before the kill and after recovery).
+    for out in clients:
+        assert out["bad"] == [], out["bad"]
+        assert out["ok"] > 0
+        assert out["last_ok"] > phase2_t0
+    # The journal chain, merged across incarnations, in causal order and
+    # chaos-attributed; no supervisor relaunch anywhere (zero restarts).
+    rows = merge_journals(state)
+    events = [r["event"] for r in rows]
+    assert "replica.relaunch" not in events
+    chaos = next(r for r in rows if r["event"] == "chaos.inject")
+    assert chaos["site"] == "gateway.crash"
+    crash = events.index("gateway.crash")
+    assert rows[crash]["chaos"] is True
+    start = events.index("recovery.start")
+    done = events.index("recovery.done")
+    adopted = [i for i, e in enumerate(events) if e == "recovery.adopted"]
+    assert crash < start < min(adopted) <= max(adopted) < done
+    assert len(adopted) == 2
+
+
+@pytest.mark.multiproc
+def test_crash_drill_control_run(tmp_path):
+    """Chaos-free control: same command line, kill_at=0 — runs to
+    completion in one incarnation and journals ZERO recovery events."""
+    state = str(tmp_path / "state")
+    os.makedirs(state)
+    cmd = [sys.executable, DRILL, state, str(free_port()), "40", "0"]
+    try:
+        p = subprocess.run(cmd, cwd=REPO_ROOT, capture_output=True,
+                           timeout=180)
+    finally:
+        _kill_manifest_pids(state)
+    assert p.returncode == 0, p.stderr.decode()
+    summary = json.loads(p.stdout.decode().strip().splitlines()[-1])
+    assert summary["recovering"] is False and summary["drained"] is True
+    (job,) = summary["jobs"]
+    assert job["n_done"] == 40 and job["state"] == "completed"
+    events = [r["event"] for r in merge_journals(state)]
+    assert not any(e.startswith("recovery.") for e in events)
+    assert "gateway.crash" not in events
+    assert "chaos.inject" not in events
+    # The manifest exists and is adoptable — crash consistency is always
+    # on with a journal dir, not a --recover special mode.
+    assert load_manifest(state) is not None
